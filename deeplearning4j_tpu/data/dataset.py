@@ -1,0 +1,78 @@
+"""DataSet containers.
+
+Reference parity: ND4J `DataSet` (features, labels, featuresMask, labelsMask)
+and `MultiDataSet` (lists of each) — the unit every iterator yields and every
+`fit()` consumes. Arrays are host numpy until the train step moves them to
+device (one transfer per batch; double-buffered by AsyncDataSetIterator).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class DataSet:
+    features: np.ndarray
+    labels: Optional[np.ndarray] = None
+    features_mask: Optional[np.ndarray] = None
+    labels_mask: Optional[np.ndarray] = None
+
+    def num_examples(self) -> int:
+        return int(self.features.shape[0])
+
+    def split_test_and_train(self, n_train: int) -> Tuple["DataSet", "DataSet"]:
+        """Reference: DataSet.splitTestAndTrain."""
+        def sl(a, lo, hi):
+            return None if a is None else a[lo:hi]
+
+        train = DataSet(self.features[:n_train], sl(self.labels, 0, n_train),
+                        sl(self.features_mask, 0, n_train), sl(self.labels_mask, 0, n_train))
+        n = self.num_examples()
+        test = DataSet(self.features[n_train:], sl(self.labels, n_train, n),
+                       sl(self.features_mask, n_train, n), sl(self.labels_mask, n_train, n))
+        return train, test
+
+    def shuffle(self, seed: Optional[int] = None) -> "DataSet":
+        rng = np.random.default_rng(seed)
+        idx = rng.permutation(self.num_examples())
+        pick = lambda a: None if a is None else a[idx]
+        return DataSet(self.features[idx], pick(self.labels),
+                       pick(self.features_mask), pick(self.labels_mask))
+
+    def batch_by(self, batch_size: int) -> List["DataSet"]:
+        out = []
+        n = self.num_examples()
+        for lo in range(0, n, batch_size):
+            hi = min(lo + batch_size, n)
+            sl = lambda a: None if a is None else a[lo:hi]
+            out.append(DataSet(self.features[lo:hi], sl(self.labels),
+                               sl(self.features_mask), sl(self.labels_mask)))
+        return out
+
+    @staticmethod
+    def merge(datasets: Sequence["DataSet"]) -> "DataSet":
+        """Reference: DataSet.merge."""
+        cat = lambda xs: None if xs[0] is None else np.concatenate(xs, axis=0)
+        return DataSet(
+            cat([d.features for d in datasets]),
+            cat([d.labels for d in datasets]),
+            cat([d.features_mask for d in datasets]),
+            cat([d.labels_mask for d in datasets]),
+        )
+
+
+@dataclasses.dataclass
+class MultiDataSet:
+    """Multi-input/multi-output container. Reference: ND4J MultiDataSet."""
+
+    features: List[np.ndarray]
+    labels: List[np.ndarray]
+    features_masks: Optional[List[Optional[np.ndarray]]] = None
+    labels_masks: Optional[List[Optional[np.ndarray]]] = None
+
+    def num_examples(self) -> int:
+        return int(self.features[0].shape[0])

@@ -1,0 +1,259 @@
+"""DataSet iterators: base protocol, array-backed, async prefetch, decorators.
+
+Reference parity: `DataSetIterator` (ND4J iface) + dl4j-nn
+`datasets/iterator/`: `AsyncDataSetIterator.java:30-68` (background thread +
+LinkedBlockingQueue — here a Python thread + queue feeding the device while
+TPU computes), `MultipleEpochsIterator`, `EarlyTerminationDataSetIterator`,
+`BenchmarkDataSetIterator` (synthetic fixed batches for throughput
+measurement).
+
+The async iterator is the host↔device overlap seam: JAX dispatch is already
+asynchronous, so the thread only needs to hide HOST-side ETL (decode,
+augmentation, numpy collation), exactly the role the reference gives it.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Callable, Iterator, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+
+
+class DataSetIterator:
+    """Base protocol. Mirrors the reference DataSetIterator (hasNext/next/
+    reset/batch/totalOutcomes) as a Python iterable with reset()."""
+
+    def __iter__(self) -> Iterator[DataSet]:
+        self.reset()
+        return self
+
+    def __next__(self) -> DataSet:
+        raise StopIteration
+
+    def reset(self) -> None:
+        pass
+
+    @property
+    def batch_size(self) -> Optional[int]:
+        return None
+
+    @property
+    def num_outcomes(self) -> Optional[int]:
+        return None
+
+    def async_(self, prefetch: int = 2) -> "AsyncDataSetIterator":
+        return AsyncDataSetIterator(self, prefetch)
+
+
+class ArrayDataSetIterator(DataSetIterator):
+    """Batches over in-memory arrays (the workhorse for tests + canned data)."""
+
+    def __init__(self, features, labels=None, batch_size: int = 32,
+                 features_mask=None, labels_mask=None,
+                 shuffle: bool = False, seed: int = 0, drop_last: bool = False):
+        self._data = DataSet(
+            np.asarray(features),
+            None if labels is None else np.asarray(labels),
+            features_mask, labels_mask,
+        )
+        self._bs = batch_size
+        self._shuffle = shuffle
+        self._seed = seed
+        self._epoch = 0
+        self._drop_last = drop_last
+        self._pos = 0
+        self._cur = self._data
+
+    def reset(self):
+        self._pos = 0
+        if self._shuffle:
+            self._cur = self._data.shuffle(self._seed + self._epoch)
+            self._epoch += 1
+
+    def __next__(self) -> DataSet:
+        n = self._cur.num_examples()
+        if self._pos >= n:
+            raise StopIteration
+        hi = min(self._pos + self._bs, n)
+        if self._drop_last and hi - self._pos < self._bs:
+            raise StopIteration
+        sl = lambda a: None if a is None else a[self._pos:hi]
+        d = DataSet(self._cur.features[self._pos:hi], sl(self._cur.labels),
+                    sl(self._cur.features_mask), sl(self._cur.labels_mask))
+        self._pos = hi
+        return d
+
+    @property
+    def batch_size(self):
+        return self._bs
+
+    @property
+    def num_outcomes(self):
+        if self._data.labels is not None and self._data.labels.ndim >= 2:
+            return int(self._data.labels.shape[-1])
+        return None
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background-thread prefetch. Reference:
+    `datasets/iterator/AsyncDataSetIterator.java:30-68`."""
+
+    _SENTINEL = object()
+
+    def __init__(self, base: DataSetIterator, prefetch: int = 2):
+        self._base = base
+        self._prefetch = prefetch
+        self._queue: Optional[queue.Queue] = None
+        self._thread: Optional[threading.Thread] = None
+        self._error: Optional[BaseException] = None
+        self._stop: Optional[threading.Event] = None
+
+    def _pump(self, q: queue.Queue, stop: threading.Event):
+        try:
+            for d in self._base:
+                # Bounded put that aborts when a reset() orphaned this thread,
+                # so abandoned pumps don't block forever holding batches.
+                while not stop.is_set():
+                    try:
+                        q.put(d, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+        except BaseException as e:  # surfaced on the consumer side
+            self._error = e
+        finally:
+            try:
+                q.put_nowait(self._SENTINEL)
+            except queue.Full:
+                pass
+
+    def reset(self):
+        if self._stop is not None:
+            self._stop.set()
+        self._queue = queue.Queue(maxsize=self._prefetch + 1)  # +1: sentinel
+        self._error = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._pump, args=(self._queue, self._stop), daemon=True)
+        self._thread.start()
+
+    def __next__(self) -> DataSet:
+        if self._queue is None:
+            self.reset()
+        item = self._queue.get()
+        if item is self._SENTINEL:
+            self._queue = None
+            if self._error is not None:
+                raise self._error
+            raise StopIteration
+        return item
+
+    @property
+    def batch_size(self):
+        return self._base.batch_size
+
+    @property
+    def num_outcomes(self):
+        return self._base.num_outcomes
+
+
+class MultipleEpochsIterator(DataSetIterator):
+    """Repeat a base iterator N times. Reference: MultipleEpochsIterator."""
+
+    def __init__(self, base: DataSetIterator, epochs: int):
+        self._base = base
+        self._epochs = epochs
+        self._epoch = 0
+        self._inner: Optional[Iterator] = None
+
+    def reset(self):
+        self._epoch = 0
+        self._inner = iter(self._base)
+
+    def __next__(self) -> DataSet:
+        if self._inner is None:
+            self.reset()
+        while True:
+            try:
+                return next(self._inner)
+            except StopIteration:
+                self._epoch += 1
+                if self._epoch >= self._epochs:
+                    raise
+                self._inner = iter(self._base)
+
+
+class EarlyTerminationDataSetIterator(DataSetIterator):
+    """Cap the number of minibatches. Reference: EarlyTerminationDataSetIterator."""
+
+    def __init__(self, base: DataSetIterator, max_batches: int):
+        self._base = base
+        self._max = max_batches
+        self._count = 0
+        self._inner: Optional[Iterator] = None
+
+    def reset(self):
+        self._count = 0
+        self._inner = iter(self._base)
+
+    def __next__(self) -> DataSet:
+        if self._inner is None:
+            self.reset()
+        if self._count >= self._max:
+            raise StopIteration
+        self._count += 1
+        return next(self._inner)
+
+
+class BenchmarkDataSetIterator(DataSetIterator):
+    """Synthetic fixed batches for throughput measurement. Reference:
+    `datasets/iterator/impl/BenchmarkDataSetIterator.java`."""
+
+    def __init__(self, feature_shape, num_classes: int, num_batches: int,
+                 seed: int = 0, label_shape=None):
+        rng = np.random.default_rng(seed)
+        self._features = rng.standard_normal(feature_shape, dtype=np.float32)
+        b = feature_shape[0]
+        if label_shape is None:
+            labels = np.zeros((b, num_classes), dtype=np.float32)
+            labels[np.arange(b), rng.integers(0, num_classes, b)] = 1.0
+        else:
+            labels = rng.standard_normal(label_shape).astype(np.float32)
+        self._labels = labels
+        self._n = num_batches
+        self._i = 0
+
+    def reset(self):
+        self._i = 0
+
+    def __next__(self) -> DataSet:
+        if self._i >= self._n:
+            raise StopIteration
+        self._i += 1
+        return DataSet(self._features, self._labels)
+
+    @property
+    def batch_size(self):
+        return int(self._features.shape[0])
+
+    @property
+    def num_outcomes(self):
+        return int(self._labels.shape[-1])
+
+
+def as_iterator(data, labels=None, batch_size: int = 32) -> DataSetIterator:
+    """Coerce arrays / DataSet / iterator into a DataSetIterator."""
+    if isinstance(data, DataSetIterator):
+        return data
+    if isinstance(data, DataSet):
+        return ArrayDataSetIterator(
+            data.features, data.labels, batch_size,
+            data.features_mask, data.labels_mask,
+        )
+    return ArrayDataSetIterator(data, labels, batch_size)

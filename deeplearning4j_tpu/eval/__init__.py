@@ -1,0 +1,17 @@
+"""Evaluation metrics.
+
+Reference parity: `eval/` in deeplearning4j-nn — Evaluation (confusion
+matrix / precision / recall / F1), EvaluationBinary, RegressionEvaluation,
+ROC family. Metrics accumulate batch-wise on host numpy (tiny data), matching
+the reference's streaming eval design.
+"""
+
+from deeplearning4j_tpu.eval.evaluation import Evaluation, ConfusionMatrix
+from deeplearning4j_tpu.eval.regression import RegressionEvaluation
+from deeplearning4j_tpu.eval.roc import ROC, ROCBinary, ROCMultiClass
+from deeplearning4j_tpu.eval.binary import EvaluationBinary
+
+__all__ = [
+    "Evaluation", "ConfusionMatrix", "RegressionEvaluation", "ROC",
+    "ROCBinary", "ROCMultiClass", "EvaluationBinary",
+]

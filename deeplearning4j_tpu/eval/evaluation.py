@@ -1,0 +1,148 @@
+"""Classification evaluation: confusion matrix, accuracy, precision/recall/F1.
+
+Reference parity: `eval/Evaluation.java:50` (`eval():218`, `stats():414`,
+precision/recall/F1, confusion matrix) and `eval/ConfusionMatrix.java`.
+Accumulates batch-wise; mask-aware for per-timestep RNN labels (the
+reference's time-series eval path).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class ConfusionMatrix:
+    """Reference: `eval/ConfusionMatrix.java`."""
+
+    def __init__(self, num_classes: int):
+        self.matrix = np.zeros((num_classes, num_classes), dtype=np.int64)
+
+    def add(self, actual: int, predicted: int, count: int = 1):
+        self.matrix[actual, predicted] += count
+
+    def get_count(self, actual: int, predicted: int) -> int:
+        return int(self.matrix[actual, predicted])
+
+    def actual_total(self, cls: int) -> int:
+        return int(self.matrix[cls, :].sum())
+
+    def predicted_total(self, cls: int) -> int:
+        return int(self.matrix[:, cls].sum())
+
+
+class Evaluation:
+    """Streaming classification metrics. Reference: `eval/Evaluation.java`."""
+
+    def __init__(self, num_classes: Optional[int] = None,
+                 labels: Optional[Sequence[str]] = None):
+        self.num_classes = num_classes
+        self.label_names = list(labels) if labels else None
+        self.confusion: Optional[ConfusionMatrix] = None
+
+    def _ensure(self, n: int):
+        if self.confusion is None:
+            self.num_classes = self.num_classes or n
+            self.confusion = ConfusionMatrix(self.num_classes)
+
+    def eval(self, labels, predictions, mask=None):
+        """Accumulate a batch. labels/predictions: one-hot or prob arrays
+        [batch, n] or [batch, time, n]; integer class labels [batch] also
+        accepted. Reference: `eval():218` + evalTimeSeries."""
+        labels = np.asarray(labels)
+        predictions = np.asarray(predictions)
+        if labels.ndim == 3:  # time series → flatten (with mask)
+            B, T, C = labels.shape
+            labels = labels.reshape(B * T, C)
+            predictions = predictions.reshape(B * T, -1)
+            if mask is not None:
+                m = np.asarray(mask).reshape(B * T) > 0
+                labels, predictions = labels[m], predictions[m]
+        if labels.ndim == 2:
+            actual = labels.argmax(axis=-1)
+            n = labels.shape[-1]
+        else:
+            actual = labels.astype(np.int64)
+            n = int(predictions.shape[-1])
+        pred = predictions.argmax(axis=-1)
+        self._ensure(n)
+        np.add.at(self.confusion.matrix, (actual, pred), 1)
+
+    # ---- metrics (reference method names) ----
+    def _tp(self, c):
+        return self.confusion.matrix[c, c]
+
+    def accuracy(self) -> float:
+        m = self.confusion.matrix
+        total = m.sum()
+        return float(np.trace(m) / total) if total else 0.0
+
+    def precision(self, cls: Optional[int] = None) -> float:
+        m = self.confusion.matrix
+        if cls is not None:
+            denom = m[:, cls].sum()
+            return float(m[cls, cls] / denom) if denom else 0.0
+        vals = [self.precision(c) for c in range(self.num_classes)
+                if m[:, c].sum() > 0]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def recall(self, cls: Optional[int] = None) -> float:
+        m = self.confusion.matrix
+        if cls is not None:
+            denom = m[cls, :].sum()
+            return float(m[cls, cls] / denom) if denom else 0.0
+        vals = [self.recall(c) for c in range(self.num_classes)
+                if m[c, :].sum() > 0]
+        return float(np.mean(vals)) if vals else 0.0
+
+    def f1(self, cls: Optional[int] = None) -> float:
+        p, r = self.precision(cls), self.recall(cls)
+        return 2 * p * r / (p + r) if (p + r) else 0.0
+
+    def false_positive_rate(self, cls: int) -> float:
+        m = self.confusion.matrix
+        fp = m[:, cls].sum() - m[cls, cls]
+        tn = m.sum() - m[cls, :].sum() - m[:, cls].sum() + m[cls, cls]
+        return float(fp / (fp + tn)) if (fp + tn) else 0.0
+
+    def matthews_correlation(self, cls: int) -> float:
+        m = self.confusion.matrix
+        tp = m[cls, cls]
+        fp = m[:, cls].sum() - tp
+        fn = m[cls, :].sum() - tp
+        tn = m.sum() - tp - fp - fn
+        denom = np.sqrt(float((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn)))
+        return float((tp * tn - fp * fn) / denom) if denom else 0.0
+
+    def stats(self) -> str:
+        """Human-readable summary. Reference: `stats():414`."""
+        lines = [
+            "========================Evaluation Metrics========================",
+            f" # of classes: {self.num_classes}",
+            f" Accuracy:  {self.accuracy():.4f}",
+            f" Precision: {self.precision():.4f}",
+            f" Recall:    {self.recall():.4f}",
+            f" F1 Score:  {self.f1():.4f}",
+            "",
+            "=========================Confusion Matrix=========================",
+        ]
+        m = self.confusion.matrix
+        names = self.label_names or [str(i) for i in range(self.num_classes)]
+        header = "      " + " ".join(f"{n:>6}" for n in names)
+        lines.append(header)
+        for i in range(self.num_classes):
+            row = " ".join(f"{int(m[i, j]):>6}" for j in range(self.num_classes))
+            lines.append(f"{names[i]:>5} {row}")
+        return "\n".join(lines)
+
+    def merge(self, other: "Evaluation") -> "Evaluation":
+        """Combine evaluations from shards (used by distributed eval;
+        reference: Spark-side evaluation aggregation)."""
+        if other.confusion is None:
+            return self
+        if self.confusion is None:
+            self.num_classes = other.num_classes
+            self.confusion = ConfusionMatrix(other.num_classes)
+        self.confusion.matrix = self.confusion.matrix + other.confusion.matrix
+        return self

@@ -1,0 +1,10 @@
+"""Model runtimes: MultiLayerNetwork (sequential) and ComputationGraph (DAG).
+
+Reference parity: `nn/multilayer/MultiLayerNetwork.java` and
+`nn/graph/ComputationGraph.java`. The eager per-op loop of the reference
+becomes one jitted XLA computation per train step here.
+"""
+
+from deeplearning4j_tpu.models.multilayer import MultiLayerNetwork
+
+__all__ = ["MultiLayerNetwork"]

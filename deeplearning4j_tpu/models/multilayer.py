@@ -1,0 +1,479 @@
+"""MultiLayerNetwork — sequential-stack model runtime.
+
+Reference parity: `nn/multilayer/MultiLayerNetwork.java` — `init():446`,
+`feedForward:752-858`, `fit(DataSetIterator):1046`, `backprop():1147`,
+tBPTT `:1102-1104,1351`, `output:1716-1827`, `computeGradientAndScore():2047`,
+pretrain `:214-301` — and the solver loop
+(`optimize/solvers/StochasticGradientDescent.java:58-98`).
+
+TPU-first redesign: the reference's OUTER HOT LOOP (SURVEY §3.1) ran dozens of
+eager native ops per layer per step; here `fit()` compiles forward + backward
++ updater into ONE donated, jitted XLA computation. Parameters and optimizer
+state are pytrees keyed by layer name (the reference's flattened view arrays
+are available on demand via `params()` for serde/parity). Gradients come from
+`jax.value_and_grad` — the reference's per-layer `backpropGradient` chain is
+the autodiff transpose.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import DataSetIterator, as_iterator
+from deeplearning4j_tpu.nn.config import MultiLayerConfiguration
+from deeplearning4j_tpu.nn.layers.base import Layer
+from deeplearning4j_tpu.nn.layers.recurrent import (
+    BaseRecurrentLayer, Bidirectional, GravesBidirectionalLSTM, LastTimeStep,
+)
+from deeplearning4j_tpu.nn.layers.special import CenterLossOutputLayer
+from deeplearning4j_tpu.optim.listeners import TrainingListener
+from deeplearning4j_tpu.optim.updaters import NoOp, Updater, resolve_updater
+from deeplearning4j_tpu.utils.pytrees import (
+    flatten_params, param_count, tree_norm, unflatten_params,
+)
+
+_tmap = jax.tree_util.tree_map
+
+
+def _dtype_of(name: str):
+    return {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+            "float16": jnp.float16, "float64": jnp.float64}[name]
+
+
+def _is_recurrent(layer: Layer) -> bool:
+    return isinstance(
+        layer, (BaseRecurrentLayer, Bidirectional, GravesBidirectionalLSTM)
+    )
+
+
+def _normalize_grads(grads, mode: str, threshold: float):
+    """Gradient normalization/clipping per layer subtree.
+    Reference: `nn/conf/GradientNormalization.java` applied in BaseLayer."""
+    if mode == "none":
+        return grads
+    if mode == "clip_elementwise_absolute_value":
+        return _tmap(lambda g: jnp.clip(g, -threshold, threshold), grads)
+
+    def per_layer(sub):
+        if mode == "renormalize_l2_per_layer":
+            n = tree_norm(sub)
+            return _tmap(lambda g: g / jnp.maximum(n, 1e-8), sub)
+        if mode == "clip_l2_per_layer":
+            n = tree_norm(sub)
+            scale = jnp.minimum(1.0, threshold / jnp.maximum(n, 1e-8))
+            return _tmap(lambda g: g * scale, sub)
+        if mode == "renormalize_l2_per_param_type":
+            return {k: v / jnp.maximum(jnp.linalg.norm(jnp.ravel(v)), 1e-8)
+                    for k, v in sub.items()}
+        if mode == "clip_l2_per_param_type":
+            out = {}
+            for k, v in sub.items():
+                n = jnp.linalg.norm(jnp.ravel(v))
+                out[k] = v * jnp.minimum(1.0, threshold / jnp.maximum(n, 1e-8))
+            return out
+        raise ValueError(mode)
+
+    return {name: per_layer(sub) for name, sub in grads.items()}
+
+
+class MultiLayerNetwork:
+    """Sequential network runtime over a MultiLayerConfiguration."""
+
+    def __init__(self, conf: MultiLayerConfiguration):
+        self.conf = conf
+        self.layers: Tuple[Layer, ...] = conf.layers
+        self.dtype = _dtype_of(conf.dtype)
+        self.params_tree: Optional[Dict[str, Any]] = None
+        self.state_tree: Dict[str, Any] = {}
+        self.updater_state: Optional[Dict[str, Any]] = None
+        self.iteration = 0
+        self.epoch = 0
+        self.listeners: List[TrainingListener] = []
+        self.last_batch_size: Optional[int] = None
+        self.score_: Optional[float] = None
+        self._rng = jax.random.PRNGKey(conf.seed)
+        self._stateful: set = set()           # layers with persistent state (BN)
+        self._layer_updaters: Dict[str, Updater] = {}
+        self._jit_cache: Dict[Any, Any] = {}
+        self._rnn_carries: Dict[str, Any] = {}  # rnnTimeStep statefulness
+
+    # ------------------------------------------------------------- init
+    def init(self) -> "MultiLayerNetwork":
+        """Initialize params/state. Reference: `MultiLayerNetwork.init():446`."""
+        key = jax.random.PRNGKey(self.conf.seed)
+        params, states = {}, {}
+        it = self.conf.input_type
+        for i, layer in enumerate(self.layers):
+            if it is not None and i in self.conf.preprocessors:
+                it = self.conf.preprocessors[i].output_type(it)
+            key, sub = jax.random.split(key)
+            p, s = layer.init_params(sub, it, self.dtype)
+            params[layer.name] = p
+            states[layer.name] = s
+            if s:
+                self._stateful.add(layer.name)
+            if it is not None:
+                it = layer.output_type(it)
+        self.params_tree = params
+        self.state_tree = states
+        self._build_updaters()
+        self.updater_state = {
+            name: u.init(params[name]) for name, u in self._layer_updaters.items()
+        }
+        return self
+
+    def _build_updaters(self):
+        """Per-layer updaters honoring per-layer overrides + freezing.
+        Reference: `nn/updater/MultiLayerUpdater` / UpdaterBlock grouping."""
+        global_u = resolve_updater(self.conf.updater or "sgd")
+        for layer in self.layers:
+            u = layer.updater if layer.updater is not None else global_u
+            u = resolve_updater(u)
+            if layer.learning_rate is not None and hasattr(u, "learning_rate"):
+                u = dataclasses.replace(u, learning_rate=layer.learning_rate)
+            if layer.frozen:
+                u = NoOp()
+            self._layer_updaters[layer.name] = u
+
+    # ---------------------------------------------------------- forward
+    def _forward(self, params, states, x, *, train: bool, rng, fmask=None,
+                 carries: Optional[Dict[str, Any]] = None,
+                 collect: bool = False):
+        """Run the stack; returns (final_out, out_layer_input, new_states,
+        activations?). Reference: `feedForward:752-858`."""
+        acts = []
+        new_states = {}
+        out_in = x
+        n = len(self.layers)
+        for i, layer in enumerate(self.layers):
+            if i in self.conf.preprocessors:
+                x = self.conf.preprocessors[i].apply(x, fmask)
+            if layer.is_output_layer and i == n - 1:
+                out_in = x
+            st = states.get(layer.name) or None
+            if carries is not None and layer.name in carries:
+                st = carries[layer.name]
+            lrng = None if rng is None else jax.random.fold_in(rng, i)
+            x, new_st = layer.apply(
+                params[layer.name], x, state=st, train=train, rng=lrng, mask=fmask
+            )
+            new_states[layer.name] = new_st
+            if collect:
+                acts.append(x)
+        return x, out_in, new_states, acts
+
+    # ------------------------------------------------------------- loss
+    def _loss(self, params, states, features, labels, fmask, lmask, rng,
+              train: bool = True, carries=None):
+        """Score = output-layer loss + L1/L2 regularization.
+        Reference: `computeGradientAndScore():2047` + calcL1/calcL2."""
+        out, out_in, new_states, _ = self._forward(
+            params, states, features, train=train, rng=rng, fmask=fmask,
+            carries=carries,
+        )
+        out_layer = self.layers[-1]
+        score_mask = lmask if lmask is not None else (
+            fmask if labels is not None and labels.ndim == 3 else None
+        )
+        if isinstance(out_layer, CenterLossOutputLayer):
+            score, cstate = out_layer.score_and_state(
+                params[out_layer.name], out_in, labels,
+                states[out_layer.name], score_mask,
+            )
+            new_states[out_layer.name] = cstate
+        else:
+            score = out_layer.score(params[out_layer.name], out_in, labels, score_mask)
+        reg = sum(
+            layer.regularization(params[layer.name]) for layer in self.layers
+        )
+        return score + reg, new_states
+
+    # ------------------------------------------------------ train step
+    def _get_train_step(self, key):
+        if key in self._jit_cache:
+            return self._jit_cache[key]
+
+        has_fmask, has_lmask, tbptt = key[0], key[1], key[2]
+        mode = self.conf.gradient_normalization
+        thr = self.conf.gradient_normalization_threshold
+        updaters = self._layer_updaters
+        stateful = self._stateful
+        rnn_names = [l.name for l in self.layers if _is_recurrent(l)]
+
+        def step_fn(params, opt_state, states, step, features, labels,
+                    fmask, lmask, rng, carries):
+            def loss_fn(p):
+                return self._loss(p, states, features, labels, fmask, lmask,
+                                  rng, train=True, carries=carries)
+
+            (loss, new_states), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            grads = _normalize_grads(grads, mode, thr)
+            new_params, new_opt = {}, {}
+            for name, u in updaters.items():
+                upd, st = u.apply(grads[name], opt_state[name], params[name], step)
+                new_params[name] = _tmap(lambda a, b: a - b, params[name], upd)
+                new_opt[name] = st
+            persist = {
+                n: (new_states[n] if n in stateful else states.get(n, {}))
+                for n in states
+            }
+            out_carries = {
+                n: _tmap(jax.lax.stop_gradient, new_states[n]) for n in rnn_names
+            } if tbptt else {}
+            return new_params, new_opt, persist, loss, out_carries
+
+        fn = jax.jit(step_fn, donate_argnums=(0, 1, 2))
+        self._jit_cache[key] = fn
+        return fn
+
+    # ---------------------------------------------------------- fit API
+    def fit(self, data, labels=None, *, epochs: int = 1, batch_size: int = 32):
+        """Train. Accepts arrays, a DataSet, or a DataSetIterator.
+        Reference: `fit(DataSetIterator):1046` (+ tBPTT dispatch `:1102`)."""
+        self._check_init()
+        it = as_iterator(data, labels, batch_size)
+        for l in self.listeners:
+            l.on_fit_start(self)
+        for ep in range(epochs):
+            for l in self.listeners:
+                l.on_epoch_start(self, self.epoch)
+            etl_start = time.perf_counter()
+            for ds in it:
+                etl_ms = (time.perf_counter() - etl_start) * 1e3
+                if self.conf.tbptt_fwd_length > 0 and ds.features.ndim == 3:
+                    score = self._fit_tbptt(ds)
+                else:
+                    score = self._fit_batch(ds)
+                self.score_ = score
+                self.iteration += 1
+                for l in self.listeners:
+                    if hasattr(l, "set_etl_time"):
+                        l.set_etl_time(etl_ms)
+                    l.iteration_done(self, self.iteration, self.epoch, score)
+                etl_start = time.perf_counter()
+            for l in self.listeners:
+                l.on_epoch_end(self, self.epoch)
+            self.epoch += 1
+        for l in self.listeners:
+            l.on_fit_end(self)
+        return self
+
+    def _split_rng(self):
+        self._rng, k = jax.random.split(self._rng)
+        return k
+
+    def _check_init(self):
+        if self.params_tree is None:
+            raise RuntimeError(
+                "Network not initialized — call net.init() before "
+                "fit()/output()/score() (reference: MultiLayerNetwork.init())"
+            )
+
+    def _check_input(self, x):
+        it = self.conf.input_type
+        if it is None:
+            return
+        expect = it.shape(int(x.shape[0]))
+        if it.kind == "rnn" and it.timesteps is None:
+            ok = x.ndim == 3 and x.shape[-1] == it.size
+        else:
+            ok = tuple(x.shape) == tuple(expect)
+        if not ok:
+            raise ValueError(
+                f"Input shape {tuple(x.shape)} does not match configured "
+                f"{it!r} (expected {tuple(expect)} for batch={x.shape[0]})"
+            )
+
+    def _fit_batch(self, ds: DataSet) -> float:
+        self._check_input(ds.features)
+        self.last_batch_size = ds.num_examples()
+        key = (ds.features_mask is not None, ds.labels_mask is not None, False)
+        fn = self._get_train_step(key)
+        (self.params_tree, self.updater_state, self.state_tree, loss, _
+         ) = fn(self.params_tree, self.updater_state, self.state_tree,
+                jnp.asarray(self.iteration, jnp.int32),
+                jnp.asarray(ds.features, self.dtype),
+                None if ds.labels is None else jnp.asarray(ds.labels),
+                None if ds.features_mask is None else jnp.asarray(ds.features_mask),
+                None if ds.labels_mask is None else jnp.asarray(ds.labels_mask),
+                self._split_rng(), None)
+        return float(loss)
+
+    def _fit_tbptt(self, ds: DataSet) -> float:
+        """Truncated BPTT: slice time into chunks, carry RNN state across
+        chunks with stop_gradient. Reference: `doTruncatedBPTT`
+        (`MultiLayerNetwork.java:1102-1104,1351`)."""
+        L = self.conf.tbptt_fwd_length
+        T = ds.features.shape[1]
+        if ds.labels is None or ds.labels.ndim != 3:
+            raise ValueError(
+                "Truncated BPTT requires per-timestep (3-D [batch, time, "
+                "n_out]) labels, as the reference's doTruncatedBPTT does; for "
+                "sequence-level labels use tbptt_fwd_length=0"
+            )
+        key = (ds.features_mask is not None, ds.labels_mask is not None, True)
+        fn = self._get_train_step(key)
+        carries = {}
+        losses = []
+        for lo in range(0, T, L):
+            hi = min(lo + L, T)
+            sl = lambda a: None if a is None else jnp.asarray(a[:, lo:hi])
+            (self.params_tree, self.updater_state, self.state_tree, loss,
+             carries) = fn(
+                self.params_tree, self.updater_state, self.state_tree,
+                jnp.asarray(self.iteration, jnp.int32),
+                jnp.asarray(ds.features[:, lo:hi], self.dtype),
+                sl(ds.labels), sl(ds.features_mask), sl(ds.labels_mask),
+                self._split_rng(), carries if carries else None)
+            losses.append(float(loss))
+        self.last_batch_size = ds.num_examples()
+        return float(np.mean(losses))
+
+    # -------------------------------------------------------- inference
+    def output(self, x, train: bool = False):
+        """Forward to final activations. Reference: `output:1716-1827`."""
+        self._check_init()
+        self._check_input(np.asarray(x) if not hasattr(x, "shape") else x)
+        key = ("output", train)
+        if key not in self._jit_cache:
+            def out_fn(params, states, feats):
+                y, _, _, _ = self._forward(
+                    params, states, feats, train=train, rng=None)
+                return y
+            self._jit_cache[key] = jax.jit(out_fn)
+        return self._jit_cache[key](
+            self.params_tree, self.state_tree, jnp.asarray(x, self.dtype))
+
+    def feed_forward(self, x, train: bool = False) -> List[jax.Array]:
+        """All per-layer activations. Reference: `feedForward:752`."""
+        _, _, _, acts = self._forward(
+            self.params_tree, self.state_tree, jnp.asarray(x, self.dtype),
+            train=train, rng=None, collect=True)
+        return acts
+
+    def score(self, data, labels=None) -> float:
+        """Mean loss on data. Reference: `score(DataSet)`."""
+        ds = data if isinstance(data, DataSet) else DataSet(
+            np.asarray(data), np.asarray(labels))
+        loss, _ = self._loss(
+            self.params_tree, self.state_tree,
+            jnp.asarray(ds.features, self.dtype),
+            None if ds.labels is None else jnp.asarray(ds.labels),
+            None if ds.features_mask is None else jnp.asarray(ds.features_mask),
+            None if ds.labels_mask is None else jnp.asarray(ds.labels_mask),
+            rng=None, train=False)
+        return float(loss)
+
+    def predict(self, x) -> np.ndarray:
+        """Argmax class predictions. Reference: `predict(INDArray)`."""
+        return np.asarray(jnp.argmax(self.output(x), axis=-1))
+
+    def evaluate(self, iterator: DataSetIterator):
+        """Reference: `MultiLayerNetwork.evaluate(DataSetIterator)`."""
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+
+        e = Evaluation()
+        for ds in iterator:
+            out = np.asarray(self.output(ds.features))
+            e.eval(ds.labels, out, mask=ds.labels_mask)
+        return e
+
+    # ----------------------------------------------------- rnn stepping
+    def rnn_time_step(self, x):
+        """Stateful single-step inference; carries persist across calls.
+        Reference: `rnnTimeStep` + `rnnClearPreviousState`."""
+        x = jnp.asarray(x, self.dtype)
+        if x.ndim == 2:
+            x = x[:, None, :]
+        out, _, new_states, _ = self._forward(
+            self.params_tree, self.state_tree, x, train=False, rng=None,
+            carries=self._rnn_carries or None)
+        self._rnn_carries = {
+            l.name: new_states[l.name] for l in self.layers if _is_recurrent(l)
+        }
+        return out
+
+    def rnn_clear_previous_state(self):
+        self._rnn_carries = {}
+
+    # -------------------------------------------------------- pretrain
+    def pretrain(self, data, *, epochs: int = 1, batch_size: int = 32):
+        """Greedy layerwise unsupervised pretraining for pretrainable layers
+        (AutoEncoder/RBM/VAE). Reference: `pretrain:214-301`."""
+        it = as_iterator(data, None, batch_size)
+        for idx, layer in enumerate(self.layers):
+            if not layer.is_pretrainable:
+                continue
+            updater = self._layer_updaters[layer.name]
+            opt = updater.init(self.params_tree[layer.name])
+
+            def featurize(feats):
+                x = feats
+                for j in range(idx):
+                    if j in self.conf.preprocessors:
+                        x = self.conf.preprocessors[j].apply(x)
+                    x, _ = self.layers[j].apply(
+                        self.params_tree[self.layers[j].name], x,
+                        state=self.state_tree.get(self.layers[j].name) or None,
+                        train=False, rng=None)
+                if idx in self.conf.preprocessors:
+                    x = self.conf.preprocessors[idx].apply(x)
+                return x
+
+            @jax.jit
+            def pre_step(lp, opt_state, step, feats, rng):
+                x = featurize(feats)
+
+                def loss_fn(p):
+                    return layer.reconstruction_score(p, x, rng=rng)
+
+                loss, grads = jax.value_and_grad(loss_fn)(lp)
+                upd, new_opt = updater.apply(grads, opt_state, lp, step)
+                return _tmap(lambda a, b: a - b, lp, upd), new_opt, loss
+
+            step = 0
+            for _ in range(epochs):
+                for ds in it:
+                    lp, opt, loss = pre_step(
+                        self.params_tree[layer.name], opt,
+                        jnp.asarray(step, jnp.int32),
+                        jnp.asarray(ds.features, self.dtype), self._split_rng())
+                    self.params_tree[layer.name] = lp
+                    step += 1
+        return self
+
+    # ----------------------------------------------------- param views
+    def params(self) -> np.ndarray:
+        """Single flat parameter vector. Reference: `Model.params()`."""
+        flat, _ = flatten_params(self.params_tree)
+        return np.asarray(flat)
+
+    def set_params(self, flat) -> None:
+        self.params_tree = unflatten_params(
+            jnp.asarray(flat), self.params_tree)
+
+    def num_params(self) -> int:
+        return param_count(self.params_tree)
+
+    def set_listeners(self, *listeners: TrainingListener) -> None:
+        self.listeners = list(listeners)
+
+    def add_listener(self, l: TrainingListener) -> None:
+        self.listeners.append(l)
+
+    def clone(self) -> "MultiLayerNetwork":
+        """Deep copy (new runtime, copied params). Reference: MLN.clone()."""
+        other = MultiLayerNetwork(self.conf)
+        other.init()
+        if self.params_tree is not None:
+            other.params_tree = _tmap(lambda a: a, self.params_tree)
+            other.state_tree = jax.tree_util.tree_map(lambda a: a, self.state_tree)
+        return other

@@ -1,0 +1,208 @@
+"""Weight initialization schemes.
+
+Reference parity: `nn/weights/WeightInit.java:47` (enum: XAVIER, RELU,
+DISTRIBUTION, …) and `nn/weights/WeightInitUtil.java`. Fan-in/fan-out follow
+the reference convention: for a dense kernel [n_in, n_out] fan_in = n_in;
+for a conv kernel [kh, kw, c_in, c_out] (our NHWC/HWIO layout) fan_in =
+kh*kw*c_in, fan_out = kh*kw*c_out.
+
+All initializers are pure functions of an explicit `jax.random` key — the
+reference's global `Nd4j.getRandom()` seed (`NeuralNetConfiguration.java:728`)
+maps to the root PRNGKey threaded through model init.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+
+def _fans(shape: Sequence[int]) -> Tuple[int, int]:
+    if len(shape) < 1:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    receptive = math.prod(shape[:-2])
+    return receptive * shape[-2], receptive * shape[-1]
+
+
+def zeros(key, shape, dtype=jnp.float32):
+    return jnp.zeros(shape, dtype)
+
+
+def ones(key, shape, dtype=jnp.float32):
+    return jnp.ones(shape, dtype)
+
+
+def normal(key, shape, dtype=jnp.float32):
+    """Reference WeightInit.NORMAL: N(0, 1/sqrt(fan_in))."""
+    fan_in, _ = _fans(shape)
+    return jax.random.normal(key, shape, dtype) / jnp.sqrt(jnp.asarray(fan_in, dtype))
+
+
+def uniform(key, shape, dtype=jnp.float32):
+    """Reference WeightInit.UNIFORM: U[-a, a], a = 1/sqrt(fan_in)."""
+    fan_in, _ = _fans(shape)
+    a = 1.0 / math.sqrt(max(fan_in, 1))
+    return jax.random.uniform(key, shape, dtype, minval=-a, maxval=a)
+
+
+def xavier(key, shape, dtype=jnp.float32):
+    """Reference WeightInit.XAVIER: N(0, 2/(fan_in+fan_out))."""
+    fan_in, fan_out = _fans(shape)
+    std = math.sqrt(2.0 / max(fan_in + fan_out, 1))
+    return jax.random.normal(key, shape, dtype) * std
+
+
+def xavier_uniform(key, shape, dtype=jnp.float32):
+    """Reference WeightInit.XAVIER_UNIFORM: U[-a, a], a = sqrt(6/(fan_in+fan_out))."""
+    fan_in, fan_out = _fans(shape)
+    a = math.sqrt(6.0 / max(fan_in + fan_out, 1))
+    return jax.random.uniform(key, shape, dtype, minval=-a, maxval=a)
+
+
+def xavier_fan_in(key, shape, dtype=jnp.float32):
+    """Reference WeightInit.XAVIER_FAN_IN: N(0, 1/fan_in)."""
+    fan_in, _ = _fans(shape)
+    return jax.random.normal(key, shape, dtype) / jnp.sqrt(jnp.asarray(max(fan_in, 1), dtype))
+
+
+def relu_init(key, shape, dtype=jnp.float32):
+    """Reference WeightInit.RELU (He): N(0, 2/fan_in)."""
+    fan_in, _ = _fans(shape)
+    return jax.random.normal(key, shape, dtype) * math.sqrt(2.0 / max(fan_in, 1))
+
+
+def relu_uniform(key, shape, dtype=jnp.float32):
+    """Reference WeightInit.RELU_UNIFORM: U[-a, a], a = sqrt(6/fan_in)."""
+    fan_in, _ = _fans(shape)
+    a = math.sqrt(6.0 / max(fan_in, 1))
+    return jax.random.uniform(key, shape, dtype, minval=-a, maxval=a)
+
+
+def sigmoid_uniform(key, shape, dtype=jnp.float32):
+    """Reference WeightInit.SIGMOID_UNIFORM: U[-a, a], a = 4*sqrt(6/(fan_in+fan_out))."""
+    fan_in, fan_out = _fans(shape)
+    a = 4.0 * math.sqrt(6.0 / max(fan_in + fan_out, 1))
+    return jax.random.uniform(key, shape, dtype, minval=-a, maxval=a)
+
+
+def lecun_normal(key, shape, dtype=jnp.float32):
+    fan_in, _ = _fans(shape)
+    return jax.random.normal(key, shape, dtype) * math.sqrt(1.0 / max(fan_in, 1))
+
+
+def lecun_uniform(key, shape, dtype=jnp.float32):
+    fan_in, _ = _fans(shape)
+    a = math.sqrt(3.0 / max(fan_in, 1))
+    return jax.random.uniform(key, shape, dtype, minval=-a, maxval=a)
+
+
+def identity_init(key, shape, dtype=jnp.float32):
+    """Reference WeightInit.IDENTITY (square dense kernels only)."""
+    if len(shape) == 2 and shape[0] == shape[1]:
+        return jnp.eye(shape[0], dtype=dtype)
+    raise ValueError(f"IDENTITY init needs a square 2-D shape, got {shape}")
+
+
+def orthogonal(key, shape, dtype=jnp.float32, gain: float = 1.0):
+    return jax.nn.initializers.orthogonal(scale=gain)(key, shape, dtype)
+
+
+def distribution(dist: str = "normal", **kw) -> Callable:
+    """Reference WeightInit.DISTRIBUTION + `nn/conf/distribution/*`.
+
+    Supported: normal(mean,std), uniform(lower,upper), constant(value),
+    truncated_normal(mean,std), lognormal(mean,std), binomial(n,p).
+    """
+    d = dist.lower()
+
+    def init(key, shape, dtype=jnp.float32):
+        if d == "normal" or d == "gaussian":
+            return kw.get("mean", 0.0) + kw.get("std", 1.0) * jax.random.normal(key, shape, dtype)
+        if d == "uniform":
+            return jax.random.uniform(
+                key, shape, dtype, minval=kw.get("lower", -1.0), maxval=kw.get("upper", 1.0)
+            )
+        if d == "constant":
+            return jnp.full(shape, kw.get("value", 0.0), dtype)
+        if d == "truncated_normal":
+            return kw.get("mean", 0.0) + kw.get("std", 1.0) * jax.random.truncated_normal(
+                key, -2.0, 2.0, shape, dtype
+            )
+        if d == "lognormal":
+            return jnp.exp(
+                kw.get("mean", 0.0) + kw.get("std", 1.0) * jax.random.normal(key, shape, dtype)
+            )
+        if d == "binomial":
+            return jax.random.bernoulli(
+                key, kw.get("p", 0.5), shape + (kw.get("n", 1),)
+            ).sum(-1).astype(dtype)
+        raise ValueError(f"Unknown distribution {dist!r}")
+
+    init.__name__ = f"distribution_{d}"
+    return init
+
+
+_REGISTRY: Dict[str, Callable] = {
+    "zero": zeros,
+    "zeros": zeros,
+    "ones": ones,
+    "normal": normal,
+    "uniform": uniform,
+    "xavier": xavier,
+    "xavier_uniform": xavier_uniform,
+    "xavier_fan_in": xavier_fan_in,
+    "relu": relu_init,
+    "he": relu_init,
+    "relu_uniform": relu_uniform,
+    "sigmoid_uniform": sigmoid_uniform,
+    "lecun_normal": lecun_normal,
+    "lecun_uniform": lecun_uniform,
+    "identity": identity_init,
+    "orthogonal": orthogonal,
+}
+
+
+class WeightInit:
+    """Enum-like accessor mirroring `nn/weights/WeightInit.java:47`."""
+
+    ZERO = "zero"
+    ONES = "ones"
+    NORMAL = "normal"
+    UNIFORM = "uniform"
+    XAVIER = "xavier"
+    XAVIER_UNIFORM = "xavier_uniform"
+    XAVIER_FAN_IN = "xavier_fan_in"
+    RELU = "relu"
+    RELU_UNIFORM = "relu_uniform"
+    SIGMOID_UNIFORM = "sigmoid_uniform"
+    LECUN_NORMAL = "lecun_normal"
+    LECUN_UNIFORM = "lecun_uniform"
+    IDENTITY = "identity"
+    ORTHOGONAL = "orthogonal"
+    DISTRIBUTION = "distribution"
+
+    @staticmethod
+    def get(name_or_fn: Union[str, Callable, None]) -> Callable:
+        if name_or_fn is None:
+            return xavier
+        if callable(name_or_fn):
+            return name_or_fn
+        key = str(name_or_fn).lower()
+        if key not in _REGISTRY:
+            raise ValueError(f"Unknown weight init {name_or_fn!r}; known: {sorted(_REGISTRY)}")
+        return _REGISTRY[key]
+
+    @staticmethod
+    def register(name: str, fn: Callable) -> None:
+        _REGISTRY[name.lower()] = fn
+
+
+def resolve(name_or_fn) -> Callable:
+    return WeightInit.get(name_or_fn)

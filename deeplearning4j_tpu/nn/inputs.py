@@ -1,0 +1,103 @@
+"""Input type descriptors — static shape metadata for layer wiring.
+
+Reference parity: `nn/conf/inputs/InputType.java` (feedForward, recurrent,
+convolutional, convolutionalFlat) used by `setInputType` to auto-insert
+preprocessors and infer nIn. Because XLA requires static shapes, InputType is
+the single source of shape truth at configuration time.
+
+TPU-first deviation from the reference: convolutional activations are NHWC
+(channels-last) — the layout XLA/TPU prefers — instead of the reference's
+NCHW. Recurrent activations are [batch, time, features] instead of the
+reference's [batch, features, time].
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+from deeplearning4j_tpu.utils.serde import register_serde
+
+
+@register_serde
+@dataclasses.dataclass(frozen=True)
+class InputType:
+    """Shape descriptor, batch dimension excluded."""
+
+    kind: str  # "ff" | "rnn" | "cnn" | "cnn_flat" | "cnn3d"
+    size: Optional[int] = None          # ff / rnn feature size
+    timesteps: Optional[int] = None     # rnn sequence length (None = variable at config time)
+    height: Optional[int] = None
+    width: Optional[int] = None
+    channels: Optional[int] = None
+    depth: Optional[int] = None         # cnn3d
+
+    # ---- constructors (mirror InputType.feedForward(...) etc.) ----
+    @staticmethod
+    def feed_forward(size: int) -> "InputType":
+        return InputType(kind="ff", size=int(size))
+
+    @staticmethod
+    def recurrent(size: int, timesteps: Optional[int] = None) -> "InputType":
+        return InputType(kind="rnn", size=int(size), timesteps=timesteps)
+
+    @staticmethod
+    def convolutional(height: int, width: int, channels: int) -> "InputType":
+        return InputType(kind="cnn", height=int(height), width=int(width), channels=int(channels))
+
+    @staticmethod
+    def convolutional_flat(height: int, width: int, channels: int) -> "InputType":
+        """Flattened image rows (e.g. raw MNIST 784-vectors); a preprocessor
+        reshapes to NHWC before the first conv layer.
+        Reference: InputType.convolutionalFlat."""
+        return InputType(
+            kind="cnn_flat", height=int(height), width=int(width), channels=int(channels)
+        )
+
+    @staticmethod
+    def convolutional3d(depth: int, height: int, width: int, channels: int) -> "InputType":
+        return InputType(
+            kind="cnn3d", depth=int(depth), height=int(height), width=int(width),
+            channels=int(channels),
+        )
+
+    # ---- shape math ----
+    def flat_size(self) -> int:
+        if self.kind == "ff":
+            return self.size
+        if self.kind == "rnn":
+            return self.size
+        if self.kind in ("cnn", "cnn_flat"):
+            return self.height * self.width * self.channels
+        if self.kind == "cnn3d":
+            return self.depth * self.height * self.width * self.channels
+        raise ValueError(self.kind)
+
+    def shape(self, batch: int = 1) -> Tuple[int, ...]:
+        """Concrete array shape including a batch dim (NHWC / BTF layouts)."""
+        if self.kind == "ff":
+            return (batch, self.size)
+        if self.kind == "rnn":
+            t = self.timesteps if self.timesteps is not None else 1
+            return (batch, t, self.size)
+        if self.kind == "cnn":
+            return (batch, self.height, self.width, self.channels)
+        if self.kind == "cnn_flat":
+            return (batch, self.height * self.width * self.channels)
+        if self.kind == "cnn3d":
+            return (batch, self.depth, self.height, self.width, self.channels)
+        raise ValueError(self.kind)
+
+    # ---- serde ----
+    def to_dict(self) -> dict:
+        return {k: v for k, v in dataclasses.asdict(self).items() if v is not None}
+
+    @staticmethod
+    def from_dict(d: dict) -> "InputType":
+        return InputType(**d)
+
+    def __repr__(self):
+        fields = ", ".join(
+            f"{k}={v}" for k, v in dataclasses.asdict(self).items() if v is not None and k != "kind"
+        )
+        return f"InputType.{self.kind}({fields})"

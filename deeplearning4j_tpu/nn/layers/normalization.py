@@ -1,0 +1,119 @@
+"""Normalization layers: BatchNorm, LRN, LayerNorm.
+
+Reference parity: `nn/conf/layers/BatchNormalization.java` + impl
+`nn/layers/normalization/BatchNormalization.java` (cuDNN helper seam at
+`:56-64,125,307`) and `LocalResponseNormalization.java`. Running mean/var are
+NON-trainable state carried explicitly through the train step (the reference
+mutates them in place; under jit we return the new state), updated with the
+reference's `decay` EMA semantics.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class BatchNormalization(Layer):
+    """Batch norm over the trailing channel/feature axis (NHWC/ BTF / BF).
+
+    Reference: `nn/conf/layers/BatchNormalization.java` (decay `:…`, eps,
+    lockGammaBeta) — gamma/beta trainable, global mean/var state."""
+
+    n_out: Optional[int] = None   # feature count, inferred
+    decay: float = 0.9
+    eps: float = 1e-5
+    lock_gamma_beta: bool = False
+
+    def infer_n_in(self, input_type: InputType) -> "BatchNormalization":
+        if self.n_out is None:
+            feat = (input_type.channels if input_type.kind in ("cnn", "cnn3d")
+                    else input_type.size if input_type.kind == "rnn"
+                    else input_type.flat_size())
+            return dataclasses.replace(self, n_out=feat)
+        return self
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        f = self.n_out
+        params = {}
+        if not self.lock_gamma_beta:
+            params = {"gamma": jnp.ones((f,), dtype), "beta": jnp.zeros((f,), dtype)}
+        state = {"mean": jnp.zeros((f,), dtype), "var": jnp.ones((f,), dtype)}
+        return params, state
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        axes = tuple(range(x.ndim - 1))  # all but channel/feature axis
+        if train:
+            mean = jnp.mean(x, axis=axes)
+            var = jnp.var(x, axis=axes)
+            d = self.decay
+            new_state = {
+                "mean": d * state["mean"] + (1 - d) * mean,
+                "var": d * state["var"] + (1 - d) * var,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        inv = 1.0 / jnp.sqrt(var + self.eps)
+        y = (x - mean) * inv
+        if not self.lock_gamma_beta:
+            y = y * params["gamma"] + params["beta"]
+        return self._act(y), new_state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class LocalResponseNormalization(Layer):
+    """Cross-channel LRN (AlexNet-era). Reference:
+    `nn/conf/layers/LocalResponseNormalization.java` + cuDNN helper
+    (`CudnnLocalResponseNormalizationHelper.java`); here a slide over the
+    channel axis that XLA fuses — no helper needed."""
+
+    k: float = 2.0
+    n: int = 5
+    alpha: float = 1e-4
+    beta: float = 0.75
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        # x: NHWC. Sum x^2 over a window of `n` adjacent channels.
+        half = self.n // 2
+        sq = x * x
+        padded = jnp.pad(sq, ((0, 0), (0, 0), (0, 0), (half, half)))
+        c = x.shape[-1]
+        acc = sum(padded[..., i:i + c] for i in range(self.n))
+        denom = (self.k + (self.alpha / self.n) * acc) ** self.beta
+        return x / denom, state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class LayerNormalization(Layer):
+    """Layer norm over the trailing feature axis — no reference counterpart
+    (DL4J 0.8 predates it); required by the modern model families this
+    framework must also serve (transformers, ring attention)."""
+
+    n_out: Optional[int] = None
+    eps: float = 1e-6
+
+    def infer_n_in(self, input_type: InputType) -> "LayerNormalization":
+        if self.n_out is None:
+            feat = input_type.size if input_type.kind == "rnn" else input_type.flat_size()
+            return dataclasses.replace(self, n_out=feat)
+        return self
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        f = self.n_out
+        return {"gamma": jnp.ones((f,), dtype), "beta": jnp.zeros((f,), dtype)}, {}
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        mean = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        y = (x - mean) / jnp.sqrt(var + self.eps)
+        return self._act(y * params["gamma"] + params["beta"]), state

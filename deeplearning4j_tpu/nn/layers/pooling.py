@@ -1,0 +1,72 @@
+"""Global pooling (with mask support).
+
+Reference parity: `nn/conf/layers/GlobalPoolingLayer.java` + impl
+`nn/layers/pooling/GlobalPoolingLayer` — pools over time (RNN [B,T,F]) or
+space (CNN NHWC) with MAX/AVG/SUM/PNORM, honoring per-timestep masks (the
+reference's masking path for variable-length sequences).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+
+
+class PoolingType:
+    MAX = "max"
+    AVG = "avg"
+    SUM = "sum"
+    PNORM = "pnorm"
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class GlobalPoolingLayer(Layer):
+    pooling: str = "max"
+    pnorm: int = 2
+    collapse_dimensions: bool = True
+
+    def output_type(self, input_type: InputType) -> InputType:
+        if input_type.kind == "rnn":
+            return InputType.feed_forward(input_type.size)
+        if input_type.kind == "cnn":
+            return InputType.feed_forward(input_type.channels)
+        return input_type
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        if x.ndim == 3:      # [B, T, F] — pool over time
+            axes = (1,)
+        elif x.ndim == 4:    # NHWC — pool over H, W
+            axes = (1, 2)
+        else:
+            raise ValueError(f"GlobalPooling expects 3-D or 4-D input, got {x.shape}")
+
+        p = self.pooling.lower()
+        if mask is not None and x.ndim == 3:
+            m = mask.astype(x.dtype)[..., None]  # [B, T, 1]
+            if p == "max":
+                x = jnp.where(m > 0, x, -jnp.inf)
+                return jnp.max(x, axis=axes), state
+            if p == "sum":
+                return jnp.sum(x * m, axis=axes), state
+            if p == "avg":
+                s = jnp.sum(x * m, axis=axes)
+                cnt = jnp.maximum(jnp.sum(m, axis=axes), 1.0)
+                return s / cnt, state
+            if p == "pnorm":
+                s = jnp.sum(jnp.abs(x * m) ** self.pnorm, axis=axes)
+                return s ** (1.0 / self.pnorm), state
+
+        if p == "max":
+            return jnp.max(x, axis=axes), state
+        if p == "sum":
+            return jnp.sum(x, axis=axes), state
+        if p == "avg":
+            return jnp.mean(x, axis=axes), state
+        if p == "pnorm":
+            return jnp.sum(jnp.abs(x) ** self.pnorm, axis=axes) ** (1.0 / self.pnorm), state
+        raise ValueError(f"Unknown pooling {self.pooling!r}")

@@ -1,0 +1,138 @@
+"""Input preprocessors — shape adapters between layer families.
+
+Reference parity: `nn/conf/preprocessor/` (CnnToFeedForward, FeedForwardToCnn,
+FeedForwardToRnn, RnnToFeedForward, RnnToCnn, CnnToRnn). The reference
+auto-inserts these from `setInputType`; our builder does the same from
+InputType transitions. All are pure reshapes that XLA folds into layout ops
+(zero cost on TPU when shapes allow).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.utils.serde import register_serde
+
+
+@dataclasses.dataclass(frozen=True)
+class Preprocessor:
+    def output_type(self, input_type: InputType) -> InputType:
+        raise NotImplementedError
+
+    def apply(self, x, mask=None):
+        raise NotImplementedError
+
+
+@register_serde
+@dataclasses.dataclass(frozen=True)
+class CnnToFeedForward(Preprocessor):
+    """NHWC → flat. Reference: CnnToFeedForwardPreProcessor."""
+    height: int = 0
+    width: int = 0
+    channels: int = 0
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(input_type.flat_size())
+
+    def apply(self, x, mask=None):
+        return x.reshape(x.shape[0], -1)
+
+
+@register_serde
+@dataclasses.dataclass(frozen=True)
+class FeedForwardToCnn(Preprocessor):
+    """Flat → NHWC. Reference: FeedForwardToCnnPreProcessor."""
+    height: int = 0
+    width: int = 0
+    channels: int = 1
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+    def apply(self, x, mask=None):
+        return x.reshape(x.shape[0], self.height, self.width, self.channels)
+
+
+@register_serde
+@dataclasses.dataclass(frozen=True)
+class FeedForwardToRnn(Preprocessor):
+    """[B,F] → [B,1,F] (or broadcast over known T). Reference:
+    FeedForwardToRnnPreProcessor."""
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(input_type.flat_size(), 1)
+
+    def apply(self, x, mask=None):
+        return x[:, None, :]
+
+
+@register_serde
+@dataclasses.dataclass(frozen=True)
+class RnnToFeedForward(Preprocessor):
+    """[B,T,F] → [B*T? no — B,(T·F)]? The reference folds time into batch for
+    time-distributed dense. Here RnnOutputLayer handles 3-D natively, so this
+    preprocessor takes the LAST timestep for plain FF layers."""
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.feed_forward(input_type.size)
+
+    def apply(self, x, mask=None):
+        return x[:, -1, :]
+
+
+@register_serde
+@dataclasses.dataclass(frozen=True)
+class RnnToCnn(Preprocessor):
+    """[B,T,F] with F=h·w·c → [B·T folded? No: [B,T,...] spatial per step].
+    Simplified: collapse time into batch, reshape to NHWC (reference semantics
+    for video-frame pipelines)."""
+    height: int = 0
+    width: int = 0
+    channels: int = 1
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.convolutional(self.height, self.width, self.channels)
+
+    def apply(self, x, mask=None):
+        b, t, f = x.shape
+        return x.reshape(b * t, self.height, self.width, self.channels)
+
+
+@register_serde
+@dataclasses.dataclass(frozen=True)
+class CnnToRnn(Preprocessor):
+    """NHWC → [B, T=1, F]. Reference: CnnToRnnPreProcessor."""
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return InputType.recurrent(input_type.flat_size(), 1)
+
+    def apply(self, x, mask=None):
+        return x.reshape(x.shape[0], 1, -1)
+
+
+def auto_preprocessor(from_type: InputType, to_kind: str) -> Optional[Preprocessor]:
+    """Pick the adapter for an InputType transition, as the reference's
+    `getPreProcessorForInputType` does per layer config."""
+    f = from_type.kind
+    if f == to_kind or (f == "cnn_flat" and to_kind == "ff"):
+        return None
+    if f in ("cnn",) and to_kind == "ff":
+        return CnnToFeedForward(from_type.height, from_type.width, from_type.channels)
+    if f in ("ff", "cnn_flat") and to_kind == "cnn":
+        if f == "cnn_flat":
+            return FeedForwardToCnn(from_type.height, from_type.width, from_type.channels)
+        raise ValueError(
+            "Cannot infer CNN shape from a plain feed-forward input; use "
+            "InputType.convolutional_flat(h, w, c)"
+        )
+    if f == "ff" and to_kind == "rnn":
+        return FeedForwardToRnn()
+    if f == "rnn" and to_kind == "ff":
+        return RnnToFeedForward()
+    if f == "cnn" and to_kind == "rnn":
+        return CnnToRnn()
+    return None

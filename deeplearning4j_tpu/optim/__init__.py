@@ -1,0 +1,23 @@
+"""Optimization: updaters (optimizer rules), LR schedules, solver loop.
+
+Reference parity: ND4J `GradientUpdater` impls applied through
+`nn/updater/UpdaterBlock.java:101-160` and the solver loop in
+`optimize/solvers/BaseOptimizer.java` / `StochasticGradientDescent.java`.
+"""
+
+from deeplearning4j_tpu.optim.updaters import (
+    Updater, Sgd, Adam, AdaMax, Nadam, AMSGrad, Nesterovs, AdaGrad, AdaDelta,
+    RmsProp, NoOp,
+)
+from deeplearning4j_tpu.optim.schedules import (
+    Schedule, FixedSchedule, StepSchedule, ExponentialSchedule, InverseSchedule,
+    PolySchedule, SigmoidSchedule, MapSchedule, WarmupCosineSchedule,
+)
+
+__all__ = [
+    "Updater", "Sgd", "Adam", "AdaMax", "Nadam", "AMSGrad", "Nesterovs",
+    "AdaGrad", "AdaDelta", "RmsProp", "NoOp",
+    "Schedule", "FixedSchedule", "StepSchedule", "ExponentialSchedule",
+    "InverseSchedule", "PolySchedule", "SigmoidSchedule", "MapSchedule",
+    "WarmupCosineSchedule",
+]

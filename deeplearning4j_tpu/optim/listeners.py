@@ -1,0 +1,136 @@
+"""Training listeners — the observability seam.
+
+Reference parity: `optimize/api/IterationListener.java` /
+`TrainingListener.java` and `optimize/listeners/` (ScoreIterationListener,
+PerformanceListener `:60` with samples/sec + ETL time, CollectScores,
+TimeIteration). Listeners run on the HOST after each step; because JAX
+dispatch is async, reading the score forces a device sync — listeners that
+only need it every N iterations therefore only sync every N iterations
+(the reference pays a similar cost reading scalars off-device).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, List, Optional
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+class TrainingListener:
+    """Reference: `optimize/api/TrainingListener.java` (onEpochStart/
+    onEpochEnd/iterationDone; forward/backward hooks collapse into
+    iteration_done because the step is one fused XLA computation)."""
+
+    def iteration_done(self, model, iteration: int, epoch: int, score) -> None:
+        pass
+
+    def on_epoch_start(self, model, epoch: int) -> None:
+        pass
+
+    def on_epoch_end(self, model, epoch: int) -> None:
+        pass
+
+    def on_fit_start(self, model) -> None:
+        pass
+
+    def on_fit_end(self, model) -> None:
+        pass
+
+
+class ScoreIterationListener(TrainingListener):
+    """Log score every N iterations. Reference: ScoreIterationListener."""
+
+    def __init__(self, print_iterations: int = 10, out: Optional[Callable] = None):
+        self.n = max(1, print_iterations)
+        self._out = out or (lambda msg: logger.info(msg))
+
+    def iteration_done(self, model, iteration, epoch, score):
+        if iteration % self.n == 0:
+            self._out(f"Score at iteration {iteration} is {float(score):.6f}")
+
+
+class CollectScoresIterationListener(TrainingListener):
+    """Accumulate (iteration, score) pairs. Reference: CollectScoresIterationListener."""
+
+    def __init__(self, frequency: int = 1):
+        self.frequency = max(1, frequency)
+        self.scores: List[tuple] = []
+
+    def iteration_done(self, model, iteration, epoch, score):
+        if iteration % self.frequency == 0:
+            self.scores.append((iteration, float(score)))
+
+
+class PerformanceListener(TrainingListener):
+    """Throughput tracking: samples/sec, batches/sec, ETL time.
+    Reference: `optimize/listeners/PerformanceListener.java:24-25,60`."""
+
+    def __init__(self, frequency: int = 10, report: Optional[Callable] = None):
+        self.frequency = max(1, frequency)
+        self._report = report or (lambda msg: logger.info(msg))
+        self._last_time = None
+        self._last_iter = 0
+        self.last_samples_per_sec = 0.0
+        self.last_batches_per_sec = 0.0
+        self.last_etl_ms = 0.0
+
+    def set_etl_time(self, ms: float) -> None:
+        """Reference: setLastEtlTime threading (`MultiLayerNetwork.java:1092`)."""
+        self.last_etl_ms = ms
+
+    def iteration_done(self, model, iteration, epoch, score):
+        now = time.perf_counter()
+        if self._last_time is None:
+            self._last_time = now
+            self._last_iter = iteration
+            return
+        if iteration - self._last_iter >= self.frequency:
+            dt = now - self._last_time
+            n_batches = iteration - self._last_iter
+            bs = getattr(model, "last_batch_size", None) or 0
+            self.last_batches_per_sec = n_batches / dt
+            self.last_samples_per_sec = n_batches * bs / dt
+            self._report(
+                f"iteration {iteration}: {self.last_samples_per_sec:.1f} samples/sec, "
+                f"{self.last_batches_per_sec:.2f} batches/sec, ETL {self.last_etl_ms:.1f} ms"
+            )
+            self._last_time = now
+            self._last_iter = iteration
+
+
+class TimeIterationListener(TrainingListener):
+    """ETA logging. Reference: TimeIterationListener."""
+
+    def __init__(self, total_iterations: int, frequency: int = 100):
+        self.total = total_iterations
+        self.frequency = max(1, frequency)
+        self._start = None
+
+    def iteration_done(self, model, iteration, epoch, score):
+        if self._start is None:
+            self._start = time.perf_counter()
+            return
+        if iteration % self.frequency == 0 and iteration > 0:
+            elapsed = time.perf_counter() - self._start
+            remaining = elapsed / iteration * max(self.total - iteration, 0)
+            logger.info(
+                f"iteration {iteration}/{self.total}, ETA {remaining:.0f}s"
+            )
+
+
+class EvaluativeListener(TrainingListener):
+    """Periodic evaluation on a held-out iterator. Reference: EvaluativeListener."""
+
+    def __init__(self, iterator, frequency: int = 1, on_epoch: bool = True):
+        self.iterator = iterator
+        self.frequency = max(1, frequency)
+        self.on_epoch = on_epoch
+        self.evaluations: List = []
+
+    def on_epoch_end(self, model, epoch):
+        if self.on_epoch and epoch % self.frequency == 0:
+            e = model.evaluate(self.iterator)
+            self.evaluations.append(e)
+            logger.info(f"epoch {epoch} eval: accuracy={e.accuracy():.4f}")

@@ -1,0 +1,9 @@
+"""Utilities: JSON serde registry, pytree/param-view helpers, dtype policy."""
+
+from deeplearning4j_tpu.utils.serde import register_serde, to_json, from_json, config_to_dict, config_from_dict
+from deeplearning4j_tpu.utils.pytrees import flatten_params, unflatten_params, param_count, tree_norm
+
+__all__ = [
+    "register_serde", "to_json", "from_json", "config_to_dict", "config_from_dict",
+    "flatten_params", "unflatten_params", "param_count", "tree_norm",
+]

@@ -1,0 +1,62 @@
+"""Pytree parameter helpers — the flattened-view equivalent.
+
+Reference parity: DL4J keeps ALL network parameters in one flat contiguous
+INDArray with per-layer views (`MultiLayerNetwork.init():446`,
+`initGradientsView():563`; param initializers in `nn/params/`). On TPU the
+idiomatic storage is a pytree (dict-of-dicts of jax.Array) — XLA handles
+layout; these helpers provide the flat view on demand for serialization,
+gradient checks, and parity with `Model.params()` semantics.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+import jax
+import jax.flatten_util
+import jax.numpy as jnp
+import numpy as np
+
+
+def flatten_params(tree: Any) -> Tuple[jnp.ndarray, Callable[[jnp.ndarray], Any]]:
+    """Pytree → (flat 1-D vector, unravel fn). Mirrors `Model.params()`."""
+    flat, unravel = jax.flatten_util.ravel_pytree(tree)
+    return flat, unravel
+
+
+def unflatten_params(flat: jnp.ndarray, like: Any) -> Any:
+    _, unravel = jax.flatten_util.ravel_pytree(like)
+    return unravel(flat)
+
+
+def param_count(tree: Any) -> int:
+    """Reference: `Model.numParams()`."""
+    return sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(tree))
+
+
+def tree_norm(tree: Any) -> jnp.ndarray:
+    """Global L2 norm over all leaves (gradient-norm clipping support)."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.asarray(0.0)
+    return jnp.sqrt(sum(jnp.sum(jnp.square(x.astype(jnp.float32))) for x in leaves))
+
+
+def tree_map(fn, *trees):
+    return jax.tree_util.tree_map(fn, *trees)
+
+
+def tree_zeros_like(tree):
+    return jax.tree_util.tree_map(jnp.zeros_like, tree)
+
+
+def tree_add(a, b):
+    return jax.tree_util.tree_map(jnp.add, a, b)
+
+
+def tree_scale(tree, s):
+    return jax.tree_util.tree_map(lambda x: x * s, tree)
+
+
+def tree_to_numpy(tree):
+    return jax.tree_util.tree_map(lambda x: np.asarray(x), tree)

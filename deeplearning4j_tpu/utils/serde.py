@@ -1,0 +1,88 @@
+"""Config JSON serde — the config-as-data backbone.
+
+Reference parity: DL4J serializes every network configuration to JSON via
+Jackson (`nn/conf/NeuralNetConfiguration.java` toJson/fromJson,
+`nn/conf/serde/*Deserializer.java` for legacy-format compat). Here every
+config object is a frozen dataclass; this module provides a type registry so
+nested configs (layers, vertices, schedules, updaters, preprocessors)
+round-trip through plain dicts/JSON with a ``@class`` discriminator —
+the same polymorphic-JSON pattern Jackson's @JsonTypeInfo gives the reference.
+
+Version compat: `from_dict` tolerates unknown keys (dropped with a warning
+hook) so configs written by future versions still load — mirroring the
+reference's legacy deserializers (`BaseNetConfigDeserializer.java`).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, Type
+
+_TYPE_REGISTRY: Dict[str, Type] = {}
+
+_TAG = "@class"
+
+
+def register_serde(cls):
+    """Class decorator: register a dataclass for polymorphic JSON round-trip."""
+    _TYPE_REGISTRY[cls.__name__] = cls
+    return cls
+
+
+def registered(name: str) -> Type:
+    if name not in _TYPE_REGISTRY:
+        raise KeyError(
+            f"Unknown config class {name!r} — registered: {sorted(_TYPE_REGISTRY)}"
+        )
+    return _TYPE_REGISTRY[name]
+
+
+def config_to_dict(obj: Any) -> Any:
+    """Recursively convert a (possibly nested) config object to plain data."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        out = {_TAG: type(obj).__name__}
+        for f in dataclasses.fields(obj):
+            v = getattr(obj, f.name)
+            if v is None:
+                continue
+            out[f.name] = config_to_dict(v)
+        return out
+    if isinstance(obj, dict):
+        return {k: config_to_dict(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [config_to_dict(v) for v in obj]
+    if callable(obj) and hasattr(obj, "__name__"):
+        # Function-valued fields (custom activations etc.) serialize by name.
+        return {"@fn": obj.__name__}
+    return obj
+
+
+def config_from_dict(data: Any) -> Any:
+    """Inverse of config_to_dict; tolerant of unknown keys for fwd-compat."""
+    if isinstance(data, dict):
+        if _TAG in data:
+            cls = registered(data[_TAG])
+            field_names = {f.name for f in dataclasses.fields(cls)}
+            kwargs = {}
+            for k, v in data.items():
+                if k == _TAG:
+                    continue
+                if k in field_names:
+                    kwargs[k] = config_from_dict(v)
+                # Unknown keys are dropped (legacy/forward compat).
+            return cls(**kwargs)
+        if "@fn" in data:
+            return data["@fn"]  # resolved lazily by Activation/Loss registries
+        return {k: config_from_dict(v) for k, v in data.items()}
+    if isinstance(data, list):
+        return [config_from_dict(v) for v in data]
+    return data
+
+
+def to_json(obj: Any, indent: int = 2) -> str:
+    return json.dumps(config_to_dict(obj), indent=indent, sort_keys=False)
+
+
+def from_json(s: str) -> Any:
+    return config_from_dict(json.loads(s))

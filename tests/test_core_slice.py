@@ -1,0 +1,176 @@
+"""End-to-end tests for the NN core slice: config DSL → MLN → fit/eval.
+
+Mirrors the reference test strategy (SURVEY §4): unit tests for conf/serde,
+integration convergence tests, and numeric gradient checks as the
+correctness backbone.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import InputType
+from deeplearning4j_tpu.nn.config import NeuralNetConfiguration, MultiLayerConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.optim.updaters import Adam, Sgd, Nesterovs
+from deeplearning4j_tpu.optim.listeners import CollectScoresIterationListener
+from deeplearning4j_tpu.gradientcheck import check_gradients
+
+
+def _toy_classification(n=256, d=8, classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    w = rng.standard_normal((d, classes))
+    y = (x @ w + 0.1 * rng.standard_normal((n, classes))).argmax(-1)
+    onehot = np.zeros((n, classes), dtype=np.float32)
+    onehot[np.arange(n), y] = 1
+    return x, onehot
+
+
+def _mlp_conf(d=8, classes=3, updater=None, **kw):
+    return (NeuralNetConfiguration.builder()
+            .seed(42)
+            .updater(updater or Adam(1e-2))
+            .weight_init("xavier")
+            .activation("tanh")
+            .list(
+                DenseLayer(n_out=16),
+                OutputLayer(n_out=classes, activation="softmax", loss="mcxent"))
+            .set_input_type(InputType.feed_forward(d))
+            .build())
+
+
+class TestConfigDSL:
+    def test_builder_cascades_defaults(self):
+        conf = _mlp_conf()
+        assert conf.layers[0].activation == "tanh"
+        assert conf.layers[1].activation == "softmax"  # explicit overrides
+        assert conf.layers[0].weight_init == "xavier"
+        assert conf.layers[0].n_in == 8
+        assert conf.layers[1].n_in == 16
+
+    def test_json_round_trip(self):
+        conf = _mlp_conf()
+        js = conf.to_json()
+        conf2 = MultiLayerConfiguration.from_json(js)
+        assert conf2.layers[0].n_in == conf.layers[0].n_in
+        assert conf2.layers[1].loss == "mcxent"
+        assert conf2.seed == conf.seed
+        assert conf2.to_json() == js
+
+    def test_num_params(self):
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        assert net.num_params() == (8 * 16 + 16) + (16 * 3 + 3)
+
+    def test_param_flat_round_trip(self):
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        flat = net.params()
+        flat2 = flat + 1.0
+        net.set_params(flat2)
+        np.testing.assert_allclose(net.params(), flat2, rtol=1e-6)
+
+
+class TestFit:
+    @pytest.mark.parametrize("updater", [Adam(1e-2), Sgd(0.5), Nesterovs(0.1)])
+    def test_loss_decreases(self, updater):
+        x, y = _toy_classification()
+        net = MultiLayerNetwork(_mlp_conf(updater=updater)).init()
+        before = net.score(x, y)
+        net.fit(x, y, epochs=30, batch_size=64)
+        after = net.score(x, y)
+        assert after < before * 0.7, f"loss {before} -> {after}"
+
+    def test_accuracy_improves(self):
+        x, y = _toy_classification()
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        net.fit(x, y, epochs=50, batch_size=64)
+        from deeplearning4j_tpu.data import ArrayDataSetIterator
+        e = net.evaluate(ArrayDataSetIterator(x, y, 64))
+        assert e.accuracy() > 0.8, e.stats()
+
+    def test_listeners_collect_scores(self):
+        x, y = _toy_classification(n=64)
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        col = CollectScoresIterationListener()
+        net.add_listener(col)
+        net.fit(x, y, epochs=2, batch_size=32)
+        assert len(col.scores) == 4  # 2 batches x 2 epochs
+
+    def test_output_shape_and_predict(self):
+        x, y = _toy_classification(n=32)
+        net = MultiLayerNetwork(_mlp_conf()).init()
+        out = np.asarray(net.output(x))
+        assert out.shape == (32, 3)
+        np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-5)
+        assert net.predict(x).shape == (32,)
+
+
+class TestGradientChecks:
+    """Reference: gradientcheck suites (the correctness backbone, SURVEY §4)."""
+
+    def test_mlp_mcxent(self):
+        x, y = _toy_classification(n=8, d=4, classes=3, seed=1)
+        conf = (NeuralNetConfiguration.builder()
+                .seed(7).updater(Sgd(0.1)).activation("tanh")
+                .list(DenseLayer(n_out=5),
+                      OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        assert check_gradients(net, x, y)
+
+    def test_mlp_mse_identity(self):
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((8, 4)).astype(np.float64)
+        y = rng.standard_normal((8, 2)).astype(np.float64)
+        conf = (NeuralNetConfiguration.builder()
+                .seed(7).updater(Sgd(0.1)).activation("sigmoid")
+                .list(DenseLayer(n_out=6),
+                      OutputLayer(n_out=2, activation="identity", loss="mse"))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        assert check_gradients(net, x, y)
+
+    def test_l1_l2_regularization_grads(self):
+        x, y = _toy_classification(n=8, d=4, classes=3, seed=2)
+        conf = (NeuralNetConfiguration.builder()
+                .seed(7).updater(Sgd(0.1)).activation("tanh")
+                .l1(1e-2).l2(1e-2)
+                .list(DenseLayer(n_out=5),
+                      OutputLayer(n_out=3, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        assert check_gradients(net, x, y)
+
+
+class TestEvaluation:
+    def test_confusion_and_metrics(self):
+        from deeplearning4j_tpu.eval import Evaluation
+        e = Evaluation()
+        labels = np.eye(3)[[0, 0, 1, 1, 2, 2]]
+        preds = np.eye(3)[[0, 1, 1, 1, 2, 0]]
+        e.eval(labels, preds)
+        assert e.confusion.get_count(0, 0) == 1
+        assert e.confusion.get_count(0, 1) == 1
+        assert abs(e.accuracy() - 4 / 6) < 1e-9
+        assert 0 < e.f1() <= 1
+        assert "Accuracy" in e.stats()
+
+    def test_regression_eval(self):
+        from deeplearning4j_tpu.eval import RegressionEvaluation
+        r = RegressionEvaluation()
+        labels = np.array([[1.0], [2.0], [3.0]])
+        preds = np.array([[1.1], [1.9], [3.2]])
+        r.eval(labels, preds)
+        assert r.mean_absolute_error(0) == pytest.approx(0.1333, abs=1e-3)
+        assert r.correlation_r2(0) > 0.99
+
+    def test_roc_auc(self):
+        from deeplearning4j_tpu.eval import ROC
+        roc = ROC()
+        y = np.array([0, 0, 1, 1])
+        s = np.array([0.1, 0.4, 0.35, 0.8])
+        roc.eval(y, s)
+        assert roc.calculate_auc() == pytest.approx(0.75)
