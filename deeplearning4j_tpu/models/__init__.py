@@ -6,5 +6,6 @@ becomes one jitted XLA computation per train step here.
 """
 
 from deeplearning4j_tpu.models.multilayer import MultiLayerNetwork
+from deeplearning4j_tpu.models.computation_graph import ComputationGraph
 
-__all__ = ["MultiLayerNetwork"]
+__all__ = ["MultiLayerNetwork", "ComputationGraph"]

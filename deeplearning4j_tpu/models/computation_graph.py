@@ -1,0 +1,309 @@
+"""ComputationGraph — DAG model runtime (multi-input / multi-output).
+
+Reference parity: `nn/graph/ComputationGraph.java` — `init():340` (toposort
+`:357`), `fit(DataSetIterator):778`, forward loop over `topologicalOrder`
+`:1313,1325`, backprop `:1200-1210` (reverse topo order with fan-in epsilon
+accumulation — here `jax.grad` through the forward fold).
+
+The runtime folds over the configuration's topological order; the whole
+forward + losses for ALL outputs + backward + update is one jitted XLA
+computation, with multi-output loss = sum of per-output-layer losses
+(reference: ComputationGraph sums output layer scores).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.data.iterators import DataSetIterator, as_iterator
+from deeplearning4j_tpu.nn.graph import (
+    ComputationGraphConfiguration, GraphVertex, LayerVertex,
+)
+from deeplearning4j_tpu.nn.layers.special import CenterLossOutputLayer
+from deeplearning4j_tpu.models.multilayer import _dtype_of, _normalize_grads
+from deeplearning4j_tpu.optim.listeners import TrainingListener
+from deeplearning4j_tpu.optim.updaters import NoOp, Updater, resolve_updater
+from deeplearning4j_tpu.utils.pytrees import (
+    flatten_params, param_count, unflatten_params,
+)
+
+_tmap = jax.tree_util.tree_map
+
+
+class ComputationGraph:
+    """DAG network runtime over a ComputationGraphConfiguration."""
+
+    def __init__(self, conf: ComputationGraphConfiguration):
+        self.conf = conf
+        self.dtype = _dtype_of(conf.dtype)
+        self.params_tree: Optional[Dict[str, Any]] = None
+        self.state_tree: Dict[str, Any] = {}
+        self.updater_state: Optional[Dict[str, Any]] = None
+        self.iteration = 0
+        self.epoch = 0
+        self.listeners: List[TrainingListener] = []
+        self.last_batch_size: Optional[int] = None
+        self.score_: Optional[float] = None
+        self._rng = jax.random.PRNGKey(conf.seed)
+        self._stateful: set = set()
+        self._vertex_updaters: Dict[str, Updater] = {}
+        self._jit_cache: Dict[Any, Any] = {}
+
+    # ------------------------------------------------------------- init
+    def init(self) -> "ComputationGraph":
+        key = jax.random.PRNGKey(self.conf.seed)
+        params, states = {}, {}
+        known = dict(self.conf.input_types)
+        for name in self.conf.topological_order:
+            v = self.conf.vertices[name]
+            in_types = [known[i] for i in self.conf.vertex_inputs[name]
+                        if i in known]
+            key, sub = jax.random.split(key)
+            p, s = v.init_params(sub, in_types, self.dtype)
+            params[name] = p
+            states[name] = s
+            if s:
+                self._stateful.add(name)
+            try:
+                known[name] = v.output_type(*in_types)
+            except Exception:
+                pass
+        self.params_tree = params
+        self.state_tree = states
+        self._build_updaters()
+        self.updater_state = {
+            n: u.init(params[n]) for n, u in self._vertex_updaters.items()
+        }
+        return self
+
+    def _build_updaters(self):
+        global_u = resolve_updater(self.conf.updater or "sgd")
+        for name in self.conf.topological_order:
+            v = self.conf.vertices[name]
+            u = global_u
+            if isinstance(v, LayerVertex):
+                layer = v.layer
+                if layer.updater is not None:
+                    u = resolve_updater(layer.updater)
+                if layer.learning_rate is not None and hasattr(u, "learning_rate"):
+                    u = dataclasses.replace(u, learning_rate=layer.learning_rate)
+                if layer.frozen:
+                    u = NoOp()
+            self._vertex_updaters[name] = u
+
+    # ---------------------------------------------------------- forward
+    def _forward(self, params, states, inputs: Dict[str, Any], *, train, rng,
+                 fmasks: Optional[Dict[str, Any]] = None):
+        """Fold over topological order. Returns (values, out_inputs, states)
+        where out_inputs[name] is the input activation each output layer saw
+        (needed for fused-loss score)."""
+        values: Dict[str, Any] = dict(inputs)
+        out_inputs: Dict[str, Any] = {}
+        new_states: Dict[str, Any] = {}
+        for idx, name in enumerate(self.conf.topological_order):
+            v = self.conf.vertices[name]
+            ins = [values[i] for i in self.conf.vertex_inputs[name]]
+            st = states.get(name) or None
+            lrng = None if rng is None else jax.random.fold_in(rng, idx)
+            mask = None
+            if fmasks:
+                for i in self.conf.vertex_inputs[name]:
+                    if i in fmasks:
+                        mask = fmasks[i]
+                        break
+            if isinstance(v, LayerVertex) and v.layer.is_output_layer:
+                x = ins[0]
+                if v.preprocessor is not None:
+                    x = v.preprocessor.apply(x)
+                out_inputs[name] = x
+                y, new_st = v.layer.apply(
+                    params[name], x, state=st, train=train, rng=lrng, mask=mask)
+            else:
+                y, new_st = v.apply(
+                    params[name], ins, state=st, train=train, rng=lrng, mask=mask)
+            values[name] = y
+            new_states[name] = new_st
+        return values, out_inputs, new_states
+
+    # ------------------------------------------------------------- loss
+    def _loss(self, params, states, inputs, labels: Dict[str, Any],
+              fmasks, lmasks, rng, train=True):
+        values, out_inputs, new_states = self._forward(
+            params, states, inputs, train=train, rng=rng, fmasks=fmasks)
+        total = jnp.asarray(0.0, jnp.float32)
+        for name in self.conf.network_outputs:
+            v = self.conf.vertices[name]
+            if not (isinstance(v, LayerVertex) and v.layer.is_output_layer):
+                continue
+            lm = lmasks.get(name) if lmasks else None
+            lab = labels[name]
+            if isinstance(v.layer, CenterLossOutputLayer):
+                s, cstate = v.layer.score_and_state(
+                    params[name], out_inputs[name], lab, states[name], lm)
+                new_states[name] = cstate
+            else:
+                s = v.layer.score(params[name], out_inputs[name], lab, lm)
+            total = total + s
+        for name, v in self.conf.vertices.items():
+            if isinstance(v, LayerVertex):
+                total = total + v.layer.regularization(params[name])
+        return total, new_states
+
+    # ------------------------------------------------------ train step
+    def _get_train_step(self, key):
+        if key in self._jit_cache:
+            return self._jit_cache[key]
+        mode = self.conf.gradient_normalization
+        thr = self.conf.gradient_normalization_threshold
+        updaters = self._vertex_updaters
+        stateful = self._stateful
+
+        def step_fn(params, opt_state, states, step, inputs, labels,
+                    fmasks, lmasks, rng):
+            def loss_fn(p):
+                return self._loss(p, states, inputs, labels, fmasks, lmasks,
+                                  rng, train=True)
+
+            (loss, new_states), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params)
+            grads = _normalize_grads(grads, mode, thr)
+            new_params, new_opt = {}, {}
+            for name, u in updaters.items():
+                upd, st = u.apply(grads[name], opt_state[name], params[name], step)
+                new_params[name] = _tmap(lambda a, b: a - b, params[name], upd)
+                new_opt[name] = st
+            persist = {
+                n: (new_states[n] if n in stateful else states.get(n, {}))
+                for n in states
+            }
+            return new_params, new_opt, persist, loss
+
+        fn = jax.jit(step_fn, donate_argnums=(0, 1, 2))
+        self._jit_cache[key] = fn
+        return fn
+
+    # ---------------------------------------------------- data plumbing
+    def _to_dicts(self, ds: Union[DataSet, MultiDataSet]):
+        """Map a DataSet/MultiDataSet onto named inputs/outputs by order."""
+        ins = self.conf.network_inputs
+        outs = self.conf.network_outputs
+        if isinstance(ds, MultiDataSet):
+            feats = {n: jnp.asarray(f, self.dtype)
+                     for n, f in zip(ins, ds.features)}
+            labs = {n: jnp.asarray(l) for n, l in zip(outs, ds.labels)}
+            fmasks = {}
+            if ds.features_masks:
+                fmasks = {n: jnp.asarray(m) for n, m in
+                          zip(ins, ds.features_masks) if m is not None}
+            lmasks = {}
+            if ds.labels_masks:
+                lmasks = {n: jnp.asarray(m) for n, m in
+                          zip(outs, ds.labels_masks) if m is not None}
+            return feats, labs, fmasks or None, lmasks or None
+        feats = {ins[0]: jnp.asarray(ds.features, self.dtype)}
+        labs = {outs[0]: jnp.asarray(ds.labels)} if ds.labels is not None else {}
+        fmasks = ({ins[0]: jnp.asarray(ds.features_mask)}
+                  if ds.features_mask is not None else None)
+        lmasks = ({outs[0]: jnp.asarray(ds.labels_mask)}
+                  if ds.labels_mask is not None else None)
+        return feats, labs, fmasks, lmasks
+
+    # ---------------------------------------------------------- fit API
+    def fit(self, data, labels=None, *, epochs: int = 1, batch_size: int = 32):
+        """Reference: `ComputationGraph.fit(DataSetIterator):778` (also
+        accepts MultiDataSet / arrays / iterator)."""
+        if self.params_tree is None:
+            raise RuntimeError("Network not initialized — call init() first")
+        if isinstance(data, MultiDataSet):
+            batches: Sequence = [data]
+            iterable = lambda: batches
+        else:
+            it = as_iterator(data, labels, batch_size)
+            iterable = lambda: it
+        for l in self.listeners:
+            l.on_fit_start(self)
+        for _ in range(epochs):
+            for l in self.listeners:
+                l.on_epoch_start(self, self.epoch)
+            for ds in iterable():
+                feats, labs, fmasks, lmasks = self._to_dicts(ds)
+                self.last_batch_size = next(iter(feats.values())).shape[0]
+                key = (fmasks is not None, lmasks is not None)
+                fn = self._get_train_step(key)
+                self._rng, k = jax.random.split(self._rng)
+                (self.params_tree, self.updater_state, self.state_tree, loss
+                 ) = fn(self.params_tree, self.updater_state, self.state_tree,
+                        jnp.asarray(self.iteration, jnp.int32),
+                        feats, labs, fmasks, lmasks, k)
+                self.score_ = float(loss)
+                self.iteration += 1
+                for l in self.listeners:
+                    l.iteration_done(self, self.iteration, self.epoch, self.score_)
+            for l in self.listeners:
+                l.on_epoch_end(self, self.epoch)
+            self.epoch += 1
+        for l in self.listeners:
+            l.on_fit_end(self)
+        return self
+
+    # -------------------------------------------------------- inference
+    def output(self, *xs, train: bool = False):
+        """Forward; returns a list of output arrays (single array if one
+        output). Reference: `ComputationGraph.output(INDArray...)`."""
+        if self.params_tree is None:
+            raise RuntimeError("Network not initialized — call init() first")
+        inputs = {n: jnp.asarray(x, self.dtype)
+                  for n, x in zip(self.conf.network_inputs, xs)}
+        key = ("output", train, tuple(sorted(inputs)))
+        if key not in self._jit_cache:
+            def out_fn(params, states, feats):
+                values, _, _ = self._forward(
+                    params, states, feats, train=train, rng=None)
+                return [values[o] for o in self.conf.network_outputs]
+            self._jit_cache[key] = jax.jit(out_fn)
+        outs = self._jit_cache[key](self.params_tree, self.state_tree, inputs)
+        return outs[0] if len(outs) == 1 else outs
+
+    def score(self, ds: Union[DataSet, MultiDataSet]) -> float:
+        feats, labs, fmasks, lmasks = self._to_dicts(ds)
+        loss, _ = self._loss(self.params_tree, self.state_tree, feats, labs,
+                             fmasks, lmasks, rng=None, train=False)
+        return float(loss)
+
+    def predict(self, *xs) -> np.ndarray:
+        out = self.output(*xs)
+        if isinstance(out, list):
+            return [np.asarray(jnp.argmax(o, -1)) for o in out]
+        return np.asarray(jnp.argmax(out, -1))
+
+    def evaluate(self, iterator: DataSetIterator):
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+
+        e = Evaluation()
+        for ds in iterator:
+            out = self.output(ds.features)
+            e.eval(ds.labels, np.asarray(out), mask=ds.labels_mask)
+        return e
+
+    # ----------------------------------------------------- param views
+    def params(self) -> np.ndarray:
+        flat, _ = flatten_params(self.params_tree)
+        return np.asarray(flat)
+
+    def set_params(self, flat) -> None:
+        self.params_tree = unflatten_params(jnp.asarray(flat), self.params_tree)
+
+    def num_params(self) -> int:
+        return param_count(self.params_tree)
+
+    def set_listeners(self, *listeners: TrainingListener) -> None:
+        self.listeners = list(listeners)
+
+    def add_listener(self, l: TrainingListener) -> None:
+        self.listeners.append(l)

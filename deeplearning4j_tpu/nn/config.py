@@ -233,6 +233,14 @@ class ListBuilder:
                     cur = preprocessors[i].output_type(cur)
                 layer = layer.infer_n_in(cur)
                 cur = layer.output_type(cur)
+            else:
+                # No input type declared: propagate from layers with explicit
+                # dims (reference allows nIn-explicit configs without
+                # setInputType).
+                try:
+                    cur = layer.output_type(cur)
+                except Exception:
+                    cur = None
             layers.append(layer)
 
         return MultiLayerConfiguration(

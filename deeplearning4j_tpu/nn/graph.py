@@ -1,0 +1,438 @@
+"""ComputationGraph configuration: graph vertices + GraphBuilder DSL.
+
+Reference parity: `nn/conf/ComputationGraphConfiguration.java` (748 LoC,
+GraphBuilder), vertex configs in `nn/conf/graph/` (ElementWise, Merge,
+Subset, Stack, Unstack, Scale, Shift, Reshape, L2, L2Normalize,
+Preprocessor, LayerVertex + rnn/ LastTimeStep & duplicate-to-timeseries),
+runtime vertices `nn/graph/vertex/impl/`.
+
+The DAG is data: named vertices + input-name edges. Topological order is
+computed once at build() (reference: `ComputationGraph.init():340,357`
+computes `topologicalOrder`); the runtime just folds over that order, which
+traces into one XLA computation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import Layer
+from deeplearning4j_tpu.nn.preprocessors import Preprocessor
+from deeplearning4j_tpu.utils.serde import register_serde, to_json, from_json
+
+
+@dataclasses.dataclass(frozen=True)
+class GraphVertex:
+    """Base DAG node (non-layer). Pure like Layer: init_params/apply."""
+
+    name: Optional[str] = None
+
+    def output_type(self, *input_types: InputType) -> InputType:
+        return input_types[0]
+
+    def init_params(self, key, input_types: Sequence[InputType], dtype=jnp.float32):
+        return {}, {}
+
+    def apply(self, params, inputs: List, *, state=None, train=False,
+              rng=None, mask=None):
+        raise NotImplementedError
+
+
+@register_serde
+@dataclasses.dataclass(frozen=True)
+class ElementWiseVertex(GraphVertex):
+    """Add/Subtract/Product/Average/Max of same-shaped inputs.
+    Reference: `nn/conf/graph/ElementWiseVertex.java`."""
+
+    op: str = "add"
+
+    def apply(self, params, inputs, **kw):
+        op = self.op.lower()
+        out = inputs[0]
+        if op == "add":
+            for x in inputs[1:]:
+                out = out + x
+        elif op in ("sub", "subtract"):
+            out = inputs[0] - inputs[1]
+        elif op in ("mul", "product"):
+            for x in inputs[1:]:
+                out = out * x
+        elif op in ("avg", "average"):
+            out = sum(inputs) / len(inputs)
+        elif op == "max":
+            for x in inputs[1:]:
+                out = jnp.maximum(out, x)
+        else:
+            raise ValueError(f"Unknown elementwise op {self.op!r}")
+        return out, kw.get("state")
+
+
+@register_serde
+@dataclasses.dataclass(frozen=True)
+class MergeVertex(GraphVertex):
+    """Concatenate along the feature (trailing) axis. Reference:
+    `nn/conf/graph/MergeVertex.java` (channel axis for CNN — trailing in
+    our NHWC layout, so one rule covers FF/RNN/CNN)."""
+
+    def output_type(self, *input_types: InputType) -> InputType:
+        t0 = input_types[0]
+        if t0.kind == "ff":
+            return InputType.feed_forward(sum(t.size for t in input_types))
+        if t0.kind == "rnn":
+            return InputType.recurrent(
+                sum(t.size for t in input_types), t0.timesteps)
+        if t0.kind == "cnn":
+            return InputType.convolutional(
+                t0.height, t0.width, sum(t.channels for t in input_types))
+        return t0
+
+    def apply(self, params, inputs, **kw):
+        return jnp.concatenate(inputs, axis=-1), kw.get("state")
+
+
+@register_serde
+@dataclasses.dataclass(frozen=True)
+class SubsetVertex(GraphVertex):
+    """Feature-range slice [from, to] inclusive. Reference:
+    `nn/conf/graph/SubsetVertex.java`."""
+
+    from_: int = 0
+    to: int = 0
+
+    def output_type(self, *input_types: InputType) -> InputType:
+        n = self.to - self.from_ + 1
+        t0 = input_types[0]
+        if t0.kind == "rnn":
+            return InputType.recurrent(n, t0.timesteps)
+        return InputType.feed_forward(n)
+
+    def apply(self, params, inputs, **kw):
+        return inputs[0][..., self.from_:self.to + 1], kw.get("state")
+
+
+@register_serde
+@dataclasses.dataclass(frozen=True)
+class StackVertex(GraphVertex):
+    """Stack along the batch axis (examples concat). Reference:
+    `nn/conf/graph/StackVertex.java`."""
+
+    def apply(self, params, inputs, **kw):
+        return jnp.concatenate(inputs, axis=0), kw.get("state")
+
+
+@register_serde
+@dataclasses.dataclass(frozen=True)
+class UnstackVertex(GraphVertex):
+    """Take slice `from_` of `stack_size` equal batch chunks. Reference:
+    `nn/conf/graph/UnstackVertex.java`."""
+
+    from_: int = 0
+    stack_size: int = 1
+
+    def apply(self, params, inputs, **kw):
+        x = inputs[0]
+        n = x.shape[0] // self.stack_size
+        return x[self.from_ * n:(self.from_ + 1) * n], kw.get("state")
+
+
+@register_serde
+@dataclasses.dataclass(frozen=True)
+class ScaleVertex(GraphVertex):
+    """Multiply by a fixed scalar. Reference: `nn/conf/graph/ScaleVertex.java`."""
+
+    scale: float = 1.0
+
+    def apply(self, params, inputs, **kw):
+        return inputs[0] * self.scale, kw.get("state")
+
+
+@register_serde
+@dataclasses.dataclass(frozen=True)
+class ShiftVertex(GraphVertex):
+    """Add a fixed scalar. Reference: `nn/conf/graph/ShiftVertex.java`."""
+
+    shift: float = 0.0
+
+    def apply(self, params, inputs, **kw):
+        return inputs[0] + self.shift, kw.get("state")
+
+
+@register_serde
+@dataclasses.dataclass(frozen=True)
+class ReshapeVertex(GraphVertex):
+    """Reshape to a fixed shape (batch dim preserved with -1 lead).
+    Reference: `nn/conf/graph/ReshapeVertex.java`."""
+
+    shape: Tuple[int, ...] = ()
+
+    def apply(self, params, inputs, **kw):
+        return inputs[0].reshape((inputs[0].shape[0],) + tuple(self.shape)), kw.get("state")
+
+
+@register_serde
+@dataclasses.dataclass(frozen=True)
+class L2NormalizeVertex(GraphVertex):
+    """x / ||x||_2 over trailing axis. Reference: `nn/conf/graph/L2NormalizeVertex.java`."""
+
+    eps: float = 1e-8
+
+    def apply(self, params, inputs, **kw):
+        x = inputs[0]
+        n = jnp.sqrt(jnp.sum(x * x, axis=-1, keepdims=True) + self.eps)
+        return x / n, kw.get("state")
+
+
+@register_serde
+@dataclasses.dataclass(frozen=True)
+class L2Vertex(GraphVertex):
+    """Pairwise L2 distance between two inputs → [batch, 1]. Reference:
+    `nn/conf/graph/L2Vertex.java` (used by siamese/triplet nets)."""
+
+    eps: float = 1e-8
+
+    def output_type(self, *input_types: InputType) -> InputType:
+        return InputType.feed_forward(1)
+
+    def apply(self, params, inputs, **kw):
+        d = inputs[0] - inputs[1]
+        return jnp.sqrt(jnp.sum(d * d, axis=-1, keepdims=True) + self.eps), kw.get("state")
+
+
+@register_serde
+@dataclasses.dataclass(frozen=True)
+class PreprocessorVertex(GraphVertex):
+    """Wrap an InputPreProcessor as a vertex. Reference:
+    `nn/conf/graph/PreprocessorVertex.java`."""
+
+    preprocessor: Optional[Preprocessor] = None
+
+    def output_type(self, *input_types: InputType) -> InputType:
+        return self.preprocessor.output_type(input_types[0])
+
+    def apply(self, params, inputs, **kw):
+        return self.preprocessor.apply(inputs[0]), kw.get("state")
+
+
+@register_serde
+@dataclasses.dataclass(frozen=True)
+class LastTimeStepVertex(GraphVertex):
+    """[B,T,F] → [B,F] last unmasked step. Reference:
+    `nn/conf/graph/rnn/LastTimeStepVertex.java`."""
+
+    mask_input: Optional[str] = None
+
+    def output_type(self, *input_types: InputType) -> InputType:
+        return InputType.feed_forward(input_types[0].size)
+
+    def apply(self, params, inputs, *, mask=None, **kw):
+        x = inputs[0]
+        if mask is None:
+            return x[:, -1, :], kw.get("state")
+        idx = jnp.maximum(jnp.sum(mask, axis=1).astype(jnp.int32) - 1, 0)
+        return jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0, :], kw.get("state")
+
+
+@register_serde
+@dataclasses.dataclass(frozen=True)
+class DuplicateToTimeSeriesVertex(GraphVertex):
+    """[B,F] → [B,T,F] broadcast over the timesteps of a reference input.
+    Reference: `nn/conf/graph/rnn/DuplicateToTimeSeriesVertex.java`."""
+
+    timesteps: int = 1
+
+    def output_type(self, *input_types: InputType) -> InputType:
+        return InputType.recurrent(input_types[0].flat_size(), self.timesteps)
+
+    def apply(self, params, inputs, **kw):
+        x = inputs[0]
+        return jnp.broadcast_to(
+            x[:, None, :], (x.shape[0], self.timesteps, x.shape[-1])
+        ), kw.get("state")
+
+
+@register_serde
+@dataclasses.dataclass(frozen=True)
+class LayerVertex(GraphVertex):
+    """A Layer as a DAG node (single input). Reference:
+    `nn/conf/graph/LayerVertex.java`."""
+
+    layer: Optional[Layer] = None
+    preprocessor: Optional[Preprocessor] = None
+
+    def output_type(self, *input_types: InputType) -> InputType:
+        it = input_types[0]
+        if self.preprocessor is not None:
+            it = self.preprocessor.output_type(it)
+        return self.layer.output_type(it)
+
+    def init_params(self, key, input_types, dtype=jnp.float32):
+        it = input_types[0]
+        if self.preprocessor is not None:
+            it = self.preprocessor.output_type(it)
+        return self.layer.init_params(key, it, dtype)
+
+    def apply(self, params, inputs, **kw):
+        x = inputs[0]
+        if self.preprocessor is not None:
+            x = self.preprocessor.apply(x)
+        return self.layer.apply(params, x, **kw)
+
+
+@register_serde
+@dataclasses.dataclass(frozen=True)
+class ComputationGraphConfiguration:
+    """Finalized DAG config. Reference:
+    `nn/conf/ComputationGraphConfiguration.java`."""
+
+    vertices: Dict[str, GraphVertex] = dataclasses.field(default_factory=dict)
+    vertex_inputs: Dict[str, Tuple[str, ...]] = dataclasses.field(default_factory=dict)
+    network_inputs: Tuple[str, ...] = ()
+    network_outputs: Tuple[str, ...] = ()
+    input_types: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    topological_order: Tuple[str, ...] = ()
+    seed: int = 12345
+    updater: Any = None
+    dtype: str = "float32"
+    gradient_normalization: str = "none"
+    gradient_normalization_threshold: float = 1.0
+    tbptt_fwd_length: int = 0
+    tbptt_back_length: int = 0
+
+    def to_json(self) -> str:
+        return to_json(self)
+
+    @staticmethod
+    def from_json(s: str) -> "ComputationGraphConfiguration":
+        conf = from_json(s)
+        return dataclasses.replace(
+            conf,
+            vertex_inputs={k: tuple(v) for k, v in conf.vertex_inputs.items()},
+            network_inputs=tuple(conf.network_inputs),
+            network_outputs=tuple(conf.network_outputs),
+            topological_order=tuple(conf.topological_order),
+        )
+
+
+def toposort(vertex_inputs: Dict[str, Sequence[str]],
+             network_inputs: Sequence[str]) -> List[str]:
+    """Kahn topological order over vertex names. Reference:
+    `ComputationGraph.topologicalSortOrder()` (`init():357`)."""
+    indeg = {v: 0 for v in vertex_inputs}
+    consumers: Dict[str, List[str]] = {}
+    for v, ins in vertex_inputs.items():
+        for i in ins:
+            if i in vertex_inputs:
+                indeg[v] += 1
+                consumers.setdefault(i, []).append(v)
+            elif i not in network_inputs:
+                raise ValueError(f"Vertex {v!r} references unknown input {i!r}")
+    ready = sorted([v for v, d in indeg.items() if d == 0])
+    order = []
+    while ready:
+        v = ready.pop(0)
+        order.append(v)
+        for c in consumers.get(v, []):
+            indeg[c] -= 1
+            if indeg[c] == 0:
+                ready.append(c)
+    if len(order) != len(vertex_inputs):
+        cyc = set(vertex_inputs) - set(order)
+        raise ValueError(f"Graph has a cycle involving: {sorted(cyc)}")
+    return order
+
+
+class GraphBuilder:
+    """Reference: `ComputationGraphConfiguration.GraphBuilder` reached via
+    `NeuralNetConfiguration.Builder.graphBuilder()` (`:717`)."""
+
+    def __init__(self, base):
+        self._base = base
+        self._vertices: Dict[str, GraphVertex] = {}
+        self._inputs: Dict[str, Tuple[str, ...]] = {}
+        self._network_inputs: List[str] = []
+        self._network_outputs: List[str] = []
+        self._input_types: Dict[str, InputType] = {}
+        self._tbptt_fwd = 0
+        self._tbptt_back = 0
+
+    def add_inputs(self, *names: str) -> "GraphBuilder":
+        self._network_inputs.extend(names)
+        return self
+
+    def set_input_types(self, *types: InputType) -> "GraphBuilder":
+        for name, t in zip(self._network_inputs, types):
+            self._input_types[name] = t
+        return self
+
+    def add_layer(self, name: str, layer: Layer, *inputs: str,
+                  preprocessor: Optional[Preprocessor] = None) -> "GraphBuilder":
+        layer = dataclasses.replace(layer, name=name)
+        self._vertices[name] = LayerVertex(
+            name=name, layer=layer, preprocessor=preprocessor)
+        self._inputs[name] = tuple(inputs)
+        return self
+
+    def add_vertex(self, name: str, vertex: GraphVertex, *inputs: str) -> "GraphBuilder":
+        self._vertices[name] = dataclasses.replace(vertex, name=name)
+        self._inputs[name] = tuple(inputs)
+        return self
+
+    def set_outputs(self, *names: str) -> "GraphBuilder":
+        self._network_outputs = list(names)
+        return self
+
+    def tbptt(self, fwd: int, back: Optional[int] = None) -> "GraphBuilder":
+        self._tbptt_fwd = fwd
+        self._tbptt_back = back if back is not None else fwd
+        return self
+
+    def build(self) -> ComputationGraphConfiguration:
+        defaults = self._base._defaults()
+        order = toposort(self._inputs, self._network_inputs)
+
+        # Shape inference + defaults cascade along topological order.
+        known: Dict[str, InputType] = dict(self._input_types)
+        finalized: Dict[str, GraphVertex] = {}
+        for name in order:
+            v = self._vertices[name]
+            in_types = [known[i] for i in self._inputs[name] if i in known]
+            if isinstance(v, LayerVertex):
+                layer = v.layer.with_defaults(**defaults)
+                if in_types:
+                    it = in_types[0]
+                    if v.preprocessor is not None:
+                        it = v.preprocessor.output_type(it)
+                    layer = layer.infer_n_in(it)
+                from deeplearning4j_tpu.nn.config import _validate_layer
+                _validate_layer(layer, -1)
+                v = dataclasses.replace(v, layer=layer)
+            finalized[name] = v
+            if in_types or not self._inputs[name]:
+                try:
+                    known[name] = v.output_type(*in_types)
+                except Exception:
+                    pass  # shape unknown → downstream n_in must be explicit
+        missing = [o for o in self._network_outputs if o not in finalized]
+        if missing:
+            raise ValueError(f"set_outputs references unknown vertices: {missing}")
+
+        return ComputationGraphConfiguration(
+            vertices=finalized,
+            vertex_inputs=dict(self._inputs),
+            network_inputs=tuple(self._network_inputs),
+            network_outputs=tuple(self._network_outputs),
+            input_types=self._input_types,
+            topological_order=tuple(order),
+            seed=self._base._seed,
+            updater=defaults["updater"],
+            dtype=self._base._dtype,
+            gradient_normalization=self._base._grad_norm,
+            gradient_normalization_threshold=self._base._grad_norm_threshold,
+            tbptt_fwd_length=self._tbptt_fwd,
+            tbptt_back_length=self._tbptt_back,
+        )

@@ -8,7 +8,7 @@ float64 is enabled for gradient checks (reference runs them in double).
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"  # force: env ships with axon TPU set
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -17,6 +17,9 @@ if "xla_force_host_platform_device_count" not in flags:
 
 import jax  # noqa: E402
 
+# The image's sitecustomize pins jax_platforms to "axon,cpu" at interpreter
+# start (overriding JAX_PLATFORMS), so re-pin to cpu AFTER importing jax.
+jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
 
 import pytest  # noqa: E402

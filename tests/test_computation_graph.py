@@ -1,0 +1,174 @@
+"""ComputationGraph DAG runtime tests.
+
+Mirrors reference suites: `nn/graph/` tests + GradientCheckTestsComputationGraph.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import InputType
+from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.graph import (
+    ComputationGraphConfiguration, ElementWiseVertex, L2NormalizeVertex,
+    MergeVertex, SubsetVertex, toposort,
+)
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.models import ComputationGraph
+from deeplearning4j_tpu.optim.updaters import Adam, Sgd
+from deeplearning4j_tpu.gradientcheck import check_gradients
+
+
+def _toy(n=256, d=8, classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    w = rng.standard_normal((d, classes))
+    y = (x @ w).argmax(-1)
+    return x, np.eye(classes, dtype=np.float32)[y]
+
+
+def _simple_graph(d=8, classes=3):
+    return (NeuralNetConfiguration.builder()
+            .seed(1).updater(Adam(1e-2)).activation("tanh")
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("d1", DenseLayer(n_out=16), "in")
+            .add_layer("d2", DenseLayer(n_out=16), "d1")
+            .add_vertex("skip", ElementWiseVertex(op="add"), "d1", "d2")
+            .add_layer("out", OutputLayer(n_out=classes, activation="softmax",
+                                          loss="mcxent"), "skip")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(d))
+            .build())
+
+
+class TestToposort:
+    def test_order_respects_edges(self):
+        order = toposort(
+            {"a": ("in",), "b": ("a",), "c": ("a", "b")}, ["in"])
+        assert order.index("a") < order.index("b") < order.index("c")
+
+    def test_cycle_detection(self):
+        with pytest.raises(ValueError, match="cycle"):
+            toposort({"a": ("b",), "b": ("a",)}, [])
+
+    def test_unknown_input(self):
+        with pytest.raises(ValueError, match="unknown input"):
+            toposort({"a": ("nope",)}, ["in"])
+
+
+class TestGraphBuild:
+    def test_shape_inference_through_vertices(self):
+        conf = _simple_graph()
+        assert conf.vertices["d1"].layer.n_in == 8
+        assert conf.vertices["d2"].layer.n_in == 16
+        assert conf.vertices["out"].layer.n_in == 16
+        assert conf.topological_order.index("skip") \
+            < conf.topological_order.index("out")
+
+    def test_json_round_trip(self):
+        conf = _simple_graph()
+        js = conf.to_json()
+        conf2 = ComputationGraphConfiguration.from_json(js)
+        assert conf2.vertices["d1"].layer.n_in == 8
+        assert conf2.network_outputs == ("out",)
+        assert conf2.to_json() == js
+
+    def test_merge_vertex_output_type(self):
+        m = MergeVertex()
+        t = m.output_type(InputType.feed_forward(3), InputType.feed_forward(5))
+        assert t.size == 8
+
+
+class TestGraphFit:
+    def test_skip_connection_learns(self):
+        x, y = _toy()
+        net = ComputationGraph(_simple_graph()).init()
+        before = net.score(__import__(
+            "deeplearning4j_tpu.data.dataset", fromlist=["DataSet"]
+        ).DataSet(x, y))
+        net.fit(x, y, epochs=30, batch_size=64)
+        from deeplearning4j_tpu.data.dataset import DataSet
+        after = net.score(DataSet(x, y))
+        assert after < before * 0.5
+
+    def test_multi_input_multi_output(self):
+        from deeplearning4j_tpu.data.dataset import MultiDataSet
+        rng = np.random.default_rng(0)
+        xa = rng.standard_normal((64, 4)).astype(np.float32)
+        xb = rng.standard_normal((64, 6)).astype(np.float32)
+        ya = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 64)]
+        yb = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 64)]
+        conf = (NeuralNetConfiguration.builder()
+                .seed(1).updater(Sgd(0.1)).activation("relu")
+                .graph_builder()
+                .add_inputs("ina", "inb")
+                .add_layer("da", DenseLayer(n_out=8), "ina")
+                .add_layer("db", DenseLayer(n_out=8), "inb")
+                .add_vertex("merge", MergeVertex(), "da", "db")
+                .add_layer("outa", OutputLayer(n_out=2, activation="softmax"),
+                           "merge")
+                .add_layer("outb", OutputLayer(n_out=3, activation="softmax"),
+                           "merge")
+                .set_outputs("outa", "outb")
+                .set_input_types(InputType.feed_forward(4),
+                                 InputType.feed_forward(6))
+                .build())
+        net = ComputationGraph(conf).init()
+        mds = MultiDataSet([xa, xb], [ya, yb])
+        s0 = net.score(mds)
+        for _ in range(20):
+            net.fit(mds)
+        assert net.score(mds) < s0
+        oa, ob = net.output(xa, xb)
+        assert oa.shape == (64, 2) and ob.shape == (64, 3)
+
+    def test_subset_and_l2norm_vertices(self):
+        x, y = _toy(d=10, classes=2)
+        conf = (NeuralNetConfiguration.builder()
+                .seed(3).updater(Sgd(0.3)).activation("tanh")
+                .graph_builder()
+                .add_inputs("in")
+                .add_vertex("sub", SubsetVertex(from_=0, to=4), "in")
+                .add_vertex("l2n", L2NormalizeVertex(), "sub")
+                .add_layer("out", OutputLayer(n_out=2, activation="softmax"),
+                           "l2n")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(10))
+                .build())
+        net = ComputationGraph(conf).init()
+        assert conf.vertices["out"].layer.n_in == 5
+        net.fit(x, y, epochs=5, batch_size=64)
+        assert net.output(x).shape == (256, 2)
+
+
+class TestGraphGradients:
+    def test_gradient_check_skip_graph(self):
+        x, y = _toy(n=8, d=4, classes=2, seed=5)
+        conf = (NeuralNetConfiguration.builder()
+                .seed(5).updater(Sgd(0.1)).activation("tanh")
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("d1", DenseLayer(n_out=5), "in")
+                .add_layer("d2", DenseLayer(n_out=5), "d1")
+                .add_vertex("skip", ElementWiseVertex(op="add"), "d1", "d2")
+                .add_layer("out", OutputLayer(n_out=2, activation="softmax",
+                                              loss="mcxent"), "skip")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(4))
+                .build())
+        net = ComputationGraph(conf).init()
+
+        class _Shim:
+            params_tree = net.params_tree
+            state_tree = net.state_tree
+
+            @staticmethod
+            def _loss(params, states, features, labels, fmask, lmask, rng,
+                      train=False):
+                return net._loss(
+                    params, states, {"in": features}, {"out": labels},
+                    None if fmask is None else {"in": fmask},
+                    None if lmask is None else {"out": lmask},
+                    rng, train=train)
+
+        assert check_gradients(_Shim, x, y)

@@ -1,0 +1,347 @@
+"""Layer-zoo tests: conv/pool/BN/LSTM/masking gradient checks + behavior.
+
+Mirrors the reference gradient-check suites (CNNGradientCheckTest,
+LSTMGradientCheckTests, BNGradientCheckTest, GradientCheckTestsMasking,
+VaeGradientCheckTests — SURVEY §4).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import InputType
+from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import (
+    ActivationLayer, AutoEncoder, BatchNormalization, Bidirectional,
+    ConvolutionLayer, DenseLayer, DropoutLayer, EmbeddingLayer,
+    GlobalPoolingLayer, GravesLSTM, LSTM, LastTimeStep,
+    LocalResponseNormalization, OutputLayer, RBM, RnnOutputLayer,
+    SimpleRnn, SubsamplingLayer, VariationalAutoencoder, ZeroPaddingLayer,
+)
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.optim.updaters import Adam, Sgd
+from deeplearning4j_tpu.gradientcheck import check_gradients
+
+
+def _onehot(idx, n):
+    return np.eye(n, dtype=np.float32)[idx]
+
+
+class TestCnn:
+    def _conf(self, **kw):
+        return (NeuralNetConfiguration.builder()
+                .seed(9).updater(Sgd(0.1)).activation("tanh")
+                .list(
+                    ConvolutionLayer(n_out=3, kernel=(3, 3), stride=(1, 1)),
+                    SubsamplingLayer(pooling=kw.get("pooling", "max"),
+                                     kernel=(2, 2), stride=(2, 2)),
+                    OutputLayer(n_out=2, activation="softmax", loss="mcxent"))
+                .set_input_type(InputType.convolutional(8, 8, 2))
+                .build())
+
+    def test_shape_inference(self):
+        conf = self._conf()
+        assert conf.layers[0].n_in == 2
+        # conv 8x8 k3 s1 truncate -> 6x6x3; pool 2x2 -> 3x3x3 -> flat 27
+        assert conf.layers[2].n_in == 27
+
+    @pytest.mark.parametrize("pooling", ["max", "avg"])
+    def test_gradient_check(self, pooling):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((4, 8, 8, 2))
+        y = _onehot(rng.integers(0, 2, 4), 2)
+        net = MultiLayerNetwork(self._conf(pooling=pooling)).init()
+        assert check_gradients(net, x, y, subset=60)
+
+    def test_cnn_flat_input_with_preprocessor(self):
+        conf = (NeuralNetConfiguration.builder()
+                .seed(1).updater(Adam(1e-2)).activation("relu")
+                .list(ConvolutionLayer(n_out=4, kernel=(3, 3)),
+                      SubsamplingLayer(kernel=(2, 2), stride=(2, 2)),
+                      OutputLayer(n_out=3, activation="softmax"))
+                .set_input_type(InputType.convolutional_flat(8, 8, 1))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((16, 64)).astype(np.float32)
+        y = _onehot(rng.integers(0, 3, 16), 3)
+        net.fit(x, y, epochs=2, batch_size=8)
+        assert np.asarray(net.output(x)).shape == (16, 3)
+
+    def test_zero_padding_and_same_mode(self):
+        conf = (NeuralNetConfiguration.builder()
+                .seed(1).updater(Sgd(0.1))
+                .list(ZeroPaddingLayer(pad=(1, 1)),
+                      ConvolutionLayer(n_out=2, kernel=(3, 3),
+                                       convolution_mode="same",
+                                       activation="relu"),
+                      OutputLayer(n_out=2, activation="softmax"))
+                .set_input_type(InputType.convolutional(6, 6, 1))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.default_rng(0).standard_normal((2, 6, 6, 1))
+        out = np.asarray(net.output(x.astype(np.float32)))
+        assert out.shape == (2, 2)
+
+
+class TestBatchNorm:
+    def test_running_stats_update_and_freeze_at_eval(self):
+        conf = (NeuralNetConfiguration.builder()
+                .seed(2).updater(Sgd(0.1)).activation("identity")
+                .list(DenseLayer(n_out=6),
+                      BatchNormalization(),
+                      OutputLayer(n_out=2, activation="softmax"))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        x = 3 + 2 * rng.standard_normal((64, 4)).astype(np.float32)
+        y = _onehot(rng.integers(0, 2, 64), 2)
+        bn_name = conf.layers[1].name
+        mean0 = np.asarray(net.state_tree[bn_name]["mean"]).copy()
+        net.fit(x, y, epochs=3, batch_size=32)
+        mean1 = np.asarray(net.state_tree[bn_name]["mean"])
+        assert not np.allclose(mean0, mean1), "running mean should move in train"
+        out1 = np.asarray(net.output(x))
+        out2 = np.asarray(net.output(x))
+        np.testing.assert_allclose(out1, out2)  # eval is deterministic
+
+    def test_gradient_check_eval_stats(self):
+        conf = (NeuralNetConfiguration.builder()
+                .seed(2).updater(Sgd(0.1)).activation("tanh")
+                .list(DenseLayer(n_out=5), BatchNormalization(),
+                      OutputLayer(n_out=2, activation="softmax"))
+                .set_input_type(InputType.feed_forward(3))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((6, 3))
+        y = _onehot(rng.integers(0, 2, 6), 2)
+        assert check_gradients(net, x, y)
+
+
+class TestRnn:
+    def _lstm_conf(self, cls=LSTM, loss_layer=None, T=None):
+        loss_layer = loss_layer or RnnOutputLayer(
+            n_out=3, activation="softmax", loss="mcxent")
+        return (NeuralNetConfiguration.builder()
+                .seed(4).updater(Sgd(0.1)).activation("tanh")
+                .list(cls(n_out=5), loss_layer)
+                .set_input_type(InputType.recurrent(4, T))
+                .build())
+
+    @pytest.mark.parametrize("cls", [LSTM, GravesLSTM, SimpleRnn])
+    def test_gradient_check_rnn(self, cls):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((3, 6, 4))
+        y = _onehot(rng.integers(0, 3, (3, 6)), 3)
+        net = MultiLayerNetwork(self._lstm_conf(cls)).init()
+        assert check_gradients(net, x, y, subset=80)
+
+    def test_gradient_check_masked(self):
+        """Reference: GradientCheckTestsMasking."""
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((3, 6, 4))
+        y = _onehot(rng.integers(0, 3, (3, 6)), 3)
+        mask = np.ones((3, 6))
+        mask[0, 4:] = 0
+        mask[2, 2:] = 0
+        net = MultiLayerNetwork(self._lstm_conf(LSTM)).init()
+        assert check_gradients(net, x, y, features_mask=mask,
+                               labels_mask=mask, subset=80)
+
+    def test_masked_timesteps_do_not_affect_carry(self):
+        net = MultiLayerNetwork(self._lstm_conf(LSTM)).init()
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2, 6, 4)).astype(np.float32)
+        mask = np.ones((2, 6), np.float32)
+        mask[:, 3:] = 0
+        x2 = x.copy()
+        x2[:, 3:] = 999.0  # junk in masked region
+        import jax.numpy as jnp
+        l = net.conf.layers[0]
+        p = net.params_tree[l.name]
+        y1, c1 = l.apply(p, jnp.asarray(x), mask=jnp.asarray(mask))
+        y2, c2 = l.apply(p, jnp.asarray(x2), mask=jnp.asarray(mask))
+        np.testing.assert_allclose(np.asarray(c1["h"]), np.asarray(c2["h"]),
+                                   rtol=1e-5)
+
+    def test_rnn_time_step_matches_full_forward(self):
+        """Reference: rnnTimeStep consistency tests."""
+        conf = self._lstm_conf(LSTM)
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2, 5, 4)).astype(np.float32)
+        full = np.asarray(net.output(x))
+        net.rnn_clear_previous_state()
+        steps = [np.asarray(net.rnn_time_step(x[:, t])) for t in range(5)]
+        stepped = np.concatenate(steps, axis=1)
+        np.testing.assert_allclose(full, stepped, rtol=1e-4, atol=1e-5)
+
+    def test_bidirectional_and_last_timestep(self):
+        conf = (NeuralNetConfiguration.builder()
+                .seed(4).updater(Adam(1e-2)).activation("tanh")
+                .list(Bidirectional(layer=LSTM(n_out=4)),
+                      LastTimeStep(layer=LSTM(n_out=6)),
+                      OutputLayer(n_out=2, activation="softmax"))
+                .set_input_type(InputType.recurrent(3))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((8, 7, 3)).astype(np.float32)
+        y = _onehot(rng.integers(0, 2, 8), 2)
+        net.fit(x, y, epochs=3, batch_size=8)
+        assert np.asarray(net.output(x)).shape == (8, 2)
+
+    def test_tbptt_fit(self):
+        conf = (NeuralNetConfiguration.builder()
+                .seed(4).updater(Adam(1e-2)).activation("tanh")
+                .list(LSTM(n_out=5),
+                      RnnOutputLayer(n_out=2, activation="softmax"))
+                .set_input_type(InputType.recurrent(3))
+                .tbptt(4)
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((4, 12, 3)).astype(np.float32)
+        y = _onehot(rng.integers(0, 2, (4, 12)), 2)
+        net.fit(x, y, epochs=3, batch_size=4)
+        assert net.score_ is not None and np.isfinite(net.score_)
+
+    def test_tbptt_rejects_2d_labels(self):
+        conf = (NeuralNetConfiguration.builder()
+                .list(LSTM(n_out=4), LastTimeStep(layer=LSTM(n_out=4)),
+                      OutputLayer(n_out=2, activation="softmax"))
+                .set_input_type(InputType.recurrent(3)).tbptt(4).build())
+        net = MultiLayerNetwork(conf).init()
+        x = np.zeros((2, 8, 3), np.float32)
+        y = _onehot([0, 1], 2)
+        with pytest.raises(ValueError, match="per-timestep"):
+            net.fit(x, y, epochs=1)
+
+
+class TestMiscLayers:
+    def test_embedding_layer(self):
+        conf = (NeuralNetConfiguration.builder()
+                .seed(0).updater(Adam(5e-2))
+                .list(EmbeddingLayer(n_in=10, n_out=6, activation="identity"),
+                      OutputLayer(n_out=10, activation="softmax"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        idx = np.arange(10)
+        y = _onehot(idx, 10)  # identity mapping task
+        for _ in range(60):
+            net.fit(idx[:, None], y, epochs=1, batch_size=10)
+        assert (net.predict(idx[:, None]) == idx).mean() > 0.8
+
+    def test_dropout_train_vs_eval(self):
+        conf = (NeuralNetConfiguration.builder()
+                .seed(0).updater(Sgd(0.1)).dropout(0.5)
+                .list(DenseLayer(n_out=32, activation="identity"),
+                      OutputLayer(n_out=2, activation="softmax"))
+                .set_input_type(InputType.feed_forward(8))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = np.ones((4, 8), np.float32)
+        o1 = np.asarray(net.output(x))
+        o2 = np.asarray(net.output(x))
+        np.testing.assert_allclose(o1, o2)  # no dropout at inference
+
+    def test_global_pooling_cnn(self):
+        conf = (NeuralNetConfiguration.builder()
+                .seed(0).updater(Sgd(0.1))
+                .list(ConvolutionLayer(n_out=5, kernel=(3, 3),
+                                       activation="relu"),
+                      GlobalPoolingLayer(pooling="avg"),
+                      OutputLayer(n_out=2, activation="softmax"))
+                .set_input_type(InputType.convolutional(6, 6, 1))
+                .build())
+        assert conf.layers[2].n_in == 5
+        net = MultiLayerNetwork(conf).init()
+        x = np.random.default_rng(0).standard_normal((3, 6, 6, 1)).astype(np.float32)
+        assert np.asarray(net.output(x)).shape == (3, 2)
+
+    def test_lrn_preserves_shape(self):
+        import jax.numpy as jnp
+        lrn = LocalResponseNormalization()
+        x = jnp.ones((2, 4, 4, 7))
+        y, _ = lrn.apply({}, x)
+        assert y.shape == x.shape
+
+
+class TestPretraining:
+    def test_autoencoder_pretrain_reduces_reconstruction(self):
+        conf = (NeuralNetConfiguration.builder()
+                .seed(0).updater(Adam(1e-2)).activation("sigmoid")
+                .list(AutoEncoder(n_out=8, corruption_level=0.0),
+                      OutputLayer(n_out=2, activation="softmax"))
+                .set_input_type(InputType.feed_forward(16))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.random((64, 16)).astype(np.float32)
+        import jax
+        ae = conf.layers[0]
+        r0 = float(ae.reconstruction_score(
+            net.params_tree[ae.name], x, rng=jax.random.PRNGKey(0)))
+        net.pretrain(x, epochs=30, batch_size=32)
+        r1 = float(ae.reconstruction_score(
+            net.params_tree[ae.name], x, rng=jax.random.PRNGKey(0)))
+        assert r1 < r0
+
+    def test_vae_pretrain_improves_elbo(self):
+        conf = (NeuralNetConfiguration.builder()
+                .seed(0).updater(Adam(1e-2)).activation("tanh")
+                .list(VariationalAutoencoder(
+                          n_out=4, encoder_sizes=(16,), decoder_sizes=(16,),
+                          reconstruction_distribution="bernoulli"),
+                      OutputLayer(n_out=2, activation="softmax"))
+                .set_input_type(InputType.feed_forward(12))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        x = (rng.random((64, 12)) > 0.5).astype(np.float32)
+        import jax
+        vae = conf.layers[0]
+        e0 = float(vae.reconstruction_score(
+            net.params_tree[vae.name], x, rng=jax.random.PRNGKey(0)))
+        net.pretrain(x, epochs=20, batch_size=32)
+        e1 = float(vae.reconstruction_score(
+            net.params_tree[vae.name], x, rng=jax.random.PRNGKey(0)))
+        assert e1 < e0
+
+    def test_rbm_pretrain_runs(self):
+        conf = (NeuralNetConfiguration.builder()
+                .seed(0).updater(Sgd(0.05))
+                .list(RBM(n_out=6),
+                      OutputLayer(n_out=2, activation="softmax"))
+                .set_input_type(InputType.feed_forward(10))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = (np.random.default_rng(0).random((32, 10)) > 0.5).astype(np.float32)
+        w0 = np.asarray(net.params_tree[conf.layers[0].name]["W"]).copy()
+        net.pretrain(x, epochs=5, batch_size=16)
+        w1 = np.asarray(net.params_tree[conf.layers[0].name]["W"])
+        assert not np.allclose(w0, w1)
+
+
+class TestFreezing:
+    def test_frozen_layer_params_do_not_change(self):
+        from deeplearning4j_tpu.nn.layers import FrozenLayer
+        conf = (NeuralNetConfiguration.builder()
+                .seed(0).updater(Sgd(0.5)).activation("tanh")
+                .list(FrozenLayer(layer=DenseLayer(n_out=6)),
+                      OutputLayer(n_out=2, activation="softmax"))
+                .set_input_type(InputType.feed_forward(4))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((32, 4)).astype(np.float32)
+        y = _onehot(rng.integers(0, 2, 32), 2)
+        frozen_name = conf.layers[0].name
+        out_name = conf.layers[1].name
+        w0 = np.asarray(net.params_tree[frozen_name]["W"]).copy()
+        o0 = np.asarray(net.params_tree[out_name]["W"]).copy()
+        net.fit(x, y, epochs=5, batch_size=16)
+        np.testing.assert_allclose(
+            np.asarray(net.params_tree[frozen_name]["W"]), w0)
+        assert not np.allclose(np.asarray(net.params_tree[out_name]["W"]), o0)
