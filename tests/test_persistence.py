@@ -1,0 +1,232 @@
+"""Checkpoint / transfer-learning / early-stopping tests.
+
+Mirrors reference suites: ModelSerializer tests, regression/serialization
+compat tests, TransferLearning tests, TestEarlyStopping (SURVEY §4).
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import InputType
+from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import BatchNormalization, DenseLayer, OutputLayer
+from deeplearning4j_tpu.models import ComputationGraph, MultiLayerNetwork
+from deeplearning4j_tpu.models.serialize import save_model, load_model
+from deeplearning4j_tpu.models.transfer import (
+    FineTuneConfiguration, TransferLearning, TransferLearningHelper,
+)
+from deeplearning4j_tpu.earlystopping import (
+    DataSetLossCalculator, EarlyStoppingConfiguration, EarlyStoppingTrainer,
+    InMemoryModelSaver, InvalidScoreIterationTerminationCondition,
+    LocalFileModelSaver, MaxEpochsTerminationCondition,
+    MaxTimeIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+)
+from deeplearning4j_tpu.data import ArrayDataSetIterator
+from deeplearning4j_tpu.optim.updaters import Adam, Sgd
+
+
+def _toy(n=128, d=6, classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    w = rng.standard_normal((d, classes))
+    y = np.eye(classes, dtype=np.float32)[(x @ w).argmax(-1)]
+    return x, y
+
+
+def _net(d=6, classes=3, with_bn=False):
+    layers = [DenseLayer(n_out=12)]
+    if with_bn:
+        layers.append(BatchNormalization())
+    layers.append(OutputLayer(n_out=classes, activation="softmax"))
+    return MultiLayerNetwork(
+        (NeuralNetConfiguration.builder()
+         .seed(11).updater(Adam(1e-2)).activation("tanh")
+         .list(*layers)
+         .set_input_type(InputType.feed_forward(d))
+         .build())).init()
+
+
+class TestModelSerializer:
+    def test_zip_round_trip_exact(self, tmp_path):
+        x, y = _toy()
+        net = _net(with_bn=True)
+        net.fit(x, y, epochs=3, batch_size=32)
+        p = tmp_path / "model.zip"
+        save_model(net, p)
+        net2 = load_model(p)
+        np.testing.assert_allclose(net.params(), net2.params(), rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(net.output(x)), np.asarray(net2.output(x)), rtol=1e-5)
+        assert net2.iteration == net.iteration
+        assert net2.epoch == net.epoch
+
+    def test_training_resumes_identically(self, tmp_path):
+        """Updater state round-trips: resumed training == uninterrupted."""
+        x, y = _toy()
+        a = _net()
+        a.fit(x, y, epochs=2, batch_size=32)
+        p = tmp_path / "mid.zip"
+        save_model(a, p)
+        a.fit(x, y, epochs=2, batch_size=32)
+
+        b = load_model(p)
+        b._rng = __import__("jax").random.PRNGKey(999)  # rng only affects dropout (none here)
+        b.fit(x, y, epochs=2, batch_size=32)
+        np.testing.assert_allclose(a.params(), b.params(), rtol=1e-4, atol=1e-6)
+
+    def test_graph_round_trip(self, tmp_path):
+        from deeplearning4j_tpu.nn.graph import MergeVertex
+        x, y = _toy(d=6, classes=2)
+        conf = (NeuralNetConfiguration.builder()
+                .seed(1).updater(Sgd(0.1)).activation("relu")
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("a", DenseLayer(n_out=4), "in")
+                .add_layer("b", DenseLayer(n_out=4), "in")
+                .add_vertex("m", MergeVertex(), "a", "b")
+                .add_layer("out", OutputLayer(n_out=2, activation="softmax"), "m")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(6))
+                .build())
+        net = ComputationGraph(conf).init()
+        net.fit(x, y, epochs=2, batch_size=64)
+        p = tmp_path / "graph.zip"
+        save_model(net, p)
+        net2 = load_model(p)
+        assert isinstance(net2, ComputationGraph)
+        np.testing.assert_allclose(
+            np.asarray(net.output(x)), np.asarray(net2.output(x)), rtol=1e-5)
+
+
+class TestOrbaxCheckpoints:
+    def test_checkpoint_manager_round_trip(self, tmp_path):
+        from deeplearning4j_tpu.models.serialize import CheckpointManager
+        x, y = _toy()
+        net = _net()
+        net.fit(x, y, epochs=2, batch_size=32)
+        mgr = CheckpointManager(tmp_path / "ckpts", async_save=False)
+        mgr.save(0, net)
+        mgr.wait()
+        net2 = _net()
+        mgr.restore(net2, 0)
+        np.testing.assert_allclose(net.params(), net2.params(), rtol=1e-6)
+        mgr.close()
+
+
+class TestTransferLearning:
+    def test_freeze_and_replace_head(self):
+        x, y = _toy(classes=3)
+        src = _net(classes=3)
+        src.fit(x, y, epochs=3, batch_size=32)
+
+        new = (TransferLearning.builder(src)
+               .fine_tune_configuration(FineTuneConfiguration(updater=Sgd(0.05)))
+               .set_feature_extractor(0)
+               .remove_layers_from_output(1)
+               .add_layer(OutputLayer(n_out=5, activation="softmax",
+                                      loss="mcxent"))
+               .build())
+        assert new.layers[0].frozen
+        assert new.layers[-1].n_out == 5
+        assert new.layers[-1].n_in == 12
+        # frozen layer kept source params
+        src_w = np.asarray(src.params_tree[src.layers[0].name]["W"])
+        new_w = np.asarray(new.params_tree[new.layers[0].name]["W"])
+        np.testing.assert_allclose(src_w, new_w)
+        # training does not change frozen weights
+        y5 = np.eye(5, dtype=np.float32)[np.random.default_rng(0).integers(0, 5, len(x))]
+        new.fit(x, y5, epochs=2, batch_size=32)
+        np.testing.assert_allclose(
+            np.asarray(new.params_tree[new.layers[0].name]["W"]), src_w)
+
+    def test_n_out_replace(self):
+        src = _net()
+        new = (TransferLearning.builder(src)
+               .n_out_replace(0, 20)
+               .build())
+        assert new.layers[0].n_out == 20
+        assert new.layers[1].n_in == 20
+        assert np.asarray(new.params_tree[new.layers[0].name]["W"]).shape == (6, 20)
+
+    def test_helper_featurize(self):
+        x, y = _toy()
+        src = _net()
+        frozen = (TransferLearning.builder(src)
+                  .set_feature_extractor(0)
+                  .build())
+        helper = TransferLearningHelper(frozen)
+        feats = helper.featurize(x)
+        assert feats.shape == (len(x), 12)
+        helper.fit_featurized(x, y, epochs=2, batch_size=32)
+
+
+class TestEarlyStopping:
+    def test_max_epochs_and_best_model(self):
+        x, y = _toy()
+        net = _net()
+        it = ArrayDataSetIterator(x, y, 32)
+        cfg = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(ArrayDataSetIterator(x, y, 64)),
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(8)],
+            model_saver=InMemoryModelSaver(),
+        )
+        result = EarlyStoppingTrainer(cfg, net, it).fit()
+        assert result.termination_reason == "EpochTermination"
+        assert result.total_epochs <= 8 + 1
+        assert result.best_model is not None
+        assert np.isfinite(result.best_model_score)
+        assert result.best_model_score <= max(result.score_vs_epoch.values())
+
+    def test_score_improvement_patience(self):
+        x, y = _toy()
+        net = _net()
+        cfg = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(ArrayDataSetIterator(x, y, 64)),
+            epoch_termination_conditions=[
+                ScoreImprovementEpochTerminationCondition(2, min_improvement=5e-2),
+                MaxEpochsTerminationCondition(100),
+            ],
+        )
+        result = EarlyStoppingTrainer(
+            cfg, net, ArrayDataSetIterator(x, y, 32)).fit()
+        assert result.total_epochs < 100
+
+    def test_invalid_score_abort(self):
+        x, y = _toy()
+        net = _net()
+        net.conf = __import__("dataclasses").replace(net.conf)
+        # Blow up the LR to force NaN quickly.
+        bad = MultiLayerNetwork(
+            (NeuralNetConfiguration.builder()
+             .seed(1).updater(Sgd(1e6)).activation("tanh")
+             .list(DenseLayer(n_out=12),
+                   OutputLayer(n_out=3, activation="softmax"))
+             .set_input_type(InputType.feed_forward(6))
+             .build())).init()
+        cfg = EarlyStoppingConfiguration(
+            iteration_termination_conditions=[
+                InvalidScoreIterationTerminationCondition()],
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(50)],
+        )
+        result = EarlyStoppingTrainer(
+            cfg, bad, ArrayDataSetIterator(x, y, 32)).fit()
+        assert result.termination_reason in ("IterationTermination", "EpochTermination")
+
+    def test_local_file_saver(self, tmp_path):
+        x, y = _toy()
+        net = _net()
+        cfg = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(ArrayDataSetIterator(x, y, 64)),
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(3)],
+            model_saver=LocalFileModelSaver(str(tmp_path)),
+            save_last_model=True,
+        )
+        result = EarlyStoppingTrainer(
+            cfg, net, ArrayDataSetIterator(x, y, 32)).fit()
+        assert os.path.exists(tmp_path / "bestModel.zip")
+        assert os.path.exists(tmp_path / "latestModel.zip")
+        best = result.best_model
+        assert np.asarray(best.output(x)).shape == (128, 3)
