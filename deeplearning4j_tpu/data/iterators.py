@@ -128,10 +128,14 @@ class AsyncDataSetIterator(DataSetIterator):
         except BaseException as e:  # surfaced on the consumer side
             self._error = e
         finally:
-            try:
-                q.put_nowait(self._SENTINEL)
-            except queue.Full:
-                pass
+            # The sentinel MUST reach the consumer (a dropped sentinel hangs
+            # the consumer) — block with the same stop-aware loop.
+            while not stop.is_set():
+                try:
+                    q.put(self._SENTINEL, timeout=0.1)
+                    break
+                except queue.Full:
+                    continue
 
     def reset(self):
         if self._stop is not None:

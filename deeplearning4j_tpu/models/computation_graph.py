@@ -156,9 +156,19 @@ class ComputationGraph:
         return total, new_states
 
     # ------------------------------------------------------ train step
+    def make_step_fn(self):
+        """Pure (un-jitted) train-step fn for parallel trainers (see
+        MultiLayerNetwork.make_step_fn)."""
+        return self._build_step(jit=False)
+
     def _get_train_step(self, key):
         if key in self._jit_cache:
             return self._jit_cache[key]
+        fn = self._build_step(jit=True)
+        self._jit_cache[key] = fn
+        return fn
+
+    def _build_step(self, jit: bool):
         mode = self.conf.gradient_normalization
         thr = self.conf.gradient_normalization_threshold
         updaters = self._vertex_updaters
@@ -184,9 +194,9 @@ class ComputationGraph:
             }
             return new_params, new_opt, persist, loss
 
-        fn = jax.jit(step_fn, donate_argnums=(0, 1, 2))
-        self._jit_cache[key] = fn
-        return fn
+        if not jit:
+            return step_fn
+        return jax.jit(step_fn, donate_argnums=(0, 1, 2))
 
     # ---------------------------------------------------- data plumbing
     def _to_dicts(self, ds: Union[DataSet, MultiDataSet]):

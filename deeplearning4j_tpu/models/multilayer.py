@@ -196,10 +196,20 @@ class MultiLayerNetwork:
         return score + reg, new_states
 
     # ------------------------------------------------------ train step
+    def make_step_fn(self, tbptt: bool = False):
+        """The pure (un-jitted) train-step function — also consumed by the
+        parallel trainers, which re-jit it with mesh shardings (DP/TP),
+        the way the reference's ParallelWrapper wraps the same model fit."""
+        return self._build_step(( False, False, tbptt), jit=False)
+
     def _get_train_step(self, key):
         if key in self._jit_cache:
             return self._jit_cache[key]
+        fn = self._build_step(key, jit=True)
+        self._jit_cache[key] = fn
+        return fn
 
+    def _build_step(self, key, jit: bool):
         has_fmask, has_lmask, tbptt = key[0], key[1], key[2]
         mode = self.conf.gradient_normalization
         thr = self.conf.gradient_normalization_threshold
@@ -230,9 +240,9 @@ class MultiLayerNetwork:
             } if tbptt else {}
             return new_params, new_opt, persist, loss, out_carries
 
-        fn = jax.jit(step_fn, donate_argnums=(0, 1, 2))
-        self._jit_cache[key] = fn
-        return fn
+        if not jit:
+            return step_fn
+        return jax.jit(step_fn, donate_argnums=(0, 1, 2))
 
     # ---------------------------------------------------------- fit API
     def fit(self, data, labels=None, *, epochs: int = 1, batch_size: int = 32):

@@ -292,11 +292,13 @@ def _expected_kind(layer: Layer, cur: InputType) -> str:
         conv_mod.Cropping2DLayer, conv_mod.DepthwiseConvolution2DLayer,
         conv_mod.SeparableConvolution2DLayer,
     )
+    from deeplearning4j_tpu.nn.layers.attention import MultiHeadAttention
+
     rnn_types = (
         rnn_mod.BaseRecurrentLayer, rnn_mod.Bidirectional,
         rnn_mod.GravesBidirectionalLSTM, rnn_mod.RnnOutputLayer,
         rnn_mod.LastTimeStep, conv_mod.Convolution1DLayer,
-        conv_mod.Subsampling1DLayer,
+        conv_mod.Subsampling1DLayer, MultiHeadAttention,
     )
     if isinstance(layer, cnn_types):
         return "cnn"

@@ -28,6 +28,7 @@ from deeplearning4j_tpu.nn.layers.recurrent import (
 from deeplearning4j_tpu.nn.layers.special import (
     FrozenLayer, CenterLossOutputLayer, VariationalAutoencoder, RBM,
 )
+from deeplearning4j_tpu.nn.layers.attention import MultiHeadAttention
 
 __all__ = [
     "Layer", "LAYER_REGISTRY",
@@ -42,4 +43,5 @@ __all__ = [
     "LSTM", "GravesLSTM", "GravesBidirectionalLSTM", "SimpleRnn", "GRU",
     "RnnOutputLayer", "Bidirectional", "LastTimeStep",
     "FrozenLayer", "CenterLossOutputLayer", "VariationalAutoencoder", "RBM",
+    "MultiHeadAttention",
 ]

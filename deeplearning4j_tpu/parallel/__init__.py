@@ -1,0 +1,32 @@
+"""Parallelism over TPU device meshes.
+
+Reference parity (redesigned): deeplearning4j-scaleout's five data-parallel
+flavors (SURVEY §2.4) — ParallelWrapper AVERAGING / SHARED_GRADIENTS, Spark
+parameter averaging, Aeron parameter server, hogwild embeddings — all
+collapse on TPU into sharded jit over a `jax.sharding.Mesh` with XLA
+collectives over ICI (allreduce replaces quantized-gradient queues,
+treeAggregate, and the PS daemon at once; SURVEY §5 'distributed
+communication backend').
+
+Extensions beyond the reference (required for TPU scale, SURVEY §7 step 7):
+tensor/sequence parallelism as extra mesh axes, ring attention for long
+context, multi-host DCN initialization.
+"""
+
+from deeplearning4j_tpu.parallel.mesh import (
+    MeshSpec, make_mesh, device_count, local_device_count,
+)
+from deeplearning4j_tpu.parallel.data_parallel import ParallelWrapper
+from deeplearning4j_tpu.parallel.sharding import (
+    ShardingRules, shard_params, replicate, batch_sharding,
+    tensor_parallel_rules,
+)
+from deeplearning4j_tpu.parallel.inference import ParallelInference
+from deeplearning4j_tpu.parallel.distributed import initialize_distributed
+
+__all__ = [
+    "MeshSpec", "make_mesh", "device_count", "local_device_count",
+    "ParallelWrapper", "ParallelInference",
+    "ShardingRules", "shard_params", "replicate", "batch_sharding",
+    "tensor_parallel_rules", "initialize_distributed",
+]

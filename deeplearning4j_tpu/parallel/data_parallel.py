@@ -1,0 +1,213 @@
+"""ParallelWrapper — sharded-jit multi-device trainer.
+
+Reference parity: `parallelism/ParallelWrapper.java` (SURVEY §3.3): the
+reference round-robins minibatches to N replica threads and averages
+params/updater state every `averagingFrequency` iterations (AVERAGING mode)
+or exchanges threshold-quantized gradients (SHARED_GRADIENTS mode). On TPU
+the whole construct is ONE jitted train step over a mesh: the global batch
+is sharded over the `data` axis, params are replicated (or FSDP-sharded via
+rules), and XLA emits a single fused allreduce over ICI for the gradients —
+mathematically the reference's averaging with frequency 1, without
+quantization (ICI bandwidth makes 1-bit compression pointless — SURVEY §5).
+
+Works over MultiLayerNetwork and ComputationGraph. Same API shape as the
+reference: wrap a model, call fit(iterator).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.data.iterators import as_iterator
+from deeplearning4j_tpu.parallel.mesh import AXIS_DATA, make_mesh
+from deeplearning4j_tpu.parallel.sharding import ShardingRules
+
+
+def _is_graph(net) -> bool:
+    from deeplearning4j_tpu.models.computation_graph import ComputationGraph
+
+    return isinstance(net, ComputationGraph)
+
+
+class ParallelWrapper:
+    """Data-parallel trainer over a mesh.
+
+    Kwargs mirror the reference Builder (`ParallelWrapper.java:562-715`)
+    where meaningful: `prefetch_buffer` maps to async-iterator depth;
+    `workers` is implied by the mesh's data-axis size. Gradient averaging is
+    exact and per-step (allreduce), i.e. averagingFrequency=1 semantics.
+    `param_rules` opts into FSDP/ZeRO-style parameter+optimizer sharding
+    (reference precedent: none — extension)."""
+
+    def __init__(self, net, *, mesh: Optional[Mesh] = None,
+                 param_rules: Optional[ShardingRules] = None,
+                 prefetch_buffer: int = 2,
+                 batch_axis: str = AXIS_DATA):
+        if net.params_tree is None:
+            raise RuntimeError("Model must be init()ed before wrapping")
+        self.net = net
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.batch_axis = batch_axis
+        self.param_rules = param_rules
+        self.prefetch = prefetch_buffer
+        self._graph = _is_graph(net)
+        self._jit_cache: Dict[Any, Any] = {}
+
+        if batch_axis not in self.mesh.axis_names:
+            raise ValueError(
+                f"Mesh {self.mesh.axis_names} has no {batch_axis!r} axis")
+        self.data_size = self.mesh.shape[batch_axis]
+
+        self._rep = NamedSharding(self.mesh, P())
+        self._params_sh = self._param_tree_sharding(net.params_tree)
+        self._opt_sh = self._param_tree_sharding(net.updater_state)
+        net.params_tree = jax.device_put(net.params_tree, self._params_sh)
+        net.updater_state = jax.device_put(net.updater_state, self._opt_sh)
+        if net.state_tree:
+            net.state_tree = jax.device_put(net.state_tree, self._rep)
+
+    # ------------------------------------------------------- shardings
+    def _param_tree_sharding(self, tree):
+        """NamedSharding tree matching `tree`'s structure. Param-name rules
+        apply at the LEAF key (so updater state like {'m': {'W': ...}} shards
+        like its underlying param 'W')."""
+        if self.param_rules is None:
+            return jax.tree_util.tree_map(lambda _: self._rep, tree)
+
+        def build(layer_name, sub):
+            if isinstance(sub, dict):
+                return {k: build(layer_name, v) if isinstance(v, dict)
+                        else self._leaf_sharding(layer_name, k, v)
+                        for k, v in sub.items()}
+            return jax.tree_util.tree_map(lambda _: self._rep, sub)
+
+        return {ln: build(ln, sub) for ln, sub in tree.items()}
+
+    def _leaf_sharding(self, layer_name, param_name, leaf):
+        spec = self.param_rules.spec_for(layer_name, param_name)
+        nd = getattr(leaf, "ndim", None)
+        if nd is not None and len(spec) > nd:
+            spec = P()
+        return NamedSharding(self.mesh, spec)
+
+    def _batch_sharding_like(self, x):
+        if x is None:
+            return None
+        if isinstance(x, dict):
+            return {k: self._batch_sharding_like(v) for k, v in x.items()}
+        return NamedSharding(
+            self.mesh, P(self.batch_axis, *([None] * (x.ndim - 1))))
+
+    # ------------------------------------------------------- step build
+    def _get_step(self, key, example_args):
+        if key in self._jit_cache:
+            return self._jit_cache[key]
+        base = self.net.make_step_fn()
+        if self._graph:
+            # (params, opt, states, step, inputs, labels, fmasks, lmasks, rng)
+            _, _, _, _, feats, labs, fms, lms, _ = example_args
+            in_sh = (self._params_sh, self._opt_sh, self._rep, self._rep,
+                     self._batch_sharding_like(feats),
+                     self._batch_sharding_like(labs),
+                     self._batch_sharding_like(fms),
+                     self._batch_sharding_like(lms),
+                     self._rep)
+        else:
+            # (params, opt, states, step, feats, labels, fm, lm, rng, carries)
+            _, _, _, _, feats, labs, fm, lm, _, _ = example_args
+            in_sh = (self._params_sh, self._opt_sh, self._rep, self._rep,
+                     self._batch_sharding_like(feats),
+                     self._batch_sharding_like(labs),
+                     self._batch_sharding_like(fm),
+                     self._batch_sharding_like(lm),
+                     self._rep, None)
+        fn = jax.jit(base, in_shardings=in_sh, donate_argnums=(0, 1, 2))
+        self._jit_cache[key] = fn
+        return fn
+
+    # -------------------------------------------------------------- fit
+    def _pad_to_divisible(self, ds):
+        b = ds.num_examples()
+        if b % self.data_size == 0:
+            return ds
+        pad = self.data_size - (b % self.data_size)
+        idx = np.concatenate([np.arange(b), np.zeros(pad, np.int64)])
+        if isinstance(ds, MultiDataSet):
+            return MultiDataSet(
+                [f[idx] for f in ds.features], [l[idx] for l in ds.labels],
+                None if not ds.features_masks else
+                [None if m is None else m[idx] for m in ds.features_masks],
+                None if not ds.labels_masks else
+                [None if m is None else m[idx] for m in ds.labels_masks])
+        sl = lambda a: None if a is None else a[idx]
+        return DataSet(ds.features[idx], sl(ds.labels),
+                       sl(ds.features_mask), sl(ds.labels_mask))
+
+    def fit(self, data, labels=None, *, epochs: int = 1,
+            batch_size: int = 128):
+        """Reference: `ParallelWrapper.fit(DataSetIterator):409`. Partial
+        final batches are padded by repetition to keep XLA shapes static."""
+        net = self.net
+        if isinstance(data, MultiDataSet):
+            batches = [data]
+            iterable = lambda: batches
+        else:
+            it = as_iterator(data, labels, batch_size)
+            if self.prefetch:
+                it = it.async_(self.prefetch)
+            iterable = lambda: it
+        for l in net.listeners:
+            l.on_fit_start(net)
+        for _ in range(epochs):
+            for l in net.listeners:
+                l.on_epoch_start(net, net.epoch)
+            for ds in iterable():
+                ds = self._pad_to_divisible(ds)
+                net.last_batch_size = ds.num_examples()
+                loss = self._step(ds)
+                net.score_ = loss
+                net.iteration += 1
+                for l in net.listeners:
+                    l.iteration_done(net, net.iteration, net.epoch, loss)
+            for l in net.listeners:
+                l.on_epoch_end(net, net.epoch)
+            net.epoch += 1
+        for l in net.listeners:
+            l.on_fit_end(net)
+        return net
+
+    def _step(self, ds) -> float:
+        net = self.net
+        net._rng, k = jax.random.split(net._rng)
+        step = jnp.asarray(net.iteration, jnp.int32)
+        if self._graph:
+            feats, labs, fms, lms = net._to_dicts(ds)
+            args = (net.params_tree, net.updater_state, net.state_tree, step,
+                    feats, labs, fms, lms, k)
+            key = ("g", tuple(sorted(feats)), tuple(sorted(labs)),
+                   fms is not None, lms is not None)
+            fn = self._get_step(key, args)
+            (net.params_tree, net.updater_state, net.state_tree, loss
+             ) = fn(*args)
+        else:
+            args = (net.params_tree, net.updater_state, net.state_tree, step,
+                    jnp.asarray(ds.features, net.dtype),
+                    None if ds.labels is None else jnp.asarray(ds.labels),
+                    None if ds.features_mask is None
+                    else jnp.asarray(ds.features_mask),
+                    None if ds.labels_mask is None
+                    else jnp.asarray(ds.labels_mask),
+                    k, None)
+            key = ("m", ds.features.ndim,
+                   0 if ds.labels is None else ds.labels.ndim,
+                   ds.features_mask is not None, ds.labels_mask is not None)
+            fn = self._get_step(key, args)
+            (net.params_tree, net.updater_state, net.state_tree, loss, _
+             ) = fn(*args)
+        return float(loss)

@@ -1,0 +1,145 @@
+"""ParallelInference — batched multi-device inference server.
+
+Reference parity: `parallelism/ParallelInference.java:33-74` — modes
+INPLACE/SEQUENTIAL/BATCHED with an observable queue batching concurrent
+requests (`BatchedInferenceObservable`). Here: a host-side collector thread
+coalesces requests up to `max_batch_size` (or `max_wait_ms`), pads to a
+bucketed static shape (XLA needs static shapes; buckets avoid recompiles),
+runs ONE sharded jit forward over the mesh's data axis, and scatters results
+back to waiting futures.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from concurrent.futures import Future
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.mesh import AXIS_DATA, make_mesh
+
+
+class InferenceMode:
+    """Reference: `ParallelInference.InferenceMode` (`:53`)."""
+
+    INPLACE = "inplace"
+    BATCHED = "batched"
+
+
+class ParallelInference:
+    def __init__(self, net, *, mesh: Optional[Mesh] = None,
+                 mode: str = InferenceMode.BATCHED,
+                 max_batch_size: int = 64, max_wait_ms: float = 5.0,
+                 batch_buckets: Optional[List[int]] = None):
+        self.net = net
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.mode = mode
+        self.max_batch = max_batch_size
+        self.max_wait = max_wait_ms / 1e3
+        self.buckets = sorted(batch_buckets or [1, 8, 32, max_batch_size])
+        self._queue: "queue.Queue" = queue.Queue()
+        self._jit_cache = {}
+        self._stop = threading.Event()
+        self._worker: Optional[threading.Thread] = None
+        if mode == InferenceMode.BATCHED:
+            self._worker = threading.Thread(target=self._collector, daemon=True)
+            self._worker.start()
+
+    # ---------------------------------------------------------- public
+    def output(self, x) -> np.ndarray:
+        """Blocking single request (thread-safe). Reference:
+        `ParallelInference.output(INDArray)`."""
+        x = np.asarray(x)
+        if self.mode == InferenceMode.INPLACE:
+            return self._run(x)
+        fut: Future = Future()
+        self._queue.put((x, fut))
+        return fut.result()
+
+    def shutdown(self):
+        self._stop.set()
+        if self._worker is not None:
+            self._queue.put(None)
+            self._worker.join(timeout=2)
+
+    # --------------------------------------------------------- internal
+    def _bucket(self, n: int) -> int:
+        for b in self.buckets:
+            if n <= b:
+                return b
+        return self.buckets[-1]
+
+    def _forward_jit(self, padded_batch: int, feat_shape):
+        key = (padded_batch, feat_shape)
+        if key not in self._jit_cache:
+            net = self.net
+            sharding = NamedSharding(
+                self.mesh,
+                P(AXIS_DATA, *([None] * len(feat_shape))))
+
+            def fwd(params, states, x):
+                y, _, _, _ = net._forward(params, states, x,
+                                          train=False, rng=None)
+                return y
+
+            self._jit_cache[key] = jax.jit(fwd, in_shardings=(None, None, sharding))
+        return self._jit_cache[key]
+
+    def _run(self, x: np.ndarray) -> np.ndarray:
+        n = x.shape[0]
+        b = self._bucket(n)
+        # data-axis divisibility for sharding
+        d = self.mesh.shape[AXIS_DATA]
+        b = ((b + d - 1) // d) * d
+        if n < b:
+            pad = np.repeat(x[:1], b - n, axis=0)
+            x = np.concatenate([x, pad], axis=0)
+        fn = self._forward_jit(b, x.shape[1:])
+        y = fn(self.net.params_tree, self.net.state_tree,
+               jnp.asarray(x, self.net.dtype))
+        return np.asarray(y)[:n]
+
+    def _collector(self):
+        """Coalesce concurrent requests into one device batch.
+        Reference: BatchedInferenceObservable + ObservablesProvider."""
+        while not self._stop.is_set():
+            try:
+                item = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if item is None:
+                break
+            batch = [item]
+            total = item[0].shape[0]
+            deadline = self.max_wait
+            import time
+            t0 = time.monotonic()
+            while total < self.max_batch:
+                remaining = deadline - (time.monotonic() - t0)
+                if remaining <= 0:
+                    break
+                try:
+                    nxt = self._queue.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                if nxt is None:
+                    self._stop.set()
+                    break
+                batch.append(nxt)
+                total += nxt[0].shape[0]
+            xs = np.concatenate([b[0] for b in batch], axis=0)
+            try:
+                ys = self._run(xs)
+                off = 0
+                for x, fut in batch:
+                    fut.set_result(ys[off:off + x.shape[0]])
+                    off += x.shape[0]
+            except BaseException as e:
+                for _, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(e)

@@ -1,0 +1,81 @@
+"""Device-mesh construction.
+
+The mesh is the TPU-native replacement for the reference's device zoo
+(`ParallelWrapper.createZooIfNeccessary:539-553` pinning threads to GPUs via
+AffinityManager): instead of N threads × N model replicas, ONE program is
+compiled over a `jax.sharding.Mesh` and XLA lays collectives onto ICI.
+
+Axis conventions (used by all trainers/rules in this package):
+  data  — batch (data parallel)
+  model — tensor parallel (hidden/feature dims)
+  pipe  — pipeline stages
+  seq   — sequence/context parallel (ring attention)
+  expert — MoE expert parallel
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+AXIS_DATA = "data"
+AXIS_MODEL = "model"
+AXIS_PIPE = "pipe"
+AXIS_SEQ = "seq"
+AXIS_EXPERT = "expert"
+
+
+@dataclasses.dataclass(frozen=True)
+class MeshSpec:
+    """Named axis sizes; -1 for one axis means 'all remaining devices'."""
+
+    axes: Dict[str, int]
+
+    def resolve(self, n_devices: int) -> Dict[str, int]:
+        sizes = dict(self.axes)
+        wild = [k for k, v in sizes.items() if v == -1]
+        fixed = int(np.prod([v for v in sizes.values() if v != -1])) or 1
+        if len(wild) > 1:
+            raise ValueError("At most one axis may be -1")
+        if wild:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {sizes}")
+            sizes[wild[0]] = n_devices // fixed
+        total = int(np.prod(list(sizes.values())))
+        if total != n_devices:
+            raise ValueError(
+                f"Mesh axes {sizes} use {total} devices but {n_devices} "
+                f"are available")
+        return sizes
+
+
+def device_count() -> int:
+    return jax.device_count()
+
+
+def local_device_count() -> int:
+    return jax.local_device_count()
+
+
+def make_mesh(axes: Optional[Dict[str, int]] = None,
+              devices: Optional[Sequence] = None) -> Mesh:
+    """Build a Mesh. Default: 1-D data-parallel over all devices.
+
+    On multi-host TPU slices, `jax.devices()` is globally ordered so the
+    trailing mesh axes land on ICI-adjacent chips — put the
+    highest-bandwidth-demand axis (model/seq) LAST, data FIRST so its
+    collectives can ride DCN if the mesh spans slices (scaling-book recipe).
+    """
+    devices = list(devices if devices is not None else jax.devices())
+    if axes is None:
+        axes = {AXIS_DATA: len(devices)}
+    sizes = MeshSpec(dict(axes)).resolve(len(devices))
+    names = tuple(sizes)
+    shape = tuple(sizes[n] for n in names)
+    arr = np.asarray(devices).reshape(shape)
+    return Mesh(arr, names)

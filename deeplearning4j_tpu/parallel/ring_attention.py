@@ -1,0 +1,122 @@
+"""Ring attention — sequence/context parallelism over a mesh axis.
+
+No reference counterpart (SURVEY §5: 'No ring attention / context parallel…
+RNN era'); this is the green-field long-context mechanism the charter
+requires. Design: the sequence axis is sharded over the `seq` mesh axis;
+each device holds a local block of Q/K/V. K/V blocks rotate around the ring
+via `lax.ppermute` while each device accumulates its queries' attention with
+the numerically-stable online-softmax (flash-attention style) running
+(max, sum, out) triple — so peak memory is O(T_local²) instead of O(T²) and
+the K/V transfer rides ICI neighbor links (the ring pattern maps exactly
+onto the TPU torus).
+
+Blockwise comm/compute overlap: each ppermute is issued before the block
+accumulation it hides behind (XLA schedules the collective-permute
+asynchronously).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.mesh import AXIS_SEQ
+
+
+def _block_accumulate(q, k, v, m, l, o, *, scale, q_off, k_off, causal):
+    """Online-softmax accumulation of one K/V block into (m, l, o).
+
+    q: [B,Tq,H,D]  k,v: [B,Tk,H,D]  m,l: [B,H,Tq]  o: [B,Tq,H,D]
+    q_off/k_off: global offsets of the blocks (for causal masking).
+    """
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale  # [B,H,Tq,Tk]
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        qi = q_off + jnp.arange(tq)[:, None]
+        ki = k_off + jnp.arange(tk)[None, :]
+        s = jnp.where(ki > qi, -jnp.inf, s)
+    m_blk = jnp.max(s, axis=-1)                       # [B,H,Tq]
+    m_new = jnp.maximum(m, m_blk)
+    # guard fully-masked rows (all -inf) against NaN
+    m_safe = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+    p = jnp.exp(s - m_safe[..., None])
+    p = jnp.where(jnp.isfinite(s), p, 0.0)
+    corr = jnp.exp(jnp.where(jnp.isfinite(m), m - m_safe, -jnp.inf))
+    corr = jnp.where(jnp.isfinite(corr), corr, 0.0)
+    l_new = l * corr + jnp.sum(p, axis=-1)
+    o_new = (o * corr.transpose(0, 2, 1)[..., None]
+             + jnp.einsum("bhqk,bkhd->bqhd", p, v))
+    return m_new, l_new, o_new
+
+
+def attention(q, k, v, *, causal: bool = False, scale: Optional[float] = None):
+    """Single-device reference attention (used when no seq axis / tests)."""
+    d = q.shape[-1]
+    scale = scale if scale is not None else 1.0 / jnp.sqrt(d).astype(q.dtype)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        tq, tk = q.shape[1], k.shape[1]
+        mask = jnp.arange(tk)[None, :] > jnp.arange(tq)[:, None]
+        s = jnp.where(mask, -jnp.inf, s)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
+                          scale: Optional[float]):
+    """Per-shard body (runs under shard_map). q/k/v: local blocks
+    [B, T_local, H, D]."""
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    B, Tq, H, D = q.shape
+    scale_ = scale if scale is not None else 1.0 / (D ** 0.5)
+
+    m0 = jnp.full((B, H, Tq), -jnp.inf, q.dtype)
+    l0 = jnp.zeros((B, H, Tq), q.dtype)
+    o0 = jnp.zeros_like(q)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    def body(i, carry):
+        k_blk, v_blk, m, l, o = carry
+        # Block currently held arrived from device (my - i) mod n.
+        src = (my - i) % n
+        # Rotate early so the permute overlaps the block math below.
+        k_nxt = lax.ppermute(k_blk, axis_name, perm)
+        v_nxt = lax.ppermute(v_blk, axis_name, perm)
+        m, l, o = _block_accumulate(
+            q, k_blk, v_blk, m, l, o,
+            scale=scale_, q_off=my * Tq, k_off=src * Tq, causal=causal)
+        return (k_nxt, v_nxt, m, l, o)
+
+    _, _, m, l, o = lax.fori_loop(0, n, body, (k, v, m0, l0, o0))
+    l_safe = jnp.maximum(l, 1e-20)
+    return o / l_safe.transpose(0, 2, 1)[..., None]
+
+
+def ring_self_attention(q, k, v, mesh: Mesh, *, axis: str = AXIS_SEQ,
+                        causal: bool = False,
+                        scale: Optional[float] = None):
+    """Sequence-parallel attention: q/k/v [B, T, H, D] with T sharded over
+    `axis`. Returns output with the same sharding."""
+    try:
+        from jax import shard_map
+        kw = {"check_vma": False}
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+        kw = {"check_rep": False}
+
+    spec = P(None, axis, None, None)
+    fn = shard_map(
+        functools.partial(_ring_attention_local, axis_name=axis,
+                          causal=causal, scale=scale),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+        **kw,
+    )
+    return fn(q, k, v)

@@ -1,0 +1,120 @@
+"""Parameter/batch sharding rules.
+
+The reference has no tensor-parallel story (SURVEY §2.4: data parallel only);
+these rules are the green-field extension that maps layer param trees onto
+mesh axes. GSPMD then partitions the jitted step — matmuls become
+local matmuls + ICI collectives without manual comms code (pjit idiom,
+scaling-book recipe: annotate shardings, let XLA insert collectives).
+
+Rule model: a ShardingRules maps (layer_name, param_name) → PartitionSpec by
+first-match over (layer_glob, param_name) patterns. Defaults implement
+Megatron-style alternating column/row parallel for Dense/Conv/LSTM stacks.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+from typing import Dict, List, Optional, Tuple
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.mesh import AXIS_DATA, AXIS_MODEL
+
+
+@dataclasses.dataclass
+class ShardingRules:
+    """Ordered (layer_glob, param_name_glob) → PartitionSpec rules."""
+
+    rules: List[Tuple[str, str, P]] = dataclasses.field(default_factory=list)
+    default: P = dataclasses.field(default_factory=P)
+
+    def spec_for(self, layer_name: str, param_name: str) -> P:
+        for lg, pg, spec in self.rules:
+            if fnmatch.fnmatch(layer_name, lg) and fnmatch.fnmatch(param_name, pg):
+                return spec
+        return self.default
+
+    def tree_specs(self, params: Dict) -> Dict:
+        """PartitionSpec pytree matching a {layer: {param: array}} tree."""
+        def leaf_specs(layer_name, sub, path=""):
+            out = {}
+            for k, v in sub.items():
+                if isinstance(v, dict):
+                    out[k] = leaf_specs(layer_name, v, path + k + "/")
+                else:
+                    out[k] = self.spec_for(layer_name, path + k)
+            return out
+        return {ln: leaf_specs(ln, sub) for ln, sub in params.items()}
+
+
+def replicate(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def batch_sharding(mesh: Mesh, ndim: int, axis: str = AXIS_DATA) -> NamedSharding:
+    """Shard the leading (batch) dim over `axis`, replicate the rest."""
+    return NamedSharding(mesh, P(axis, *([None] * (ndim - 1))))
+
+
+def shard_params(params, mesh: Mesh, rules: Optional[ShardingRules] = None):
+    """Place a param tree on the mesh per rules (device_put with
+    NamedSharding). With no rules: fully replicated."""
+    if rules is None:
+        sharding = replicate(mesh)
+        return jax.device_put(params, sharding)
+    specs = rules.tree_specs(params)
+
+    def place(x, spec):
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(place, params, specs)
+
+
+def tensor_parallel_rules(layer_names: List[str],
+                          axis: str = AXIS_MODEL) -> ShardingRules:
+    """Megatron-style alternating column/row parallel over a sequential
+    stack: even layers shard the OUTPUT dim (column parallel, spec
+    (None, model)), odd layers shard the INPUT dim (row parallel,
+    (model, None)) so activations stay sharded across the pair with a single
+    psum at the row-parallel output. Biases follow the output dim; the final
+    (output/classifier) layer is replicated for exact loss semantics."""
+    rules: List[Tuple[str, str, P]] = []
+    n = len(layer_names)
+    for i, name in enumerate(layer_names):
+        if i == n - 1:
+            rules.append((name, "*", P()))
+            continue
+        if i % 2 == 0:
+            rules.append((name, "W", P(None, axis)))
+            rules.append((name, "RW", P(None, axis)))
+            rules.append((name, "b", P(axis)))
+        else:
+            rules.append((name, "W", P(axis, None)))
+            rules.append((name, "RW", P(axis, None)))
+            rules.append((name, "b", P()))
+    return ShardingRules(rules=rules)
+
+
+def conv_channel_rules(layer_names: List[str], axis: str = AXIS_MODEL
+                       ) -> ShardingRules:
+    """Channel-parallel conv stacks: shard conv kernels on the output-channel
+    dim (HWIO → spec (None, None, None, model)); replicate the classifier."""
+    rules: List[Tuple[str, str, P]] = []
+    for i, name in enumerate(layer_names):
+        if i == len(layer_names) - 1:
+            rules.append((name, "*", P()))
+        else:
+            rules.append((name, "W", P(None, None, None, axis)))
+            rules.append((name, "b", P(axis)))
+    return ShardingRules(rules=rules)
+
+
+def fsdp_rules(layer_names: List[str], axis: str = AXIS_DATA) -> ShardingRules:
+    """ZeRO/FSDP-style: shard every large param's FIRST dim over the data
+    axis — optimizer state shards with it (cross-replica weight-update
+    sharding, cf. PAPERS.md 'Automatic Cross-Replica Sharding of Weight
+    Update in Data-Parallel Training'). XLA all-gathers weights per layer
+    on use and reduce-scatters grads."""
+    return ShardingRules(rules=[("*", "W", P(axis)), ("*", "RW", P(axis))])

@@ -1,0 +1,213 @@
+"""Parallelism tests on the 8-device virtual CPU mesh.
+
+Mirrors the reference's multi-device tests (ParallelWrapper/ParallelInference
+suites run on CPU threads — SURVEY §4 'Multi-device parallel tests'), with
+the TPU twist: correctness is asserted against single-device training
+(sharded training must match unsharded numerics).
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deeplearning4j_tpu import InputType
+from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import (
+    DenseLayer, MultiHeadAttention, OutputLayer,
+)
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.optim.updaters import Sgd, Adam
+from deeplearning4j_tpu.parallel import (
+    ParallelInference, ParallelWrapper, make_mesh,
+)
+from deeplearning4j_tpu.parallel.sharding import (
+    ShardingRules, fsdp_rules, shard_params, tensor_parallel_rules,
+)
+from deeplearning4j_tpu.parallel.ring_attention import (
+    attention, ring_self_attention,
+)
+
+
+def _toy(n=256, d=8, classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    w = rng.standard_normal((d, classes))
+    y = np.eye(classes, dtype=np.float32)[(x @ w).argmax(-1)]
+    return x, y
+
+
+def _net(seed=7, d=8, classes=3, updater=None):
+    return MultiLayerNetwork(
+        (NeuralNetConfiguration.builder()
+         .seed(seed).updater(updater or Sgd(0.1)).activation("tanh")
+         .list(DenseLayer(n_out=16),
+               OutputLayer(n_out=classes, activation="softmax"))
+         .set_input_type(InputType.feed_forward(d))
+         .build())).init()
+
+
+class TestMesh:
+    def test_make_mesh_default(self, devices8):
+        mesh = make_mesh()
+        assert mesh.shape["data"] == 8
+
+    def test_make_mesh_2d_with_wildcard(self, devices8):
+        mesh = make_mesh({"data": -1, "model": 2})
+        assert mesh.shape["data"] == 4 and mesh.shape["model"] == 2
+
+    def test_mesh_size_mismatch(self, devices8):
+        with pytest.raises(ValueError):
+            make_mesh({"data": 3})
+
+
+class TestParallelWrapper:
+    def test_dp_matches_single_device(self, devices8):
+        """Sharded DP step == single-device step (allreduce is exact mean)."""
+        x, y = _toy(n=64)
+        a = _net(seed=7)
+        b = _net(seed=7)
+        np.testing.assert_allclose(a.params(), b.params())
+
+        a.fit(x, y, epochs=3, batch_size=64)
+
+        pw = ParallelWrapper(b, mesh=make_mesh({"data": 8}), prefetch_buffer=0)
+        pw.fit(x, y, epochs=3, batch_size=64)
+        np.testing.assert_allclose(a.params(), b.params(), rtol=2e-4, atol=1e-6)
+
+    def test_dp_loss_decreases_with_adam(self, devices8):
+        x, y = _toy(n=256)
+        net = _net(updater=Adam(1e-2))
+        pw = ParallelWrapper(net, mesh=make_mesh({"data": 8}))
+        s0 = net.score(x, y)
+        pw.fit(x, y, epochs=10, batch_size=64)
+        assert net.score(x, y) < s0 * 0.7
+
+    def test_partial_batch_padding(self, devices8):
+        x, y = _toy(n=100)  # not divisible by 8
+        net = _net()
+        pw = ParallelWrapper(net, mesh=make_mesh({"data": 8}), prefetch_buffer=0)
+        pw.fit(x, y, epochs=1, batch_size=64)  # batches: 64 + 36→40
+        assert np.isfinite(net.score_)
+
+    def test_fsdp_param_sharding(self, devices8):
+        x, y = _toy(n=64, d=8)
+        net = _net()
+        rules = fsdp_rules([l.name for l in net.layers])
+        pw = ParallelWrapper(net, mesh=make_mesh({"data": 8}),
+                             param_rules=rules, prefetch_buffer=0)
+        w = net.params_tree[net.layers[0].name]["W"]
+        assert len(w.sharding.spec) >= 1 and w.sharding.spec[0] == "data"
+        pw.fit(x, y, epochs=2, batch_size=64)
+        assert np.isfinite(net.score_)
+
+
+class TestTensorParallel:
+    def test_tp_output_matches_replicated(self, devices8):
+        """TP-sharded forward == replicated forward (GSPMD exactness)."""
+        mesh = make_mesh({"data": 4, "model": 2})
+        net = _net(d=8, classes=3)
+        x, _ = _toy(n=32)
+        expected = np.asarray(net.output(x))
+
+        rules = tensor_parallel_rules([l.name for l in net.layers])
+        sharded = shard_params(net.params_tree, mesh, rules)
+
+        def fwd(params, feats):
+            y, _, _, _ = net._forward(params, {}, feats, train=False, rng=None)
+            return y
+
+        out = jax.jit(fwd)(sharded, jnp.asarray(x))
+        np.testing.assert_allclose(expected, np.asarray(out), rtol=1e-5)
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_dense_attention(self, devices8, causal):
+        mesh = make_mesh({"seq": 8})
+        rng = np.random.default_rng(0)
+        B, T, H, D = 2, 32, 4, 16
+        q = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+        dense = attention(q, k, v, causal=causal)
+        ring = ring_self_attention(q, k, v, mesh, axis="seq", causal=causal)
+        np.testing.assert_allclose(
+            np.asarray(dense), np.asarray(ring), rtol=2e-4, atol=2e-5)
+
+    def test_gradients_flow_through_ring(self, devices8):
+        mesh = make_mesh({"seq": 8})
+        rng = np.random.default_rng(1)
+        B, T, H, D = 1, 16, 2, 8
+        q = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+
+        def loss_ring(q, k, v):
+            return jnp.sum(ring_self_attention(q, k, v, mesh, causal=True) ** 2)
+
+        def loss_dense(q, k, v):
+            return jnp.sum(attention(q, k, v, causal=True) ** 2)
+
+        g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+        g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for gr, gd in zip(g_ring, g_dense):
+            np.testing.assert_allclose(
+                np.asarray(gr), np.asarray(gd), rtol=5e-4, atol=5e-5)
+
+
+class TestAttentionLayer:
+    def test_mha_in_network(self):
+        from deeplearning4j_tpu.nn.layers import GlobalPoolingLayer
+        conf = (NeuralNetConfiguration.builder()
+                .seed(0).updater(Adam(1e-2)).activation("identity")
+                .list(MultiHeadAttention(num_heads=2),
+                      GlobalPoolingLayer(pooling="avg"),
+                      OutputLayer(n_out=2, activation="softmax"))
+                .set_input_type(InputType.recurrent(8))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((16, 10, 8)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+        net.fit(x, y, epochs=3, batch_size=8)
+        assert np.asarray(net.output(x)).shape == (16, 2)
+
+    def test_mha_gradcheck(self):
+        from deeplearning4j_tpu.nn.layers import GlobalPoolingLayer
+        from deeplearning4j_tpu.gradientcheck import check_gradients
+        conf = (NeuralNetConfiguration.builder()
+                .seed(0).updater(Sgd(0.1)).activation("identity")
+                .list(MultiHeadAttention(num_heads=2, n_out=4),
+                      GlobalPoolingLayer(pooling="avg"),
+                      OutputLayer(n_out=2, activation="softmax"))
+                .set_input_type(InputType.recurrent(4))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((2, 5, 4))
+        y = np.eye(2)[rng.integers(0, 2, 2)]
+        assert check_gradients(net, x, y, subset=40)
+
+
+class TestParallelInference:
+    def test_batched_inference_matches_direct(self, devices8):
+        net = _net()
+        x, _ = _toy(n=40)
+        direct = np.asarray(net.output(x))
+        pi = ParallelInference(net, mesh=make_mesh({"data": 8}),
+                               max_batch_size=64)
+        try:
+            got = pi.output(x)
+            np.testing.assert_allclose(direct, got, rtol=1e-5)
+            # concurrent requests
+            import concurrent.futures as cf
+            with cf.ThreadPoolExecutor(4) as ex:
+                futs = [ex.submit(pi.output, x[i:i + 10])
+                        for i in range(0, 40, 10)]
+                outs = [f.result() for f in futs]
+            np.testing.assert_allclose(
+                direct, np.concatenate(outs, axis=0), rtol=1e-5)
+        finally:
+            pi.shutdown()
