@@ -1,0 +1,134 @@
+"""Benchmark: ResNet-50 training throughput on the real TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline: the reference repo publishes no numbers (BASELINE.md); the
+north-star target is >=70% of reference A100 images/sec/chip for dl4j-zoo
+ResNet-50 data-parallel training. We anchor on a public A100 ResNet-50
+training throughput of ~2500 img/s/chip (MLPerf-era mixed precision), so
+vs_baseline = value / (0.7 * 2500) — i.e. vs_baseline >= 1.0 meets the
+target on a per-chip basis.
+
+Env knobs: BENCH_MODEL=resnet50|lenet, BENCH_BATCH, BENCH_STEPS, BENCH_DTYPE.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+import time
+
+import numpy as np
+
+A100_REF_IMG_S = 2500.0
+TARGET_FRACTION = 0.70
+
+
+def _bench_resnet50(batch: int, steps: int, dtype: str):
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.optim.updaters import Nesterovs
+    from deeplearning4j_tpu.zoo import ResNet50
+
+    model = ResNet50(num_classes=1000, input_shape=(224, 224, 3),
+                     updater=Nesterovs(0.1, 0.9))
+    conf = dataclasses.replace(model.conf(), dtype=dtype)
+    from deeplearning4j_tpu.models import ComputationGraph
+
+    net = ComputationGraph(conf).init()
+
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((batch, 224, 224, 3)), net.dtype)
+    y = jnp.asarray(np.eye(1000, dtype=np.float32)[
+        rng.integers(0, 1000, batch)])
+
+    step_fn = jax.jit(net.make_step_fn(), donate_argnums=(0, 1, 2))
+    state = [net.params_tree, net.updater_state, net.state_tree]
+    key = jax.random.PRNGKey(0)
+
+    def run(n):
+        loss = None
+        for i in range(n):
+            state[0], state[1], state[2], loss = step_fn(
+                state[0], state[1], state[2], jnp.asarray(i, jnp.int32),
+                {"input": x}, {"output": y}, None, None, key)
+        return loss
+
+    return _timed_ips(run, batch, steps)
+
+
+def _timed_ips(run, batch: int, steps: int):
+    """Two-point timing that is robust to the tunneled TPU runtime, where
+    block_until_ready returns early and every host fetch pays seconds of
+    relay latency: run N1 and N2 chained steps, force completion by fetching
+    only the SCALAR loss each time, and difference out the constant
+    latency: per_step = (t2 - t1) / (N2 - N1)."""
+    import time
+
+    loss = run(3)           # compile + warmup
+    _ = float(loss)
+    n1, n2 = max(2, steps // 4), steps
+    t0 = time.perf_counter()
+    l1 = float(run(n1))
+    t1 = time.perf_counter()
+    l2 = float(run(n2))
+    t2 = time.perf_counter()
+    per_step = ((t2 - t1) - (t1 - t0)) / (n2 - n1)
+    per_step = max(per_step, 1e-9)
+    return batch / per_step, l2
+
+
+def _bench_lenet(batch: int, steps: int, dtype: str):
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.zoo import LeNet
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+
+    conf = dataclasses.replace(LeNet().conf(), dtype=dtype)
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((batch, 784)), net.dtype)
+    y = jnp.asarray(np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)])
+    step_fn = jax.jit(net.make_step_fn(), donate_argnums=(0, 1, 2))
+    state = [net.params_tree, net.updater_state, net.state_tree]
+    key = jax.random.PRNGKey(0)
+
+    def run(n):
+        loss = None
+        for i in range(n):
+            state[0], state[1], state[2], loss, _ = step_fn(
+                state[0], state[1], state[2], jnp.asarray(i, jnp.int32),
+                x, y, None, None, key, None)
+        return loss
+
+    return _timed_ips(run, batch, steps)
+
+
+def main():
+    model = os.environ.get("BENCH_MODEL", "resnet50")
+    batch = int(os.environ.get("BENCH_BATCH", "256"))
+    steps = int(os.environ.get("BENCH_STEPS", "40"))
+    dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
+
+    if model == "lenet":
+        ips, loss = _bench_lenet(batch, steps, dtype)
+        metric = "lenet_mnist_train_images_per_sec"
+        vs = ips / 10000.0  # no published reference; nominal anchor
+    else:
+        ips, loss = _bench_resnet50(batch, steps, dtype)
+        metric = "resnet50_train_images_per_sec_per_chip"
+        vs = ips / (TARGET_FRACTION * A100_REF_IMG_S)
+
+    print(json.dumps({
+        "metric": metric,
+        "value": round(ips, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(vs, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,188 @@
+"""Canned datasets: MNIST, CIFAR-10, Iris.
+
+Reference parity: deeplearning4j-core `datasets/iterator/impl/`
+(MnistDataSetIterator, CifarDataSetIterator, IrisDataSetIterator) and the
+binary fetchers in `datasets/mnist/`. The reference downloads on first use;
+this environment is zero-egress, so loaders read the standard cache layout
+(`~/.deeplearning4j_tpu/<name>/` or $DL4J_TPU_DATA_DIR) and otherwise fall
+back to a DETERMINISTIC synthetic surrogate with identical shapes/dtypes,
+clearly flagged via `.synthetic` so tests/benches know.
+
+Iris ships embedded (150 rows, public-domain Fisher data).
+"""
+
+from __future__ import annotations
+
+import gzip
+import os
+import struct
+from typing import Optional, Tuple
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterators import ArrayDataSetIterator
+
+
+def data_dir() -> str:
+    return os.environ.get(
+        "DL4J_TPU_DATA_DIR",
+        os.path.join(os.path.expanduser("~"), ".deeplearning4j_tpu"))
+
+
+# --------------------------------------------------------------------- MNIST
+def _read_idx_images(path: str) -> np.ndarray:
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
+        assert magic == 2051, f"bad magic {magic}"
+        return np.frombuffer(f.read(), np.uint8).reshape(n, rows, cols)
+
+
+def _read_idx_labels(path: str) -> np.ndarray:
+    op = gzip.open if path.endswith(".gz") else open
+    with op(path, "rb") as f:
+        magic, n = struct.unpack(">II", f.read(8))
+        assert magic == 2049, f"bad magic {magic}"
+        return np.frombuffer(f.read(), np.uint8)
+
+
+def _find(name_options, base) -> Optional[str]:
+    for n in name_options:
+        for ext in ("", ".gz"):
+            p = os.path.join(base, n + ext)
+            if os.path.exists(p):
+                return p
+    return None
+
+
+def load_mnist(train: bool = True) -> Tuple[np.ndarray, np.ndarray, bool]:
+    """Returns (images [N,784] float32 in [0,1], labels one-hot [N,10],
+    synthetic_flag)."""
+    base = os.path.join(data_dir(), "mnist")
+    prefix = "train" if train else "t10k"
+    img = _find([f"{prefix}-images-idx3-ubyte", f"{prefix}-images.idx3-ubyte"], base)
+    lab = _find([f"{prefix}-labels-idx1-ubyte", f"{prefix}-labels.idx1-ubyte"], base)
+    if img and lab:
+        x = _read_idx_images(img).astype(np.float32).reshape(-1, 784) / 255.0
+        y = np.eye(10, dtype=np.float32)[_read_idx_labels(lab)]
+        return x, y, False
+    # Deterministic synthetic surrogate: 10 gaussian digit prototypes.
+    n = 60000 if train else 10000
+    rng = np.random.default_rng(42 if train else 43)
+    protos = np.random.default_rng(7).random((10, 784)).astype(np.float32)
+    labels = rng.integers(0, 10, n)
+    x = 0.6 * protos[labels] + 0.4 * rng.random((n, 784), dtype=np.float32)
+    y = np.eye(10, dtype=np.float32)[labels]
+    return x.astype(np.float32), y, True
+
+
+class MnistDataSetIterator(ArrayDataSetIterator):
+    """Reference: `datasets/iterator/impl/MnistDataSetIterator`."""
+
+    def __init__(self, batch_size: int, train: bool = True,
+                 shuffle: bool = True, seed: int = 123,
+                 num_examples: Optional[int] = None):
+        x, y, synthetic = load_mnist(train)
+        if num_examples:
+            x, y = x[:num_examples], y[:num_examples]
+        self.synthetic = synthetic
+        super().__init__(x, y, batch_size, shuffle=shuffle, seed=seed)
+
+
+# -------------------------------------------------------------------- CIFAR
+def load_cifar10(train: bool = True) -> Tuple[np.ndarray, np.ndarray, bool]:
+    """Returns (images [N,32,32,3] float32, one-hot labels [N,10], synthetic)."""
+    base = os.path.join(data_dir(), "cifar-10-batches-bin")
+    files = ([f"data_batch_{i}.bin" for i in range(1, 6)] if train
+             else ["test_batch.bin"])
+    paths = [os.path.join(base, f) for f in files]
+    if all(os.path.exists(p) for p in paths):
+        xs, ys = [], []
+        for p in paths:
+            raw = np.fromfile(p, np.uint8).reshape(-1, 3073)
+            ys.append(raw[:, 0])
+            xs.append(raw[:, 1:].reshape(-1, 3, 32, 32).transpose(0, 2, 3, 1))
+        x = np.concatenate(xs).astype(np.float32) / 255.0
+        y = np.eye(10, dtype=np.float32)[np.concatenate(ys)]
+        return x, y, False
+    n = 50000 if train else 10000
+    rng = np.random.default_rng(44 if train else 45)
+    protos = np.random.default_rng(8).random((10, 32, 32, 3)).astype(np.float32)
+    labels = rng.integers(0, 10, n)
+    x = 0.6 * protos[labels] + 0.4 * rng.random((n, 32, 32, 3), dtype=np.float32)
+    return x.astype(np.float32), np.eye(10, dtype=np.float32)[labels], True
+
+
+class CifarDataSetIterator(ArrayDataSetIterator):
+    """Reference: `datasets/iterator/impl/CifarDataSetIterator`."""
+
+    def __init__(self, batch_size: int, train: bool = True,
+                 shuffle: bool = True, seed: int = 123,
+                 num_examples: Optional[int] = None):
+        x, y, synthetic = load_cifar10(train)
+        if num_examples:
+            x, y = x[:num_examples], y[:num_examples]
+        self.synthetic = synthetic
+        super().__init__(x, y, batch_size, shuffle=shuffle, seed=seed)
+
+
+# --------------------------------------------------------------------- Iris
+# Fisher's iris data (public domain): 150 rows of
+# sepal_len, sepal_wid, petal_len, petal_wid, class(0..2)
+_IRIS = np.array([
+    [5.1,3.5,1.4,0.2,0],[4.9,3.0,1.4,0.2,0],[4.7,3.2,1.3,0.2,0],[4.6,3.1,1.5,0.2,0],
+    [5.0,3.6,1.4,0.2,0],[5.4,3.9,1.7,0.4,0],[4.6,3.4,1.4,0.3,0],[5.0,3.4,1.5,0.2,0],
+    [4.4,2.9,1.4,0.2,0],[4.9,3.1,1.5,0.1,0],[5.4,3.7,1.5,0.2,0],[4.8,3.4,1.6,0.2,0],
+    [4.8,3.0,1.4,0.1,0],[4.3,3.0,1.1,0.1,0],[5.8,4.0,1.2,0.2,0],[5.7,4.4,1.5,0.4,0],
+    [5.4,3.9,1.3,0.4,0],[5.1,3.5,1.4,0.3,0],[5.7,3.8,1.7,0.3,0],[5.1,3.8,1.5,0.3,0],
+    [5.4,3.4,1.7,0.2,0],[5.1,3.7,1.5,0.4,0],[4.6,3.6,1.0,0.2,0],[5.1,3.3,1.7,0.5,0],
+    [4.8,3.4,1.9,0.2,0],[5.0,3.0,1.6,0.2,0],[5.0,3.4,1.6,0.4,0],[5.2,3.5,1.5,0.2,0],
+    [5.2,3.4,1.4,0.2,0],[4.7,3.2,1.6,0.2,0],[4.8,3.1,1.6,0.2,0],[5.4,3.4,1.5,0.4,0],
+    [5.2,4.1,1.5,0.1,0],[5.5,4.2,1.4,0.2,0],[4.9,3.1,1.5,0.2,0],[5.0,3.2,1.2,0.2,0],
+    [5.5,3.5,1.3,0.2,0],[4.9,3.6,1.4,0.1,0],[4.4,3.0,1.3,0.2,0],[5.1,3.4,1.5,0.2,0],
+    [5.0,3.5,1.3,0.3,0],[4.5,2.3,1.3,0.3,0],[4.4,3.2,1.3,0.2,0],[5.0,3.5,1.6,0.6,0],
+    [5.1,3.8,1.9,0.4,0],[4.8,3.0,1.4,0.3,0],[5.1,3.8,1.6,0.2,0],[4.6,3.2,1.4,0.2,0],
+    [5.3,3.7,1.5,0.2,0],[5.0,3.3,1.4,0.2,0],
+    [7.0,3.2,4.7,1.4,1],[6.4,3.2,4.5,1.5,1],[6.9,3.1,4.9,1.5,1],[5.5,2.3,4.0,1.3,1],
+    [6.5,2.8,4.6,1.5,1],[5.7,2.8,4.5,1.3,1],[6.3,3.3,4.7,1.6,1],[4.9,2.4,3.3,1.0,1],
+    [6.6,2.9,4.6,1.3,1],[5.2,2.7,3.9,1.4,1],[5.0,2.0,3.5,1.0,1],[5.9,3.0,4.2,1.5,1],
+    [6.0,2.2,4.0,1.0,1],[6.1,2.9,4.7,1.4,1],[5.6,2.9,3.6,1.3,1],[6.7,3.1,4.4,1.4,1],
+    [5.6,3.0,4.5,1.5,1],[5.8,2.7,4.1,1.0,1],[6.2,2.2,4.5,1.5,1],[5.6,2.5,3.9,1.1,1],
+    [5.9,3.2,4.8,1.8,1],[6.1,2.8,4.0,1.3,1],[6.3,2.5,4.9,1.5,1],[6.1,2.8,4.7,1.2,1],
+    [6.4,2.9,4.3,1.3,1],[6.6,3.0,4.4,1.4,1],[6.8,2.8,4.8,1.4,1],[6.7,3.0,5.0,1.7,1],
+    [6.0,2.9,4.5,1.5,1],[5.7,2.6,3.5,1.0,1],[5.5,2.4,3.8,1.1,1],[5.5,2.4,3.7,1.0,1],
+    [5.8,2.7,3.9,1.2,1],[6.0,2.7,5.1,1.6,1],[5.4,3.0,4.5,1.5,1],[6.0,3.4,4.5,1.6,1],
+    [6.7,3.1,4.7,1.5,1],[6.3,2.3,4.4,1.3,1],[5.6,3.0,4.1,1.3,1],[5.5,2.5,4.0,1.3,1],
+    [5.5,2.6,4.4,1.2,1],[6.1,3.0,4.6,1.4,1],[5.8,2.6,4.0,1.2,1],[5.0,2.3,3.3,1.0,1],
+    [5.6,2.7,4.2,1.3,1],[5.7,3.0,4.2,1.2,1],[5.7,2.9,4.2,1.3,1],[6.2,2.9,4.3,1.3,1],
+    [5.1,2.5,3.0,1.1,1],[5.7,2.8,4.1,1.3,1],
+    [6.3,3.3,6.0,2.5,2],[5.8,2.7,5.1,1.9,2],[7.1,3.0,5.9,2.1,2],[6.3,2.9,5.6,1.8,2],
+    [6.5,3.0,5.8,2.2,2],[7.6,3.0,6.6,2.1,2],[4.9,2.5,4.5,1.7,2],[7.3,2.9,6.3,1.8,2],
+    [6.7,2.5,5.8,1.8,2],[7.2,3.6,6.1,2.5,2],[6.5,3.2,5.1,2.0,2],[6.4,2.7,5.3,1.9,2],
+    [6.8,3.0,5.5,2.1,2],[5.7,2.5,5.0,2.0,2],[5.8,2.8,5.1,2.4,2],[6.4,3.2,5.3,2.3,2],
+    [6.5,3.0,5.5,1.8,2],[7.7,3.8,6.7,2.2,2],[7.7,2.6,6.9,2.3,2],[6.0,2.2,5.0,1.5,2],
+    [6.9,3.2,5.7,2.3,2],[5.6,2.8,4.9,2.0,2],[7.7,2.8,6.7,2.0,2],[6.3,2.7,4.9,1.8,2],
+    [6.7,3.3,5.7,2.1,2],[7.2,3.2,6.0,1.8,2],[6.2,2.8,4.8,1.8,2],[6.1,3.0,4.9,1.8,2],
+    [6.4,2.8,5.6,2.1,2],[7.2,3.0,5.8,1.6,2],[7.4,2.8,6.1,1.9,2],[7.9,3.8,6.4,2.0,2],
+    [6.4,2.8,5.6,2.2,2],[6.3,2.8,5.1,1.5,2],[6.1,2.6,5.6,1.4,2],[7.7,3.0,6.1,2.3,2],
+    [6.3,3.4,5.6,2.4,2],[6.4,3.1,5.5,1.8,2],[6.0,3.0,4.8,1.8,2],[6.9,3.1,5.4,2.1,2],
+    [6.7,3.1,5.6,2.4,2],[6.9,3.1,5.1,2.3,2],[5.8,2.7,5.1,1.9,2],[6.8,3.2,5.9,2.3,2],
+    [6.7,3.3,5.7,2.5,2],[6.7,3.0,5.2,2.3,2],[6.3,2.5,5.0,1.9,2],[6.5,3.0,5.2,2.0,2],
+    [6.2,3.4,5.4,2.3,2],[5.9,3.0,5.1,1.8,2],
+], dtype=np.float32)
+
+
+def load_iris() -> Tuple[np.ndarray, np.ndarray]:
+    x = _IRIS[:, :4].copy()
+    y = np.eye(3, dtype=np.float32)[_IRIS[:, 4].astype(int)]
+    return x, y
+
+
+class IrisDataSetIterator(ArrayDataSetIterator):
+    """Reference: `datasets/iterator/impl/IrisDataSetIterator`."""
+
+    def __init__(self, batch_size: int = 150, shuffle: bool = False,
+                 seed: int = 123):
+        x, y = load_iris()
+        super().__init__(x, y, batch_size, shuffle=shuffle, seed=seed)

@@ -186,8 +186,12 @@ class ComputationGraph:
             new_params, new_opt = {}, {}
             for name, u in updaters.items():
                 upd, st = u.apply(grads[name], opt_state[name], params[name], step)
-                new_params[name] = _tmap(lambda a, b: a - b, params[name], upd)
-                new_opt[name] = st
+                # Preserve dtypes (bf16 training + donation): see
+                # MultiLayerNetwork._build_step.
+                new_params[name] = _tmap(
+                    lambda a, b: a - b.astype(a.dtype), params[name], upd)
+                new_opt[name] = _tmap(
+                    lambda n, o: n.astype(o.dtype), st, opt_state[name])
             persist = {
                 n: (new_states[n] if n in stateful else states.get(n, {}))
                 for n in states

@@ -229,8 +229,13 @@ class MultiLayerNetwork:
             new_params, new_opt = {}, {}
             for name, u in updaters.items():
                 upd, st = u.apply(grads[name], opt_state[name], params[name], step)
-                new_params[name] = _tmap(lambda a, b: a - b, params[name], upd)
-                new_opt[name] = st
+                # Preserve dtypes: schedules/updater math may promote to f32
+                # (strong-typed scalars); params and optimizer state must keep
+                # their configured dtype (bf16 training, buffer donation).
+                new_params[name] = _tmap(
+                    lambda a, b: a - b.astype(a.dtype), params[name], upd)
+                new_opt[name] = _tmap(
+                    lambda n, o: n.astype(o.dtype), st, opt_state[name])
             persist = {
                 n: (new_states[n] if n in stateful else states.get(n, {}))
                 for n in states
@@ -447,7 +452,11 @@ class MultiLayerNetwork:
 
                 loss, grads = jax.value_and_grad(loss_fn)(lp)
                 upd, new_opt = updater.apply(grads, opt_state, lp, step)
-                return _tmap(lambda a, b: a - b, lp, upd), new_opt, loss
+                # Dtype preservation: see _build_step.
+                new_lp = _tmap(lambda a, b: a - b.astype(a.dtype), lp, upd)
+                new_opt = _tmap(lambda n, o: n.astype(o.dtype), new_opt,
+                                opt_state)
+                return new_lp, new_opt, loss
 
             step = 0
             for _ in range(epochs):

@@ -1,0 +1,64 @@
+"""ZooModel base.
+
+Reference parity: `zoo/ZooModel.java` — `init()` builds the network,
+`initPretrained()` loads cached weights (`:40-52`).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Tuple, Type
+
+ZOO_REGISTRY: Dict[str, Type] = {}
+
+
+def register_zoo(cls):
+    ZOO_REGISTRY[cls.__name__.lower()] = cls
+    return cls
+
+
+class ZooModel:
+    """Base: subclasses define conf()/init()."""
+
+    name: str = "zoomodel"
+    num_classes: int = 1000
+    input_shape: Tuple[int, ...] = (224, 224, 3)
+
+    def __init__(self, num_classes: Optional[int] = None,
+                 input_shape: Optional[Tuple[int, ...]] = None,
+                 seed: int = 123, **kw):
+        if num_classes is not None:
+            self.num_classes = num_classes
+        if input_shape is not None:
+            self.input_shape = tuple(input_shape)
+        self.seed = seed
+        self.kw = kw
+
+    def conf(self):
+        raise NotImplementedError
+
+    def init(self):
+        """Build + initialize the network. Reference: `ZooModel.init()`."""
+        conf = self.conf()
+        from deeplearning4j_tpu.nn.config import MultiLayerConfiguration
+        if isinstance(conf, MultiLayerConfiguration):
+            from deeplearning4j_tpu.models import MultiLayerNetwork
+            return MultiLayerNetwork(conf).init()
+        from deeplearning4j_tpu.models import ComputationGraph
+        return ComputationGraph(conf).init()
+
+    def pretrained_path(self) -> str:
+        from deeplearning4j_tpu.data.datasets import data_dir
+        return os.path.join(data_dir(), "zoo",
+                            f"{type(self).__name__.lower()}.zip")
+
+    def init_pretrained(self):
+        """Reference: `ZooModel.initPretrained()` — cache-dir load (no
+        egress in this environment; no silent download)."""
+        p = self.pretrained_path()
+        if not os.path.exists(p):
+            raise FileNotFoundError(
+                f"No pretrained weights at {p}; place a checkpoint zip there "
+                f"(this environment cannot download)")
+        from deeplearning4j_tpu.models.serialize import load_model
+        return load_model(p)

@@ -1,0 +1,99 @@
+"""ResNet-50 as a ComputationGraph — the north-star benchmark model.
+
+Reference parity: `zoo/model/ResNet50.java:82` (`init()`), identity/conv
+blocks `:91-132`, graphBuilder `:173`. Same topology (stem 7×7/2 + maxpool,
+stages [3,4,6,3] of bottleneck blocks, global average pool, softmax head) in
+NHWC with BN folded next to each conv — the layout XLA fuses best on TPU.
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.graph import ElementWiseVertex
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.nn.layers import (
+    ActivationLayer, BatchNormalization, ConvolutionLayer, GlobalPoolingLayer,
+    OutputLayer, SubsamplingLayer, ZeroPaddingLayer,
+)
+from deeplearning4j_tpu.optim.updaters import Nesterovs
+from deeplearning4j_tpu.zoo.base import ZooModel, register_zoo
+
+
+@register_zoo
+class ResNet50(ZooModel):
+    num_classes = 1000
+    input_shape = (224, 224, 3)
+
+    def _conv_bn(self, g, name, inp, n_out, kernel, stride=(1, 1),
+                 pad=(0, 0), act="relu", mode="truncate"):
+        g.add_layer(f"{name}_conv",
+                    ConvolutionLayer(n_out=n_out, kernel=kernel, stride=stride,
+                                     padding=pad, convolution_mode=mode,
+                                     activation="identity", has_bias=False),
+                    inp)
+        g.add_layer(f"{name}_bn", BatchNormalization(activation=act),
+                    f"{name}_conv")
+        return f"{name}_bn"
+
+    def _conv_block(self, g, name, inp, filters, stride):
+        """Reference: ResNet50.java convBlock `:112-132` (projection
+        shortcut)."""
+        f1, f2, f3 = filters
+        x = self._conv_bn(g, f"{name}_a", inp, f1, (1, 1), stride)
+        x = self._conv_bn(g, f"{name}_b", x, f2, (3, 3), (1, 1), mode="same")
+        x = self._conv_bn(g, f"{name}_c", x, f3, (1, 1), act="identity")
+        sc = self._conv_bn(g, f"{name}_sc", inp, f3, (1, 1), stride,
+                           act="identity")
+        g.add_vertex(f"{name}_add", ElementWiseVertex(op="add"), x, sc)
+        g.add_layer(f"{name}_out", ActivationLayer(activation="relu"),
+                    f"{name}_add")
+        return f"{name}_out"
+
+    def _identity_block(self, g, name, inp, filters):
+        """Reference: ResNet50.java identityBlock `:91-110`."""
+        f1, f2, f3 = filters
+        x = self._conv_bn(g, f"{name}_a", inp, f1, (1, 1))
+        x = self._conv_bn(g, f"{name}_b", x, f2, (3, 3), (1, 1), mode="same")
+        x = self._conv_bn(g, f"{name}_c", x, f3, (1, 1), act="identity")
+        g.add_vertex(f"{name}_add", ElementWiseVertex(op="add"), x, inp)
+        g.add_layer(f"{name}_out", ActivationLayer(activation="relu"),
+                    f"{name}_add")
+        return f"{name}_out"
+
+    def conf(self):
+        h, w, c = self.input_shape
+        g = (NeuralNetConfiguration.builder()
+             .seed(self.seed)
+             .updater(self.kw.get("updater", Nesterovs(1e-1, 0.9)))
+             .weight_init("relu")
+             .graph_builder()
+             .add_inputs("input")
+             .set_input_types(InputType.convolutional(h, w, c)))
+
+        # Stem (reference: graphBuilder `:173` stem section)
+        g.add_layer("pad0", ZeroPaddingLayer(pad=(3, 3)), "input")
+        x = self._conv_bn(g, "stem", "pad0", 64, (7, 7), (2, 2))
+        g.add_layer("pool0",
+                    SubsamplingLayer(pooling="max", kernel=(3, 3),
+                                     stride=(2, 2), convolution_mode="same"),
+                    x)
+        x = "pool0"
+
+        stages = [
+            ("res2", (64, 64, 256), 3, (1, 1)),
+            ("res3", (128, 128, 512), 4, (2, 2)),
+            ("res4", (256, 256, 1024), 6, (2, 2)),
+            ("res5", (512, 512, 2048), 3, (2, 2)),
+        ]
+        for sname, filters, blocks, stride in stages:
+            x = self._conv_block(g, f"{sname}a", x, filters, stride)
+            for b in range(1, blocks):
+                x = self._identity_block(g, f"{sname}{chr(97 + b)}", x, filters)
+
+        g.add_layer("avgpool", GlobalPoolingLayer(pooling="avg"), x)
+        g.add_layer("output",
+                    OutputLayer(n_out=self.num_classes, activation="softmax",
+                                loss="mcxent"),
+                    "avgpool")
+        g.set_outputs("output")
+        return g.build()
