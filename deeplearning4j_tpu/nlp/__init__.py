@@ -1,0 +1,36 @@
+"""NLP / embeddings.
+
+Reference parity: deeplearning4j-nlp-parent (SURVEY §2.5) — SequenceVectors,
+Word2Vec, ParagraphVectors, GloVe, vocab construction + Huffman coding,
+tokenization pipeline, word-vector serialization.
+
+TPU redesign: the reference trains embeddings with N hogwild threads doing
+lock-free scatter updates into shared syn0/syn1 (SURVEY §3.5) — a pattern
+with no good TPU analogue. Here each step is ONE jitted computation over a
+LARGE batch of (center, context, negatives) indices: embedding gathers →
+sampled-softmax loss → autodiff scatter-add gradients (SURVEY §7 hard part
+(c): 'redesign as large-batch sharded skipgram'). Data parallelism shards
+the pair batch over the mesh like any other model.
+"""
+
+from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabWord, build_vocab, HuffmanTree
+from deeplearning4j_tpu.nlp.tokenization import (
+    DefaultTokenizerFactory, CommonPreprocessor, SentenceIterator,
+    CollectionSentenceIterator, FileSentenceIterator, LineSentenceIterator,
+)
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+from deeplearning4j_tpu.nlp.glove import Glove
+from deeplearning4j_tpu.nlp.serializer import (
+    write_word_vectors, read_word_vectors, write_binary, read_binary,
+)
+from deeplearning4j_tpu.nlp.bow import BagOfWordsVectorizer, TfidfVectorizer
+
+__all__ = [
+    "VocabCache", "VocabWord", "build_vocab", "HuffmanTree",
+    "DefaultTokenizerFactory", "CommonPreprocessor", "SentenceIterator",
+    "CollectionSentenceIterator", "FileSentenceIterator",
+    "LineSentenceIterator", "Word2Vec", "ParagraphVectors", "Glove",
+    "write_word_vectors", "read_word_vectors", "write_binary", "read_binary",
+    "BagOfWordsVectorizer", "TfidfVectorizer",
+]

@@ -1,0 +1,91 @@
+"""GloVe — co-occurrence counting + weighted-least-squares embedding fit.
+
+Reference parity: `models/glove/Glove.java` + `models/glove/count/`
+(co-occurrence map) and the AdaGrad element updates in
+`models/embeddings/learning/impl/elements/GloVe.java`. Counting stays on
+host (hash map, like the reference's CountMap); the optimization is batched
+AdaGrad in one jitted step over (i, j, X_ij) triples.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
+from deeplearning4j_tpu.nlp.vocab import VocabCache, build_vocab
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec, _as_token_lists
+
+
+class Glove(Word2Vec):
+    def __init__(self, *, x_max: float = 100.0, alpha: float = 0.75, **kw):
+        kw.setdefault("learning_rate", 0.05)
+        super().__init__(**kw)
+        self.x_max = x_max
+        self.alpha = alpha
+
+    def fit(self, corpus) -> "Glove":
+        sentences = _as_token_lists(corpus, self.tokenizer_factory)
+        self.vocab = build_vocab(sentences, min_count=self.min_count)
+        V, D = len(self.vocab), self.layer_size
+
+        # ---- co-occurrence accumulation (reference: CountMap/RoundCount)
+        cooc: Dict[Tuple[int, int], float] = defaultdict(float)
+        for s in sentences:
+            idx = [self.vocab.index_of(w) for w in s]
+            idx = [i for i in idx if i >= 0]
+            for pos, ci in enumerate(idx):
+                lo = max(0, pos - self.window)
+                for off, cj in enumerate(idx[lo:pos]):
+                    dist = pos - (lo + off)
+                    w = 1.0 / dist
+                    a, b = (ci, cj) if ci <= cj else (cj, ci)
+                    cooc[(a, b)] += w
+        if not cooc:
+            raise ValueError("No co-occurrences")
+        pairs = np.array(list(cooc.keys()), dtype=np.int64)
+        xij = np.array(list(cooc.values()), dtype=np.float32)
+
+        rng = np.random.default_rng(self.seed)
+        params = {
+            "w": jnp.asarray((rng.random((V, D), dtype=np.float32) - .5) / D),
+            "wc": jnp.asarray((rng.random((V, D), dtype=np.float32) - .5) / D),
+            "b": jnp.zeros((V,), jnp.float32),
+            "bc": jnp.zeros((V,), jnp.float32),
+        }
+        hist = jax.tree_util.tree_map(
+            lambda a: jnp.ones_like(a) * 1e-8, params)
+        x_max, alpha, lr = self.x_max, self.alpha, self.lr
+
+        @jax.jit
+        def step(params, hist, ii, jj, x):
+            def loss_fn(p):
+                dot = jnp.einsum("bd,bd->b", p["w"][ii], p["wc"][jj])
+                pred = dot + p["b"][ii] + p["bc"][jj]
+                fw = jnp.minimum((x / x_max) ** alpha, 1.0)
+                return jnp.sum(fw * (pred - jnp.log(jnp.maximum(x, 1e-10))) ** 2)
+
+            grads = jax.grad(loss_fn)(params)
+            new_hist = jax.tree_util.tree_map(
+                lambda h, g: h + g * g, hist, grads)
+            new_params = jax.tree_util.tree_map(
+                lambda p, g, h: p - lr * g / jnp.sqrt(h),
+                params, grads, new_hist)
+            return new_params, new_hist
+
+        for _ in range(max(self.epochs, 1)):
+            order = rng.permutation(len(xij))
+            for lo in range(0, len(order), self.batch_size):
+                sel = order[lo:lo + self.batch_size]
+                params, hist = step(params, hist,
+                                    jnp.asarray(pairs[sel, 0]),
+                                    jnp.asarray(pairs[sel, 1]),
+                                    jnp.asarray(xij[sel]))
+
+        self.syn0 = np.asarray(params["w"] + params["wc"])  # standard sum
+        self._syn1 = np.asarray(params["wc"])
+        return self

@@ -1,0 +1,165 @@
+"""ParagraphVectors (doc2vec): DM and DBOW.
+
+Reference parity: `models/paragraphvectors/ParagraphVectors.java` (1,439
+LoC) with sequence learning algorithms `impl/sequence/{DM,DBOW}.java` —
+document/label vectors trained jointly with (DM) or instead of (DBOW) word
+context, plus `inferVector` for unseen documents (gradient steps on a fresh
+doc vector with frozen word tables).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.tokenization import DefaultTokenizerFactory
+from deeplearning4j_tpu.nlp.vocab import build_vocab, unigram_table
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec, _as_token_lists
+
+
+class ParagraphVectors(Word2Vec):
+    def __init__(self, *, dm: bool = True, **kw):
+        kw.setdefault("min_count", 1)
+        super().__init__(**kw)
+        self.dm = dm
+        self.doc_vectors: Optional[np.ndarray] = None
+        self.labels: List[str] = []
+
+    # ------------------------------------------------------------ fitting
+    def fit(self, documents: Union[Sequence[str], Sequence[Sequence[str]]],
+            labels: Optional[Sequence[str]] = None) -> "ParagraphVectors":
+        docs = _as_token_lists(documents, self.tokenizer_factory)
+        self.labels = list(labels) if labels else [
+            f"DOC_{i}" for i in range(len(docs))]
+        self.vocab = build_vocab(docs, min_count=self.min_count)
+        V, D, N = len(self.vocab), self.layer_size, len(docs)
+        rng = np.random.default_rng(self.seed)
+        params = {
+            "syn0": jnp.asarray((rng.random((V, D), dtype=np.float32) - .5) / D),
+            "syn1": jnp.zeros((V, D), jnp.float32),
+            "docs": jnp.asarray((rng.random((N, D), dtype=np.float32) - .5) / D),
+        }
+        idx_docs = [
+            np.array([self.vocab.index_of(w) for w in s], dtype=np.int64)
+            for s in docs
+        ]
+        idx_docs = [s[s >= 0] for s in idx_docs]
+        probs = unigram_table(self.vocab)
+        step = self._make_pv_step()
+
+        pairs = []  # (doc_id, center, context)
+        for d, s in enumerate(idx_docs):
+            n = len(s)
+            if n < 2:
+                continue
+            b = rng.integers(1, self.window + 1, n)
+            for off in range(1, self.window + 1):
+                if n <= off:
+                    break
+                i = np.arange(n - off)
+                m = b[i + off] >= off
+                pairs.append(np.stack([np.full(m.sum(), d), s[i + off][m],
+                                       s[i][m]], 1))
+                m = b[i] >= off
+                pairs.append(np.stack([np.full(m.sum(), d), s[i][m],
+                                       s[i + off][m]], 1))
+        all_pairs = np.concatenate(pairs) if pairs else np.zeros((0, 3), np.int64)
+
+        for epoch in range(self.epochs):
+            order = rng.permutation(len(all_pairs))
+            shuffled = all_pairs[order]
+            frac_base = epoch / max(self.epochs, 1)
+            for lo in range(0, len(shuffled), self.batch_size):
+                chunk = shuffled[lo:lo + self.batch_size]
+                if len(chunk) < 8:
+                    continue
+                negs = rng.choice(len(probs),
+                                  size=(len(chunk), self.negative), p=probs)
+                lr = max(self.lr * (1 - frac_base), self.min_lr)
+                params = step(params, jnp.asarray(chunk[:, 0]),
+                              jnp.asarray(chunk[:, 1]),
+                              jnp.asarray(chunk[:, 2]),
+                              jnp.asarray(negs),
+                              jnp.asarray(lr, jnp.float32))
+        self.syn0 = np.asarray(params["syn0"])
+        self._syn1 = np.asarray(params["syn1"])
+        self.doc_vectors = np.asarray(params["docs"])
+        return self
+
+    def _make_pv_step(self):
+        dm = self.dm
+
+        @jax.jit
+        def step(params, doc_ids, centers, contexts, negatives, lr):
+            def loss_fn(p):
+                dv = p["docs"][doc_ids]            # [B,D]
+                if dm:
+                    h = 0.5 * (dv + p["syn0"][centers])   # DM: doc + word ctx
+                else:
+                    h = dv                                  # DBOW: doc only
+                pos = jnp.einsum("bd,bd->b", h, p["syn1"][contexts])
+                neg = jnp.einsum("bd,bkd->bk", h, p["syn1"][negatives])
+                # SUM: per-pair SGD semantics (see word2vec.py)
+                return (jnp.sum(jax.nn.softplus(-pos))
+                        + jnp.sum(jax.nn.softplus(neg)))
+
+            grads = jax.grad(loss_fn)(params)
+            return jax.tree_util.tree_map(lambda a, g: a - lr * g,
+                                          params, grads)
+
+        return step
+
+    # ------------------------------------------------------------ queries
+    def doc_vector(self, label: str) -> Optional[np.ndarray]:
+        try:
+            return self.doc_vectors[self.labels.index(label)]
+        except ValueError:
+            return None
+
+    def similarity_to_label(self, doc_a: str, doc_b: str) -> float:
+        va, vb = self.doc_vector(doc_a), self.doc_vector(doc_b)
+        if va is None or vb is None:
+            return float("nan")
+        return float(va @ vb / (np.linalg.norm(va) * np.linalg.norm(vb) + 1e-12))
+
+    def infer_vector(self, text: Union[str, Sequence[str]], *,
+                     steps: int = 50, lr: float = 0.05) -> np.ndarray:
+        """Reference: `ParagraphVectors.inferVector` — gradient-fit a fresh
+        doc vector against frozen word tables."""
+        tokens = (self.tokenizer_factory.create(text).tokens()
+                  if isinstance(text, str) else list(text))
+        idx = np.array([self.vocab.index_of(w) for w in tokens])
+        idx = idx[idx >= 0]
+        if len(idx) == 0:
+            return np.zeros(self.layer_size, np.float32)
+        rng = np.random.default_rng(self.seed)
+        dv = jnp.asarray((rng.random(self.layer_size,
+                                     dtype=np.float32) - .5) / self.layer_size)
+        syn0 = jnp.asarray(self.syn0)
+        syn1 = jnp.asarray(self._syn1)
+        probs = unigram_table(self.vocab)
+        targets = jnp.asarray(idx)
+        dm = self.dm
+
+        @jax.jit
+        def istep(dv, negs, lr_):
+            def loss_fn(v):
+                h = (0.5 * (v[None, :] + syn0[targets]) if dm
+                     else jnp.broadcast_to(v, (len(idx), v.shape[0])))
+                pos = jnp.einsum("bd,bd->b", h, syn1[targets])
+                neg = jnp.einsum("bd,bkd->bk", h, syn1[negs])
+                # SUM: per-pair SGD semantics (see word2vec.py)
+                return (jnp.sum(jax.nn.softplus(-pos))
+                        + jnp.sum(jax.nn.softplus(neg)))
+
+            return dv - lr_ * jax.grad(loss_fn)(dv)
+
+        for s in range(steps):
+            negs = rng.choice(len(probs), size=(len(idx), self.negative),
+                              p=probs)
+            dv = istep(dv, jnp.asarray(negs),
+                       jnp.asarray(lr * (1 - s / steps), jnp.float32))
+        return np.asarray(dv)

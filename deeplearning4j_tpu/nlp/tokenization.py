@@ -1,0 +1,132 @@
+"""Tokenization + sentence iteration pipeline.
+
+Reference parity: `text/tokenization/` (TokenizerFactory SPI,
+DefaultTokenizer, CommonPreprocessor lowercase/punct-strip) and
+`text/sentenceiterator/` (13 impls in the reference; the load-bearing ones
+here: collection, file, line).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+from typing import Callable, Iterable, Iterator, List, Optional, Sequence
+
+
+class TokenPreProcess:
+    """Reference: `tokenization/tokenizer/TokenPreProcess`."""
+
+    def pre_process(self, token: str) -> str:
+        return token
+
+
+class CommonPreprocessor(TokenPreProcess):
+    """Lowercase + strip punctuation/digits. Reference:
+    `tokenizer/preprocessor/CommonPreprocessor`."""
+
+    _PUNCT = re.compile(r"[\d\.:,\"'\(\)\[\]|/?!;]+")
+
+    def pre_process(self, token: str) -> str:
+        return self._PUNCT.sub("", token.lower())
+
+
+class Tokenizer:
+    def __init__(self, text: str, pre: Optional[TokenPreProcess] = None):
+        self._tokens = [t for t in text.split() if t]
+        self._pre = pre
+
+    def tokens(self) -> List[str]:
+        out = []
+        for t in self._tokens:
+            if self._pre is not None:
+                t = self._pre.pre_process(t)
+            if t:
+                out.append(t)
+        return out
+
+
+class TokenizerFactory:
+    """Reference: `tokenization/tokenizerfactory/TokenizerFactory` SPI."""
+
+    def __init__(self):
+        self._pre: Optional[TokenPreProcess] = None
+
+    def set_token_pre_processor(self, pre: TokenPreProcess):
+        self._pre = pre
+        return self
+
+    def create(self, text: str) -> Tokenizer:
+        return Tokenizer(text, self._pre)
+
+
+class DefaultTokenizerFactory(TokenizerFactory):
+    """Whitespace tokenizer. Reference: DefaultTokenizerFactory."""
+
+
+class NGramTokenizerFactory(TokenizerFactory):
+    """Reference: NGramTokenizerFactory — emits n-grams joined by '_'."""
+
+    def __init__(self, n_min: int = 1, n_max: int = 2):
+        super().__init__()
+        self.n_min, self.n_max = n_min, n_max
+
+    def create(self, text: str) -> Tokenizer:
+        base = Tokenizer(text, self._pre).tokens()
+        out = []
+        for n in range(self.n_min, self.n_max + 1):
+            for i in range(len(base) - n + 1):
+                out.append("_".join(base[i:i + n]))
+        t = Tokenizer("", None)
+        t._tokens = out
+        return t
+
+
+class SentenceIterator:
+    """Reference: `text/sentenceiterator/SentenceIterator`."""
+
+    def __iter__(self) -> Iterator[str]:
+        raise NotImplementedError
+
+    def reset(self):
+        pass
+
+
+class CollectionSentenceIterator(SentenceIterator):
+    def __init__(self, sentences: Sequence[str]):
+        self._s = list(sentences)
+
+    def __iter__(self):
+        return iter(self._s)
+
+
+class FileSentenceIterator(SentenceIterator):
+    """Iterate sentences (lines) of every file under a directory.
+    Reference: FileSentenceIterator."""
+
+    def __init__(self, path: str):
+        self.path = path
+
+    def __iter__(self):
+        if os.path.isfile(self.path):
+            files = [self.path]
+        else:
+            files = sorted(
+                os.path.join(d, f)
+                for d, _, fs in os.walk(self.path) for f in fs)
+        for fp in files:
+            with open(fp, "r", errors="replace") as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        yield line
+
+
+class LineSentenceIterator(FileSentenceIterator):
+    """Reference: LineSentenceIterator (single file, line per sentence)."""
+
+
+def tokenize_corpus(sentences: Iterable[str],
+                    factory: Optional[TokenizerFactory] = None
+                    ) -> List[List[str]]:
+    factory = factory or DefaultTokenizerFactory()
+    return [factory.create(s).tokens() for s in sentences]

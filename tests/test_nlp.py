@@ -1,0 +1,142 @@
+"""NLP tests: vocab/Huffman, Word2Vec convergence + similarity, doc vectors,
+GloVe, serialization round-trips, vectorizers.
+
+Mirrors the reference nlp test strategy (SURVEY §4: 'Word2Vec /
+ParagraphVectors convergence + similarity assertions on bundled corpora')
+with a synthetic two-topic corpus instead of bundled raw text.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    BagOfWordsVectorizer, CollectionSentenceIterator, CommonPreprocessor,
+    DefaultTokenizerFactory, Glove, HuffmanTree, ParagraphVectors,
+    TfidfVectorizer, Word2Vec, build_vocab, read_binary, read_word_vectors,
+    write_binary, write_word_vectors,
+)
+
+
+def _two_topic_corpus(n=400, seed=0):
+    """Sentences drawn from two disjoint topic vocabularies — embeddings
+    must place same-topic words closer than cross-topic words."""
+    rng = np.random.default_rng(seed)
+    animals = ["cat", "dog", "horse", "cow", "sheep", "goat"]
+    tech = ["cpu", "gpu", "tpu", "ram", "disk", "cache"]
+    out = []
+    for _ in range(n):
+        topic = animals if rng.random() < 0.5 else tech
+        out.append([topic[i] for i in rng.integers(0, len(topic), 8)])
+    return out
+
+
+class TestVocab:
+    def test_build_and_prune(self):
+        v = build_vocab([["a", "a", "b"], ["a", "c"]], min_count=2)
+        assert "a" in v and "b" not in v
+        assert v.words[0].word == "a" and v.words[0].count == 3
+
+    def test_huffman_codes_prefix_free(self):
+        v = build_vocab(_two_topic_corpus(50), min_count=1)
+        HuffmanTree(v)
+        codes = ["".join(map(str, w.code)) for w in v.words]
+        assert len(set(codes)) == len(codes)
+        for a in codes:
+            for b in codes:
+                if a != b:
+                    assert not b.startswith(a) or len(b) == len(a)
+
+    def test_frequent_words_get_short_codes(self):
+        v = build_vocab([["x"] * 100, ["y"] * 5, ["z"] * 5, ["w"] * 2],
+                        min_count=1)
+        HuffmanTree(v)
+        assert len(v.words[0].code) <= len(v.words[-1].code)
+
+
+class TestWord2Vec:
+    @pytest.mark.parametrize("hs", [False, True])
+    def test_topic_similarity(self, hs):
+        w2v = Word2Vec(layer_size=24, window=3, min_count=1, negative=4,
+                       hierarchic_softmax=hs, epochs=6, batch_size=1024,
+                       subsampling=0, seed=1)
+        w2v.fit(_two_topic_corpus())
+        same = w2v.similarity("cat", "dog")
+        cross = w2v.similarity("cat", "gpu")
+        assert same > cross, (same, cross)
+        near = w2v.words_nearest("cpu", 3)
+        assert set(near) <= {"gpu", "tpu", "ram", "disk", "cache"}, near
+
+    def test_sentence_iterator_and_tokenizer_path(self):
+        sents = [" ".join(s) for s in _two_topic_corpus(100)]
+        it = CollectionSentenceIterator(sents)
+        tf = DefaultTokenizerFactory().set_token_pre_processor(
+            CommonPreprocessor())
+        w2v = Word2Vec(layer_size=16, min_count=1, epochs=2, seed=0,
+                       subsampling=0, tokenizer_factory=tf)
+        w2v.fit(it)
+        assert w2v.word_vector("cat") is not None
+
+    def test_serialization_round_trips(self, tmp_path):
+        w2v = Word2Vec(layer_size=8, min_count=1, epochs=1, subsampling=0)
+        w2v.fit(_two_topic_corpus(50))
+        ptxt = tmp_path / "vecs.txt"
+        write_word_vectors(w2v, str(ptxt))
+        vocab, mat = read_word_vectors(str(ptxt))
+        assert len(vocab) == len(w2v.vocab)
+        i = vocab.index_of("cat")
+        np.testing.assert_allclose(mat[i], w2v.word_vector("cat"), atol=1e-5)
+
+        pbin = tmp_path / "vecs.bin"
+        write_binary(w2v, str(pbin))
+        vocab2, mat2 = read_binary(str(pbin))
+        i2 = vocab2.index_of("cat")
+        np.testing.assert_allclose(mat2[i2], w2v.word_vector("cat"),
+                                   rtol=1e-6)
+
+
+class TestParagraphVectors:
+    def test_doc_similarity_by_topic(self):
+        corpus = _two_topic_corpus(200)
+        labels = [f"DOC_{i}" for i in range(len(corpus))]
+        pv = ParagraphVectors(layer_size=24, window=3, min_count=1,
+                              negative=4, epochs=8, seed=3, subsampling=0,
+                              dm=False)
+        pv.fit(corpus, labels)
+        # find two same-topic and two cross-topic docs
+        a_docs = [i for i, s in enumerate(corpus) if s[0] in
+                  {"cat", "dog", "horse", "cow", "sheep", "goat"}]
+        t_docs = [i for i in range(len(corpus)) if i not in a_docs]
+        same = pv.similarity_to_label(f"DOC_{a_docs[0]}", f"DOC_{a_docs[1]}")
+        cross = pv.similarity_to_label(f"DOC_{a_docs[0]}", f"DOC_{t_docs[0]}")
+        assert same > cross, (same, cross)
+
+    def test_infer_vector(self):
+        corpus = _two_topic_corpus(100)
+        pv = ParagraphVectors(layer_size=16, min_count=1, epochs=4,
+                              subsampling=0, seed=0)
+        pv.fit(corpus)
+        v = pv.infer_vector(["cat", "dog", "cow"])
+        assert v.shape == (16,) and np.isfinite(v).all()
+
+
+class TestGlove:
+    def test_glove_topic_similarity(self):
+        g = Glove(layer_size=16, window=4, min_count=1, epochs=30,
+                  batch_size=4096, seed=0)
+        g.fit(_two_topic_corpus(300))
+        assert g.similarity("cat", "dog") > g.similarity("cat", "gpu")
+
+
+class TestVectorizers:
+    def test_bow_counts(self):
+        bow = BagOfWordsVectorizer()
+        m = bow.fit_transform([["a", "b", "a"], ["b", "c"]])
+        assert m.shape == (2, 3)
+        ia = bow.vocab.index_of("a")
+        assert m[0, ia] == 2
+
+    def test_tfidf_downweights_common(self):
+        tv = TfidfVectorizer()
+        m = tv.fit_transform([["a", "b"], ["a", "c"], ["a", "d"]])
+        ia, ib = tv.vocab.index_of("a"), tv.vocab.index_of("b")
+        assert m[0, ia] < m[0, ib]
