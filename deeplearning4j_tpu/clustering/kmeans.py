@@ -1,0 +1,76 @@
+"""KMeans via jitted Lloyd iterations.
+
+Reference parity: `clustering/kmeans/KMeansClustering.java` +
+`clustering/cluster/` — k-means++ style seeding, iteration cap,
+convergence by centroid movement.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def _assign(points, centroids):
+    # pairwise sq-distances via the matmul identity (MXU-friendly)
+    p2 = jnp.sum(points * points, axis=1, keepdims=True)
+    c2 = jnp.sum(centroids * centroids, axis=1)
+    d = p2 - 2.0 * points @ centroids.T + c2
+    return jnp.argmin(d, axis=1)
+
+
+@jax.jit
+def _update(points, assign, k_onehot):
+    counts = jnp.sum(k_onehot, axis=0)
+    sums = k_onehot.T @ points
+    return sums / jnp.maximum(counts, 1.0)[:, None], counts
+
+
+class KMeansClustering:
+    def __init__(self, k: int, max_iterations: int = 100, tol: float = 1e-4,
+                 seed: int = 0):
+        self.k = k
+        self.max_iterations = max_iterations
+        self.tol = tol
+        self.seed = seed
+        self.centroids: Optional[np.ndarray] = None
+
+    def fit(self, points: np.ndarray) -> "KMeansClustering":
+        pts = jnp.asarray(points, jnp.float32)
+        n = pts.shape[0]
+        rng = np.random.default_rng(self.seed)
+
+        # k-means++ seeding (host; k small)
+        centroids = [np.asarray(pts[rng.integers(n)])]
+        for _ in range(1, self.k):
+            d = np.min(
+                [np.sum((np.asarray(pts) - c) ** 2, axis=1) for c in centroids],
+                axis=0)
+            probs = d / max(d.sum(), 1e-12)
+            centroids.append(np.asarray(pts[rng.choice(n, p=probs)]))
+        cent = jnp.asarray(np.stack(centroids))
+
+        for _ in range(self.max_iterations):
+            a = _assign(pts, cent)
+            onehot = jax.nn.one_hot(a, self.k, dtype=jnp.float32)
+            new_cent, counts = _update(pts, a, onehot)
+            # keep empty clusters where they were
+            new_cent = jnp.where(counts[:, None] > 0, new_cent, cent)
+            move = float(jnp.max(jnp.linalg.norm(new_cent - cent, axis=1)))
+            cent = new_cent
+            if move < self.tol:
+                break
+        self.centroids = np.asarray(cent)
+        return self
+
+    def predict(self, points) -> np.ndarray:
+        return np.asarray(_assign(jnp.asarray(points, jnp.float32),
+                                  jnp.asarray(self.centroids)))
+
+    def inertia(self, points) -> float:
+        a = self.predict(points)
+        return float(np.sum((np.asarray(points) - self.centroids[a]) ** 2))

@@ -1,0 +1,311 @@
+"""Keras JSON-config → native config mapping + weight copying.
+
+Reference parity: `KerasModel.java` (689 LoC, `getComputationGraph():105`),
+`KerasSequentialModel.java`, `KerasLayer.java` (1,207 LoC per-type mapping),
+entry `KerasModelImport.java:101
+(importKerasModelAndWeights)`.
+
+Convention notes (why little transposing happens here): Keras/TF and this
+framework share NHWC activations, HWIO conv kernels, [in,out] dense kernels,
+and i,f,c,o LSTM gate order — so weights copy through; the reference's NCHW
+transposes (`KerasLayer.java` weight-copy paths) are unnecessary.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.keras_import.h5 import Hdf5Archive
+from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.graph import ElementWiseVertex, MergeVertex
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.nn.layers import (
+    ActivationLayer, BatchNormalization, ConvolutionLayer, DenseLayer,
+    DropoutLayer, EmbeddingSequenceLayer, GlobalPoolingLayer, LSTM,
+    LastTimeStep, OutputLayer, SimpleRnn, SubsamplingLayer, ZeroPaddingLayer,
+)
+from deeplearning4j_tpu.models import ComputationGraph, MultiLayerNetwork
+
+_ACT = {
+    "relu": "relu", "softmax": "softmax", "sigmoid": "sigmoid",
+    "tanh": "tanh", "linear": "identity", "elu": "elu", "selu": "selu",
+    "softplus": "softplus", "softsign": "softsign",
+    "hard_sigmoid": "hardsigmoid", "swish": "swish", "gelu": "gelu",
+    "relu6": "relu6", None: "identity",
+}
+
+
+def _act(cfg: dict, key: str = "activation") -> str:
+    a = cfg.get(key)
+    if a not in _ACT:
+        raise ValueError(f"Unsupported Keras activation {a!r}")
+    return _ACT[a]
+
+
+def _pair(v):
+    return tuple(v) if isinstance(v, (list, tuple)) else (v, v)
+
+
+def _input_type_from_shape(shape) -> Optional[InputType]:
+    """batch_input_shape (batch dim first, None) → InputType."""
+    if shape is None:
+        return None
+    dims = [d for d in shape[1:]]
+    if len(dims) == 1:
+        return InputType.feed_forward(dims[0])
+    if len(dims) == 2:
+        return InputType.recurrent(dims[1], dims[0])
+    if len(dims) == 3:
+        h, w, c = dims
+        return InputType.convolutional(h, w, c)
+    raise ValueError(f"Unsupported input shape {shape}")
+
+
+class _Unsupported(Exception):
+    pass
+
+
+def _map_layer(class_name: str, cfg: dict, *, is_last: bool):
+    """One Keras layer config → native layer(s). Reference:
+    `KerasLayer.java` per-type mapping."""
+    name = cfg.get("name")
+    if class_name == "Dense":
+        act = _act(cfg)
+        if is_last:
+            loss = "mcxent" if act == "softmax" else (
+                "xent" if act == "sigmoid" else "mse")
+            return OutputLayer(name=name, n_out=cfg["units"], activation=act,
+                               loss=loss, has_bias=cfg.get("use_bias", True))
+        return DenseLayer(name=name, n_out=cfg["units"], activation=act,
+                          has_bias=cfg.get("use_bias", True))
+    if class_name in ("Conv2D", "Convolution2D"):
+        return ConvolutionLayer(
+            name=name, n_out=cfg["filters"],
+            kernel=_pair(cfg.get("kernel_size", cfg.get("nb_row", 3))),
+            stride=_pair(cfg.get("strides", (1, 1))),
+            convolution_mode=("same" if cfg.get("padding", "valid") == "same"
+                              else "truncate"),
+            activation=_act(cfg), has_bias=cfg.get("use_bias", True))
+    if class_name in ("MaxPooling2D", "AveragePooling2D"):
+        return SubsamplingLayer(
+            name=name,
+            pooling="max" if class_name.startswith("Max") else "avg",
+            kernel=_pair(cfg.get("pool_size", (2, 2))),
+            stride=_pair(cfg.get("strides") or cfg.get("pool_size", (2, 2))),
+            convolution_mode=("same" if cfg.get("padding", "valid") == "same"
+                              else "truncate"))
+    if class_name in ("GlobalAveragePooling2D", "GlobalMaxPooling2D",
+                      "GlobalAveragePooling1D", "GlobalMaxPooling1D"):
+        return GlobalPoolingLayer(
+            name=name,
+            pooling="avg" if "Average" in class_name else "max")
+    if class_name == "Flatten":
+        return None  # handled by automatic CnnToFeedForward preprocessor
+    if class_name == "Dropout":
+        return DropoutLayer(name=name, dropout=cfg.get("rate", 0.5))
+    if class_name == "Activation":
+        return ActivationLayer(name=name, activation=_act(cfg))
+    if class_name == "BatchNormalization":
+        return BatchNormalization(name=name, eps=cfg.get("epsilon", 1e-3),
+                                  decay=cfg.get("momentum", 0.99))
+    if class_name == "ZeroPadding2D":
+        return ZeroPaddingLayer(name=name, pad=_pair(cfg.get("padding", 1)))
+    if class_name == "LSTM":
+        lstm = LSTM(name=name, n_out=cfg["units"], activation=_act(cfg),
+                    gate_activation=_act(cfg, "recurrent_activation"))
+        if not cfg.get("return_sequences", False):
+            return LastTimeStep(name=name, layer=lstm)
+        return lstm
+    if class_name == "SimpleRNN":
+        rnn = SimpleRnn(name=name, n_out=cfg["units"], activation=_act(cfg))
+        if not cfg.get("return_sequences", False):
+            return LastTimeStep(name=name, layer=rnn)
+        return rnn
+    if class_name == "Embedding":
+        return EmbeddingSequenceLayer(name=name, n_in=cfg["input_dim"],
+                                      n_out=cfg["output_dim"])
+    if class_name == "InputLayer":
+        return None
+    raise _Unsupported(f"Keras layer type {class_name!r} not supported "
+                       f"(reference parity list: KerasLayer.java)")
+
+
+def _copy_weights(net, keras_name: str, our_name: str, weights: List[np.ndarray],
+                  layer) -> None:
+    """Order conventions per Keras save format (kernel, bias, ...)."""
+    if not weights or our_name not in net.params_tree:
+        return
+    p = dict(net.params_tree[our_name])
+    if isinstance(layer, BatchNormalization):
+        # keras order: gamma, beta, moving_mean, moving_var
+        if len(weights) == 4:
+            p["gamma"] = jnp.asarray(weights[0])
+            p["beta"] = jnp.asarray(weights[1])
+            net.state_tree[our_name] = {
+                "mean": jnp.asarray(weights[2]),
+                "var": jnp.asarray(weights[3]),
+            }
+    elif isinstance(layer, (LSTM, SimpleRnn)) or (
+            isinstance(layer, LastTimeStep)):
+        p["W"] = jnp.asarray(weights[0])
+        p["RW"] = jnp.asarray(weights[1])
+        if len(weights) > 2:
+            p["b"] = jnp.asarray(weights[2])
+    else:
+        p["W"] = jnp.asarray(weights[0])
+        if len(weights) > 1 and "b" in p:
+            p["b"] = jnp.asarray(weights[1])
+    net.params_tree[our_name] = p
+
+
+class KerasModelImport:
+    """Reference: `KerasModelImport.java` static entry points."""
+
+    @staticmethod
+    def import_keras_sequential_model_and_weights(path: str):
+        return import_keras_model_and_weights(path)
+
+    @staticmethod
+    def import_keras_model_and_weights(path: str):
+        return import_keras_model_and_weights(path)
+
+
+def import_keras_model_and_weights(path: str):
+    """Auto-detects Sequential vs functional Model.
+    Reference: `KerasModelImport.importKerasModelAndWeights(...):101`."""
+    with Hdf5Archive(path) as ar:
+        config = ar.model_config()
+        cls = config.get("class_name")
+        if cls == "Sequential":
+            net = _import_sequential(config, ar)
+        elif cls in ("Model", "Functional"):
+            net = _import_functional(config, ar)
+        else:
+            raise ValueError(f"Unknown Keras model class {cls!r}")
+    return net
+
+
+def _layer_list(config: dict) -> List[dict]:
+    inner = config.get("config")
+    if isinstance(inner, list):          # Keras 1
+        return inner
+    return inner.get("layers", [])       # Keras 2
+
+
+def _import_sequential(config: dict, ar: Hdf5Archive) -> MultiLayerNetwork:
+    """Reference: `KerasSequentialModel.java` → MultiLayerNetwork."""
+    klayers = _layer_list(config)
+    input_type = None
+    layers = []
+    keras_names: List[Tuple[str, Any]] = []
+    n = len([k for k in klayers
+             if k["class_name"] not in ("InputLayer", "Flatten")])
+    seen = 0
+    for k in klayers:
+        cfg = k.get("config", {})
+        if input_type is None:
+            shape = cfg.get("batch_input_shape") or cfg.get("batch_shape")
+            it = _input_type_from_shape(shape)
+            if it is not None:
+                input_type = it
+        if k["class_name"] in ("InputLayer", "Flatten"):
+            continue
+        seen += 1
+        layer = _map_layer(k["class_name"], cfg, is_last=(seen == n))
+        if layer is None:
+            continue
+        layers.append(layer)
+        keras_names.append((cfg.get("name", k["class_name"]), layer))
+
+    builder = (NeuralNetConfiguration.builder()
+               .seed(123)
+               .list(*layers))
+    if input_type is not None:
+        builder = builder.set_input_type(input_type)
+    net = MultiLayerNetwork(builder.build()).init()
+
+    h5_names = ar.layer_names()
+    for (kname, layer), conf_layer in zip(keras_names, net.conf.layers):
+        source = kname if kname in h5_names else None
+        if source is None:
+            continue
+        _copy_weights(net, kname, conf_layer.name, ar.layer_weights(kname),
+                      layer)
+    return net
+
+
+def _import_functional(config: dict, ar: Hdf5Archive) -> ComputationGraph:
+    """Reference: `KerasModel.getComputationGraph():105`."""
+    inner = config["config"]
+    klayers = inner["layers"]
+    out_names = [o[0] for o in inner.get("output_layers", [])]
+    in_names = [i[0] for i in inner.get("input_layers", [])]
+
+    g = NeuralNetConfiguration.builder().seed(123).graph_builder()
+    input_types = []
+    mapped: Dict[str, Any] = {}
+    for k in klayers:
+        cname = k["class_name"]
+        cfg = k.get("config", {})
+        name = k.get("name") or cfg.get("name")
+        inbound = k.get("inbound_nodes", [])
+        ins: List[str] = []
+        if inbound:
+            node = inbound[0]
+            if isinstance(node, dict):  # Keras 3 style
+                args = node.get("args", [])
+                def walk(a):
+                    if isinstance(a, dict) and "config" in a and \
+                            "keras_history" in a.get("config", {}):
+                        ins.append(a["config"]["keras_history"][0])
+                    elif isinstance(a, (list, tuple)):
+                        for x in a:
+                            walk(x)
+                walk(args)
+            else:
+                for entry in node:
+                    ins.append(entry[0])
+        if cname == "InputLayer":
+            g.add_inputs(name)
+            it = _input_type_from_shape(
+                cfg.get("batch_input_shape") or cfg.get("batch_shape"))
+            input_types.append(it)
+            continue
+        if cname == "Add":
+            g.add_vertex(name, ElementWiseVertex(op="add"), *ins)
+            continue
+        if cname in ("Concatenate", "Merge"):
+            g.add_vertex(name, MergeVertex(), *ins)
+            continue
+        if cname == "Average":
+            g.add_vertex(name, ElementWiseVertex(op="avg"), *ins)
+            continue
+        if cname == "Multiply":
+            g.add_vertex(name, ElementWiseVertex(op="mul"), *ins)
+            continue
+        if cname == "Flatten":
+            from deeplearning4j_tpu.nn.graph import PreprocessorVertex
+            from deeplearning4j_tpu.nn.preprocessors import CnnToFeedForward
+            g.add_vertex(name, PreprocessorVertex(
+                preprocessor=CnnToFeedForward()), *ins)
+            continue
+        layer = _map_layer(cname, cfg, is_last=(name in out_names))
+        if layer is None:
+            continue
+        mapped[name] = layer
+        g.add_layer(name, layer, *ins)
+    g.set_outputs(*out_names)
+    if input_types and all(t is not None for t in input_types):
+        g.set_input_types(*input_types)
+    net = ComputationGraph(g.build()).init()
+
+    h5_names = set(ar.layer_names())
+    for name, layer in mapped.items():
+        if name in h5_names:
+            _copy_weights(net, name, name, ar.layer_weights(name), layer)
+    return net

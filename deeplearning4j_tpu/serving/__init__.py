@@ -1,0 +1,12 @@
+"""Serving: REST nearest-neighbor server + model inference endpoint.
+
+Reference parity: deeplearning4j-nearestneighbor-server
+(`NearestNeighborsServer.java:37`, `NearestNeighbor.java:19` — REST k-NN
+over a VPTree) plus an /output endpoint backed by ParallelInference
+(the reference serves models via ParallelInference embedded in user code).
+"""
+
+from deeplearning4j_tpu.serving.knn_server import NearestNeighborsServer
+from deeplearning4j_tpu.serving.inference_server import InferenceServer
+
+__all__ = ["NearestNeighborsServer", "InferenceServer"]

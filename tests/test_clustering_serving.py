@@ -1,0 +1,183 @@
+"""Clustering, t-SNE, record readers, and REST serving tests.
+
+Mirrors reference suites: clustering tests, MagicQueue-style queue tests,
+nearest-neighbor-server tests (SURVEY §2.2/§2.7).
+"""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.clustering import (
+    BarnesHutTsne, KDTree, KMeansClustering, VPTree,
+)
+
+
+def _blobs(n_per=50, seed=0):
+    rng = np.random.default_rng(seed)
+    centers = np.array([[0, 0], [10, 10], [-10, 10]], np.float64)
+    pts = np.concatenate([
+        c + rng.standard_normal((n_per, 2)) for c in centers])
+    labels = np.repeat(np.arange(3), n_per)
+    return pts, labels
+
+
+class TestKMeans:
+    def test_recovers_blobs(self):
+        pts, labels = _blobs()
+        km = KMeansClustering(3, seed=1).fit(pts)
+        pred = km.predict(pts)
+        # each true cluster should map to one dominant predicted cluster
+        for c in range(3):
+            counts = np.bincount(pred[labels == c], minlength=3)
+            assert counts.max() / counts.sum() > 0.95
+
+    def test_inertia_decreases_vs_random(self):
+        pts, _ = _blobs()
+        km = KMeansClustering(3, seed=0).fit(pts)
+        rand = KMeansClustering(3, max_iterations=0, seed=0)
+        rand.centroids = np.random.default_rng(5).standard_normal((3, 2)) * 10
+        assert km.inertia(pts) < rand.inertia(pts)
+
+
+class TestTrees:
+    def test_vptree_matches_bruteforce(self):
+        rng = np.random.default_rng(0)
+        pts = rng.standard_normal((200, 8))
+        tree = VPTree(pts)
+        q = rng.standard_normal(8)
+        idx, dist = tree.search(q, 5)
+        brute = np.argsort(np.linalg.norm(pts - q, axis=1))[:5]
+        assert set(idx) == set(brute.tolist())
+        assert dist == sorted(dist)
+
+    def test_vptree_cosine(self):
+        rng = np.random.default_rng(1)
+        pts = rng.standard_normal((100, 4))
+        tree = VPTree(pts, metric="cosine")
+        idx, _ = tree.search(pts[7], 1)
+        assert idx[0] == 7
+
+    def test_kdtree_matches_bruteforce(self):
+        rng = np.random.default_rng(2)
+        pts = rng.standard_normal((150, 3))
+        tree = KDTree(pts)
+        q = rng.standard_normal(3)
+        idx, _ = tree.nn(q, 4)
+        brute = np.argsort(np.linalg.norm(pts - q, axis=1))[:4]
+        assert set(idx) == set(brute.tolist())
+
+
+class TestTsne:
+    def test_preserves_cluster_structure(self):
+        pts, labels = _blobs(n_per=30)
+        emb = BarnesHutTsne(n_iter=250, perplexity=10,
+                            seed=0).fit_transform(pts)
+        assert emb.shape == (90, 2)
+        # mean within-cluster distance << mean cross-cluster distance
+        within, cross = [], []
+        for i in range(0, 90, 7):
+            for j in range(0, 90, 11):
+                d = np.linalg.norm(emb[i] - emb[j])
+                (within if labels[i] == labels[j] else cross).append(d)
+        assert np.mean(within) < 0.5 * np.mean(cross)
+
+
+class TestRecordReaders:
+    def test_csv_reader_iterator(self, tmp_path):
+        p = tmp_path / "data.csv"
+        rows = ["1.0,2.0,0", "2.0,3.0,1", "3.0,4.0,2", "4.0,5.0,0"]
+        p.write_text("\n".join(rows))
+        from deeplearning4j_tpu.data.records import (
+            CSVRecordReader, RecordReaderDataSetIterator,
+        )
+        it = RecordReaderDataSetIterator(
+            CSVRecordReader(str(p)), batch_size=2, num_classes=3)
+        batches = list(it)
+        assert len(batches) == 2
+        assert batches[0].features.shape == (2, 2)
+        assert batches[0].labels.shape == (2, 3)
+        np.testing.assert_allclose(batches[0].features[0], [1.0, 2.0])
+
+    def test_sequence_reader_padding_and_mask(self, tmp_path):
+        d = tmp_path / "seqs"
+        d.mkdir()
+        (d / "a.csv").write_text("1,2,0\n3,4,1\n")
+        (d / "b.csv").write_text("5,6,1\n7,8,0\n9,10,1\n")
+        from deeplearning4j_tpu.data.records import (
+            CSVSequenceRecordReader, SequenceRecordReaderDataSetIterator,
+        )
+        it = SequenceRecordReaderDataSetIterator(
+            CSVSequenceRecordReader(str(d)), batch_size=2, num_classes=2)
+        ds = next(iter(it))
+        assert ds.features.shape == (2, 3, 2)
+        assert ds.features_mask.tolist() == [[1, 1, 0], [1, 1, 1]]
+
+    def test_image_reader(self, tmp_path):
+        from PIL import Image
+        for cls in ["cats", "dogs"]:
+            (tmp_path / cls).mkdir()
+            for i in range(2):
+                Image.new("RGB", (10, 8), color=(i * 100, 50, 50)).save(
+                    tmp_path / cls / f"{i}.png")
+        from deeplearning4j_tpu.data.records import (
+            ImageRecordReader, RecordReaderDataSetIterator,
+        )
+        rr = ImageRecordReader(str(tmp_path), height=8, width=10, channels=3)
+        it = RecordReaderDataSetIterator(rr, batch_size=4, num_classes=2)
+        ds = next(iter(it))
+        assert ds.features.shape == (4, 8, 10, 3)
+        assert ds.labels.sum(0).tolist() == [2, 2]
+
+
+class TestServers:
+    def _post(self, port, path, payload):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}",
+            data=json.dumps(payload).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            return json.loads(r.read())
+
+    def test_knn_server(self):
+        from deeplearning4j_tpu.serving import NearestNeighborsServer
+        rng = np.random.default_rng(0)
+        pts = rng.standard_normal((50, 4))
+        srv = NearestNeighborsServer(pts, port=0)
+        port = srv.start()
+        try:
+            out = self._post(port, "/knn", {"ndarray": pts[3].tolist(), "k": 3})
+            assert out["results"][0]["index"] == 3
+            assert out["results"][0]["distance"] == pytest.approx(0.0)
+            out2 = self._post(port, "/knnindex", {"index": 3, "k": 2})
+            assert all(r["index"] != 3 for r in out2["results"])
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
+                assert json.loads(r.read())["status"] == "ok"
+        finally:
+            srv.stop()
+
+    def test_inference_server(self):
+        from deeplearning4j_tpu import InputType
+        from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.models import MultiLayerNetwork
+        from deeplearning4j_tpu.serving import InferenceServer
+        net = MultiLayerNetwork(
+            (NeuralNetConfiguration.builder()
+             .seed(0).list(DenseLayer(n_out=8, activation="relu"),
+                           OutputLayer(n_out=2, activation="softmax"))
+             .set_input_type(InputType.feed_forward(4))
+             .build())).init()
+        srv = InferenceServer(net, port=0, batched=False)
+        port = srv.start()
+        try:
+            x = np.random.default_rng(0).standard_normal((3, 4)).tolist()
+            out = self._post(port, "/output", {"ndarray": x})
+            got = np.asarray(out["output"])
+            want = np.asarray(net.output(np.asarray(x, np.float32)))
+            np.testing.assert_allclose(got, want, rtol=1e-4)
+        finally:
+            srv.stop()

@@ -1,0 +1,158 @@
+"""Keras import tests using hand-written .h5 fixtures (Keras-2 save layout),
+so no TensorFlow is needed — the files exercise the same parsing path as
+real model.save() artifacts.
+
+Mirrors reference modelimport tests (KerasModelImport round-trips).
+"""
+
+import json
+
+import h5py
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.keras_import import import_keras_model_and_weights
+from deeplearning4j_tpu.models import ComputationGraph, MultiLayerNetwork
+
+
+def _write_weights(grp, layer_name, arrays):
+    sub = grp.create_group(layer_name)
+    names = []
+    kinds = ["kernel:0", "bias:0", "extra2:0", "extra3:0"]
+    for arr, kind in zip(arrays, kinds):
+        path = f"{layer_name}/{kind}"
+        sub.create_dataset(kind, data=arr)
+        names.append(path.encode())
+    sub.attrs["weight_names"] = names
+
+
+def _make_sequential_h5(path):
+    rng = np.random.default_rng(0)
+    w1 = rng.standard_normal((8, 16)).astype(np.float32)
+    b1 = rng.standard_normal(16).astype(np.float32)
+    w2 = rng.standard_normal((16, 3)).astype(np.float32)
+    b2 = rng.standard_normal(3).astype(np.float32)
+    config = {
+        "class_name": "Sequential",
+        "config": {"layers": [
+            {"class_name": "Dense",
+             "config": {"name": "dense_1", "units": 16, "activation": "relu",
+                        "use_bias": True, "batch_input_shape": [None, 8]}},
+            {"class_name": "Dense",
+             "config": {"name": "dense_2", "units": 3,
+                        "activation": "softmax", "use_bias": True}},
+        ]},
+    }
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(config)
+        mw = f.create_group("model_weights")
+        mw.attrs["layer_names"] = [b"dense_1", b"dense_2"]
+        mw.attrs["keras_version"] = b"2.1.6"
+        _write_weights(mw, "dense_1", [w1, b1])
+        _write_weights(mw, "dense_2", [w2, b2])
+    return (w1, b1, w2, b2)
+
+
+def _make_functional_h5(path):
+    rng = np.random.default_rng(1)
+    wa = rng.standard_normal((6, 4)).astype(np.float32)
+    ba = np.zeros(4, np.float32)
+    wb = rng.standard_normal((6, 4)).astype(np.float32)
+    bb = np.zeros(4, np.float32)
+    wo = rng.standard_normal((8, 2)).astype(np.float32)
+    bo = np.zeros(2, np.float32)
+    config = {
+        "class_name": "Model",
+        "config": {
+            "layers": [
+                {"class_name": "InputLayer", "name": "in",
+                 "config": {"name": "in", "batch_input_shape": [None, 6]},
+                 "inbound_nodes": []},
+                {"class_name": "Dense", "name": "da",
+                 "config": {"name": "da", "units": 4, "activation": "tanh",
+                            "use_bias": True},
+                 "inbound_nodes": [[["in", 0, 0, {}]]]},
+                {"class_name": "Dense", "name": "db",
+                 "config": {"name": "db", "units": 4, "activation": "tanh",
+                            "use_bias": True},
+                 "inbound_nodes": [[["in", 0, 0, {}]]]},
+                {"class_name": "Concatenate", "name": "cat", "config": {},
+                 "inbound_nodes": [[["da", 0, 0, {}], ["db", 0, 0, {}]]]},
+                {"class_name": "Dense", "name": "out",
+                 "config": {"name": "out", "units": 2,
+                            "activation": "softmax", "use_bias": True},
+                 "inbound_nodes": [[["cat", 0, 0, {}]]]},
+            ],
+            "input_layers": [["in", 0, 0]],
+            "output_layers": [["out", 0, 0]],
+        },
+    }
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(config)
+        mw = f.create_group("model_weights")
+        mw.attrs["layer_names"] = [b"da", b"db", b"out"]
+        _write_weights(mw, "da", [wa, ba])
+        _write_weights(mw, "db", [wb, bb])
+        _write_weights(mw, "out", [wo, bo])
+    return (wa, ba, wb, bb, wo, bo)
+
+
+def _softmax(x):
+    e = np.exp(x - x.max(-1, keepdims=True))
+    return e / e.sum(-1, keepdims=True)
+
+
+class TestSequentialImport:
+    def test_import_matches_manual_forward(self, tmp_path):
+        p = str(tmp_path / "seq.h5")
+        w1, b1, w2, b2 = _make_sequential_h5(p)
+        net = import_keras_model_and_weights(p)
+        assert isinstance(net, MultiLayerNetwork)
+        x = np.random.default_rng(2).standard_normal((5, 8)).astype(np.float32)
+        got = np.asarray(net.output(x))
+        want = _softmax(np.maximum(x @ w1 + b1, 0) @ w2 + b2)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_imported_model_is_trainable(self, tmp_path):
+        p = str(tmp_path / "seq.h5")
+        _make_sequential_h5(p)
+        net = import_keras_model_and_weights(p)
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((32, 8)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+        s0 = net.score(x, y)
+        net.fit(x, y, epochs=5, batch_size=16)
+        assert net.score(x, y) < s0
+
+
+class TestFunctionalImport:
+    def test_import_matches_manual_forward(self, tmp_path):
+        p = str(tmp_path / "func.h5")
+        wa, ba, wb, bb, wo, bo = _make_functional_h5(p)
+        net = import_keras_model_and_weights(p)
+        assert isinstance(net, ComputationGraph)
+        x = np.random.default_rng(3).standard_normal((4, 6)).astype(np.float32)
+        got = np.asarray(net.output(x))
+        cat = np.concatenate([np.tanh(x @ wa + ba), np.tanh(x @ wb + bb)], -1)
+        want = _softmax(cat @ wo + bo)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+
+class TestUnsupported:
+    def test_unknown_layer_type_raises_with_name(self, tmp_path):
+        p = str(tmp_path / "bad.h5")
+        config = {"class_name": "Sequential", "config": {"layers": [
+            {"class_name": "Lambda",
+             "config": {"name": "l", "batch_input_shape": [None, 4]}}]}}
+        with h5py.File(p, "w") as f:
+            f.attrs["model_config"] = json.dumps(config)
+            f.create_group("model_weights").attrs["layer_names"] = []
+        with pytest.raises(Exception, match="Lambda"):
+            import_keras_model_and_weights(p)
+
+    def test_not_a_keras_file(self, tmp_path):
+        p = str(tmp_path / "plain.h5")
+        with h5py.File(p, "w") as f:
+            f.create_dataset("x", data=np.zeros(3))
+        with pytest.raises(ValueError, match="model_config"):
+            import_keras_model_and_weights(p)
