@@ -1,0 +1,241 @@
+"""Training UI server + remote stats routing.
+
+Reference parity: `deeplearning4j-play/.../ui/play/PlayUIServer.java` —
+`getInstance()` singleton, `attach(statsStorage):254`, port via the
+`org.deeplearning4j.ui.port` system property (:59), remote-listener endpoint
+`enableRemoteListener():313`; dashboards served by `ui/module/train/
+TrainModule.java` (overview score chart, model param charts, system tab).
+Remote side: `deeplearning4j-core/.../impl/RemoteUIStatsStorageRouter.java:33`
+(HTTP POST of records, retry queue) + `ui/module/remote/
+RemoteReceiverModule.java` (receiving endpoint).
+
+TPU redesign: a dependency-free `http.server` dashboard (the reference
+embeds a Play framework app); charts are inline SVG polled via JSON
+endpoints. The server is read-only over the `StatsStorage` API, exactly
+like the reference's UIModule seam.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from deeplearning4j_tpu.ui.storage import (
+    Persistable, StatsStorage, StatsStorageRouter,
+)
+
+_PAGE = """<!doctype html>
+<html><head><title>deeplearning4j_tpu training UI</title>
+<style>
+ body{font-family:sans-serif;margin:24px;background:#fafafa}
+ h1{font-size:20px} h2{font-size:16px}
+ .card{background:#fff;border:1px solid #ddd;border-radius:6px;
+       padding:12px;margin-bottom:16px;max-width:900px}
+ svg{width:100%;height:220px} .meta{color:#666;font-size:13px}
+ polyline{fill:none;stroke:#2a6fdb;stroke-width:1.5}
+ table{border-collapse:collapse;font-size:13px}
+ td,th{border:1px solid #ddd;padding:4px 8px;text-align:right}
+ th:first-child,td:first-child{text-align:left}
+</style></head><body>
+<h1>Training overview</h1>
+<div class=card><h2>Score vs iteration</h2><svg id=score></svg>
+<div class=meta id=perf></div></div>
+<div class=card><h2>Parameter norms (last report)</h2>
+<table id=params><tr><th>parameter</th><th>norm2</th><th>mean mag</th>
+<th>update norm2</th></tr></table></div>
+<div class=card><h2>Session</h2><div class=meta id=session></div></div>
+<script>
+function line(svg, xs, ys){
+  if(!ys.length){return}
+  const W=880,H=220,P=30;
+  const xmax=Math.max(...xs,1), ymin=Math.min(...ys), ymax=Math.max(...ys);
+  const sx=x=>P+(W-2*P)*x/xmax, sy=y=>H-P-(H-2*P)*(y-ymin)/((ymax-ymin)||1);
+  svg.setAttribute('viewBox',`0 0 ${W} ${H}`);
+  svg.innerHTML=`<text x=4 y=14 font-size=11>${ymax.toPrecision(4)}</text>`+
+    `<text x=4 y=${H-8} font-size=11>${ymin.toPrecision(4)}</text>`+
+    `<polyline points="${xs.map((x,i)=>sx(x)+','+sy(ys[i])).join(' ')}"/>`;
+}
+async function tick(){
+  try{
+    const r=await (await fetch('train/overview')).json();
+    line(document.getElementById('score'), r.iterations, r.scores);
+    document.getElementById('perf').textContent =
+      `${r.scores.length} reports; last score ${r.scores.at(-1)?.toPrecision(6)??'-'}; `+
+      `${(r.minibatches_per_second??0).toFixed(2)} minibatches/s; `+
+      `rss ${(r.memory_rss_mb??0).toFixed(0)} MB`;
+    const t=document.getElementById('params');
+    t.innerHTML='<tr><th>parameter</th><th>norm2</th><th>mean mag</th><th>update norm2</th></tr>';
+    for(const [k,v] of Object.entries(r.param_stats||{})){
+      const u=(r.update_stats||{})[k]||{};
+      t.innerHTML+=`<tr><td>${k}</td><td>${v.norm2?.toPrecision(5)}</td>`+
+        `<td>${v.mean_magnitude?.toPrecision(5)}</td>`+
+        `<td>${u.norm2?.toPrecision(5)??'-'}</td></tr>`;
+    }
+    document.getElementById('session').textContent=JSON.stringify(r.static||{});
+  }catch(e){}
+  setTimeout(tick, 2000);
+}
+tick();
+</script></body></html>"""
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "dl4jtpu-ui/1.0"
+
+    def log_message(self, *a):  # silence request logging
+        pass
+
+    # --------------------------------------------------------------- GET
+    def do_GET(self):
+        storage: Optional[StatsStorage] = self.server.ui.storage
+        path = self.path.split("?")[0].rstrip("/")
+        if path in ("", "/", "/train", "/train/overview.html"):
+            return self._send(200, _PAGE, "text/html")
+        if path == "/train/overview":
+            return self._send_json(self._overview(storage))
+        if path == "/train/sessions":
+            sids = storage.list_session_ids() if storage else []
+            return self._send_json({"sessions": sids})
+        self._send(404, "not found", "text/plain")
+
+    def _overview(self, storage):
+        if storage is None:
+            return {"iterations": [], "scores": []}
+        out = {"iterations": [], "scores": []}
+        sids = storage.list_session_ids()
+        if not sids:
+            return out
+        sid = sids[-1]
+        for tid in storage.list_type_ids(sid):
+            for wid in storage.list_worker_ids(sid, tid):
+                ups = storage.get_all_updates(sid, tid, wid)
+                for u in ups:
+                    if "score" in u.content:
+                        out["iterations"].append(u.content.get("iteration"))
+                        out["scores"].append(u.content["score"])
+                if ups:
+                    last = ups[-1].content
+                    out["param_stats"] = last.get("param_stats")
+                    out["update_stats"] = last.get("update_stats")
+                    out["minibatches_per_second"] = last.get(
+                        "minibatches_per_second")
+                    out["memory_rss_mb"] = last.get("memory_rss_mb")
+                st = storage.get_static_info(sid, tid, wid)
+                if st:
+                    out["static"] = {
+                        "model_class": st.content.get("model_class"),
+                        "num_params": st.content.get("num_params"),
+                        "backend": (st.content.get("software") or {}).get(
+                            "backend"),
+                    }
+        return out
+
+    # --------------------------------------------------------------- POST
+    def do_POST(self):
+        """Remote-listener receiver. Reference:
+        `RemoteReceiverModule.java` paired with PlayUIServer
+        `enableRemoteListener():313`."""
+        ui = self.server.ui
+        if self.path.rstrip("/") != "/remote" or not ui.remote_enabled:
+            return self._send(404, "remote receiver not enabled",
+                              "text/plain")
+        n = int(self.headers.get("Content-Length", 0))
+        body = json.loads(self.rfile.read(n))
+        rec = Persistable(**body["record"])
+        if ui.storage is not None:
+            if body.get("kind") == "static":
+                ui.storage.put_static_info(rec)
+            else:
+                ui.storage.put_update(rec)
+        self._send_json({"ok": True})
+
+    # ------------------------------------------------------------ helpers
+    def _send(self, code, body, ctype):
+        data = body.encode() if isinstance(body, str) else body
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def _send_json(self, obj):
+        self._send(200, json.dumps(obj), "application/json")
+
+
+class UIServer:
+    """Reference: `PlayUIServer` — `getInstance()`, `attach(storage)`,
+    `enableRemoteListener()`. Port 0 picks a free port (the reference
+    defaults to 9000 via the ui.port property)."""
+
+    _instance: Optional["UIServer"] = None
+
+    def __init__(self, port: int = 0):
+        self.storage: Optional[StatsStorage] = None
+        self.remote_enabled = False
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", port), _Handler)
+        self._httpd.ui = self
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True)
+        self._thread.start()
+
+    @classmethod
+    def get_instance(cls, port: int = 0) -> "UIServer":
+        if cls._instance is None:
+            cls._instance = cls(port)
+        return cls._instance
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def attach(self, storage: StatsStorage) -> None:
+        self.storage = storage
+
+    def detach(self, storage: StatsStorage) -> None:
+        if self.storage is storage:
+            self.storage = None
+
+    def enable_remote_listener(self) -> None:
+        self.remote_enabled = True
+        if self.storage is None:
+            self.storage = StatsStorage()
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._thread.join(timeout=5)
+        if UIServer._instance is self:
+            UIServer._instance = None
+
+
+class RemoteStatsRouter(StatsStorageRouter):
+    """HTTP-POST router to a remote UIServer. Reference:
+    `impl/RemoteUIStatsStorageRouter.java:33` (posts records, silently
+    retries/drops on failure so training never blocks on the UI)."""
+
+    def __init__(self, url: str, *, timeout: float = 2.0,
+                 raise_on_error: bool = False):
+        self.url = url.rstrip("/") + "/remote"
+        self.timeout = timeout
+        self.raise_on_error = raise_on_error
+
+    def _post(self, kind: str, record: Persistable) -> None:
+        import dataclasses as dc
+        body = json.dumps({"kind": kind,
+                           "record": dc.asdict(record)}).encode()
+        req = urllib.request.Request(
+            self.url, data=body,
+            headers={"Content-Type": "application/json"})
+        try:
+            urllib.request.urlopen(req, timeout=self.timeout).read()
+        except Exception:
+            if self.raise_on_error:
+                raise
+
+    def put_static_info(self, record: Persistable) -> None:
+        self._post("static", record)
+
+    def put_update(self, record: Persistable) -> None:
+        self._post("update", record)

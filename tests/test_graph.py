@@ -1,0 +1,168 @@
+"""Graph module tests — mirrors the reference suites
+`deeplearning4j-graph/src/test/java/org/deeplearning4j/graph/`:
+TestGraph, TestGraphHuffman, TestDeepWalk, TestGraphLoading."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.graph import (
+    DeepWalk, Graph, GraphHuffman, Node2VecWalker, NoEdgeHandling,
+    RandomWalker, WeightedWalker, generate_walks, load_edge_list,
+    load_weighted_edge_list,
+)
+
+
+def ring_graph(n=10):
+    g = Graph(n)
+    for i in range(n):
+        g.add_edge(i, (i + 1) % n)
+    return g
+
+
+class TestGraphApi:
+    def test_adjacency(self):
+        g = ring_graph(10)
+        assert g.num_vertices() == 10
+        # undirected: each vertex sees both ring neighbors
+        assert sorted(g.get_connected_vertex_indices(0)) == [1, 9]
+        assert g.degree(0) == 2
+        assert g.num_edges() == 20  # stored both directions
+
+    def test_directed(self):
+        g = Graph(3)
+        g.add_edge(0, 1, directed=True)
+        assert g.get_connected_vertex_indices(0) == [1]
+        assert g.get_connected_vertex_indices(1) == []
+
+    def test_neighbor_table_padding(self):
+        g = Graph(4)
+        g.add_edge(0, 1)
+        g.add_edge(0, 2)
+        g.add_edge(0, 3)
+        nbrs, wts, degs = g.neighbor_table()
+        assert nbrs.shape == (4, 3)
+        assert degs.tolist() == [3, 1, 1, 1]
+
+    def test_edge_list_loading(self):
+        lines = ["0,1", "1,2", "2,0"]
+        g = load_edge_list(lines, 3)
+        assert g.degree(0) == 2
+        wl = ["0,1,2.5", "1,2,0.5"]
+        gw = load_weighted_edge_list(wl, 3)
+        _, wts, _ = gw.neighbor_table()
+        assert wts[0, 0] == 2.5
+
+
+class TestWalkers:
+    def test_random_walks_stay_on_edges(self):
+        g = ring_graph(10)
+        walks = RandomWalker(g, walk_length=8, seed=1).walks()
+        assert walks.shape == (10, 9)
+        for row in walks:
+            for a, b in zip(row[:-1], row[1:]):
+                assert b in g.get_connected_vertex_indices(int(a))
+
+    def test_disconnected_self_loops(self):
+        g = Graph(3)
+        g.add_edge(0, 1)
+        walks = RandomWalker(g, walk_length=4, seed=0).walks(
+            np.array([2], dtype=np.int64))
+        assert (walks == 2).all()
+
+    def test_disconnected_exception(self):
+        g = Graph(3)
+        g.add_edge(0, 1)
+        w = RandomWalker(
+            g, 4, no_edge_handling=NoEdgeHandling.EXCEPTION_ON_DISCONNECTED)
+        with pytest.raises(ValueError):
+            w.walks(np.array([2], dtype=np.int64))
+
+    def test_weighted_walks_prefer_heavy_edges(self):
+        g = Graph(3)
+        g.add_edge(0, 1, 100.0)
+        g.add_edge(0, 2, 0.01)
+        walks = WeightedWalker(g, walk_length=1, seed=0).walks(
+            np.zeros(200, dtype=np.int64))
+        frac_to_1 = (walks[:, 1] == 1).mean()
+        assert frac_to_1 > 0.9
+
+    def test_node2vec_walks_valid(self):
+        g = ring_graph(8)
+        walks = Node2VecWalker(g, walk_length=6, p=0.5, q=2.0,
+                               seed=3).walks()
+        for row in walks:
+            for a, b in zip(row[:-1], row[1:]):
+                assert b in g.get_connected_vertex_indices(int(a))
+
+    def test_generate_walks_multiple_per_vertex(self):
+        g = ring_graph(6)
+        walks = generate_walks(g, walk_length=4, walks_per_vertex=3)
+        assert walks.shape == (18, 5)
+
+
+class TestGraphHuffman:
+    def test_codes_prefix_free(self):
+        # mirrors reference TestGraphHuffman: distinct, prefix-free codes,
+        # high-degree vertices get short codes
+        degrees = np.array([10, 9, 8, 7, 5, 2, 1])
+        h = GraphHuffman(degrees)
+        codes = ["".join(map(str, h.get_code(i)))
+                 for i in range(len(degrees))]
+        assert len(set(codes)) == len(codes)
+        for i, a in enumerate(codes):
+            for j, b in enumerate(codes):
+                if i != j:
+                    assert not b.startswith(a)
+        assert len(codes[0]) <= len(codes[-1])
+
+    def test_inner_nodes_in_range(self):
+        degrees = np.array([3, 3, 2, 1])
+        h = GraphHuffman(degrees)
+        for i in range(4):
+            pts = h.get_path_inner_nodes(i)
+            assert len(pts) == h.get_code_length(i)
+            assert all(0 <= p < 3 for p in pts)
+
+
+class TestDeepWalk:
+    def test_fit_shapes_and_queries(self):
+        g = ring_graph(12)
+        dw = DeepWalk(vector_size=16, window_size=3, epochs=2,
+                      walks_per_vertex=4, seed=0)
+        dw.fit(g, walk_length=8)
+        assert dw.vertex_vectors.shape == (12, 16)
+        assert np.isfinite(dw.vertex_vectors).all()
+        assert -1.01 <= dw.similarity(0, 6) <= 1.01
+        near = dw.vertices_nearest(0, top=3)
+        assert len(near) == 3 and 0 not in near
+
+    def test_neighbors_closer_than_far_vertices(self):
+        # two disjoint cliques: same-clique similarity must beat cross-clique
+        g = Graph(10)
+        for c in (range(5), range(5, 10)):
+            c = list(c)
+            for i in c:
+                for j in c:
+                    if i < j:
+                        g.add_edge(i, j)
+        dw = DeepWalk(vector_size=24, window_size=4, epochs=10,
+                      walks_per_vertex=8, learning_rate=0.05, seed=1)
+        dw.fit(g, walk_length=10)
+        same = np.mean([dw.similarity(0, j) for j in range(1, 5)])
+        cross = np.mean([dw.similarity(0, j) for j in range(5, 10)])
+        assert same > cross
+
+    def test_initialize_from_degrees(self):
+        dw = DeepWalk(vector_size=8)
+        dw.initialize(np.array([4, 3, 2, 1]))
+        assert dw.vertex_vectors.shape == (4, 8)
+        assert dw.huffman.get_code_length(0) <= dw.huffman.get_code_length(3)
+
+    def test_save_load_roundtrip(self, tmp_path):
+        g = ring_graph(6)
+        dw = DeepWalk(vector_size=8, epochs=1, seed=0).fit(g, walk_length=4)
+        p = str(tmp_path / "gv.txt")
+        dw.save(p)
+        dw2 = DeepWalk.load(p)
+        np.testing.assert_allclose(dw2.vertex_vectors, dw.vertex_vectors,
+                                   rtol=1e-6)

@@ -1,0 +1,156 @@
+"""UI/stats pipeline tests — mirrors reference suites
+`deeplearning4j-ui-parent/.../TestStatsListener.java`,
+`TestStatsStorage.java`, and the remote-router/receiver pairing."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ui import (
+    FileStatsStorage, InMemoryStatsStorage, Persistable, RemoteStatsRouter,
+    StatsListener, UIServer,
+)
+
+
+def small_net():
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.optim.updaters import Sgd
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(12345).updater(Sgd(0.1))
+            .list()
+            .layer(DenseLayer(n_in=4, n_out=8, activation="tanh"))
+            .layer(OutputLayer(n_in=8, n_out=3, activation="softmax",
+                               loss="negativeloglikelihood"))
+            .build())
+    return MultiLayerNetwork(conf).init()
+
+
+def toy_data(n=64, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+    return x, y
+
+
+class TestStatsStorage:
+    def rec(self, sid="s1", tid="StatsListener", wid="w1", ts=1.0, **kw):
+        return Persistable(sid, tid, wid, ts, dict(kw))
+
+    def test_update_and_query(self):
+        st = InMemoryStatsStorage()
+        st.put_static_info(self.rec(ts=0.5, model="m"))
+        st.put_update(self.rec(ts=1.0, score=2.0))
+        st.put_update(self.rec(ts=2.0, score=1.0))
+        assert st.list_session_ids() == ["s1"]
+        assert st.list_type_ids("s1") == ["StatsListener"]
+        assert st.list_worker_ids("s1") == ["w1"]
+        assert st.num_updates("s1", "StatsListener", "w1") == 2
+        assert st.get_latest_update("s1", "StatsListener",
+                                    "w1").content["score"] == 1.0
+        after = st.get_all_updates_after("s1", "StatsListener", "w1", 1.5)
+        assert len(after) == 1
+
+    def test_listener_events(self):
+        st = InMemoryStatsStorage()
+        events = []
+        st.register_stats_storage_listener(events.append)
+        st.put_static_info(self.rec())
+        st.put_update(self.rec(ts=2.0))
+        kinds = [e.event_type for e in events]
+        assert "new_session" in kinds and "post_update" in kinds
+
+    def test_file_storage_replay(self, tmp_path):
+        p = str(tmp_path / "stats.jsonl")
+        st = FileStatsStorage(p)
+        st.put_static_info(self.rec(model="m"))
+        st.put_update(self.rec(ts=3.0, score=0.5))
+        st.close()
+        st2 = FileStatsStorage(p)
+        assert st2.num_updates("s1", "StatsListener", "w1") == 1
+        assert st2.get_static_info("s1", "StatsListener",
+                                   "w1").content["model"] == "m"
+        st2.close()
+
+
+class TestStatsListener:
+    def test_reports_collected_during_fit(self):
+        st = InMemoryStatsStorage()
+        net = small_net()
+        net.set_listeners(StatsListener(st, frequency=1,
+                                        collect_histograms=True))
+        x, y = toy_data()
+        net.fit(x, y, epochs=2, batch_size=32)
+        sid = st.list_session_ids()[0]
+        ups = st.get_all_updates(sid, "StatsListener", "local")
+        assert len(ups) == 4  # 2 epochs * 2 batches
+        last = ups[-1].content
+        assert np.isfinite(last["score"])
+        assert "param_stats" in last
+        # one entry per param leaf, each with norms
+        some = next(iter(last["param_stats"].values()))
+        assert {"mean", "std", "norm2"} <= set(some)
+        assert "update_stats" in last  # deltas exist from 2nd report on
+        assert "param_histograms" in last
+        static = st.get_static_info(sid, "StatsListener", "local")
+        assert static.content["num_params"] == net.num_params()
+
+    def test_frequency_thinning(self):
+        st = InMemoryStatsStorage()
+        net = small_net()
+        net.set_listeners(StatsListener(st, frequency=2))
+        x, y = toy_data()
+        net.fit(x, y, epochs=2, batch_size=32)
+        sid = st.list_session_ids()[0]
+        assert st.num_updates(sid, "StatsListener", "local") == 2
+
+
+class TestUIServer:
+    def test_overview_endpoint(self):
+        server = UIServer(port=0)
+        try:
+            st = InMemoryStatsStorage()
+            server.attach(st)
+            net = small_net()
+            net.set_listeners(StatsListener(st, frequency=1))
+            x, y = toy_data()
+            net.fit(x, y, epochs=1, batch_size=32)
+            url = f"http://127.0.0.1:{server.port}"
+            page = urllib.request.urlopen(url + "/").read().decode()
+            assert "Training overview" in page
+            data = json.loads(urllib.request.urlopen(
+                url + "/train/overview").read())
+            assert len(data["scores"]) == 2
+            assert data["static"]["model_class"] == "MultiLayerNetwork"
+        finally:
+            server.stop()
+
+    def test_remote_router_roundtrip(self):
+        server = UIServer(port=0)
+        try:
+            server.enable_remote_listener()
+            router = RemoteStatsRouter(
+                f"http://127.0.0.1:{server.port}", raise_on_error=True)
+            router.put_static_info(Persistable("s9", "T", "w", 1.0,
+                                               {"model": "x"}))
+            router.put_update(Persistable("s9", "T", "w", 2.0,
+                                          {"score": 3.0}))
+            st = server.storage
+            assert st.list_session_ids() == ["s9"]
+            assert st.get_latest_update("s9", "T", "w").content["score"] == 3.0
+        finally:
+            server.stop()
+
+    def test_remote_disabled_404(self):
+        server = UIServer(port=0)
+        try:
+            router = RemoteStatsRouter(
+                f"http://127.0.0.1:{server.port}", raise_on_error=True)
+            with pytest.raises(Exception):
+                router.put_update(Persistable("s", "T", "w", 1.0, {}))
+        finally:
+            server.stop()
