@@ -32,19 +32,21 @@ def data_dir() -> str:
 
 # --------------------------------------------------------------------- MNIST
 def _read_idx_images(path: str) -> np.ndarray:
+    from deeplearning4j_tpu import native
     op = gzip.open if path.endswith(".gz") else open
     with op(path, "rb") as f:
-        magic, n, rows, cols = struct.unpack(">IIII", f.read(16))
-        assert magic == 2051, f"bad magic {magic}"
-        return np.frombuffer(f.read(), np.uint8).reshape(n, rows, cols)
+        arr = native.read_idx(f.read())
+    assert arr.ndim == 3, f"bad idx image rank {arr.ndim}"
+    return arr
 
 
 def _read_idx_labels(path: str) -> np.ndarray:
+    from deeplearning4j_tpu import native
     op = gzip.open if path.endswith(".gz") else open
     with op(path, "rb") as f:
-        magic, n = struct.unpack(">II", f.read(8))
-        assert magic == 2049, f"bad magic {magic}"
-        return np.frombuffer(f.read(), np.uint8)
+        arr = native.read_idx(f.read())
+    assert arr.ndim == 1, f"bad idx label rank {arr.ndim}"
+    return arr
 
 
 def _find(name_options, base) -> Optional[str]:
@@ -64,8 +66,9 @@ def load_mnist(train: bool = True) -> Tuple[np.ndarray, np.ndarray, bool]:
     img = _find([f"{prefix}-images-idx3-ubyte", f"{prefix}-images.idx3-ubyte"], base)
     lab = _find([f"{prefix}-labels-idx1-ubyte", f"{prefix}-labels.idx1-ubyte"], base)
     if img and lab:
-        x = _read_idx_images(img).astype(np.float32).reshape(-1, 784) / 255.0
-        y = np.eye(10, dtype=np.float32)[_read_idx_labels(lab)]
+        from deeplearning4j_tpu import native
+        x = native.u8_to_f32(_read_idx_images(img)).reshape(-1, 784)
+        y = native.one_hot(_read_idx_labels(lab), 10)
         return x, y, False
     # Deterministic synthetic surrogate: 10 gaussian digit prototypes.
     n = 60000 if train else 10000

@@ -115,9 +115,13 @@ class Node2VecWalker(RandomWalker):
         rng = np.random.default_rng(self.seed)
         n = len(starts)
         max_d = nbrs.shape[1]
-        # neighbor-membership sets for the q-bias (dist(prev, x) == 1 test)
-        nbr_sets = [set(self.graph.get_connected_vertex_indices(i))
-                    for i in range(self.graph.num_vertices())]
+        # Sorted neighbor rows (padding → sentinel V, no vertex id collides)
+        # enable a fully vectorized dist(prev, x) == 1 membership test via
+        # one flat searchsorted per step — no per-row Python loops.
+        V = self.graph.num_vertices()
+        col = np.arange(max_d)[None, :]
+        snbrs = np.sort(np.where(col < degs[:, None], nbrs, V), axis=1)
+        row_off = (np.arange(n, dtype=np.int64) * (V + 2))[:, None]
         out = np.empty((n, self.walk_length + 1), dtype=np.int64)
         out[:, 0] = starts
         prev = starts.copy()
@@ -133,10 +137,13 @@ class Node2VecWalker(RandomWalker):
             w[valid >= degs[cur][:, None]] = 0.0
             # bias: back to prev → w/p ; dist(prev,·)==1 → w ; else → w/q
             back = cand == prev[:, None]
-            is_nbr = np.zeros_like(back)
-            for r in range(n):
-                ps = nbr_sets[prev[r]]
-                is_nbr[r] = [c in ps for c in cand[r]]
+            # keys are globally sorted: rows ascend, offsets jump by V+2
+            sorted_keys = (snbrs[prev] + row_off).ravel()
+            cand_keys = (cand + row_off).ravel()
+            pos = np.searchsorted(sorted_keys, cand_keys)
+            hit = pos < sorted_keys.size
+            hit[hit] = sorted_keys[pos[hit]] == cand_keys[hit]
+            is_nbr = hit.reshape(n, max_d)
             alpha = np.where(back, 1.0 / self.p,
                              np.where(is_nbr, 1.0, 1.0 / self.q))
             w = w * alpha

@@ -158,9 +158,15 @@ class StatsListener(TrainingListener):
             self.session_id, TYPE_ID, self.worker_id, time.time(), info))
         self._init_done = True
 
-    def clone(self) -> "StatsListener":
+    def clone(self, worker_id: Optional[str] = None) -> "StatsListener":
+        """Per-replica copy for multi-worker training (the reference's
+        ParallelWrapper clones listeners per Trainer): SAME session, distinct
+        worker id, fresh accumulation state."""
+        if worker_id is None:
+            worker_id = f"{self.worker_id}-{uuid.uuid4().hex[:6]}"
         return StatsListener(self.router, self.frequency,
-                             worker_id=self.worker_id,
+                             session_id=self.session_id,
+                             worker_id=worker_id,
                              collect_histograms=self.collect_histograms,
                              histogram_bins=self.histogram_bins)
 

@@ -1,0 +1,187 @@
+"""Tests for the C++ native host runtime and the quantized-gradient exchange.
+
+Mirrors the reference's native-op coverage expectations: the threshold codec
+round-trips (EncodingHandler semantics), record decoding matches numpy, and
+the staging workspace cycles (MemoryWorkspace semantics).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu import native
+from deeplearning4j_tpu.parallel.accumulation import (
+    EncodingHandler, GradientsAccumulator, SharedGradientsExchange)
+
+
+def test_native_library_builds():
+    # g++ is part of the baked toolchain; the lib must actually build here.
+    assert native.available()
+
+
+def _encode_ref(grad, t):
+    flat = grad.reshape(-1)
+    hits = np.flatnonzero(np.abs(flat) >= t)
+    signs = (flat[hits] > 0).astype(np.uint8)
+    flat[hits] -= np.where(signs, t, -t).astype(np.float32)
+    return hits.astype(np.int32), signs
+
+
+def test_threshold_encode_matches_numpy_reference():
+    rng = np.random.default_rng(0)
+    g1 = rng.standard_normal(4096).astype(np.float32) * 0.01
+    g2 = g1.copy()
+    t = 0.008
+    idx_n, signs_n = native.threshold_encode(g1, t)
+    idx_r, signs_r = _encode_ref(g2, t)
+    np.testing.assert_array_equal(idx_n, idx_r)
+    np.testing.assert_array_equal(signs_n, signs_r)
+    np.testing.assert_allclose(g1, g2, atol=1e-7)
+
+
+def test_threshold_roundtrip_preserves_mass():
+    rng = np.random.default_rng(1)
+    grad = rng.standard_normal(2048).astype(np.float32) * 0.02
+    orig = grad.copy()
+    t = 0.01
+    idx, signs = native.threshold_encode(grad, t)
+    decoded = np.zeros_like(orig)
+    native.threshold_decode(decoded, t, idx, signs)
+    # decoded + residual == original gradient (no mass lost, only delayed)
+    np.testing.assert_allclose(decoded + grad, orig, atol=1e-6)
+
+
+def test_threshold_decode_accumulates():
+    target = np.zeros(8, dtype=np.float32)
+    idx = np.array([1, 1, 3], dtype=np.int32)
+    signs = np.array([1, 1, 0], dtype=np.uint8)
+    native.threshold_decode(target, 0.5, idx, signs)
+    np.testing.assert_allclose(target, [0, 1.0, 0, -0.5, 0, 0, 0, 0])
+
+
+def test_parse_csv():
+    arr = native.parse_csv("1.5,2,3\n4,5.25,6\n")
+    np.testing.assert_allclose(arr, [[1.5, 2, 3], [4, 5.25, 6]])
+
+
+def test_parse_csv_crlf_and_blank_lines():
+    arr = native.parse_csv("1,2\r\n\r\n3,4\r\n")
+    np.testing.assert_allclose(arr, [[1, 2], [3, 4]])
+
+
+def test_read_idx_roundtrip():
+    data = np.arange(2 * 3 * 4, dtype=np.uint8).reshape(2, 3, 4)
+    header = bytes([0, 0, 0x08, 3]) + b"".join(
+        int(d).to_bytes(4, "big") for d in data.shape)
+    arr = native.read_idx(header + data.tobytes())
+    np.testing.assert_array_equal(arr, data)
+
+
+def test_read_idx_float32():
+    vals = np.array([1.5, -2.25, 3.0], dtype=">f4")
+    header = bytes([0, 0, 0x0D, 1]) + (3).to_bytes(4, "big")
+    arr = native.read_idx(header + vals.tobytes())
+    np.testing.assert_allclose(arr, [1.5, -2.25, 3.0])
+    assert arr.dtype == np.float32
+
+
+def test_u8_to_f32_and_one_hot():
+    px = np.array([0, 51, 255], dtype=np.uint8)
+    np.testing.assert_allclose(native.u8_to_f32(px),
+                               [0.0, 0.2, 1.0], atol=1e-6)
+    oh = native.one_hot(np.array([0, 2, 1]), 3)
+    np.testing.assert_array_equal(
+        oh, [[1, 0, 0], [0, 0, 1], [0, 1, 0]])
+
+
+def test_workspace_cycle():
+    with native.Workspace(1 << 16) as ws:
+        a = ws.alloc((16, 16), np.float32)
+        a[:] = 7.0
+        used1 = ws.used
+        assert used1 >= 16 * 16 * 4
+        ws.reset()
+        assert ws.used == 0
+        b = ws.alloc((16, 16), np.float32)
+        b[:] = 3.0
+        assert ws.high_water >= used1
+        np.testing.assert_allclose(b, 3.0)
+        del a, b  # views must be dropped before the workspace closes
+
+
+def test_workspace_exhaustion():
+    if not native.available():
+        pytest.skip("numpy fallback never exhausts")
+    with native.Workspace(1024) as ws:
+        with pytest.raises(MemoryError):
+            ws.alloc((1024,), np.float32)
+
+
+def test_workspace_close_guards_live_views():
+    if not native.available():
+        pytest.skip("fallback arrays don't alias arena memory")
+    ws = native.Workspace(4096)
+    arr = ws.alloc((8,), np.float32)
+    with pytest.raises(RuntimeError):
+        ws.close()
+    del arr
+    ws.close()
+
+
+def test_parse_csv_empty_fields_match_fallback():
+    # '1,,3' has an empty middle field -> 0.0, identically on both paths.
+    arr = native.parse_csv("1,,3\n4,5,6\n")
+    np.testing.assert_allclose(arr, [[1, 0, 3], [4, 5, 6]])
+    arr2 = native.parse_csv("1,abc,3\n")
+    np.testing.assert_allclose(arr2, [[1, 0, 3]])
+
+
+def test_threshold_decode_skips_out_of_range():
+    target = np.zeros(4, dtype=np.float32)
+    idx = np.array([1, 9, -2], dtype=np.int32)
+    signs = np.array([1, 1, 1], dtype=np.uint8)
+    native.threshold_decode(target, 0.5, idx, signs)
+    np.testing.assert_allclose(target, [0, 0.5, 0, 0])
+
+
+def test_apply_updates_rejects_noncontiguous_target():
+    acc = GradientsAccumulator(4)
+    acc.receive_update(np.array([2, 5]), 0.5, n=4)
+    buf = np.zeros((4, 2), dtype=np.float32)
+    with pytest.raises(ValueError):
+        acc.apply_updates(buf.T)  # non-contiguous view
+    flat = np.zeros(4, dtype=np.float32)
+    assert acc.apply_updates(flat) == 1
+
+
+def test_encoding_handler_residual_carryover():
+    h = EncodingHandler(threshold=1.0)
+    # Below threshold: nothing broadcast, residual carries.
+    assert h.broadcast_update(np.full(4, 0.6, np.float32)) == 0
+    # Second round pushes residual over threshold.
+    assert h.broadcast_update(np.full(4, 0.6, np.float32)) == 4
+    np.testing.assert_allclose(h.residual, 0.2, atol=1e-6)
+
+
+def test_shared_gradients_exchange_converges():
+    n = 64
+    ex = SharedGradientsExchange(n_workers=2, n_params=n, threshold=0.01)
+    params0 = np.zeros(n, dtype=np.float32)
+    params1 = np.zeros(n, dtype=np.float32)
+    rng = np.random.default_rng(2)
+    g = rng.standard_normal(n).astype(np.float32) * 0.1
+    ex.publish(0, g)
+    ex.publish(1, g)
+    assert ex.collect(0, params0) == 1   # worker 0 sees worker 1's update
+    assert ex.collect(1, params1) == 1
+    # Each worker applied the peer's quantized gradient: every applied
+    # element moves in the gradient's direction (1-bit sign semantics).
+    hits = params0 != 0
+    assert hits.sum() > n // 2
+    assert np.all(np.sign(params0[hits]) == np.sign(g[hits]))
+    np.testing.assert_allclose(params0, params1)
+
+
+def test_accumulator_rejects_mismatched_size():
+    acc = GradientsAccumulator(8)
+    with pytest.raises(ValueError):
+        acc.receive_update(np.array([2]), 0.1, n=4)
