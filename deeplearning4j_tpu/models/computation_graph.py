@@ -153,6 +153,11 @@ class ComputationGraph:
         for name, v in self.conf.vertices.items():
             if isinstance(v, LayerVertex):
                 total = total + v.layer.regularization(params[name])
+        # Activity-dependent auxiliary losses (e.g. MoE load balancing)
+        # reported via vertex state — differentiated with the score.
+        for st in new_states.values():
+            if isinstance(st, dict) and "aux_loss" in st:
+                total = total + st["aux_loss"]
         return total, new_states
 
     # ------------------------------------------------------ train step

@@ -193,7 +193,14 @@ class MultiLayerNetwork:
         reg = sum(
             layer.regularization(params[layer.name]) for layer in self.layers
         )
-        return score + reg, new_states
+        # Activity-dependent auxiliary losses (e.g. MoE load balancing)
+        # reported through layer state — added INSIDE the differentiated
+        # closure so they contribute gradients.
+        aux = sum(
+            st["aux_loss"] for st in new_states.values()
+            if isinstance(st, dict) and "aux_loss" in st
+        )
+        return score + reg + aux, new_states
 
     # ------------------------------------------------------ train step
     def make_step_fn(self, tbptt: bool = False):

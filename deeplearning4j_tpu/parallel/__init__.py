@@ -23,10 +23,21 @@ from deeplearning4j_tpu.parallel.sharding import (
 )
 from deeplearning4j_tpu.parallel.inference import ParallelInference
 from deeplearning4j_tpu.parallel.distributed import initialize_distributed
+from deeplearning4j_tpu.parallel.pipeline import (
+    PipelineParallel, make_pipeline_fn, stack_stage_params,
+    split_microbatches,
+)
+from deeplearning4j_tpu.parallel.moe import (
+    MoEFeedForward, moe_ffn, top_k_gating, expert_sharding, expert_mesh,
+)
 
 __all__ = [
     "MeshSpec", "make_mesh", "device_count", "local_device_count",
     "ParallelWrapper", "ParallelInference",
     "ShardingRules", "shard_params", "replicate", "batch_sharding",
     "tensor_parallel_rules", "initialize_distributed",
+    "PipelineParallel", "make_pipeline_fn", "stack_stage_params",
+    "split_microbatches",
+    "MoEFeedForward", "moe_ffn", "top_k_gating", "expert_sharding",
+    "expert_mesh",
 ]

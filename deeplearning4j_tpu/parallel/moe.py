@@ -1,0 +1,221 @@
+"""Mixture-of-Experts with expert parallelism over the `expert` mesh axis.
+
+No reference counterpart: DL4J has no conditional-compute layers (SURVEY
+§2.4/§5 — parallelism surface is data-parallel only); this is a green-field
+TPU-scale extension required by SURVEY §7 step 7.
+
+TPU-first design (GShard/Switch-style, MXU-friendly):
+- Routing is expressed entirely as dense one-hot einsums over a FIXED
+  per-expert capacity C — no dynamic shapes, no gather/scatter loops, so XLA
+  tiles everything onto the MXU and the dispatch/combine contractions lower
+  to all_to_all over ICI when the expert axis of the parameter leaves is
+  sharded over the `expert` mesh axis (collectives are inserted by the
+  partitioner from sharding constraints — the scaling-book recipe — rather
+  than hand-written).
+- Load balancing uses the standard auxiliary loss (mean gate fraction ×
+  mean routed fraction, scaled by E); the layer reports it through the
+  state pytree under "aux_loss" and the model runtimes add it to the score
+  inside the differentiated loss closure.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.nn.activations import Activation
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
+from deeplearning4j_tpu.parallel.mesh import AXIS_EXPERT
+
+
+_ACTIVE_MESH: List[Tuple[Mesh, str]] = []
+
+
+@contextlib.contextmanager
+def expert_mesh(mesh: Mesh, axis: str = AXIS_EXPERT):
+    """Make `mesh` visible to MoEFeedForward layers traced inside the block.
+
+    The layer API has no mesh parameter (layers are mesh-agnostic pure
+    functions), so the sharding constraints that pin dispatch/combine to
+    all_to_all need a side channel. Activate this context around the call
+    that TRACES the train/inference step (fit(), make_step_fn() + jit, ...);
+    the constraint is baked into the jaxpr at trace time.
+    """
+    _ACTIVE_MESH.append((mesh, axis))
+    try:
+        yield
+    finally:
+        _ACTIVE_MESH.pop()
+
+
+def _active_expert_mesh() -> Tuple[Optional[Mesh], str]:
+    return _ACTIVE_MESH[-1] if _ACTIVE_MESH else (None, AXIS_EXPERT)
+
+
+def top_k_gating(logits, k: int, capacity: int, token_mask=None):
+    """Top-k token→expert routing with fixed expert capacity.
+
+    logits: [N, E]. Returns (combine [N, E, C], dispatch [N, E, C],
+    aux_loss scalar). Tokens overflowing an expert's capacity are dropped
+    (their combine weights are zero — residual connections carry them).
+    token_mask: optional [N] 0/1 — masked (padding) tokens are excluded from
+    routing entirely: they occupy no capacity and don't skew the aux loss.
+    """
+    n, e = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+    if token_mask is not None:
+        probs = probs * token_mask[:, None].astype(probs.dtype)
+    denom = (jnp.maximum(jnp.sum(token_mask.astype(probs.dtype)), 1.0)
+             if token_mask is not None else jnp.asarray(float(n), probs.dtype))
+    combine = jnp.zeros((n, e, capacity), probs.dtype)
+    dispatch = jnp.zeros((n, e, capacity), jnp.bool_)
+    masked = probs
+    # Occupancy accumulates across the k rounds so slot indices never collide.
+    occupancy = jnp.zeros((e,), jnp.int32)
+    fraction_routed = jnp.zeros((e,), probs.dtype)
+    for _ in range(k):
+        choice = jnp.argmax(masked, axis=-1)                     # [N]
+        onehot_raw = jax.nn.one_hot(choice, e, dtype=jnp.int32)   # [N, E]
+        # A token whose remaining probs are all zero (padding, or E < k) is
+        # out of the round: no capacity slot, no routed-fraction credit.
+        valid = jnp.max(masked, axis=-1) > 0                      # [N]
+        onehot = onehot_raw * valid[:, None].astype(jnp.int32)
+        pos = occupancy[None, :] + jnp.cumsum(onehot, axis=0) - onehot
+        pos = jnp.sum(pos * onehot, axis=-1)                      # [N]
+        keep = (pos < capacity) & valid
+        occupancy = occupancy + jnp.sum(
+            onehot * keep[:, None].astype(jnp.int32), axis=0)
+        slot = jax.nn.one_hot(pos, capacity, dtype=probs.dtype)   # [N, C]
+        gate = jnp.take_along_axis(probs, choice[:, None], axis=-1)[:, 0]
+        route = (onehot.astype(probs.dtype) * keep[:, None]
+                 )[:, :, None] * slot[:, None, :]                 # [N, E, C]
+        combine = combine + gate[:, None, None] * route
+        dispatch = dispatch | (route > 0)
+        fraction_routed = fraction_routed + jnp.sum(
+            onehot.astype(probs.dtype), axis=0) / denom
+        masked = masked * (1.0 - onehot_raw.astype(probs.dtype))
+    # Switch-transformer load-balance loss: E * <p_e> . <f_e> (per round,
+    # averaged, over VALID tokens); pushes toward uniform expert utilisation.
+    aux = e * jnp.sum(jnp.sum(probs, axis=0) / denom * fraction_routed / k)
+    return combine, dispatch.astype(probs.dtype), aux
+
+
+def moe_ffn(params: Dict[str, jax.Array], x, *, k: int = 2,
+            capacity_factor: float = 1.25,
+            activation: str = "gelu",
+            mesh: Optional[Mesh] = None,
+            axis: str = AXIS_EXPERT,
+            token_mask=None) -> Tuple[jax.Array, jax.Array]:
+    """Expert-parallel feed-forward over tokens x: [N, d] -> [N, d].
+
+    params: gate [d, E], w1 [E, d, h], b1 [E, h], w2 [E, h, d], b2 [E, d].
+    token_mask: optional [N] 0/1 validity (padding excluded from routing).
+    Returns (y, aux_loss).
+    """
+    e = params["w1"].shape[0]
+    n = x.shape[0]
+    capacity = max(1, int(capacity_factor * k * n / e))
+    act = Activation.get(activation)
+
+    logits = x @ params["gate"].astype(x.dtype)
+    combine, dispatch, aux = top_k_gating(
+        logits.astype(jnp.float32), k, capacity, token_mask=token_mask)
+    combine = combine.astype(x.dtype)
+    dispatch = dispatch.astype(x.dtype)
+
+    ex_in = jnp.einsum("nec,nd->ecd", dispatch, x)
+    if mesh is not None and axis in mesh.axis_names:
+        # Pin the expert dim so the partitioner materialises the dispatch as
+        # an all_to_all over ICI instead of replicating expert blocks.
+        ex_in = jax.lax.with_sharding_constraint(
+            ex_in, NamedSharding(mesh, P(axis)))
+    h = act(jnp.einsum("ecd,edh->ech", ex_in, params["w1"])
+            + params["b1"][:, None, :])
+    ex_out = (jnp.einsum("ech,ehd->ecd", h, params["w2"])
+              + params["b2"][:, None, :])
+    if mesh is not None and axis in mesh.axis_names:
+        ex_out = jax.lax.with_sharding_constraint(
+            ex_out, NamedSharding(mesh, P(axis)))
+    y = jnp.einsum("nec,ecd->nd", combine, ex_out)
+    return y, aux
+
+
+def expert_sharding(params: Dict[str, Any], mesh: Mesh,
+                    axis: str = AXIS_EXPERT):
+    """NamedShardings: expert-indexed leaves sharded on their E axis, gate
+    replicated."""
+    return {
+        k: NamedSharding(mesh, P() if k == "gate" else P(axis))
+        for k in params
+    }
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class MoEFeedForward(Layer):
+    """Mixture-of-experts FFN layer (d -> d, residual inside).
+
+    Pluggable into MultiLayerNetwork/ComputationGraph like any layer;
+    reports its load-balancing auxiliary loss via state["aux_loss"], which
+    the model loss closures fold into the score (weighted by aux_weight).
+    Accepts [B, d] or RNN-format [B, d, T] activations.
+    """
+
+    n_in: Optional[int] = None
+    n_experts: int = 8
+    hidden_mult: int = 4
+    k: int = 2
+    capacity_factor: float = 1.25
+    aux_weight: float = 1e-2
+    residual: bool = True
+
+    def infer_n_in(self, input_type: InputType) -> "MoEFeedForward":
+        if self.n_in is None:
+            return dataclasses.replace(self, n_in=input_type.size)
+        return self
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        d = self.n_in or input_type.size
+        h = self.hidden_mult * d
+        e = self.n_experts
+        ks = jax.random.split(key, 3)
+        winit = self._winit()
+        params = {
+            "gate": winit(ks[0], (d, e), dtype),
+            "w1": jnp.stack([winit(jax.random.fold_in(ks[1], i), (d, h), dtype)
+                             for i in range(e)]),
+            "b1": jnp.zeros((e, h), dtype),
+            "w2": jnp.stack([winit(jax.random.fold_in(ks[2], i), (h, d), dtype)
+                             for i in range(e)]),
+            "b2": jnp.zeros((e, d), dtype),
+        }
+        return params, {}
+
+    def apply(self, params, x, *, state=None, train=False, rng=None,
+              mask=None):
+        x = self._maybe_dropout(x, train, rng)
+        rnn = x.ndim == 3
+        token_mask = None
+        if rnn:  # [B, d, T] (reference RNN layout) -> tokens [B*T, d]
+            b, d, t = x.shape
+            tokens = jnp.transpose(x, (0, 2, 1)).reshape(b * t, d)
+            if mask is not None:  # [B, T] timestep mask -> [B*T]
+                token_mask = jnp.reshape(mask, (b * t,))
+        else:
+            tokens = x
+        mesh, axis = _active_expert_mesh()
+        y, aux = moe_ffn(params, tokens, k=self.k,
+                         capacity_factor=self.capacity_factor,
+                         activation=self.activation or "gelu",
+                         mesh=mesh, axis=axis, token_mask=token_mask)
+        if self.residual:
+            y = y + tokens
+        if rnn:
+            y = jnp.transpose(y.reshape(b, t, d), (0, 2, 1))
+        return y, {"aux_loss": self.aux_weight * aux}

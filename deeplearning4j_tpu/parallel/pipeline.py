@@ -1,0 +1,173 @@
+"""Pipeline parallelism — GPipe-style microbatch schedule over the `pipe`
+mesh axis.
+
+No reference counterpart: DL4J implements only data parallelism (SURVEY
+§2.4 enumerates all five flavors); pipeline parallelism is one of the
+green-field TPU-scale extensions demanded by SURVEY §7 step 7.
+
+TPU-first design:
+- Stages are STACKED: every stage has an identical parameter pytree and the
+  per-stage leaves are stacked on a leading axis that is sharded over the
+  `pipe` mesh axis. Each device therefore holds exactly its stage's weights
+  (transformer-block style; heterogeneous prologue/epilogue layers live
+  outside the pipelined trunk).
+- The schedule is a single `lax.scan` over ticks inside `shard_map`;
+  activations move stage→stage via `lax.ppermute` — a point-to-point ICI
+  transfer, not a broadcast. With B microbatches and S stages, the scan runs
+  B + S - 1 ticks (the classic GPipe fill+drain bubble).
+- Backward is *derived*: `jax.grad` through scan + ppermute yields the
+  reverse pipeline schedule automatically (ppermute's transpose is the
+  reverse permutation) — no hand-written backward pass.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.mesh import AXIS_DATA, AXIS_PIPE
+
+_tmap = jax.tree_util.tree_map
+
+
+def stack_stage_params(stage_params: Sequence[Any]):
+    """Stack S structurally-identical per-stage pytrees on a new leading
+    axis (the axis that gets sharded over `pipe`)."""
+    return _tmap(lambda *xs: jnp.stack(xs), *stage_params)
+
+
+def unstack_stage_params(stacked) -> List[Any]:
+    leaves, treedef = jax.tree_util.tree_flatten(stacked)
+    n = leaves[0].shape[0]
+    return [
+        jax.tree_util.tree_unflatten(treedef, [l[i] for l in leaves])
+        for i in range(n)
+    ]
+
+
+def stage_sharding(stacked, mesh: Mesh, axis: str = AXIS_PIPE):
+    """NamedShardings placing stage i's slice on pipe-coordinate i."""
+    return _tmap(lambda _: NamedSharding(mesh, P(axis)), stacked)
+
+
+def split_microbatches(x, n_micro: int):
+    """[batch, ...] -> [n_micro, batch/n_micro, ...]."""
+    b = x.shape[0]
+    if b % n_micro:
+        raise ValueError(f"batch {b} not divisible into {n_micro} microbatches")
+    return x.reshape((n_micro, b // n_micro) + x.shape[1:])
+
+
+def merge_microbatches(y):
+    return y.reshape((y.shape[0] * y.shape[1],) + y.shape[2:])
+
+
+def make_pipeline_fn(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                     n_stages: int, n_micro: int, mesh: Mesh, *,
+                     axis: str = AXIS_PIPE,
+                     data_axis: Optional[str] = None):
+    """Build f(stacked_params, x_mb) -> y_mb running the GPipe schedule.
+
+    stage_fn: (one stage's params, activations [mb, ...]) -> [mb, ...];
+      activation shape must be stage-invariant (uniform-trunk restriction).
+    x_mb / y_mb: [n_micro, mb, ...]. If `data_axis` is given, the per-
+      microbatch batch dim is additionally sharded over it (2-D pipe×data).
+    """
+    if n_stages < 1:
+        raise ValueError("n_stages must be >= 1")
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    total_ticks = n_micro + n_stages - 1
+
+    def local_fn(params_shard, x_mb):
+        my_params = _tmap(lambda p: p[0], params_shard)
+        stage = lax.axis_index(axis)
+
+        def tick(buf, t):
+            mb_idx = jnp.clip(t, 0, n_micro - 1)
+            feed = lax.dynamic_index_in_dim(x_mb, mb_idx, keepdims=False)
+            inp = jnp.where(stage == 0, feed, buf)
+            out = stage_fn(my_params, inp)
+            nxt = lax.ppermute(out, axis, perm)
+            return nxt, out
+
+        # Mark the carry as device-varying over `pipe` (jax 0.9 vma typing:
+        # the ppermute output is varying, so the initial carry must be too).
+        buf0 = lax.pcast(jnp.zeros_like(x_mb[0]), (axis,), to="varying")
+        _, outs = lax.scan(tick, buf0, jnp.arange(total_ticks))
+        # Last stage's outputs for microbatch m appear at tick m + S - 1.
+        tail = lax.dynamic_slice_in_dim(outs, n_stages - 1, n_micro, axis=0)
+        mask = (stage == n_stages - 1).astype(tail.dtype)
+        return lax.psum(tail * mask, axis)
+
+    in_x = P(None, data_axis) if data_axis else P()
+    out_y = P(None, data_axis) if data_axis else P()
+    return shard_map(local_fn, mesh=mesh,
+                     in_specs=(P(axis), in_x), out_specs=out_y)
+
+
+class PipelineParallel:
+    """High-level wrapper: owns stacked stage params + a train step.
+
+    Analogue of the role ParallelWrapper plays for DP
+    (`parallelism/ParallelWrapper.java:409`), but for a pipelined trunk: the
+    user supplies one `stage_fn` and S per-stage param trees; `fit_batch`
+    runs forward+backward+update as ONE jitted sharded computation.
+    """
+
+    def __init__(self, stage_fn, stage_params: Sequence[Any], mesh: Mesh, *,
+                 loss_fn: Callable[[jax.Array, jax.Array], jax.Array],
+                 updater=None, n_micro: int = 4, axis: str = AXIS_PIPE,
+                 data_axis: Optional[str] = None):
+        from deeplearning4j_tpu.optim.updaters import Sgd
+
+        self.mesh = mesh
+        self.axis = axis
+        self.n_stages = len(stage_params)
+        self.n_micro = n_micro
+        self.loss_fn = loss_fn
+        self.updater = updater or Sgd(1e-2)
+        stacked = stack_stage_params(stage_params)
+        self.params = jax.device_put(stacked, stage_sharding(stacked, mesh, axis))
+        # Optimizer state is zeros_like(params): every leaf carries the stage
+        # dim leading, so one prefix spec shards the whole (differently
+        # shaped) state tree.
+        opt = self.updater.init(self.params)
+        self.opt_state = (jax.device_put(opt, NamedSharding(mesh, P(axis)))
+                          if jax.tree_util.tree_leaves(opt) else opt)
+        self._fwd = make_pipeline_fn(stage_fn, self.n_stages, n_micro, mesh,
+                                     axis=axis, data_axis=data_axis)
+        self._step = None
+
+    def forward(self, x):
+        y = self._fwd(self.params, split_microbatches(x, self.n_micro))
+        return merge_microbatches(y)
+
+    def _build_step(self):
+        fwd, loss_fn, updater = self._fwd, self.loss_fn, self.updater
+
+        def step(params, opt_state, it, x_mb, y_mb):
+            def objective(p):
+                pred = fwd(p, x_mb)
+                return loss_fn(pred, y_mb)
+
+            loss, grads = jax.value_and_grad(objective)(params)
+            upd, new_opt = updater.apply(grads, opt_state, params, it)
+            new_params = _tmap(lambda a, b: a - b.astype(a.dtype), params, upd)
+            return new_params, new_opt, loss
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def fit_batch(self, x, y, it: int = 0) -> float:
+        if self._step is None:
+            self._step = self._build_step()
+        x_mb = split_microbatches(jnp.asarray(x), self.n_micro)
+        y_mb = split_microbatches(jnp.asarray(y), self.n_micro)
+        self.params, self.opt_state, loss = self._step(
+            self.params, self.opt_state, jnp.asarray(it, jnp.int32),
+            x_mb, y_mb)
+        return float(loss)

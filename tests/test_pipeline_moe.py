@@ -1,0 +1,277 @@
+"""Pipeline parallelism + mixture-of-experts tests (8-device CPU mesh).
+
+These are green-field TPU-scale extensions (SURVEY §7 step 7 — the
+reference's parallelism surface is data-parallel only, SURVEY §2.4), so the
+correctness oracle is internal: pipelined/expert-parallel execution must
+match the plain sequential computation.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.parallel import make_mesh
+from deeplearning4j_tpu.parallel.mesh import AXIS_EXPERT, AXIS_PIPE
+from deeplearning4j_tpu.parallel.moe import (
+    MoEFeedForward, expert_sharding, moe_ffn, top_k_gating,
+)
+from deeplearning4j_tpu.parallel.pipeline import (
+    PipelineParallel, make_pipeline_fn, merge_microbatches,
+    split_microbatches, stack_stage_params, unstack_stage_params,
+)
+
+
+def _dense_stage(params, x):
+    return jnp.tanh(x @ params["w"] + params["b"])
+
+
+def _make_stages(n_stages, d, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        {"w": jnp.asarray(rng.standard_normal((d, d)) / np.sqrt(d),
+                          jnp.float32),
+         "b": jnp.asarray(rng.standard_normal((d,)) * 0.1, jnp.float32)}
+        for _ in range(n_stages)
+    ]
+
+
+def _sequential(stages, x):
+    for p in stages:
+        x = _dense_stage(p, x)
+    return x
+
+
+class TestPipeline:
+    def test_forward_matches_sequential(self, devices8):
+        n_stages, n_micro, d = 4, 8, 16
+        mesh = make_mesh({AXIS_PIPE: n_stages}, devices=devices8[:n_stages])
+        stages = _make_stages(n_stages, d)
+        fn = make_pipeline_fn(_dense_stage, n_stages, n_micro, mesh)
+        x = jnp.asarray(
+            np.random.default_rng(1).standard_normal((32, d)), jnp.float32)
+        y = merge_microbatches(
+            jax.jit(fn)(stack_stage_params(stages),
+                        split_microbatches(x, n_micro)))
+        np.testing.assert_allclose(
+            np.asarray(y), np.asarray(_sequential(stages, x)),
+            rtol=1e-5, atol=1e-5)
+
+    def test_grads_match_sequential(self, devices8):
+        n_stages, n_micro, d = 4, 4, 8
+        mesh = make_mesh({AXIS_PIPE: n_stages}, devices=devices8[:n_stages])
+        stages = _make_stages(n_stages, d, seed=2)
+        stacked = stack_stage_params(stages)
+        fn = make_pipeline_fn(_dense_stage, n_stages, n_micro, mesh)
+        x = jnp.asarray(
+            np.random.default_rng(3).standard_normal((16, d)), jnp.float32)
+        tgt = jnp.ones((16, d), jnp.float32)
+
+        def piped_loss(p):
+            y = merge_microbatches(fn(p, split_microbatches(x, n_micro)))
+            return jnp.mean((y - tgt) ** 2)
+
+        def seq_loss(stage_list):
+            return jnp.mean((_sequential(stage_list, x) - tgt) ** 2)
+
+        lp, gp = jax.value_and_grad(piped_loss)(stacked)
+        ls, gs = jax.value_and_grad(seq_loss)(stages)
+        np.testing.assert_allclose(float(lp), float(ls), rtol=1e-5)
+        gs_stacked = stack_stage_params(gs)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-5),
+            gp, gs_stacked)
+
+    def test_pipe_times_data_mesh(self, devices8):
+        """2-D pipe×data mesh: microbatch batch dim sharded over `data`."""
+        n_stages, n_micro, d = 4, 4, 8
+        mesh = make_mesh({AXIS_PIPE: n_stages, "data": 2},
+                         devices=devices8[:8])
+        stages = _make_stages(n_stages, d, seed=4)
+        fn = make_pipeline_fn(_dense_stage, n_stages, n_micro, mesh,
+                              data_axis="data")
+        x = jnp.asarray(
+            np.random.default_rng(5).standard_normal((16, d)), jnp.float32)
+        y = merge_microbatches(
+            jax.jit(fn)(stack_stage_params(stages),
+                        split_microbatches(x, n_micro)))
+        np.testing.assert_allclose(
+            np.asarray(y), np.asarray(_sequential(stages, x)),
+            rtol=1e-5, atol=1e-5)
+
+    def test_trainer_reduces_loss(self, devices8):
+        from deeplearning4j_tpu.optim.updaters import Adam
+
+        n_stages, d = 4, 8
+        mesh = make_mesh({AXIS_PIPE: n_stages}, devices=devices8[:n_stages])
+        pp = PipelineParallel(
+            _dense_stage, _make_stages(n_stages, d, seed=6), mesh,
+            loss_fn=lambda pred, y: jnp.mean((pred - y) ** 2),
+            updater=Adam(1e-2), n_micro=4)
+        rng = np.random.default_rng(7)
+        x = rng.standard_normal((16, d)).astype(np.float32)
+        y = np.tanh(x @ rng.standard_normal((d, d)).astype(np.float32))
+        first = pp.fit_batch(x, y, 0)
+        last = first
+        for i in range(1, 30):
+            last = pp.fit_batch(x, y, i)
+        assert last < 0.5 * first, (first, last)
+
+    def test_stack_unstack_roundtrip(self):
+        stages = _make_stages(3, 4)
+        back = unstack_stage_params(stack_stage_params(stages))
+        for a, b in zip(stages, back):
+            np.testing.assert_array_equal(np.asarray(a["w"]),
+                                          np.asarray(b["w"]))
+
+
+class TestMoE:
+    def test_gating_respects_capacity_and_topk(self):
+        rng = np.random.default_rng(0)
+        logits = jnp.asarray(rng.standard_normal((32, 4)), jnp.float32)
+        combine, dispatch, aux = top_k_gating(logits, k=2, capacity=8)
+        # each token uses at most k expert slots
+        per_token = np.asarray(jnp.sum(dispatch > 0, axis=(1, 2)))
+        assert per_token.max() <= 2
+        # capacity respected per expert
+        per_expert = np.asarray(jnp.sum(dispatch > 0, axis=(0, 2)))
+        assert per_expert.max() <= 8
+        # no slot double-booked
+        per_slot = np.asarray(jnp.sum(dispatch, axis=0))
+        assert per_slot.max() <= 1.0 + 1e-6
+        assert float(aux) > 0
+
+    def test_moe_ffn_identity_routing(self):
+        """With ample capacity, each routed token's output is the gate-
+        weighted sum of its experts' FFN — check vs direct computation."""
+        rng = np.random.default_rng(1)
+        d, h, e, n = 6, 12, 4, 16
+        params = {
+            "gate": jnp.asarray(rng.standard_normal((d, e)), jnp.float32),
+            "w1": jnp.asarray(rng.standard_normal((e, d, h)) * 0.1,
+                              jnp.float32),
+            "b1": jnp.zeros((e, h), jnp.float32),
+            "w2": jnp.asarray(rng.standard_normal((e, h, d)) * 0.1,
+                              jnp.float32),
+            "b2": jnp.zeros((e, d), jnp.float32),
+        }
+        x = jnp.asarray(rng.standard_normal((n, d)), jnp.float32)
+        y, aux = moe_ffn(params, x, k=1, capacity_factor=4.0,
+                         activation="relu")
+        # direct: every token goes to its argmax expert with softmax gate
+        probs = jax.nn.softmax(x @ params["gate"], axis=-1)
+        choice = jnp.argmax(probs, axis=-1)
+        expect = []
+        for i in range(n):
+            ei = int(choice[i])
+            hdn = jax.nn.relu(x[i] @ params["w1"][ei] + params["b1"][ei])
+            expect.append(float(probs[i, ei]) *
+                          (hdn @ params["w2"][ei] + params["b2"][ei]))
+        np.testing.assert_allclose(np.asarray(y), np.asarray(jnp.stack(expect)),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_expert_parallel_matches_single_device(self, devices8):
+        rng = np.random.default_rng(2)
+        d, h, e, n = 8, 16, 8, 64
+        params = {
+            "gate": jnp.asarray(rng.standard_normal((d, e)), jnp.float32),
+            "w1": jnp.asarray(rng.standard_normal((e, d, h)) * 0.1,
+                              jnp.float32),
+            "b1": jnp.zeros((e, h), jnp.float32),
+            "w2": jnp.asarray(rng.standard_normal((e, h, d)) * 0.1,
+                              jnp.float32),
+            "b2": jnp.zeros((e, d), jnp.float32),
+        }
+        x = jnp.asarray(rng.standard_normal((n, d)), jnp.float32)
+        ref, _ = moe_ffn(params, x, k=2)
+
+        mesh = make_mesh({AXIS_EXPERT: 8}, devices=devices8)
+        sharded = jax.device_put(params, expert_sharding(params, mesh))
+
+        @jax.jit
+        def run(p, xx):
+            y, aux = moe_ffn(p, xx, k=2, mesh=mesh)
+            return y
+
+        np.testing.assert_allclose(np.asarray(run(sharded, x)),
+                                   np.asarray(ref), rtol=1e-4, atol=1e-5)
+
+    def test_moe_layer_in_network_trains(self):
+        from deeplearning4j_tpu.models import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.inputs import InputType
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.optim.updaters import Adam
+
+        net = MultiLayerNetwork(
+            (NeuralNetConfiguration.builder()
+             .seed(0).updater(Adam(1e-2)).activation("relu")
+             .list(DenseLayer(n_out=16),
+                   MoEFeedForward(n_experts=4, k=2, hidden_mult=2),
+                   OutputLayer(n_out=3, activation="softmax"))
+             .set_input_type(InputType.feed_forward(8))
+             .build())).init()
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((64, 8)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 64)]
+        net.fit(x, y, epochs=1, batch_size=32)
+        first = net.score_
+        net.fit(x, y, epochs=20, batch_size=32)
+        assert net.score_ < first
+
+    def test_gating_token_mask_excludes_padding(self):
+        rng = np.random.default_rng(4)
+        logits = jnp.asarray(rng.standard_normal((16, 4)), jnp.float32)
+        mask = jnp.asarray([1] * 8 + [0] * 8, jnp.float32)
+        combine, dispatch, aux = top_k_gating(logits, k=2, capacity=8,
+                                              token_mask=mask)
+        # padded tokens routed nowhere, occupy no capacity
+        assert float(jnp.sum(dispatch[8:])) == 0
+        assert float(jnp.sum(combine[8:])) == 0
+        # aux loss matches gating over just the valid tokens
+        _, _, aux_valid = top_k_gating(logits[:8], k=2, capacity=8)
+        np.testing.assert_allclose(float(aux), float(aux_valid), rtol=1e-5)
+
+    def test_expert_mesh_context_reaches_layer(self, devices8):
+        """MoEFeedForward traced under expert_mesh() must bake the sharding
+        constraints and still match unsharded execution."""
+        from deeplearning4j_tpu.models import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.inputs import InputType
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.parallel.moe import expert_mesh
+
+        mesh = make_mesh({AXIS_EXPERT: 8}, devices=devices8)
+        net = MultiLayerNetwork(
+            (NeuralNetConfiguration.builder()
+             .seed(0).activation("relu")
+             .list(DenseLayer(n_out=16),
+                   MoEFeedForward(n_experts=8, k=2, hidden_mult=2),
+                   OutputLayer(n_out=3, activation="softmax"))
+             .set_input_type(InputType.feed_forward(8))
+             .build())).init()
+        x = np.random.default_rng(5).standard_normal((32, 8)).astype(
+            np.float32)
+        base = np.asarray(net.output(x))
+        with expert_mesh(mesh):
+            sharded = np.asarray(net.output(x))
+        np.testing.assert_allclose(sharded, base, rtol=1e-4, atol=1e-6)
+
+    def test_moe_layer_serde_roundtrip(self):
+        from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.inputs import InputType
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.nn.config import MultiLayerConfiguration
+
+        conf = (NeuralNetConfiguration.builder()
+                .seed(0).activation("relu")
+                .list(DenseLayer(n_out=16),
+                      MoEFeedForward(n_experts=4, k=1),
+                      OutputLayer(n_out=3, activation="softmax"))
+                .set_input_type(InputType.feed_forward(8))
+                .build())
+        j = conf.to_json()
+        back = MultiLayerConfiguration.from_json(j)
+        assert isinstance(back.layers[1], MoEFeedForward)
+        assert back.layers[1].n_experts == 4
