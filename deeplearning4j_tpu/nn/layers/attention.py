@@ -67,9 +67,16 @@ class MultiHeadAttention(Layer):
             return (x @ w).reshape(B, T, H, Dh)
 
         q, k, v = split(params["Wq"]), split(params["Wk"]), split(params["Wv"])
-        if mask is not None and not self.causal:
-            # Padding mask: large negative bias on masked keys before softmax.
-            o = self._masked_attention(q, k, v, mask)
+        if mask is not None:
+            # Padding mask: large negative bias on masked keys before softmax
+            # (combined with the causal band when both apply).
+            o = self._masked_attention(q, k, v, mask, self.causal)
+        elif (not train and jax.default_backend() == "tpu" and T % 128 == 0):
+            # Fused blockwise kernel (ops/attention.py), inference only: its
+            # backward is a dense recompute, so training keeps the XLA path.
+            from deeplearning4j_tpu.ops.attention import flash_attention
+
+            o = flash_attention(q, k, v, self.causal)
         else:
             o = attention(q, k, v, causal=self.causal)
         o = o.reshape(B, T, self.n_out)
@@ -77,9 +84,13 @@ class MultiHeadAttention(Layer):
         return self._act(y), state
 
     @staticmethod
-    def _masked_attention(q, k, v, mask):
+    def _masked_attention(q, k, v, mask, causal=False):
         d = q.shape[-1]
         s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(d)
         bias = jnp.where(mask[:, None, None, :] > 0, 0.0, -1e30)
+        if causal:
+            t = s.shape[-1]
+            band = jnp.tril(jnp.ones((t, t), jnp.bool_))
+            bias = bias + jnp.where(band[None, None], 0.0, -1e30)
         p = jax.nn.softmax(s + bias, axis=-1)
         return jnp.einsum("bhqk,bkhd->bqhd", p, v)

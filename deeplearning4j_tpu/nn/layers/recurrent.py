@@ -70,6 +70,10 @@ class LSTM(BaseRecurrentLayer):
     `LSTMHelpers.activateHelper` with peephole=false. Gate order i,f,g,o."""
 
     peephole: bool = False
+    # Fused Pallas sequence kernel (ops/lstm.py — the LSTMHelpers-equivalent
+    # fusion, SURVEY §7): None = auto (on TPU when gate/cell activations are
+    # the standard sigmoid/tanh), True/False = force.
+    fused: Optional[bool] = None
 
     def init_params(self, key, input_type, dtype=jnp.float32):
         h = self.n_out
@@ -88,6 +92,21 @@ class LSTM(BaseRecurrentLayer):
     def initial_carry(self, batch: int, dtype=jnp.float32):
         h = self.n_out
         return {"h": jnp.zeros((batch, h), dtype), "c": jnp.zeros((batch, h), dtype)}
+
+    def _use_fused(self) -> bool:
+        from deeplearning4j_tpu.ops.lstm import fused_lstm_available
+
+        # NB: activation=None means IDENTITY (Activation.get(None)), not
+        # tanh — the kernel hard-codes sigmoid/tanh, so require them exactly.
+        ok = fused_lstm_available(self.gate_activation, self.activation)
+        if self.fused is not None:
+            if self.fused and not ok:
+                raise ValueError(
+                    f"fused=True requires gate_activation='sigmoid' and "
+                    f"activation='tanh'; got {self.gate_activation!r}/"
+                    f"{self.activation!r}")
+            return self.fused
+        return ok and jax.default_backend() == "tpu"
 
     def _step(self, params, carry, xw_t, m_t):
         """One scan step. xw_t: precomputed x_t @ W + b, [B, 4H]."""
@@ -121,6 +140,18 @@ class LSTM(BaseRecurrentLayer):
         xw = x.reshape(B * T, -1) @ params["W"] + params["b"]
         xw = xw.reshape(B, T, -1).transpose(1, 0, 2)  # [T, B, 4H]
         m = None if mask is None else mask.astype(x.dtype).T  # [T, B]
+
+        if self._use_fused():
+            from deeplearning4j_tpu.ops.lstm import fused_lstm
+
+            p = params.get("P")
+            if p is None:
+                p = jnp.zeros((3, self.n_out), x.dtype)
+            mm = m if m is not None else jnp.ones((T, B), x.dtype)
+            hs, hT, cT = fused_lstm(
+                xw, params["RW"], p, carry["h"], carry["c"], mm,
+                jax.default_backend() != "tpu")
+            return hs.transpose(1, 0, 2), {"h": hT, "c": cT}
 
         def step(c, inp):
             xw_t, m_t = inp

@@ -1,0 +1,162 @@
+"""Blockwise fused attention kernel (Pallas TPU).
+
+No reference counterpart (DL4J predates attention — SURVEY §5 "no attention
+layers at all"); this backs the framework's transformer extension
+(`nn/layers/attention.py`, `parallel/ring_attention.py`) the way cuDNN
+helpers backed conv layers in the reference (SURVEY §2.3 seam).
+
+Design: classic flash-attention forward — grid over (batch·heads, q blocks);
+K/V stream through VMEM in blocks under a fori_loop carrying the online
+softmax statistics (running max m, normalizer l), so the [T, T] score matrix
+is never materialized in HBM. Causal masking skips fully-masked K blocks'
+contribution via block-index comparison. The backward pass recomputes
+attention with XLA (rematerialization — the standard flash trade: O(T)
+memory for extra FLOPs) via `jax.custom_vjp`.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _dense_attention(q, k, v, causal: bool, scale: float):
+    """Reference O(T^2) attention used for the recompute backward."""
+    scores = jnp.einsum("bqd,bkd->bqk", q, k) * scale
+    if causal:
+        tq, tk = scores.shape[-2], scores.shape[-1]
+        mask = jnp.tril(jnp.ones((tq, tk), jnp.bool_))
+        scores = jnp.where(mask[None], scores, _NEG_INF)
+    w = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bqk,bkd->bqd", w, v)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
+                  scale: float):
+    qb = pl.program_id(1)
+    q = q_ref[0]                                  # [Bq, D]
+    bq, d = q.shape
+    t = k_ref.shape[1]
+    nk = t // block_k
+
+    def body(kb, carry):
+        acc, m_prev, l_prev = carry
+        k = k_ref[0, pl.ds(kb * block_k, block_k), :]        # [Bk, D]
+        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
+        prec = (jax.lax.Precision.HIGHEST if q.dtype == jnp.float32
+                else jax.lax.Precision.DEFAULT)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
+                    precision=prec) * scale
+        if causal:
+            q_ids = (qb * bq
+                     + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0))
+            k_ids = (kb * block_k
+                     + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1))
+            s = jnp.where(q_ids >= k_ids, s, _NEG_INF)
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * alpha + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32,
+            precision=prec)
+        return acc, m_new, l_new
+
+    if causal:
+        # K blocks strictly after this Q block's last row contribute nothing.
+        nk_eff = jnp.minimum(nk, (qb + 1) * bq // block_k
+                             + ((qb + 1) * bq % block_k != 0).astype(jnp.int32))
+    else:
+        nk_eff = nk
+    acc0 = jnp.zeros((bq, d), jnp.float32)
+    m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, nk_eff, body, (acc0, m0, l0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+def _run_flash(q, k, v, *, causal: bool, scale: float, block_q: int,
+               block_k: int, interpret: bool):
+    bh, t, d = q.shape
+    block_q = min(block_q, t)
+    block_k = min(block_k, t)
+    if t % block_q or t % block_k:
+        raise ValueError(f"seq len {t} not divisible by blocks "
+                         f"({block_q}, {block_k})")
+    kernel = functools.partial(_flash_kernel, block_k=block_k, causal=causal,
+                               scale=scale)
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, t // block_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        interpret=interpret,
+    )(q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None, block_q: int = 128,
+                    block_k: int = 128, interpret: bool = False):
+    """Fused attention. q/k/v: [B, T, H, D] or [BH, T, D]; returns same
+    layout.
+
+    Forward saves only q/k/v (O(T) residual memory). The backward, however,
+    is currently a DENSE recompute via XLA — it materializes the [T, T]
+    scores again — so for training at long T prefer the plain XLA path (the
+    MultiHeadAttention layer auto-uses this kernel for inference only); a
+    blockwise Pallas backward is future work."""
+    s = scale if scale is not None else q.shape[-1] ** -0.5
+    mh = q.ndim == 4
+    if mh:
+        b, t, h, d = q.shape
+        fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+        q3, k3, v3 = fold(q), fold(k), fold(v)
+    else:
+        q3, k3, v3 = q, k, v
+    o = _run_flash(q3, k3, v3, causal=causal, scale=s, block_q=block_q,
+                   block_k=block_k, interpret=interpret)
+    if mh:
+        o = o.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    return (flash_attention(q, k, v, causal, scale, block_q, block_k,
+                            interpret),
+            (q, k, v))
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, res, do):
+    q, k, v = res
+    s = scale if scale is not None else q.shape[-1] ** -0.5
+    mh = q.ndim == 4
+    if mh:
+        b, t, h, d = q.shape
+        fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+        unfold = lambda x: x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+        q3, k3, v3, do3 = fold(q), fold(k), fold(v), fold(do)
+    else:
+        q3, k3, v3, do3 = q, k, v, do
+    _, vjp = jax.vjp(
+        lambda qq, kk, vv: _dense_attention(qq, kk, vv, causal, s),
+        q3, k3, v3)
+    dq, dk, dv = vjp(do3)
+    if mh:
+        dq, dk, dv = unfold(dq), unfold(dk), unfold(dv)
+    return dq, dk, dv
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)

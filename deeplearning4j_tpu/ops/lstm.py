@@ -1,0 +1,280 @@
+"""Fused LSTM sequence kernel (Pallas TPU).
+
+Reference parity: `nn/layers/recurrent/LSTMHelpers.java` — the hand-fused
+forward (`:62`) and backward (`:291`) passes DL4J wrote because eager
+op-at-a-time execution of the recurrence was too slow; SURVEY §7 names the
+fused LSTM cell as the framework's Pallas obligation.
+
+Design:
+- The big input projection x@W+b for ALL timesteps happens OUTSIDE the
+  kernel as one [B*T, F]@[F, 4H] MXU matmul (XLA's strength). The kernel
+  fuses what XLA cannot: the sequential recurrence. It runs a grid over
+  timesteps keeping h/c resident in VMEM scratch, so each step is one
+  small [B,H]@[H,4H] MXU matmul plus VPU gate math — no HBM round-trip for
+  the carry between steps, no per-step kernel launch.
+- Backward is a hand-written reverse-time Pallas kernel wired up via
+  `jax.custom_vjp`, accumulating dRW/dP in VMEM scratch across the grid
+  (the moral equivalent of LSTMHelpers' backpropGradientHelper). dW/dx/db
+  fall out of autodiff OUTSIDE the kernel since xw is the custom-vjp input.
+- Gate order i,f,g,o; sigmoid gates, tanh cell — matching
+  `layers/recurrent.py` (which matches GravesLSTMParamInitializer).
+  Peepholes (GravesLSTM) are supported branch-free: P=zeros disables them.
+- Per-timestep masking holds the carry where mask==0 (reference
+  variable-length semantics).
+
+On non-TPU backends the kernels run in interpret mode (tests) or layers
+fall back to the lax.scan path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def fused_lstm_available(gate_activation: str, activation: str) -> bool:
+    return gate_activation == "sigmoid" and activation == "tanh"
+
+
+def _sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+# --------------------------------------------------------------- forward
+def _fwd_kernel(xw_ref, rw_ref, p_ref, h0_ref, c0_ref, m_ref,
+                hs_ref, cs_ref, gates_ref, hT_ref, cT_ref,
+                h_scr, c_scr):
+    t = pl.program_id(0)
+    T = pl.num_programs(0)
+
+    @pl.when(t == 0)
+    def _():
+        h_scr[:] = h0_ref[:]
+        c_scr[:] = c0_ref[:]
+
+    h_prev = h_scr[:]
+    c_prev = c_scr[:]
+    rw = rw_ref[:]
+    p = p_ref[:]
+    hsz = h_prev.shape[-1]
+
+    gates = xw_ref[0] + jnp.dot(h_prev, rw, preferred_element_type=h_prev.dtype)
+    i_pre = gates[:, :hsz] + c_prev * p[0:1, :]
+    f_pre = gates[:, hsz:2 * hsz] + c_prev * p[1:2, :]
+    g_pre = gates[:, 2 * hsz:3 * hsz]
+    i = _sigmoid(i_pre)
+    f = _sigmoid(f_pre)
+    g = jnp.tanh(g_pre)
+    c_new = f * c_prev + i * g
+    o_pre = gates[:, 3 * hsz:] + c_new * p[2:3, :]
+    o = _sigmoid(o_pre)
+    h_new = o * jnp.tanh(c_new)
+
+    m = jnp.transpose(m_ref[pl.ds(t, 1), :])    # [B, 1]
+    h = m * h_new + (1.0 - m) * h_prev
+    c = m * c_new + (1.0 - m) * c_prev
+
+    h_scr[:] = h
+    c_scr[:] = c
+    hs_ref[0] = h
+    cs_ref[0] = c
+    gates_ref[0] = jnp.concatenate([i, f, g, o], axis=-1)
+
+    @pl.when(t == T - 1)
+    def _():
+        hT_ref[:] = h
+        cT_ref[:] = c
+
+
+def _run_forward(xw, rw, p, h0, c0, mask, *, interpret: bool):
+    T, B, H4 = xw.shape
+    H = H4 // 4
+    dt = xw.dtype
+    out_shape = (
+        jax.ShapeDtypeStruct((T, B, H), dt),    # hs
+        jax.ShapeDtypeStruct((T, B, H), dt),    # cs
+        jax.ShapeDtypeStruct((T, B, H4), dt),   # activated gates
+        jax.ShapeDtypeStruct((B, H), dt),       # h_T
+        jax.ShapeDtypeStruct((B, H), dt),       # c_T
+    )
+    return pl.pallas_call(
+        _fwd_kernel,
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((1, B, H4), lambda t: (t, 0, 0)),
+            pl.BlockSpec((H, H4), lambda t: (0, 0)),
+            pl.BlockSpec((3, H), lambda t: (0, 0)),
+            pl.BlockSpec((B, H), lambda t: (0, 0)),
+            pl.BlockSpec((B, H), lambda t: (0, 0)),
+            pl.BlockSpec((T, B), lambda t: (0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, B, H), lambda t: (t, 0, 0)),
+            pl.BlockSpec((1, B, H), lambda t: (t, 0, 0)),
+            pl.BlockSpec((1, B, H4), lambda t: (t, 0, 0)),
+            pl.BlockSpec((B, H), lambda t: (0, 0)),
+            pl.BlockSpec((B, H), lambda t: (0, 0)),
+        ],
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((B, H), dt),
+            pltpu.VMEM((B, H), dt),
+        ],
+        interpret=interpret,
+    )(xw, rw, p, h0, c0, mask)
+
+
+# -------------------------------------------------------------- backward
+def _bwd_kernel(dhs_ref, gates_ref, cs_ref, csp_ref, hsp_ref, rw_ref, p_ref,
+                m_ref, dhT_ref, dcT_ref, h0_ref, c0_ref,
+                dxw_ref, dh0_ref, dc0_ref, drw_ref, dp_ref,
+                dh_scr, dc_scr, drw_scr, dp_scr):
+    idx = pl.program_id(0)
+    T = pl.num_programs(0)
+
+    @pl.when(idx == 0)
+    def _():
+        dh_scr[:] = dhT_ref[:]
+        dc_scr[:] = dcT_ref[:]
+        drw_scr[:] = jnp.zeros_like(drw_scr)
+        dp_scr[:] = jnp.zeros_like(dp_scr)
+
+    gates = gates_ref[0]
+    hsz = gates.shape[-1] // 4
+    i = gates[:, :hsz]
+    f = gates[:, hsz:2 * hsz]
+    g = gates[:, 2 * hsz:3 * hsz]
+    o = gates[:, 3 * hsz:]
+    c_t = cs_ref[0]
+    # csp/hsp alias cs/hs with a t-1 index map (clamped at 0); the true t=0
+    # predecessors are the initial carry.
+    t_is_0 = idx == T - 1
+    c_prev = jnp.where(t_is_0, c0_ref[:], csp_ref[0])
+    h_prev = jnp.where(t_is_0, h0_ref[:], hsp_ref[0])
+    p = p_ref[:]
+    m = jnp.transpose(m_ref[pl.ds(T - 1 - idx, 1), :])   # [B, 1]
+
+    dh_in = dhs_ref[0] + dh_scr[:]
+    dh_t = m * dh_in            # grad into the freshly computed h at step t
+    pass_h = (1.0 - m) * dh_in  # grad flowing straight to h_{t-1} (mask hold)
+
+    tanh_c = jnp.tanh(c_t)
+    do_pre = dh_t * tanh_c * o * (1.0 - o)
+    dc_new = (m * dc_scr[:] + dh_t * o * (1.0 - tanh_c * tanh_c)
+              + do_pre * p[2:3, :])
+    di_pre = dc_new * g * i * (1.0 - i)
+    df_pre = dc_new * c_prev * f * (1.0 - f)
+    dg_pre = dc_new * i * (1.0 - g * g)
+    dc_prev = (dc_new * f + (1.0 - m) * dc_scr[:]
+               + di_pre * p[0:1, :] + df_pre * p[1:2, :])
+
+    dgates = jnp.concatenate([di_pre, df_pre, dg_pre, do_pre], axis=-1)
+    dh_prev = jnp.dot(dgates, rw_ref[:].T,
+                      preferred_element_type=dgates.dtype) + pass_h
+
+    dxw_ref[0] = dgates
+    drw_scr[:] = drw_scr[:] + jnp.dot(
+        h_prev.T, dgates, preferred_element_type=dgates.dtype)
+    dp_scr[0:1, :] = dp_scr[0:1, :] + jnp.sum(di_pre * c_prev, axis=0,
+                                               keepdims=True)
+    dp_scr[1:2, :] = dp_scr[1:2, :] + jnp.sum(df_pre * c_prev, axis=0,
+                                              keepdims=True)
+    dp_scr[2:3, :] = dp_scr[2:3, :] + jnp.sum(do_pre * c_t, axis=0,
+                                              keepdims=True)
+    dh_scr[:] = dh_prev
+    dc_scr[:] = dc_prev
+
+    @pl.when(idx == T - 1)
+    def _():
+        dh0_ref[:] = dh_scr[:]
+        dc0_ref[:] = dc_scr[:]
+        drw_ref[:] = drw_scr[:]
+        dp_ref[:] = dp_scr[:]
+
+
+def _run_backward(res, dhs, dhT, dcT, *, interpret: bool):
+    rw, p, mask, hs, cs, gates, h0, c0 = res
+    T, B, H = hs.shape
+    H4 = 4 * H
+    dt = hs.dtype
+    rev = lambda t: (T - 1 - t, 0, 0)
+    # Previous-step blocks read from hs/cs themselves (no shifted copies):
+    # grid step i handles t = T-1-i and wants index t-1, clamped at 0 (the
+    # clamped read is discarded in-kernel in favour of h0/c0).
+    rev_prev = lambda t: (jnp.maximum(T - 2 - t, 0), 0, 0)
+    out_shape = (
+        jax.ShapeDtypeStruct((T, B, H4), dt),   # dxw
+        jax.ShapeDtypeStruct((B, H), dt),       # dh0
+        jax.ShapeDtypeStruct((B, H), dt),       # dc0
+        jax.ShapeDtypeStruct((H, H4), dt),      # dRW
+        jax.ShapeDtypeStruct((3, H), dt),       # dP
+    )
+    return pl.pallas_call(
+        _bwd_kernel,
+        grid=(T,),
+        in_specs=[
+            pl.BlockSpec((1, B, H), rev),       # dhs
+            pl.BlockSpec((1, B, H4), rev),      # gates
+            pl.BlockSpec((1, B, H), rev),       # cs
+            pl.BlockSpec((1, B, H), rev_prev),  # cs at t-1
+            pl.BlockSpec((1, B, H), rev_prev),  # hs at t-1
+            pl.BlockSpec((H, H4), lambda t: (0, 0)),
+            pl.BlockSpec((3, H), lambda t: (0, 0)),
+            pl.BlockSpec((T, B), lambda t: (0, 0)),   # mask (full)
+            pl.BlockSpec((B, H), lambda t: (0, 0)),   # dh_T
+            pl.BlockSpec((B, H), lambda t: (0, 0)),   # dc_T
+            pl.BlockSpec((B, H), lambda t: (0, 0)),   # h0
+            pl.BlockSpec((B, H), lambda t: (0, 0)),   # c0
+        ],
+        out_specs=[
+            pl.BlockSpec((1, B, H4), rev),
+            pl.BlockSpec((B, H), lambda t: (0, 0)),
+            pl.BlockSpec((B, H), lambda t: (0, 0)),
+            pl.BlockSpec((H, H4), lambda t: (0, 0)),
+            pl.BlockSpec((3, H), lambda t: (0, 0)),
+        ],
+        out_shape=out_shape,
+        scratch_shapes=[
+            pltpu.VMEM((B, H), dt),
+            pltpu.VMEM((B, H), dt),
+            pltpu.VMEM((H, H4), dt),
+            pltpu.VMEM((3, H), dt),
+        ],
+        interpret=interpret,
+    )(dhs, gates, cs, cs, hs, rw, p, mask, dhT, dcT, h0, c0)
+
+
+# ------------------------------------------------------------ public op
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6,))
+def fused_lstm(xw, rw, p, h0, c0, mask, interpret=False):
+    """Fused LSTM over a whole sequence.
+
+    xw:   [T, B, 4H] precomputed x@W + b (gate order i,f,g,o)
+    rw:   [H, 4H] recurrent weights; p: [3, H] peepholes (zeros = none)
+    h0/c0:[B, H] initial carry; mask: [T, B] 1=valid (carry held at 0)
+    Returns (hs [T, B, H], h_T, c_T).
+    """
+    hs, cs, gates, hT, cT = _run_forward(
+        xw, rw, p, h0, c0, mask, interpret=interpret)
+    return hs, hT, cT
+
+
+def _fused_fwd(xw, rw, p, h0, c0, mask, interpret):
+    hs, cs, gates, hT, cT = _run_forward(
+        xw, rw, p, h0, c0, mask, interpret=interpret)
+    return (hs, hT, cT), (rw, p, mask, hs, cs, gates, h0, c0)
+
+
+def _fused_bwd(interpret, res, cts):
+    dhs, dhT, dcT = cts
+    rw, p, mask, hs, cs, gates, h0, c0 = res
+    dxw, dh0, dc0, drw, dp = _run_backward(
+        res, dhs, dhT, dcT, interpret=interpret)
+    return dxw, drw, dp, dh0, dc0, None
+
+
+fused_lstm.defvjp(_fused_fwd, _fused_bwd)
