@@ -1,0 +1,166 @@
+"""CJK tokenizer factories — language-pack parity.
+
+Reference parity: sibling modules `deeplearning4j-nlp-{japanese,chinese,
+korean}` (SURVEY §2.5) bundle heavyweight analyzers (a kuromoji fork for
+ja, ansj for zh, a Korean twitter-text port). Those are dictionary-driven
+morphological analyzers; shipping ~55 files of dictionary machinery is not
+what the TPU port needs, so these factories implement the standard
+lightweight equivalents:
+
+- Japanese: character-class run segmentation (kanji / hiragana / katakana /
+  latin / digit runs split at class boundaries) — the classic dictionary-
+  free baseline; a user dictionary can refine it via longest-match.
+- Chinese: greedy forward maximum-match over an optional user dictionary,
+  falling back to unigram characters (the reference ansj default degrades
+  the same way on OOV).
+- Korean: whitespace-delimited eojeol, optionally stripped of trailing
+  particles (josa) from a small closed set.
+
+All three plug into the same `TokenizerFactory` SPI as the default
+tokenizer (reference seam: `tokenization/tokenizerfactory/`), so
+Word2Vec/ParagraphVectors/BagOfWords accept them unchanged.
+"""
+
+from __future__ import annotations
+
+import unicodedata
+from typing import Iterable, List, Optional, Sequence, Set
+
+from deeplearning4j_tpu.nlp.tokenization import Tokenizer, TokenizerFactory
+
+
+def _char_class(ch: str) -> str:
+    code = ord(ch)
+    if 0x4E00 <= code <= 0x9FFF or 0x3400 <= code <= 0x4DBF:
+        return "kanji"
+    if 0x3040 <= code <= 0x309F:
+        return "hiragana"
+    if 0x30A0 <= code <= 0x30FF or code == 0x30FC:
+        return "katakana"
+    if 0xAC00 <= code <= 0xD7AF:
+        return "hangul"
+    if ch.isdigit():
+        return "digit"
+    if ch.isalpha():
+        return "latin"
+    if ch.isspace():
+        return "space"
+    return "other"
+
+
+def _runs(text: str) -> List[str]:
+    out: List[str] = []
+    cur, cls = "", None
+    for ch in text:
+        c = _char_class(ch)
+        if c == cls and c not in ("space", "other"):
+            cur += ch
+        else:
+            if cur:
+                out.append(cur)
+            cur = ch if c not in ("space",) else ""
+            cls = c
+            if c == "other" and cur:
+                out.append(cur)
+                cur = ""
+    if cur:
+        out.append(cur)
+    return out
+
+
+def _max_match(text: str, dictionary: Set[str], max_len: int) -> List[str]:
+    """Greedy forward longest-match; unmatched spans fall back per-char."""
+    out: List[str] = []
+    i = 0
+    while i < len(text):
+        match = None
+        for ln in range(min(max_len, len(text) - i), 1, -1):
+            if text[i:i + ln] in dictionary:
+                match = text[i:i + ln]
+                break
+        if match:
+            out.append(match)
+            i += len(match)
+        else:
+            out.append(text[i])
+            i += 1
+    return out
+
+
+class JapaneseTokenizerFactory(TokenizerFactory):
+    """Reference: `deeplearning4j-nlp-japanese` (kuromoji fork)."""
+
+    def __init__(self, user_dictionary: Optional[Iterable[str]] = None):
+        super().__init__()
+        self._dict = set(user_dictionary or ())
+        self._max = max((len(w) for w in self._dict), default=0)
+
+    def create(self, text: str) -> Tokenizer:
+        toks: List[str] = []
+        for run in _runs(unicodedata.normalize("NFKC", text)):
+            cls = _char_class(run[0])
+            if self._dict and cls in ("kanji", "hiragana", "katakana"):
+                toks.extend(_max_match(run, self._dict, self._max))
+            else:
+                toks.append(run)
+        return _ListTokenizer(toks, self._pre)
+
+
+class ChineseTokenizerFactory(TokenizerFactory):
+    """Reference: `deeplearning4j-nlp-chinese` (ansj analyzer)."""
+
+    def __init__(self, dictionary: Optional[Iterable[str]] = None):
+        super().__init__()
+        self._dict = set(dictionary or ())
+        self._max = max((len(w) for w in self._dict), default=0)
+
+    def create(self, text: str) -> Tokenizer:
+        toks: List[str] = []
+        for run in _runs(unicodedata.normalize("NFKC", text)):
+            if _char_class(run[0]) == "kanji":
+                if self._dict:
+                    toks.extend(_max_match(run, self._dict, self._max))
+                else:
+                    toks.extend(run)  # unigram fallback
+            else:
+                toks.append(run)
+        return _ListTokenizer(toks, self._pre)
+
+
+_JOSA = ("은", "는", "이", "가", "을", "를", "의", "에", "에서", "으로",
+         "로", "와", "과", "도", "만", "까지", "부터", "에게")
+
+
+class KoreanTokenizerFactory(TokenizerFactory):
+    """Reference: `deeplearning4j-nlp-korean` (twitter-text port)."""
+
+    def __init__(self, strip_particles: bool = True):
+        super().__init__()
+        self.strip_particles = strip_particles
+
+    def create(self, text: str) -> Tokenizer:
+        toks: List[str] = []
+        for word in text.split():
+            w = word.strip(".,!?…·()[]\"'")
+            if not w:
+                continue
+            if self.strip_particles and _char_class(w[-1]) == "hangul":
+                for josa in sorted(_JOSA, key=len, reverse=True):
+                    if len(w) > len(josa) and w.endswith(josa):
+                        w = w[:-len(josa)]
+                        break
+            toks.append(w)
+        return _ListTokenizer(toks, self._pre)
+
+
+class _ListTokenizer(Tokenizer):
+    """Tokenizer over a precomputed token list (factories above)."""
+
+    def __init__(self, toks: List[str], pre):
+        self._toks = toks
+        self._pre = pre
+
+    def tokens(self) -> List[str]:
+        out = [self._pre.pre_process(t) if self._pre else t
+               for t in self._toks]
+        return [t for t in out if t]

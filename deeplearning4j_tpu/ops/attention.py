@@ -5,11 +5,13 @@ layers at all"); this backs the framework's transformer extension
 (`nn/layers/attention.py`, `parallel/ring_attention.py`) the way cuDNN
 helpers backed conv layers in the reference (SURVEY §2.3 seam).
 
-Design: classic flash-attention forward — grid over (batch·heads, q blocks);
-K/V stream through VMEM in blocks under a fori_loop carrying the online
-softmax statistics (running max m, normalizer l), so the [T, T] score matrix
-is never materialized in HBM. Causal masking skips fully-masked K blocks'
-contribution via block-index comparison. The backward pass recomputes
+Design: classic flash-attention forward — grid over (batch·heads, q blocks,
+K blocks); one [Bk, D] K/V tile is resident in VMEM at a time, with the
+online-softmax statistics (running max m, normalizer l, accumulator) carried
+in VMEM scratch across the innermost K grid dimension, so neither the
+[T, T] score matrix nor the full K/V sequence ever sits in VMEM/HBM at
+once. Causal masking skips dead K blocks' FLOPs via block-index
+comparison. The backward pass recomputes
 attention with XLA (rematerialization — the standard flash trade: O(T)
 memory for extra FLOPs) via `jax.custom_vjp`.
 """
@@ -38,18 +40,33 @@ def _dense_attention(q, k, v, causal: bool, scale: float):
     return jnp.einsum("bqk,bkd->bqd", w, v)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
-                  scale: float):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_scr, m_scr, l_scr, *,
+                  causal: bool, scale: float):
+    """Grid = (batch·heads, q blocks, K blocks): the K/V HBM→VMEM transfer
+    is blocked by the grid itself (one [Bk, D] tile resident at a time),
+    with the online-softmax state carried in VMEM scratch across the
+    innermost (K) grid dimension."""
     qb = pl.program_id(1)
+    kb = pl.program_id(2)
+    nk = pl.num_programs(2)
     q = q_ref[0]                                  # [Bq, D]
     bq, d = q.shape
-    t = k_ref.shape[1]
-    nk = t // block_k
+    block_k = k_ref.shape[1]
 
-    def body(kb, carry):
-        acc, m_prev, l_prev = carry
-        k = k_ref[0, pl.ds(kb * block_k, block_k), :]        # [Bk, D]
-        v = v_ref[0, pl.ds(kb * block_k, block_k), :]
+    @pl.when(kb == 0)
+    def _():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    # Causal: K blocks strictly above this Q block's last row are dead —
+    # skip their FLOPs (the DMA still happens; acceptable at Bk=128).
+    relevant = (kb * block_k <= (qb + 1) * bq - 1) if causal else (kb >= 0)
+
+    @pl.when(relevant)
+    def _():
+        k = k_ref[0]                              # [Bk, D]
+        v = v_ref[0]
         prec = (jax.lax.Precision.HIGHEST if q.dtype == jnp.float32
                 else jax.lax.Precision.DEFAULT)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
@@ -60,26 +77,20 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, block_k: int, causal: bool,
             k_ids = (kb * block_k
                      + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1))
             s = jnp.where(q_ids >= k_ids, s, _NEG_INF)
+        m_prev = m_scr[:]
         m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
         alpha = jnp.exp(m_prev - m_new)
         p = jnp.exp(s - m_new)
-        l_new = l_prev * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * alpha + jnp.dot(
+        m_scr[:] = m_new
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jnp.dot(
             p.astype(v.dtype), v, preferred_element_type=jnp.float32,
             precision=prec)
-        return acc, m_new, l_new
 
-    if causal:
-        # K blocks strictly after this Q block's last row contribute nothing.
-        nk_eff = jnp.minimum(nk, (qb + 1) * bq // block_k
-                             + ((qb + 1) * bq % block_k != 0).astype(jnp.int32))
-    else:
-        nk_eff = nk
-    acc0 = jnp.zeros((bq, d), jnp.float32)
-    m0 = jnp.full((bq, 1), _NEG_INF, jnp.float32)
-    l0 = jnp.zeros((bq, 1), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, nk_eff, body, (acc0, m0, l0))
-    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+    @pl.when(kb == nk - 1)
+    def _():
+        o_ref[0] = (acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)
+                    ).astype(o_ref.dtype)
 
 
 def _run_flash(q, k, v, *, causal: bool, scale: float, block_q: int,
@@ -90,18 +101,22 @@ def _run_flash(q, k, v, *, causal: bool, scale: float, block_q: int,
     if t % block_q or t % block_k:
         raise ValueError(f"seq len {t} not divisible by blocks "
                          f"({block_q}, {block_k})")
-    kernel = functools.partial(_flash_kernel, block_k=block_k, causal=causal,
-                               scale=scale)
+    kernel = functools.partial(_flash_kernel, causal=causal, scale=scale)
     return pl.pallas_call(
         kernel,
-        grid=(bh, t // block_q),
+        grid=(bh, t // block_q, t // block_k),
         in_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, t, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
         interpret=interpret,
     )(q, k, v)
 

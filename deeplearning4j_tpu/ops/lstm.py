@@ -45,9 +45,23 @@ def _sigmoid(x):
 
 
 # --------------------------------------------------------------- forward
+def _cell(xw_t, h_prev, c_prev, rw, p):
+    """Shared gate math for both forward kernel variants."""
+    hsz = h_prev.shape[-1]
+    gates = xw_t + jnp.dot(h_prev, rw, preferred_element_type=h_prev.dtype)
+    i = _sigmoid(gates[:, :hsz] + c_prev * p[0:1, :])
+    f = _sigmoid(gates[:, hsz:2 * hsz] + c_prev * p[1:2, :])
+    g = jnp.tanh(gates[:, 2 * hsz:3 * hsz])
+    c_new = f * c_prev + i * g
+    o = _sigmoid(gates[:, 3 * hsz:] + c_new * p[2:3, :])
+    h_new = o * jnp.tanh(c_new)
+    return h_new, c_new, i, f, g, o
+
+
 def _fwd_kernel(xw_ref, rw_ref, p_ref, h0_ref, c0_ref, m_ref,
                 hs_ref, cs_ref, gates_ref, hT_ref, cT_ref,
                 h_scr, c_scr):
+    """Training forward: also emits the cs/gates residuals for backward."""
     t = pl.program_id(0)
     T = pl.num_programs(0)
 
@@ -56,24 +70,9 @@ def _fwd_kernel(xw_ref, rw_ref, p_ref, h0_ref, c0_ref, m_ref,
         h_scr[:] = h0_ref[:]
         c_scr[:] = c0_ref[:]
 
-    h_prev = h_scr[:]
-    c_prev = c_scr[:]
-    rw = rw_ref[:]
-    p = p_ref[:]
-    hsz = h_prev.shape[-1]
-
-    gates = xw_ref[0] + jnp.dot(h_prev, rw, preferred_element_type=h_prev.dtype)
-    i_pre = gates[:, :hsz] + c_prev * p[0:1, :]
-    f_pre = gates[:, hsz:2 * hsz] + c_prev * p[1:2, :]
-    g_pre = gates[:, 2 * hsz:3 * hsz]
-    i = _sigmoid(i_pre)
-    f = _sigmoid(f_pre)
-    g = jnp.tanh(g_pre)
-    c_new = f * c_prev + i * g
-    o_pre = gates[:, 3 * hsz:] + c_new * p[2:3, :]
-    o = _sigmoid(o_pre)
-    h_new = o * jnp.tanh(c_new)
-
+    h_prev, c_prev = h_scr[:], c_scr[:]
+    h_new, c_new, i, f, g, o = _cell(
+        xw_ref[0], h_prev, c_prev, rw_ref[:], p_ref[:])
     m = jnp.transpose(m_ref[pl.ds(t, 1), :])    # [B, 1]
     h = m * h_new + (1.0 - m) * h_prev
     c = m * c_new + (1.0 - m) * c_prev
@@ -90,19 +89,49 @@ def _fwd_kernel(xw_ref, rw_ref, p_ref, h0_ref, c0_ref, m_ref,
         cT_ref[:] = c
 
 
-def _run_forward(xw, rw, p, h0, c0, mask, *, interpret: bool):
+def _fwd_kernel_inference(xw_ref, rw_ref, p_ref, h0_ref, c0_ref, m_ref,
+                          hs_ref, hT_ref, cT_ref, h_scr, c_scr):
+    """Inference forward: writes only hs/h_T/c_T — ~5x less HBM output
+    bandwidth than the training variant (no cs/gates residuals)."""
+    t = pl.program_id(0)
+    T = pl.num_programs(0)
+
+    @pl.when(t == 0)
+    def _():
+        h_scr[:] = h0_ref[:]
+        c_scr[:] = c0_ref[:]
+
+    h_prev, c_prev = h_scr[:], c_scr[:]
+    h_new, c_new, _, _, _, _ = _cell(
+        xw_ref[0], h_prev, c_prev, rw_ref[:], p_ref[:])
+    m = jnp.transpose(m_ref[pl.ds(t, 1), :])
+    h = m * h_new + (1.0 - m) * h_prev
+    c = m * c_new + (1.0 - m) * c_prev
+    h_scr[:] = h
+    c_scr[:] = c
+    hs_ref[0] = h
+
+    @pl.when(t == T - 1)
+    def _():
+        hT_ref[:] = h
+        cT_ref[:] = c
+
+
+def _run_forward(xw, rw, p, h0, c0, mask, *, interpret: bool,
+                 with_residuals: bool = True):
     T, B, H4 = xw.shape
     H = H4 // 4
     dt = xw.dtype
-    out_shape = (
-        jax.ShapeDtypeStruct((T, B, H), dt),    # hs
+    res_out = [
         jax.ShapeDtypeStruct((T, B, H), dt),    # cs
         jax.ShapeDtypeStruct((T, B, H4), dt),   # activated gates
-        jax.ShapeDtypeStruct((B, H), dt),       # h_T
-        jax.ShapeDtypeStruct((B, H), dt),       # c_T
-    )
-    return pl.pallas_call(
-        _fwd_kernel,
+    ] if with_residuals else []
+    res_spec = [
+        pl.BlockSpec((1, B, H), lambda t: (t, 0, 0)),
+        pl.BlockSpec((1, B, H4), lambda t: (t, 0, 0)),
+    ] if with_residuals else []
+    out = pl.pallas_call(
+        _fwd_kernel if with_residuals else _fwd_kernel_inference,
         grid=(T,),
         in_specs=[
             pl.BlockSpec((1, B, H4), lambda t: (t, 0, 0)),
@@ -112,20 +141,25 @@ def _run_forward(xw, rw, p, h0, c0, mask, *, interpret: bool):
             pl.BlockSpec((B, H), lambda t: (0, 0)),
             pl.BlockSpec((T, B), lambda t: (0, 0)),
         ],
-        out_specs=[
-            pl.BlockSpec((1, B, H), lambda t: (t, 0, 0)),
-            pl.BlockSpec((1, B, H), lambda t: (t, 0, 0)),
-            pl.BlockSpec((1, B, H4), lambda t: (t, 0, 0)),
+        out_specs=[pl.BlockSpec((1, B, H), lambda t: (t, 0, 0))] + res_spec
+        + [
             pl.BlockSpec((B, H), lambda t: (0, 0)),
             pl.BlockSpec((B, H), lambda t: (0, 0)),
         ],
-        out_shape=out_shape,
+        out_shape=tuple([jax.ShapeDtypeStruct((T, B, H), dt)] + res_out + [
+            jax.ShapeDtypeStruct((B, H), dt),
+            jax.ShapeDtypeStruct((B, H), dt),
+        ]),
         scratch_shapes=[
             pltpu.VMEM((B, H), dt),
             pltpu.VMEM((B, H), dt),
         ],
         interpret=interpret,
     )(xw, rw, p, h0, c0, mask)
+    if with_residuals:
+        return out  # (hs, cs, gates, hT, cT)
+    hs, hT, cT = out
+    return hs, None, None, hT, cT
 
 
 # -------------------------------------------------------------- backward
@@ -258,8 +292,8 @@ def fused_lstm(xw, rw, p, h0, c0, mask, interpret=False):
     h0/c0:[B, H] initial carry; mask: [T, B] 1=valid (carry held at 0)
     Returns (hs [T, B, H], h_T, c_T).
     """
-    hs, cs, gates, hT, cT = _run_forward(
-        xw, rw, p, h0, c0, mask, interpret=interpret)
+    hs, _, _, hT, cT = _run_forward(
+        xw, rw, p, h0, c0, mask, interpret=interpret, with_residuals=False)
     return hs, hT, cT
 
 

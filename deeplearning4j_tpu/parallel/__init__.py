@@ -30,6 +30,11 @@ from deeplearning4j_tpu.parallel.pipeline import (
 from deeplearning4j_tpu.parallel.moe import (
     MoEFeedForward, moe_ffn, top_k_gating, expert_sharding, expert_mesh,
 )
+from deeplearning4j_tpu.parallel.training_master import (
+    TrainingMaster, ParameterAveragingTrainingMaster,
+    DistributedTrainingMaster, PhaseStats,
+)
+from deeplearning4j_tpu.parallel.estimator import NetworkEstimator
 
 __all__ = [
     "MeshSpec", "make_mesh", "device_count", "local_device_count",
@@ -40,4 +45,6 @@ __all__ = [
     "split_microbatches",
     "MoEFeedForward", "moe_ffn", "top_k_gating", "expert_sharding",
     "expert_mesh",
+    "TrainingMaster", "ParameterAveragingTrainingMaster",
+    "DistributedTrainingMaster", "PhaseStats", "NetworkEstimator",
 ]

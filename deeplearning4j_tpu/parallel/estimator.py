@@ -1,0 +1,84 @@
+"""Estimator/Model pipeline wrappers — dl4j-spark-ml parity, sklearn-shaped.
+
+Reference parity: `dl4j-spark-ml/.../SparkDl4jNetwork.scala` wraps a network
+config + TrainingMaster as a Spark ML `Estimator` whose `fit` returns a
+`Model` usable in ML pipelines (SURVEY §2.4). The idiomatic Python analogue
+is the scikit-learn estimator protocol (fit/predict/get_params), which
+composes with sklearn Pipelines the way the Scala class composed with Spark
+ML pipelines.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.parallel.training_master import (
+    DistributedTrainingMaster, TrainingMaster,
+)
+
+
+class NetworkEstimator:
+    """Fit a network config into a trained model, optionally through a
+    TrainingMaster (distributed) — `new SparkDl4jNetwork(conf, tm).fit(df)`
+    becomes `NetworkEstimator(conf, training_master=tm).fit(X, y)`."""
+
+    def __init__(self, conf, *, training_master: Optional[TrainingMaster]
+                 = None, epochs: int = 1, batch_size: int = 32):
+        self.conf = conf
+        self.training_master = training_master
+        self.epochs = epochs
+        self.batch_size = batch_size
+        self.model_: Optional[Any] = None
+
+    # sklearn protocol ------------------------------------------------
+    def get_params(self, deep: bool = True):
+        return {"conf": self.conf, "training_master": self.training_master,
+                "epochs": self.epochs, "batch_size": self.batch_size}
+
+    def set_params(self, **kw):
+        for k, v in kw.items():
+            setattr(self, k, v)
+        return self
+
+    def _build(self):
+        from deeplearning4j_tpu.models import (
+            ComputationGraph, MultiLayerNetwork,
+        )
+
+        if hasattr(self.conf, "vertices"):
+            return ComputationGraph(self.conf).init()
+        return MultiLayerNetwork(self.conf).init()
+
+    def fit(self, X, y=None):
+        net = self._build()
+        if self.training_master is not None:
+            self.training_master.execute_training(
+                net, X, y, batch_size=self.batch_size, epochs=self.epochs)
+        else:
+            net.fit(X, y, epochs=self.epochs, batch_size=self.batch_size)
+        self.model_ = net
+        return self
+
+    def predict(self, X):
+        if self.model_ is None:
+            raise RuntimeError("fit() before predict()")
+        out = self.model_.output(X)
+        if isinstance(out, dict):
+            out = next(iter(out.values()))
+        return np.argmax(np.asarray(out), axis=-1)
+
+    def predict_proba(self, X):
+        if self.model_ is None:
+            raise RuntimeError("fit() before predict()")
+        out = self.model_.output(X)
+        if isinstance(out, dict):
+            out = next(iter(out.values()))
+        return np.asarray(out)
+
+    def score(self, X, y):
+        pred = self.predict(X)
+        true = np.argmax(np.asarray(y), axis=-1) if np.asarray(y).ndim > 1 \
+            else np.asarray(y)
+        return float(np.mean(pred == true))

@@ -1,0 +1,122 @@
+"""Streaming ingestion (dl4j-streaming parity) + CJK tokenizer tests."""
+
+import threading
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.streaming import (
+    InMemoryBroker, NDArrayConsumer, NDArrayPublisher,
+    StreamingDataSetIterator, bytes_to_ndarray, ndarray_to_bytes,
+    record_to_ndarray,
+)
+from deeplearning4j_tpu.nlp.lang import (
+    ChineseTokenizerFactory, JapaneseTokenizerFactory, KoreanTokenizerFactory,
+)
+
+
+class TestStreaming:
+    def test_codec_roundtrip(self):
+        for arr in (np.arange(12, dtype=np.float32).reshape(3, 4),
+                    np.asarray([1.5], np.float64),
+                    np.zeros((2, 3, 4), np.int32)):
+            back = bytes_to_ndarray(ndarray_to_bytes(arr))
+            assert back.dtype == arr.dtype
+            np.testing.assert_array_equal(back, arr)
+
+    def test_record_conversion(self):
+        np.testing.assert_allclose(record_to_ndarray(["1.5", 2, "3"]),
+                                   [1.5, 2.0, 3.0])
+
+    def test_pub_sub(self):
+        broker = InMemoryBroker()
+        pub = NDArrayPublisher(broker, "t")
+        sub = NDArrayConsumer(broker, "t", timeout=0.2)
+        for i in range(5):
+            pub.publish(np.full((2,), i, np.float32))
+        got = list(sub)
+        assert len(got) == 5
+        np.testing.assert_allclose(got[3], [3, 3])
+
+    def test_streaming_iterator_feeds_training(self):
+        """Producer thread publishes while fit() consumes — the Camel-route-
+        into-training-pipeline scenario."""
+        from deeplearning4j_tpu.models import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.inputs import InputType
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.optim.updaters import Adam
+
+        broker = InMemoryBroker()
+        rng = np.random.default_rng(0)
+        w = rng.standard_normal((6, 2)).astype(np.float32)
+
+        def produce():
+            px = NDArrayPublisher(broker, "x")
+            py = NDArrayPublisher(broker, "y")
+            for _ in range(96):
+                x = rng.standard_normal(6).astype(np.float32)
+                y = np.eye(2, dtype=np.float32)[int(np.argmax(x @ w))]
+                px.publish(x)
+                py.publish(y)
+
+        t = threading.Thread(target=produce)
+        t.start()
+        it = StreamingDataSetIterator(broker, features_topic="x",
+                                      labels_topic="y", batch_size=32,
+                                      timeout=1.0)
+        net = MultiLayerNetwork(
+            (NeuralNetConfiguration.builder()
+             .seed(0).updater(Adam(1e-2)).activation("relu")
+             .list(DenseLayer(n_out=8),
+                   OutputLayer(n_out=2, activation="softmax"))
+             .set_input_type(InputType.feed_forward(6))
+             .build())).init()
+        net.fit(it)
+        t.join()
+        assert np.isfinite(net.score_)
+        assert net.iteration == 3  # 96 examples / batch 32
+
+    def test_timeout_ends_epoch(self):
+        it = StreamingDataSetIterator(InMemoryBroker(), features_topic="x",
+                                      labels_topic="y", timeout=0.05)
+        assert list(it) == []
+
+
+class TestCJKTokenizers:
+    def test_japanese_char_class_runs(self):
+        tf = JapaneseTokenizerFactory()
+        toks = tf.create("私はTPUで学習します123").tokens()
+        assert "TPU" in toks
+        assert "123" in toks
+        # kanji and kana separated at class boundaries
+        assert "私" in toks and "は" in toks
+
+    def test_japanese_user_dictionary(self):
+        tf = JapaneseTokenizerFactory(user_dictionary=["機械学習", "学習"])
+        toks = tf.create("機械学習を学習する").tokens()
+        assert "機械学習" in toks
+
+    def test_chinese_unigram_and_dict(self):
+        assert ChineseTokenizerFactory().create("我爱北京").tokens() == [
+            "我", "爱", "北", "京"]
+        toks = ChineseTokenizerFactory(["北京", "天安门"]).create(
+            "我爱北京天安门").tokens()
+        assert toks == ["我", "爱", "北京", "天安门"]
+
+    def test_korean_particle_stripping(self):
+        toks = KoreanTokenizerFactory().create("나는 학교에 간다").tokens()
+        assert "나" in toks and "학교" in toks
+        keep = KoreanTokenizerFactory(strip_particles=False).create(
+            "나는 학교에 간다").tokens()
+        assert "나는" in keep
+
+    def test_factory_spi_with_word2vec(self):
+        """CJK factories slot into the same SPI the embedding stack uses."""
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+        sentences = ["我 爱 学习", "我 爱 北京", "学习 北京"] * 10
+        w2v = Word2Vec(min_count=1, layer_size=8, epochs=1,
+                       seed=1, tokenizer_factory=ChineseTokenizerFactory())
+        w2v.fit(["".join(s.split()) for s in sentences])
+        assert w2v.word_vector("我") is not None
