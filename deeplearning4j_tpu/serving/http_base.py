@@ -1,0 +1,85 @@
+"""Shared stdlib JSON-over-HTTP plumbing for the serving endpoints.
+
+One implementation of the request/response mechanics (header parsing, JSON
+encode/decode, 404/400 mapping, threaded serve/shutdown) used by the
+inference server, the k-NN server (reference:
+`NearestNeighborsServer.java:37`) and the Keras gateway — the role Play
+filled for the reference's REST modules.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+
+class JsonHttpServer:
+    """Subclass and override get_routes()/post_routes().
+
+    GET handlers: () -> payload dict. POST handlers: (request dict) ->
+    payload dict. Exceptions map to {"error": str} with HTTP 400."""
+
+    def __init__(self, *, port: int = 0, host: str = "127.0.0.1"):
+        self.port = port
+        self.host = host
+        self._httpd: Optional[ThreadingHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+
+    def get_routes(self) -> Dict[str, Callable[[], dict]]:
+        return {"/healthz": lambda: {"status": "ok"}}
+
+    def post_routes(self) -> Dict[str, Callable[[dict], dict]]:
+        return {}
+
+    def start(self) -> int:
+        gets = self.get_routes()
+        posts = self.post_routes()
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def _json(self, code: int, payload: dict):
+                body = json.dumps(payload).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                fn = gets.get(self.path)
+                if fn is None:
+                    return self._json(404, {"error": "not found"})
+                try:
+                    self._json(200, fn())
+                except Exception as e:
+                    self._json(400, {"error": str(e)})
+
+            def do_POST(self):
+                fn = posts.get(self.path)
+                if fn is None:
+                    return self._json(404, {"error": "not found"})
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    req = json.loads(self.rfile.read(n) or b"{}")
+                    self._json(200, fn(req))
+                except KeyError as e:
+                    self._json(400, {"error": f"missing field/model: {e}"})
+                except Exception as e:
+                    self._json(400, {"error": str(e)})
+
+        self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
+        self.port = self._httpd.server_port
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None

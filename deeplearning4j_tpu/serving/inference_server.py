@@ -1,74 +1,36 @@
 """REST model-inference server backed by ParallelInference.
 
 Reference precedent: the reference embeds `ParallelInference` in user code;
-this exposes it over HTTP like the nearest-neighbor server exposes VPTree:
+this exposes it over HTTP (shared plumbing in serving/http_base.py) like
+the nearest-neighbor server exposes VPTree:
   POST /output  {"ndarray": [[...], ...]}  → {"output": [[...], ...]}
   GET  /healthz
 """
 
 from __future__ import annotations
 
-import json
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
-
 import numpy as np
 
 from deeplearning4j_tpu.parallel.inference import InferenceMode, ParallelInference
+from deeplearning4j_tpu.serving.http_base import JsonHttpServer
 
 
-class InferenceServer:
+class InferenceServer(JsonHttpServer):
     def __init__(self, net, *, port: int = 9001, batched: bool = True,
                  max_batch_size: int = 64):
+        super().__init__(port=port)
         self.pi = ParallelInference(
             net,
             mode=InferenceMode.BATCHED if batched else InferenceMode.INPLACE,
             max_batch_size=max_batch_size)
-        self.port = port
-        self._httpd: Optional[ThreadingHTTPServer] = None
 
-    def start(self) -> int:
-        pi = self.pi
+    def _output(self, req: dict):
+        x = np.asarray(req["ndarray"], np.float32)
+        return {"output": np.asarray(self.pi.output(x)).tolist()}
 
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *a):
-                pass
-
-            def _json(self, code, payload):
-                body = json.dumps(payload).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
-
-            def do_GET(self):
-                if self.path == "/healthz":
-                    self._json(200, {"status": "ok"})
-                else:
-                    self._json(404, {"error": "not found"})
-
-            def do_POST(self):
-                if self.path != "/output":
-                    return self._json(404, {"error": "not found"})
-                try:
-                    n = int(self.headers.get("Content-Length", 0))
-                    req = json.loads(self.rfile.read(n) or b"{}")
-                    x = np.asarray(req["ndarray"], np.float32)
-                    out = pi.output(x)
-                    self._json(200, {"output": np.asarray(out).tolist()})
-                except Exception as e:
-                    self._json(400, {"error": str(e)})
-
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
-        self.port = self._httpd.server_port
-        threading.Thread(target=self._httpd.serve_forever,
-                         daemon=True).start()
-        return self.port
+    def post_routes(self):
+        return {"/output": self._output}
 
     def stop(self):
-        if self._httpd:
-            self._httpd.shutdown()
-            self._httpd.server_close()
+        super().stop()
         self.pi.shutdown()

@@ -1,7 +1,7 @@
 """REST k-nearest-neighbors server over a VPTree.
 
 Reference parity: `nearestneighbor/server/NearestNeighborsServer.java:37`
-(Play REST → stdlib http.server here):
+(Play REST → shared stdlib plumbing in serving/http_base.py):
   POST /knn        {"ndarray": [...], "k": 5}        → neighbors of a vector
   POST /knnindex   {"index": 3, "k": 5}              → neighbors of a row
   GET  /healthz
@@ -10,78 +10,39 @@ Responses: {"results": [{"index": i, "distance": d}, ...]}
 
 from __future__ import annotations
 
-import json
-import threading
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
-
 import numpy as np
 
 from deeplearning4j_tpu.clustering.vptree import VPTree
+from deeplearning4j_tpu.serving.http_base import JsonHttpServer
 
 
-class NearestNeighborsServer:
+class NearestNeighborsServer(JsonHttpServer):
     def __init__(self, points: np.ndarray, *, port: int = 9000,
                  metric: str = "euclidean"):
+        super().__init__(port=port)
         self.points = np.asarray(points)
         self.tree = VPTree(self.points, metric=metric)
-        self.port = port
-        self._httpd: Optional[ThreadingHTTPServer] = None
-        self._thread: Optional[threading.Thread] = None
 
-    # ------------------------------------------------------------ control
-    def start(self) -> int:
-        tree = self.tree
-        points = self.points
+    @staticmethod
+    def _results(idx, dist):
+        return {"results": [{"index": int(i), "distance": float(d)}
+                            for i, d in zip(idx, dist)]}
 
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *a):
-                pass
+    def _knn(self, req: dict):
+        vec = np.asarray(req["ndarray"], np.float64)
+        idx, dist = self.tree.search(vec, int(req.get("k", 5)))
+        return self._results(idx, dist)
 
-            def _json(self, code: int, payload: dict):
-                body = json.dumps(payload).encode()
-                self.send_response(code)
-                self.send_header("Content-Type", "application/json")
-                self.send_header("Content-Length", str(len(body)))
-                self.end_headers()
-                self.wfile.write(body)
+    def _knn_index(self, req: dict):
+        i = int(req["index"])
+        k = int(req.get("k", 5))
+        idx, dist = self.tree.search(self.points[i], k + 1)
+        pairs = [(j, d) for j, d in zip(idx, dist) if j != i][:k]
+        return self._results([j for j, _ in pairs], [d for _, d in pairs])
 
-            def do_GET(self):
-                if self.path == "/healthz":
-                    self._json(200, {"status": "ok", "points": len(points)})
-                else:
-                    self._json(404, {"error": "not found"})
+    def get_routes(self):
+        return {"/healthz":
+                lambda: {"status": "ok", "points": len(self.points)}}
 
-            def do_POST(self):
-                try:
-                    n = int(self.headers.get("Content-Length", 0))
-                    req = json.loads(self.rfile.read(n) or b"{}")
-                    k = int(req.get("k", 5))
-                    if self.path == "/knn":
-                        vec = np.asarray(req["ndarray"], np.float64)
-                        idx, dist = tree.search(vec, k)
-                    elif self.path == "/knnindex":
-                        i = int(req["index"])
-                        idx, dist = tree.search(points[i], k + 1)
-                        pairs = [(j, d) for j, d in zip(idx, dist) if j != i]
-                        idx = [j for j, _ in pairs][:k]
-                        dist = [d for _, d in pairs][:k]
-                    else:
-                        return self._json(404, {"error": "not found"})
-                    self._json(200, {"results": [
-                        {"index": int(i2), "distance": float(d)}
-                        for i2, d in zip(idx, dist)]})
-                except Exception as e:  # surface errors as JSON
-                    self._json(400, {"error": str(e)})
-
-        self._httpd = ThreadingHTTPServer(("127.0.0.1", self.port), Handler)
-        self.port = self._httpd.server_port
-        self._thread = threading.Thread(
-            target=self._httpd.serve_forever, daemon=True)
-        self._thread.start()
-        return self.port
-
-    def stop(self):
-        if self._httpd:
-            self._httpd.shutdown()
-            self._httpd.server_close()
+    def post_routes(self):
+        return {"/knn": self._knn, "/knnindex": self._knn_index}

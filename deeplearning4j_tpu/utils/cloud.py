@@ -1,0 +1,171 @@
+"""Cloud dataset IO + cluster provisioning — deeplearning4j-aws parity.
+
+Reference parity: `deeplearning4j-aws/` (SURVEY §2.7) — `S3Uploader` /
+`S3Downloader` move datasets/models through object storage, and
+`ClusterSetup`/`ClusterProvision` spin up EC2 worker fleets.
+
+TPU-native redesign:
+- Object storage is an SPI (`ObjectStore`). `LocalObjectStore` (filesystem
+  directory) always works and is what tests use; `S3ObjectStore` /
+  `GCSObjectStore` activate when boto3 / google-cloud-storage exist in the
+  environment (neither is baked into this image — constructing them
+  without the dependency raises ImportError with a clear message).
+- Provisioning: TPU fleets come from the cloud CLI, not an in-process SDK
+  loop like EC2. `TpuPodProvisioner` renders the exact `gcloud` command
+  lines (create / ssh-run / delete) for a queued-resource v5e slice — the
+  ClusterSetup equivalent expressed as auditable commands, optionally
+  executed via subprocess when the CLI is present.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import subprocess
+from typing import List, Optional
+
+
+class ObjectStore:
+    """put/get/list over a bucket-like namespace (S3Uploader/Downloader)."""
+
+    def put(self, key: str, local_path: str) -> None:
+        raise NotImplementedError
+
+    def get(self, key: str, local_path: str) -> str:
+        raise NotImplementedError
+
+    def keys(self, prefix: str = "") -> List[str]:
+        raise NotImplementedError
+
+
+class LocalObjectStore(ObjectStore):
+    """Directory-backed store — the embedded/test implementation and the
+    right answer for single-host and NFS-mounted pod setups."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def _path(self, key: str) -> str:
+        root = os.path.abspath(self.root)
+        p = os.path.abspath(os.path.join(root, key))
+        if p != root and not p.startswith(root + os.sep):
+            raise ValueError(f"key escapes store root: {key!r}")
+        return p
+
+    def put(self, key: str, local_path: str) -> None:
+        dst = self._path(key)
+        os.makedirs(os.path.dirname(dst) or ".", exist_ok=True)
+        shutil.copyfile(local_path, dst)
+
+    def get(self, key: str, local_path: str) -> str:
+        shutil.copyfile(self._path(key), local_path)
+        return local_path
+
+    def keys(self, prefix: str = "") -> List[str]:
+        out = []
+        for dirpath, _, files in os.walk(self.root):
+            for f in files:
+                rel = os.path.relpath(os.path.join(dirpath, f), self.root)
+                if rel.startswith(prefix):
+                    out.append(rel)
+        return sorted(out)
+
+
+class S3ObjectStore(ObjectStore):  # pragma: no cover - env-dependent
+    """Reference: `aws/s3/{uploader,reader}`. Requires boto3."""
+
+    def __init__(self, bucket: str):
+        try:
+            import boto3
+        except ImportError as e:
+            raise ImportError("S3ObjectStore requires boto3") from e
+        self._s3 = boto3.client("s3")
+        self.bucket = bucket
+
+    def put(self, key, local_path):
+        self._s3.upload_file(local_path, self.bucket, key)
+
+    def get(self, key, local_path):
+        self._s3.download_file(self.bucket, key, local_path)
+        return local_path
+
+    def keys(self, prefix=""):
+        out = []
+        paginator = self._s3.get_paginator("list_objects_v2")
+        for page in paginator.paginate(Bucket=self.bucket, Prefix=prefix):
+            out.extend(o["Key"] for o in page.get("Contents", []))
+        return out
+
+
+class GCSObjectStore(ObjectStore):  # pragma: no cover - env-dependent
+    """GCS sibling (the natural store next to a TPU pod)."""
+
+    def __init__(self, bucket: str):
+        try:
+            from google.cloud import storage
+        except ImportError as e:
+            raise ImportError(
+                "GCSObjectStore requires google-cloud-storage") from e
+        self._bucket = storage.Client().bucket(bucket)
+
+    def put(self, key, local_path):
+        self._bucket.blob(key).upload_from_filename(local_path)
+
+    def get(self, key, local_path):
+        self._bucket.blob(key).download_to_filename(local_path)
+        return local_path
+
+    def keys(self, prefix=""):
+        return [b.name for b in self._bucket.list_blobs(prefix=prefix)]
+
+
+class TpuPodProvisioner:
+    """Reference: `aws/ec2/provision/ClusterSetup.java` — but a TPU fleet
+    is declared to the cloud control plane, not SSH-bootstrapped machine by
+    machine, so the deliverable is the exact command set."""
+
+    def __init__(self, *, name: str, zone: str = "us-central2-b",
+                 accelerator_type: str = "v5litepod-64",
+                 runtime_version: str = "tpu-ubuntu2204-base",
+                 project: Optional[str] = None):
+        self.name = name
+        self.zone = zone
+        self.accelerator_type = accelerator_type
+        self.runtime_version = runtime_version
+        self.project = project
+
+    def _base(self) -> List[str]:
+        cmd = ["gcloud", "compute", "tpus", "tpu-vm"]
+        return cmd
+
+    def create_command(self) -> List[str]:
+        cmd = self._base() + [
+            "create", self.name, f"--zone={self.zone}",
+            f"--accelerator-type={self.accelerator_type}",
+            f"--version={self.runtime_version}",
+        ]
+        if self.project:
+            cmd.append(f"--project={self.project}")
+        return cmd
+
+    def run_command(self, worker_cmd: str, worker: str = "all") -> List[str]:
+        cmd = self._base() + [
+            "ssh", self.name, f"--zone={self.zone}", f"--worker={worker}",
+            f"--command={worker_cmd}",
+        ]
+        if self.project:
+            cmd.append(f"--project={self.project}")
+        return cmd
+
+    def delete_command(self) -> List[str]:
+        cmd = self._base() + ["delete", self.name, f"--zone={self.zone}",
+                              "--quiet"]
+        if self.project:
+            cmd.append(f"--project={self.project}")
+        return cmd
+
+    def execute(self, cmd: List[str]) -> int:  # pragma: no cover - env
+        if shutil.which(cmd[0]) is None:
+            raise RuntimeError(f"{cmd[0]} CLI not available on this host")
+        return subprocess.call(cmd)

@@ -1,0 +1,209 @@
+"""BASELINE config #3 (Word2Vec + LSTM sentiment) end-to-end, plus cloud
+object store (deeplearning4j-aws parity) and Keras gateway
+(deeplearning4j-keras parity) tests."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+# ---------------------------------------------------------------- sentiment
+POS_WORDS = ["great", "good", "excellent", "love", "wonderful", "best"]
+NEG_WORDS = ["bad", "awful", "terrible", "hate", "worst", "boring"]
+FILLER = ["the", "movie", "was", "plot", "acting", "film", "story", "it"]
+
+
+def _corpus(n=240, seed=0):
+    rng = np.random.default_rng(seed)
+    sents, labels = [], []
+    for _ in range(n):
+        y = int(rng.integers(0, 2))
+        words = list(rng.choice(FILLER, 4))
+        pool = POS_WORDS if y else NEG_WORDS
+        for _ in range(3):
+            words.insert(int(rng.integers(0, len(words) + 1)),
+                         str(rng.choice(pool)))
+        sents.append(" ".join(words))
+        labels.append(y)
+    return sents, labels
+
+
+class TestWord2VecLSTMSentiment:
+    def test_end_to_end(self):
+        """The full BASELINE config-#3 pipeline: fit Word2Vec on the corpus,
+        tensorize via SentenceDataSetIterator, train an LSTM classifier,
+        beat chance comfortably."""
+        from deeplearning4j_tpu.models import MultiLayerNetwork
+        from deeplearning4j_tpu.nlp.sentence_data import (
+            SentenceDataSetIterator,
+        )
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+        from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.inputs import InputType
+        from deeplearning4j_tpu.nn.layers import RnnOutputLayer
+        from deeplearning4j_tpu.nn.layers.recurrent import LSTM, LastTimeStep
+        from deeplearning4j_tpu.nn.layers.feedforward import OutputLayer
+        from deeplearning4j_tpu.optim.updaters import Adam
+
+        sents, labels = _corpus()
+        w2v = Word2Vec(layer_size=16, min_count=1, window=3, epochs=3,
+                       seed=1, negative=4)
+        w2v.fit(sents)
+        assert w2v.word_vector("great") is not None
+
+        it = SentenceDataSetIterator(
+            sents, labels, word_vectors=w2v, batch_size=32, max_length=12)
+        net = MultiLayerNetwork(
+            (NeuralNetConfiguration.builder()
+             .seed(0).updater(Adam(5e-3)).activation("tanh")
+             .list(LastTimeStep(layer=LSTM(n_out=24)),
+                   OutputLayer(n_out=2, activation="softmax"))
+             .set_input_type(InputType.recurrent(16, 12))
+             .build())).init()
+        for _ in range(12):
+            net.fit(it)
+        ev = net.evaluate(it)
+        assert ev.accuracy() > 0.8, ev.accuracy()
+
+    def test_cnn_format_shapes(self):
+        from deeplearning4j_tpu.nlp.sentence_data import (
+            SentenceDataSetIterator,
+        )
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+        sents, labels = _corpus(40)
+        w2v = Word2Vec(layer_size=8, min_count=1, epochs=1, seed=2)
+        w2v.fit(sents)
+        it = SentenceDataSetIterator(sents, labels, word_vectors=w2v,
+                                     batch_size=10, max_length=6, fmt="cnn")
+        ds = next(iter(it))
+        assert ds.features.shape == (10, 6, 8, 1)
+        assert ds.features_mask.shape == (10, 6)
+        assert ds.labels.shape == (10, 2)
+
+    def test_oov_sentence_gets_valid_mask(self):
+        from deeplearning4j_tpu.nlp.sentence_data import (
+            SentenceDataSetIterator,
+        )
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+        sents, labels = _corpus(30)
+        w2v = Word2Vec(layer_size=8, min_count=1, epochs=1, seed=3)
+        w2v.fit(sents)
+        it = SentenceDataSetIterator(
+            ["zzzz qqqq xxxx"], [0], word_vectors=w2v, num_classes=2,
+            batch_size=1, max_length=4)
+        ds = next(iter(it))
+        # all-OOV sentence: zero features but mask keeps >=1 step valid so
+        # the RNN mask-hold semantics never see an all-zero mask row
+        assert ds.features_mask.sum() == 1.0
+
+
+# ------------------------------------------------------------------- cloud
+class TestObjectStore:
+    def test_local_store_roundtrip(self, tmp_path):
+        from deeplearning4j_tpu.utils.cloud import LocalObjectStore
+
+        store = LocalObjectStore(str(tmp_path / "bucket"))
+        src = tmp_path / "model.bin"
+        src.write_bytes(b"\x00\x01payload")
+        store.put("ckpt/round1/model.bin", str(src))
+        assert store.keys() == ["ckpt/round1/model.bin"]
+        assert store.keys(prefix="ckpt/") == ["ckpt/round1/model.bin"]
+        dst = tmp_path / "restored.bin"
+        store.get("ckpt/round1/model.bin", str(dst))
+        assert dst.read_bytes() == b"\x00\x01payload"
+
+    def test_key_escape_rejected(self, tmp_path):
+        from deeplearning4j_tpu.utils.cloud import LocalObjectStore
+
+        store = LocalObjectStore(str(tmp_path / "bucket"))
+        with pytest.raises(ValueError):
+            store._path("../outside")
+
+    def test_provisioner_commands(self):
+        from deeplearning4j_tpu.utils.cloud import TpuPodProvisioner
+
+        p = TpuPodProvisioner(name="trainer", zone="us-east5-a",
+                              accelerator_type="v5litepod-64",
+                              project="proj")
+        create = " ".join(p.create_command())
+        assert "tpus tpu-vm create trainer" in create
+        assert "--accelerator-type=v5litepod-64" in create
+        assert "--project=proj" in create
+        run = " ".join(p.run_command("python train.py"))
+        assert "--worker=all" in run and "python train.py" in run
+        assert "delete" in p.delete_command()
+
+
+# ----------------------------------------------------------------- gateway
+def _make_h5(path):
+    import h5py
+
+    rng = np.random.default_rng(0)
+    w1 = rng.standard_normal((8, 16)).astype(np.float32) * 0.3
+    b1 = np.zeros(16, np.float32)
+    w2 = rng.standard_normal((16, 3)).astype(np.float32) * 0.3
+    b2 = np.zeros(3, np.float32)
+    config = {
+        "class_name": "Sequential",
+        "config": {"layers": [
+            {"class_name": "Dense",
+             "config": {"name": "dense_1", "units": 16, "activation": "relu",
+                        "use_bias": True, "batch_input_shape": [None, 8]}},
+            {"class_name": "Dense",
+             "config": {"name": "dense_2", "units": 3,
+                        "activation": "softmax", "use_bias": True}},
+        ]},
+    }
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(config)
+        mw = f.create_group("model_weights")
+        mw.attrs["layer_names"] = [b"dense_1", b"dense_2"]
+        for name, arrs in (("dense_1", [w1, b1]), ("dense_2", [w2, b2])):
+            sub = mw.create_group(name)
+            names = []
+            for arr, kind in zip(arrs, ["kernel:0", "bias:0"]):
+                sub.create_dataset(kind, data=arr)
+                names.append(f"{name}/{kind}".encode())
+            sub.attrs["weight_names"] = names
+
+
+class TestKerasGateway:
+    def test_import_fit_predict_over_http(self, tmp_path):
+        from deeplearning4j_tpu.serving.keras_gateway import (
+            KerasGatewayServer,
+        )
+
+        h5 = str(tmp_path / "model.h5")
+        _make_h5(h5)
+        gw = KerasGatewayServer()
+        port = gw.start()
+        try:
+            def post(path, payload):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{port}{path}",
+                    data=json.dumps(payload).encode(),
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=30) as r:
+                    return json.loads(r.read())
+
+            mid = post("/import", {"path": h5})["model_id"]
+            rng = np.random.default_rng(1)
+            x = rng.standard_normal((64, 8)).astype(np.float32)
+            y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 64)]
+            s1 = post("/fit", {"model_id": mid, "features": x.tolist(),
+                               "labels": y.tolist(), "epochs": 1})["score"]
+            s2 = post("/fit", {"model_id": mid, "features": x.tolist(),
+                               "labels": y.tolist(), "epochs": 10})["score"]
+            assert s2 < s1
+            out = np.asarray(post("/predict", {
+                "model_id": mid, "features": x[:4].tolist()})["output"])
+            assert out.shape == (4, 3)
+            np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-4)
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/models", timeout=10) as r:
+                assert json.loads(r.read())["models"] == [mid]
+        finally:
+            gw.stop()
