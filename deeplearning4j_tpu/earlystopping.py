@@ -220,6 +220,10 @@ class EarlyStoppingTrainer:
         self.net = net
         self.iterator = train_iterator
 
+    def _fit_batch(self, ds) -> float:
+        """One train step — the seam the parallel trainer overrides."""
+        return self.net._fit_batch(ds)
+
     def fit(self) -> EarlyStoppingResult:
         cfg = self.config
         best_score = math.inf
@@ -231,7 +235,7 @@ class EarlyStoppingTrainer:
         while True:
             terminated = False
             for ds in self.iterator:
-                score = self.net._fit_batch(ds)
+                score = self._fit_batch(ds)
                 self.net.iteration += 1
                 for cond in cfg.iteration_termination_conditions:
                     if cond.terminate(self.net.iteration, score):
@@ -281,3 +285,25 @@ class EarlyStoppingTrainer:
             score_vs_epoch=scores,
             best_model=cfg.model_saver.get_best(),
         )
+
+
+class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
+    """Early stopping over multi-device data-parallel training.
+
+    Reference: `parallelism/EarlyStoppingParallelTrainer.java` (SURVEY
+    §2.4) — early stopping wrapped around ParallelWrapper. Here each epoch
+    batch runs through the sharded-jit step over the mesh (per-step ICI
+    allreduce), with the same termination/saving hooks."""
+
+    def __init__(self, config: EarlyStoppingConfiguration, net,
+                 train_iterator, *, mesh=None, param_rules=None):
+        super().__init__(config, net, train_iterator)
+        from deeplearning4j_tpu.parallel.data_parallel import ParallelWrapper
+
+        self._pw = ParallelWrapper(net, mesh=mesh, param_rules=param_rules,
+                                   prefetch_buffer=0)
+
+    def _fit_batch(self, ds) -> float:
+        score = self._pw._step(self._pw._pad_to_divisible(ds))
+        self.net.score_ = score
+        return score

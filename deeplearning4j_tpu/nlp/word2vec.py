@@ -69,27 +69,24 @@ class Word2Vec:
         self._syn1: Optional[np.ndarray] = None
 
     # ------------------------------------------------------------ fitting
-    def fit(self, corpus) -> "Word2Vec":
-        """Reference: `SequenceVectors.fit():187` (vocab build → Huffman →
-        training threads → here: batched jit steps)."""
-        sentences = _as_token_lists(corpus, self.tokenizer_factory)
-        self.vocab = build_vocab(sentences, min_count=self.min_count)
-        if len(self.vocab) == 0:
-            raise ValueError("Empty vocabulary (min_count too high?)")
-        V, D = len(self.vocab), self.layer_size
-        rng = np.random.default_rng(self.seed)
-        syn0 = ((rng.random((V, D), dtype=np.float32) - 0.5) / D)
-        syn1 = np.zeros((V, D), dtype=np.float32)
-
-        idx_sentences = [
+    def _index_sentences(self, sentences):
+        idx = [
             np.array([self.vocab.index_of(w) for w in s], dtype=np.int64)
             for s in sentences
         ]
-        idx_sentences = [s[s >= 0] for s in idx_sentences if (s >= 0).sum() > 1]
+        return [s[s >= 0] for s in idx if (s >= 0).sum() > 1]
+
+    def _setup(self, rng=None):
+        """Allocate syn0/syn1 and build the jit step from self.vocab.
+        Shared by local fit() and the distributed trainer."""
+        V, D = len(self.vocab), self.layer_size
+        if rng is None:
+            rng = np.random.default_rng(self.seed)
+        syn0 = ((rng.random((V, D), dtype=np.float32) - 0.5) / D)
+        syn1 = np.zeros((V, D), dtype=np.float32)
         probs = unigram_table(self.vocab)
         counts = self.vocab.counts()
         total = counts.sum()
-
         if self.hs:
             HuffmanTree(self.vocab)
             codes, points, lens = HuffmanTree.padded_codes(self.vocab)
@@ -97,39 +94,56 @@ class Word2Vec:
             syn1 = np.zeros((max(V - 1, 1), D), dtype=np.float32)
         else:
             step = self._make_ns_step()
-
         # subsampling keep probability (word2vec formula)
         t = self.subsampling
         freq = counts / max(total, 1)
         keep = (np.sqrt(freq / t) + 1) * (t / np.maximum(freq, 1e-12)) \
             if t > 0 else np.ones(V)
-        keep = np.clip(keep, 0, 1)
-
         params = {"syn0": jnp.asarray(syn0), "syn1": jnp.asarray(syn1)}
-        total_pairs_est = sum(len(s) for s in idx_sentences) * self.window \
+        return {"params": params, "keep": np.clip(keep, 0, 1),
+                "probs": probs, "step": step}
+
+    def _run_epoch(self, params, idx_sentences, setup, rng, seen, total_est):
+        """One pass over idx_sentences; returns (params, seen)."""
+        keep, probs, step = setup["keep"], setup["probs"], setup["step"]
+        centers, contexts = self._generate_pairs(idx_sentences, keep, rng)
+        order = rng.permutation(len(centers))
+        centers, contexts = centers[order], contexts[order]
+        for lo in range(0, len(centers), self.batch_size):
+            c = centers[lo:lo + self.batch_size]
+            x = contexts[lo:lo + self.batch_size]
+            if len(c) < 16:
+                continue
+            frac = min(seen / max(total_est, 1), 1.0)
+            lr = max(self.lr * (1.0 - frac), self.min_lr)
+            if self.hs:
+                params = step(params, jnp.asarray(c), jnp.asarray(x),
+                              jnp.asarray(lr, jnp.float32))
+            else:
+                negs = rng.choice(len(probs),
+                                  size=(len(c), self.negative), p=probs)
+                params = step(params, jnp.asarray(c), jnp.asarray(x),
+                              jnp.asarray(negs), jnp.asarray(lr, jnp.float32))
+            seen += len(c)
+        return params, seen
+
+    def fit(self, corpus) -> "Word2Vec":
+        """Reference: `SequenceVectors.fit():187` (vocab build → Huffman →
+        training threads → here: batched jit steps)."""
+        sentences = _as_token_lists(corpus, self.tokenizer_factory)
+        self.vocab = build_vocab(sentences, min_count=self.min_count)
+        if len(self.vocab) == 0:
+            raise ValueError("Empty vocabulary (min_count too high?)")
+        rng = np.random.default_rng(self.seed)
+        idx_sentences = self._index_sentences(sentences)
+        setup = self._setup(rng)
+        params = setup["params"]
+        total_est = sum(len(s) for s in idx_sentences) * self.window \
             * max(self.epochs, 1)
         seen = 0
         for epoch in range(self.epochs):
-            centers, contexts = self._generate_pairs(
-                idx_sentences, keep, rng)
-            order = rng.permutation(len(centers))
-            centers, contexts = centers[order], contexts[order]
-            for lo in range(0, len(centers), self.batch_size):
-                c = centers[lo:lo + self.batch_size]
-                x = contexts[lo:lo + self.batch_size]
-                if len(c) < 16:
-                    continue
-                frac = min(seen / max(total_pairs_est, 1), 1.0)
-                lr = max(self.lr * (1.0 - frac), self.min_lr)
-                if self.hs:
-                    params = step(params, jnp.asarray(c), jnp.asarray(x),
-                                  jnp.asarray(lr, jnp.float32))
-                else:
-                    negs = rng.choice(len(probs),
-                                      size=(len(c), self.negative), p=probs)
-                    params = step(params, jnp.asarray(c), jnp.asarray(x),
-                                  jnp.asarray(negs), jnp.asarray(lr, jnp.float32))
-                seen += len(c)
+            params, seen = self._run_epoch(
+                params, idx_sentences, setup, rng, seen, total_est)
         self.syn0 = np.asarray(params["syn0"])
         self._syn1 = np.asarray(params["syn1"])
         return self

@@ -1,0 +1,51 @@
+"""Shared Keras .h5 fixture builders (Keras-2 save layout).
+
+Hand-written so no TensorFlow is needed; exercises the same parsing path
+as real model.save() artifacts. Used by test_keras_import.py and
+test_sentiment_cloud_gateway.py."""
+
+import json
+
+import numpy as np
+
+
+def write_weights(grp, layer_name, arrays):
+    sub = grp.create_group(layer_name)
+    names = []
+    kinds = ["kernel:0", "bias:0", "extra2:0", "extra3:0"]
+    for arr, kind in zip(arrays, kinds):
+        sub.create_dataset(kind, data=arr)
+        names.append(f"{layer_name}/{kind}".encode())
+    sub.attrs["weight_names"] = names
+
+
+def make_dense_sequential_h5(path, *, n_in=8, hidden=16, n_out=3, seed=0,
+                             scale=1.0):
+    """Two-dense-layer Sequential .h5 (relu → softmax)."""
+    import h5py
+
+    rng = np.random.default_rng(seed)
+    w1 = rng.standard_normal((n_in, hidden)).astype(np.float32) * scale
+    b1 = np.zeros(hidden, np.float32)
+    w2 = rng.standard_normal((hidden, n_out)).astype(np.float32) * scale
+    b2 = np.zeros(n_out, np.float32)
+    config = {
+        "class_name": "Sequential",
+        "config": {"layers": [
+            {"class_name": "Dense",
+             "config": {"name": "dense_1", "units": hidden,
+                        "activation": "relu", "use_bias": True,
+                        "batch_input_shape": [None, n_in]}},
+            {"class_name": "Dense",
+             "config": {"name": "dense_2", "units": n_out,
+                        "activation": "softmax", "use_bias": True}},
+        ]},
+    }
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(config)
+        mw = f.create_group("model_weights")
+        mw.attrs["layer_names"] = [b"dense_1", b"dense_2"]
+        mw.attrs["keras_version"] = b"2.1.6"
+        write_weights(mw, "dense_1", [w1, b1])
+        write_weights(mw, "dense_2", [w2, b2])
+    return (w1, b1, w2, b2)

@@ -1,0 +1,96 @@
+"""Distributed Word2Vec (dl4j-spark-nlp parity) + EarlyStoppingParallelTrainer
+tests."""
+
+from collections import Counter
+
+import numpy as np
+import pytest
+
+SENTS = (["tpu chip fast matrix compute", "tpu pod fast interconnect",
+          "chip matrix multiply fast", "dog cat animal pet fur",
+          "cat dog pet animal play", "animal fur pet dog"] * 20)
+
+
+class TestDistributedWord2Vec:
+    def test_accumulator_count_merge(self):
+        from deeplearning4j_tpu.nlp.distributed import merge_partition_counts
+
+        vocab = merge_partition_counts(
+            [Counter({"a": 3, "b": 1}), Counter({"a": 2, "c": 5})],
+            min_count=2)
+        assert vocab.count_of("a") == 5
+        assert vocab.count_of("c") == 5
+        assert "b" not in vocab  # below min_count after merge
+
+    def test_trains_and_matches_topics(self):
+        from deeplearning4j_tpu.nlp.distributed import DistributedWord2Vec
+
+        w2v = DistributedWord2Vec(num_workers=3, layer_size=16, min_count=1,
+                                  window=3, epochs=6, seed=5, negative=4,
+                                  subsampling=0)
+        w2v.fit(SENTS)
+        # in-topic similarity beats cross-topic
+        same = w2v.similarity("dog", "cat")
+        cross = w2v.similarity("dog", "tpu")
+        assert same > cross, (same, cross)
+
+    def test_single_worker_equals_vocab_of_local(self):
+        from deeplearning4j_tpu.nlp.distributed import DistributedWord2Vec
+        from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+
+        d = DistributedWord2Vec(num_workers=1, layer_size=8, min_count=2,
+                                epochs=1, seed=1)
+        d.fit(SENTS)
+        l = Word2Vec(layer_size=8, min_count=2, epochs=1, seed=1)
+        l.fit(SENTS)
+        assert len(d.vocab) == len(l.vocab)
+
+    def test_validates_workers(self):
+        from deeplearning4j_tpu.nlp.distributed import DistributedWord2Vec
+
+        with pytest.raises(ValueError):
+            DistributedWord2Vec(num_workers=0)
+
+
+class TestEarlyStoppingParallel:
+    def test_parallel_early_stopping(self, devices8):
+        from deeplearning4j_tpu.data.iterators import ArrayDataSetIterator
+        from deeplearning4j_tpu.earlystopping import (
+            EarlyStoppingConfiguration, EarlyStoppingParallelTrainer,
+            InMemoryModelSaver, MaxEpochsTerminationCondition,
+            ScoreImprovementEpochTerminationCondition,
+        )
+        from deeplearning4j_tpu.models import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.inputs import InputType
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.optim.updaters import Adam
+        from deeplearning4j_tpu.parallel import make_mesh
+
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((128, 8)).astype(np.float32)
+        w = rng.standard_normal((8, 3)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[np.argmax(x @ w, 1)]
+        net = MultiLayerNetwork(
+            (NeuralNetConfiguration.builder()
+             .seed(0).updater(Adam(1e-2)).activation("relu")
+             .list(DenseLayer(n_out=16),
+                   OutputLayer(n_out=3, activation="softmax"))
+             .set_input_type(InputType.feed_forward(8))
+             .build())).init()
+        cfg = EarlyStoppingConfiguration(
+            model_saver=InMemoryModelSaver(),
+            epoch_termination_conditions=[
+                MaxEpochsTerminationCondition(30),
+                ScoreImprovementEpochTerminationCondition(5),
+            ])
+        trainer = EarlyStoppingParallelTrainer(
+            cfg, net, ArrayDataSetIterator(x, y, 32),
+            mesh=make_mesh({"data": 8}, devices=devices8))
+        result = trainer.fit()
+        assert result.best_model is not None
+        assert np.isfinite(result.best_model_score)
+        assert result.total_epochs <= 30
+        best = result.best_model
+        pred = np.argmax(np.asarray(best.output(x)), -1)
+        assert (pred == np.argmax(y, -1)).mean() > 0.8

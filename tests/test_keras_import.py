@@ -15,15 +15,7 @@ from deeplearning4j_tpu.keras_import import import_keras_model_and_weights
 from deeplearning4j_tpu.models import ComputationGraph, MultiLayerNetwork
 
 
-def _write_weights(grp, layer_name, arrays):
-    sub = grp.create_group(layer_name)
-    names = []
-    kinds = ["kernel:0", "bias:0", "extra2:0", "extra3:0"]
-    for arr, kind in zip(arrays, kinds):
-        path = f"{layer_name}/{kind}"
-        sub.create_dataset(kind, data=arr)
-        names.append(path.encode())
-    sub.attrs["weight_names"] = names
+from keras_fixtures import write_weights as _write_weights
 
 
 def _make_sequential_h5(path):

@@ -139,35 +139,9 @@ class TestObjectStore:
 
 # ----------------------------------------------------------------- gateway
 def _make_h5(path):
-    import h5py
+    from keras_fixtures import make_dense_sequential_h5
 
-    rng = np.random.default_rng(0)
-    w1 = rng.standard_normal((8, 16)).astype(np.float32) * 0.3
-    b1 = np.zeros(16, np.float32)
-    w2 = rng.standard_normal((16, 3)).astype(np.float32) * 0.3
-    b2 = np.zeros(3, np.float32)
-    config = {
-        "class_name": "Sequential",
-        "config": {"layers": [
-            {"class_name": "Dense",
-             "config": {"name": "dense_1", "units": 16, "activation": "relu",
-                        "use_bias": True, "batch_input_shape": [None, 8]}},
-            {"class_name": "Dense",
-             "config": {"name": "dense_2", "units": 3,
-                        "activation": "softmax", "use_bias": True}},
-        ]},
-    }
-    with h5py.File(path, "w") as f:
-        f.attrs["model_config"] = json.dumps(config)
-        mw = f.create_group("model_weights")
-        mw.attrs["layer_names"] = [b"dense_1", b"dense_2"]
-        for name, arrs in (("dense_1", [w1, b1]), ("dense_2", [w2, b2])):
-            sub = mw.create_group(name)
-            names = []
-            for arr, kind in zip(arrs, ["kernel:0", "bias:0"]):
-                sub.create_dataset(kind, data=arr)
-                names.append(f"{name}/{kind}".encode())
-            sub.attrs["weight_names"] = names
+    make_dense_sequential_h5(path, scale=0.3)
 
 
 class TestKerasGateway:
