@@ -107,6 +107,87 @@ def _bench_lenet(batch: int, steps: int, dtype: str):
     return _timed_ips(run, batch, steps)
 
 
+def _bench_lstm(batch: int, steps: int, dtype: str):
+    """GravesLSTM language-model-style step with the fused Pallas kernel
+    (BASELINE config #3's RNN path; reference precedent: LSTMHelpers)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.inputs import InputType
+    from deeplearning4j_tpu.nn.layers.recurrent import (
+        GravesLSTM, RnnOutputLayer,
+    )
+    from deeplearning4j_tpu.optim.updaters import Adam
+
+    T, F, H, C = 128, 128, 512, 64
+    net = MultiLayerNetwork(
+        (NeuralNetConfiguration.builder()
+         .seed(0).updater(Adam(1e-3)).activation("tanh")
+         .list(GravesLSTM(n_out=H), GravesLSTM(n_out=H),
+               RnnOutputLayer(n_out=C, activation="softmax"))
+         .set_input_type(InputType.recurrent(F))
+         .build())).init()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((batch, T, F)), jnp.float32)
+    y = jnp.asarray(np.eye(C, dtype=np.float32)[
+        rng.integers(0, C, (batch, T))])
+    step_fn = jax.jit(net.make_step_fn(), donate_argnums=(0, 1, 2))
+    state = [net.params_tree, net.updater_state, net.state_tree]
+    key = jax.random.PRNGKey(0)
+
+    def run(n):
+        loss = None
+        for i in range(n):
+            state[0], state[1], state[2], loss, _ = step_fn(
+                state[0], state[1], state[2], jnp.asarray(i, jnp.int32),
+                x, y, None, None, key, None)
+        return loss
+
+    return _timed_ips(run, batch, steps)
+
+
+def _bench_vgg16(batch: int, steps: int, dtype: str):
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models import ComputationGraph
+    from deeplearning4j_tpu.optim.updaters import Nesterovs
+    from deeplearning4j_tpu.zoo import VGG16
+
+    model = VGG16(num_classes=1000, input_shape=(224, 224, 3),
+                  updater=Nesterovs(0.01, 0.9))
+    conf = dataclasses.replace(model.conf(), dtype=dtype)
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+
+    net = (ComputationGraph(conf).init() if hasattr(conf, "vertices")
+           else MultiLayerNetwork(conf).init())
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((batch, 224, 224, 3)), net.dtype)
+    y = jnp.asarray(np.eye(1000, dtype=np.float32)[
+        rng.integers(0, 1000, batch)])
+    step_fn = jax.jit(net.make_step_fn(), donate_argnums=(0, 1, 2))
+    state = [net.params_tree, net.updater_state, net.state_tree]
+    key = jax.random.PRNGKey(0)
+    graph = hasattr(conf, "vertices")
+
+    def run(n):
+        loss = None
+        for i in range(n):
+            if graph:
+                state[0], state[1], state[2], loss = step_fn(
+                    state[0], state[1], state[2], jnp.asarray(i, jnp.int32),
+                    {"input": x}, {"output": y}, None, None, key)[:4]
+            else:
+                state[0], state[1], state[2], loss, _ = step_fn(
+                    state[0], state[1], state[2], jnp.asarray(i, jnp.int32),
+                    x, y, None, None, key, None)
+        return loss
+
+    return _timed_ips(run, batch, steps)
+
+
 def main():
     model = os.environ.get("BENCH_MODEL", "resnet50")
     batch = int(os.environ.get("BENCH_BATCH", "256"))
@@ -117,15 +198,24 @@ def main():
         ips, loss = _bench_lenet(batch, steps, dtype)
         metric = "lenet_mnist_train_images_per_sec"
         vs = ips / 10000.0  # no published reference; nominal anchor
+    elif model == "lstm":
+        ips, loss = _bench_lstm(min(batch, 64), steps, dtype)
+        metric = "lstm_train_sequences_per_sec"
+        vs = ips / 100.0  # no published reference; nominal anchor
+    elif model == "vgg16":
+        ips, loss = _bench_vgg16(min(batch, 128), steps, dtype)
+        metric = "vgg16_train_images_per_sec_per_chip"
+        vs = ips / (TARGET_FRACTION * 1100.0)  # A100 VGG16 ~1100 img/s
     else:
         ips, loss = _bench_resnet50(batch, steps, dtype)
         metric = "resnet50_train_images_per_sec_per_chip"
         vs = ips / (TARGET_FRACTION * A100_REF_IMG_S)
 
+    unit = "sequences/sec" if model == "lstm" else "images/sec"
     print(json.dumps({
         "metric": metric,
         "value": round(ips, 2),
-        "unit": "images/sec",
+        "unit": unit,
         "vs_baseline": round(vs, 4),
     }))
 

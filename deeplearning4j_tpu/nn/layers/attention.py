@@ -94,3 +94,128 @@ class MultiHeadAttention(Layer):
             bias = bias + jnp.where(band[None, None], 0.0, -1e30)
         p = jax.nn.softmax(s + bias, axis=-1)
         return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class PositionEmbeddingLayer(Layer):
+    """Learned absolute position embedding added to [B, T, d] activations
+    (extension: pairs with EmbeddingSequenceLayer for transformer inputs)."""
+
+    max_length: int = 512
+    n_out: Optional[int] = None
+
+    def infer_n_in(self, input_type: InputType):
+        if self.n_out is None:
+            return dataclasses.replace(self, n_out=input_type.size)
+        return self
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        d = self.n_out or input_type.size
+        return {"P": 0.02 * jax.random.normal(
+            key, (self.max_length, d), dtype)}, {}
+
+    def apply(self, params, x, *, state=None, train=False, rng=None,
+              mask=None):
+        t = x.shape[1]
+        if t > self.max_length:
+            raise ValueError(f"sequence length {t} > max_length "
+                             f"{self.max_length}")
+        return x + params["P"][None, :t, :], state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class TransformerEncoderBlock(Layer):
+    """Pre-LN transformer block: x + MHA(LN(x)), then x + FFN(LN(x)).
+
+    Modern extension (no reference counterpart — SURVEY §5 notes the
+    reference predates attention). Composes the framework's own pieces:
+    MultiHeadAttention (flash kernel on TPU inference, ring attention under
+    a seq mesh) and either a dense FFN or a MoEFeedForward
+    (set n_experts > 0) for conditional compute.
+    """
+
+    n_in: Optional[int] = None
+    num_heads: int = 4
+    ffn_mult: int = 4
+    causal: bool = True
+    n_experts: int = 0            # 0 = dense FFN; >0 = MoE
+    moe_k: int = 2
+
+    def infer_n_in(self, input_type: InputType):
+        if self.n_in is None:
+            return dataclasses.replace(self, n_in=input_type.size)
+        return self
+
+    def output_type(self, input_type: InputType) -> InputType:
+        return input_type
+
+    def _sub(self):
+        d = self.n_in
+        attn = MultiHeadAttention(
+            n_in=d, n_out=d, num_heads=self.num_heads, causal=self.causal,
+            activation="identity", weight_init=self.weight_init)
+        if self.n_experts > 0:
+            from deeplearning4j_tpu.parallel.moe import MoEFeedForward
+
+            ffn = MoEFeedForward(
+                n_in=d, n_experts=self.n_experts, k=self.moe_k,
+                hidden_mult=self.ffn_mult, activation="gelu",
+                weight_init=self.weight_init, residual=False)
+        else:
+            ffn = None
+        return attn, ffn
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        d = self.n_in
+        ks = jax.random.split(key, 4)
+        attn, moe = self._sub()
+        params = {
+            "ln1_g": jnp.ones((d,), dtype), "ln1_b": jnp.zeros((d,), dtype),
+            "ln2_g": jnp.ones((d,), dtype), "ln2_b": jnp.zeros((d,), dtype),
+        }
+        ap, _ = attn.init_params(ks[0], input_type, dtype)
+        params.update({f"attn_{k}": v for k, v in ap.items()})
+        if moe is not None:
+            mp, _ = moe.init_params(ks[1], input_type, dtype)
+            params.update({f"moe_{k}": v for k, v in mp.items()})
+        else:
+            h = self.ffn_mult * d
+            winit = self._winit()
+            params.update({
+                "ffn_w1": winit(ks[1], (d, h), dtype),
+                "ffn_b1": jnp.zeros((h,), dtype),
+                "ffn_w2": winit(ks[2], (h, d), dtype),
+                "ffn_b2": jnp.zeros((d,), dtype),
+            })
+        return params, {}
+
+    @staticmethod
+    def _ln(x, g, b):
+        mu = jnp.mean(x, axis=-1, keepdims=True)
+        var = jnp.var(x, axis=-1, keepdims=True)
+        return (x - mu) * jax.lax.rsqrt(var + 1e-5) * g + b
+
+    def apply(self, params, x, *, state=None, train=False, rng=None,
+              mask=None):
+        attn, moe = self._sub()
+        ap = {k[5:]: v for k, v in params.items() if k.startswith("attn_")}
+        h = self._ln(x, params["ln1_g"], params["ln1_b"])
+        a, _ = attn.apply(ap, h, state=None, train=train, rng=rng, mask=mask)
+        x = x + a
+        h = self._ln(x, params["ln2_g"], params["ln2_b"])
+        new_state = {}
+        if moe is not None:
+            mp = {k[4:]: v for k, v in params.items() if k.startswith("moe_")}
+            b_, t_, d_ = h.shape
+            y, st = moe.apply(mp, h.reshape(b_ * t_, d_), state=None,
+                              train=train, rng=rng)
+            y = y.reshape(b_, t_, d_)
+            if "aux_loss" in st:
+                new_state["aux_loss"] = st["aux_loss"]
+        else:
+            y = jax.nn.gelu(h @ params["ffn_w1"] + params["ffn_b1"])
+            y = y @ params["ffn_w2"] + params["ffn_b2"]
+        y = self._maybe_dropout(y, train, rng)
+        return x + y, new_state

@@ -171,6 +171,8 @@ class EmbeddingSequenceLayer(Layer):
         return {"W": self._winit()(key, (self.n_in, self.n_out), dtype)}, {}
 
     def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        if x.ndim == 3 and x.shape[-1] == 1:
+            x = x[..., 0]  # [B, T, 1] token-id tensors (InputType.recurrent(1))
         emb = jnp.take(params["W"], x.astype(jnp.int32), axis=0)
         return self._act(emb), state
 

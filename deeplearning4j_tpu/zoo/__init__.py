@@ -20,9 +20,10 @@ from deeplearning4j_tpu.zoo.resnet import ResNet50
 from deeplearning4j_tpu.zoo.inception import (
     GoogLeNet, InceptionResNetV1, FaceNetNN4Small2,
 )
+from deeplearning4j_tpu.zoo.transformer import TextGenerationTransformer
 
 __all__ = [
     "ZooModel", "ZOO_REGISTRY", "LeNet", "AlexNet", "SimpleCNN", "VGG16",
     "VGG19", "TextGenerationLSTM", "ResNet50", "GoogLeNet",
-    "InceptionResNetV1", "FaceNetNN4Small2",
+    "InceptionResNetV1", "FaceNetNN4Small2", "TextGenerationTransformer",
 ]

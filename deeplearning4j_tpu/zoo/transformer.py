@@ -1,0 +1,67 @@
+"""Transformer zoo models — modern extension beyond the reference zoo.
+
+The reference zoo's sequence model is TextGenerationLSTM
+(`zoo/model/TextGenerationLSTM.java`); these are its transformer-class
+successors, required by the project charter's long-context mandate
+(SURVEY §7 step 7). Built entirely from the framework's own layers:
+EmbeddingSequenceLayer + PositionEmbeddingLayer + TransformerEncoderBlock
+(flash attention on TPU inference; MoE experts optional; ring attention
+under a `seq`-axis mesh via parallel.ring_attention).
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.nn.layers.attention import (
+    PositionEmbeddingLayer, TransformerEncoderBlock,
+)
+from deeplearning4j_tpu.nn.layers.feedforward import EmbeddingSequenceLayer
+from deeplearning4j_tpu.nn.layers.recurrent import RnnOutputLayer
+from deeplearning4j_tpu.optim.updaters import Adam
+from deeplearning4j_tpu.zoo.base import ZooModel, register_zoo
+
+
+@register_zoo
+class TextGenerationTransformer(ZooModel):
+    """GPT-style causal byte/char LM.
+
+    Inputs: token ids as [batch, time, 1]; outputs per-timestep softmax
+    over the vocabulary (same contract as TextGenerationLSTM, so the
+    text-generation tooling is interchangeable).
+    """
+
+    num_classes = 256             # byte vocabulary
+    input_shape = (256, 1)        # (timesteps, 1 token-id channel)
+
+    def __init__(self, *args, d_model: int = 256, num_heads: int = 8,
+                 num_blocks: int = 4, n_experts: int = 0, **kw):
+        super().__init__(*args, **kw)
+        self.d_model = d_model
+        self.num_heads = num_heads
+        self.num_blocks = num_blocks
+        self.n_experts = n_experts
+
+    def conf(self):
+        t = self.input_shape[0]
+        vocab = self.num_classes
+        blocks = [
+            TransformerEncoderBlock(
+                num_heads=self.num_heads, causal=True,
+                n_experts=self.n_experts)
+            for _ in range(self.num_blocks)
+        ]
+        return (NeuralNetConfiguration.builder()
+                .seed(self.seed)
+                .updater(self.kw.get("updater", Adam(3e-4)))
+                .activation("identity")
+                .weight_init("xavier")
+                .list(
+                    EmbeddingSequenceLayer(n_in=vocab, n_out=self.d_model,
+                                           activation="identity"),
+                    PositionEmbeddingLayer(max_length=t),
+                    *blocks,
+                    RnnOutputLayer(n_out=vocab, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.recurrent(1, t))
+                .build())
