@@ -300,6 +300,13 @@ def _expected_kind(layer: Layer, cur: InputType) -> str:
         rnn_mod.LastTimeStep, conv_mod.Convolution1DLayer,
         conv_mod.Subsampling1DLayer, MultiHeadAttention,
     )
+    # Layers that declare their input family explicitly ("any" = shape-
+    # preserving, consume whatever arrives) bypass the type tables.
+    declared = getattr(layer, "CONSUMES", None)
+    if declared == "any":
+        return cur.kind
+    if declared is not None:
+        return declared
     if isinstance(layer, cnn_types):
         return "cnn"
     if isinstance(layer, rnn_types):

@@ -111,39 +111,117 @@ def moe_ffn(params: Dict[str, jax.Array], x, *, k: int = 2,
             activation: str = "gelu",
             mesh: Optional[Mesh] = None,
             axis: str = AXIS_EXPERT,
-            token_mask=None) -> Tuple[jax.Array, jax.Array]:
+            token_mask=None,
+            group_size: Optional[int] = None
+            ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Expert-parallel feed-forward over tokens x: [N, d] -> [N, d].
 
     params: gate [d, E], w1 [E, d, h], b1 [E, h], w2 [E, h, d], b2 [E, d].
     token_mask: optional [N] 0/1 validity (padding excluded from routing).
-    Returns (y, aux_loss).
+
+    group_size=None routes all N tokens in one group — dispatch/combine are
+    [N, E, C] with C = cf*k*N/E, i.e. O(N^2) memory; fine for small batches.
+    group_size=S switches to GShard-style grouped dispatch ([G, S, E, C],
+    C = cf*k*S/E): per-group capacity, memory linear in N, and the G (token)
+    → E (expert) resharding of the dispatch einsum lowers to all_to_all over
+    ICI when `mesh` is active. Use this at >4k-token scale.
+
+    Returns (y, aux_loss, overflow_frac) — overflow_frac is the fraction of
+    desired (token, expert) routes dropped because expert capacity filled up.
     """
     e = params["w1"].shape[0]
     n = x.shape[0]
-    capacity = max(1, int(capacity_factor * k * n / e))
     act = Activation.get(activation)
 
-    logits = x @ params["gate"].astype(x.dtype)
-    combine, dispatch, aux = top_k_gating(
-        logits.astype(jnp.float32), k, capacity, token_mask=token_mask)
-    combine = combine.astype(x.dtype)
-    dispatch = dispatch.astype(x.dtype)
+    if group_size is None or group_size >= n:
+        capacity = max(1, int(capacity_factor * k * n / e))
+        logits = x @ params["gate"].astype(x.dtype)
+        combine, dispatch, aux = top_k_gating(
+            logits.astype(jnp.float32), k, capacity, token_mask=token_mask)
+        combine = combine.astype(x.dtype)
+        dispatch = dispatch.astype(x.dtype)
+        n_valid = (jnp.sum(token_mask) if token_mask is not None
+                   else jnp.asarray(float(n), jnp.float32))
 
-    ex_in = jnp.einsum("nec,nd->ecd", dispatch, x)
-    if mesh is not None and axis in mesh.axis_names:
-        # Pin the expert dim so the partitioner materialises the dispatch as
-        # an all_to_all over ICI instead of replicating expert blocks.
-        ex_in = jax.lax.with_sharding_constraint(
-            ex_in, NamedSharding(mesh, P(axis)))
-    h = act(jnp.einsum("ecd,edh->ech", ex_in, params["w1"])
-            + params["b1"][:, None, :])
-    ex_out = (jnp.einsum("ech,ehd->ecd", h, params["w2"])
-              + params["b2"][:, None, :])
-    if mesh is not None and axis in mesh.axis_names:
-        ex_out = jax.lax.with_sharding_constraint(
-            ex_out, NamedSharding(mesh, P(axis)))
-    y = jnp.einsum("nec,ecd->nd", combine, ex_out)
-    return y, aux
+        ex_in = jnp.einsum("nec,nd->ecd", dispatch, x)
+        if mesh is not None and axis in mesh.axis_names:
+            # Pin the expert dim so the partitioner materialises the dispatch
+            # as an all_to_all over ICI instead of replicating expert blocks.
+            ex_in = jax.lax.with_sharding_constraint(
+                ex_in, NamedSharding(mesh, P(axis)))
+        h = act(jnp.einsum("ecd,edh->ech", ex_in, params["w1"])
+                + params["b1"][:, None, :])
+        ex_out = (jnp.einsum("ech,ehd->ecd", h, params["w2"])
+                  + params["b2"][:, None, :])
+        if mesh is not None and axis in mesh.axis_names:
+            ex_out = jax.lax.with_sharding_constraint(
+                ex_out, NamedSharding(mesh, P(axis)))
+        y = jnp.einsum("nec,ecd->nd", combine, ex_out)
+        routed = jnp.sum(dispatch)
+    else:
+        s = int(group_size)
+        pad = (-n) % s
+        if pad:
+            x_p = jnp.concatenate([x, jnp.zeros((pad,) + x.shape[1:],
+                                                x.dtype)])
+            tm = (jnp.concatenate([token_mask.astype(jnp.float32),
+                                   jnp.zeros((pad,), jnp.float32)])
+                  if token_mask is not None
+                  else jnp.concatenate([jnp.ones((n,), jnp.float32),
+                                        jnp.zeros((pad,), jnp.float32)]))
+        else:
+            x_p = x
+            tm = (token_mask.astype(jnp.float32)
+                  if token_mask is not None else None)
+        g = x_p.shape[0] // s
+        capacity = max(1, int(capacity_factor * k * s / e))
+        x_g = x_p.reshape(g, s, -1)
+        if mesh is not None and axis in mesh.axis_names:
+            # Token groups data-parallel over the expert devices: the G→E
+            # resharding in the dispatch einsum becomes the MoE all_to_all.
+            x_g = jax.lax.with_sharding_constraint(
+                x_g, NamedSharding(mesh, P(axis)))
+        logits_g = (x_g @ params["gate"].astype(x.dtype)).astype(jnp.float32)
+        if tm is not None:
+            tm_g = tm.reshape(g, s)
+            combine, dispatch, aux_g = jax.vmap(
+                lambda lg, mg: top_k_gating(lg, k, capacity, token_mask=mg)
+            )(logits_g, tm_g)
+            n_valid = jnp.sum(tm)
+            # Weight by per-group valid tokens: fully-masked groups report
+            # aux=0 and must not dilute the load-balance gradient.
+            valid_g = jnp.sum(tm_g, axis=1)
+            aux = (jnp.sum(aux_g * valid_g)
+                   / jnp.maximum(jnp.sum(valid_g), 1.0))
+        else:
+            combine, dispatch, aux_g = jax.vmap(
+                lambda lg: top_k_gating(lg, k, capacity))(logits_g)
+            n_valid = jnp.asarray(float(n), jnp.float32)
+            aux = jnp.mean(aux_g)
+        combine = combine.astype(x.dtype)
+        dispatch = dispatch.astype(x.dtype)
+
+        ex_in = jnp.einsum("gsec,gsd->egcd", dispatch, x_g)
+        if mesh is not None and axis in mesh.axis_names:
+            ex_in = jax.lax.with_sharding_constraint(
+                ex_in, NamedSharding(mesh, P(axis)))
+        h = act(jnp.einsum("egcd,edh->egch", ex_in, params["w1"])
+                + params["b1"][:, None, None, :])
+        ex_out = (jnp.einsum("egch,ehd->egcd", h, params["w2"])
+                  + params["b2"][:, None, None, :])
+        if mesh is not None and axis in mesh.axis_names:
+            ex_out = jax.lax.with_sharding_constraint(
+                ex_out, NamedSharding(mesh, P(axis)))
+        y_g = jnp.einsum("gsec,egcd->gsd", combine, ex_out)
+        if mesh is not None and axis in mesh.axis_names:
+            y_g = jax.lax.with_sharding_constraint(
+                y_g, NamedSharding(mesh, P(axis)))
+        y = y_g.reshape(g * s, -1)[:n]
+        routed = jnp.sum(dispatch)
+
+    expected = jnp.maximum(n_valid * min(k, e), 1.0)
+    overflow = jnp.maximum(0.0, 1.0 - routed / expected)
+    return y, aux, overflow
 
 
 def expert_sharding(params: Dict[str, Any], mesh: Mesh,
@@ -164,8 +242,12 @@ class MoEFeedForward(Layer):
     Pluggable into MultiLayerNetwork/ComputationGraph like any layer;
     reports its load-balancing auxiliary loss via state["aux_loss"], which
     the model loss closures fold into the score (weighted by aux_weight).
-    Accepts [B, d] or RNN-format [B, d, T] activations.
+    Accepts [B, d] or RNN-format [B, T, d] activations.
     """
+
+    # Consumes [B, d] or [B, T, d] natively — keep the config builder from
+    # inserting an Rnn->FF (last-timestep) preprocessor in front of it.
+    CONSUMES = "any"
 
     n_in: Optional[int] = None
     n_experts: int = 8
@@ -174,6 +256,10 @@ class MoEFeedForward(Layer):
     capacity_factor: float = 1.25
     aux_weight: float = 1e-2
     residual: bool = True
+    # GShard-style grouped dispatch: None = single group (fine for small
+    # batches); set to e.g. 512-1024 at >4k-token scale to keep the
+    # dispatch/combine tensors linear in token count.
+    group_size: Optional[int] = None
 
     def infer_n_in(self, input_type: InputType) -> "MoEFeedForward":
         if self.n_in is None:
@@ -195,27 +281,35 @@ class MoEFeedForward(Layer):
                              for i in range(e)]),
             "b2": jnp.zeros((e, d), dtype),
         }
-        return params, {}
+        # Non-empty init state marks the layer stateful, so the model
+        # runtimes persist the per-step routing metrics into state_tree —
+        # net.state_tree[name]["overflow_frac"] is user-visible after fit.
+        state = {"aux_loss": jnp.zeros((), jnp.float32),
+                 "overflow_frac": jnp.zeros((), jnp.float32)}
+        return params, state
 
     def apply(self, params, x, *, state=None, train=False, rng=None,
               mask=None):
         x = self._maybe_dropout(x, train, rng)
         rnn = x.ndim == 3
         token_mask = None
-        if rnn:  # [B, d, T] (reference RNN layout) -> tokens [B*T, d]
-            b, d, t = x.shape
-            tokens = jnp.transpose(x, (0, 2, 1)).reshape(b * t, d)
+        if rnn:  # [B, T, d] (framework RNN layout, recurrent.py) -> [B*T, d]
+            b, t, d = x.shape
+            tokens = x.reshape(b * t, d)
             if mask is not None:  # [B, T] timestep mask -> [B*T]
                 token_mask = jnp.reshape(mask, (b * t,))
         else:
             tokens = x
         mesh, axis = _active_expert_mesh()
-        y, aux = moe_ffn(params, tokens, k=self.k,
-                         capacity_factor=self.capacity_factor,
-                         activation=self.activation or "gelu",
-                         mesh=mesh, axis=axis, token_mask=token_mask)
+        y, aux, overflow = moe_ffn(
+            params, tokens, k=self.k,
+            capacity_factor=self.capacity_factor,
+            activation=self.activation or "gelu",
+            mesh=mesh, axis=axis, token_mask=token_mask,
+            group_size=self.group_size)
         if self.residual:
             y = y + tokens
         if rnn:
-            y = jnp.transpose(y.reshape(b, t, d), (0, 2, 1))
-        return y, {"aux_loss": self.aux_weight * aux}
+            y = y.reshape(b, t, d)
+        return y, {"aux_loss": self.aux_weight * aux,
+                   "overflow_frac": overflow}

@@ -163,10 +163,10 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
             loss = None
             for k in range(self.averaging_frequency):
                 rng = jax.random.fold_in(wrng, k)  # fresh dropout per step
-                lo = (k * bs) % max(1, idx.size)
-                sel = idx[lo:lo + bs]
-                if sel.size == 0:
-                    break
+                lo = (k * bs) % idx.size
+                # Wrap to a FIXED bs so the jitted step sees one static batch
+                # shape (a short trailing chunk would trigger a recompile).
+                sel = idx[(lo + np.arange(bs)) % idx.size]
                 fx, fy = jnp.asarray(xs[sel]), jnp.asarray(ys[sel])
                 if graph:
                     out = step(params, opt, states, itn,

@@ -157,8 +157,8 @@ class TestMoE:
             "b2": jnp.zeros((e, d), jnp.float32),
         }
         x = jnp.asarray(rng.standard_normal((n, d)), jnp.float32)
-        y, aux = moe_ffn(params, x, k=1, capacity_factor=4.0,
-                         activation="relu")
+        y, aux, _ = moe_ffn(params, x, k=1, capacity_factor=4.0,
+                            activation="relu")
         # direct: every token goes to its argmax expert with softmax gate
         probs = jax.nn.softmax(x @ params["gate"], axis=-1)
         choice = jnp.argmax(probs, axis=-1)
@@ -184,14 +184,14 @@ class TestMoE:
             "b2": jnp.zeros((e, d), jnp.float32),
         }
         x = jnp.asarray(rng.standard_normal((n, d)), jnp.float32)
-        ref, _ = moe_ffn(params, x, k=2)
+        ref, _, _ = moe_ffn(params, x, k=2)
 
         mesh = make_mesh({AXIS_EXPERT: 8}, devices=devices8)
         sharded = jax.device_put(params, expert_sharding(params, mesh))
 
         @jax.jit
         def run(p, xx):
-            y, aux = moe_ffn(p, xx, k=2, mesh=mesh)
+            y, aux, _ = moe_ffn(p, xx, k=2, mesh=mesh)
             return y
 
         np.testing.assert_allclose(np.asarray(run(sharded, x)),
@@ -257,6 +257,122 @@ class TestMoE:
         with expert_mesh(mesh):
             sharded = np.asarray(net.output(x))
         np.testing.assert_allclose(sharded, base, rtol=1e-4, atol=1e-6)
+
+    def test_grouped_matches_ungrouped(self):
+        """With ample capacity and k=1, grouped dispatch routes identically
+        to single-group dispatch (per-group capacity never binds)."""
+        rng = np.random.default_rng(6)
+        d, h, e, n = 8, 16, 4, 128
+        params = {
+            "gate": jnp.asarray(rng.standard_normal((d, e)), jnp.float32),
+            "w1": jnp.asarray(rng.standard_normal((e, d, h)) * 0.1,
+                              jnp.float32),
+            "b1": jnp.zeros((e, h), jnp.float32),
+            "w2": jnp.asarray(rng.standard_normal((e, h, d)) * 0.1,
+                              jnp.float32),
+            "b2": jnp.zeros((e, d), jnp.float32),
+        }
+        x = jnp.asarray(rng.standard_normal((n, d)), jnp.float32)
+        ref, _, ov_ref = moe_ffn(params, x, k=1, capacity_factor=8.0)
+        got, _, ov = moe_ffn(params, x, k=1, capacity_factor=8.0,
+                             group_size=32)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+        assert float(ov) == 0.0 and float(ov_ref) == 0.0
+
+    def test_grouped_handles_ragged_tail_and_mask(self):
+        rng = np.random.default_rng(7)
+        d, h, e, n = 4, 8, 2, 50   # 50 % 16 != 0 -> padded tail group
+        params = {
+            "gate": jnp.asarray(rng.standard_normal((d, e)), jnp.float32),
+            "w1": jnp.asarray(rng.standard_normal((e, d, h)) * 0.1,
+                              jnp.float32),
+            "b1": jnp.zeros((e, h), jnp.float32),
+            "w2": jnp.asarray(rng.standard_normal((e, h, d)) * 0.1,
+                              jnp.float32),
+            "b2": jnp.zeros((e, d), jnp.float32),
+        }
+        x = jnp.asarray(rng.standard_normal((n, d)), jnp.float32)
+        mask = jnp.asarray([1.0] * 40 + [0.0] * 10, jnp.float32)
+        y, aux, ov = moe_ffn(params, x, k=1, capacity_factor=8.0,
+                             group_size=16, token_mask=mask)
+        assert y.shape == (n, d)
+        assert np.isfinite(float(aux)) and float(ov) == 0.0
+
+    def test_overflow_counter_reports_drops(self):
+        """Tiny capacity forces drops; the overflow fraction must be > 0."""
+        rng = np.random.default_rng(8)
+        d, h, e, n = 4, 8, 2, 64
+        params = {
+            "gate": jnp.asarray(np.zeros((d, e)), jnp.float32),  # uniform
+            "w1": jnp.asarray(rng.standard_normal((e, d, h)) * 0.1,
+                              jnp.float32),
+            "b1": jnp.zeros((e, h), jnp.float32),
+            "w2": jnp.asarray(rng.standard_normal((e, h, d)) * 0.1,
+                              jnp.float32),
+            "b2": jnp.zeros((e, d), jnp.float32),
+        }
+        x = jnp.asarray(rng.standard_normal((n, d)), jnp.float32)
+        _, _, ov = moe_ffn(params, x, k=1, capacity_factor=0.1)
+        assert float(ov) > 0.3
+        _, _, ovg = moe_ffn(params, x, k=1, capacity_factor=0.1,
+                            group_size=16)
+        assert float(ovg) > 0.3
+
+    def test_moe_after_lstm_3d_layout(self):
+        """MoE routed after an LSTM: activations are [B, T, F]
+        (recurrent.py layout) with T != F to catch axis transposition."""
+        from deeplearning4j_tpu.models import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.inputs import InputType
+        from deeplearning4j_tpu.nn.layers import LSTM, RnnOutputLayer
+        from deeplearning4j_tpu.optim.updaters import Adam
+
+        b, t, f = 4, 7, 5   # T != F on purpose
+        net = MultiLayerNetwork(
+            (NeuralNetConfiguration.builder()
+             .seed(0).updater(Adam(1e-2))
+             .list(LSTM(n_out=f, activation="tanh"),
+                   MoEFeedForward(n_experts=2, k=1, hidden_mult=2),
+                   RnnOutputLayer(n_out=3, activation="softmax"))
+             .set_input_type(InputType.recurrent(6))
+             .build())).init()
+        rng = np.random.default_rng(9)
+        x = rng.standard_normal((b, t, 6)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (b, t))]
+        out = net.output(x)
+        assert out.shape == (b, t, 3)
+        net.fit(x, y, epochs=2, batch_size=b)
+        assert np.isfinite(net.score_)
+
+    def test_16k_tokens_grouped_emits_all_to_all(self, devices8):
+        """At 16k tokens the grouped path must compile with an all_to_all
+        (G->E resharding over the expert axis) and stay linear-memory."""
+        rng = np.random.default_rng(10)
+        d, h, e, n, s = 16, 32, 8, 16384, 512
+        params = {
+            "gate": jnp.asarray(rng.standard_normal((d, e)), jnp.float32),
+            "w1": jnp.asarray(rng.standard_normal((e, d, h)) * 0.1,
+                              jnp.float32),
+            "b1": jnp.zeros((e, h), jnp.float32),
+            "w2": jnp.asarray(rng.standard_normal((e, h, d)) * 0.1,
+                              jnp.float32),
+            "b2": jnp.zeros((e, d), jnp.float32),
+        }
+        mesh = make_mesh({AXIS_EXPERT: 8}, devices=devices8)
+        sharded = jax.device_put(params, expert_sharding(params, mesh))
+        x = jnp.asarray(rng.standard_normal((n, d)), jnp.float32)
+
+        def run(p, xx):
+            y, aux, ov = moe_ffn(p, xx, k=2, mesh=mesh, group_size=s)
+            return y, ov
+
+        compiled = jax.jit(run).lower(sharded, x).compile()
+        hlo = compiled.as_text()
+        assert "all-to-all" in hlo, "grouped MoE dispatch must use all_to_all"
+        y, ov = compiled(sharded, x)
+        assert y.shape == (n, d)
+        assert 0.0 <= float(ov) <= 1.0
 
     def test_moe_layer_serde_roundtrip(self):
         from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
