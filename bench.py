@@ -1,6 +1,12 @@
 """Benchmark: ResNet-50 training throughput on the real TPU chip.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...} with
+per-step latency and MFU alongside. Never dies silently: the measurement
+runs in a CHILD process (a failed TPU backend init is cached for the life
+of a jax process, so retry must mean a fresh interpreter); the parent
+retries with backoff, degrades through fallback configs (smaller batch ->
+LeNet -> CPU), and emits structured JSON with an "error" field even when
+every attempt fails.
 
 Baseline: the reference repo publishes no numbers (BASELINE.md); the
 north-star target is >=70% of reference A100 images/sec/chip for dl4j-zoo
@@ -9,7 +15,8 @@ training throughput of ~2500 img/s/chip (MLPerf-era mixed precision), so
 vs_baseline = value / (0.7 * 2500) — i.e. vs_baseline >= 1.0 meets the
 target on a per-chip basis.
 
-Env knobs: BENCH_MODEL=resnet50|lenet, BENCH_BATCH, BENCH_STEPS, BENCH_DTYPE.
+Env knobs: BENCH_MODEL=resnet50|vgg16|lstm|lenet, BENCH_BATCH, BENCH_STEPS,
+BENCH_DTYPE, BENCH_ATTEMPT_TIMEOUT (s), BENCH_NO_FALLBACK=1.
 """
 
 from __future__ import annotations
@@ -17,12 +24,77 @@ from __future__ import annotations
 import dataclasses
 import json
 import os
+import subprocess
+import sys
 import time
 
 import numpy as np
 
 A100_REF_IMG_S = 2500.0
 TARGET_FRACTION = 0.70
+
+# Peak dense bf16 matmul throughput per chip, FLOP/s (public spec sheets).
+_PEAK_FLOPS = (
+    ("v6", 918e12),       # Trillium / v6e
+    ("v5p", 459e12),
+    ("v5 lite", 197e12),  # v5e device_kind is "TPU v5 lite"
+    ("v5litepod", 197e12),
+    ("v5e", 197e12),
+    ("v5", 459e12),
+    ("v4", 275e12),
+    ("v3", 123e12),
+    ("v2", 45e12),
+)
+
+
+def _peak_flops(device_kind: str):
+    kind = device_kind.lower()
+    for key, peak in _PEAK_FLOPS:
+        if key in kind:
+            return peak
+    return None
+
+
+def _compile(fn, donate, *args):
+    """AOT-compile a jitted step once; return (callable, flops_per_step).
+
+    Using the AOT executable for BOTH cost analysis and execution avoids a
+    second trace/compile, and cost_analysis gives the exact HLO flop count
+    for the MFU figure (PerformanceListener.java:24-60 is the reference's
+    measurement seam; MFU is the TPU-native extension of it).
+    """
+    import jax
+
+    compiled = jax.jit(fn, donate_argnums=donate).lower(*args).compile()
+    flops = None
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        flops = float(cost.get("flops", 0.0)) or None
+    except Exception:
+        pass
+    return compiled, flops
+
+
+def _timed_ips(run, batch: int, steps: int):
+    """Two-point timing that is robust to the tunneled TPU runtime, where
+    block_until_ready returns early and every host fetch pays seconds of
+    relay latency: run N1 and N2 chained steps, force completion by fetching
+    only the SCALAR loss each time, and difference out the constant
+    latency: per_step = (t2 - t1) / (N2 - N1)."""
+    loss = run(3)           # compile + warmup
+    _ = float(loss)
+    n1 = max(2, steps // 4)
+    n2 = max(steps, n1 + 1)
+    t0 = time.perf_counter()
+    l1 = float(run(n1))
+    t1 = time.perf_counter()
+    l2 = float(run(n2))
+    t2 = time.perf_counter()
+    per_step = ((t2 - t1) - (t1 - t0)) / (n2 - n1)
+    per_step = max(per_step, 1e-9)
+    return batch / per_step, per_step, l2
 
 
 def _bench_resnet50(batch: int, steps: int, dtype: str):
@@ -43,41 +115,22 @@ def _bench_resnet50(batch: int, steps: int, dtype: str):
     x = jnp.asarray(rng.standard_normal((batch, 224, 224, 3)), net.dtype)
     y = jnp.asarray(np.eye(1000, dtype=np.float32)[
         rng.integers(0, 1000, batch)])
-
-    step_fn = jax.jit(net.make_step_fn(), donate_argnums=(0, 1, 2))
     state = [net.params_tree, net.updater_state, net.state_tree]
     key = jax.random.PRNGKey(0)
+    step_fn, flops = _compile(
+        net.make_step_fn(), (0, 1, 2),
+        state[0], state[1], state[2], jnp.asarray(0, jnp.int32),
+        {"input": x}, {"output": y}, None, None, key)
 
     def run(n):
         loss = None
         for i in range(n):
             state[0], state[1], state[2], loss = step_fn(
                 state[0], state[1], state[2], jnp.asarray(i, jnp.int32),
-                {"input": x}, {"output": y}, None, None, key)
+                {"input": x}, {"output": y}, None, None, key)[:4]
         return loss
 
-    return _timed_ips(run, batch, steps)
-
-
-def _timed_ips(run, batch: int, steps: int):
-    """Two-point timing that is robust to the tunneled TPU runtime, where
-    block_until_ready returns early and every host fetch pays seconds of
-    relay latency: run N1 and N2 chained steps, force completion by fetching
-    only the SCALAR loss each time, and difference out the constant
-    latency: per_step = (t2 - t1) / (N2 - N1)."""
-    import time
-
-    loss = run(3)           # compile + warmup
-    _ = float(loss)
-    n1, n2 = max(2, steps // 4), steps
-    t0 = time.perf_counter()
-    l1 = float(run(n1))
-    t1 = time.perf_counter()
-    l2 = float(run(n2))
-    t2 = time.perf_counter()
-    per_step = ((t2 - t1) - (t1 - t0)) / (n2 - n1)
-    per_step = max(per_step, 1e-9)
-    return batch / per_step, l2
+    return _timed_ips(run, batch, steps) + (flops,)
 
 
 def _bench_lenet(batch: int, steps: int, dtype: str):
@@ -92,19 +145,22 @@ def _bench_lenet(batch: int, steps: int, dtype: str):
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.standard_normal((batch, 784)), net.dtype)
     y = jnp.asarray(np.eye(10, dtype=np.float32)[rng.integers(0, 10, batch)])
-    step_fn = jax.jit(net.make_step_fn(), donate_argnums=(0, 1, 2))
     state = [net.params_tree, net.updater_state, net.state_tree]
     key = jax.random.PRNGKey(0)
+    step_fn, flops = _compile(
+        net.make_step_fn(), (0, 1, 2),
+        state[0], state[1], state[2], jnp.asarray(0, jnp.int32),
+        x, y, None, None, key, None)
 
     def run(n):
         loss = None
         for i in range(n):
-            state[0], state[1], state[2], loss, _ = step_fn(
+            state[0], state[1], state[2], loss = step_fn(
                 state[0], state[1], state[2], jnp.asarray(i, jnp.int32),
-                x, y, None, None, key, None)
+                x, y, None, None, key, None)[:4]
         return loss
 
-    return _timed_ips(run, batch, steps)
+    return _timed_ips(run, batch, steps) + (flops,)
 
 
 def _bench_lstm(batch: int, steps: int, dtype: str):
@@ -133,90 +189,184 @@ def _bench_lstm(batch: int, steps: int, dtype: str):
     x = jnp.asarray(rng.standard_normal((batch, T, F)), jnp.float32)
     y = jnp.asarray(np.eye(C, dtype=np.float32)[
         rng.integers(0, C, (batch, T))])
-    step_fn = jax.jit(net.make_step_fn(), donate_argnums=(0, 1, 2))
     state = [net.params_tree, net.updater_state, net.state_tree]
     key = jax.random.PRNGKey(0)
+    step_fn, flops = _compile(
+        net.make_step_fn(), (0, 1, 2),
+        state[0], state[1], state[2], jnp.asarray(0, jnp.int32),
+        x, y, None, None, key, None)
 
     def run(n):
         loss = None
         for i in range(n):
-            state[0], state[1], state[2], loss, _ = step_fn(
+            state[0], state[1], state[2], loss = step_fn(
                 state[0], state[1], state[2], jnp.asarray(i, jnp.int32),
-                x, y, None, None, key, None)
+                x, y, None, None, key, None)[:4]
         return loss
 
-    return _timed_ips(run, batch, steps)
+    return _timed_ips(run, batch, steps) + (flops,)
 
 
 def _bench_vgg16(batch: int, steps: int, dtype: str):
     import jax
     import jax.numpy as jnp
 
-    from deeplearning4j_tpu.models import ComputationGraph
+    from deeplearning4j_tpu.models import ComputationGraph, MultiLayerNetwork
     from deeplearning4j_tpu.optim.updaters import Nesterovs
     from deeplearning4j_tpu.zoo import VGG16
 
     model = VGG16(num_classes=1000, input_shape=(224, 224, 3),
                   updater=Nesterovs(0.01, 0.9))
     conf = dataclasses.replace(model.conf(), dtype=dtype)
-    from deeplearning4j_tpu.models import MultiLayerNetwork
-
     net = (ComputationGraph(conf).init() if hasattr(conf, "vertices")
            else MultiLayerNetwork(conf).init())
     rng = np.random.default_rng(0)
     x = jnp.asarray(rng.standard_normal((batch, 224, 224, 3)), net.dtype)
     y = jnp.asarray(np.eye(1000, dtype=np.float32)[
         rng.integers(0, 1000, batch)])
-    step_fn = jax.jit(net.make_step_fn(), donate_argnums=(0, 1, 2))
     state = [net.params_tree, net.updater_state, net.state_tree]
     key = jax.random.PRNGKey(0)
     graph = hasattr(conf, "vertices")
+    feats = {"input": x} if graph else x
+    labs = {"output": y} if graph else y
+    extra = () if graph else (None,)
+    step_fn, flops = _compile(
+        net.make_step_fn(), (0, 1, 2),
+        state[0], state[1], state[2], jnp.asarray(0, jnp.int32),
+        feats, labs, None, None, key, *extra)
 
     def run(n):
         loss = None
         for i in range(n):
-            if graph:
-                state[0], state[1], state[2], loss = step_fn(
-                    state[0], state[1], state[2], jnp.asarray(i, jnp.int32),
-                    {"input": x}, {"output": y}, None, None, key)[:4]
-            else:
-                state[0], state[1], state[2], loss, _ = step_fn(
-                    state[0], state[1], state[2], jnp.asarray(i, jnp.int32),
-                    x, y, None, None, key, None)
+            state[0], state[1], state[2], loss = step_fn(
+                state[0], state[1], state[2], jnp.asarray(i, jnp.int32),
+                feats, labs, None, None, key, *extra)[:4]
         return loss
 
-    return _timed_ips(run, batch, steps)
+    return _timed_ips(run, batch, steps) + (flops,)
 
 
-def main():
+_BENCHES = {
+    "resnet50": (_bench_resnet50, "resnet50_train_images_per_sec_per_chip",
+                 "images/sec", TARGET_FRACTION * A100_REF_IMG_S),
+    "vgg16": (_bench_vgg16, "vgg16_train_images_per_sec_per_chip",
+              "images/sec", TARGET_FRACTION * 1100.0),  # A100 VGG16 ~1100
+    "lstm": (_bench_lstm, "lstm_train_sequences_per_sec",
+             "sequences/sec", 100.0),   # no published reference; nominal
+    "lenet": (_bench_lenet, "lenet_mnist_train_images_per_sec",
+              "images/sec", 10000.0),   # no published reference; nominal
+}
+
+
+def _child_main():
+    """One measurement in THIS process; prints detailed JSON on success."""
+    import jax
+
+    if os.environ.get("BENCH_FORCE_CPU"):
+        jax.config.update("jax_platforms", "cpu")
+
     model = os.environ.get("BENCH_MODEL", "resnet50")
     batch = int(os.environ.get("BENCH_BATCH", "256"))
     steps = int(os.environ.get("BENCH_STEPS", "40"))
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
 
-    if model == "lenet":
-        ips, loss = _bench_lenet(batch, steps, dtype)
-        metric = "lenet_mnist_train_images_per_sec"
-        vs = ips / 10000.0  # no published reference; nominal anchor
-    elif model == "lstm":
-        ips, loss = _bench_lstm(min(batch, 64), steps, dtype)
-        metric = "lstm_train_sequences_per_sec"
-        vs = ips / 100.0  # no published reference; nominal anchor
+    dev = jax.devices()[0]
+    bench_fn, metric, unit, anchor = _BENCHES[model]
+    if model == "lstm":
+        batch = min(batch, 64)
     elif model == "vgg16":
-        ips, loss = _bench_vgg16(min(batch, 128), steps, dtype)
-        metric = "vgg16_train_images_per_sec_per_chip"
-        vs = ips / (TARGET_FRACTION * 1100.0)  # A100 VGG16 ~1100 img/s
-    else:
-        ips, loss = _bench_resnet50(batch, steps, dtype)
-        metric = "resnet50_train_images_per_sec_per_chip"
-        vs = ips / (TARGET_FRACTION * A100_REF_IMG_S)
+        batch = min(batch, 128)
 
-    unit = "sequences/sec" if model == "lstm" else "images/sec"
+    ips, per_step, loss, flops = bench_fn(batch, steps, dtype)
+    peak = _peak_flops(getattr(dev, "device_kind", ""))
+    mfu = (flops / per_step / peak) if (flops and peak) else None
     print(json.dumps({
         "metric": metric,
         "value": round(ips, 2),
         "unit": unit,
-        "vs_baseline": round(vs, 4),
+        "vs_baseline": round(ips / anchor, 4),
+        "per_step_ms": round(per_step * 1e3, 3),
+        "mfu": round(mfu, 4) if mfu is not None else None,
+        "flops_per_step": flops,
+        "batch": batch,
+        "dtype": dtype,
+        "device": getattr(dev, "device_kind", str(dev)),
+        "platform": dev.platform,
+        "final_loss": round(loss, 4),
+    }))
+
+
+def _attempt_plans():
+    """Ordered (env-overrides, label) fallback ladder. A flaky axon backend
+    init (BENCH_r01's failure mode) gets fresh-process retries; a persistent
+    one degrades to cheaper configs and finally to the CPU backend so the
+    driver always records a structured number."""
+    model = os.environ.get("BENCH_MODEL", "resnet50")
+    batch = int(os.environ.get("BENCH_BATCH", "256"))
+    plans = [
+        ({}, f"{model} b{batch}"),
+        ({}, f"{model} b{batch} retry"),
+        ({"BENCH_BATCH": str(max(32, batch // 2))},
+         f"{model} b{max(32, batch // 2)}"),
+    ]
+    if not os.environ.get("BENCH_NO_FALLBACK"):
+        if model != "lenet":
+            plans.append(({"BENCH_MODEL": "lenet", "BENCH_BATCH": "1024"},
+                          "lenet fallback"))
+        plans.append(({"BENCH_MODEL": "lenet", "BENCH_BATCH": "1024",
+                       "BENCH_FORCE_CPU": "1"}, "lenet cpu fallback"))
+    return plans
+
+
+def main():
+    if os.environ.get("BENCH_CHILD"):
+        _child_main()
+        return
+
+    timeout = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "1200"))
+    backoffs = [15.0, 45.0, 90.0]
+    errors = []
+    for i, (overrides, label) in enumerate(_attempt_plans()):
+        env = dict(os.environ, BENCH_CHILD="1", **overrides)
+        try:
+            proc = subprocess.run(
+                [sys.executable, os.path.abspath(__file__)],
+                env=env, capture_output=True, text=True, timeout=timeout)
+        except subprocess.TimeoutExpired:
+            errors.append(f"{label}: timeout after {timeout}s")
+            continue
+        if proc.returncode == 0:
+            for line in reversed(proc.stdout.strip().splitlines()):
+                try:
+                    result = json.loads(line)
+                    break
+                except json.JSONDecodeError:
+                    continue
+            else:
+                errors.append(f"{label}: rc=0 but no JSON in output")
+                continue
+            result["attempt"] = i + 1
+            result["config"] = label
+            if errors:
+                result["prior_errors"] = errors
+            print(json.dumps(result))
+            return
+        tail = (proc.stderr or proc.stdout or "").strip().splitlines()
+        errors.append(f"{label}: rc={proc.returncode}: "
+                      + " | ".join(tail[-3:]))
+        if i < len(backoffs):
+            time.sleep(backoffs[i])
+
+    # Every attempt failed: still emit the structured line (rc 0) so the
+    # driver records WHY instead of a bare rc=1 like round 1.
+    model = os.environ.get("BENCH_MODEL", "resnet50")
+    _, metric, unit, _ = _BENCHES.get(model, _BENCHES["resnet50"])
+    print(json.dumps({
+        "metric": metric,
+        "value": 0.0,
+        "unit": unit,
+        "vs_baseline": 0.0,
+        "error": errors,
     }))
 
 

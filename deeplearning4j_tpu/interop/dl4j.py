@@ -1,0 +1,669 @@
+"""DL4J checkpoint-container interop: read/write the reference's zip format.
+
+The reference persists models as a zip of three entries
+(`util/ModelSerializer.java:37-119`):
+
+- ``configuration.json`` — the Jackson-serialized MultiLayerConfiguration
+  (`nn/conf/MultiLayerConfiguration.java`), layers wrapped in one-key type
+  objects per ``@JsonTypeInfo(WRAPPER_OBJECT)`` (`nn/conf/layers/Layer.java:47-68`).
+- ``coefficients.bin`` — the single flattened parameter row vector written
+  with ``Nd4j.write`` (two ND4J DataBuffers: shape-info then data, each as
+  ``writeUTF(allocationMode), writeInt(length), writeUTF(dtype), elements``
+  big-endian).
+- ``updaterState.bin`` — the flat updater state view, same array codec.
+
+Per-layer flat layouts (the param-initializer ordering):
+- Dense/Output/Embedding (`nn/params/DefaultParamInitializer.java:60-88`):
+  [W ('f'-order, (nIn, nOut)), b (nOut)].
+- Convolution (`nn/params/ConvolutionParamInitializer.java:76-100`):
+  [b (nOut), W ('c'-order, (nOut, nIn, kH, kW))] — note bias FIRST and 'c'
+  order, unlike everything else.
+- BatchNormalization (`nn/params/BatchNormalizationParamInitializer.java:56-80`):
+  [gamma, beta, mean, var] (gamma/beta absent when lockGammaBeta).
+- GravesLSTM (`nn/params/GravesLSTMParamInitializer.java:57-120`):
+  [W_in ('f', (nIn, 4H)), RW ('f', (H, 4H+3)), b (4H)]. Gate column blocks
+  are [candidate, forget, output, input] (`nn/layers/recurrent/LSTMHelpers.java:
+  180-250` — their "inputActivations" block 0 is the tanh candidate and
+  their "input modulation gate" block 3 is the sigmoid input gate); the
+  three extra RW columns are the peepholes wFF (forget, col 4H), wOO
+  (output, col 4H+1), wGG (input, col 4H+2). This framework's gate order is
+  [input, forget, candidate, output] with peepholes P=[input, forget,
+  output] (nn/layers/recurrent.py), so columns are permuted on the way in.
+
+This is an interop adapter, not a port: imported configs become this
+framework's dataclass configs and imported params land in the pytree param
+store, after which everything runs the TPU-native jit path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+import struct
+import zipfile
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+# --------------------------------------------------------------------------
+# ND4J binary array codec (Nd4j.write / Nd4j.read wire format)
+# --------------------------------------------------------------------------
+
+_DTYPES = {
+    "FLOAT": (">f4", 4),
+    "DOUBLE": (">f8", 8),
+    "INT": (">i4", 4),
+    "LONG": (">i8", 8),
+    "HALF": (">f2", 2),
+}
+
+
+def _read_java_utf(f) -> str:
+    (n,) = struct.unpack(">H", f.read(2))
+    return f.read(n).decode("utf-8")
+
+
+def _write_java_utf(f, s: str) -> None:
+    b = s.encode("utf-8")
+    f.write(struct.pack(">H", len(b)))
+    f.write(b)
+
+
+def _read_buffer(f) -> Tuple[str, np.ndarray]:
+    """One ND4J DataBuffer: (allocation mode UTF, length int32, dtype UTF,
+    big-endian elements)."""
+    alloc = _read_java_utf(f)
+    (length,) = struct.unpack(">i", f.read(4))
+    dtype = _read_java_utf(f)
+    if dtype not in _DTYPES:
+        raise ValueError(f"unsupported ND4J buffer dtype {dtype!r}")
+    fmt, size = _DTYPES[dtype]
+    data = np.frombuffer(f.read(length * size), dtype=fmt, count=length)
+    return alloc, data
+
+
+def _write_buffer(f, data: np.ndarray, dtype: str, alloc: str = "DIRECT"):
+    fmt, _ = _DTYPES[dtype]
+    _write_java_utf(f, alloc)
+    f.write(struct.pack(">i", data.size))
+    _write_java_utf(f, dtype)
+    f.write(np.ascontiguousarray(data, dtype=fmt).tobytes())
+
+
+def read_nd4j_array(f) -> np.ndarray:
+    """Parse one Nd4j.write'd array: shape-info buffer then data buffer.
+
+    Shape info is an int buffer [rank, *shape, *stride, offset,
+    elementWiseStride, order-char] of length 2*rank + 4.
+    """
+    if isinstance(f, (bytes, bytearray)):
+        f = io.BytesIO(f)
+    _, shape_info = _read_buffer(f)
+    rank = int(shape_info[0])
+    if len(shape_info) < 2 * rank + 4:
+        raise ValueError(
+            f"malformed ND4J shape info: rank {rank}, len {len(shape_info)}")
+    shape = tuple(int(s) for s in shape_info[1:1 + rank])
+    order = chr(int(shape_info[2 * rank + 3]))
+    _, data = _read_buffer(f)
+    arr = np.asarray(data)
+    if int(np.prod(shape)) != arr.size:
+        raise ValueError(f"shape {shape} does not match {arr.size} elements")
+    return arr.reshape(shape, order="F" if order == "f" else "C").astype(
+        arr.dtype.newbyteorder("="))
+
+
+def write_nd4j_array(f, arr: np.ndarray, dtype: str = "FLOAT") -> None:
+    """Write `arr` in Nd4j.write format ('c' order, contiguous)."""
+    arr = np.asarray(arr)
+    rank = arr.ndim
+    strides = []
+    acc = 1
+    for s in reversed(arr.shape):   # 'c'-order element strides
+        strides.insert(0, acc)
+        acc *= s
+    shape_info = np.asarray(
+        [rank, *arr.shape, *strides, 0, 1, ord("c")], dtype=">i4")
+    _write_buffer(f, shape_info, "INT")
+    _write_buffer(f, arr.reshape(-1, order="C"), dtype)
+
+
+# --------------------------------------------------------------------------
+# Enum / name translation
+# --------------------------------------------------------------------------
+
+# IActivation impl class suffix (or legacy enum string) -> framework name.
+_ACT_MAP = {
+    "relu": "relu", "leakyrelu": "leakyrelu", "lrelu": "leakyrelu",
+    "tanh": "tanh", "sigmoid": "sigmoid", "softmax": "softmax",
+    "identity": "identity", "linear": "identity", "elu": "elu",
+    "selu": "selu", "softplus": "softplus", "softsign": "softsign",
+    "hardtanh": "hardtanh", "hardsigmoid": "hardsigmoid", "cube": "cube",
+    "rationaltanh": "rationaltanh", "rectifiedtanh": "rectifiedtanh",
+    "swish": "swish", "gelu": "gelu",
+}
+
+# LossFn impl class suffix / legacy LossFunctions enum -> framework name.
+_LOSS_MAP = {
+    "mcxent": "mcxent", "negativeloglikelihood": "negativeloglikelihood",
+    "mse": "mse", "l2": "l2", "l1": "l1", "xent": "xent",
+    "binaryxent": "xent", "kldivergence": "kl_divergence", "kld": "kl_divergence",
+    "mae": "mae", "meanabsoluteerror": "mae",
+    "meansquaredlogarithmicerror": "msle", "msle": "msle",
+    "meanabsolutepercentageerror": "mape", "mape": "mape",
+    "hinge": "hinge", "squaredhinge": "squared_hinge",
+    "poisson": "poisson", "cosineproximity": "cosine_proximity",
+    "reconstructioncrossentropy": "reconstruction_crossentropy",
+    "squaredloss": "squared_loss", "wasserstein": "wasserstein",
+}
+
+_LOSS_TO_DL4J = {
+    "mcxent": "MCXENT", "negativeloglikelihood": "NEGATIVELOGLIKELIHOOD",
+    "mse": "MSE", "l2": "L2", "l1": "L1", "xent": "XENT",
+    "kl_divergence": "KL_DIVERGENCE", "mae": "MEAN_ABSOLUTE_ERROR",
+    "msle": "MEAN_SQUARED_LOGARITHMIC_ERROR",
+    "mape": "MEAN_ABSOLUTE_PERCENTAGE_ERROR", "hinge": "HINGE",
+    "squared_hinge": "SQUARED_HINGE", "poisson": "POISSON",
+    "cosine_proximity": "COSINE_PROXIMITY",
+    "reconstruction_crossentropy": "RECONSTRUCTION_CROSSENTROPY",
+    "squared_loss": "SQUARED_LOSS", "wasserstein": "WASSERSTEIN",
+}
+
+
+def _act_from_dl4j(layer_json: Dict[str, Any]) -> Optional[str]:
+    fn = layer_json.get("activationFn")
+    if isinstance(fn, dict):
+        cls = fn.get("@class", "")
+        name = cls.rsplit(".", 1)[-1]        # e.g. ActivationReLU
+        key = name.replace("Activation", "").replace("H", "h").lower()
+        key = key.replace("-", "")
+        hit = _ACT_MAP.get(key) or _ACT_MAP.get(
+            name.replace("Activation", "").lower())
+        if hit:
+            return hit
+        raise ValueError(f"unmapped DL4J activation {cls!r}")
+    legacy = layer_json.get("activationFunction") or layer_json.get(
+        "activation")
+    if isinstance(legacy, str):
+        key = legacy.replace("_", "").lower()
+        if key in _ACT_MAP:
+            return _ACT_MAP[key]
+        raise ValueError(f"unmapped DL4J activation {legacy!r}")
+    return None
+
+
+def _act_to_dl4j(name: Optional[str]) -> Dict[str, Any]:
+    cls = {
+        "relu": "ActivationReLU", "leakyrelu": "ActivationLReLU",
+        "tanh": "ActivationTanH", "sigmoid": "ActivationSigmoid",
+        "softmax": "ActivationSoftmax", "identity": "ActivationIdentity",
+        "elu": "ActivationELU", "selu": "ActivationSELU",
+        "softplus": "ActivationSoftPlus", "softsign": "ActivationSoftSign",
+        "hardtanh": "ActivationHardTanH",
+        "hardsigmoid": "ActivationHardSigmoid", "cube": "ActivationCube",
+        "rationaltanh": "ActivationRationalTanh",
+        "rectifiedtanh": "ActivationRectifiedTanh",
+    }.get(name or "identity", "ActivationIdentity")
+    return {"@class": f"org.nd4j.linalg.activations.impl.{cls}"}
+
+
+def _loss_from_dl4j(layer_json: Dict[str, Any]) -> str:
+    fn = layer_json.get("lossFn")
+    if isinstance(fn, dict):
+        cls = fn.get("@class", "").rsplit(".", 1)[-1]   # e.g. LossMCXENT
+        key = cls.replace("Loss", "", 1).replace("_", "").lower()
+        if key in _LOSS_MAP:
+            return _LOSS_MAP[key]
+        raise ValueError(f"unmapped DL4J loss {cls!r}")
+    legacy = layer_json.get("lossFunction")
+    if isinstance(legacy, str):
+        key = legacy.replace("_", "").lower()
+        if key in _LOSS_MAP:
+            return _LOSS_MAP[key]
+        raise ValueError(f"unmapped DL4J loss {legacy!r}")
+    return "mcxent"
+
+
+def _weight_init_from_dl4j(name: Optional[str]) -> Optional[str]:
+    return name.lower() if isinstance(name, str) else None
+
+
+def _get(d: Dict[str, Any], *keys, default=None):
+    for k in keys:
+        if k in d and d[k] is not None:
+            return d[k]
+    return default
+
+
+# --------------------------------------------------------------------------
+# Layer config translation
+# --------------------------------------------------------------------------
+
+def _layer_from_dl4j(type_name: str, d: Dict[str, Any]):
+    """One DL4J layer JSON (already unwrapped from its type object) ->
+    framework layer dataclass."""
+    from deeplearning4j_tpu.nn.layers import (
+        ActivationLayer, BatchNormalization, ConvolutionLayer, DenseLayer,
+        DropoutLayer, EmbeddingLayer, GlobalPoolingLayer, GravesLSTM,
+        LocalResponseNormalization, LossLayer, LSTM, OutputLayer,
+        RnnOutputLayer, SubsamplingLayer, ZeroPaddingLayer,
+    )
+
+    common = dict(
+        name=d.get("layerName"),
+        activation=_act_from_dl4j(d),
+        weight_init=_weight_init_from_dl4j(d.get("weightInit")),
+        l1=d.get("l1") or None,
+        l2=d.get("l2") or None,
+        dropout=d.get("dropOut") or None,
+        bias_init=d.get("biasInit") or None,
+    )
+    nin = _get(d, "nin", "nIn", "NIn")
+    nout = _get(d, "nout", "nOut", "NOut")
+
+    if type_name == "dense":
+        return DenseLayer(n_in=nin, n_out=nout, **common)
+    if type_name == "output":
+        return OutputLayer(n_in=nin, n_out=nout, loss=_loss_from_dl4j(d),
+                           **common)
+    if type_name == "rnnoutput":
+        return RnnOutputLayer(n_in=nin, n_out=nout, loss=_loss_from_dl4j(d),
+                              **common)
+    if type_name == "loss":
+        return LossLayer(loss=_loss_from_dl4j(d), **common)
+    if type_name == "embedding":
+        return EmbeddingLayer(n_in=nin, n_out=nout, **common)
+    if type_name == "convolution":
+        return ConvolutionLayer(
+            n_in=nin, n_out=nout,
+            kernel=tuple(d.get("kernelSize", (3, 3))),
+            stride=tuple(d.get("stride", (1, 1))),
+            padding=tuple(d.get("padding", (0, 0))),
+            convolution_mode=(d.get("convolutionMode") or "truncate").lower(),
+            **common)
+    if type_name == "subsampling":
+        return SubsamplingLayer(
+            pooling=(d.get("poolingType") or "MAX").lower(),
+            kernel=tuple(d.get("kernelSize", (2, 2))),
+            stride=tuple(d.get("stride", (2, 2))),
+            padding=tuple(d.get("padding", (0, 0))),
+            convolution_mode=(d.get("convolutionMode") or "truncate").lower(),
+            **common)
+    if type_name == "batchNormalization":
+        return BatchNormalization(
+            n_out=nout or nin,
+            decay=d.get("decay", 0.9), eps=d.get("eps", 1e-5),
+            lock_gamma_beta=bool(d.get("lockGammaBeta", False)),
+            **common)
+    if type_name == "localResponseNormalization":
+        return LocalResponseNormalization(
+            n=d.get("n", 5), k=d.get("k", 2.0),
+            alpha=d.get("alpha", 1e-4), beta=d.get("beta", 0.75), **common)
+    if type_name in ("gravesLSTM", "LSTM"):
+        cls = GravesLSTM if type_name == "gravesLSTM" else LSTM
+        fb = d.get("forgetGateBiasInit", 1.0)
+        return cls(n_in=nin, n_out=nout, forget_gate_bias_init=fb, **common)
+    if type_name == "activation":
+        return ActivationLayer(**common)
+    if type_name == "dropout":
+        return DropoutLayer(**common)
+    if type_name == "GlobalPooling":
+        return GlobalPoolingLayer(
+            pooling=(d.get("poolingType") or "MAX").lower(), **common)
+    if type_name == "zeroPadding":
+        pad = d.get("padding", (0, 0))
+        return ZeroPaddingLayer(pad=tuple(pad), **common)
+    raise ValueError(f"unsupported DL4J layer type {type_name!r} "
+                     f"(supported: dense/output/rnnoutput/loss/embedding/"
+                     f"convolution/subsampling/batchNormalization/LRN/"
+                     f"gravesLSTM/LSTM/activation/dropout/GlobalPooling/"
+                     f"zeroPadding)")
+
+
+def _layer_to_dl4j(layer) -> Tuple[str, Dict[str, Any]]:
+    from deeplearning4j_tpu.nn.layers import (
+        ActivationLayer, BatchNormalization, ConvolutionLayer, DenseLayer,
+        DropoutLayer, EmbeddingLayer, GlobalPoolingLayer, GravesLSTM,
+        LocalResponseNormalization, LossLayer, LSTM, OutputLayer,
+        RnnOutputLayer, SubsamplingLayer, ZeroPaddingLayer,
+    )
+
+    d: Dict[str, Any] = {
+        "layerName": layer.name,
+        "activationFn": _act_to_dl4j(layer.activation),
+        "weightInit": (layer.weight_init or "xavier").upper(),
+        "biasInit": layer.bias_init or 0.0,
+        "l1": layer.l1 or 0.0, "l2": layer.l2 or 0.0,
+        "dropOut": layer.dropout or 0.0,
+    }
+
+    def ff(extra=None):
+        d.update({"nin": layer.n_in, "nout": layer.n_out})
+        d.update(extra or {})
+        return d
+
+    if isinstance(layer, RnnOutputLayer):
+        return "rnnoutput", ff({"lossFn": _loss_ref(layer.loss)})
+    if isinstance(layer, OutputLayer):
+        return "output", ff({"lossFn": _loss_ref(layer.loss)})
+    if isinstance(layer, LossLayer):
+        d["lossFn"] = _loss_ref(layer.loss)
+        return "loss", d
+    if isinstance(layer, EmbeddingLayer):
+        return "embedding", ff()
+    if isinstance(layer, ConvolutionLayer) and type(layer).__name__ == "ConvolutionLayer":
+        return "convolution", ff({
+            "kernelSize": list(_pair(layer.kernel)),
+            "stride": list(_pair(layer.stride)),
+            "padding": list(_pair(layer.padding)),
+            "convolutionMode": (layer.convolution_mode or "truncate").title(),
+        })
+    if isinstance(layer, SubsamplingLayer):
+        d.update({
+            "poolingType": layer.pooling.upper(),
+            "kernelSize": list(_pair(layer.kernel)),
+            "stride": list(_pair(layer.stride)),
+            "padding": list(_pair(layer.padding)),
+        })
+        return "subsampling", d
+    if isinstance(layer, BatchNormalization):
+        d.update({"nin": layer.n_out, "nout": layer.n_out,
+                  "decay": layer.decay, "eps": layer.eps,
+                  "lockGammaBeta": layer.lock_gamma_beta})
+        return "batchNormalization", d
+    if isinstance(layer, LocalResponseNormalization):
+        d.update({"n": layer.n, "k": layer.k, "alpha": layer.alpha,
+                  "beta": layer.beta})
+        return "localResponseNormalization", d
+    if isinstance(layer, GravesLSTM):
+        return "gravesLSTM", ff(
+            {"forgetGateBiasInit": layer.forget_gate_bias_init})
+    if isinstance(layer, LSTM):
+        return "LSTM", ff(
+            {"forgetGateBiasInit": layer.forget_gate_bias_init})
+    if isinstance(layer, ActivationLayer):
+        return "activation", d
+    if isinstance(layer, DropoutLayer):
+        return "dropout", d
+    if isinstance(layer, GlobalPoolingLayer):
+        d["poolingType"] = layer.pooling.upper()
+        return "GlobalPooling", d
+    if isinstance(layer, ZeroPaddingLayer):
+        d["padding"] = list(layer.pad) if isinstance(
+            layer.pad, (tuple, list)) else [layer.pad, layer.pad]
+        return "zeroPadding", d
+    if isinstance(layer, DenseLayer):
+        return "dense", ff()
+    raise ValueError(
+        f"layer type {type(layer).__name__} has no DL4J JSON mapping")
+
+
+_LOSS_CLASS = {
+    # exact DL4J impl class names (org.nd4j.linalg.lossfunctions.impl.*)
+    "mcxent": "LossMCXENT", "negativeloglikelihood": "LossNegativeLogLikelihood",
+    "mse": "LossMSE", "l1": "LossL1", "l2": "LossL2",
+    "xent": "LossBinaryXENT", "kl_divergence": "LossKLD",
+    "mae": "LossMAE", "msle": "LossMSLE", "mape": "LossMAPE",
+    "hinge": "LossHinge", "squared_hinge": "LossSquaredHinge",
+    "poisson": "LossPoisson", "cosine_proximity": "LossCosineProximity",
+}
+
+
+def _loss_ref(name) -> Dict[str, Any]:
+    cls = _LOSS_CLASS.get(str(name), "LossMCXENT")
+    return {"@class": f"org.nd4j.linalg.lossfunctions.impl.{cls}"}
+
+
+def _pair(v):
+    return tuple(v) if isinstance(v, (tuple, list)) else (v, v)
+
+
+# --------------------------------------------------------------------------
+# Flat parameter codec (param-initializer ordering)
+# --------------------------------------------------------------------------
+
+def _lstm_col_perm(h: int, to_framework: bool) -> np.ndarray:
+    """Column permutation between DL4J gate blocks [cand, f, o, i] and this
+    framework's [i, f, cand, o] (see module docstring)."""
+    blocks_dl4j_to_fw = [3, 1, 0, 2]   # fw block j comes from dl4j block[j]
+    idx = np.arange(4 * h).reshape(4, h)
+    if to_framework:
+        return np.concatenate([idx[b] for b in blocks_dl4j_to_fw])
+    # inverse: dl4j block j comes from fw block inv[j]
+    inv = [blocks_dl4j_to_fw.index(j) for j in range(4)]
+    return np.concatenate([idx[b] for b in inv])
+
+
+def _params_from_flat(layer, flat: np.ndarray) -> Tuple[
+        Dict[str, np.ndarray], Dict[str, np.ndarray], int]:
+    """Consume `layer`'s DL4J flat segment; return (params, state, used)."""
+    from deeplearning4j_tpu.nn.layers import (
+        BatchNormalization, ConvolutionLayer, LSTM,
+    )
+    from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer, EmbeddingLayer
+
+    if isinstance(layer, ConvolutionLayer) and hasattr(layer, "kernel") \
+            and type(layer).__name__ == "ConvolutionLayer":
+        kh, kw = _pair(layer.kernel)
+        nin, nout = layer.n_in, layer.n_out
+        nb, nw = nout, nout * nin * kh * kw
+        b = flat[:nb]
+        w = flat[nb:nb + nw].reshape((nout, nin, kh, kw), order="C")
+        return ({"W": np.transpose(w, (2, 3, 1, 0)).copy(), "b": b.copy()},
+                {}, nb + nw)
+    if isinstance(layer, BatchNormalization):
+        n = layer.n_out
+        used = 0
+        params: Dict[str, np.ndarray] = {}
+        if not layer.lock_gamma_beta:
+            params = {"gamma": flat[:n].copy(), "beta": flat[n:2 * n].copy()}
+            used = 2 * n
+        state = {"mean": flat[used:used + n].copy(),
+                 "var": flat[used + n:used + 2 * n].copy()}
+        return params, state, used + 2 * n
+    if isinstance(layer, LSTM):          # covers GravesLSTM
+        h, nin = layer.n_out, layer.n_in
+        peep = layer.peephole
+        rw_cols = 4 * h + (3 if peep else 0)
+        n_w, n_rw, n_b = nin * 4 * h, h * rw_cols, 4 * h
+        perm = _lstm_col_perm(h, to_framework=True)
+        w = flat[:n_w].reshape((nin, 4 * h), order="F")[:, perm]
+        rw_full = flat[n_w:n_w + n_rw].reshape((h, rw_cols), order="F")
+        rw = rw_full[:, :4 * h][:, perm]
+        b = flat[n_w + n_rw:n_w + n_rw + n_b][perm]
+        params = {"W": w.copy(), "RW": rw.copy(), "b": b.copy()}
+        if peep:
+            # cols: 4H=wFF(forget), 4H+1=wOO(output), 4H+2=wGG(input)
+            params["P"] = np.stack([
+                rw_full[:, 4 * h + 2],   # input peephole
+                rw_full[:, 4 * h],       # forget peephole
+                rw_full[:, 4 * h + 1],   # output peephole
+            ]).copy()
+        return params, {}, n_w + n_rw + n_b
+    if isinstance(layer, (DenseLayer, EmbeddingLayer)):  # + Output subclasses
+        nin, nout = layer.n_in, layer.n_out
+        nw = nin * nout
+        w = flat[:nw].reshape((nin, nout), order="F")
+        params = {"W": w.copy()}
+        used = nw
+        if getattr(layer, "has_bias", True):
+            params["b"] = flat[nw:nw + nout].copy()
+            used += nout
+        return params, {}, used
+    return {}, {}, 0    # parameterless layer
+
+
+def _params_to_flat(layer, params: Dict[str, Any],
+                    state: Dict[str, Any]) -> np.ndarray:
+    from deeplearning4j_tpu.nn.layers import (
+        BatchNormalization, ConvolutionLayer, LSTM,
+    )
+    from deeplearning4j_tpu.nn.layers.feedforward import DenseLayer, EmbeddingLayer
+
+    def f32(a):
+        return np.asarray(a, dtype=np.float32)
+
+    if isinstance(layer, ConvolutionLayer) \
+            and type(layer).__name__ == "ConvolutionLayer":
+        w = np.transpose(f32(params["W"]), (3, 2, 0, 1))  # HWIO -> OIHW
+        return np.concatenate([f32(params["b"]).ravel(),
+                               w.reshape(-1, order="C")])
+    if isinstance(layer, BatchNormalization):
+        parts = []
+        if not layer.lock_gamma_beta:
+            parts += [f32(params["gamma"]).ravel(), f32(params["beta"]).ravel()]
+        parts += [f32(state["mean"]).ravel(), f32(state["var"]).ravel()]
+        return np.concatenate(parts)
+    if isinstance(layer, LSTM):
+        h = layer.n_out
+        perm = _lstm_col_perm(h, to_framework=False)
+        w = f32(params["W"])[:, perm]
+        rw = f32(params["RW"])[:, perm]
+        b = f32(params["b"])[perm]
+        if layer.peephole:
+            p = f32(params["P"])
+            extra = np.stack([p[1], p[2], p[0]], axis=1)  # wFF, wOO, wGG
+            rw = np.concatenate([rw, extra], axis=1)
+        return np.concatenate([w.reshape(-1, order="F"),
+                               rw.reshape(-1, order="F"), b.ravel()])
+    if isinstance(layer, (DenseLayer, EmbeddingLayer)):
+        parts = [f32(params["W"]).reshape(-1, order="F")]
+        if "b" in params:
+            parts.append(f32(params["b"]).ravel())
+        return np.concatenate(parts)
+    return np.zeros((0,), np.float32)
+
+
+# --------------------------------------------------------------------------
+# Zip container import / export
+# --------------------------------------------------------------------------
+
+def import_dl4j_model(path, *, input_type=None, updater=None, dtype=None):
+    """Load a DL4J MultiLayerNetwork zip (configuration.json +
+    coefficients.bin [+ updaterState.bin]) into a MultiLayerNetwork.
+
+    input_type: optional InputType for shape-dependent nets (CNNs); when
+    omitted the layer nIn/nOut fields from the config are used as-is.
+    The raw updater-state vector (if present) is attached as
+    ``net.dl4j_updater_state`` — DL4J updater blocks don't map 1:1 onto
+    this framework's per-layer optimizer pytrees, so remapping is left to
+    the caller.
+    """
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.optim.updaters import Sgd
+
+    with zipfile.ZipFile(path) as zf:
+        conf_json = json.loads(zf.read("configuration.json"))
+        coeffs = read_nd4j_array(zf.read("coefficients.bin"))
+        upd_raw = None
+        for entry in ("updaterState.bin", "updater.bin"):
+            if entry in zf.namelist():
+                try:
+                    upd_raw = read_nd4j_array(zf.read(entry))
+                except ValueError:
+                    pass   # old updater.bin is Java serialization, skip
+                break
+
+    if "vertices" in conf_json:
+        raise ValueError(
+            "ComputationGraph zips are not supported yet; MLN zips only")
+
+    layers = []
+    for conf in conf_json.get("confs", []):
+        wrapper = conf["layer"]
+        (type_name, layer_json), = wrapper.items()
+        layers.append(_layer_from_dl4j(type_name, layer_json))
+
+    builder = NeuralNetConfiguration.builder()
+    if updater is not None:
+        builder = builder.updater(updater)
+    else:
+        builder = builder.updater(Sgd(0.1))
+    lb = builder.list(*layers)
+    if input_type is not None:
+        lb = lb.set_input_type(input_type)
+    tf = conf_json.get("tbpttFwdLength") or 0
+    tb = conf_json.get("tbpttBackLength") or 0
+    if tf:
+        lb = lb.tbptt(tf, tb or tf)
+    mlconf = lb.build()
+    if dtype is not None:
+        mlconf = dataclasses.replace(mlconf, dtype=dtype)
+    net = MultiLayerNetwork(mlconf).init()
+
+    flat = np.asarray(coeffs, np.float32).ravel(order="C")
+    off = 0
+    for layer in net.layers:
+        try:
+            p, s, used = _params_from_flat(layer, flat[off:])
+        except ValueError as e:
+            raise ValueError(
+                f"coefficients.bin too short for layer {layer.name!r} "
+                f"({type(layer).__name__}) at offset {off}: {e}") from None
+        off += used
+        if p:
+            net.params_tree[layer.name] = {
+                k: jnp.asarray(v, net.params_tree[layer.name][k].dtype)
+                if k in net.params_tree[layer.name] else jnp.asarray(v)
+                for k, v in p.items()
+            }
+        if s:
+            net.state_tree[layer.name] = {
+                k: jnp.asarray(v) for k, v in s.items()
+            }
+    if off != flat.size:
+        raise ValueError(
+            f"coefficients.bin has {flat.size} params, config consumes {off}")
+    net.dl4j_updater_state = upd_raw
+    return net
+
+
+def export_dl4j_model(net, path, *, save_updater: bool = False) -> None:
+    """Write `net` (MultiLayerNetwork) as a DL4J-layout zip: the reference's
+    ModelSerializer container (configuration.json + coefficients.bin).
+
+    save_updater flattens this framework's optimizer pytree in parameter
+    order — layout differs from DL4J's updater blocks (documented; primarily
+    for round-trips within this framework).
+    """
+    confs = []
+    for layer in net.layers:
+        type_name, layer_json = _layer_to_dl4j(layer)
+        confs.append({"layer": {type_name: layer_json}})
+
+    conf_json = {
+        "backprop": True,
+        "backpropType": "Standard",
+        "pretrain": False,
+        "confs": confs,
+        "tbpttFwdLength": getattr(net.conf, "tbptt_fwd_length", 0) or 0,
+        "tbpttBackLength": getattr(net.conf, "tbptt_back_length", 0) or 0,
+    }
+
+    segs: List[np.ndarray] = []
+    for layer in net.layers:
+        segs.append(_params_to_flat(
+            layer, net.params_tree.get(layer.name, {}),
+            net.state_tree.get(layer.name, {})))
+    flat = (np.concatenate([s for s in segs if s.size])
+            if any(s.size for s in segs) else np.zeros((0,), np.float32))
+
+    with zipfile.ZipFile(path, "w") as zf:
+        zf.writestr("configuration.json", json.dumps(conf_json, indent=2))
+        buf = io.BytesIO()
+        write_nd4j_array(buf, flat.reshape(1, -1))
+        zf.writestr("coefficients.bin", buf.getvalue())
+        if save_updater:
+            import jax
+
+            leaves = jax.tree_util.tree_leaves(net.updater_state)
+            state = (np.concatenate(
+                [np.asarray(l, np.float32).ravel() for l in leaves])
+                if leaves else np.zeros((0,), np.float32))
+            buf = io.BytesIO()
+            write_nd4j_array(buf, state.reshape(1, -1))
+            zf.writestr("updaterState.bin", buf.getvalue())

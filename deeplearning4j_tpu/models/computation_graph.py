@@ -26,7 +26,9 @@ from deeplearning4j_tpu.nn.graph import (
     ComputationGraphConfiguration, GraphVertex, LayerVertex,
 )
 from deeplearning4j_tpu.nn.layers.special import CenterLossOutputLayer
-from deeplearning4j_tpu.models.multilayer import _dtype_of, _normalize_grads
+from deeplearning4j_tpu.models.multilayer import (
+    _dtype_of, _is_recurrent, _normalize_grads,
+)
 from deeplearning4j_tpu.optim.listeners import TrainingListener
 from deeplearning4j_tpu.optim.updaters import NoOp, Updater, resolve_updater
 from deeplearning4j_tpu.utils.pytrees import (
@@ -51,6 +53,7 @@ class ComputationGraph:
         self.last_batch_size: Optional[int] = None
         self.score_: Optional[float] = None
         self._rng = jax.random.PRNGKey(conf.seed)
+        self._rnn_carries: Dict[str, Any] = {}  # rnnTimeStep statefulness
         self._stateful: set = set()
         self._vertex_updaters: Dict[str, Updater] = {}
         self._jit_cache: Dict[Any, Any] = {}
@@ -98,18 +101,36 @@ class ComputationGraph:
             self._vertex_updaters[name] = u
 
     # ---------------------------------------------------------- forward
+    @property
+    def _rnn_vertex_names(self) -> List[str]:
+        """Vertices that carry RNN state (tBPTT / rnnTimeStep persistence)."""
+        if not hasattr(self, "_rnn_names_cache"):
+            self._rnn_names_cache = [
+                n for n, v in self.conf.vertices.items()
+                if isinstance(v, LayerVertex) and _is_recurrent(v.layer)
+            ]
+        return self._rnn_names_cache
+
     def _forward(self, params, states, inputs: Dict[str, Any], *, train, rng,
-                 fmasks: Optional[Dict[str, Any]] = None):
+                 fmasks: Optional[Dict[str, Any]] = None,
+                 carries: Optional[Dict[str, Any]] = None,
+                 stop_before: Optional[str] = None):
         """Fold over topological order. Returns (values, out_inputs, states)
         where out_inputs[name] is the input activation each output layer saw
-        (needed for fused-loss score)."""
+        (needed for fused-loss score). `carries` override the stored state of
+        recurrent vertices (tBPTT / rnnTimeStep statefulness — reference:
+        `ComputationGraph.rnnTimeStep` / `rnnUpdateStateWithTBPTTState`)."""
         values: Dict[str, Any] = dict(inputs)
         out_inputs: Dict[str, Any] = {}
         new_states: Dict[str, Any] = {}
         for idx, name in enumerate(self.conf.topological_order):
+            if name == stop_before:
+                break
             v = self.conf.vertices[name]
             ins = [values[i] for i in self.conf.vertex_inputs[name]]
             st = states.get(name) or None
+            if carries is not None and name in carries:
+                st = carries[name]
             lrng = None if rng is None else jax.random.fold_in(rng, idx)
             mask = None
             if fmasks:
@@ -133,9 +154,10 @@ class ComputationGraph:
 
     # ------------------------------------------------------------- loss
     def _loss(self, params, states, inputs, labels: Dict[str, Any],
-              fmasks, lmasks, rng, train=True):
+              fmasks, lmasks, rng, train=True, carries=None):
         values, out_inputs, new_states = self._forward(
-            params, states, inputs, train=train, rng=rng, fmasks=fmasks)
+            params, states, inputs, train=train, rng=rng, fmasks=fmasks,
+            carries=carries)
         total = jnp.asarray(0.0, jnp.float32)
         for name in self.conf.network_outputs:
             v = self.conf.vertices[name]
@@ -161,29 +183,31 @@ class ComputationGraph:
         return total, new_states
 
     # ------------------------------------------------------ train step
-    def make_step_fn(self):
+    def make_step_fn(self, tbptt: bool = False):
         """Pure (un-jitted) train-step fn for parallel trainers (see
         MultiLayerNetwork.make_step_fn)."""
-        return self._build_step(jit=False)
+        return self._build_step(jit=False, tbptt=tbptt)
 
-    def _get_train_step(self, key):
+    def _get_train_step(self, key, tbptt: bool = False):
+        key = (key, tbptt)
         if key in self._jit_cache:
             return self._jit_cache[key]
-        fn = self._build_step(jit=True)
+        fn = self._build_step(jit=True, tbptt=tbptt)
         self._jit_cache[key] = fn
         return fn
 
-    def _build_step(self, jit: bool):
+    def _build_step(self, jit: bool, tbptt: bool = False):
         mode = self.conf.gradient_normalization
         thr = self.conf.gradient_normalization_threshold
         updaters = self._vertex_updaters
         stateful = self._stateful
+        rnn_names = self._rnn_vertex_names
 
         def step_fn(params, opt_state, states, step, inputs, labels,
-                    fmasks, lmasks, rng):
+                    fmasks, lmasks, rng, carries=None):
             def loss_fn(p):
                 return self._loss(p, states, inputs, labels, fmasks, lmasks,
-                                  rng, train=True)
+                                  rng, train=True, carries=carries)
 
             (loss, new_states), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(params)
@@ -201,6 +225,15 @@ class ComputationGraph:
                 n: (new_states[n] if n in stateful else states.get(n, {}))
                 for n in states
             }
+            if tbptt:
+                # Carry RNN state to the next chunk, gradients truncated at
+                # the chunk boundary (reference:
+                # `ComputationGraph.rnnUpdateStateWithTBPTTState`).
+                out_carries = {
+                    n: _tmap(jax.lax.stop_gradient, new_states[n])
+                    for n in rnn_names
+                }
+                return new_params, new_opt, persist, loss, out_carries
             return new_params, new_opt, persist, loss
 
         if not jit:
@@ -253,14 +286,20 @@ class ComputationGraph:
             for ds in iterable():
                 feats, labs, fmasks, lmasks = self._to_dicts(ds)
                 self.last_batch_size = next(iter(feats.values())).shape[0]
-                key = (fmasks is not None, lmasks is not None)
-                fn = self._get_train_step(key)
-                self._rng, k = jax.random.split(self._rng)
-                (self.params_tree, self.updater_state, self.state_tree, loss
-                 ) = fn(self.params_tree, self.updater_state, self.state_tree,
-                        jnp.asarray(self.iteration, jnp.int32),
-                        feats, labs, fmasks, lmasks, k)
-                self.score_ = float(loss)
+                if (self.conf.tbptt_fwd_length > 0
+                        and all(v.ndim == 3 for v in feats.values())):
+                    loss = self._fit_tbptt(feats, labs, fmasks, lmasks)
+                else:
+                    key = (fmasks is not None, lmasks is not None)
+                    fn = self._get_train_step(key)
+                    self._rng, k = jax.random.split(self._rng)
+                    (self.params_tree, self.updater_state, self.state_tree,
+                     loss) = fn(self.params_tree, self.updater_state,
+                                self.state_tree,
+                                jnp.asarray(self.iteration, jnp.int32),
+                                feats, labs, fmasks, lmasks, k)
+                    loss = float(loss)
+                self.score_ = loss
                 self.iteration += 1
                 for l in self.listeners:
                     l.iteration_done(self, self.iteration, self.epoch, self.score_)
@@ -269,6 +308,137 @@ class ComputationGraph:
             self.epoch += 1
         for l in self.listeners:
             l.on_fit_end(self)
+        return self
+
+    def _fit_tbptt(self, feats, labs, fmasks, lmasks) -> float:
+        """Truncated BPTT over every 3-D input/label dict entry; RNN vertex
+        state carried across chunks with stop_gradient. Reference:
+        `ComputationGraph.fit` tBPTT dispatch (`:778`) + doTruncatedBPTT."""
+        L = self.conf.tbptt_fwd_length
+        Lb = min(self.conf.tbptt_back_length or L, L)
+        T = next(iter(feats.values())).shape[1]
+        for name, lab in labs.items():
+            if lab.ndim != 3:
+                raise ValueError(
+                    f"Truncated BPTT requires per-timestep 3-D labels; "
+                    f"output {name!r} has shape {tuple(lab.shape)}")
+        key = (fmasks is not None, lmasks is not None)
+        fn = self._get_train_step(key, tbptt=True)
+        carries = {}
+        losses = []
+        for lo in range(0, T, L):
+            hi = min(lo + L, T)
+            t_lo = lo
+
+            def sl(d, a, b):
+                return None if d is None else {
+                    n: jnp.asarray(v[:, a:b]) for n, v in d.items()}
+
+            if Lb < hi - lo:
+                # fwd > back: advance carries over the prefix, no update.
+                t_lo = hi - Lb
+                carries = self._advance_carries(
+                    sl(feats, lo, t_lo), sl(fmasks, lo, t_lo), carries)
+            self._rng, k = jax.random.split(self._rng)
+            (self.params_tree, self.updater_state, self.state_tree, loss,
+             carries) = fn(
+                self.params_tree, self.updater_state, self.state_tree,
+                jnp.asarray(self.iteration, jnp.int32),
+                sl(feats, t_lo, hi), sl(labs, t_lo, hi),
+                sl(fmasks, t_lo, hi), sl(lmasks, t_lo, hi), k,
+                carries if carries else None)
+            losses.append(float(loss))
+        return float(np.mean(losses))
+
+    def _advance_carries(self, feats, fmasks, carries):
+        """Gradient-free forward that only advances RNN vertex carries."""
+        key = ("advance", fmasks is not None, bool(carries))
+        if key not in self._jit_cache:
+            rnn_names = self._rnn_vertex_names
+
+            def adv(params, states, inputs, fm, car):
+                _, _, new_states = self._forward(
+                    params, states, inputs, train=False, rng=None,
+                    fmasks=fm, carries=car)
+                return {n: new_states[n] for n in rnn_names}
+
+            self._jit_cache[key] = jax.jit(adv)
+        return self._jit_cache[key](
+            self.params_tree, self.state_tree, feats, fmasks,
+            carries if carries else None)
+
+    # ----------------------------------------------------- rnn stepping
+    def rnn_time_step(self, *xs):
+        """Stateful single-step inference; RNN vertex carries persist across
+        calls. Reference: `ComputationGraph.rnnTimeStep`."""
+        inputs = {}
+        for n, x in zip(self.conf.network_inputs, xs):
+            x = jnp.asarray(x, self.dtype)
+            if x.ndim == 2:
+                x = x[:, None, :]
+            inputs[n] = x
+        values, _, new_states = self._forward(
+            self.params_tree, self.state_tree, inputs, train=False, rng=None,
+            carries=self._rnn_carries or None)
+        self._rnn_carries = {
+            n: new_states[n] for n in self._rnn_vertex_names
+        }
+        outs = [values[o] for o in self.conf.network_outputs]
+        return outs[0] if len(outs) == 1 else outs
+
+    def rnn_clear_previous_state(self):
+        """Reference: `ComputationGraph.rnnClearPreviousState`."""
+        self._rnn_carries = {}
+
+    # -------------------------------------------------------- pretrain
+    def pretrain(self, data, *, epochs: int = 1, batch_size: int = 32):
+        """Greedy layerwise unsupervised pretraining of pretrainable layer
+        vertices (AutoEncoder/RBM/VAE), in topological order. Reference:
+        `ComputationGraph.pretrain(DataSetIterator)`."""
+        it = as_iterator(data, None, batch_size)
+        for name in self.conf.topological_order:
+            v = self.conf.vertices[name]
+            if not (isinstance(v, LayerVertex) and v.layer.is_pretrainable):
+                continue
+            layer, vertex = v.layer, v
+            updater = self._vertex_updaters[name]
+            opt = updater.init(self.params_tree[name])
+
+            def featurize(params, states, feats):
+                """This vertex's input activation under the current params:
+                fold the DAG only up to (not including) this vertex."""
+                values, _, _ = self._forward(
+                    params, states, feats, train=False, rng=None,
+                    stop_before=name)
+                x = values[self.conf.vertex_inputs[name][0]]
+                if vertex.preprocessor is not None:
+                    x = vertex.preprocessor.apply(x)
+                return x
+
+            @jax.jit
+            def pre_step(params, lp, opt_state, step, feats, rng):
+                x = featurize(params, self.state_tree, feats)
+
+                def loss_fn(p):
+                    return layer.reconstruction_score(p, x, rng=rng)
+
+                loss, grads = jax.value_and_grad(loss_fn)(lp)
+                upd, new_opt = updater.apply(grads, opt_state, lp, step)
+                new_lp = _tmap(lambda a, b: a - b.astype(a.dtype), lp, upd)
+                new_opt = _tmap(lambda n, o: n.astype(o.dtype), new_opt,
+                                opt_state)
+                return new_lp, new_opt, loss
+
+            step = 0
+            for _ in range(epochs):
+                for ds in it:
+                    feats, _, _, _ = self._to_dicts(ds)
+                    self._rng, k = jax.random.split(self._rng)
+                    lp, opt, _ = pre_step(
+                        self.params_tree, self.params_tree[name], opt,
+                        jnp.asarray(step, jnp.int32), feats, k)
+                    self.params_tree[name] = lp
+                    step += 1
         return self
 
     # -------------------------------------------------------- inference

@@ -216,13 +216,21 @@ class MultiLayerNetwork:
         self._jit_cache[key] = fn
         return fn
 
+    @property
+    def _rnn_layer_names(self):
+        """Layers that carry RNN state (tBPTT / rnnTimeStep persistence)."""
+        if not hasattr(self, "_rnn_names_cache"):
+            self._rnn_names_cache = [
+                l.name for l in self.layers if _is_recurrent(l)]
+        return self._rnn_names_cache
+
     def _build_step(self, key, jit: bool):
         has_fmask, has_lmask, tbptt = key[0], key[1], key[2]
         mode = self.conf.gradient_normalization
         thr = self.conf.gradient_normalization_threshold
         updaters = self._layer_updaters
         stateful = self._stateful
-        rnn_names = [l.name for l in self.layers if _is_recurrent(l)]
+        rnn_names = self._rnn_layer_names
 
         def step_fn(params, opt_state, states, step, features, labels,
                     fmask, lmask, rng, carries):
@@ -330,10 +338,15 @@ class MultiLayerNetwork:
         return float(loss)
 
     def _fit_tbptt(self, ds: DataSet) -> float:
-        """Truncated BPTT: slice time into chunks, carry RNN state across
-        chunks with stop_gradient. Reference: `doTruncatedBPTT`
+        """Truncated BPTT: slice time into fwd-length chunks, carry RNN
+        state across chunks with stop_gradient. When tbptt_back_length <
+        tbptt_fwd_length, the first (fwd - back) steps of each chunk only
+        advance the carries (no gradient, no update) and the train step
+        covers the last `back` steps — gradients flow at most back_length
+        steps, the reference's fwd != back truncation
         (`MultiLayerNetwork.java:1102-1104,1351`)."""
         L = self.conf.tbptt_fwd_length
+        Lb = min(self.conf.tbptt_back_length or L, L)
         T = ds.features.shape[1]
         if ds.labels is None or ds.labels.ndim != 3:
             raise ValueError(
@@ -347,17 +360,43 @@ class MultiLayerNetwork:
         losses = []
         for lo in range(0, T, L):
             hi = min(lo + L, T)
-            sl = lambda a: None if a is None else jnp.asarray(a[:, lo:hi])
+            t_lo = lo
+            if Lb < hi - lo:
+                t_lo = hi - Lb
+                carries = self._advance_carries(
+                    jnp.asarray(ds.features[:, lo:t_lo], self.dtype),
+                    None if ds.features_mask is None
+                    else jnp.asarray(ds.features_mask[:, lo:t_lo]),
+                    carries)
+            sl = lambda a: None if a is None else jnp.asarray(a[:, t_lo:hi])
             (self.params_tree, self.updater_state, self.state_tree, loss,
              carries) = fn(
                 self.params_tree, self.updater_state, self.state_tree,
                 jnp.asarray(self.iteration, jnp.int32),
-                jnp.asarray(ds.features[:, lo:hi], self.dtype),
+                jnp.asarray(ds.features[:, t_lo:hi], self.dtype),
                 sl(ds.labels), sl(ds.features_mask), sl(ds.labels_mask),
                 self._split_rng(), carries if carries else None)
             losses.append(float(loss))
         self.last_batch_size = ds.num_examples()
         return float(np.mean(losses))
+
+    def _advance_carries(self, feats, fmask, carries):
+        """Gradient-free forward that only moves the RNN carries along —
+        the no-update prefix of a fwd>back tBPTT chunk."""
+        key = ("advance", fmask is not None, bool(carries))
+        if key not in self._jit_cache:
+            rnn_names = self._rnn_layer_names
+
+            def adv(params, states, x, fm, car):
+                _, _, new_states, _ = self._forward(
+                    params, states, x, train=False, rng=None, fmask=fm,
+                    carries=car)
+                return {n: new_states[n] for n in rnn_names}
+
+            self._jit_cache[key] = jax.jit(adv)
+        return self._jit_cache[key](
+            self.params_tree, self.state_tree, feats, fmask,
+            carries if carries else None)
 
     # -------------------------------------------------------- inference
     def output(self, x, train: bool = False):
@@ -419,7 +458,7 @@ class MultiLayerNetwork:
             self.params_tree, self.state_tree, x, train=False, rng=None,
             carries=self._rnn_carries or None)
         self._rnn_carries = {
-            l.name: new_states[l.name] for l in self.layers if _is_recurrent(l)
+            n: new_states[n] for n in self._rnn_layer_names
         }
         return out
 

@@ -172,3 +172,143 @@ class TestGraphGradients:
                     rng, train=train)
 
         assert check_gradients(_Shim, x, y)
+
+
+class TestGraphRnn:
+    """CG twins of the MLN LSTM suites (VERDICT round-1 gap: tBPTT,
+    rnn_time_step, pretrain were MLN-only). Reference:
+    `ComputationGraph.java:778` (fit w/ tBPTT dispatch), rnnTimeStep,
+    pretrain."""
+
+    def _lstm_graph(self, cls=None, tbptt=0, tbptt_back=None, n_in=4, h=5,
+                    classes=3):
+        from deeplearning4j_tpu.nn.layers import LSTM, RnnOutputLayer
+        cls = cls or LSTM
+        gb = (NeuralNetConfiguration.builder()
+              .seed(4).updater(Sgd(0.1)).activation("tanh")
+              .graph_builder()
+              .add_inputs("in")
+              .add_layer("lstm", cls(n_out=h), "in")
+              .add_layer("out", RnnOutputLayer(n_out=classes,
+                                               activation="softmax",
+                                               loss="mcxent"), "lstm")
+              .set_outputs("out")
+              .set_input_types(InputType.recurrent(n_in)))
+        if tbptt:
+            gb = gb.tbptt(tbptt, tbptt_back)
+        return gb.build()
+
+    def _shim(self, net):
+        class _Shim:
+            params_tree = net.params_tree
+            state_tree = net.state_tree
+
+            @staticmethod
+            def _loss(params, states, features, labels, fmask, lmask, rng,
+                      train=False):
+                return net._loss(
+                    params, states, {"in": features}, {"out": labels},
+                    None if fmask is None else {"in": fmask},
+                    None if lmask is None else {"out": lmask},
+                    rng, train=train)
+
+        return _Shim
+
+    def test_gradient_check_lstm_graph(self):
+        from deeplearning4j_tpu.nn.layers import LSTM, GravesLSTM
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((3, 6, 4))
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (3, 6))]
+        for cls in (LSTM, GravesLSTM):
+            net = ComputationGraph(self._lstm_graph(cls)).init()
+            assert check_gradients(self._shim(net), x, y, subset=80), cls
+
+    def test_gradient_check_lstm_graph_masked(self):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((3, 6, 4))
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, (3, 6))]
+        mask = np.ones((3, 6))
+        mask[0, 4:] = 0
+        mask[2, 2:] = 0
+        net = ComputationGraph(self._lstm_graph()).init()
+        assert check_gradients(self._shim(net), x, y, features_mask=mask,
+                               labels_mask=mask, subset=80)
+
+    def test_rnn_time_step_matches_full_forward(self):
+        net = ComputationGraph(self._lstm_graph()).init()
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((2, 5, 4)).astype(np.float32)
+        full = np.asarray(net.output(x))
+        net.rnn_clear_previous_state()
+        steps = [np.asarray(net.rnn_time_step(x[:, t])) for t in range(5)]
+        stepped = np.concatenate(steps, axis=1)
+        np.testing.assert_allclose(full, stepped, rtol=1e-4, atol=1e-5)
+        # clearing state restarts the sequence
+        net.rnn_clear_previous_state()
+        again = np.asarray(net.rnn_time_step(x[:, 0]))
+        np.testing.assert_allclose(again, steps[0], rtol=1e-5)
+
+    def test_tbptt_fit_learns(self):
+        conf = self._lstm_graph(tbptt=4, classes=2)
+        net = ComputationGraph(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((4, 12, 4)).astype(np.float32)
+        w = rng.standard_normal((4, 2))
+        y = np.eye(2, dtype=np.float32)[(x @ w).argmax(-1)]
+        net.fit(x, y, epochs=1, batch_size=4)
+        first = net.score_
+        net.fit(x, y, epochs=15, batch_size=4)
+        assert np.isfinite(net.score_) and net.score_ < first
+
+    def test_tbptt_back_shorter_than_fwd(self):
+        conf = self._lstm_graph(tbptt=6, tbptt_back=3, classes=2)
+        net = ComputationGraph(conf).init()
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((3, 12, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, (3, 12))]
+        net.fit(x, y, epochs=2, batch_size=3)
+        assert np.isfinite(net.score_)
+
+    def test_tbptt_rejects_2d_labels(self):
+        from deeplearning4j_tpu.nn.layers import LSTM, LastTimeStep
+        conf = (NeuralNetConfiguration.builder()
+                .seed(0).updater(Sgd(0.1)).activation("tanh")
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("lstm", LSTM(n_out=4), "in")
+                .add_layer("last", LastTimeStep(layer=LSTM(n_out=4)), "lstm")
+                .add_layer("out", OutputLayer(n_out=2, activation="softmax"),
+                           "last")
+                .set_outputs("out")
+                .set_input_types(InputType.recurrent(3))
+                .tbptt(4)
+                .build())
+        net = ComputationGraph(conf).init()
+        x = np.zeros((2, 8, 3), np.float32)
+        y = np.eye(2, dtype=np.float32)[[0, 1]]
+        with pytest.raises(ValueError, match="per-timestep"):
+            net.fit(x, y, epochs=1, batch_size=2)
+
+    def test_pretrain_autoencoder_vertex(self):
+        from deeplearning4j_tpu.nn.layers import AutoEncoder
+        conf = (NeuralNetConfiguration.builder()
+                .seed(0).updater(Adam(1e-2)).activation("sigmoid")
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("ae", AutoEncoder(n_out=6), "in")
+                .add_layer("out", OutputLayer(n_out=2, activation="softmax"),
+                           "ae")
+                .set_outputs("out")
+                .set_input_types(InputType.feed_forward(10))
+                .build())
+        net = ComputationGraph(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.random((64, 10)).astype(np.float32)
+        ae = conf.vertices["ae"].layer
+        import jax.numpy as jnp
+        before = float(ae.reconstruction_score(
+            net.params_tree["ae"], jnp.asarray(x)))
+        net.pretrain(x, epochs=30, batch_size=32)
+        after = float(ae.reconstruction_score(
+            net.params_tree["ae"], jnp.asarray(x)))
+        assert after < before * 0.8, (before, after)

@@ -207,6 +207,25 @@ class TestRnn:
         net.fit(x, y, epochs=3, batch_size=4)
         assert net.score_ is not None and np.isfinite(net.score_)
 
+    def test_tbptt_back_shorter_than_fwd(self):
+        """tbptt(6, 3): chunk prefix advances carries gradient-free, train
+        step covers the last 3 steps (reference fwd != back truncation,
+        `MultiLayerNetwork.java:1102-1104`)."""
+        conf = (NeuralNetConfiguration.builder()
+                .seed(4).updater(Adam(1e-2)).activation("tanh")
+                .list(LSTM(n_out=5),
+                      RnnOutputLayer(n_out=2, activation="softmax"))
+                .set_input_type(InputType.recurrent(3))
+                .tbptt(6, 3)
+                .build())
+        assert conf.tbptt_back_length == 3
+        net = MultiLayerNetwork(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((4, 12, 3)).astype(np.float32)
+        y = _onehot(rng.integers(0, 2, (4, 12)), 2)
+        net.fit(x, y, epochs=3, batch_size=4)
+        assert net.score_ is not None and np.isfinite(net.score_)
+
     def test_tbptt_rejects_2d_labels(self):
         conf = (NeuralNetConfiguration.builder()
                 .list(LSTM(n_out=4), LastTimeStep(layer=LSTM(n_out=4)),
