@@ -9,8 +9,10 @@ JavaCPP JNI bindings; here h5py plays that role.
 """
 
 from deeplearning4j_tpu.keras_import.importer import (
-    KerasModelImport, import_keras_model_and_weights,
+    KerasModelImport, import_keras_configuration,
+    import_keras_model_and_weights,
 )
 from deeplearning4j_tpu.keras_import.h5 import Hdf5Archive
 
-__all__ = ["KerasModelImport", "import_keras_model_and_weights", "Hdf5Archive"]
+__all__ = ["KerasModelImport", "import_keras_configuration",
+           "import_keras_model_and_weights", "Hdf5Archive"]

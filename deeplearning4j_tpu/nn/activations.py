@@ -109,6 +109,14 @@ def log_softmax(x):
     return jax.nn.log_softmax(x, axis=-1)
 
 
+def exp(x):
+    return jnp.exp(x)
+
+
+def clippedrelu(x, max_value: float = 6.0):
+    return jnp.clip(x, 0.0, max_value)
+
+
 def thresholdedrelu(x, theta: float = 1.0):
     return jnp.where(x > theta, x, 0.0)
 
@@ -139,6 +147,8 @@ _REGISTRY: Dict[str, Callable] = {
     "softmax": softmax,
     "logsoftmax": log_softmax,
     "thresholdedrelu": thresholdedrelu,
+    "exp": exp,
+    "clippedrelu": clippedrelu,
 }
 
 
@@ -173,6 +183,14 @@ class Activation:
         if callable(name_or_fn):
             return name_or_fn
         key = str(name_or_fn).lower()
+        if ":" in key:
+            # Parametrized form "name:value" (e.g. "leakyrelu:0.2"), kept as a
+            # plain string so layer configs stay JSON-serializable. Used by the
+            # Keras importer for LeakyReLU/ELU/ThresholdedReLU alpha/theta.
+            base, _, arg = key.partition(":")
+            if base in _REGISTRY and arg:
+                fn, val = _REGISTRY[base], float(arg)
+                return lambda x: fn(x, val)
         if key not in _REGISTRY:
             raise ValueError(
                 f"Unknown activation {name_or_fn!r}; known: {sorted(_REGISTRY)}"

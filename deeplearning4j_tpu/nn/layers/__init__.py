@@ -10,7 +10,7 @@ and the whole forward pass traces into a single XLA computation.
 from deeplearning4j_tpu.nn.layers.base import Layer, LAYER_REGISTRY
 from deeplearning4j_tpu.nn.layers.feedforward import (
     DenseLayer, OutputLayer, LossLayer, ActivationLayer, DropoutLayer,
-    EmbeddingLayer, EmbeddingSequenceLayer, AutoEncoder,
+    EmbeddingLayer, EmbeddingSequenceLayer, AutoEncoder, PReLULayer,
 )
 from deeplearning4j_tpu.nn.layers.convolution import (
     ConvolutionLayer, Convolution1DLayer, SubsamplingLayer, Subsampling1DLayer,
@@ -33,7 +33,7 @@ from deeplearning4j_tpu.nn.layers.attention import MultiHeadAttention
 __all__ = [
     "Layer", "LAYER_REGISTRY",
     "DenseLayer", "OutputLayer", "LossLayer", "ActivationLayer", "DropoutLayer",
-    "EmbeddingLayer", "EmbeddingSequenceLayer", "AutoEncoder",
+    "EmbeddingLayer", "EmbeddingSequenceLayer", "AutoEncoder", "PReLULayer",
     "ConvolutionLayer", "Convolution1DLayer", "SubsamplingLayer",
     "Subsampling1DLayer", "ZeroPaddingLayer", "Upsampling2DLayer",
     "SeparableConvolution2DLayer", "Deconvolution2DLayer",

@@ -111,6 +111,37 @@ class ActivationLayer(Layer):
 
 @register_layer
 @dataclasses.dataclass(frozen=True)
+class PReLULayer(Layer):
+    """Parametric ReLU with a learnable per-feature slope (reference:
+    `nn/conf/layers/PReLULayer` precedent; Keras `PReLU` with
+    shared_axes covering all but the last axis). alpha initializes to
+    `alpha_init` (Keras default 0)."""
+
+    n_out: Optional[int] = None
+    alpha_init: float = 0.0
+
+    def infer_n_in(self, input_type):
+        if self.n_out is None:
+            # alpha broadcasts over the trailing (feature/channel) axis
+            n = (input_type.channels if input_type.kind in ("cnn", "cnn3d")
+                 else input_type.size)
+            return dataclasses.replace(self, n_out=n)
+        return self
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        n = self.n_out
+        if n is None:
+            n = (input_type.channels if input_type.kind in ("cnn", "cnn3d")
+                 else input_type.size)
+        return {"alpha": jnp.full((n,), self.alpha_init, dtype)}, {}
+
+    def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        a = params["alpha"]
+        return jnp.where(x >= 0, x, a * x), state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
 class DropoutLayer(Layer):
     """Standalone dropout. Reference: `nn/conf/layers/DropoutLayer.java`."""
 

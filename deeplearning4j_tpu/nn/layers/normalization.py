@@ -31,6 +31,8 @@ class BatchNormalization(Layer):
     decay: float = 0.9
     eps: float = 1e-5
     lock_gamma_beta: bool = False
+    scale: bool = True            # learnable gamma (Keras scale flag)
+    center: bool = True           # learnable beta (Keras center flag)
 
     def infer_n_in(self, input_type: InputType) -> "BatchNormalization":
         if self.n_out is None:
@@ -44,7 +46,10 @@ class BatchNormalization(Layer):
         f = self.n_out
         params = {}
         if not self.lock_gamma_beta:
-            params = {"gamma": jnp.ones((f,), dtype), "beta": jnp.zeros((f,), dtype)}
+            if self.scale:
+                params["gamma"] = jnp.ones((f,), dtype)
+            if self.center:
+                params["beta"] = jnp.zeros((f,), dtype)
         state = {"mean": jnp.zeros((f,), dtype), "var": jnp.ones((f,), dtype)}
         return params, state
 
@@ -64,7 +69,10 @@ class BatchNormalization(Layer):
         inv = 1.0 / jnp.sqrt(var + self.eps)
         y = (x - mean) * inv
         if not self.lock_gamma_beta:
-            y = y * params["gamma"] + params["beta"]
+            if self.scale:
+                y = y * params["gamma"]
+            if self.center:
+                y = y + params["beta"]
         return self._act(y), new_state
 
 

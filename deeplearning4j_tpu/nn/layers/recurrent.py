@@ -175,17 +175,31 @@ class GravesLSTM(LSTM):
 @register_layer
 @dataclasses.dataclass(frozen=True)
 class GRU(BaseRecurrentLayer):
-    """GRU — modern extension (the reference snapshot has no GRU impl)."""
+    """GRU — modern extension (the reference snapshot has no GRU impl).
+
+    `reset_after` picks where the reset gate applies: True (default, the
+    cuDNN/Keras-2 GRU-v2 variant) multiplies r into the already-computed
+    recurrent matmul (n = act(xW + r·(h RW))); False (classic Cho et al. /
+    Keras reset_after=False) multiplies r into the hidden state BEFORE the
+    matmul (n = act(xW + (r·h) RW)). `recurrent_bias=True` adds a separate
+    bias on the recurrent matmul (only meaningful with reset_after=True) —
+    both are needed for exact Keras import."""
+
+    reset_after: bool = True
+    recurrent_bias: bool = False
 
     def init_params(self, key, input_type, dtype=jnp.float32):
         h = self.n_out
         k1, k2 = jax.random.split(key)
         winit = self._winit()
-        return {
+        params = {
             "W": winit(k1, (self.n_in, 3 * h), dtype),
             "RW": winit(k2, (h, 3 * h), dtype),
             "b": jnp.zeros((3 * h,), dtype),
-        }, {}
+        }
+        if self.recurrent_bias:
+            params["rb"] = jnp.zeros((3 * h,), dtype)
+        return params, {}
 
     def initial_carry(self, batch: int, dtype=jnp.float32):
         return {"h": jnp.zeros((batch, self.n_out), dtype)}
@@ -204,10 +218,19 @@ class GRU(BaseRecurrentLayer):
         def step(c, inp):
             xw_t, m_t = inp
             h_prev = c["h"]
-            rh = h_prev @ params["RW"]
-            r = gate_act(xw_t[:, :hsz] + rh[:, :hsz])
-            z = gate_act(xw_t[:, hsz:2 * hsz] + rh[:, hsz:2 * hsz])
-            n = self._act(xw_t[:, 2 * hsz:] + r * rh[:, 2 * hsz:])
+            if self.reset_after:
+                rh = h_prev @ params["RW"]
+                if "rb" in params:
+                    rh = rh + params["rb"]
+                r = gate_act(xw_t[:, :hsz] + rh[:, :hsz])
+                z = gate_act(xw_t[:, hsz:2 * hsz] + rh[:, hsz:2 * hsz])
+                n = self._act(xw_t[:, 2 * hsz:] + r * rh[:, 2 * hsz:])
+            else:
+                rz = h_prev @ params["RW"][:, :2 * hsz]
+                r = gate_act(xw_t[:, :hsz] + rz[:, :hsz])
+                z = gate_act(xw_t[:, hsz:2 * hsz] + rz[:, hsz:])
+                n = self._act(xw_t[:, 2 * hsz:]
+                              + (r * h_prev) @ params["RW"][:, 2 * hsz:])
             h = (1 - z) * n + z * h_prev
             h = _mask_carry(h, h_prev, m_t)
             return {"h": h}, h
@@ -262,6 +285,11 @@ class Bidirectional(Layer):
 
     layer: Optional[Any] = None
     merge: str = "concat"
+    # False = emit only the final state of each direction, merged (Keras
+    # Bidirectional(..., return_sequences=False)): forward's last step with
+    # backward's FULL-sequence state (which aligns with t=0) — NOT the last
+    # timestep of the re-flipped backward output.
+    return_sequences: bool = True
 
     def infer_n_in(self, input_type: InputType):
         return dataclasses.replace(self, layer=self.layer.infer_n_in(input_type))
@@ -272,9 +300,10 @@ class Bidirectional(Layer):
 
     def output_type(self, input_type: InputType) -> InputType:
         inner = self.layer.output_type(input_type)
-        if self.merge == "concat":
-            return InputType.recurrent(inner.size * 2, inner.timesteps)
-        return inner
+        size = inner.size * 2 if self.merge == "concat" else inner.size
+        if not self.return_sequences:
+            return InputType.feed_forward(size)
+        return InputType.recurrent(size, inner.timesteps)
 
     def init_params(self, key, input_type, dtype=jnp.float32):
         kf, kb = jax.random.split(key)
@@ -290,18 +319,32 @@ class Bidirectional(Layer):
         xr = jnp.flip(x, axis=1)
         mr = None if mask is None else jnp.flip(mask, axis=1)
         yb, _ = self.layer.apply(params["bwd"], xr, train=train, rng=rb, mask=mr)
+        if not self.return_sequences:
+            # Forward: last unmasked step. Backward: its own final scan step
+            # (reversed time puts right-padding first, where the mask carries
+            # the initial state through, so index -1 is the full-seq state).
+            if mask is None:
+                hf = yf[:, -1, :]
+            else:
+                idx = jnp.maximum(
+                    jnp.sum(mask, axis=1).astype(jnp.int32) - 1, 0)
+                hf = jnp.take_along_axis(
+                    yf, idx[:, None, None], axis=1)[:, 0, :]
+            hb = yb[:, -1, :]
+            return self._merge(hf, hb), state
         yb = jnp.flip(yb, axis=1)
+        return self._merge(yf, yb), state
+
+    def _merge(self, yf, yb):
         if self.merge == "concat":
-            y = jnp.concatenate([yf, yb], axis=-1)
-        elif self.merge == "add":
-            y = yf + yb
-        elif self.merge == "mul":
-            y = yf * yb
-        elif self.merge in ("ave", "average"):
-            y = 0.5 * (yf + yb)
-        else:
-            raise ValueError(f"Unknown merge {self.merge!r}")
-        return y, state
+            return jnp.concatenate([yf, yb], axis=-1)
+        if self.merge == "add":
+            return yf + yb
+        if self.merge == "mul":
+            return yf * yb
+        if self.merge in ("ave", "average"):
+            return 0.5 * (yf + yb)
+        raise ValueError(f"Unknown merge {self.merge!r}")
 
 
 @register_layer

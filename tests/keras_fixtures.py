@@ -19,6 +19,197 @@ def write_weights(grp, layer_name, arrays):
     sub.attrs["weight_names"] = names
 
 
+class _FunctionalH5Builder:
+    """Builds a Keras-2 functional-model .h5 (config JSON + weight groups)
+    without TensorFlow. Tracks per-tensor channel counts so conv/BN weight
+    shapes come out right."""
+
+    def __init__(self, seed=0):
+        self.rng = np.random.default_rng(seed)
+        self.layers = []
+        self.weights = {}  # name -> list of arrays
+        self.channels = {}  # tensor name -> channel count
+        self.counter = {}
+
+    def _name(self, kind):
+        i = self.counter.get(kind, 0)
+        self.counter[kind] = i + 1
+        return kind if i == 0 else f"{kind}_{i}"
+
+    def add(self, class_name, config, inputs, name=None, weights=None):
+        name = name or self._name(class_name.lower())
+        config = dict(config, name=name)
+        entry = {"class_name": class_name, "name": name, "config": config}
+        if inputs is not None:
+            entry["inbound_nodes"] = [[[i, 0, 0, {}] for i in inputs]]
+        self.layers.append(entry)
+        if weights:
+            self.weights[name] = weights
+        return name
+
+    def input(self, shape, name="input_1"):
+        self.add("InputLayer", {"batch_input_shape": [None, *shape]},
+                 None, name=name)
+        self.channels[name] = shape[-1]
+        return name
+
+    def conv_bn(self, x, filters, kh, kw, strides=(1, 1), padding="same"):
+        """keras.applications conv2d_bn: Conv2D(use_bias=False) +
+        BatchNormalization(scale=False) + relu Activation."""
+        cin = self.channels[x]
+        kernel = (self.rng.standard_normal((kh, kw, cin, filters))
+                  / np.sqrt(kh * kw * cin)).astype(np.float32)
+        c = self.add("Conv2D", {
+            "filters": filters, "kernel_size": [kh, kw],
+            "strides": list(strides), "padding": padding,
+            "use_bias": False, "activation": "linear"}, [x],
+            weights=[kernel])
+        beta = self.rng.standard_normal(filters).astype(np.float32) * 0.1
+        mean = self.rng.standard_normal(filters).astype(np.float32) * 0.1
+        var = (1.0 + 0.1 * self.rng.random(filters)).astype(np.float32)
+        b = self.add("BatchNormalization",
+                     {"epsilon": 1e-3, "momentum": 0.99, "scale": False},
+                     [c], weights=[beta, mean, var])
+        a = self.add("Activation", {"activation": "relu"}, [b])
+        self.channels[a] = filters
+        return a
+
+    def pool(self, x, kind, size, strides, padding="valid", name=None):
+        p = self.add(kind, {"pool_size": list(size),
+                            "strides": list(strides), "padding": padding},
+                     [x], name=name)
+        self.channels[p] = self.channels[x]
+        return p
+
+    def concat(self, xs, name):
+        c = self.add("Concatenate", {"axis": -1}, xs, name=name)
+        self.channels[c] = sum(self.channels[x] for x in xs)
+        return c
+
+    def finish(self, path, out_name, input_names=("input_1",)):
+        import h5py
+
+        config = {
+            "class_name": "Model",
+            "config": {
+                "name": "model",
+                "layers": self.layers,
+                "input_layers": [[n, 0, 0] for n in input_names],
+                "output_layers": [[out_name, 0, 0]],
+            },
+        }
+        with h5py.File(path, "w") as f:
+            f.attrs["model_config"] = json.dumps(config)
+            mw = f.create_group("model_weights")
+            mw.attrs["layer_names"] = [
+                l["name"].encode() for l in self.layers]
+            mw.attrs["keras_version"] = b"2.1.6"
+            for lname, arrays in self.weights.items():
+                sub = mw.create_group(lname)
+                names = []
+                for j, arr in enumerate(arrays):
+                    wn = f"{lname}/w{j}:0"
+                    sub.create_dataset(f"w{j}:0", data=arr)
+                    names.append(wn.encode())
+                sub.attrs["weight_names"] = names
+        return config
+
+
+def make_inception_v3_h5(path, *, scale=8, classes=16, input_size=75, seed=0):
+    """The genuine InceptionV3 topology (keras.applications.inception_v3:
+    stem, mixed0-10 inception blocks with asymmetric 1x7/7x1 convs and
+    nested branch concats, GAP head) with all channel counts divided by
+    `scale` to keep the fixture small. 94 Conv2D + 94 BN layers at any scale.
+    """
+    b = _FunctionalH5Builder(seed=seed)
+
+    def s(n):
+        return max(2, n // scale)
+
+    x = b.input((input_size, input_size, 3))
+    # --- stem ---
+    x = b.conv_bn(x, s(32), 3, 3, strides=(2, 2), padding="valid")
+    x = b.conv_bn(x, s(32), 3, 3, padding="valid")
+    x = b.conv_bn(x, s(64), 3, 3)
+    x = b.pool(x, "MaxPooling2D", (3, 3), (2, 2))
+    x = b.conv_bn(x, s(80), 1, 1, padding="valid")
+    x = b.conv_bn(x, s(192), 3, 3, padding="valid")
+    x = b.pool(x, "MaxPooling2D", (3, 3), (2, 2))
+
+    # --- mixed 0..2 (35x35 blocks) ---
+    for i, pool_proj in enumerate([s(32), s(64), s(64)]):
+        b1 = b.conv_bn(x, s(64), 1, 1)
+        b5 = b.conv_bn(b.conv_bn(x, s(48), 1, 1), s(64), 5, 5)
+        b3 = b.conv_bn(x, s(64), 1, 1)
+        b3 = b.conv_bn(b3, s(96), 3, 3)
+        b3 = b.conv_bn(b3, s(96), 3, 3)
+        bp = b.pool(x, "AveragePooling2D", (3, 3), (1, 1), "same")
+        bp = b.conv_bn(bp, pool_proj, 1, 1)
+        x = b.concat([b1, b5, b3, bp], f"mixed{i}")
+
+    # --- mixed 3 (reduction) ---
+    b3 = b.conv_bn(x, s(384), 3, 3, strides=(2, 2), padding="valid")
+    bd = b.conv_bn(x, s(64), 1, 1)
+    bd = b.conv_bn(bd, s(96), 3, 3)
+    bd = b.conv_bn(bd, s(96), 3, 3, strides=(2, 2), padding="valid")
+    bp = b.pool(x, "MaxPooling2D", (3, 3), (2, 2))
+    x = b.concat([b3, bd, bp], "mixed3")
+
+    # --- mixed 4..7 (17x17 blocks, asymmetric 1x7 / 7x1 convs) ---
+    for i, c7 in enumerate([s(128), s(160), s(160), s(192)]):
+        b1 = b.conv_bn(x, s(192), 1, 1)
+        b7 = b.conv_bn(x, c7, 1, 1)
+        b7 = b.conv_bn(b7, c7, 1, 7)
+        b7 = b.conv_bn(b7, s(192), 7, 1)
+        bd = b.conv_bn(x, c7, 1, 1)
+        bd = b.conv_bn(bd, c7, 7, 1)
+        bd = b.conv_bn(bd, c7, 1, 7)
+        bd = b.conv_bn(bd, c7, 7, 1)
+        bd = b.conv_bn(bd, s(192), 1, 7)
+        bp = b.pool(x, "AveragePooling2D", (3, 3), (1, 1), "same")
+        bp = b.conv_bn(bp, s(192), 1, 1)
+        x = b.concat([b1, b7, bd, bp], f"mixed{4 + i}")
+
+    # --- mixed 8 (reduction) ---
+    b3 = b.conv_bn(b.conv_bn(x, s(192), 1, 1), s(320), 3, 3,
+                   strides=(2, 2), padding="valid")
+    b7 = b.conv_bn(x, s(192), 1, 1)
+    b7 = b.conv_bn(b7, s(192), 1, 7)
+    b7 = b.conv_bn(b7, s(192), 7, 1)
+    b7 = b.conv_bn(b7, s(192), 3, 3, strides=(2, 2), padding="valid")
+    bp = b.pool(x, "MaxPooling2D", (3, 3), (2, 2))
+    x = b.concat([b3, b7, bp], "mixed8")
+
+    # --- mixed 9, 10 (8x8 blocks with nested branch concats) ---
+    for i in range(2):
+        b1 = b.conv_bn(x, s(320), 1, 1)
+        b3 = b.conv_bn(x, s(384), 1, 1)
+        b3a = b.conv_bn(b3, s(384), 1, 3)
+        b3b = b.conv_bn(b3, s(384), 3, 1)
+        b3 = b.concat([b3a, b3b], f"mixed9_{i}")
+        bd = b.conv_bn(x, s(448), 1, 1)
+        bd = b.conv_bn(bd, s(384), 3, 3)
+        bda = b.conv_bn(bd, s(384), 1, 3)
+        bdb = b.conv_bn(bd, s(384), 3, 1)
+        bd = b.concat([bda, bdb], f"concat_{i}")
+        bp = b.pool(x, "AveragePooling2D", (3, 3), (1, 1), "same")
+        bp = b.conv_bn(bp, s(192), 1, 1)
+        x = b.concat([b1, b3, bd, bp], f"mixed{9 + i}")
+
+    # --- head ---
+    gap = b.add("GlobalAveragePooling2D", {}, [x], name="avg_pool")
+    b.channels[gap] = b.channels[x]
+    cin = b.channels[gap]
+    rng = b.rng
+    w = rng.standard_normal((cin, classes)).astype(np.float32) / np.sqrt(cin)
+    bias = np.zeros(classes, np.float32)
+    out = b.add("Dense", {"units": classes, "activation": "softmax",
+                          "use_bias": True}, [gap], name="predictions",
+                weights=[w, bias])
+    b.finish(path, out)
+    return b
+
+
 def make_dense_sequential_h5(path, *, n_in=8, hidden=16, n_out=3, seed=0,
                              scale=1.0):
     """Two-dense-layer Sequential .h5 (relu → softmax)."""
