@@ -155,8 +155,12 @@ class _Unsupported(Exception):
 
 
 def _conv_mode(cfg: dict) -> str:
-    return "same" if cfg.get("padding", cfg.get("border_mode",
-                                                "valid")) == "same" else "truncate"
+    pad = cfg.get("padding", cfg.get("border_mode", "valid"))
+    if pad == "causal":
+        raise _Unsupported(
+            "Keras padding='causal' (left-padded temporal conv) has no "
+            "native counterpart yet")
+    return "same" if pad == "same" else "truncate"
 
 
 def _map_layer(class_name: str, cfg: dict, *, is_last: bool):
@@ -335,8 +339,12 @@ def _gru_perm(arr: np.ndarray, h: int) -> np.ndarray:
 
 
 def _rnn_param_block(layer, weights: List[np.ndarray]) -> Dict[str, Any]:
-    """kernel/recurrent/bias triple → native param dict for one direction."""
+    """kernel/recurrent/bias triple → native param dict for one direction.
+    When the file has no bias (Keras use_bias=False), the bias is ZEROED —
+    the native init's forget-gate bias of 1 would otherwise shift every
+    gate vs the Keras model, which has no bias term at all."""
     p: Dict[str, Any] = {}
+    nb = weights[0].shape[-1]
     if isinstance(layer, GRU):
         h = layer.n_out
         p["W"] = jnp.asarray(_gru_perm(weights[0], h))
@@ -348,11 +356,15 @@ def _rnn_param_block(layer, weights: List[np.ndarray]) -> Dict[str, Any]:
                 p["rb"] = jnp.asarray(_gru_perm(b[1], h))
             else:
                 p["b"] = jnp.asarray(_gru_perm(b, h))
+        else:
+            p["b"] = jnp.zeros((nb,), jnp.float32)
+            if layer.recurrent_bias:
+                p["rb"] = jnp.zeros((nb,), jnp.float32)
     else:
         p["W"] = jnp.asarray(weights[0])
         p["RW"] = jnp.asarray(weights[1])
-        if len(weights) > 2:
-            p["b"] = jnp.asarray(weights[2])
+        p["b"] = (jnp.asarray(weights[2]) if len(weights) > 2
+                  else jnp.zeros((nb,), jnp.float32))
     return p
 
 
@@ -384,9 +396,13 @@ def _copy_weights(net, keras_name: str, our_name: str,
             "var": jnp.asarray(w[1]),
         }
     elif isinstance(layer, Bidirectional):
+        # merge over the init dicts so params absent from the file (e.g. a
+        # zero bias when the inner RNN has use_bias=False) survive
         half = len(weights) // 2
-        p["fwd"] = _rnn_param_block(layer.layer, weights[:half])
-        p["bwd"] = _rnn_param_block(layer.layer, weights[half:])
+        p["fwd"] = {**p.get("fwd", {}),
+                    **_rnn_param_block(layer.layer, weights[:half])}
+        p["bwd"] = {**p.get("bwd", {}),
+                    **_rnn_param_block(layer.layer, weights[half:])}
     elif isinstance(layer, (LSTM, GRU, SimpleRnn)):
         p.update(_rnn_param_block(layer, weights))
     elif isinstance(layer, SeparableConvolution2DLayer):

@@ -57,6 +57,7 @@ class ComputationGraph:
         self._stateful: set = set()
         self._vertex_updaters: Dict[str, Updater] = {}
         self._jit_cache: Dict[Any, Any] = {}
+        self._solver = None                     # full-batch solver cache
 
     # ------------------------------------------------------------- init
     def init(self) -> "ComputationGraph":
@@ -286,7 +287,14 @@ class ComputationGraph:
             for ds in iterable():
                 feats, labs, fmasks, lmasks = self._to_dicts(ds)
                 self.last_batch_size = next(iter(feats.values())).shape[0]
-                if (self.conf.tbptt_fwd_length > 0
+                if self.conf.optimization_algo != \
+                        "stochastic_gradient_descent":
+                    from deeplearning4j_tpu.optim.solvers import (
+                        fit_with_solver,
+                    )
+
+                    loss = fit_with_solver(self, feats, labs, fmasks, lmasks)
+                elif (self.conf.tbptt_fwd_length > 0
                         and all(v.ndim == 3 for v in feats.values())):
                     loss = self._fit_tbptt(feats, labs, fmasks, lmasks)
                 else:

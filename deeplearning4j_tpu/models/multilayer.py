@@ -103,6 +103,7 @@ class MultiLayerNetwork:
         self._layer_updaters: Dict[str, Updater] = {}
         self._jit_cache: Dict[Any, Any] = {}
         self._rnn_carries: Dict[str, Any] = {}  # rnnTimeStep statefulness
+        self._solver = None                     # full-batch solver cache
 
     # ------------------------------------------------------------- init
     def init(self) -> "MultiLayerNetwork":
@@ -325,6 +326,18 @@ class MultiLayerNetwork:
     def _fit_batch(self, ds: DataSet) -> float:
         self._check_input(ds.features)
         self.last_batch_size = ds.num_examples()
+        if self.conf.optimization_algo != "stochastic_gradient_descent":
+            # Full-batch solver path (CG / LBFGS / line GD) — reference:
+            # Solver.java builds the configured optimizer per fit call.
+            from deeplearning4j_tpu.optim.solvers import fit_with_solver
+
+            return fit_with_solver(
+                self, jnp.asarray(ds.features, self.dtype),
+                None if ds.labels is None else jnp.asarray(ds.labels),
+                None if ds.features_mask is None
+                else jnp.asarray(ds.features_mask),
+                None if ds.labels_mask is None
+                else jnp.asarray(ds.labels_mask))
         key = (ds.features_mask is not None, ds.labels_mask is not None, False)
         fn = self._get_train_step(key)
         (self.params_tree, self.updater_state, self.state_tree, loss, _

@@ -52,6 +52,12 @@ class MultiLayerConfiguration:
     tbptt_back_length: int = 0
     backprop: bool = True
     pretrain: bool = False
+    # Reference: OptimizationAlgorithm enum (`optimizationAlgo:746`) —
+    # stochastic_gradient_descent | conjugate_gradient | lbfgs |
+    # line_gradient_descent. Non-SGD algorithms run `solver_iterations`
+    # full-batch solver steps per fit batch (optim/solvers.py).
+    optimization_algo: str = "stochastic_gradient_descent"
+    solver_iterations: int = 100
 
     def to_json(self) -> str:
         return to_json(self)
@@ -95,6 +101,8 @@ class Builder:
         self._grad_norm: str = "none"
         self._grad_norm_threshold: float = 1.0
         self._mini_batch = True
+        self._opt_algo = "stochastic_gradient_descent"
+        self._solver_iterations = 100
 
     # -- fluent setters (names mirror the reference builder methods) --
     def seed(self, s: int) -> "Builder":
@@ -140,6 +148,27 @@ class Builder:
 
     def mini_batch(self, v: bool) -> "Builder":
         self._mini_batch = v
+        return self
+
+    def optimization_algo(self, algo: str,
+                          iterations: Optional[int] = None) -> "Builder":
+        """Reference: `optimizationAlgo(OptimizationAlgorithm...)`:746.
+        Accepts reference enum-style or snake_case names."""
+        algo = str(algo).lower()
+        aliases = {
+            "sgd": "stochastic_gradient_descent",
+            "cg": "conjugate_gradient",
+        }
+        algo = aliases.get(algo, algo)
+        known = {"stochastic_gradient_descent", "conjugate_gradient",
+                 "lbfgs", "line_gradient_descent"}
+        if algo not in known:
+            raise ValueError(
+                f"Unknown optimization algorithm {algo!r}; known: "
+                f"{sorted(known)}")
+        self._opt_algo = algo
+        if iterations is not None:
+            self._solver_iterations = int(iterations)
         return self
 
     # -- terminals --
@@ -257,6 +286,8 @@ class ListBuilder:
             tbptt_back_length=self._tbptt_back,
             backprop=self._backprop,
             pretrain=self._pretrain,
+            optimization_algo=self._base._opt_algo,
+            solver_iterations=self._base._solver_iterations,
         )
 
 

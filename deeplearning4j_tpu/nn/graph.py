@@ -302,6 +302,8 @@ class ComputationGraphConfiguration:
     gradient_normalization_threshold: float = 1.0
     tbptt_fwd_length: int = 0
     tbptt_back_length: int = 0
+    optimization_algo: str = "stochastic_gradient_descent"
+    solver_iterations: int = 100
 
     def to_json(self) -> str:
         return to_json(self)
@@ -435,4 +437,6 @@ class GraphBuilder:
             gradient_normalization_threshold=self._base._grad_norm_threshold,
             tbptt_fwd_length=self._tbptt_fwd,
             tbptt_back_length=self._tbptt_back,
+            optimization_algo=self._base._opt_algo,
+            solver_iterations=self._base._solver_iterations,
         )

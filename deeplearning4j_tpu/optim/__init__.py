@@ -13,8 +13,13 @@ from deeplearning4j_tpu.optim.schedules import (
     Schedule, FixedSchedule, StepSchedule, ExponentialSchedule, InverseSchedule,
     PolySchedule, SigmoidSchedule, MapSchedule, WarmupCosineSchedule,
 )
+from deeplearning4j_tpu.optim.solvers import (
+    Solver, backtrack_line_search, minimize_cg, minimize_gd, minimize_lbfgs,
+)
 
 __all__ = [
+    "Solver", "backtrack_line_search", "minimize_cg", "minimize_gd",
+    "minimize_lbfgs",
     "Updater", "Sgd", "Adam", "AdaMax", "Nadam", "AMSGrad", "Nesterovs",
     "AdaGrad", "AdaDelta", "RmsProp", "NoOp",
     "Schedule", "FixedSchedule", "StepSchedule", "ExponentialSchedule",

@@ -9,6 +9,30 @@ import json
 import numpy as np
 
 
+def write_weight_group(mw, name, arrays):
+    """One layer's weight group in the Keras-2 save layout."""
+    sub = mw.create_group(name)
+    names = []
+    for j, arr in enumerate(arrays):
+        sub.create_dataset(f"w{j}:0", data=arr)
+        names.append(f"{name}/w{j}:0".encode())
+    sub.attrs["weight_names"] = names
+
+
+def write_sequential_h5(path, layer_entries, weight_map):
+    """Write a Sequential .h5 from raw layer config entries + weights."""
+    import h5py
+
+    config = {"class_name": "Sequential", "config": {"layers": layer_entries}}
+    with h5py.File(path, "w") as f:
+        f.attrs["model_config"] = json.dumps(config)
+        mw = f.create_group("model_weights")
+        mw.attrs["layer_names"] = [n.encode() for n in weight_map]
+        mw.attrs["keras_version"] = b"2.1.6"
+        for name, arrays in weight_map.items():
+            write_weight_group(mw, name, arrays)
+
+
 def write_weights(grp, layer_name, arrays):
     sub = grp.create_group(layer_name)
     names = []
@@ -105,13 +129,7 @@ class _FunctionalH5Builder:
                 l["name"].encode() for l in self.layers]
             mw.attrs["keras_version"] = b"2.1.6"
             for lname, arrays in self.weights.items():
-                sub = mw.create_group(lname)
-                names = []
-                for j, arr in enumerate(arrays):
-                    wn = f"{lname}/w{j}:0"
-                    sub.create_dataset(f"w{j}:0", data=arr)
-                    names.append(wn.encode())
-                sub.attrs["weight_names"] = names
+                write_weight_group(mw, lname, arrays)
         return config
 
 

@@ -130,21 +130,7 @@ class TestFunctionalImport:
         np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
 
 
-def _seq_h5(path, layer_entries, weight_map):
-    """Write a Sequential .h5 from raw layer config entries + weights."""
-    config = {"class_name": "Sequential", "config": {"layers": layer_entries}}
-    with h5py.File(path, "w") as f:
-        f.attrs["model_config"] = json.dumps(config)
-        mw = f.create_group("model_weights")
-        mw.attrs["layer_names"] = [n.encode() for n in weight_map]
-        mw.attrs["keras_version"] = b"2.1.6"
-        for name, arrays in weight_map.items():
-            sub = mw.create_group(name)
-            names = []
-            for j, arr in enumerate(arrays):
-                sub.create_dataset(f"w{j}:0", data=arr)
-                names.append(f"{name}/w{j}:0".encode())
-            sub.attrs["weight_names"] = names
+from keras_fixtures import write_sequential_h5 as _seq_h5  # noqa: E402
 
 
 def _sigmoid(x):
@@ -346,6 +332,50 @@ class TestExpandedLayerImport:
         acts = net.feed_forward(x)
         np.testing.assert_allclose(np.asarray(acts[0]), h,
                                    rtol=1e-4, atol=1e-5)
+
+    def test_bidirectional_without_bias_keeps_zero_bias(self, tmp_path):
+        rng = np.random.default_rng(11)
+        F, H, T = 3, 4, 5
+        wf = [rng.standard_normal((F, 4 * H)).astype(np.float32) * 0.3,
+              rng.standard_normal((H, 4 * H)).astype(np.float32) * 0.3]
+        wb = [rng.standard_normal((F, 4 * H)).astype(np.float32) * 0.3,
+              rng.standard_normal((H, 4 * H)).astype(np.float32) * 0.3]
+        p = str(tmp_path / "binb.h5")
+        _seq_h5(p, [
+            {"class_name": "Bidirectional",
+             "config": {"name": "bi", "merge_mode": "concat",
+                        "layer": {"class_name": "LSTM",
+                                  "config": {"units": H, "activation": "tanh",
+                                             "recurrent_activation": "sigmoid",
+                                             "use_bias": False,
+                                             "return_sequences": True}},
+                        "batch_input_shape": [None, T, F]}},
+            {"class_name": "GlobalAveragePooling1D", "config": {"name": "g"}},
+            {"class_name": "Dense",
+             "config": {"name": "out", "units": 2, "activation": "softmax",
+                        "use_bias": True}},
+        ], {"bi": wf + wb,
+            "out": [rng.standard_normal((2 * H, 2)).astype(np.float32),
+                    np.zeros(2, np.float32)]})
+        net = import_keras_model_and_weights(p)
+        blk = net.params_tree[net.conf.layers[0].name]
+        # bias absent from the file → the zero init must survive the copy
+        np.testing.assert_array_equal(np.asarray(blk["fwd"]["b"]),
+                                      np.zeros(4 * H, np.float32))
+        x = rng.standard_normal((2, T, F)).astype(np.float32)
+        assert np.isfinite(np.asarray(net.output(x))).all()
+
+    def test_causal_padding_raises(self, tmp_path):
+        p = str(tmp_path / "causal.h5")
+        _seq_h5(p, [
+            {"class_name": "Conv1D",
+             "config": {"name": "c", "filters": 4, "kernel_size": [3],
+                        "padding": "causal", "activation": "relu",
+                        "use_bias": True,
+                        "batch_input_shape": [None, 8, 2]}},
+        ], {})
+        with pytest.raises(Exception, match="causal"):
+            import_keras_model_and_weights(p)
 
     def test_advanced_activations_and_prelu(self, tmp_path):
         rng = np.random.default_rng(7)
