@@ -240,6 +240,12 @@ class ListBuilder:
         return self
 
     def build(self) -> MultiLayerConfiguration:
+        if (self._base._opt_algo != "stochastic_gradient_descent"
+                and self._tbptt_fwd > 0):
+            raise ValueError(
+                "Truncated BPTT is only supported with "
+                "stochastic_gradient_descent; full-batch solvers "
+                f"({self._base._opt_algo}) cannot carry tBPTT state")
         defaults = self._base._defaults()
         layers: List[Layer] = []
         preprocessors = dict(self._preprocessors)

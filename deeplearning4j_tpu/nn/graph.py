@@ -394,6 +394,12 @@ class GraphBuilder:
         return self
 
     def build(self) -> ComputationGraphConfiguration:
+        if (self._base._opt_algo != "stochastic_gradient_descent"
+                and self._tbptt_fwd > 0):
+            raise ValueError(
+                "Truncated BPTT is only supported with "
+                "stochastic_gradient_descent; full-batch solvers "
+                f"({self._base._opt_algo}) cannot carry tBPTT state")
         defaults = self._base._defaults()
         order = toposort(self._inputs, self._network_inputs)
 

@@ -208,15 +208,17 @@ class Solver:
         self.iterations = iterations
         self.history = history
         self._jitted = None
+        self._refresh = None
 
     def optimize(self, features, labels, fmask=None, lmask=None):
         """Run the configured solver to convergence on ONE batch; returns
         the loss trajectory. Deterministic loss (no dropout)."""
         model = self.model
         x0, unravel = ravel_pytree(model.params_tree)
-        if not isinstance(features, (list, tuple, dict)):
+        if features is not None and not isinstance(features,
+                                                   (list, tuple, dict)):
             features = jnp.asarray(features)
-        if not isinstance(labels, (list, tuple, dict)):
+        if labels is not None and not isinstance(labels, (list, tuple, dict)):
             labels = jnp.asarray(labels)
 
         minimize = _ALGOS[self.algo]
@@ -234,10 +236,32 @@ class Solver:
                                           fm, lm, None, train=True)
                     return loss
                 return minimize(flat_loss, flat, **kw)
+
+            def refresh(flat, feats, labs, fm, lm, states):
+                _, ns = model._loss(unravel(flat), states, feats, labs,
+                                    fm, lm, None, train=True)
+                return ns
             self._jitted = jax.jit(run)
+            self._refresh = jax.jit(refresh)
         res = self._jitted(x0, features, labels, fmask, lmask,
                            model.state_tree)
         model.params_tree = unravel(res.x)
+        # Persistent layer state (BN running mean/var): the reference's
+        # solvers run a train-mode forward per iteration + line-search
+        # probe, decay-blending running stats toward the batch every time.
+        # Mirror that by refreshing the stateful subset `iterations` times
+        # at the optimum (capped — the blend converges geometrically).
+        stateful = getattr(model, "_stateful", set())
+        if stateful and model.state_tree:
+            states = model.state_tree
+            for _ in range(min(self.iterations, 30)):
+                ns = self._refresh(res.x, features, labels, fmask, lmask,
+                                   states)
+                states = {
+                    n: (ns[n] if n in stateful and n in ns else states[n])
+                    for n in states
+                }
+            model.state_tree = states
         model.score_ = float(res.loss)
         return res.history
 

@@ -35,8 +35,10 @@ from deeplearning4j_tpu.parallel.training_master import (
     DistributedTrainingMaster, PhaseStats,
 )
 from deeplearning4j_tpu.parallel.estimator import NetworkEstimator
+from deeplearning4j_tpu.parallel.checkpoint import ShardedCheckpointer
 
 __all__ = [
+    "ShardedCheckpointer",
     "MeshSpec", "make_mesh", "device_count", "local_device_count",
     "ParallelWrapper", "ParallelInference",
     "ShardingRules", "shard_params", "replicate", "batch_sharding",

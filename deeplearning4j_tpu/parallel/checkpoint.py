@@ -1,0 +1,332 @@
+"""Sharded, asynchronous training checkpoints with exact resume.
+
+SURVEY §7 step 4 ("checkpoint zip ↦ sharded async ckpt") and §5 (the
+reference has NO sharded checkpoints and no elastic recovery — this is a
+required capability extension). Reference precedent for the artifact set:
+`util/ModelSerializer.java:37-119` (params + updater state + config);
+on top of that the full LOOP state is captured — iteration, epoch,
+position inside the epoch's iterator, and the training RNG key — so a
+killed run resumes producing bit-identical losses.
+
+Design (TPU-native, multi-host-shaped):
+- Each leaf of the params/updater/state pytrees is saved as its set of
+  UNIQUE addressable device shards (one .npy per distinct shard index), so
+  an FSDP-sharded tensor writes 1/N of its bytes per host and a replicated
+  tensor writes one copy — no host-side gather of the global array.
+- Each process writes only its own `process-<k>/` subdirectory + manifest;
+  restore unions all processes' manifests (single-host: one directory).
+- Async: device→host snapshot happens synchronously (the train loop
+  donates buffers, so shards must be copied out before the next step), the
+  file writes happen on a background thread — the step loop never blocks
+  on disk.
+- A checkpoint directory is only valid once `COMMIT` exists (written
+  last), so a kill mid-write never yields a half checkpoint.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import shutil
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+_MANIFEST = "manifest.json"
+_COMMIT = "COMMIT"
+
+
+# --------------------------------------------------------------- pytree IO
+def _flatten(tree, prefix="") -> Dict[str, Any]:
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten(v, f"{prefix}{k}/"))
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            out.update(_flatten(v, f"{prefix}{i}/"))
+    elif tree is None:
+        pass
+    else:
+        out[prefix.rstrip("/")] = tree
+    return out
+
+
+def _unflatten_into(flat: Dict[str, np.ndarray], like, device_put=None):
+    """Rebuild `like`'s structure from path-keyed arrays; leaves missing
+    from `flat` keep their current value."""
+    def rebuild(sub, prefix, sharding):
+        if isinstance(sub, dict):
+            return {k: rebuild(v, f"{prefix}{k}/",
+                               sharding.get(k) if isinstance(sharding, dict)
+                               else None)
+                    for k, v in sub.items()}
+        if isinstance(sub, (list, tuple)):
+            return type(sub)(
+                rebuild(v, f"{prefix}{i}/",
+                        sharding[i] if isinstance(sharding, (list, tuple))
+                        else None)
+                for i, v in enumerate(sub))
+        key = prefix.rstrip("/")
+        if key not in flat:
+            return sub
+        arr = flat[key]
+        if device_put is not None:
+            return device_put(key, arr, sub, sharding)
+        return jax.numpy.asarray(arr)
+    return rebuild(like, "", device_put and {})
+
+
+def _index_bounds(index: Tuple, shape: Tuple[int, ...]) -> List[List[int]]:
+    """Normalize a shard index (tuple of slices) to [[lo, hi], ...]."""
+    out = []
+    for sl, dim in zip(index, shape):
+        lo = 0 if sl.start is None else int(sl.start)
+        hi = dim if sl.stop is None else int(sl.stop)
+        out.append([lo, hi])
+    return out
+
+
+def _snapshot_leaf(arr) -> List[Tuple[List[List[int]], np.ndarray]]:
+    """Unique addressable shards of a jax.Array as host copies.
+    Replicated arrays (every shard covering the full index) collapse to a
+    single entry; FSDP-sharded arrays yield one entry per distinct slice."""
+    if not isinstance(arr, jax.Array):
+        a = np.asarray(arr)
+        return [(_index_bounds((), a.shape), a)]
+    seen: Dict[Tuple, Any] = {}
+    for sh in arr.addressable_shards:
+        key = tuple(
+            (None if s.start is None else int(s.start),
+             None if s.stop is None else int(s.stop))
+            for s in sh.index)
+        if key not in seen:
+            seen[key] = sh
+    return [(_index_bounds(sh.index, arr.shape), np.asarray(sh.data))
+            for sh in seen.values()]
+
+
+# ------------------------------------------------------------ checkpointer
+class ShardedCheckpointer:
+    """Save/restore sharded training snapshots with rotation + async IO.
+
+    `save()` returns as soon as device shards are copied to host; writing
+    happens on a daemon thread. `restore_into()` rebuilds the model trees
+    (re-sharded onto the wrapper's mesh when one is supplied) and returns
+    the loop position for exact resume."""
+
+    def __init__(self, directory: str, *, max_to_keep: int = 3,
+                 async_save: bool = True):
+        self.directory = os.path.abspath(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.max_to_keep = max_to_keep
+        self.async_save = async_save
+        self._q: "queue.Queue" = queue.Queue()
+        self._worker: Optional[threading.Thread] = None
+        self._errors: List[BaseException] = []
+
+    # ------------------------------------------------------------- save
+    def save(self, net, *, step: int, position: Optional[Dict] = None):
+        """Snapshot params/updater/state + loop position at `step`."""
+        payload = {}
+        for name, tree in (("params", net.params_tree),
+                           ("updater", net.updater_state),
+                           ("state", net.state_tree)):
+            flat = _flatten(tree)
+            payload[name] = {k: _snapshot_leaf(v) for k, v in flat.items()}
+        rng = getattr(net, "_rng", None)
+        if rng is not None:
+            try:
+                rng = jax.random.key_data(rng)  # typed PRNG keys
+            except Exception:
+                pass                            # legacy uint32 key arrays
+        meta = {
+            "step": int(step),
+            "iteration": int(net.iteration),
+            "epoch": int(net.epoch),
+            "position": position or {},
+            "rng": None if rng is None else np.asarray(rng).tolist(),
+            "process_index": jax.process_index(),
+            "process_count": jax.process_count(),
+        }
+        leaf_meta = {
+            name: {k: {"shape": list(np.asarray(shards[0][1]).shape)
+                       if shards[0][0] == [] or not shards[0][0]
+                       else None,
+                       "dtype": str(shards[0][1].dtype)}
+                   for k, shards in payload[name].items()}
+            for name in payload
+        }
+        # global shape per leaf: from the live tree (host obtains it freely)
+        for name, tree in (("params", net.params_tree),
+                           ("updater", net.updater_state),
+                           ("state", net.state_tree)):
+            for k, v in _flatten(tree).items():
+                leaf_meta[name][k]["shape"] = list(np.shape(v))
+        job = (dict(payload), meta, leaf_meta)
+        if self.async_save:
+            self._ensure_worker()
+            self._q.put(job)
+        else:
+            self._write(job)
+        return self
+
+    def _ensure_worker(self):
+        if self._worker is None or not self._worker.is_alive():
+            self._worker = threading.Thread(
+                target=self._drain, daemon=True, name="ckpt-writer")
+            self._worker.start()
+
+    def _drain(self):
+        while True:
+            job = self._q.get()
+            if job is None:
+                return
+            try:
+                self._write(job)
+            except BaseException as e:  # surfaced by wait()
+                self._errors.append(e)
+            finally:
+                self._q.task_done()
+
+    def _write(self, job):
+        payload, meta, leaf_meta = job
+        step = meta["step"]
+        proc = meta["process_index"]
+        d = os.path.join(self.directory, f"step-{step:010d}")
+        pdir = os.path.join(d, f"process-{proc}")
+        os.makedirs(pdir, exist_ok=True)
+        manifest = {"meta": meta, "leaves": {}}
+        fid = 0
+        for name, leaves in payload.items():
+            for key, shards in leaves.items():
+                entries = []
+                for bounds, data in shards:
+                    fn = f"s{fid:06d}.npy"
+                    fid += 1
+                    np.save(os.path.join(pdir, fn), data)
+                    entries.append({"index": bounds, "file": fn})
+                manifest["leaves"][f"{name}:{key}"] = {
+                    "shards": entries, **leaf_meta[name][key]}
+        with open(os.path.join(pdir, _MANIFEST), "w") as f:
+            json.dump(manifest, f)
+        with open(os.path.join(pdir, _COMMIT), "w") as f:
+            f.write("ok")
+        self._rotate()
+
+    def _rotate(self):
+        steps = self.steps()
+        for s in steps[:-self.max_to_keep]:
+            shutil.rmtree(
+                os.path.join(self.directory, f"step-{s:010d}"),
+                ignore_errors=True)
+
+    def wait(self):
+        """Block until queued writes land; re-raise writer errors."""
+        if self._worker is not None and self._worker.is_alive():
+            self._q.join()
+        if self._errors:
+            raise self._errors[0]
+        return self
+
+    # ---------------------------------------------------------- restore
+    def steps(self) -> List[int]:
+        out = []
+        for n in os.listdir(self.directory):
+            if not n.startswith("step-"):
+                continue
+            d = os.path.join(self.directory, n)
+            committed = any(
+                os.path.exists(os.path.join(d, p, _COMMIT))
+                for p in os.listdir(d))
+            if committed:
+                out.append(int(n[len("step-"):]))
+        return sorted(out)
+
+    def latest_step(self) -> Optional[int]:
+        s = self.steps()
+        return s[-1] if s else None
+
+    def _read_step(self, step: int):
+        d = os.path.join(self.directory, f"step-{step:010d}")
+        flats: Dict[str, Dict[str, np.ndarray]] = {}
+        meta = None
+        for pname in sorted(os.listdir(d)):
+            pdir = os.path.join(d, pname)
+            mf = os.path.join(pdir, _MANIFEST)
+            if not os.path.exists(mf) or \
+                    not os.path.exists(os.path.join(pdir, _COMMIT)):
+                continue
+            with open(mf) as f:
+                manifest = json.load(f)
+            meta = meta or manifest["meta"]
+            for full_key, info in manifest["leaves"].items():
+                name, key = full_key.split(":", 1)
+                shape = tuple(info["shape"])
+                tgt = flats.setdefault(name, {})
+                if key not in tgt:
+                    tgt[key] = np.empty(shape, dtype=np.dtype(info["dtype"]))
+                for entry in info["shards"]:
+                    data = np.load(os.path.join(pdir, entry["file"]))
+                    idx = tuple(slice(lo, hi) for lo, hi in entry["index"])
+                    tgt[key][idx] = data
+        if meta is None:
+            raise FileNotFoundError(
+                f"No committed checkpoint for step {step} in {self.directory}")
+        return flats, meta
+
+    def restore_into(self, net, *, step: Optional[int] = None,
+                     shardings: Optional[Dict[str, Any]] = None) -> Dict:
+        """Load a checkpoint into a model. `shardings` optionally maps
+        {'params': tree, 'updater': tree, 'state': tree} of NamedShardings
+        (e.g. a ParallelWrapper's) so restored leaves land sharded on the
+        mesh rather than on one device. Returns the loop position."""
+        step = step if step is not None else self.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"No checkpoints in {self.directory}")
+        flats, meta = self._read_step(step)
+
+        def put(kind):
+            sh_tree = (shardings or {}).get(kind)
+            sh_flat = _flatten(sh_tree) if sh_tree is not None else {}
+
+            def device_put(key, arr, current, _):
+                sh = sh_flat.get(key)
+                a = jax.numpy.asarray(
+                    arr, getattr(current, "dtype", None))
+                return jax.device_put(a, sh) if sh is not None else a
+            return device_put
+
+        if "params" in flats:
+            net.params_tree = _unflatten_into(
+                flats["params"], net.params_tree, put("params"))
+        if "updater" in flats and net.updater_state is not None:
+            net.updater_state = _unflatten_into(
+                flats["updater"], net.updater_state, put("updater"))
+        if "state" in flats and net.state_tree:
+            net.state_tree = _unflatten_into(
+                flats["state"], net.state_tree, put("state"))
+        net.iteration = int(meta["iteration"])
+        net.epoch = int(meta["epoch"])
+        if meta.get("rng") is not None and getattr(net, "_rng", None) is not None:
+            kd = np.asarray(meta["rng"], dtype=np.uint32)
+            try:
+                if jax.numpy.issubdtype(net._rng.dtype, jax.dtypes.prng_key):
+                    net._rng = jax.random.wrap_key_data(kd)
+                else:
+                    net._rng = jax.numpy.asarray(kd)
+            except Exception:
+                net._rng = jax.numpy.asarray(kd)
+        return dict(meta["position"])
+
+    def restore_into_wrapper(self, wrapper, *,
+                             step: Optional[int] = None) -> Dict:
+        """Restore into a ParallelWrapper's model with ITS shardings —
+        FSDP-sharded leaves go straight back onto the mesh."""
+        return self.restore_into(
+            wrapper.net, step=step,
+            shardings={"params": wrapper._params_sh,
+                       "updater": wrapper._opt_sh})

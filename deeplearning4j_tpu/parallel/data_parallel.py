@@ -51,6 +51,13 @@ class ParallelWrapper:
                  batch_axis: str = AXIS_DATA):
         if net.params_tree is None:
             raise RuntimeError("Model must be init()ed before wrapping")
+        if getattr(net.conf, "optimization_algo",
+                   "stochastic_gradient_descent") != \
+                "stochastic_gradient_descent":
+            raise ValueError(
+                "ParallelWrapper trains with the sharded SGD step; "
+                f"optimization_algo={net.conf.optimization_algo!r} is a "
+                "full-batch single-device solver — fit the model directly")
         self.net = net
         self.mesh = mesh if mesh is not None else make_mesh()
         self.batch_axis = batch_axis
@@ -150,9 +157,17 @@ class ParallelWrapper:
                        sl(ds.features_mask), sl(ds.labels_mask))
 
     def fit(self, data, labels=None, *, epochs: int = 1,
-            batch_size: int = 128):
+            batch_size: int = 128, checkpointer=None,
+            checkpoint_every: int = 1, resume: Optional[Dict] = None):
         """Reference: `ParallelWrapper.fit(DataSetIterator):409`. Partial
-        final batches are padded by repetition to keep XLA shapes static."""
+        final batches are padded by repetition to keep XLA shapes static.
+
+        `checkpointer` (a ShardedCheckpointer) saves sharded snapshots every
+        `checkpoint_every` iterations, async. `resume` takes the position
+        dict returned by `ShardedCheckpointer.restore_into_wrapper` —
+        training continues mid-epoch from the exact batch/rng/step, and
+        `epochs` counts TOTAL epochs over the whole (resumed) run so an
+        interrupted fit(epochs=N) is finished by the same call."""
         net = self.net
         if isinstance(data, MultiDataSet):
             batches = [data]
@@ -162,12 +177,16 @@ class ParallelWrapper:
             if self.prefetch:
                 it = it.async_(self.prefetch)
             iterable = lambda: it
+        start_epoch = net.epoch if resume is not None else 0
+        skip = (resume or {}).get("batch_in_epoch", 0)
         for l in net.listeners:
             l.on_fit_start(net)
-        for _ in range(epochs):
+        for _ in range(start_epoch, epochs):
             for l in net.listeners:
                 l.on_epoch_start(net, net.epoch)
-            for ds in iterable():
+            for bi, ds in enumerate(iterable()):
+                if bi < skip:
+                    continue
                 ds = self._pad_to_divisible(ds)
                 net.last_batch_size = ds.num_examples()
                 loss = self._step(ds)
@@ -175,11 +194,18 @@ class ParallelWrapper:
                 net.iteration += 1
                 for l in net.listeners:
                     l.iteration_done(net, net.iteration, net.epoch, loss)
+                if checkpointer is not None and \
+                        net.iteration % checkpoint_every == 0:
+                    checkpointer.save(net, step=net.iteration,
+                                      position={"batch_in_epoch": bi + 1})
+            skip = 0
             for l in net.listeners:
                 l.on_epoch_end(net, net.epoch)
             net.epoch += 1
         for l in net.listeners:
             l.on_fit_end(net)
+        if checkpointer is not None:
+            checkpointer.wait()
         return net
 
     def _step(self, ds) -> float:

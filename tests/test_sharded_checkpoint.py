@@ -1,0 +1,197 @@
+"""Sharded/async checkpoint + exact training resume (SURVEY §7 step 4:
+"checkpoint zip ↦ sharded async ckpt"; §5 elastic-recovery gap).
+
+The kill-and-resume test is the acceptance criterion from the round-1
+verdict: an interrupted FSDP run restored from the sharded snapshot must
+reproduce the uninterrupted run's loss curve exactly.
+"""
+
+import os
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import Mesh, PartitionSpec as P
+
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.optim.updaters import Adam
+from deeplearning4j_tpu.parallel import ParallelWrapper, ShardedCheckpointer
+from deeplearning4j_tpu.parallel.mesh import AXIS_DATA
+from deeplearning4j_tpu.parallel.sharding import ShardingRules
+
+
+def _net(seed=7):
+    return MultiLayerNetwork(
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .updater(Adam(1e-2))
+        .list(
+            DenseLayer(n_in=12, n_out=32, activation="relu"),
+            DenseLayer(n_out=32, n_in=32, activation="relu"),
+            OutputLayer(n_in=32, n_out=4, activation="softmax",
+                        loss="mcxent"),
+        )
+        .build()
+    ).init()
+
+
+def _data(n=256, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, 12)).astype(np.float32)
+    yi = rng.integers(0, 4, n)
+    x[np.arange(n), yi % 12] += 2.0
+    return x, np.eye(4, dtype=np.float32)[yi]
+
+
+def _fsdp_rules():
+    # scope to the 32-wide dense layers (output layer's 4 cols can't split 8)
+    return ShardingRules(rules=[("*dense*", "W", P(None, AXIS_DATA)),
+                                ("*dense*", "b", P(AXIS_DATA))])
+
+
+class _Recorder:
+    """Minimal listener capturing the loss curve."""
+
+    def __init__(self):
+        self.losses = []
+
+    def __getattr__(self, name):
+        if name.startswith("on_") or name in ("iteration_done",):
+            if name == "iteration_done":
+                return lambda net, it, ep, loss: self.losses.append(loss)
+            return lambda *a, **k: None
+        raise AttributeError(name)
+
+
+class TestShardedCheckpointer:
+    def test_fsdp_shards_written_per_device_slice(self, tmp_path, devices8):
+        """An FSDP-sharded leaf writes N distinct slice files, a replicated
+        leaf exactly one — no host-side gather of the global array."""
+        mesh = Mesh(np.array(devices8), (AXIS_DATA,))
+        net = _net()
+        ParallelWrapper(net, mesh=mesh, param_rules=_fsdp_rules())
+        ck = ShardedCheckpointer(str(tmp_path / "ck"), async_save=False)
+        ck.save(net, step=1)
+        import json
+        pdir = tmp_path / "ck" / "step-0000000001" / "process-0"
+        manifest = json.loads((pdir / "manifest.json").read_text())
+        w0 = manifest["leaves"]["params:layer0_denselayer/W"]
+        assert len(w0["shards"]) == 8       # one file per mesh slice
+        # 32 cols sharded over 8 devices → 4-wide column slices
+        assert w0["shards"][0]["index"][1][1] - \
+            w0["shards"][0]["index"][1][0] == 4
+        st = manifest["leaves"]["state:layer0_denselayer"] \
+            if "state:layer0_denselayer" in manifest["leaves"] else None
+        # replicated iteration-step scalar in updater state: single shard
+        any_rep = [v for k, v in manifest["leaves"].items()
+                   if len(v["shards"]) == 1]
+        assert any_rep
+
+    def test_roundtrip_restores_sharded_values(self, tmp_path, devices8):
+        mesh = Mesh(np.array(devices8), (AXIS_DATA,))
+        net = _net()
+        w = ParallelWrapper(net, mesh=mesh, param_rules=_fsdp_rules())
+        x, y = _data()
+        w.fit(x, y, epochs=1, batch_size=64)
+        ck = ShardedCheckpointer(str(tmp_path / "ck"), async_save=False)
+        ck.save(net, step=net.iteration)
+
+        net2 = _net(seed=99)   # different init
+        w2 = ParallelWrapper(net2, mesh=mesh, param_rules=_fsdp_rules())
+        ck.restore_into_wrapper(w2)
+        for lname, sub in net.params_tree.items():
+            for k, v in sub.items():
+                np.testing.assert_array_equal(np.asarray(v),
+                                              np.asarray(net2.params_tree[lname][k]))
+        # restored leaves carry the wrapper's NamedSharding (stay on mesh)
+        leaf = net2.params_tree["layer0_denselayer"]["W"]
+        assert len({s.index for s in leaf.addressable_shards}) == 8
+        assert net2.iteration == net.iteration
+
+    def test_kill_and_resume_reproduces_loss_curve(self, tmp_path, devices8):
+        """Train 8 iterations straight vs. train 4 + 'kill' + restore +
+        resume 4: the last 4 losses must match to float tolerance."""
+        mesh = Mesh(np.array(devices8), (AXIS_DATA,))
+        x, y = _data()
+
+        # --- uninterrupted run ---
+        net_a = _net()
+        wa = ParallelWrapper(net_a, mesh=mesh, param_rules=_fsdp_rules())
+        rec_a = _Recorder()
+        net_a.listeners.append(rec_a)
+        wa.fit(x, y, epochs=2, batch_size=64)       # 4 batches/epoch
+        assert len(rec_a.losses) == 8
+
+        # --- interrupted run: checkpoint every step, stop after epoch 1 ---
+        net_b = _net()
+        wb = ParallelWrapper(net_b, mesh=mesh, param_rules=_fsdp_rules())
+        rec_b = _Recorder()
+        net_b.listeners.append(rec_b)
+        ck = ShardedCheckpointer(str(tmp_path / "ck"), max_to_keep=2)
+        wb.fit(x, y, epochs=1, batch_size=64, checkpointer=ck)
+        ck.wait()
+        assert ck.latest_step() == 4
+        del net_b, wb  # "kill"
+
+        # --- resume in a fresh wrapper ---
+        net_c = _net(seed=1234)  # init is irrelevant, restore overwrites
+        wc = ParallelWrapper(net_c, mesh=mesh, param_rules=_fsdp_rules())
+        rec_c = _Recorder()
+        net_c.listeners.append(rec_c)
+        pos = ck.restore_into_wrapper(wc)
+        wc.fit(x, y, epochs=2, batch_size=64, resume=pos)
+        assert len(rec_c.losses) == 4
+        np.testing.assert_allclose(rec_b.losses + rec_c.losses, rec_a.losses,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_mid_epoch_resume(self, tmp_path, devices8):
+        """Kill mid-epoch: resume skips exactly the consumed batches."""
+        mesh = Mesh(np.array(devices8), (AXIS_DATA,))
+        x, y = _data()
+        net_a = _net()
+        wa = ParallelWrapper(net_a, mesh=mesh)
+        rec_a = _Recorder()
+        net_a.listeners.append(rec_a)
+        wa.fit(x, y, epochs=1, batch_size=64)
+
+        net_b = _net()
+        wb = ParallelWrapper(net_b, mesh=mesh)
+        ck = ShardedCheckpointer(str(tmp_path / "ck2"))
+        rec_b = _Recorder()
+        net_b.listeners.append(rec_b)
+        # manually run 2 of the 4 batches, checkpointing
+        wb.fit(x[:128], y[:128], epochs=1, batch_size=64, checkpointer=ck)
+        ck.wait()
+        pos = {"batch_in_epoch": 2}  # as if killed after batch 2 of 4
+
+        net_c = _net(seed=5)
+        wc = ParallelWrapper(net_c, mesh=mesh)
+        rec_c = _Recorder()
+        net_c.listeners.append(rec_c)
+        restored = ck.restore_into_wrapper(wc)
+        assert restored["batch_in_epoch"] == 2
+        net_c.epoch = 0
+        wc.fit(x, y, epochs=1, batch_size=64, resume=pos)
+        assert len(rec_c.losses) == 2  # only batches 3 and 4
+        np.testing.assert_allclose(rec_b.losses + rec_c.losses, rec_a.losses,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_async_save_does_not_block_and_commits(self, tmp_path, devices8):
+        mesh = Mesh(np.array(devices8), (AXIS_DATA,))
+        net = _net()
+        ParallelWrapper(net, mesh=mesh)
+        ck = ShardedCheckpointer(str(tmp_path / "ck3"), async_save=True)
+        for s in (1, 2, 3, 4, 5):
+            ck.save(net, step=s)
+        ck.wait()
+        assert ck.steps() == [3, 4, 5]  # rotation kept max_to_keep=3
+        for s in ck.steps():
+            d = tmp_path / "ck3" / f"step-{s:010d}" / "process-0"
+            assert (d / "COMMIT").exists()
+
+    def test_restore_without_checkpoint_raises(self, tmp_path):
+        ck = ShardedCheckpointer(str(tmp_path / "empty"))
+        with pytest.raises(FileNotFoundError):
+            ck.restore_into(_net())

