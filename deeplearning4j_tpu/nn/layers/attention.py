@@ -102,6 +102,8 @@ class PositionEmbeddingLayer(Layer):
     """Learned absolute position embedding added to [B, T, d] activations
     (extension: pairs with EmbeddingSequenceLayer for transformer inputs)."""
 
+    CONSUMES = "rnn"   # [B, T, d] — shape-preserving sequence layer
+
     max_length: int = 512
     n_out: Optional[int] = None
 
@@ -135,6 +137,8 @@ class TransformerEncoderBlock(Layer):
     a seq mesh) and either a dense FFN or a MoEFeedForward
     (set n_experts > 0) for conditional compute.
     """
+
+    CONSUMES = "rnn"   # [B, T, d] sequence activations
 
     n_in: Optional[int] = None
     num_heads: int = 4

@@ -192,6 +192,8 @@ class EmbeddingSequenceLayer(Layer):
     """[batch, time] indices → [batch, time, n_out] vectors (modern
     counterpart of reference EmbeddingSequenceLayer)."""
 
+    CONSUMES = "rnn"   # sequence input — no RnnToFeedForward before it
+
     n_in: Optional[int] = None
     n_out: Optional[int] = None
 

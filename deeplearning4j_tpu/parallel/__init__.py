@@ -24,7 +24,8 @@ from deeplearning4j_tpu.parallel.sharding import (
 from deeplearning4j_tpu.parallel.inference import ParallelInference
 from deeplearning4j_tpu.parallel.distributed import initialize_distributed
 from deeplearning4j_tpu.parallel.pipeline import (
-    PipelineParallel, make_pipeline_fn, stack_stage_params,
+    PipelineParallel, PipelinedNetwork, make_pipeline_fn,
+    make_pipeline_1f1b_fn, partition_for_pipeline, stack_stage_params,
     split_microbatches,
 )
 from deeplearning4j_tpu.parallel.moe import (
@@ -43,7 +44,8 @@ __all__ = [
     "ParallelWrapper", "ParallelInference",
     "ShardingRules", "shard_params", "replicate", "batch_sharding",
     "tensor_parallel_rules", "initialize_distributed",
-    "PipelineParallel", "make_pipeline_fn", "stack_stage_params",
+    "PipelineParallel", "PipelinedNetwork", "make_pipeline_fn",
+    "make_pipeline_1f1b_fn", "partition_for_pipeline", "stack_stage_params",
     "split_microbatches",
     "MoEFeedForward", "moe_ffn", "top_k_gating", "expert_sharding",
     "expert_mesh",

@@ -27,6 +27,7 @@ from typing import Any, Callable, List, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 try:
@@ -115,6 +116,147 @@ def make_pipeline_fn(stage_fn: Callable[[Any, jax.Array], jax.Array],
                      in_specs=(P(axis), in_x), out_specs=out_y)
 
 
+def make_pipeline_1f1b_fn(stage_fn: Callable[[Any, jax.Array], jax.Array],
+                          last_loss: Callable[[Any, jax.Array, jax.Array],
+                                              jax.Array],
+                          n_stages: int, n_micro: int, mesh: Mesh, *,
+                          axis: str = AXIS_PIPE):
+    """1F1B (eager-backward) pipeline schedule with hand-rolled backward.
+
+    Unlike the GPipe path (`make_pipeline_fn` + jax.grad, which stores
+    residuals for ALL B microbatches before any backward runs), this
+    schedule starts each microbatch's backward as soon as its forward
+    reaches the last stage, interleaving one forward and one backward per
+    tick. Activation memory is the 1F1B bound: a circular input stash of
+    depth min(B, 2S-1) — O(stages), independent of microbatch count — with
+    per-stage recompute (rematerialization) in the backward.
+
+    stage_fn: (stage params, activations [mb, ...]) -> [mb, ...]
+    last_loss: (epilogue params, trunk output [mb, ...], labels[mb, ...])
+      -> scalar mean loss for the microbatch; runs ON the last stage, so
+      its backward seeds the reverse pipeline the same tick the forward
+      finishes — that simultaneity is what makes the schedule 1F1B.
+
+    Returns f(stacked_params, epi_params, x_mb, labels_mb) ->
+      (mean_loss, trunk_grads [stacked, P(pipe)], epi_grads [replicated],
+       dx_mb [dL/d trunk-input per microbatch, replicated])
+    — everything needed to chain a prologue's vjp and an updater behind it.
+    """
+    S, B = n_stages, n_micro
+    T = B + 2 * (S - 1)
+    D = max(1, min(B, 2 * S - 1))     # stash depth: the 1F1B memory bound
+    fwd_perm = [(i, (i + 1) % S) for i in range(S)]
+    bwd_perm = [(i, (i - 1) % S) for i in range(S)]
+
+    def local_fn(params_shard, epi_params, x_mb, y_mb):
+        my = _tmap(lambda p: p[0], params_shard)
+        stage = lax.axis_index(axis)
+        is_first = (stage == 0)
+        is_last = (stage == S - 1)
+
+        def var(x):    # noqa: E306 — defined before first use below
+            try:
+                return lax.pcast(x, (axis,), to="varying")
+            except ValueError:
+                return x
+
+        # The epilogue params arrive replicated (unvarying over `pipe`).
+        # vjp wrt an UNVARYING input of a varying computation inserts an
+        # implicit cross-device psum in the cotangent — which would fold the
+        # other stages' (masked-out) garbage losses into d_epi. Cast to
+        # varying so each stage gets ITS OWN cotangent; the explicit
+        # mask + psum below does the real aggregation.
+        epi_params = _tmap(var, epi_params)
+
+
+        carry0 = (
+            var(jnp.zeros_like(x_mb[0])),                 # fwd in-buffer
+            var(jnp.zeros_like(x_mb[0])),                 # bwd in-buffer
+            var(jnp.zeros((D,) + x_mb.shape[1:], x_mb.dtype)),  # input stash
+            _tmap(lambda p: var(jnp.zeros_like(p)), my),  # trunk grad accum
+            _tmap(lambda p: var(jnp.zeros_like(p)), epi_params),
+            var(jnp.zeros_like(x_mb)),                    # dL/dx per mb
+            var(jnp.zeros((), jnp.float32)),              # loss accum
+        )
+
+        def tick(carry, t):
+            fwd_buf, bwd_buf, stash, gacc, epi_g, dx_all, loss_sum = carry
+
+            # ---------------- forward half ----------------
+            m_f = t - stage
+            act_f = jnp.logical_and(m_f >= 0, m_f < B)
+            m_f_c = jnp.clip(m_f, 0, B - 1)
+            feed = lax.dynamic_index_in_dim(x_mb, m_f_c, keepdims=False)
+            x_in = jnp.where(is_first, feed, fwd_buf)
+            y = stage_fn(my, x_in)
+            stash = jnp.where(
+                act_f,
+                lax.dynamic_update_index_in_dim(stash, x_in, m_f_c % D, 0),
+                stash)
+            fwd_next = lax.ppermute(y, axis, fwd_perm)
+
+            # ------------- last-stage loss + seed -------------
+            # Guarded by lax.cond so only the last stage pays for the
+            # epilogue forward+vjp (for a transformer that's the vocab
+            # projection — the heaviest per-token op); the other S-1
+            # stages take the zeros branch.
+            label = lax.dynamic_index_in_dim(y_mb, m_f_c, keepdims=False)
+            on_last = jnp.logical_and(act_f, is_last)
+
+            def do_loss(yy):
+                loss_val, loss_vjp = jax.vjp(
+                    lambda ep, y2: last_loss(ep, y2, label), epi_params, yy)
+                d_ep, dy = loss_vjp(var(jnp.ones((), loss_val.dtype)))
+                return loss_val.astype(jnp.float32), d_ep, dy
+
+            def no_loss(yy):
+                return (var(jnp.zeros((), jnp.float32)),
+                        _tmap(lambda p: var(jnp.zeros_like(p)), epi_params),
+                        jnp.zeros_like(yy))
+
+            loss_val, d_epi, dldy = lax.cond(on_last, do_loss, no_loss, y)
+            loss_sum = loss_sum + loss_val
+            epi_g = _tmap(lambda a, g: a + g, epi_g, d_epi)
+
+            # ---------------- backward half ----------------
+            # Stage s runs mb m's backward at tick m + 2(S-1) - s; for the
+            # last stage that's the SAME tick as its forward, so dldy above
+            # is this tick's gy — backward starts with zero delay (1F1B).
+            m_b = t - 2 * (S - 1) + stage
+            act_b = jnp.logical_and(m_b >= 0, m_b < B)
+            m_b_c = jnp.clip(m_b, 0, B - 1)
+            x_saved = stash[m_b_c % D]
+            gy = jnp.where(is_last, dldy, bwd_buf)
+            _, svjp = jax.vjp(lambda p, xx: stage_fn(p, xx), my, x_saved)
+            gp, gx = svjp(gy)
+            w_b = act_b.astype(jnp.float32)
+            gacc = _tmap(lambda a, g: a + g * w_b.astype(a.dtype), gacc, gp)
+            dx_all = jnp.where(
+                jnp.logical_and(act_b, is_first),
+                lax.dynamic_update_index_in_dim(dx_all, gx, m_b_c, 0),
+                dx_all)
+            bwd_next = lax.ppermute(gx, axis, bwd_perm)
+
+            return (fwd_next, bwd_next, stash, gacc, epi_g, dx_all,
+                    loss_sum), None
+
+        (_, _, _, gacc, epi_g, dx_all, loss_sum), _ = lax.scan(
+            tick, carry0, jnp.arange(T))
+
+        # loss/epilogue grads live on the last stage, dx on the first:
+        # psum replicates them (other stages contribute zeros).
+        loss_mean = lax.psum(loss_sum, axis) / B
+        epi_g = _tmap(lambda g: lax.psum(g, axis) / B, epi_g)
+        dx_all = lax.psum(dx_all, axis) / B
+        gacc = _tmap(lambda g: g[None] / B, gacc)   # [1,...] per stage slice
+        return loss_mean, gacc, epi_g, dx_all
+
+    return shard_map(
+        local_fn, mesh=mesh,
+        in_specs=(P(axis), P(), P(), P()),
+        out_specs=(P(), P(axis), P(), P()))
+
+
 class PipelineParallel:
     """High-level wrapper: owns stacked stage params + a train step.
 
@@ -176,3 +318,237 @@ class PipelineParallel:
             self.params, self.opt_state, jnp.asarray(it, jnp.int32),
             x_mb, y_mb)
         return float(loss)
+
+
+# --------------------------------------------------------------------------
+# Model-level pipelining: partition a configured MultiLayerNetwork
+# --------------------------------------------------------------------------
+def partition_for_pipeline(net, n_stages: int):
+    """Split a MultiLayerNetwork's layers into (prologue, trunk, epilogue).
+
+    The trunk is the longest run of consecutive layers with identical
+    config class AND identical param shapes (e.g. N TransformerEncoderBlocks
+    or a stack of equal DenseLayers); it is trimmed from the FRONT to a
+    multiple of n_stages (trimmed layers join the prologue). Everything
+    before runs as the (replicated) prologue, everything after — ending in
+    the output layer — as the epilogue fused into the last pipeline stage.
+    """
+    layers = list(net.conf.layers)
+    params = net.params_tree
+
+    import dataclasses
+
+    def sig(l):
+        sub = params[l.name]
+        # Full config equality minus the name — same-shape layers with
+        # different hyperparameters (activation, heads, ...) must NOT be
+        # merged into one trunk, or stage_fn would run every stage with the
+        # first stage's config.
+        return (dataclasses.replace(l, name=None),
+                tuple(sorted((k, tuple(v.shape)) for k, v in sub.items())))
+
+    sigs = [sig(l) for l in layers]
+    best = (0, 0)  # (start, length)
+    i = 0
+    while i < len(layers):
+        j = i + 1
+        while j < len(layers) and sigs[j] == sigs[i]:
+            j += 1
+        if j - i > best[1]:
+            best = (i, j - i)
+        i = j
+    start, length = best
+    usable = (length // n_stages) * n_stages
+    if usable < n_stages or usable == 0:
+        raise ValueError(
+            f"No uniform trunk of >= {n_stages} identical consecutive "
+            f"layers found (longest run: {length}); pipeline parallelism "
+            "needs a homogeneous trunk (transformer blocks, equal dense "
+            "stack, ...)")
+    trim = length - usable
+    start += trim  # front-trimmed extras stay in the prologue
+    pro = layers[:start]
+    trunk = layers[start:start + usable]
+    epi = layers[start + usable:]
+    if not epi or not getattr(epi[-1], "is_output_layer", False):
+        raise ValueError(
+            "Pipeline epilogue must end with an output layer (loss is "
+            "computed on the last stage)")
+    return pro, trunk, epi
+
+
+class PipelinedNetwork:
+    """Train a configured MultiLayerNetwork with pipeline parallelism.
+
+    The ParallelWrapper analogue for the `pipe` mesh axis (the reference has
+    no pipeline story at all — SURVEY §2.4): partitions the net into
+    prologue + uniform trunk + epilogue, shards the stacked trunk over the
+    pipeline stages, and trains with the 1F1B schedule
+    (`make_pipeline_1f1b_fn`) — forward, loss, backward, and update are ONE
+    jitted sharded computation per batch.
+
+    Notes: the pipelined path trains with the net's GLOBAL updater
+    (per-layer updater overrides don't apply), ignores masks, and runs
+    dropout-free (deterministic) forward — the reference semantics for all
+    three live on the single-device path.
+    """
+
+    def __init__(self, net, mesh: Optional[Mesh] = None, *,
+                 n_micro: int = 8, axis: str = AXIS_PIPE,
+                 updater=None):
+        from deeplearning4j_tpu.parallel.mesh import make_mesh
+
+        if net.params_tree is None:
+            raise RuntimeError("Model must be init()ed before pipelining")
+        self.net = net
+        self.mesh = mesh if mesh is not None else make_mesh()
+        if axis not in self.mesh.axis_names:
+            raise ValueError(f"Mesh {self.mesh.axis_names} has no "
+                             f"{axis!r} axis")
+        self.axis = axis
+        self.n_stages = S = self.mesh.shape[axis]
+        self.n_micro = n_micro
+        pro, trunk, epi = partition_for_pipeline(net, S)
+        self._pro_layers, self._trunk_layers, self._epi_layers = pro, trunk, epi
+        self._k = len(trunk) // S          # layers per stage
+        K = self._k
+
+        self.pro_params = {l.name: net.params_tree[l.name] for l in pro}
+        self.epi_params = {l.name: net.params_tree[l.name] for l in epi}
+        stage_trees = [
+            {f"b{j}": net.params_tree[trunk[i * K + j].name]
+             for j in range(K)}
+            for i in range(S)
+        ]
+        stacked = stack_stage_params(stage_trees)
+        self.trunk_params = jax.device_put(
+            stacked, stage_sharding(stacked, self.mesh, axis))
+        rep = NamedSharding(self.mesh, P())
+        self.pro_params = jax.device_put(self.pro_params, rep)
+        self.epi_params = jax.device_put(self.epi_params, rep)
+
+        self.updater = updater if updater is not None else net.conf.updater
+        params_all = {"pro": self.pro_params, "trunk": self.trunk_params,
+                      "epi": self.epi_params}
+        self.opt_state = self.updater.init(params_all)
+
+        block_cfgs = trunk[:K]   # identical configs; names differ only
+
+        def stage_fn(sp, x):
+            for j, cfg in enumerate(block_cfgs):
+                x, _ = cfg.apply(sp[f"b{j}"], x, train=True, rng=None)
+            return x
+
+        def last_loss(ep, y, label):
+            x = y
+            for l in epi[:-1]:
+                x, _ = l.apply(ep[l.name], x, train=True, rng=None)
+            out = epi[-1]
+            return out.score(ep[out.name], x, label, None)
+
+        def prologue_fn(pp, x):
+            for l in pro:
+                x, _ = l.apply(pp[l.name], x, train=True, rng=None)
+            return x
+
+        self._prologue_fn = prologue_fn
+        self._pipe = make_pipeline_1f1b_fn(
+            stage_fn, last_loss, S, n_micro, self.mesh, axis=axis)
+        self._step = None
+
+    # ------------------------------------------------------------- train
+    def _build_step(self):
+        pipe, prologue_fn, updater = self._pipe, self._prologue_fn, self.updater
+        n_micro = self.n_micro
+
+        def step(params_all, opt_state, it, x, lab_mb):
+            pro_p, trunk_p, epi_p = (params_all["pro"], params_all["trunk"],
+                                     params_all["epi"])
+            if pro_p:
+                pro_out, pro_vjp = jax.vjp(
+                    lambda p: prologue_fn(p, x), pro_p)
+            else:
+                pro_out = x
+            pro_mb = split_microbatches(pro_out, n_micro)
+            loss, trunk_g, epi_g, dx_mb = pipe(trunk_p, epi_p, pro_mb,
+                                               lab_mb)
+            grads = {"trunk": trunk_g, "epi": epi_g}
+            if pro_p:
+                (grads["pro"],) = pro_vjp(merge_microbatches(dx_mb))
+            else:
+                grads["pro"] = {}
+            upd, new_opt = updater.apply(grads, opt_state, params_all, it)
+            new_params = _tmap(lambda a, b: a - b.astype(a.dtype),
+                               params_all, upd)
+            return new_params, new_opt, loss
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def fit_batch(self, x, labels, it: Optional[int] = None) -> float:
+        net = self.net
+        if self._step is None:
+            self._step = self._build_step()
+        if it is None:
+            it = net.iteration
+        x = jnp.asarray(x, net.dtype)
+        lab_mb = split_microbatches(jnp.asarray(labels), self.n_micro)
+        params_all = {"pro": self.pro_params, "trunk": self.trunk_params,
+                      "epi": self.epi_params}
+        params_all, self.opt_state, loss = self._step(
+            params_all, self.opt_state, jnp.asarray(it, jnp.int32),
+            x, lab_mb)
+        self.pro_params = params_all["pro"]
+        self.trunk_params = params_all["trunk"]
+        self.epi_params = params_all["epi"]
+        return float(loss)
+
+    def fit(self, data, labels=None, *, epochs: int = 1,
+            batch_size: int = 128):
+        from deeplearning4j_tpu.data.iterators import as_iterator
+
+        net = self.net
+        it = as_iterator(data, labels, batch_size)
+        for l in net.listeners:
+            l.on_fit_start(net)
+        for _ in range(epochs):
+            for l in net.listeners:
+                l.on_epoch_start(net, net.epoch)
+            for ds in it:
+                feats, labs = ds.features, ds.labels
+                b = feats.shape[0]
+                if b % self.n_micro:
+                    # pad trailing partial batches by repetition so the
+                    # microbatch split keeps its static shape (the same
+                    # policy as ParallelWrapper._pad_to_divisible)
+                    pad = self.n_micro - (b % self.n_micro)
+                    idx = np.concatenate(
+                        [np.arange(b), np.zeros(pad, np.int64)])
+                    feats, labs = feats[idx], labs[idx]
+                loss = self.fit_batch(feats, labs)
+                net.score_ = loss
+                net.iteration += 1
+                for l in net.listeners:
+                    l.iteration_done(net, net.iteration, net.epoch, loss)
+            for l in net.listeners:
+                l.on_epoch_end(net, net.epoch)
+            net.epoch += 1
+        for l in net.listeners:
+            l.on_fit_end(net)
+        self.sync_to_net()
+        return net
+
+    # ------------------------------------------------------------ output
+    def sync_to_net(self):
+        """Write pipeline params back into the wrapped net (so output()/
+        evaluate()/save_model see the trained weights)."""
+        net, K = self.net, self._k
+        for l in self._pro_layers:
+            net.params_tree[l.name] = jax.device_get(self.pro_params[l.name])
+        for l in self._epi_layers:
+            net.params_tree[l.name] = jax.device_get(self.epi_params[l.name])
+        stage_trees = unstack_stage_params(jax.device_get(self.trunk_params))
+        for i, tree in enumerate(stage_trees):
+            for j in range(K):
+                name = self._trunk_layers[i * K + j].name
+                net.params_tree[name] = tree[f"b{j}"]
+        return net

@@ -17,6 +17,23 @@ from deeplearning4j_tpu.data.datasets import (
 )
 
 
+def test_transformer_forward_shapes():
+    """Regression: auto-preprocessors must NOT be inserted around the
+    sequence layers (EmbeddingSequence/PositionEmbedding/EncoderBlock) —
+    a misclassification here once broke the zoo transformer's forward."""
+    from deeplearning4j_tpu.zoo.transformer import TextGenerationTransformer
+
+    net = TextGenerationTransformer(num_classes=32, input_shape=(16, 1),
+                                    d_model=16, num_heads=2,
+                                    num_blocks=2).init()
+    assert net.conf.preprocessors == {}
+    x = np.random.default_rng(0).integers(
+        0, 32, (2, 16, 1)).astype(np.float32)
+    out = np.asarray(net.output(x))
+    assert out.shape == (2, 16, 32)
+    np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-4)
+
+
 def _img_batch(shape, n=2, seed=0):
     return np.random.default_rng(seed).standard_normal(
         (n, *shape)).astype(np.float32)
