@@ -326,6 +326,7 @@ class MultiLayerNetwork:
     def _fit_batch(self, ds: DataSet) -> float:
         self._check_input(ds.features)
         self.last_batch_size = ds.num_examples()
+        self._last_features = ds.features   # for listener activation stats
         if self.conf.optimization_algo != "stochastic_gradient_descent":
             # Full-batch solver path (CG / LBFGS / line GD) — reference:
             # Solver.java builds the configured optimizer per fit call.

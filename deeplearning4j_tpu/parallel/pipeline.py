@@ -334,6 +334,11 @@ def partition_for_pipeline(net, n_stages: int):
     the output layer — as the epilogue fused into the last pipeline stage.
     """
     layers = list(net.conf.layers)
+    if getattr(net.conf, "preprocessors", None):
+        raise ValueError(
+            "PipelinedNetwork does not apply config preprocessors "
+            f"(found at indices {sorted(net.conf.preprocessors)}); "
+            "pipeline a net whose layers connect without shape adapters")
     params = net.params_tree
 
     import dataclasses
@@ -390,7 +395,11 @@ class PipelinedNetwork:
     Notes: the pipelined path trains with the net's GLOBAL updater
     (per-layer updater overrides don't apply), ignores masks, and runs
     dropout-free (deterministic) forward — the reference semantics for all
-    three live on the single-device path.
+    three live on the single-device path. L1/L2 regularization IS applied
+    (computed directly on the param trees and added to the pipeline
+    gradients — exact, since it doesn't depend on activations). Trunks
+    with activity-dependent aux losses (MoE load balancing) are rejected:
+    their aux terms would need threading through the hand-rolled schedule.
     """
 
     def __init__(self, net, mesh: Optional[Mesh] = None, *,
@@ -409,6 +418,11 @@ class PipelinedNetwork:
         self.n_stages = S = self.mesh.shape[axis]
         self.n_micro = n_micro
         pro, trunk, epi = partition_for_pipeline(net, S)
+        if any(getattr(l, "n_experts", 0) for l in trunk):
+            raise ValueError(
+                "MoE trunk blocks (n_experts > 0) carry an activity-"
+                "dependent aux loss the pipeline schedule cannot thread; "
+                "train MoE models via ParallelWrapper / expert meshes")
         self._pro_layers, self._trunk_layers, self._epi_layers = pro, trunk, epi
         self._k = len(trunk) // S          # layers per stage
         K = self._k
@@ -452,6 +466,10 @@ class PipelinedNetwork:
             return x
 
         self._prologue_fn = prologue_fn
+        self._block_cfgs = block_cfgs
+        self._has_reg = any(
+            (l.l1 or l.l2 or l.l1_bias or l.l2_bias)
+            for l in (*pro, *trunk, *epi))
         self._pipe = make_pipeline_1f1b_fn(
             stage_fn, last_loss, S, n_micro, self.mesh, axis=axis)
         self._step = None
@@ -460,6 +478,23 @@ class PipelinedNetwork:
     def _build_step(self):
         pipe, prologue_fn, updater = self._pipe, self._prologue_fn, self.updater
         n_micro = self.n_micro
+        pro_layers, epi_layers = self._pro_layers, self._epi_layers
+        block_cfgs, has_reg = self._block_cfgs, self._has_reg
+
+        def reg_fn(params_all):
+            """L1/L2 over all groups — purely param-dependent, so it adds
+            to the pipeline gradients exactly without touching the
+            schedule (trunk blocks share coefficients, so summing over the
+            stacked stage axis equals the per-stage sum)."""
+            total = jnp.asarray(0.0, jnp.float32)
+            for l in pro_layers:
+                total = total + l.regularization(params_all["pro"][l.name])
+            for j, cfg in enumerate(block_cfgs):
+                total = total + cfg.regularization(
+                    params_all["trunk"][f"b{j}"])
+            for l in epi_layers:
+                total = total + l.regularization(params_all["epi"][l.name])
+            return total
 
         def step(params_all, opt_state, it, x, lab_mb):
             pro_p, trunk_p, epi_p = (params_all["pro"], params_all["trunk"],
@@ -477,6 +512,11 @@ class PipelinedNetwork:
                 (grads["pro"],) = pro_vjp(merge_microbatches(dx_mb))
             else:
                 grads["pro"] = {}
+            if has_reg:
+                reg_loss, reg_g = jax.value_and_grad(reg_fn)(params_all)
+                loss = loss + reg_loss
+                grads = _tmap(lambda a, b: a + b.astype(a.dtype),
+                              grads, reg_g)
             upd, new_opt = updater.apply(grads, opt_state, params_all, it)
             new_params = _tmap(lambda a, b: a - b.astype(a.dtype),
                                params_all, upd)
@@ -529,6 +569,9 @@ class PipelinedNetwork:
                 net.iteration += 1
                 for l in net.listeners:
                     l.iteration_done(net, net.iteration, net.epoch, loss)
+            # refresh net.params_tree per epoch so listeners reading param/
+            # update stats (StatsListener) see trained weights, not init
+            self.sync_to_net()
             for l in net.listeners:
                 l.on_epoch_end(net, net.epoch)
             net.epoch += 1

@@ -15,9 +15,18 @@ from deeplearning4j_tpu.ui.storage import (
 )
 from deeplearning4j_tpu.ui.stats import StatsListener
 from deeplearning4j_tpu.ui.server import RemoteStatsRouter, UIServer
+from deeplearning4j_tpu.ui.components import (
+    ChartHistogram, ChartHorizontalBar, ChartLine, ChartScatter,
+    ChartStackedArea, ChartTimeline, Component, ComponentDiv,
+    ComponentTable, ComponentText, DecoratorAccordion, Style,
+)
 
 __all__ = [
     "FileStatsStorage", "InMemoryStatsStorage", "Persistable",
     "StatsStorage", "StatsStorageEvent", "StatsStorageRouter",
     "StatsListener", "RemoteStatsRouter", "UIServer",
+    "Component", "ChartLine", "ChartHistogram", "ChartScatter",
+    "ChartHorizontalBar", "ChartStackedArea", "ChartTimeline",
+    "ComponentDiv", "ComponentTable", "ComponentText",
+    "DecoratorAccordion", "Style",
 ]

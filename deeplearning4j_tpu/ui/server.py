@@ -1,89 +1,69 @@
-"""Training UI server + remote stats routing.
+"""Training UI server: overview / model / system dashboards + t-SNE viewer
++ remote stats routing.
 
 Reference parity: `deeplearning4j-play/.../ui/play/PlayUIServer.java` —
-`getInstance()` singleton, `attach(statsStorage):254`, port via the
-`org.deeplearning4j.ui.port` system property (:59), remote-listener endpoint
-`enableRemoteListener():313`; dashboards served by `ui/module/train/
-TrainModule.java` (overview score chart, model param charts, system tab).
-Remote side: `deeplearning4j-core/.../impl/RemoteUIStatsStorageRouter.java:33`
-(HTTP POST of records, retry queue) + `ui/module/remote/
-RemoteReceiverModule.java` (receiving endpoint).
+`getInstance()` singleton, `attach(statsStorage):254`, remote-listener
+endpoint `enableRemoteListener():313`; dashboards served by UIModules:
+`ui/module/train/TrainModule.java` (overview score chart, per-layer
+param/update charts + histograms + activation charts, system tab) and
+`ui/module/tsne/` (t-SNE embedding viewer). Remote side:
+`impl/RemoteUIStatsStorageRouter.java:33` (HTTP POST of records) +
+`ui/module/remote/RemoteReceiverModule.java`.
 
-TPU redesign: a dependency-free `http.server` dashboard (the reference
-embeds a Play framework app); charts are inline SVG polled via JSON
-endpoints. The server is read-only over the `StatsStorage` API, exactly
-like the reference's UIModule seam.
+TPU redesign: a dependency-free `http.server` app (the reference embeds a
+Play framework app with Scala templates); every chart on every page is a
+`ui/components.py` component rendered server-side to inline SVG — the same
+reusable JSON components are also served raw under `/train/*` endpoints
+for programmatic consumers. The server is read-only over the
+`StatsStorage` API, exactly like the reference's UIModule seam.
 """
 
 from __future__ import annotations
 
 import json
 import threading
+import time
 import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Any, Dict, List, Optional
 
+from deeplearning4j_tpu.ui.components import (
+    ChartHistogram, ChartLine, ChartScatter, ComponentDiv, ComponentTable,
+    DecoratorAccordion, Style, histogram_component,
+)
 from deeplearning4j_tpu.ui.storage import (
     Persistable, StatsStorage, StatsStorageRouter,
 )
 
-_PAGE = """<!doctype html>
-<html><head><title>deeplearning4j_tpu training UI</title>
-<style>
+TSNE_TYPE_ID = "Tsne"
+
+_CSS = """
  body{font-family:sans-serif;margin:24px;background:#fafafa}
- h1{font-size:20px} h2{font-size:16px}
+ h1{font-size:20px} nav a{margin-right:14px;font-size:14px}
  .card{background:#fff;border:1px solid #ddd;border-radius:6px;
-       padding:12px;margin-bottom:16px;max-width:900px}
- svg{width:100%;height:220px} .meta{color:#666;font-size:13px}
- polyline{fill:none;stroke:#2a6fdb;stroke-width:1.5}
- table{border-collapse:collapse;font-size:13px}
- td,th{border:1px solid #ddd;padding:4px 8px;text-align:right}
- th:first-child,td:first-child{text-align:left}
-</style></head><body>
-<h1>Training overview</h1>
-<div class=card><h2>Score vs iteration</h2><svg id=score></svg>
-<div class=meta id=perf></div></div>
-<div class=card><h2>Parameter norms (last report)</h2>
-<table id=params><tr><th>parameter</th><th>norm2</th><th>mean mag</th>
-<th>update norm2</th></tr></table></div>
-<div class=card><h2>Session</h2><div class=meta id=session></div></div>
-<script>
-function line(svg, xs, ys){
-  if(!ys.length){return}
-  const W=880,H=220,P=30;
-  const xmax=Math.max(...xs,1), ymin=Math.min(...ys), ymax=Math.max(...ys);
-  const sx=x=>P+(W-2*P)*x/xmax, sy=y=>H-P-(H-2*P)*(y-ymin)/((ymax-ymin)||1);
-  svg.setAttribute('viewBox',`0 0 ${W} ${H}`);
-  svg.innerHTML=`<text x=4 y=14 font-size=11>${ymax.toPrecision(4)}</text>`+
-    `<text x=4 y=${H-8} font-size=11>${ymin.toPrecision(4)}</text>`+
-    `<polyline points="${xs.map((x,i)=>sx(x)+','+sy(ys[i])).join(' ')}"/>`;
-}
-async function tick(){
-  try{
-    const r=await (await fetch('train/overview')).json();
-    line(document.getElementById('score'), r.iterations, r.scores);
-    document.getElementById('perf').textContent =
-      `${r.scores.length} reports; last score ${r.scores.at(-1)?.toPrecision(6)??'-'}; `+
-      `${(r.minibatches_per_second??0).toFixed(2)} minibatches/s; `+
-      `rss ${(r.memory_rss_mb??0).toFixed(0)} MB`;
-    const t=document.getElementById('params');
-    t.innerHTML='<tr><th>parameter</th><th>norm2</th><th>mean mag</th><th>update norm2</th></tr>';
-    for(const [k,v] of Object.entries(r.param_stats||{})){
-      const u=(r.update_stats||{})[k]||{};
-      t.innerHTML+=`<tr><td>${k}</td><td>${v.norm2?.toPrecision(5)}</td>`+
-        `<td>${v.mean_magnitude?.toPrecision(5)}</td>`+
-        `<td>${u.norm2?.toPrecision(5)??'-'}</td></tr>`;
-    }
-    document.getElementById('session').textContent=JSON.stringify(r.static||{});
-  }catch(e){}
-  setTimeout(tick, 2000);
-}
-tick();
-</script></body></html>"""
+       padding:12px;margin-bottom:16px;max-width:980px}
+ .meta{color:#666;font-size:13px}
+ table.uic{border-collapse:collapse;font-size:13px;margin:8px 0}
+ table.uic td,table.uic th{border:1px solid #ddd;padding:4px 8px;
+       text-align:right}
+ table.uic th:first-child,table.uic td:first-child{text-align:left}
+ details.uic{margin:6px 0} details.uic>summary{cursor:pointer;
+       font-weight:bold;font-size:14px}
+"""
+
+
+def _page(title: str, body_html: str) -> str:
+    nav = ('<nav><a href="/train/overview.html">overview</a>'
+           '<a href="/train/model.html">model</a>'
+           '<a href="/train/system.html">system</a>'
+           '<a href="/tsne.html">t-SNE</a></nav>')
+    return (f"<!doctype html><html><head><title>{title}</title>"
+            f"<style>{_CSS}</style><meta http-equiv=refresh content=5>"
+            f"</head><body><h1>{title}</h1>{nav}{body_html}</body></html>")
 
 
 class _Handler(BaseHTTPRequestHandler):
-    server_version = "dl4jtpu-ui/1.0"
+    server_version = "dl4jtpu-ui/2.0"
 
     def log_message(self, *a):  # silence request logging
         pass
@@ -92,57 +72,300 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):
         storage: Optional[StatsStorage] = self.server.ui.storage
         path = self.path.split("?")[0].rstrip("/")
-        if path in ("", "/", "/train", "/train/overview.html"):
-            return self._send(200, _PAGE, "text/html")
-        if path == "/train/overview":
-            return self._send_json(self._overview(storage))
-        if path == "/train/sessions":
-            sids = storage.list_session_ids() if storage else []
-            return self._send_json({"sessions": sids})
-        self._send(404, "not found", "text/plain")
+        routes = {
+            "": lambda: self._send(200, _page(
+                "Training overview", self._overview_html(storage)),
+                "text/html"),
+            "/train": None, "/train/overview.html": None,
+            "/train/overview": lambda: self._send_json(
+                self._overview(storage)),
+            "/train/model": lambda: self._send_json(
+                self._model_data(storage)),
+            "/train/model.html": lambda: self._send(200, _page(
+                "Model", self._model_html(storage)), "text/html"),
+            "/train/model/components": lambda: self._send_json(
+                self._model_components(storage).to_dict()),
+            "/train/system": lambda: self._send_json(
+                self._system_data(storage)),
+            "/train/system.html": lambda: self._send(200, _page(
+                "System", self._system_html(storage)), "text/html"),
+            "/train/sessions": lambda: self._send_json(
+                {"sessions":
+                 storage.list_session_ids() if storage else []}),
+            "/tsne": lambda: self._send_json(self._tsne_data(storage)),
+            "/tsne.html": lambda: self._send(200, _page(
+                "t-SNE", self._tsne_html(storage)), "text/html"),
+        }
+        fn = routes.get(path, routes[""] if path == "/" else None)
+        if fn is None and path in routes:   # aliases to overview page
+            fn = routes[""]
+        if fn is None:
+            return self._send(404, "not found", "text/plain")
+        return fn()
+
+    # ----------------------------------------------------- data assembly
+    def _updates(self, storage) -> List[Persistable]:
+        """All StatsListener updates of the latest session, time-ordered
+        (multi-worker records interleave, like the reference's train
+        module merging worker streams)."""
+        if storage is None:
+            return []
+        sids = [s for s in storage.list_session_ids()]
+        stats_sids = [
+            s for s in sids if "StatsListener" in storage.list_type_ids(s)]
+        if not stats_sids:
+            return []
+        sid = stats_sids[-1]
+        ups: List[Persistable] = []
+        for wid in storage.list_worker_ids(sid, "StatsListener"):
+            ups.extend(storage.get_all_updates(sid, "StatsListener", wid))
+        ups.sort(key=lambda u: u.timestamp)
+        return ups
+
+    def _static(self, storage) -> Dict[str, Any]:
+        if storage is None:
+            return {}
+        for sid in reversed(storage.list_session_ids()):
+            for tid in storage.list_type_ids(sid):
+                for wid in storage.list_worker_ids(sid, tid):
+                    st = storage.get_static_info(sid, tid, wid)
+                    if st:
+                        return st.content
+        return {}
 
     def _overview(self, storage):
-        if storage is None:
-            return {"iterations": [], "scores": []}
-        out = {"iterations": [], "scores": []}
-        sids = storage.list_session_ids()
-        if not sids:
-            return out
-        sid = sids[-1]
-        for tid in storage.list_type_ids(sid):
-            for wid in storage.list_worker_ids(sid, tid):
-                ups = storage.get_all_updates(sid, tid, wid)
-                for u in ups:
-                    if "score" in u.content:
-                        out["iterations"].append(u.content.get("iteration"))
-                        out["scores"].append(u.content["score"])
-                if ups:
-                    last = ups[-1].content
-                    out["param_stats"] = last.get("param_stats")
-                    out["update_stats"] = last.get("update_stats")
-                    out["minibatches_per_second"] = last.get(
-                        "minibatches_per_second")
-                    out["memory_rss_mb"] = last.get("memory_rss_mb")
-                st = storage.get_static_info(sid, tid, wid)
-                if st:
-                    out["static"] = {
-                        "model_class": st.content.get("model_class"),
-                        "num_params": st.content.get("num_params"),
-                        "backend": (st.content.get("software") or {}).get(
-                            "backend"),
-                    }
+        ups = self._updates(storage)
+        out: Dict[str, Any] = {"iterations": [], "scores": []}
+        for u in ups:
+            if "score" in u.content:
+                out["iterations"].append(u.content.get("iteration"))
+                out["scores"].append(u.content["score"])
+        if ups:
+            last = ups[-1].content
+            out["param_stats"] = last.get("param_stats")
+            out["update_stats"] = last.get("update_stats")
+            out["minibatches_per_second"] = last.get(
+                "minibatches_per_second")
+            out["memory_rss_mb"] = last.get("memory_rss_mb")
+        st = self._static(storage)
+        if st:
+            out["static"] = {
+                "model_class": st.get("model_class"),
+                "num_params": st.get("num_params"),
+                "backend": (st.get("software") or {}).get("backend"),
+            }
         return out
+
+    def _model_data(self, storage):
+        """Per-layer norm timelines + ratio + histograms + activations —
+        the TrainModule 'model' tab payload."""
+        ups = self._updates(storage)
+        layers: Dict[str, Dict[str, list]] = {}
+        activations: Dict[str, Dict[str, list]] = {}
+        histograms: Dict[str, Any] = {}
+        update_hist: Dict[str, Any] = {}
+        for u in ups:
+            c = u.content
+            it = c.get("iteration")
+            for name, st in (c.get("param_stats") or {}).items():
+                d = layers.setdefault(name, {
+                    "iterations": [], "param_norm": [], "mean_magnitude": [],
+                    "update_norm": [], "ratio": []})
+                d["iterations"].append(it)
+                d["param_norm"].append(st.get("norm2"))
+                d["mean_magnitude"].append(st.get("mean_magnitude"))
+                ust = (c.get("update_stats") or {}).get(name) or {}
+                un = ust.get("norm2")
+                d["update_norm"].append(un)
+                pn = st.get("norm2") or 0.0
+                d["ratio"].append(
+                    (un / pn) if (un is not None and pn > 0) else None)
+            for name, st in (c.get("activation_stats") or {}).items():
+                d = activations.setdefault(name, {
+                    "iterations": [], "mean": [], "std": [],
+                    "mean_magnitude": []})
+                d["iterations"].append(it)
+                for k in ("mean", "std", "mean_magnitude"):
+                    d[k].append(st.get(k))
+            if c.get("param_histograms"):
+                histograms = c["param_histograms"]   # keep latest
+            if c.get("update_histograms"):
+                update_hist = c["update_histograms"]
+        return {"layers": layers, "activations": activations,
+                "param_histograms": histograms,
+                "update_histograms": update_hist}
+
+    def _system_data(self, storage):
+        ups = self._updates(storage)
+        out = {"iterations": [], "memory_rss_mb": [],
+               "minibatches_per_second": [], "static": self._static(storage)}
+        for u in ups:
+            c = u.content
+            out["iterations"].append(c.get("iteration"))
+            out["memory_rss_mb"].append(c.get("memory_rss_mb"))
+            out["minibatches_per_second"].append(
+                c.get("minibatches_per_second"))
+        return out
+
+    def _tsne_data(self, storage):
+        if storage is None:
+            return {"x": [], "y": [], "labels": []}
+        for sid in reversed(storage.list_session_ids()):
+            if TSNE_TYPE_ID not in storage.list_type_ids(sid):
+                continue
+            for wid in storage.list_worker_ids(sid, TSNE_TYPE_ID):
+                ups = storage.get_all_updates(sid, TSNE_TYPE_ID, wid)
+                if ups:
+                    return ups[-1].content
+        return {"x": [], "y": [], "labels": []}
+
+    # ------------------------------------------------- component building
+    def _model_components(self, storage) -> ComponentDiv:
+        """The model tab as a reusable component tree (this JSON is served
+        at /train/model/components — the ui-components contract)."""
+        data = self._model_data(storage)
+        sections = []
+        for name, d in data["layers"].items():
+            charts: List[Any] = [ChartLine(
+                title=f"{name}: norms",
+                series_names=("param norm2", "update norm2"),
+                x=(tuple(d["iterations"]), tuple(d["iterations"])),
+                y=(tuple(v or 0.0 for v in d["param_norm"]),
+                   tuple(v or 0.0 for v in d["update_norm"])))]
+            ratios = [v for v in d["ratio"] if v is not None]
+            if ratios:
+                its = [i for i, v in zip(d["iterations"], d["ratio"])
+                       if v is not None]
+                charts.append(ChartLine(
+                    title=f"{name}: update/param ratio",
+                    series_names=("ratio",),
+                    x=(tuple(its),), y=(tuple(ratios),)))
+            if name in data["param_histograms"]:
+                charts.append(histogram_component(
+                    f"{name}: parameter histogram",
+                    data["param_histograms"][name]))
+            sections.append(DecoratorAccordion(
+                title=name, children=tuple(charts),
+                default_collapsed=True))
+        for name, d in data["activations"].items():
+            sections.append(DecoratorAccordion(
+                title=f"activations: {name}", default_collapsed=True,
+                children=(ChartLine(
+                    title=f"{name}: activation mean / std",
+                    series_names=("mean", "std"),
+                    x=(tuple(d["iterations"]), tuple(d["iterations"])),
+                    y=(tuple(v or 0.0 for v in d["mean"]),
+                       tuple(v or 0.0 for v in d["std"]))),)))
+        return ComponentDiv(children=tuple(sections))
+
+    # ------------------------------------------------------------- pages
+    def _overview_html(self, storage) -> str:
+        o = self._overview(storage)
+        parts = []
+        if o["iterations"]:
+            parts.append(ChartLine(
+                title="Score vs iteration", series_names=("score",),
+                x=(tuple(o["iterations"]),), y=(tuple(o["scores"]),),
+                style=Style(width=920)).render())
+        rows = []
+        for k, v in (o.get("param_stats") or {}).items():
+            u = (o.get("update_stats") or {}).get(k) or {}
+            rows.append((k, f"{v.get('norm2', 0):.5g}",
+                         f"{v.get('mean_magnitude', 0):.5g}",
+                         f"{u.get('norm2', 0):.5g}" if u else "-"))
+        parts.append(ComponentTable(
+            title="Parameters (last report)",
+            header=("parameter", "norm2", "mean magnitude", "update norm2"),
+            rows=tuple(rows)).render())
+        st = o.get("static") or {}
+        mbs = o.get("minibatches_per_second")
+        parts.append(
+            f"<div class=meta>{len(o['iterations'])} reports; "
+            f"{(mbs or 0):.2f} minibatches/s; "
+            f"model {st.get('model_class', '-')}, "
+            f"{st.get('num_params', '-')} params, "
+            f"backend {st.get('backend', '-')}</div>")
+        return "<div class=card>" + "</div><div class=card>".join(parts) + \
+            "</div>"
+
+    def _model_html(self, storage) -> str:
+        comp = self._model_components(storage)
+        if not comp.children:
+            return "<div class=card>no model reports yet</div>"
+        return f"<div class=card>{comp.render()}</div>"
+
+    def _system_html(self, storage) -> str:
+        d = self._system_data(storage)
+        parts = []
+        its = [i for i in d["iterations"] if i is not None]
+        mem = [m or 0.0 for m in d["memory_rss_mb"]]
+        if its and mem:
+            parts.append(ChartLine(
+                title="Host RSS (MB)", series_names=("rss_mb",),
+                x=(tuple(its),), y=(tuple(mem),),
+                style=Style(width=920)).render())
+        rate = [r for r in d["minibatches_per_second"] if r is not None]
+        if rate:
+            parts.append(ChartLine(
+                title="Minibatches / second",
+                series_names=("mb/s",),
+                x=(tuple(range(len(rate))),), y=(tuple(rate),),
+                style=Style(width=920)).render())
+        st = d.get("static") or {}
+        rows = [("software", json.dumps(st.get("software") or {})),
+                ("hardware", json.dumps(st.get("hardware") or {})),
+                ("model", str(st.get("model_class")))]
+        parts.append(ComponentTable(
+            title="Environment", header=("key", "value"),
+            rows=tuple(rows)).render())
+        return "<div class=card>" + "</div><div class=card>".join(parts) + \
+            "</div>"
+
+    def _tsne_html(self, storage) -> str:
+        d = self._tsne_data(storage)
+        if not d.get("x"):
+            return ("<div class=card>no embedding uploaded — use "
+                    "UIServer.upload_tsne(points, labels)</div>")
+        labels = d.get("labels") or [0] * len(d["x"])
+        by_label: Dict[Any, list] = {}
+        for x, y, l in zip(d["x"], d["y"], labels):
+            by_label.setdefault(l, []).append((x, y))
+        names, xs, ys = [], [], []
+        for l, pts in sorted(by_label.items(), key=lambda kv: str(kv[0])):
+            names.append(str(l))
+            xs.append(tuple(p[0] for p in pts))
+            ys.append(tuple(p[1] for p in pts))
+        chart = ChartScatter(
+            title="t-SNE embedding", series_names=tuple(names),
+            x=tuple(xs), y=tuple(ys), style=Style(width=920, height=560))
+        return f"<div class=card>{chart.render()}</div>"
 
     # --------------------------------------------------------------- POST
     def do_POST(self):
-        """Remote-listener receiver. Reference:
+        """Remote-listener receiver + t-SNE upload. Reference:
         `RemoteReceiverModule.java` paired with PlayUIServer
-        `enableRemoteListener():313`."""
+        `enableRemoteListener():313`; tsne upload mirrors the reference
+        tsne module's coordinate upload."""
         ui = self.server.ui
-        if self.path.rstrip("/") != "/remote" or not ui.remote_enabled:
+        path = self.path.rstrip("/")
+        n = int(self.headers.get("Content-Length", 0))
+        if path == "/tsne":
+            # write path: gated like /remote (local callers use the
+            # UIServer.upload_tsne API directly)
+            if not ui.remote_enabled:
+                return self._send(404, "remote receiver not enabled",
+                                  "text/plain")
+            try:
+                body = json.loads(self.rfile.read(n))
+                pts = list(zip(body["x"], body["y"]))
+            except (json.JSONDecodeError, KeyError, TypeError) as e:
+                return self._send(400, f"bad tsne payload: {e}",
+                                  "text/plain")
+            ui.upload_tsne(pts, body.get("labels"))
+            return self._send_json({"ok": True})
+        if path != "/remote" or not ui.remote_enabled:
             return self._send(404, "remote receiver not enabled",
                               "text/plain")
-        n = int(self.headers.get("Content-Length", 0))
         body = json.loads(self.rfile.read(n))
         rec = Persistable(**body["record"])
         if ui.storage is not None:
@@ -202,6 +425,20 @@ class UIServer:
         self.remote_enabled = True
         if self.storage is None:
             self.storage = StatsStorage()
+
+    def upload_tsne(self, points, labels=None,
+                    session_id: str = "tsne") -> None:
+        """Publish a 2-D embedding to the t-SNE viewer (reference:
+        `ui/module/tsne/` coordinate upload). `points`: [N, 2] array or
+        list of (x, y); `labels`: optional per-point labels for coloring."""
+        if self.storage is None:
+            self.storage = StatsStorage()
+        pts = [(float(p[0]), float(p[1])) for p in points]
+        content = {"x": [p[0] for p in pts], "y": [p[1] for p in pts],
+                   "labels": (None if labels is None
+                              else [str(l) for l in labels])}
+        self.storage.put_update(Persistable(
+            session_id, TSNE_TYPE_ID, "tsne", time.time(), content))
 
     def stop(self) -> None:
         self._httpd.shutdown()

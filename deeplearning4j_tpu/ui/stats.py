@@ -61,13 +61,17 @@ class StatsListener(TrainingListener):
 
     def __init__(self, router: StatsStorageRouter, frequency: int = 1, *,
                  session_id: Optional[str] = None, worker_id: str = "local",
-                 collect_histograms: bool = False, histogram_bins: int = 20):
+                 collect_histograms: bool = False, histogram_bins: int = 20,
+                 collect_activations: bool = False,
+                 activation_examples: int = 32):
         self.router = router
         self.frequency = max(frequency, 1)
         self.session_id = session_id or uuid.uuid4().hex[:12]
         self.worker_id = worker_id
         self.collect_histograms = collect_histograms
         self.histogram_bins = histogram_bins
+        self.collect_activations = collect_activations
+        self.activation_examples = activation_examples
         self._init_done = False
         self._count = 0
         self._last_report_time: Optional[float] = None
@@ -125,6 +129,24 @@ class StatsListener(TrainingListener):
                 }
             self._last_params = [np.asarray(a) for a in flatcur]
 
+        # activation stats (reference: BaseStatsListener activation
+        # mean-magnitude/histogram collection via onForwardPass) — one
+        # extra forward on a slice of the last training batch, opt-in.
+        if self.collect_activations:
+            feats = getattr(model, "_last_features", None)
+            ff = getattr(model, "feed_forward", None)
+            if feats is not None and ff is not None:
+                sample = feats[:self.activation_examples]
+                acts = ff(sample)
+                layer_names = [l.name for l in model.conf.layers]
+                report["activation_stats"] = {
+                    n: {"mean": float(np.mean(a)),
+                        "std": float(np.std(a)),
+                        "mean_magnitude": float(np.mean(np.abs(a)))}
+                    for n, a in zip(layer_names,
+                                    (np.asarray(a) for a in acts))
+                }
+
         # memory (reference: system/JVM memory in the init+update reports)
         report["memory_rss_mb"] = (
             resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0)
@@ -168,7 +190,9 @@ class StatsListener(TrainingListener):
                              session_id=self.session_id,
                              worker_id=worker_id,
                              collect_histograms=self.collect_histograms,
-                             histogram_bins=self.histogram_bins)
+                             histogram_bins=self.histogram_bins,
+                             collect_activations=self.collect_activations,
+                             activation_examples=self.activation_examples)
 
 
 def _leaf_names(tree) -> list:

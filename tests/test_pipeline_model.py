@@ -133,6 +133,7 @@ def _transformer_net(blocks=4, d_model=16, t=8, vocab=11, seed=5,
         .seed(seed)
         .updater(Sgd(lr))
         .activation("identity")
+        .l2(1e-3)   # exercises the pipelined regularization path
         .list(
             EmbeddingSequenceLayer(n_in=vocab, n_out=d_model,
                                    activation="identity"),

@@ -154,3 +154,166 @@ class TestUIServer:
                 router.put_update(Persistable("s", "T", "w", 1.0, {}))
         finally:
             server.stop()
+
+
+class TestComponents:
+    """Reference: deeplearning4j-ui-components — JSON-serializable chart
+    components; here each also renders to inline SVG/HTML."""
+
+    def test_json_roundtrip_all_types(self):
+        from deeplearning4j_tpu.ui import (
+            ChartHistogram, ChartHorizontalBar, ChartLine, ChartScatter,
+            ChartStackedArea, ChartTimeline, Component, ComponentDiv,
+            ComponentTable, ComponentText, DecoratorAccordion,
+        )
+
+        comps = [
+            ChartLine(title="l", series_names=("a",), x=((0.0, 1.0),),
+                      y=((2.0, 3.0),)),
+            ChartHistogram(title="h", lower_bounds=(0.0, 1.0),
+                           upper_bounds=(1.0, 2.0), counts=(3.0, 5.0)),
+            ChartScatter(title="s", series_names=("c0",), x=((1.0,),),
+                         y=((2.0,),)),
+            ChartHorizontalBar(title="b", labels=("p", "q"),
+                               values=(1.0, 2.0)),
+            ChartStackedArea(title="sa", series_names=("a", "b"),
+                             x=(0.0, 1.0), y=((1.0, 2.0), (3.0, 1.0))),
+            ChartTimeline(title="t", lanes=("etl", "step"),
+                          entries=((0, 0.0, 1.0, "load"),
+                                   (1, 1.0, 2.5, "train"))),
+            ComponentTable(title="tb", header=("k", "v"),
+                           rows=(("a", "1"),)),
+            ComponentText(text="hello"),
+        ]
+        div = ComponentDiv(children=tuple(comps))
+        acc = DecoratorAccordion(title="acc", children=(div,))
+        restored = Component.from_json(acc.to_json())
+        assert isinstance(restored, DecoratorAccordion)
+        inner = restored.children[0]
+        assert isinstance(inner, ComponentDiv)
+        assert [type(c).__name__ for c in inner.children] == \
+            [type(c).__name__ for c in comps]
+        # every component renders to non-empty markup
+        for c in comps + [div, acc]:
+            html = c.render()
+            assert html and ("<svg" in html or "<table" in html
+                             or "<p" in html or "<div" in html
+                             or "<details" in html)
+
+    def test_line_chart_svg_has_series(self):
+        from deeplearning4j_tpu.ui import ChartLine
+
+        svg = ChartLine(series_names=("score",), x=((0, 1, 2),),
+                        y=((3.0, 2.0, 1.0),), title="Score").render()
+        assert "polyline" in svg and "Score" in svg and "score" in svg
+
+
+class TestTrainDashboard:
+    def _fit_with_listener(self, **kw):
+        storage = InMemoryStatsStorage()
+        net = small_net()
+        net.listeners.append(StatsListener(storage, 1, **kw))
+        x, y = toy_data()
+        net.fit(x, y, epochs=2, batch_size=32)
+        return storage
+
+    def test_model_endpoint_serves_norm_timelines_and_histograms(self):
+        server = UIServer(port=0)
+        try:
+            storage = self._fit_with_listener(
+                collect_histograms=True, collect_activations=True)
+            server.attach(storage)
+            base = f"http://127.0.0.1:{server.port}"
+            m = json.loads(urllib.request.urlopen(
+                f"{base}/train/model", timeout=5).read())
+            assert m["layers"], "no per-layer timelines"
+            some = next(iter(m["layers"].values()))
+            assert len(some["iterations"]) >= 4
+            assert all(v is not None for v in some["param_norm"])
+            # update norms appear from the second report on
+            assert any(v is not None for v in some["update_norm"])
+            assert any(v is not None for v in some["ratio"])
+            assert m["param_histograms"], "no histograms"
+            assert m["activations"], "no activation stats"
+            act = next(iter(m["activations"].values()))
+            assert len(act["mean"]) == len(act["iterations"])
+            # component JSON endpoint round-trips through the library
+            from deeplearning4j_tpu.ui import Component
+            cj = json.loads(urllib.request.urlopen(
+                f"{base}/train/model/components", timeout=5).read())
+            comp = Component.from_dict(cj)
+            assert comp.render()
+            # HTML pages render SVG charts
+            for page in ("/train/model.html", "/train/overview.html",
+                         "/train/system.html"):
+                html = urllib.request.urlopen(
+                    base + page, timeout=5).read().decode()
+                assert "<svg" in html
+        finally:
+            server.stop()
+
+    def test_system_endpoint(self):
+        server = UIServer(port=0)
+        try:
+            server.attach(self._fit_with_listener())
+            base = f"http://127.0.0.1:{server.port}"
+            s = json.loads(urllib.request.urlopen(
+                f"{base}/train/system", timeout=5).read())
+            assert s["memory_rss_mb"] and s["static"]["hardware"]
+        finally:
+            server.stop()
+
+
+class TestTsneViewer:
+    def test_upload_and_view(self):
+        server = UIServer(port=0)
+        try:
+            pts = np.random.default_rng(0).standard_normal((30, 2))
+            labels = ["a", "b", "c"] * 10
+            server.upload_tsne(pts, labels)
+            base = f"http://127.0.0.1:{server.port}"
+            d = json.loads(urllib.request.urlopen(
+                f"{base}/tsne", timeout=5).read())
+            assert len(d["x"]) == 30 and set(d["labels"]) == {"a", "b", "c"}
+            html = urllib.request.urlopen(
+                f"{base}/tsne.html", timeout=5).read().decode()
+            assert "<svg" in html and "circle" in html
+        finally:
+            server.stop()
+
+    def test_http_upload(self):
+        server = UIServer(port=0)
+        try:
+            server.enable_remote_listener()  # gates the /tsne write path
+            base = f"http://127.0.0.1:{server.port}"
+            body = json.dumps({"x": [0.0, 1.0], "y": [1.0, 2.0],
+                               "labels": ["p", "q"]}).encode()
+            req = urllib.request.Request(
+                f"{base}/tsne", data=body,
+                headers={"Content-Type": "application/json"})
+            urllib.request.urlopen(req, timeout=5).read()
+            d = json.loads(urllib.request.urlopen(
+                f"{base}/tsne", timeout=5).read())
+            assert d["x"] == [0.0, 1.0]
+            # malformed payload → clean 400, not a dropped connection
+            bad = urllib.request.Request(f"{base}/tsne", data=b"{nope",
+                                         headers={"Content-Type":
+                                                  "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(bad, timeout=5)
+            assert ei.value.code == 400
+        finally:
+            server.stop()
+
+    def test_http_upload_gated_when_remote_disabled(self):
+        server = UIServer(port=0)
+        try:
+            base = f"http://127.0.0.1:{server.port}"
+            req = urllib.request.Request(
+                f"{base}/tsne", data=b"{}",
+                headers={"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(req, timeout=5)
+            assert ei.value.code == 404
+        finally:
+            server.stop()
