@@ -121,7 +121,12 @@ class DeepWalk:
         degrees = (graph_or_degrees.degrees()
                    if isinstance(graph_or_degrees, Graph)
                    else np.asarray(graph_or_degrees))
+        # clamp isolated vertices to weight 1 so the query-facing
+        # GraphHuffman and the training engine's Huffman tree are built
+        # from the SAME weights (warm-start consistency)
+        degrees = np.maximum(np.asarray(degrees), 1)
         V, D = len(degrees), self.vector_size
+        self._degrees = degrees
         self.huffman = GraphHuffman(degrees)
         rng = np.random.default_rng(self.seed)
         self.vertex_vectors = (
@@ -141,67 +146,33 @@ class DeepWalk:
 
     def fit_walks(self, walks: np.ndarray) -> "DeepWalk":
         """Train on a precomputed walk matrix [N, L] — the equivalent of
-        `DeepWalk.fit(GraphWalkIterator):158-191` skipgram windows."""
+        `DeepWalk.fit(GraphWalkIterator):158-191` skipgram windows.
+
+        Training runs on the shared SequenceVectors engine (the reference
+        routes DeepWalk through SequenceVectors the same way): walks become
+        element sequences, vertex DEGREES become the vocab counts (so the
+        engine's count-based Huffman tree is the reference's degree-based
+        GraphHuffman), full fixed window, constant learning rate."""
+        from deeplearning4j_tpu.nlp.sequence_vectors import SequenceVectors
+
         if self.huffman is None:
             raise RuntimeError("call initialize() first")
-        codes, points, lens = self.huffman.padded()
-        step = self._make_step(codes, points, lens)
-        centers, contexts = self._window_pairs(walks)
-        rng = np.random.default_rng(self.seed)
-        params = {"syn0": jnp.asarray(self.vertex_vectors),
-                  "syn1": jnp.asarray(self._inner)}
-        lr = jnp.asarray(self.learning_rate, jnp.float32)
-        for _ in range(self.epochs):
-            order = rng.permutation(len(centers))
-            for lo in range(0, len(order), self.batch_size):
-                sel = order[lo:lo + self.batch_size]
-                params = step(params, jnp.asarray(centers[sel]),
-                              jnp.asarray(contexts[sel]), lr)
-        self.vertex_vectors = np.asarray(params["syn0"])
-        self._inner = np.asarray(params["syn1"])
+        sv = SequenceVectors(
+            layer_size=self.vector_size, window=self.window_size,
+            min_count=0, hierarchic_softmax=True, subsampling=0.0,
+            epochs=self.epochs, learning_rate=self.learning_rate,
+            min_learning_rate=self.learning_rate,   # constant LR (reference)
+            batch_size=self.batch_size, seed=self.seed,
+            dynamic_window=False)
+        sv.initial_syn0 = self.vertex_vectors
+        sv.initial_syn1 = self._inner
+        # walk entries already ARE vocab indices (vertex ids) — the indexed
+        # fast path skips per-element string lookups; vocab index == vertex
+        # id, so trained syn0 rows come back vertex-aligned.
+        sv.fit_indexed(np.asarray(walks), self._degrees)
+        self.vertex_vectors = sv.syn0
+        self._inner = sv._syn1
         return self
-
-    def _window_pairs(self, walks: np.ndarray
-                      ) -> Tuple[np.ndarray, np.ndarray]:
-        """All (center, context) pairs within the window over each walk —
-        vectorized equivalent of the reference's per-position skipGram loop
-        (`DeepWalk.skipGram` in fit(GraphWalkIterator))."""
-        all_c, all_x = [], []
-        N, L = walks.shape
-        for off in range(1, self.window_size + 1):
-            if L <= off:
-                break
-            a = walks[:, :-off].ravel()
-            b = walks[:, off:].ravel()
-            all_c.extend((a, b))
-            all_x.extend((b, a))
-        return np.concatenate(all_c), np.concatenate(all_x)
-
-    def _make_step(self, codes, points, lens):
-        codes = jnp.asarray(codes)
-        points = jnp.asarray(points)
-        lens = jnp.asarray(lens)
-
-        @jax.jit
-        def step(params, centers, contexts, lr):
-            def loss_fn(p):
-                h = p["syn0"][centers]
-                pt = points[contexts]
-                cd = codes[contexts].astype(jnp.float32)
-                valid = (jnp.arange(pt.shape[1])[None, :]
-                         < lens[contexts][:, None])
-                logits = jnp.einsum("bd,bld->bl", h, p["syn1"][pt])
-                # InMemoryGraphLookupTable convention: P(left) = sigmoid, bit
-                # selects the branch → BCE on (logit, code bit)
-                bce = jnp.where(valid, jax.nn.softplus(
-                    jnp.where(cd > 0, logits, -logits)), 0.0)
-                return jnp.sum(bce)
-
-            grads = jax.grad(loss_fn)(params)
-            return jax.tree_util.tree_map(
-                lambda a, g: a - lr * g, params, grads)
-
-        return step
 
     # -------------------------------------------------------------- queries
     def get_vertex_vector(self, i: int) -> np.ndarray:

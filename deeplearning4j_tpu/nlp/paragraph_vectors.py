@@ -31,6 +31,14 @@ class ParagraphVectors(Word2Vec):
     # ------------------------------------------------------------ fitting
     def fit(self, documents: Union[Sequence[str], Sequence[Sequence[str]]],
             labels: Optional[Sequence[str]] = None) -> "ParagraphVectors":
+        from deeplearning4j_tpu.nlp.documents import LabelAwareIterator
+
+        if isinstance(documents, LabelAwareIterator):
+            # reference: PV.Builder.iterate(LabelAwareIterator) — documents
+            # carry their own labels (LabelsSource-backed)
+            labelled = list(documents)
+            labels = [d.label for d in labelled]
+            documents = [d.content for d in labelled]
         docs = _as_token_lists(documents, self.tokenizer_factory)
         self.labels = list(labels) if labels else [
             f"DOC_{i}" for i in range(len(docs))]

@@ -82,7 +82,18 @@ class NGramTokenizerFactory(TokenizerFactory):
 
 
 class SentenceIterator:
-    """Reference: `text/sentenceiterator/SentenceIterator`."""
+    """Reference: `text/sentenceiterator/SentenceIterator` (incl. the
+    setPreProcessor seam — every sentence passes through it)."""
+
+    _pre = None  # SentencePreProcessor
+
+    def set_pre_processor(self, pre) -> "SentenceIterator":
+        """Reference: SentenceIterator.setPreProcessor."""
+        self._pre = pre
+        return self
+
+    def _apply_pre(self, sentence: str) -> str:
+        return self._pre.pre_process(sentence) if self._pre else sentence
 
     def __iter__(self) -> Iterator[str]:
         raise NotImplementedError
@@ -96,7 +107,8 @@ class CollectionSentenceIterator(SentenceIterator):
         self._s = list(sentences)
 
     def __iter__(self):
-        return iter(self._s)
+        for s in self._s:
+            yield self._apply_pre(s)
 
 
 class FileSentenceIterator(SentenceIterator):
@@ -118,11 +130,145 @@ class FileSentenceIterator(SentenceIterator):
                 for line in f:
                     line = line.strip()
                     if line:
-                        yield line
+                        yield self._apply_pre(line)
 
 
 class LineSentenceIterator(FileSentenceIterator):
     """Reference: LineSentenceIterator (single file, line per sentence)."""
+
+
+class BasicLineIterator(LineSentenceIterator):
+    """Reference: BasicLineIterator — the workhorse single-file iterator."""
+
+
+class StreamLineIterator(SentenceIterator):
+    """Iterate lines of an already-open text stream (reference:
+    StreamLineIterator). The stream is drained once; reset() replays the
+    buffered lines."""
+
+    def __init__(self, stream):
+        self._lines = [l.strip() for l in stream if l.strip()]
+
+    def __iter__(self):
+        for l in self._lines:
+            yield self._apply_pre(l)
+
+
+class AggregatingSentenceIterator(SentenceIterator):
+    """Chain several sentence iterators (reference:
+    AggregatingSentenceIterator.Builder)."""
+
+    def __init__(self, *iterators: SentenceIterator):
+        self._its = list(iterators)
+
+    def __iter__(self):
+        for it in self._its:
+            for s in it:
+                yield self._apply_pre(s)
+
+    def reset(self):
+        for it in self._its:
+            it.reset()
+
+
+class MultipleEpochsSentenceIterator(SentenceIterator):
+    """Replay an iterator N times (reference:
+    MutipleEpochsSentenceIterator — [sic] the reference's typo)."""
+
+    def __init__(self, inner: SentenceIterator, epochs: int):
+        self._inner = inner
+        self.epochs = epochs
+
+    def __iter__(self):
+        for _ in range(self.epochs):
+            self._inner.reset()
+            for s in self._inner:
+                yield self._apply_pre(s)
+
+
+class PrefetchingSentenceIterator(SentenceIterator):
+    """Background-thread prefetch through a bounded queue (reference:
+    PrefetchingSentenceIterator) — overlaps disk IO with tokenization."""
+
+    def __init__(self, inner: SentenceIterator, buffer: int = 1024):
+        self._inner = inner
+        self.buffer = buffer
+
+    def __iter__(self):
+        import queue
+        import threading
+
+        q: "queue.Queue" = queue.Queue(maxsize=self.buffer)
+        _END = object()
+        stop = threading.Event()
+
+        def put(item) -> bool:
+            # bounded put that gives up when the consumer went away, so an
+            # abandoned iteration can't leak a blocked producer thread
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce():
+            try:
+                for s in self._inner:
+                    if not put(s):
+                        return
+                put(_END)
+            except BaseException as e:  # surfaced to the consumer
+                put(("__error__", e))
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is _END:
+                    break
+                if isinstance(item, tuple) and len(item) == 2 and \
+                        item[0] == "__error__":
+                    raise item[1]
+                yield self._apply_pre(item)
+        finally:
+            stop.set()
+
+    def reset(self):
+        self._inner.reset()
+
+
+class LabelAwareSentenceIterator(SentenceIterator):
+    """Sentence iterator that also exposes the current sentence's label
+    (reference: labelaware/LabelAwareSentenceIterator SPI)."""
+
+    def current_label(self) -> str:
+        raise NotImplementedError
+
+
+class LabelAwareListSentenceIterator(LabelAwareSentenceIterator):
+    """Sentences + parallel labels (reference:
+    labelaware/LabelAwareListSentenceIterator)."""
+
+    def __init__(self, sentences: Sequence[str], labels: Sequence[str]):
+        if len(sentences) != len(labels):
+            raise ValueError("sentences and labels must align")
+        self._s = list(sentences)
+        self._labels = list(labels)
+        self._pos = -1
+
+    def __iter__(self):
+        for i, s in enumerate(self._s):
+            self._pos = i
+            yield self._apply_pre(s)
+
+    def current_label(self) -> str:
+        if self._pos < 0:
+            raise RuntimeError(
+                "current_label() before iteration — pull a sentence first")
+        return self._labels[self._pos]
 
 
 def tokenize_corpus(sentences: Iterable[str],

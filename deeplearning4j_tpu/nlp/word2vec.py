@@ -1,276 +1,50 @@
-"""Word2Vec — large-batch jitted skipgram/CBOW with negative sampling or
-hierarchical softmax.
+"""Word2Vec — tokenized-text front-end over the SequenceVectors engine.
 
 Reference parity: `models/word2vec/Word2Vec.java` over
 `models/sequencevectors/SequenceVectors.java` with learning algorithms
 `models/embeddings/learning/impl/elements/{SkipGram,CBOW}.java` and storage
 `models/embeddings/inmemory/InMemoryLookupTable.java` (syn0/syn1/syn1neg).
 
-TPU redesign (SURVEY §7 hard part (c)): the reference's N hogwild threads
-each exec batched native `AggregateSkipGram` ops against shared memory; here
-pair generation happens on host (vectorized numpy) and ALL updates for a
-batch of ~10⁴ pairs happen in one jitted step — gathers, sampled-softmax
-loss, autodiff scatter-add grads, SGD with the classic linear LR decay.
-Exact SGD semantics per batch; hogwild's lock-free races are gone.
+The whole training engine (vocab → Huffman/negative tables → batched jitted
+steps) lives in `nlp/sequence_vectors.py` — shared with ParagraphVectors
+and DeepWalk exactly as the reference shares SequenceVectors. This class
+adds only the text pipeline: sentence iterators + tokenizer factory.
 """
 
 from __future__ import annotations
 
-import dataclasses
-from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+from typing import List, Optional
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-
+from deeplearning4j_tpu.nlp.sequence_vectors import SequenceVectors
 from deeplearning4j_tpu.nlp.tokenization import (
     DefaultTokenizerFactory, SentenceIterator, TokenizerFactory,
     tokenize_corpus,
-)
-from deeplearning4j_tpu.nlp.vocab import (
-    HuffmanTree, VocabCache, build_vocab, unigram_table,
 )
 
 
 def _as_token_lists(corpus, tokenizer_factory) -> List[List[str]]:
     if isinstance(corpus, SentenceIterator):
         return tokenize_corpus(corpus, tokenizer_factory)
+    corpus = list(corpus)
     if corpus and isinstance(corpus[0], str):
         return tokenize_corpus(corpus, tokenizer_factory)
     return [list(s) for s in corpus]
 
 
-class Word2Vec:
+class Word2Vec(SequenceVectors):
     """Reference: `Word2Vec.Builder` surface mapped to kwargs."""
 
-    def __init__(self, *, layer_size: int = 100, window: int = 5,
-                 min_count: int = 5, negative: int = 5,
-                 hierarchic_softmax: bool = False,
-                 subsampling: float = 1e-3, epochs: int = 1,
-                 learning_rate: float = 0.025, min_learning_rate: float = 1e-4,
-                 batch_size: int = 8192, seed: int = 42,
-                 use_cbow: bool = False,
-                 tokenizer_factory: Optional[TokenizerFactory] = None):
-        self.layer_size = layer_size
-        self.window = window
-        self.min_count = min_count
-        self.negative = negative
-        self.hs = hierarchic_softmax
-        self.subsampling = subsampling
-        self.epochs = epochs
-        self.lr = learning_rate
-        self.min_lr = min_learning_rate
-        self.batch_size = batch_size
-        self.seed = seed
-        self.cbow = use_cbow
+    def __init__(self, *, use_cbow: bool = False,
+                 tokenizer_factory: Optional[TokenizerFactory] = None,
+                 **kw):
+        kw.setdefault("learning_algorithm", "cbow" if use_cbow
+                      else "skipgram")
+        super().__init__(**kw)
         self.tokenizer_factory = tokenizer_factory or DefaultTokenizerFactory()
-        self.vocab: Optional[VocabCache] = None
-        self.syn0: Optional[np.ndarray] = None
-        self._syn1: Optional[np.ndarray] = None
-
-    # ------------------------------------------------------------ fitting
-    def _index_sentences(self, sentences):
-        idx = [
-            np.array([self.vocab.index_of(w) for w in s], dtype=np.int64)
-            for s in sentences
-        ]
-        return [s[s >= 0] for s in idx if (s >= 0).sum() > 1]
-
-    def _setup(self, rng=None):
-        """Allocate syn0/syn1 and build the jit step from self.vocab.
-        Shared by local fit() and the distributed trainer."""
-        V, D = len(self.vocab), self.layer_size
-        if rng is None:
-            rng = np.random.default_rng(self.seed)
-        syn0 = ((rng.random((V, D), dtype=np.float32) - 0.5) / D)
-        syn1 = np.zeros((V, D), dtype=np.float32)
-        probs = unigram_table(self.vocab)
-        counts = self.vocab.counts()
-        total = counts.sum()
-        if self.hs:
-            HuffmanTree(self.vocab)
-            codes, points, lens = HuffmanTree.padded_codes(self.vocab)
-            step = self._make_hs_step(codes, points, lens)
-            syn1 = np.zeros((max(V - 1, 1), D), dtype=np.float32)
-        else:
-            step = self._make_ns_step()
-        # subsampling keep probability (word2vec formula)
-        t = self.subsampling
-        freq = counts / max(total, 1)
-        keep = (np.sqrt(freq / t) + 1) * (t / np.maximum(freq, 1e-12)) \
-            if t > 0 else np.ones(V)
-        params = {"syn0": jnp.asarray(syn0), "syn1": jnp.asarray(syn1)}
-        return {"params": params, "keep": np.clip(keep, 0, 1),
-                "probs": probs, "step": step}
-
-    def _run_epoch(self, params, idx_sentences, setup, rng, seen, total_est):
-        """One pass over idx_sentences; returns (params, seen)."""
-        keep, probs, step = setup["keep"], setup["probs"], setup["step"]
-        centers, contexts = self._generate_pairs(idx_sentences, keep, rng)
-        order = rng.permutation(len(centers))
-        centers, contexts = centers[order], contexts[order]
-        for lo in range(0, len(centers), self.batch_size):
-            c = centers[lo:lo + self.batch_size]
-            x = contexts[lo:lo + self.batch_size]
-            if len(c) < 16:
-                continue
-            frac = min(seen / max(total_est, 1), 1.0)
-            lr = max(self.lr * (1.0 - frac), self.min_lr)
-            if self.hs:
-                params = step(params, jnp.asarray(c), jnp.asarray(x),
-                              jnp.asarray(lr, jnp.float32))
-            else:
-                negs = rng.choice(len(probs),
-                                  size=(len(c), self.negative), p=probs)
-                params = step(params, jnp.asarray(c), jnp.asarray(x),
-                              jnp.asarray(negs), jnp.asarray(lr, jnp.float32))
-            seen += len(c)
-        return params, seen
 
     def fit(self, corpus) -> "Word2Vec":
-        """Reference: `SequenceVectors.fit():187` (vocab build → Huffman →
-        training threads → here: batched jit steps)."""
+        """Reference: `SequenceVectors.fit():187` reached through the
+        Word2Vec text pipeline (sentence iterator → tokenizer)."""
         sentences = _as_token_lists(corpus, self.tokenizer_factory)
-        self.vocab = build_vocab(sentences, min_count=self.min_count)
-        if len(self.vocab) == 0:
-            raise ValueError("Empty vocabulary (min_count too high?)")
-        rng = np.random.default_rng(self.seed)
-        idx_sentences = self._index_sentences(sentences)
-        setup = self._setup(rng)
-        params = setup["params"]
-        total_est = sum(len(s) for s in idx_sentences) * self.window \
-            * max(self.epochs, 1)
-        seen = 0
-        for epoch in range(self.epochs):
-            params, seen = self._run_epoch(
-                params, idx_sentences, setup, rng, seen, total_est)
-        self.syn0 = np.asarray(params["syn0"])
-        self._syn1 = np.asarray(params["syn1"])
+        SequenceVectors.fit(self, sentences)
         return self
-
-    def _generate_pairs(self, idx_sentences, keep, rng
-                        ) -> Tuple[np.ndarray, np.ndarray]:
-        """Dynamic-window (center, context) pairs with frequency
-        subsampling — vectorized host-side equivalent of the reference's
-        per-thread sentence walk."""
-        all_c, all_x = [], []
-        for s in idx_sentences:
-            if self.subsampling > 0:
-                s = s[rng.random(len(s)) < keep[s]]
-            n = len(s)
-            if n < 2:
-                continue
-            b = rng.integers(1, self.window + 1, n)  # per-center dynamic window
-            for off in range(1, self.window + 1):
-                if n <= off:
-                    break
-                i = np.arange(n - off)
-                m = b[i + off] >= off     # center i+off ← context i
-                all_c.append(s[i + off][m])
-                all_x.append(s[i][m])
-                m = b[i] >= off           # center i ← context i+off
-                all_c.append(s[i][m])
-                all_x.append(s[i + off][m])
-        if not all_c:
-            return np.zeros(0, np.int64), np.zeros(0, np.int64)
-        return np.concatenate(all_c), np.concatenate(all_x)
-
-    def _make_ns_step(self):
-        cbow = self.cbow
-
-        @jax.jit
-        def step(params, centers, contexts, negatives, lr):
-            def loss_fn(p):
-                s0, s1 = p["syn0"], p["syn1"]
-                if cbow:
-                    h = s0[contexts]          # [B,D] (single-word context here)
-                else:
-                    h = s0[centers]
-                tgt = contexts if not cbow else centers
-                pos = jnp.einsum("bd,bd->b", h, s1[tgt])
-                neg = jnp.einsum("bd,bkd->bk", h, s1[negatives])
-                # SUM (not mean): per-pair update magnitude matches the
-                # reference's per-example SGD semantics.
-                return (jnp.sum(jax.nn.softplus(-pos))
-                        + jnp.sum(jax.nn.softplus(neg)))
-
-            grads = jax.grad(loss_fn)(params)
-            return jax.tree_util.tree_map(
-                lambda p, g: p - lr * g, params, grads)
-
-        return step
-
-    def _make_hs_step(self, codes, points, lens):
-        codes = jnp.asarray(codes)
-        points = jnp.asarray(points)
-        lens = jnp.asarray(lens)
-
-        @jax.jit
-        def step(params, centers, contexts, lr):
-            def loss_fn(p):
-                h = p["syn0"][centers]                     # [B,D]
-                pt = points[contexts]                      # [B,L]
-                cd = codes[contexts].astype(jnp.float32)   # [B,L]
-                ln = lens[contexts]                        # [B]
-                L = pt.shape[1]
-                valid = jnp.arange(L)[None, :] < ln[:, None]
-                logits = jnp.einsum("bd,bld->bl", h, p["syn1"][pt])
-                # code bit 1 → sigmoid target 0 (word2vec convention):
-                # loss = softplus(logit) if bit==1 else softplus(-logit)
-                bce = jnp.where(valid, jax.nn.softplus(
-                    jnp.where(cd > 0, logits, -logits)), 0.0)
-                return jnp.sum(bce)
-
-            grads = jax.grad(loss_fn)(params)
-            return jax.tree_util.tree_map(
-                lambda p, g: p - lr * g, params, grads)
-
-        return step
-
-    # ------------------------------------------------------------ queries
-    def word_vector(self, word: str) -> Optional[np.ndarray]:
-        i = self.vocab.index_of(word)
-        return None if i < 0 else self.syn0[i]
-
-    def similarity(self, a: str, b: str) -> float:
-        """Reference: `WordVectors.similarity`."""
-        va, vb = self.word_vector(a), self.word_vector(b)
-        if va is None or vb is None:
-            return float("nan")
-        denom = np.linalg.norm(va) * np.linalg.norm(vb) + 1e-12
-        return float(va @ vb / denom)
-
-    def words_nearest(self, word_or_vec, n: int = 10) -> List[str]:
-        """Reference: `WordVectors.wordsNearest`."""
-        if isinstance(word_or_vec, str):
-            v = self.word_vector(word_or_vec)
-            exclude = {self.vocab.index_of(word_or_vec)}
-            if v is None:
-                return []
-        else:
-            v = np.asarray(word_or_vec, np.float32)
-            exclude = set()
-        norms = np.linalg.norm(self.syn0, axis=1) + 1e-12
-        sims = self.syn0 @ v / (norms * (np.linalg.norm(v) + 1e-12))
-        order = np.argsort(-sims)
-        out = []
-        for i in order:
-            if i in exclude:
-                continue
-            out.append(self.vocab.word_at(int(i)))
-            if len(out) >= n:
-                break
-        return out
-
-    def accuracy(self, questions: Sequence[Tuple[str, str, str, str]]) -> float:
-        """Analogy accuracy (a:b :: c:d). Reference: Word2Vec accuracy tests."""
-        good = total = 0
-        for a, b, c, d in questions:
-            va, vb, vc = (self.word_vector(w) for w in (a, b, c))
-            if va is None or vb is None or vc is None:
-                continue
-            pred = self.words_nearest(vb - va + vc, 4)
-            total += 1
-            if d in pred:
-                good += 1
-        return good / max(total, 1)

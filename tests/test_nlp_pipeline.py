@@ -1,0 +1,269 @@
+"""NLP pipeline depth tests: SequenceVectors SPI, document iterators +
+preprocessor stack, Google word2vec binary-format compatibility.
+
+Mirrors reference suites: sequencevectors tests (generic elements),
+documentiterator tests, WordVectorSerializer format tests.
+"""
+
+import io
+import struct
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    AggregatingSentenceIterator, CollectionDocumentIterator,
+    CollectionLabelAwareIterator, CollectionSentenceIterator,
+    CompositePreProcessor, FileDocumentIterator,
+    FilenamesLabelAwareIterator, LabelAwareDocumentIterator,
+    LabelAwareListSentenceIterator, LabelledDocument, LabelsSource,
+    LowCasePreProcessor, MultipleEpochsSentenceIterator, ParagraphVectors,
+    PrefetchingSentenceIterator, SequenceVectors, StreamLineIterator,
+    StripSpecialCharsPreProcessor, Word2Vec, read_binary, write_binary,
+)
+from deeplearning4j_tpu.nlp.sequence_vectors import (
+    CBOW, ElementsLearningAlgorithm, LEARNING_ALGORITHMS, SkipGram,
+)
+
+
+def _two_topic_sequences(n=300, seed=0):
+    """Sequences over two disjoint symbol groups (non-text elements)."""
+    rng = np.random.default_rng(seed)
+    a = [f"A{i}" for i in range(6)]
+    b = [f"B{i}" for i in range(6)]
+    seqs = []
+    for _ in range(n):
+        grp = a if rng.random() < 0.5 else b
+        seqs.append(list(rng.choice(grp, size=8)))
+    return seqs, a, b
+
+
+class TestSequenceVectorsSPI:
+    """Reference: SequenceVectors.java:51 — ONE trainer for any element
+    type, learning algorithm pluggable."""
+
+    @pytest.mark.parametrize("algo", ["skipgram", "cbow"])
+    def test_generic_elements_cluster_by_topic(self, algo):
+        seqs, a, b = _two_topic_sequences()
+        sv = SequenceVectors(layer_size=24, min_count=1, epochs=4,
+                             window=3, seed=1, learning_algorithm=algo)
+        sv.fit(seqs)
+        within = np.mean([sv.similarity(a[0], w) for w in a[1:]])
+        across = np.mean([sv.similarity(a[0], w) for w in b])
+        assert within > across
+
+    def test_hierarchical_softmax_path(self):
+        seqs, a, b = _two_topic_sequences()
+        sv = SequenceVectors(layer_size=24, min_count=1, epochs=4,
+                             window=3, seed=1, hierarchic_softmax=True)
+        sv.fit(seqs)
+        within = np.mean([sv.similarity(a[0], w) for w in a[1:]])
+        across = np.mean([sv.similarity(a[0], w) for w in b])
+        assert within > across
+
+    def test_custom_learning_algorithm_plugs_in(self):
+        """The SPI seam: a user-defined ElementsLearningAlgorithm is
+        accepted and drives training (reference:
+        ElementsLearningAlgorithm custom impls)."""
+        calls = []
+
+        class TracingSkipGram(SkipGram):
+            name = "tracing"
+
+            def make_step(self_inner, model, hs_tables=None):
+                step = super().make_step(model, hs_tables)
+
+                def wrapped(*args):
+                    calls.append(1)
+                    return step(*args)
+                return wrapped
+
+        seqs, _, _ = _two_topic_sequences(n=60)
+        sv = SequenceVectors(layer_size=8, min_count=1, epochs=1,
+                             learning_algorithm=TracingSkipGram())
+        sv.fit(seqs)
+        assert calls, "custom algorithm's step never invoked"
+
+    def test_unknown_algorithm_rejected(self):
+        with pytest.raises(ValueError, match="glovez"):
+            SequenceVectors(learning_algorithm="glovez")
+
+    def test_word2vec_is_sequence_vectors(self):
+        assert issubclass(Word2Vec, SequenceVectors)
+        assert set(LEARNING_ALGORITHMS) >= {"skipgram", "cbow"}
+        assert isinstance(Word2Vec(use_cbow=True).algorithm, CBOW)
+
+    def test_element_counts_override_orders_vocab(self):
+        """DeepWalk's degree-based Huffman path: counts injected, vocab in
+        insertion order."""
+        sv = SequenceVectors(layer_size=4, min_count=0, epochs=1,
+                             hierarchic_softmax=True, subsampling=0)
+        sv.fit([["0", "1", "2", "1", "0"]] * 20,
+               element_counts={"0": 7, "1": 3, "2": 9})
+        assert [sv.vocab.word_at(i) for i in range(3)] == ["0", "1", "2"]
+
+
+class TestDocumentIterators:
+    def test_labels_source_generates_and_stores(self):
+        src = LabelsSource("SENT_%d")
+        assert src.next_label() == "SENT_0"
+        assert src.next_label() == "SENT_1"
+        src.store_label("CUSTOM")
+        assert src.labels == ["SENT_0", "SENT_1", "CUSTOM"]
+
+    def test_collection_label_aware_into_paragraph_vectors(self):
+        docs = ["apples pears fruit " * 5, "cars trucks wheels " * 5,
+                "fruit juice apples " * 5]
+        it = CollectionLabelAwareIterator(docs, labels=["f1", "c1", "f2"])
+        pv = ParagraphVectors(layer_size=16, epochs=12, seed=0,
+                              min_count=1, window=3)
+        pv.fit(it)
+        assert pv.labels == ["f1", "c1", "f2"]
+        sims = (pv.similarity_to_label("f1", "f2"),
+                pv.similarity_to_label("f1", "c1"))
+        assert sims[0] > sims[1]
+
+    def test_file_document_iterator_one_doc_per_file(self, tmp_path):
+        (tmp_path / "a.txt").write_text("first document\nwith lines")
+        (tmp_path / "b.txt").write_text("second document")
+        docs = list(FileDocumentIterator(str(tmp_path)))
+        assert len(docs) == 2
+        assert "with lines" in docs[0]
+
+    def test_filenames_label_aware(self, tmp_path):
+        (tmp_path / "x.txt").write_text("alpha beta")
+        (tmp_path / "y.txt").write_text("gamma delta")
+        it = FilenamesLabelAwareIterator(str(tmp_path))
+        labelled = list(it)
+        assert [d.label for d in labelled] == ["x.txt", "y.txt"]
+        assert it.labels_source.labels == ["x.txt", "y.txt"]
+
+    def test_document_iterator_adapter(self):
+        inner = CollectionDocumentIterator(["one two", "three four"])
+        it = LabelAwareDocumentIterator(inner, template="D%d")
+        labelled = list(it)
+        assert [d.label for d in labelled] == ["D0", "D1"]
+        assert labelled[1].content == "three four"
+
+
+class TestPreprocessorStack:
+    def test_composite_chain(self):
+        pre = CompositePreProcessor(LowCasePreProcessor(),
+                                    StripSpecialCharsPreProcessor())
+        assert pre.pre_process("Hello, World!") == "hello world"
+
+    def test_sentence_iterator_applies_preprocessor(self):
+        it = CollectionSentenceIterator(["Foo, Bar!", "BAZ?"])
+        it.set_pre_processor(CompositePreProcessor(
+            LowCasePreProcessor(), StripSpecialCharsPreProcessor()))
+        assert list(it) == ["foo bar", "baz"]
+
+    def test_word2vec_through_preprocessed_iterator(self):
+        rng = np.random.default_rng(0)
+        a = ["Apple!", "Pear,", "Fruit?"]
+        b = ["Car.", "Truck;", "Wheel:"]
+        sents = []
+        for _ in range(200):
+            grp = a if rng.random() < 0.5 else b
+            sents.append(" ".join(rng.choice(grp, 6)))
+        it = CollectionSentenceIterator(sents)
+        it.set_pre_processor(CompositePreProcessor(
+            LowCasePreProcessor(), StripSpecialCharsPreProcessor()))
+        w2v = Word2Vec(layer_size=16, min_count=1, epochs=4, window=3,
+                       seed=2)
+        w2v.fit(it)
+        assert w2v.vocab.index_of("apple") >= 0   # punctuation stripped
+        assert w2v.similarity("apple", "pear") > \
+            w2v.similarity("apple", "car")
+
+
+class TestSentenceIterators:
+    def test_aggregating(self):
+        it = AggregatingSentenceIterator(
+            CollectionSentenceIterator(["a", "b"]),
+            CollectionSentenceIterator(["c"]))
+        assert list(it) == ["a", "b", "c"]
+
+    def test_multiple_epochs(self):
+        it = MultipleEpochsSentenceIterator(
+            CollectionSentenceIterator(["x", "y"]), epochs=3)
+        assert list(it) == ["x", "y"] * 3
+
+    def test_prefetching_preserves_order(self):
+        src = [f"s{i}" for i in range(200)]
+        it = PrefetchingSentenceIterator(
+            CollectionSentenceIterator(src), buffer=16)
+        assert list(it) == src
+
+    def test_stream_line(self):
+        it = StreamLineIterator(io.StringIO("one\n\ntwo\nthree\n"))
+        assert list(it) == ["one", "two", "three"]
+        assert list(it) == ["one", "two", "three"]  # replayable
+
+    def test_label_aware_list(self):
+        it = LabelAwareListSentenceIterator(["s1", "s2"], ["pos", "neg"])
+        with pytest.raises(RuntimeError, match="before iteration"):
+            it.current_label()
+        seen = [(s, it.current_label()) for s in it]
+        assert seen == [("s1", "pos"), ("s2", "neg")]
+
+    def test_prefetching_propagates_errors(self):
+        class Exploding(CollectionSentenceIterator):
+            def __iter__(self):
+                yield "ok"
+                raise IOError("disk gone")
+
+        it = PrefetchingSentenceIterator(Exploding([]), buffer=4)
+        with pytest.raises(IOError, match="disk gone"):
+            list(it)
+
+
+class TestTinyCorpusTrains:
+    def test_tiny_deepwalk_graph_actually_trains(self):
+        """Regression: <16 pairs used to be silently dropped — a 3-vertex
+        walk must still move the vectors."""
+        from deeplearning4j_tpu.graph import DeepWalk
+
+        dw = DeepWalk(vector_size=8, window_size=2, epochs=3, seed=0)
+        dw.initialize(np.array([1, 2, 1]))
+        before = dw.vertex_vectors.copy()
+        dw.fit_walks(np.array([[0, 1, 2]]))
+        assert not np.allclose(before, dw.vertex_vectors)
+
+
+class TestGoogleBinaryFormat:
+    """Reference: WordVectorSerializer.loadGoogleModel /
+    writeWordVectors(binary). Byte-level compatibility with the original
+    word2vec / gensim binary layout."""
+
+    def test_reads_hand_crafted_google_binary(self, tmp_path):
+        # exact original-tool layout: "V D\n", then per word:
+        # utf-8 name, 0x20, D little-endian float32, '\n'
+        p = tmp_path / "g.bin"
+        vecs = {"hello": [1.0, -2.5, 3.25], "würld": [0.5, 0.25, -1.0]}
+        with open(p, "wb") as f:
+            f.write(b"2 3\n")
+            for w, v in vecs.items():
+                f.write(w.encode("utf-8") + b" ")
+                f.write(struct.pack("<3f", *v))
+                f.write(b"\n")
+        vocab, mat = read_binary(str(p))
+        assert [vocab.word_at(i) for i in range(2)] == ["hello", "würld"]
+        np.testing.assert_allclose(mat[0], [1.0, -2.5, 3.25])
+        np.testing.assert_allclose(mat[1], [0.5, 0.25, -1.0])
+
+    def test_write_read_roundtrip_through_model(self, tmp_path):
+        seqs, a, b = _two_topic_sequences(n=80)
+        sv = SequenceVectors(layer_size=12, min_count=1, epochs=1, seed=0)
+        sv.fit(seqs)
+        p = str(tmp_path / "model.bin")
+        write_binary(sv, p)
+        vocab, mat = read_binary(p)
+        assert len(vocab) == len(sv.vocab)
+        i = vocab.index_of("A0")
+        np.testing.assert_allclose(mat[i], sv.element_vector("A0"),
+                                   rtol=1e-6)
+        # header is the original tool's "V D\n"
+        with open(p, "rb") as f:
+            head = f.readline().decode().split()
+        assert head == [str(len(vocab)), "12"]
