@@ -244,6 +244,9 @@ class LabelAwareDocumentIterator(LabelAwareIterator):
         self._source = LabelsSource(template)
 
     def __iter__(self):
+        # deterministic labels across passes: each iteration restarts the
+        # generator, so pass 2 re-yields D0, D1, ... for the same documents
+        self._source.reset()
         for text in self._docs:
             yield LabelledDocument(content=text,
                                    labels=[self._source.next_label()])

@@ -40,10 +40,17 @@ class ParagraphVectors(Word2Vec):
             labels = [d.label for d in labelled]
             documents = [d.content for d in labelled]
         docs = _as_token_lists(documents, self.tokenizer_factory)
-        self.labels = list(labels) if labels else [
-            f"DOC_{i}" for i in range(len(docs))]
+        raw_labels = [
+            (labels[i] if labels is not None and labels[i] is not None
+             else f"DOC_{i}")
+            for i in range(len(docs))]
+        # One TRAINED VECTOR PER LABEL (reference semantics): repeated
+        # labels share a vector, trained on all their documents' windows.
+        self.labels = list(dict.fromkeys(raw_labels))
+        label_ids = np.array([self.labels.index(l) for l in raw_labels],
+                             dtype=np.int64)
         self.vocab = build_vocab(docs, min_count=self.min_count)
-        V, D, N = len(self.vocab), self.layer_size, len(docs)
+        V, D, N = len(self.vocab), self.layer_size, len(self.labels)
         rng = np.random.default_rng(self.seed)
         params = {
             "syn0": jnp.asarray((rng.random((V, D), dtype=np.float32) - .5) / D),
@@ -58,21 +65,22 @@ class ParagraphVectors(Word2Vec):
         probs = unigram_table(self.vocab)
         step = self._make_pv_step()
 
-        pairs = []  # (doc_id, center, context)
+        pairs = []  # (label_id, center, context)
         for d, s in enumerate(idx_docs):
             n = len(s)
             if n < 2:
                 continue
+            lid = label_ids[d]
             b = rng.integers(1, self.window + 1, n)
             for off in range(1, self.window + 1):
                 if n <= off:
                     break
                 i = np.arange(n - off)
                 m = b[i + off] >= off
-                pairs.append(np.stack([np.full(m.sum(), d), s[i + off][m],
+                pairs.append(np.stack([np.full(m.sum(), lid), s[i + off][m],
                                        s[i][m]], 1))
                 m = b[i] >= off
-                pairs.append(np.stack([np.full(m.sum(), d), s[i][m],
+                pairs.append(np.stack([np.full(m.sum(), lid), s[i][m],
                                        s[i + off][m]], 1))
         all_pairs = np.concatenate(pairs) if pairs else np.zeros((0, 3), np.int64)
 

@@ -288,8 +288,6 @@ class SequenceVectors:
         for lo in range(0, len(centers), self.batch_size):
             c = centers[lo:lo + self.batch_size]
             x = contexts[lo:lo + self.batch_size]
-            if len(c) == 0:
-                continue
             # NOTE: the trailing partial batch trains at its natural size
             # (one extra XLA compile per distinct tail length, bounded at
             # one per corpus) — dropping it would silently skip data, and

@@ -21,8 +21,15 @@ from deeplearning4j_tpu.zoo.inception import (
     GoogLeNet, InceptionResNetV1, FaceNetNN4Small2,
 )
 from deeplearning4j_tpu.zoo.transformer import TextGenerationTransformer
+from deeplearning4j_tpu.zoo.pretrained import (
+    PRETRAINED_CATALOG, PretrainedType, fetch_pretrained, load_pretrained,
+    sniff_format,
+)
+from deeplearning4j_tpu.zoo.imagenet import ImageNetLabels
 
 __all__ = [
+    "PRETRAINED_CATALOG", "PretrainedType", "fetch_pretrained",
+    "load_pretrained", "sniff_format", "ImageNetLabels",
     "ZooModel", "ZOO_REGISTRY", "LeNet", "AlexNet", "SimpleCNN", "VGG16",
     "VGG19", "TextGenerationLSTM", "ResNet50", "GoogLeNet",
     "InceptionResNetV1", "FaceNetNN4Small2", "TextGenerationTransformer",

@@ -47,18 +47,33 @@ class ZooModel:
         from deeplearning4j_tpu.models import ComputationGraph
         return ComputationGraph(conf).init()
 
-    def pretrained_path(self) -> str:
+    def pretrained_path(self, kind: str = "imagenet") -> str:
+        """Kind-specific cache location (a kind-less name would let a
+        cached imagenet file satisfy a cifar10 request)."""
         from deeplearning4j_tpu.data.datasets import data_dir
         return os.path.join(data_dir(), "zoo",
-                            f"{type(self).__name__.lower()}.zip")
+                            f"{type(self).__name__.lower()}_{kind}.zip")
 
-    def init_pretrained(self):
-        """Reference: `ZooModel.initPretrained()` — cache-dir load (no
-        egress in this environment; no silent download)."""
-        p = self.pretrained_path()
-        if not os.path.exists(p):
-            raise FileNotFoundError(
-                f"No pretrained weights at {p}; place a checkpoint zip there "
-                f"(this environment cannot download)")
-        from deeplearning4j_tpu.models.serialize import load_model
-        return load_model(p)
+    def pretrained_available(self, kind: str = "imagenet") -> bool:
+        """Reference: `ZooModel.pretrainedAvailable`."""
+        from deeplearning4j_tpu.zoo.pretrained import PRETRAINED_CATALOG
+
+        return (type(self).__name__, kind) in PRETRAINED_CATALOG
+
+    def init_pretrained(self, kind: str = "imagenet", *,
+                        path: Optional[str] = None):
+        """Reference: `ZooModel.initPretrained():40-75` — resolve weights
+        (explicit path → model-named cache file → catalog fetch with
+        Adler32 verification) and load any supported format (native zip,
+        DL4J zip via interop, Keras .h5)."""
+        from deeplearning4j_tpu.zoo.pretrained import (
+            fetch_pretrained, load_pretrained,
+        )
+
+        if path is None:
+            local = self.pretrained_path(kind)
+            if os.path.exists(local):
+                path = local
+            else:
+                path = fetch_pretrained(type(self).__name__, kind)
+        return load_pretrained(path)

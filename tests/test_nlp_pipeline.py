@@ -123,6 +123,25 @@ class TestDocumentIterators:
                 pv.similarity_to_label("f1", "c1"))
         assert sims[0] > sims[1]
 
+    def test_repeated_labels_share_one_vector(self):
+        """Reference semantics: a label names ONE trained vector; multiple
+        documents with the same label all train it."""
+        docs = ["apples pears fruit " * 4, "fruit juice apples " * 4,
+                "cars trucks wheels " * 4, "wheels motors trucks " * 4]
+        pv = ParagraphVectors(layer_size=12, epochs=8, seed=0,
+                              min_count=1, window=3)
+        pv.fit(CollectionLabelAwareIterator(
+            docs, labels=["fruit", "fruit", "cars", "cars"]))
+        assert pv.labels == ["fruit", "cars"]
+        assert pv.doc_vectors.shape[0] == 2
+
+    def test_document_adapter_labels_stable_across_passes(self):
+        it = LabelAwareDocumentIterator(
+            CollectionDocumentIterator(["one", "two"]))
+        first = [d.label for d in it]
+        second = [d.label for d in it]
+        assert first == second == ["DOC_0", "DOC_1"]
+
     def test_file_document_iterator_one_doc_per_file(self, tmp_path):
         (tmp_path / "a.txt").write_text("first document\nwith lines")
         (tmp_path / "b.txt").write_text("second document")

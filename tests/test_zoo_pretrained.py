@@ -1,0 +1,150 @@
+"""Pretrained zoo machinery: catalog, checksum, format sniffing,
+multi-format loading, ImageNet labels.
+
+Reference parity: `zoo/ZooModel.java:28-75` (initPretrained download +
+Adler32 verify), `zoo/util/imagenet/ImageNetLabels.java`.
+"""
+
+import json
+import os
+import zlib
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.models.serialize import save_model
+from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.zoo import (
+    ImageNetLabels, LeNet, PRETRAINED_CATALOG, PretrainedType,
+    load_pretrained, sniff_format,
+)
+from deeplearning4j_tpu.zoo.pretrained import adler32_of, fetch_pretrained
+
+
+def _small_net():
+    return MultiLayerNetwork(
+        NeuralNetConfiguration.builder().seed(1)
+        .list(DenseLayer(n_in=4, n_out=8, activation="tanh"),
+              OutputLayer(n_in=8, n_out=3, activation="softmax",
+                          loss="mcxent"))
+        .build()).init()
+
+
+class TestCatalog:
+    def test_reference_entries_present(self):
+        """URLs + Adler32 checksums are the reference's published values
+        (VGG16.java:58-78 etc.)."""
+        e = PRETRAINED_CATALOG[("VGG16", PretrainedType.IMAGENET)]
+        assert e.url.endswith("vgg16_dl4j_inference.zip")
+        assert e.adler32 == 3501732770
+        assert PRETRAINED_CATALOG[
+            ("ResNet50", PretrainedType.IMAGENET)].adler32 == 1982516793
+        assert PRETRAINED_CATALOG[
+            ("LeNet", PretrainedType.MNIST)].adler32 == 3337733202
+
+    def test_pretrained_available(self):
+        assert LeNet().pretrained_available("mnist")
+        assert not LeNet().pretrained_available("imagenet")
+
+    def test_unknown_model_kind_raises(self):
+        with pytest.raises(ValueError, match="not available"):
+            fetch_pretrained("SimpleCNN", "imagenet")
+
+    def test_adler32_matches_zlib(self, tmp_path):
+        p = tmp_path / "blob.bin"
+        data = b"deeplearning4j" * 1000
+        p.write_bytes(data)
+        assert adler32_of(str(p)) == (zlib.adler32(data) & 0xFFFFFFFF)
+
+    def test_checksum_mismatch_raises(self, tmp_path, monkeypatch):
+        # pre-place a wrong file at the cache destination
+        import deeplearning4j_tpu.zoo.pretrained as zp
+
+        monkeypatch.setattr(zp, "cache_dir", lambda: str(tmp_path))
+        bad = tmp_path / "lenet_dl4j_mnist_inference.zip"
+        bad.write_bytes(b"not the real weights")
+        with pytest.raises(IOError, match="Checksum mismatch"):
+            fetch_pretrained("LeNet", "mnist")
+
+
+class TestFormatSniffAndLoad:
+    def test_native_zip_roundtrip(self, tmp_path):
+        net = _small_net()
+        p = str(tmp_path / "m.zip")
+        save_model(net, p)
+        assert sniff_format(p) == "native"
+        restored = load_pretrained(p)
+        x = np.random.default_rng(0).standard_normal((3, 4)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(restored.output(x)),
+                                   np.asarray(net.output(x)), rtol=1e-6)
+
+    def test_dl4j_zip_detected_and_loaded(self):
+        p = os.path.join(os.path.dirname(__file__), "fixtures", "dl4j",
+                         "mlp_dl4j_layout.zip")
+        assert sniff_format(p) == "dl4j"
+        net = load_pretrained(p)
+        assert net.params_tree
+
+    def test_keras_h5_detected_and_loaded(self, tmp_path):
+        from keras_fixtures import make_dense_sequential_h5
+
+        p = str(tmp_path / "k.h5")
+        make_dense_sequential_h5(p)
+        assert sniff_format(p) == "keras_h5"
+        net = load_pretrained(p)
+        x = np.zeros((2, 8), np.float32)
+        assert np.asarray(net.output(x)).shape == (2, 3)
+
+    def test_init_pretrained_explicit_path(self, tmp_path):
+        """ZooModel.init_pretrained(path=...) loads any format without
+        touching the catalog/network."""
+        net = _small_net()
+        p = str(tmp_path / "weights.zip")
+        save_model(net, p)
+        restored = LeNet().init_pretrained(path=p)
+        assert restored.params_tree
+
+    def test_unrecognized_format_raises(self, tmp_path):
+        p = tmp_path / "junk.bin"
+        p.write_bytes(b"\x00" * 64)
+        with pytest.raises(ValueError, match="unrecognized"):
+            sniff_format(str(p))
+
+
+class TestImageNetLabels:
+    def _index_file(self, tmp_path, n=4):
+        data = {str(i): [f"n{i:08d}", f"name_{i}"] for i in range(n)}
+        p = tmp_path / "imagenet_class_index.json"
+        p.write_text(json.dumps(data))
+        return str(p)
+
+    def test_loads_from_explicit_path(self, tmp_path):
+        labels = ImageNetLabels(self._index_file(tmp_path),
+                                allow_download=False)
+        assert not labels.synthetic
+        assert labels.get_label(2) == "name_2"
+        assert labels.wnid(0) == "n00000000"
+
+    def test_synthetic_fallback_is_flagged(self, tmp_path, monkeypatch):
+        import deeplearning4j_tpu.zoo.pretrained as zp
+
+        monkeypatch.setattr(zp, "cache_dir", lambda: str(tmp_path / "empty"))
+        os.makedirs(tmp_path / "empty", exist_ok=True)
+        labels = ImageNetLabels(allow_download=False)
+        assert labels.synthetic
+        assert len(labels) == 1000
+        assert labels.get_label(7) == "class_7"
+
+    def test_decode_predictions(self, tmp_path):
+        labels = ImageNetLabels(self._index_file(tmp_path),
+                                allow_download=False)
+        probs = np.array([[0.1, 0.6, 0.2, 0.1],
+                          [0.7, 0.1, 0.1, 0.1]], np.float32)
+        out = labels.decode_predictions(probs, top=2)
+        assert out[0][0][1] == "name_1" and out[0][0][2] == pytest.approx(0.6)
+        assert out[1][0][1] == "name_0"
+        # 1-D input treated as a single example
+        single = labels.decode_predictions(probs[0], top=1)
+        assert single[0][0][1] == "name_1"
