@@ -113,26 +113,46 @@ class RecordReaderDataSetIterator(DataSetIterator):
 
     def __init__(self, reader: RecordReader, batch_size: int,
                  label_index: int = -1, num_classes: Optional[int] = None,
-                 regression: bool = False):
+                 regression: bool = False, collect_meta: bool = False):
         self.reader = reader
         self.bs = batch_size
         self.label_index = label_index
         self.num_classes = num_classes
         self.regression = regression
+        # reference: RecordReaderDataSetIterator.setCollectMetaData(true) —
+        # each batch then exposes per-example RecordMetaData via
+        # `last_meta` for Evaluation.eval(..., record_meta=...)
+        self.collect_meta = collect_meta
+        self.last_meta: Optional[list] = None
+        self._record_index = 0
         self._it: Optional[Iterator] = None
+
+    def set_collect_meta_data(self, v: bool) -> None:
+        """Reference: setCollectMetaData."""
+        self.collect_meta = v
 
     def reset(self):
         self._it = iter(self.reader)
+        self._record_index = 0
 
     def __next__(self) -> DataSet:
         if self._it is None:
             self.reset()
         feats, labs = [], []
+        metas = [] if self.collect_meta else None
+        if metas is not None:
+            from deeplearning4j_tpu.eval.meta import RecordMetaData
+
+            src = str(getattr(self.reader, "path",
+                              type(self.reader).__name__))
         for _ in range(self.bs):
             try:
                 rec = next(self._it)
             except StopIteration:
                 break
+            if metas is not None:
+                metas.append(RecordMetaData(src, self._record_index))
+            self._record_index += 1
             if isinstance(rec[0], np.ndarray):  # image record
                 feats.append(rec[0])
                 labs.append(rec[1])
@@ -145,6 +165,7 @@ class RecordReaderDataSetIterator(DataSetIterator):
         if not feats:
             self._it = None
             raise StopIteration
+        self.last_meta = metas
         x = np.asarray(feats, np.float32)
         if self.regression:
             y = np.asarray(labs, np.float32).reshape(len(labs), -1)

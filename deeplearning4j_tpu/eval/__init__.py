@@ -10,8 +10,10 @@ from deeplearning4j_tpu.eval.evaluation import Evaluation, ConfusionMatrix
 from deeplearning4j_tpu.eval.regression import RegressionEvaluation
 from deeplearning4j_tpu.eval.roc import ROC, ROCBinary, ROCMultiClass
 from deeplearning4j_tpu.eval.binary import EvaluationBinary
+from deeplearning4j_tpu.eval.meta import Prediction, RecordMetaData
 
 __all__ = [
     "Evaluation", "ConfusionMatrix", "RegressionEvaluation", "ROC",
     "ROCBinary", "ROCMultiClass", "EvaluationBinary",
+    "Prediction", "RecordMetaData",
 ]

@@ -40,19 +40,29 @@ class Evaluation:
         self.num_classes = num_classes
         self.label_names = list(labels) if labels else None
         self.confusion: Optional[ConfusionMatrix] = None
+        # per-example Prediction records, populated only when record_meta
+        # is passed to eval() (reference: eval/meta/, stored when
+        # RecordMetaData flows through eval(labels, out, meta))
+        self.predictions: list = []
 
     def _ensure(self, n: int):
         if self.confusion is None:
             self.num_classes = self.num_classes or n
             self.confusion = ConfusionMatrix(self.num_classes)
 
-    def eval(self, labels, predictions, mask=None):
+    def eval(self, labels, predictions, mask=None, record_meta=None):
         """Accumulate a batch. labels/predictions: one-hot or prob arrays
         [batch, n] or [batch, time, n]; integer class labels [batch] also
-        accepted. Reference: `eval():218` + evalTimeSeries."""
+        accepted. `record_meta`: optional per-example RecordMetaData list
+        — enables the per-example accessors (get_prediction_errors, ...).
+        Reference: `eval():218` + evalTimeSeries + eval(..., meta)."""
         labels = np.asarray(labels)
         predictions = np.asarray(predictions)
         if labels.ndim == 3:  # time series → flatten (with mask)
+            if record_meta is not None:
+                raise ValueError(
+                    "record_meta is not supported with per-timestep (3-D) "
+                    "labels — the reference's meta path is per-example")
             B, T, C = labels.shape
             labels = labels.reshape(B * T, C)
             predictions = predictions.reshape(B * T, -1)
@@ -66,8 +76,32 @@ class Evaluation:
             actual = labels.astype(np.int64)
             n = int(predictions.shape[-1])
         pred = predictions.argmax(axis=-1)
+        # validate BEFORE mutating so a caught error leaves the metrics
+        # un-double-countable on retry
+        if record_meta is not None and len(record_meta) != len(actual):
+            raise ValueError(
+                f"record_meta has {len(record_meta)} entries for "
+                f"{len(actual)} examples")
         self._ensure(n)
         np.add.at(self.confusion.matrix, (actual, pred), 1)
+        if record_meta is not None:
+            from deeplearning4j_tpu.eval.meta import Prediction
+
+            self.predictions.extend(
+                Prediction(int(a), int(p), m)
+                for a, p, m in zip(actual, pred, record_meta))
+
+    # ---- per-example accessors (reference: eval/meta + Evaluation
+    #      getPredictionErrors/getPredictionsByActualClass/...) ----
+    def get_prediction_errors(self) -> list:
+        """All misclassified examples' Prediction records."""
+        return [p for p in self.predictions if p.actual != p.predicted]
+
+    def get_predictions_by_actual_class(self, cls: int) -> list:
+        return [p for p in self.predictions if p.actual == cls]
+
+    def get_predictions_by_predicted_class(self, cls: int) -> list:
+        return [p for p in self.predictions if p.predicted == cls]
 
     # ---- metrics (reference method names) ----
     def _tp(self, c):
@@ -145,4 +179,5 @@ class Evaluation:
             self.num_classes = other.num_classes
             self.confusion = ConfusionMatrix(other.num_classes)
         self.confusion.matrix = self.confusion.matrix + other.confusion.matrix
+        self.predictions.extend(other.predictions)
         return self

@@ -33,7 +33,7 @@ from deeplearning4j_tpu.parallel.moe import (
 )
 from deeplearning4j_tpu.parallel.training_master import (
     TrainingMaster, ParameterAveragingTrainingMaster,
-    DistributedTrainingMaster, PhaseStats,
+    DistributedTrainingMaster, PhaseStats, export_timeline_html,
 )
 from deeplearning4j_tpu.parallel.estimator import NetworkEstimator
 from deeplearning4j_tpu.parallel.checkpoint import ShardedCheckpointer

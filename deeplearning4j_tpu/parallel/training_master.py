@@ -41,7 +41,11 @@ _tmap = jax.tree_util.tree_map
 
 @dataclasses.dataclass
 class PhaseStats:
-    """One split's phase timings (reference: EventStats / StatsUtils)."""
+    """One split's phase timings (reference: EventStats / StatsUtils).
+    `start_ms` is a wall-clock stamp from the configured TimeSource
+    (SystemClock or NTP — `utils/timesource.py`), so timelines from
+    multiple hosts can line up like the reference's NTP-corrected
+    EventStats."""
 
     split_index: int
     n_examples: int
@@ -49,6 +53,7 @@ class PhaseStats:
     aggregate_ms: float
     broadcast_ms: float
     score: float
+    start_ms: float = 0.0
 
 
 class TrainingMaster:
@@ -142,6 +147,13 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         net.score_ = self._stats[-1].score if self._stats else net.score_
 
     def _run_split(self, net, step, si, xs, ys, bs, graph):
+        start_ms = 0.0
+        if self.collect_stats:  # keep TimeSource (possibly NTP) off the
+            from deeplearning4j_tpu.utils.timesource import (  # hot path
+                TimeSourceProvider,
+            )
+
+            start_ms = TimeSourceProvider.get_instance().current_time_millis()
         t0 = time.perf_counter()
         parts = np.array_split(np.arange(xs.shape[0]), self.num_workers)
         in_name = (net.conf.network_inputs[0]
@@ -203,7 +215,8 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
             self._stats.append(PhaseStats(
                 split_index=si, n_examples=int(xs.shape[0]),
                 fit_ms=(t1 - t0) * 1e3, aggregate_ms=(t2 - t1) * 1e3,
-                broadcast_ms=(t3 - t2) * 1e3, score=score))
+                broadcast_ms=(t3 - t2) * 1e3, score=score,
+                start_ms=start_ms))
         else:
             self._stats.append(PhaseStats(si, int(xs.shape[0]), 0, 0, 0,
                                           score))
@@ -245,6 +258,13 @@ class DistributedTrainingMaster(TrainingMaster):
                     "host_local_shard; pre-shard iterator inputs manually")
             sl = host_local_shard(len(data))
             data, labels = data[sl], labels[sl]
+        start_ms = 0.0
+        if self.collect_stats:
+            from deeplearning4j_tpu.utils.timesource import (
+                TimeSourceProvider,
+            )
+
+            start_ms = TimeSourceProvider.get_instance().current_time_millis()
         t0 = time.perf_counter()
         pw = ParallelWrapper(net, mesh=self.mesh)
         pw.fit(data, labels, epochs=epochs, batch_size=batch_size)
@@ -252,7 +272,59 @@ class DistributedTrainingMaster(TrainingMaster):
             self._stats.append(PhaseStats(
                 0, len(data) if hasattr(data, "__len__") else -1,
                 (time.perf_counter() - t0) * 1e3, 0.0, 0.0,
-                float(net.score_)))
+                float(net.score_), start_ms=start_ms))
 
     def training_stats(self) -> List[PhaseStats]:
         return self._stats
+
+
+def export_timeline_html(stats: List[PhaseStats], path: str, *,
+                         title: str = "Training phase timeline") -> str:
+    """Render collected PhaseStats as an HTML timeline + summary table.
+
+    Reference: `spark/stats/StatsUtils.java` exportStatsAsHtml — the
+    fit/aggregate/broadcast phases of every split on lanes over wall
+    time. Built from the reusable UI components (ui/components.py), so
+    the chart payload is also available as JSON via .to_dict()."""
+    from deeplearning4j_tpu.ui.components import (
+        ChartTimeline, ComponentDiv, ComponentTable, Style,
+    )
+
+    lanes = ("fit", "aggregate", "broadcast")
+    entries = []
+    t = 0.0
+    base = min((s.start_ms for s in stats if s.start_ms), default=0.0)
+    for s in stats:
+        t0 = (s.start_ms - base) if s.start_ms else t
+        spans = ((0, s.fit_ms), (1, s.aggregate_ms), (2, s.broadcast_ms))
+        cur = t0
+        for lane, dur in spans:
+            if dur > 0:
+                entries.append((lane, cur, cur + dur,
+                                f"split {s.split_index}: "
+                                f"{lanes[lane]} {dur:.1f} ms"))
+                cur += dur
+        t = max(t, cur)
+    chart = ChartTimeline(
+        title=title, lanes=lanes, entries=tuple(entries),
+        style=Style(width=960, height=220))
+    table = ComponentTable(
+        title="Per-split phase timings",
+        header=("split", "examples", "fit ms", "aggregate ms",
+                "broadcast ms", "score"),
+        rows=tuple((str(s.split_index), str(s.n_examples),
+                    f"{s.fit_ms:.1f}", f"{s.aggregate_ms:.1f}",
+                    f"{s.broadcast_ms:.1f}", f"{s.score:.5f}")
+                   for s in stats))
+    from html import escape
+
+    doc = ComponentDiv(children=(chart, table))
+    html = ("<!doctype html><html><head><title>" + escape(title)
+            + "</title>"
+            "<style>table.uic{border-collapse:collapse;font-size:13px}"
+            "table.uic td,table.uic th{border:1px solid #ddd;"
+            "padding:3px 8px}</style></head><body>"
+            + doc.render() + "</body></html>")
+    with open(path, "w") as f:
+        f.write(html)
+    return html

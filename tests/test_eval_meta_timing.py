@@ -1,0 +1,176 @@
+"""Per-example evaluation metadata + phase timing / NTP time source.
+
+Reference: eval/meta/Prediction.java + RecordMetaData plumbing;
+spark/stats/StatsUtils.java (HTML timeline export); spark/time/
+NTPTimeSource.java + TimeSourceProvider.
+"""
+
+import os
+import struct
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.eval import Evaluation
+from deeplearning4j_tpu.eval.meta import Prediction, RecordMetaData
+
+
+class TestEvalMetadata:
+    def test_predictions_recorded_with_meta(self):
+        ev = Evaluation(num_classes=3)
+        labels = np.eye(3, dtype=np.float32)[[0, 1, 2, 1]]
+        preds = np.eye(3, dtype=np.float32)[[0, 2, 2, 1]]  # one error at i=1
+        meta = [RecordMetaData("test.csv", i) for i in range(4)]
+        ev.eval(labels, preds, record_meta=meta)
+        errs = ev.get_prediction_errors()
+        assert len(errs) == 1
+        assert errs[0].actual == 1 and errs[0].predicted == 2
+        assert errs[0].record_meta.location == 1
+        assert "test.csv[1]" in str(errs[0])
+
+    def test_by_class_accessors(self):
+        ev = Evaluation(num_classes=2)
+        labels = np.eye(2, dtype=np.float32)[[0, 0, 1, 1]]
+        preds = np.eye(2, dtype=np.float32)[[0, 1, 1, 1]]
+        meta = [RecordMetaData("m", i) for i in range(4)]
+        ev.eval(labels, preds, record_meta=meta)
+        assert len(ev.get_predictions_by_actual_class(0)) == 2
+        assert len(ev.get_predictions_by_predicted_class(1)) == 3
+
+    def test_meta_length_mismatch_raises(self):
+        ev = Evaluation(num_classes=2)
+        with pytest.raises(ValueError, match="record_meta"):
+            ev.eval(np.eye(2, dtype=np.float32)[[0, 1]],
+                    np.eye(2, dtype=np.float32)[[0, 1]],
+                    record_meta=[RecordMetaData("m", 0)])
+
+    def test_merge_carries_predictions(self):
+        a, b = Evaluation(2), Evaluation(2)
+        one = np.eye(2, dtype=np.float32)
+        a.eval(one[[0]], one[[1]], record_meta=[RecordMetaData("a", 0)])
+        b.eval(one[[1]], one[[1]], record_meta=[RecordMetaData("b", 0)])
+        a.merge(b)
+        assert len(a.predictions) == 2
+        assert len(a.get_prediction_errors()) == 1
+
+    def test_record_reader_collect_meta(self, tmp_path):
+        from deeplearning4j_tpu.data.records import (
+            CSVRecordReader, RecordReaderDataSetIterator,
+        )
+
+        p = tmp_path / "data.csv"
+        p.write_text("1.0,2.0,0\n3.0,4.0,1\n5.0,6.0,0\n")
+        rr = CSVRecordReader(str(p))
+        it = RecordReaderDataSetIterator(rr, batch_size=2, num_classes=2,
+                                         collect_meta=True)
+        ds = next(it)
+        assert it.last_meta is not None and len(it.last_meta) == 2
+        assert it.last_meta[0].location == 0
+        ds2 = next(it)
+        assert it.last_meta[0].location == 2  # index continues across batches
+
+
+class TestTimeSource:
+    def test_system_clock(self):
+        import time as _t
+        from deeplearning4j_tpu.utils.timesource import SystemClockTimeSource
+
+        ts = SystemClockTimeSource()
+        assert abs(ts.current_time_millis() - _t.time() * 1000) < 2000
+
+    def test_ntp_falls_back_gracefully_offline(self):
+        from deeplearning4j_tpu.utils.timesource import NTPTimeSource
+
+        ts = NTPTimeSource(server="127.0.0.1", timeout=0.2)
+        # no NTP server there: unsynchronized but still serving time
+        assert not ts.synchronized_
+        assert ts.current_time_millis() > 0
+
+    def test_provider_singleton_and_override(self):
+        from deeplearning4j_tpu.utils.timesource import (
+            SystemClockTimeSource, TimeSourceProvider,
+        )
+
+        TimeSourceProvider.set_instance(None)
+        a = TimeSourceProvider.get_instance()
+        assert isinstance(a, SystemClockTimeSource)
+        assert TimeSourceProvider.get_instance() is a
+        TimeSourceProvider.set_instance(None)
+
+    def test_sntp_packet_parsing(self, monkeypatch):
+        """Feed a canned RFC4330 response through the socket seam."""
+        import deeplearning4j_tpu.utils.timesource as tsm
+
+        class FakeSocket:
+            def __init__(self, *a, **k):
+                pass
+
+            def __enter__(self):
+                return self
+
+            def __exit__(self, *a):
+                pass
+
+            def settimeout(self, t):
+                pass
+
+            def sendto(self, data, addr):
+                assert data[0] == 0x1B and len(data) == 48
+
+            def recvfrom(self, n):
+                import time as _t
+                now = _t.time() + tsm._NTP_EPOCH_DELTA + 1.5  # +1.5s offset
+                sec = int(now)
+                frac = int((now - sec) * 2**32)
+                resp = bytearray(48)
+                struct.pack_into("!II", resp, 32, sec, frac)
+                struct.pack_into("!II", resp, 40, sec, frac)
+                return bytes(resp), ("server", 123)
+
+        monkeypatch.setattr(tsm.socket, "socket",
+                            lambda *a, **k: FakeSocket())
+        off = tsm.sntp_offset_ms("fake")
+        assert 1000 < off < 2000  # ~1.5s offset recovered
+
+
+class TestTimelineExport:
+    def test_export_html(self, tmp_path):
+        from deeplearning4j_tpu.parallel import (
+            PhaseStats, export_timeline_html,
+        )
+
+        stats = [
+            PhaseStats(0, 64, 120.0, 30.0, 5.0, 1.2, start_ms=1000.0),
+            PhaseStats(1, 64, 110.0, 28.0, 4.0, 1.1, start_ms=1200.0),
+        ]
+        p = str(tmp_path / "timeline.html")
+        html = export_timeline_html(stats, p)
+        assert os.path.exists(p)
+        assert "<svg" in html and "fit" in html and "aggregate" in html
+        assert "Per-split phase timings" in html
+        assert "1.20000" in html  # score in the table
+
+    def test_training_master_stats_have_timestamps(self):
+        from deeplearning4j_tpu.models import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.parallel import (
+            ParameterAveragingTrainingMaster, export_timeline_html,
+        )
+
+        net = MultiLayerNetwork(
+            NeuralNetConfiguration.builder().seed(0)
+            .list(DenseLayer(n_in=4, n_out=8, activation="tanh"),
+                  OutputLayer(n_in=8, n_out=2, activation="softmax",
+                              loss="mcxent"))
+            .build()).init()
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((128, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 128)]
+        tm = ParameterAveragingTrainingMaster(
+            num_workers=2, batch_size=16, averaging_frequency=2,
+            collect_training_stats=True)
+        tm.execute_training(net, x, y)
+        stats = tm.training_stats()
+        assert stats and all(s.start_ms > 0 for s in stats)
+        assert stats == sorted(stats, key=lambda s: s.start_ms)
