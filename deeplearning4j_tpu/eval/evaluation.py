@@ -76,20 +76,35 @@ class Evaluation:
             actual = labels.astype(np.int64)
             n = int(predictions.shape[-1])
         pred = predictions.argmax(axis=-1)
-        # validate BEFORE mutating so a caught error leaves the metrics
-        # un-double-countable on retry
+        # eval_indices validates record_meta BEFORE mutating, so a caught
+        # error leaves the metrics un-double-countable on retry
+        self.eval_indices(actual, pred, num_classes=n,
+                          record_meta=record_meta)
+
+    def eval_indices(self, actual, predicted,
+                     num_classes: Optional[int] = None,
+                     record_meta=None) -> None:
+        """Accumulate pre-argmaxed class indices — the device-side fast
+        path (model.evaluate computes argmax on device and ships only int
+        vectors to host)."""
+        actual = np.asarray(actual).astype(np.int64)
+        predicted = np.asarray(predicted).astype(np.int64)
+        if len(actual) == 0:
+            return
+        n = (num_classes if num_classes is not None
+             else int(max(actual.max(), predicted.max())) + 1)
         if record_meta is not None and len(record_meta) != len(actual):
             raise ValueError(
                 f"record_meta has {len(record_meta)} entries for "
                 f"{len(actual)} examples")
         self._ensure(n)
-        np.add.at(self.confusion.matrix, (actual, pred), 1)
+        np.add.at(self.confusion.matrix, (actual, predicted), 1)
         if record_meta is not None:
             from deeplearning4j_tpu.eval.meta import Prediction
 
             self.predictions.extend(
                 Prediction(int(a), int(p), m)
-                for a, p, m in zip(actual, pred, record_meta))
+                for a, p, m in zip(actual, predicted, record_meta))
 
     # ---- per-example accessors (reference: eval/meta + Evaluation
     #      getPredictionErrors/getPredictionsByActualClass/...) ----

@@ -24,6 +24,7 @@ from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
 from deeplearning4j_tpu.data.iterators import DataSetIterator, as_iterator
 from deeplearning4j_tpu.nn.graph import (
     ComputationGraphConfiguration, GraphVertex, LayerVertex,
+    resolve_output_type,
 )
 from deeplearning4j_tpu.nn.layers.special import CenterLossOutputLayer
 from deeplearning4j_tpu.models.multilayer import (
@@ -74,10 +75,8 @@ class ComputationGraph:
             states[name] = s
             if s:
                 self._stateful.add(name)
-            try:
-                known[name] = v.output_type(*in_types)
-            except Exception:
-                pass
+            resolve_output_type(name, v, in_types,
+                                len(self.conf.vertex_inputs[name]), known)
         self.params_tree = params
         self.state_tree = states
         self._build_updaters()

@@ -435,16 +435,25 @@ class MultiLayerNetwork:
         return acts
 
     def score(self, data, labels=None) -> float:
-        """Mean loss on data. Reference: `score(DataSet)`."""
+        """Mean loss on data, as ONE jitted computation (an eager _loss
+        call here would retrace per invocation). Reference:
+        `score(DataSet)`."""
         ds = data if isinstance(data, DataSet) else DataSet(
             np.asarray(data), np.asarray(labels))
-        loss, _ = self._loss(
+        key = ("score", ds.features_mask is not None,
+               ds.labels_mask is not None)
+        if key not in self._jit_cache:
+            def score_fn(params, states, feats, labs, fm, lm):
+                loss, _ = self._loss(params, states, feats, labs, fm, lm,
+                                     None, train=False)
+                return loss
+            self._jit_cache[key] = jax.jit(score_fn)
+        loss = self._jit_cache[key](
             self.params_tree, self.state_tree,
             jnp.asarray(ds.features, self.dtype),
             None if ds.labels is None else jnp.asarray(ds.labels),
             None if ds.features_mask is None else jnp.asarray(ds.features_mask),
-            None if ds.labels_mask is None else jnp.asarray(ds.labels_mask),
-            rng=None, train=False)
+            None if ds.labels_mask is None else jnp.asarray(ds.labels_mask))
         return float(loss)
 
     def predict(self, x) -> np.ndarray:
@@ -452,13 +461,39 @@ class MultiLayerNetwork:
         return np.asarray(jnp.argmax(self.output(x), axis=-1))
 
     def evaluate(self, iterator: DataSetIterator):
-        """Reference: `MultiLayerNetwork.evaluate(DataSetIterator)`."""
+        """Reference: `MultiLayerNetwork.evaluate(DataSetIterator)`.
+
+        For plain per-example classification the argmax happens ON DEVICE
+        and only int32 class indices cross to host (the full softmax
+        round-trip only happens for masked/time-series labels, which the
+        Evaluation flattens host-side)."""
         from deeplearning4j_tpu.eval.evaluation import Evaluation
 
         e = Evaluation()
+        key = ("eval_argmax",)
+        if key not in self._jit_cache:
+            def pred_fn(params, states, feats):
+                y, _, _, _ = self._forward(params, states, feats,
+                                           train=False, rng=None)
+                return jnp.argmax(y, axis=-1).astype(jnp.int32)
+            self._jit_cache[key] = jax.jit(pred_fn)
         for ds in iterator:
-            out = np.asarray(self.output(ds.features))
-            e.eval(ds.labels, out, mask=ds.labels_mask)
+            labels = np.asarray(ds.labels)
+            if labels.ndim == 3 or ds.labels_mask is not None:
+                out = np.asarray(self.output(ds.features))
+                e.eval(labels, out, mask=ds.labels_mask)
+                continue
+            pred = np.asarray(self._jit_cache[key](
+                self.params_tree, self.state_tree,
+                jnp.asarray(ds.features, self.dtype)))
+            actual = (labels.argmax(-1) if labels.ndim == 2
+                      else labels.astype(np.int64))
+            # class count from one-hot width, else the model's own head
+            # width (a first batch missing high classes must not shrink
+            # the confusion matrix)
+            n = (labels.shape[-1] if labels.ndim == 2
+                 else getattr(self.layers[-1], "n_out", None))
+            e.eval_indices(actual, pred, num_classes=n)
         return e
 
     # ----------------------------------------------------- rnn stepping

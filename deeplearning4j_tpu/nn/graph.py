@@ -26,6 +26,27 @@ from deeplearning4j_tpu.nn.preprocessors import Preprocessor
 from deeplearning4j_tpu.utils.serde import register_serde, to_json, from_json
 
 
+def resolve_output_type(name, vertex, in_types, n_inputs, known):
+    """Shape propagation shared by GraphBuilder.build and
+    ComputationGraph.init: when ALL input shapes are known, an
+    output_type failure is a configuration error surfaced with the
+    vertex name; partially-known inputs are skipped (downstream n_in
+    must be explicit); zero-input vertices try best-effort."""
+    if in_types and len(in_types) == n_inputs:
+        try:
+            known[name] = vertex.output_type(*in_types)
+        except Exception as e:
+            raise ValueError(
+                f"vertex {name!r} ({type(vertex).__name__}): incompatible "
+                f"with its input types {[str(t) for t in in_types]}: {e}"
+            ) from e
+    elif not n_inputs:
+        try:
+            known[name] = vertex.output_type(*in_types)
+        except Exception:
+            pass  # untyped zero-input vertex
+
+
 @dataclasses.dataclass(frozen=True)
 class GraphVertex:
     """Base DAG node (non-layer). Pure like Layer: init_params/apply."""
@@ -47,9 +68,24 @@ class GraphVertex:
 @dataclasses.dataclass(frozen=True)
 class ElementWiseVertex(GraphVertex):
     """Add/Subtract/Product/Average/Max of same-shaped inputs.
-    Reference: `nn/conf/graph/ElementWiseVertex.java`."""
+    Reference: `nn/conf/graph/ElementWiseVertex.java` (validates input
+    compatibility at config time like the reference's getOutputType)."""
 
     op: str = "add"
+
+    def output_type(self, *input_types: InputType) -> InputType:
+        def sig(t):
+            # all shape-bearing fields; timesteps excluded (may be
+            # legitimately unknown on one branch)
+            return (t.kind, t.size, t.height, t.width, t.channels, t.depth)
+
+        t0 = input_types[0]
+        for t in input_types[1:]:
+            if sig(t) != sig(t0):
+                raise ValueError(
+                    f"ElementWiseVertex inputs must have identical shapes; "
+                    f"got {t0} vs {t}")
+        return t0
 
     def apply(self, params, inputs, **kw):
         op = self.op.lower()
@@ -420,11 +456,8 @@ class GraphBuilder:
                 _validate_layer(layer, -1)
                 v = dataclasses.replace(v, layer=layer)
             finalized[name] = v
-            if in_types or not self._inputs[name]:
-                try:
-                    known[name] = v.output_type(*in_types)
-                except Exception:
-                    pass  # shape unknown → downstream n_in must be explicit
+            resolve_output_type(name, v, in_types,
+                                len(self._inputs[name]), known)
         missing = [o for o in self._network_outputs if o not in finalized]
         if missing:
             raise ValueError(f"set_outputs references unknown vertices: {missing}")

@@ -79,15 +79,19 @@ class NTPTimeSource(TimeSource):
         self.timeout = timeout
         self.offset_ms = 0.0
         self.synchronized_ = False
-        self._last_update = 0.0
-        self._maybe_update()
+        # first sync inline (construction isn't on the timed path); later
+        # refreshes run on a daemon thread — the reference schedules its
+        # updates on a background executor for the same reason:
+        # current_time_millis() must never block on the network.
+        self._update_once()
+        import threading
 
-    def _maybe_update(self):
-        now = time.time() * 1000
-        if now - self._last_update < self.update_freq_ms and \
-                self._last_update > 0:
-            return
-        self._last_update = now
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._refresh_loop, daemon=True, name="ntp-refresh")
+        self._thread.start()
+
+    def _update_once(self):
         try:
             self.offset_ms = sntp_offset_ms(
                 self.server, timeout=self.timeout)
@@ -97,8 +101,15 @@ class NTPTimeSource(TimeSource):
             # serving system time rather than failing training)
             self.synchronized_ = False
 
+    def _refresh_loop(self):
+        while not self._stop.wait(self.update_freq_ms / 1000.0):
+            self._update_once()
+
+    def close(self):
+        self._stop.set()
+
     def current_time_millis(self) -> int:
-        self._maybe_update()
+        """Cached-offset read — never touches the network."""
         return int(time.time() * 1000 + self.offset_ms)
 
 
