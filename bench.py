@@ -266,7 +266,7 @@ def _child_main():
         jax.config.update("jax_platforms", "cpu")
 
     model = os.environ.get("BENCH_MODEL", "resnet50")
-    batch = int(os.environ.get("BENCH_BATCH", "256"))
+    batch = int(os.environ.get("BENCH_BATCH", "128"))
     steps = int(os.environ.get("BENCH_STEPS", "40"))
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
 
@@ -302,7 +302,7 @@ def _attempt_plans():
     one degrades to cheaper configs and finally to the CPU backend so the
     driver always records a structured number."""
     model = os.environ.get("BENCH_MODEL", "resnet50")
-    batch = int(os.environ.get("BENCH_BATCH", "256"))
+    batch = int(os.environ.get("BENCH_BATCH", "128"))
     plans = [
         ({}, f"{model} b{batch}"),
         ({}, f"{model} b{batch} retry"),

@@ -49,6 +49,15 @@ class Evaluation:
         if self.confusion is None:
             self.num_classes = self.num_classes or n
             self.confusion = ConfusionMatrix(self.num_classes)
+        if n > self.num_classes:
+            # grow: a first batch that happened to miss high class indices
+            # must not pin the matrix size for the rest of the evaluation
+            old = self.confusion.matrix
+            grown = np.zeros((n, n), old.dtype)
+            grown[:old.shape[0], :old.shape[1]] = old
+            self.num_classes = n
+            self.confusion = ConfusionMatrix(n)
+            self.confusion.matrix = grown
 
     def eval(self, labels, predictions, mask=None, record_meta=None):
         """Accumulate a batch. labels/predictions: one-hot or prob arrays
@@ -90,9 +99,12 @@ class Evaluation:
         actual = np.asarray(actual).astype(np.int64)
         predicted = np.asarray(predicted).astype(np.int64)
         if len(actual) == 0:
+            if num_classes:  # keep metrics well-defined on empty input
+                self._ensure(num_classes)
             return
         n = (num_classes if num_classes is not None
              else int(max(actual.max(), predicted.max())) + 1)
+        n = max(n, int(max(actual.max(), predicted.max())) + 1)
         if record_meta is not None and len(record_meta) != len(actual):
             raise ValueError(
                 f"record_meta has {len(record_meta)} entries for "
@@ -123,11 +135,15 @@ class Evaluation:
         return self.confusion.matrix[c, c]
 
     def accuracy(self) -> float:
+        if self.confusion is None:   # nothing accumulated yet
+            return 0.0
         m = self.confusion.matrix
         total = m.sum()
         return float(np.trace(m) / total) if total else 0.0
 
     def precision(self, cls: Optional[int] = None) -> float:
+        if self.confusion is None:
+            return 0.0
         m = self.confusion.matrix
         if cls is not None:
             denom = m[:, cls].sum()
@@ -137,6 +153,8 @@ class Evaluation:
         return float(np.mean(vals)) if vals else 0.0
 
     def recall(self, cls: Optional[int] = None) -> float:
+        if self.confusion is None:
+            return 0.0
         m = self.confusion.matrix
         if cls is not None:
             denom = m[cls, :].sum()
@@ -166,6 +184,8 @@ class Evaluation:
 
     def stats(self) -> str:
         """Human-readable summary. Reference: `stats():414`."""
+        if self.confusion is None:
+            return "Evaluation: no examples accumulated"
         lines = [
             "========================Evaluation Metrics========================",
             f" # of classes: {self.num_classes}",

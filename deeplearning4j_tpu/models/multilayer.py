@@ -469,6 +469,7 @@ class MultiLayerNetwork:
         Evaluation flattens host-side)."""
         from deeplearning4j_tpu.eval.evaluation import Evaluation
 
+        self._check_init()
         e = Evaluation()
         key = ("eval_argmax",)
         if key not in self._jit_cache:
@@ -483,6 +484,7 @@ class MultiLayerNetwork:
                 out = np.asarray(self.output(ds.features))
                 e.eval(labels, out, mask=ds.labels_mask)
                 continue
+            self._check_input(np.asarray(ds.features))
             pred = np.asarray(self._jit_cache[key](
                 self.params_tree, self.state_tree,
                 jnp.asarray(ds.features, self.dtype)))

@@ -102,10 +102,15 @@ class NTPTimeSource(TimeSource):
             self.synchronized_ = False
 
     def _refresh_loop(self):
-        while not self._stop.wait(self.update_freq_ms / 1000.0):
+        # clamp to >= 1s so update_freq_ms=0 can't busy-loop SNTP queries
+        interval = max(self.update_freq_ms, 1000) / 1000.0
+        while not self._stop.wait(interval):
             self._update_once()
 
     def close(self):
+        self._stop.set()
+
+    def __del__(self):
         self._stop.set()
 
     def current_time_millis(self) -> int:
@@ -129,4 +134,7 @@ class TimeSourceProvider:
 
     @classmethod
     def set_instance(cls, ts: Optional[TimeSource]) -> None:
+        old = cls._instance
+        if old is not None and old is not ts and hasattr(old, "close"):
+            old.close()   # stop a replaced NTP instance's refresh thread
         cls._instance = ts

@@ -174,3 +174,40 @@ class TestTimelineExport:
         stats = tm.training_stats()
         assert stats and all(s.start_ms > 0 for s in stats)
         assert stats == sorted(stats, key=lambda s: s.start_ms)
+
+
+class TestEvalRobustness:
+    def test_confusion_matrix_grows_across_batches(self):
+        ev = Evaluation()
+        ev.eval_indices([0, 1], [0, 1])      # first batch: classes 0-1
+        ev.eval_indices([3], [3])            # later batch: class 3
+        assert ev.num_classes == 4
+        assert ev.accuracy() == 1.0
+
+    def test_empty_batches_keep_metrics_defined(self):
+        ev = Evaluation()
+        ev.eval(np.empty((0, 3), np.float32), np.empty((0, 3), np.float32))
+        assert ev.accuracy() == 0.0
+        assert ev.precision() == 0.0 and ev.recall() == 0.0
+        assert "Accuracy" in ev.stats()  # matrix sized from softmax width
+        # a never-fed Evaluation stays well-defined too
+        fresh = Evaluation()
+        assert fresh.accuracy() == 0.0
+        assert "no examples" in fresh.stats()
+
+    def test_evaluate_requires_init(self):
+        from deeplearning4j_tpu.data.iterators import ArrayDataSetIterator
+        from deeplearning4j_tpu.models import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+        net = MultiLayerNetwork(
+            NeuralNetConfiguration.builder().seed(0)
+            .list(DenseLayer(n_in=4, n_out=8, activation="tanh"),
+                  OutputLayer(n_in=8, n_out=2, activation="softmax",
+                              loss="mcxent"))
+            .build())  # no init()
+        x = np.zeros((4, 4), np.float32)
+        y = np.eye(2, dtype=np.float32)[[0, 1, 0, 1]]
+        with pytest.raises(RuntimeError, match="not initialized"):
+            net.evaluate(ArrayDataSetIterator(x, y, 2))
