@@ -56,7 +56,6 @@ class Evaluation:
             grown = np.zeros((n, n), old.dtype)
             grown[:old.shape[0], :old.shape[1]] = old
             self.num_classes = n
-            self.confusion = ConfusionMatrix(n)
             self.confusion.matrix = grown
 
     def eval(self, labels, predictions, mask=None, record_meta=None):

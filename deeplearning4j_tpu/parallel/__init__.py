@@ -37,9 +37,12 @@ from deeplearning4j_tpu.parallel.training_master import (
 )
 from deeplearning4j_tpu.parallel.estimator import NetworkEstimator
 from deeplearning4j_tpu.parallel.checkpoint import ShardedCheckpointer
+from deeplearning4j_tpu.parallel.elastic import ElasticTrainer, PreemptionHandler
+from deeplearning4j_tpu.parallel.async_ps import AsyncParameterServer, AsyncTrainer
 
 __all__ = [
-    "ShardedCheckpointer",
+    "ShardedCheckpointer", "ElasticTrainer", "PreemptionHandler",
+    "AsyncParameterServer", "AsyncTrainer",
     "MeshSpec", "make_mesh", "device_count", "local_device_count",
     "ParallelWrapper", "ParallelInference",
     "ShardingRules", "shard_params", "replicate", "batch_sharding",

@@ -65,6 +65,8 @@ class ParallelWrapper:
         self.prefetch = prefetch_buffer
         self._graph = _is_graph(net)
         self._jit_cache: Dict[Any, Any] = {}
+        self.last_batch_index = -1   # in-epoch position (elastic resume)
+        self.stopped_early = False   # did the last fit() stop via stop_fn?
 
         if batch_axis not in self.mesh.axis_names:
             raise ValueError(
@@ -158,7 +160,8 @@ class ParallelWrapper:
 
     def fit(self, data, labels=None, *, epochs: int = 1,
             batch_size: int = 128, checkpointer=None,
-            checkpoint_every: int = 1, resume: Optional[Dict] = None):
+            checkpoint_every: int = 1, resume: Optional[Dict] = None,
+            stop_fn=None):
         """Reference: `ParallelWrapper.fit(DataSetIterator):409`. Partial
         final batches are padded by repetition to keep XLA shapes static.
 
@@ -167,7 +170,9 @@ class ParallelWrapper:
         dict returned by `ShardedCheckpointer.restore_into_wrapper` —
         training continues mid-epoch from the exact batch/rng/step, and
         `epochs` counts TOTAL epochs over the whole (resumed) run so an
-        interrupted fit(epochs=N) is finished by the same call."""
+        interrupted fit(epochs=N) is finished by the same call. `stop_fn`
+        (checked at step boundaries) ends training cleanly early —
+        the preemption seam used by ElasticTrainer."""
         net = self.net
         if isinstance(data, MultiDataSet):
             batches = [data]
@@ -181,15 +186,24 @@ class ParallelWrapper:
         skip = (resume or {}).get("batch_in_epoch", 0)
         for l in net.listeners:
             l.on_fit_start(net)
+        stopped = False
         for _ in range(start_epoch, epochs):
+            # per-epoch position: a stop before this epoch's first
+            # non-skipped batch must checkpoint the RESUMED position
+            # (skip batches are already trained), not the last epoch's tail
+            self.last_batch_index = skip - 1
             for l in net.listeners:
                 l.on_epoch_start(net, net.epoch)
             for bi, ds in enumerate(iterable()):
                 if bi < skip:
                     continue
+                if stop_fn is not None and stop_fn():
+                    stopped = True
+                    break
                 ds = self._pad_to_divisible(ds)
                 net.last_batch_size = ds.num_examples()
                 loss = self._step(ds)
+                self.last_batch_index = bi
                 net.score_ = loss
                 net.iteration += 1
                 for l in net.listeners:
@@ -199,9 +213,12 @@ class ParallelWrapper:
                     checkpointer.save(net, step=net.iteration,
                                       position={"batch_in_epoch": bi + 1})
             skip = 0
+            if stopped:
+                break
             for l in net.listeners:
                 l.on_epoch_end(net, net.epoch)
             net.epoch += 1
+        self.stopped_early = stopped   # authoritative for ElasticTrainer
         for l in net.listeners:
             l.on_fit_end(net)
         if checkpointer is not None:

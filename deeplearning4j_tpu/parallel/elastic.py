@@ -1,0 +1,121 @@
+"""Elastic training: preemption handling + the pod-level outer driver.
+
+SURVEY §5 ("Failure detection / elastic recovery: no elastic training" in
+the reference — "TPU build should do better: checkpoint-restart +
+preemption handling") and the layer-5 outer-driver role the reference
+delegates to Spark (SURVEY §2.8 item 5: "Spark as the multi-node
+scheduler ↦ JAX multi-controller / GCE orchestration as outer driver").
+
+Pieces:
+- PreemptionHandler: installs signal handlers (SIGTERM — what TPU VM
+  maintenance events deliver) that set a flag checked at step
+  boundaries; training stops CLEANLY (after the in-flight step and a
+  final sharded checkpoint) instead of dying mid-write.
+- ElasticTrainer: the outer driver loop — initialize distributed (when
+  configured), wrap the model for the mesh, auto-resume from the newest
+  committed checkpoint, train with periodic async sharded checkpoints,
+  and on preemption checkpoint + return resumable=True. Re-running the
+  same program continues the loss curve exactly (the guarantee tested in
+  tests/test_sharded_checkpoint.py, now reachable without manual
+  restore calls).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+from typing import Any, Callable, Dict, Optional, Sequence
+
+from deeplearning4j_tpu.parallel.checkpoint import ShardedCheckpointer
+from deeplearning4j_tpu.parallel.data_parallel import ParallelWrapper
+
+
+class PreemptionHandler:
+    """Flag-setting signal handler (reference precedent: none — the
+    reference has no preemption story; ParallelWrapper.java:94-99 only
+    installs an UncaughtExceptionHandler)."""
+
+    def __init__(self, signals: Sequence[int] = (signal.SIGTERM,)):
+        self._preempted = False
+        self._previous: Dict[int, Any] = {}
+        self.signals = tuple(signals)
+
+    def install(self) -> "PreemptionHandler":
+        for s in self.signals:
+            self._previous[s] = signal.signal(s, self._on_signal)
+        return self
+
+    def uninstall(self) -> None:
+        for s, prev in self._previous.items():
+            signal.signal(s, prev)
+        self._previous.clear()
+
+    def _on_signal(self, signum, frame):
+        self._preempted = True
+
+    @property
+    def preempted(self) -> bool:
+        return self._preempted
+
+    def reset(self) -> None:
+        self._preempted = False
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *a):
+        self.uninstall()
+
+
+class ElasticTrainer:
+    """Preemption-safe outer training driver over ParallelWrapper +
+    ShardedCheckpointer.
+
+    fit() returns a dict: {"completed": bool, "preempted": bool,
+    "iteration": int} — a preempted run checkpoints and returns; running
+    the same fit() again (same directory) resumes mid-epoch and finishes
+    the remaining epochs with a bit-identical loss curve."""
+
+    def __init__(self, net, checkpoint_dir: str, *, mesh=None,
+                 param_rules=None, checkpoint_every: int = 10,
+                 max_to_keep: int = 3,
+                 preemption_signals: Sequence[int] = (signal.SIGTERM,),
+                 stop_fn: Optional[Callable[[], bool]] = None):
+        self.wrapper = ParallelWrapper(net, mesh=mesh,
+                                       param_rules=param_rules)
+        self.checkpointer = ShardedCheckpointer(
+            checkpoint_dir, max_to_keep=max_to_keep)
+        self.checkpoint_every = checkpoint_every
+        self.handler = PreemptionHandler(preemption_signals)
+        self._extra_stop = stop_fn
+
+    def _should_stop(self) -> bool:
+        if self.handler.preempted:
+            return True
+        return bool(self._extra_stop and self._extra_stop())
+
+    def fit(self, data, labels=None, *, epochs: int = 1,
+            batch_size: int = 128) -> Dict[str, Any]:
+        net = self.wrapper.net
+        resume = None
+        if self.checkpointer.latest_step() is not None:
+            resume = self.checkpointer.restore_into_wrapper(self.wrapper)
+        with self.handler:
+            self.wrapper.fit(
+                data, labels, epochs=epochs, batch_size=batch_size,
+                checkpointer=self.checkpointer,
+                checkpoint_every=self.checkpoint_every,
+                resume=resume, stop_fn=self._should_stop)
+            # the wrapper's record is authoritative — a transient stop_fn
+            # that flipped back must still report the truncated run
+            preempted = self.wrapper.stopped_early
+            if preempted:
+                # final snapshot at the exact stop point (the periodic
+                # cadence may not have covered the last steps)
+                self.checkpointer.save(
+                    net, step=net.iteration,
+                    position={"batch_in_epoch":
+                              self.wrapper.last_batch_index + 1})
+                self.checkpointer.wait()
+        return {"completed": not preempted, "preempted": preempted,
+                "iteration": net.iteration}

@@ -134,7 +134,7 @@ class TimeSourceProvider:
 
     @classmethod
     def set_instance(cls, ts: Optional[TimeSource]) -> None:
-        old = cls._instance
-        if old is not None and old is not ts and hasattr(old, "close"):
-            old.close()   # stop a replaced NTP instance's refresh thread
+        # no implicit close(): callers may re-register the old instance
+        # later (its refresh thread must stay alive); an unreferenced NTP
+        # source stops its thread via __del__ when collected
         cls._instance = ts

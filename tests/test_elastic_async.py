@@ -1,0 +1,161 @@
+"""Elastic/preemption training driver + async parameter-server mode.
+
+Reference: SURVEY §5 elastic-recovery gap (green-field) and §2.4 flavors
+4/5 (Aeron PS + hogwild) — the async push/pull semantics with bounded
+staleness, without the UDP daemon.
+"""
+
+import os
+import signal
+
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.optim.updaters import Adam, Sgd
+from deeplearning4j_tpu.parallel import (
+    AsyncParameterServer, AsyncTrainer, ElasticTrainer, PreemptionHandler,
+)
+from deeplearning4j_tpu.parallel.mesh import AXIS_DATA
+
+
+def _net(seed=7):
+    return MultiLayerNetwork(
+        NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2))
+        .list(DenseLayer(n_in=12, n_out=32, activation="relu"),
+              OutputLayer(n_in=32, n_out=4, activation="softmax",
+                          loss="mcxent"))
+        .build()).init()
+
+
+def _data(n=256, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, 12)).astype(np.float32)
+    yi = rng.integers(0, 4, n)
+    x[np.arange(n), yi % 12] += 2.0
+    return x, np.eye(4, dtype=np.float32)[yi]
+
+
+class _Rec:
+    def __init__(self): self.losses = []
+    def __getattr__(self, n):
+        if n == "iteration_done":
+            return lambda net, i, e, l: self.losses.append(l)
+        if n.startswith("on_"):
+            return lambda *a, **k: None
+        raise AttributeError(n)
+
+
+class TestPreemptionHandler:
+    def test_signal_sets_flag_and_restores_handler(self):
+        h = PreemptionHandler(signals=(signal.SIGUSR2,))
+        prev = signal.getsignal(signal.SIGUSR2)
+        with h:
+            assert not h.preempted
+            os.kill(os.getpid(), signal.SIGUSR2)
+            assert h.preempted
+        assert signal.getsignal(signal.SIGUSR2) is prev
+
+
+class TestElasticTrainer:
+    def test_preempt_resume_reproduces_curve(self, tmp_path, devices8):
+        mesh = Mesh(np.array(devices8), (AXIS_DATA,))
+        x, y = _data()
+
+        # uninterrupted reference run
+        ref = _net(); rr = _Rec(); ref.listeners.append(rr)
+        from deeplearning4j_tpu.parallel import ParallelWrapper
+        ParallelWrapper(ref, mesh=mesh).fit(x, y, epochs=2, batch_size=64)
+
+        # run 1: 'preempted' (stop_fn trips) after 3 iterations
+        n1 = _net(); r1 = _Rec(); n1.listeners.append(r1)
+        calls = {"n": 0}
+        def stop_after_3():
+            calls["n"] += 1
+            return len(r1.losses) >= 3
+        t1 = ElasticTrainer(n1, str(tmp_path / "ck"), mesh=mesh,
+                            checkpoint_every=1, stop_fn=stop_after_3)
+        out1 = t1.fit(x, y, epochs=2, batch_size=64)
+        assert out1["preempted"] and not out1["completed"]
+        assert len(r1.losses) == 3
+
+        # run 2: fresh process equivalent — auto-resumes and finishes
+        n2 = _net(seed=123); r2 = _Rec(); n2.listeners.append(r2)
+        t2 = ElasticTrainer(n2, str(tmp_path / "ck"), mesh=mesh,
+                            checkpoint_every=1)
+        out2 = t2.fit(x, y, epochs=2, batch_size=64)
+        assert out2["completed"] and not out2["preempted"]
+        np.testing.assert_allclose(r1.losses + r2.losses, rr.losses,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_preempt_at_epoch_boundary_resumes_exactly(self, tmp_path,
+                                                       devices8):
+        """Regression: a stop tripping at the FIRST batch of a new epoch
+        must checkpoint batch_in_epoch=0 (not the previous epoch's tail),
+        or resume silently skips an epoch."""
+        mesh = Mesh(np.array(devices8), (AXIS_DATA,))
+        x, y = _data()
+        ref = _net(); rr = _Rec(); ref.listeners.append(rr)
+        from deeplearning4j_tpu.parallel import ParallelWrapper
+        ParallelWrapper(ref, mesh=mesh).fit(x, y, epochs=3, batch_size=64)
+
+        n1 = _net(); r1 = _Rec(); n1.listeners.append(r1)
+        t1 = ElasticTrainer(n1, str(tmp_path / "ckb"), mesh=mesh,
+                            checkpoint_every=1,
+                            stop_fn=lambda: len(r1.losses) >= 4)  # epoch edge
+        out1 = t1.fit(x, y, epochs=3, batch_size=64)
+        assert out1["preempted"] and len(r1.losses) == 4
+
+        n2 = _net(seed=5); r2 = _Rec(); n2.listeners.append(r2)
+        out2 = ElasticTrainer(n2, str(tmp_path / "ckb"), mesh=mesh).fit(
+            x, y, epochs=3, batch_size=64)
+        assert out2["completed"]
+        assert len(r1.losses) + len(r2.losses) == len(rr.losses)
+        np.testing.assert_allclose(r1.losses + r2.losses, rr.losses,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_fresh_directory_trains_from_scratch(self, tmp_path, devices8):
+        mesh = Mesh(np.array(devices8), (AXIS_DATA,))
+        x, y = _data()
+        n = _net(); r = _Rec(); n.listeners.append(r)
+        out = ElasticTrainer(n, str(tmp_path / "new"), mesh=mesh).fit(
+            x, y, epochs=1, batch_size=64)
+        assert out["completed"] and len(r.losses) == 4
+
+
+class TestAsyncParameterServer:
+    def test_push_pull_and_staleness_accounting(self):
+        import jax.numpy as jnp
+        params = {"w": jnp.ones((4,))}
+        ps = AsyncParameterServer(params, Sgd(0.5), staleness_limit=1)
+        v0, p0 = ps.pull()
+        g = {"w": jnp.ones((4,))}
+        assert ps.push(g, v0)          # staleness 0
+        assert ps.push(g, v0)          # staleness 1 (allowed)
+        assert not ps.push(g, v0)      # staleness 2 -> dropped
+        assert ps.rejected == 1 and ps.pushes == 2
+        _, p = ps.pull()
+        np.testing.assert_allclose(np.asarray(p["w"]), np.zeros(4))
+
+    def test_hogwild_trainer_converges(self):
+        x, y = _data(n=512)
+        net = _net()
+        s0 = net.score(x, y)
+        tr = AsyncTrainer(net, num_workers=4).fit(
+            x, y, iterations_per_worker=25, batch_size=64)
+        assert tr.server.pushes == 100       # every push applied
+        s1 = net.score(x, y)
+        assert s1 < s0 * 0.7
+        acc = float(np.mean(net.predict(x) == y.argmax(-1)))
+        assert acc >= 0.8
+
+    def test_staleness_limit_drops_but_still_trains(self):
+        x, y = _data(n=256)
+        net = _net()
+        tr = AsyncTrainer(net, num_workers=4, staleness_limit=0).fit(
+            x, y, iterations_per_worker=10, batch_size=32)
+        assert tr.server.pushes + tr.server.rejected == 40
+        assert net.score(x, y) < 1.4  # dropped stale pushes, still learns
