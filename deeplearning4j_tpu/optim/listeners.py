@@ -67,7 +67,9 @@ class PerformanceListener(TrainingListener):
     """Throughput tracking: samples/sec, batches/sec, ETL time.
     Reference: `optimize/listeners/PerformanceListener.java:24-25,60`."""
 
-    def __init__(self, frequency: int = 10, report: Optional[Callable] = None):
+    def __init__(self, frequency: int = 10, report: Optional[Callable] = None,
+                 *, flops_per_step: Optional[float] = None,
+                 peak_flops: Optional[float] = None):
         self.frequency = max(1, frequency)
         self._report = report or (lambda msg: logger.info(msg))
         self._last_time = None
@@ -75,6 +77,13 @@ class PerformanceListener(TrainingListener):
         self.last_samples_per_sec = 0.0
         self.last_batches_per_sec = 0.0
         self.last_etl_ms = 0.0
+        # MFU reporting (TPU-native extension of the reference's counters):
+        # flops_per_step from utils/profiling.step_flops(model, x, y);
+        # peak_flops defaults to the chip's spec-sheet bf16 peak.
+        self.flops_per_step = flops_per_step
+        self.peak_flops = peak_flops
+        self.last_mfu: Optional[float] = None
+        self.last_step_ms: Optional[float] = None
 
     def set_etl_time(self, ms: float) -> None:
         """Reference: setLastEtlTime threading (`MultiLayerNetwork.java:1092`)."""
@@ -92,10 +101,27 @@ class PerformanceListener(TrainingListener):
             bs = getattr(model, "last_batch_size", None) or 0
             self.last_batches_per_sec = n_batches / dt
             self.last_samples_per_sec = n_batches * bs / dt
-            self._report(
-                f"iteration {iteration}: {self.last_samples_per_sec:.1f} samples/sec, "
-                f"{self.last_batches_per_sec:.2f} batches/sec, ETL {self.last_etl_ms:.1f} ms"
-            )
+            self.last_step_ms = dt / n_batches * 1e3
+            msg = (f"iteration {iteration}: "
+                   f"{self.last_samples_per_sec:.1f} samples/sec, "
+                   f"{self.last_batches_per_sec:.2f} batches/sec, "
+                   f"{self.last_step_ms:.1f} ms/step, "
+                   f"ETL {self.last_etl_ms:.1f} ms")
+            if self.flops_per_step:
+                peak = self.peak_flops
+                if peak is None:
+                    # step_flops is the GLOBAL step's HLO count, so the
+                    # default peak must cover every participating chip
+                    import jax
+                    from deeplearning4j_tpu.utils.profiling import peak_flops
+                    per_chip = peak_flops()
+                    if per_chip:
+                        peak = self.peak_flops = per_chip * jax.device_count()
+                if peak:
+                    self.last_mfu = (self.flops_per_step
+                                     * self.last_batches_per_sec / peak)
+                    msg += f", MFU {self.last_mfu:.1%}"
+            self._report(msg)
             self._last_time = now
             self._last_iter = iteration
 

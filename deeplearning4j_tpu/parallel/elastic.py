@@ -22,7 +22,6 @@ Pieces:
 
 from __future__ import annotations
 
-import os
 import signal
 from typing import Any, Callable, Dict, Optional, Sequence
 

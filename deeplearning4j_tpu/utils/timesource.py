@@ -85,10 +85,17 @@ class NTPTimeSource(TimeSource):
         # current_time_millis() must never block on the network.
         self._update_once()
         import threading
+        import weakref
 
         self._stop = threading.Event()
+        # The worker holds only a WEAK reference: a bound-method target
+        # would pin the instance forever (never GC'd, __del__ never runs,
+        # thread leaks). With the weakref the thread exits when the source
+        # is dropped OR close()d.
         self._thread = threading.Thread(
-            target=self._refresh_loop, daemon=True, name="ntp-refresh")
+            target=_ntp_refresh_worker,
+            args=(weakref.ref(self), self._stop),
+            daemon=True, name="ntp-refresh")
         self._thread.start()
 
     def _update_once(self):
@@ -101,12 +108,6 @@ class NTPTimeSource(TimeSource):
             # serving system time rather than failing training)
             self.synchronized_ = False
 
-    def _refresh_loop(self):
-        # clamp to >= 1s so update_freq_ms=0 can't busy-loop SNTP queries
-        interval = max(self.update_freq_ms, 1000) / 1000.0
-        while not self._stop.wait(interval):
-            self._update_once()
-
     def close(self):
         self._stop.set()
 
@@ -116,6 +117,24 @@ class NTPTimeSource(TimeSource):
     def current_time_millis(self) -> int:
         """Cached-offset read — never touches the network."""
         return int(time.time() * 1000 + self.offset_ms)
+
+
+def _ntp_refresh_worker(ref, stop):
+    """Module-level refresh loop over a weakref (see NTPTimeSource.__init__).
+    Interval clamped to >= 1s so update_freq_ms=0 can't busy-loop SNTP."""
+    while True:
+        src = ref()
+        if src is None:
+            return
+        interval = max(src.update_freq_ms, 1000) / 1000.0
+        del src
+        if stop.wait(interval):
+            return
+        src = ref()
+        if src is None:
+            return
+        src._update_once()
+        del src
 
 
 class TimeSourceProvider:

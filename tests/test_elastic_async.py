@@ -9,7 +9,6 @@ import os
 import signal
 
 import numpy as np
-import pytest
 from jax.sharding import Mesh
 
 from deeplearning4j_tpu.models import MultiLayerNetwork

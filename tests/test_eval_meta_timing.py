@@ -86,6 +86,20 @@ class TestTimeSource:
         assert not ts.synchronized_
         assert ts.current_time_millis() > 0
 
+    def test_ntp_refresh_thread_exits_when_source_dropped(self):
+        """The refresh thread must hold only a weakref — a bound-method
+        target would pin the instance and leak the thread forever."""
+        import gc
+        from deeplearning4j_tpu.utils.timesource import NTPTimeSource
+
+        ts = NTPTimeSource(server="127.0.0.1", timeout=0.1,
+                           update_freq_ms=0)  # clamped to 1s internally
+        th = ts._thread
+        del ts
+        gc.collect()
+        th.join(timeout=5)
+        assert not th.is_alive()
+
     def test_provider_singleton_and_override(self):
         from deeplearning4j_tpu.utils.timesource import (
             SystemClockTimeSource, TimeSourceProvider,

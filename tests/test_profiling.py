@@ -1,0 +1,91 @@
+"""Profiling seam tests: step FLOP analysis, MFU in PerformanceListener,
+profiler trace capture (SURVEY §5 tracing gap).
+"""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.optim.listeners import PerformanceListener
+from deeplearning4j_tpu.utils.profiling import (
+    ProfilerListener, peak_flops, step_flops, trace,
+)
+
+
+def _net():
+    return MultiLayerNetwork(
+        NeuralNetConfiguration.builder().seed(0)
+        .list(DenseLayer(n_in=64, n_out=128, activation="relu"),
+              OutputLayer(n_in=128, n_out=8, activation="softmax",
+                          loss="mcxent"))
+        .build()).init()
+
+
+def _data(n=128):
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((n, 64)).astype(np.float32)
+    y = np.eye(8, dtype=np.float32)[rng.integers(0, 8, n)]
+    return x, y
+
+
+class TestStepFlops:
+    def test_flops_scale_with_batch(self):
+        net = _net()
+        x, y = _data(32)
+        f32 = step_flops(net, x, y)
+        x2, y2 = _data(64)
+        f64 = step_flops(net, x2, y2)
+        assert f32 and f64
+        # fwd+bwd matmul flops dominate and scale ~linearly with batch
+        assert 1.5 < f64 / f32 < 2.5
+        # ballpark: >= fwd+bwd dense flops 3*2*B*(64*128+128*8)
+        assert f32 >= 3 * 2 * 32 * (64 * 128 + 128 * 8) * 0.5
+
+    def test_peak_flops_table(self):
+        assert peak_flops("TPU v5 lite") == 197e12
+        assert peak_flops("TPU v4") == 275e12
+        assert peak_flops("weird accelerator") is None
+
+
+class TestPerformanceListenerMfu:
+    def test_mfu_reported(self):
+        net = _net()
+        x, y = _data(128)
+        msgs = []
+        fl = step_flops(net, x[:32], y[:32])
+        pl = PerformanceListener(frequency=2, report=msgs.append,
+                                 flops_per_step=fl, peak_flops=100e12)
+        net.listeners.append(pl)
+        net.fit(x, y, epochs=2, batch_size=32)
+        assert pl.last_mfu is not None and pl.last_mfu > 0
+        assert pl.last_step_ms is not None
+        assert any("MFU" in m and "ms/step" in m for m in msgs)
+
+
+class TestProfilerTrace:
+    def test_trace_context_writes_files(self, tmp_path):
+        import jax
+        import jax.numpy as jnp
+
+        d = str(tmp_path / "trace")
+        with trace(d):
+            jnp.sum(jnp.ones((128, 128)) @ jnp.ones((128, 128))
+                    ).block_until_ready()
+        files = glob.glob(os.path.join(d, "**", "*"), recursive=True)
+        assert any(os.path.isfile(f) for f in files), "no trace artifacts"
+
+    def test_profiler_listener_captures_window(self, tmp_path):
+        net = _net()
+        x, y = _data(128)
+        d = str(tmp_path / "ptrace")
+        pl = ProfilerListener(d, start_iteration=2, num_iterations=2)
+        net.listeners.append(pl)
+        net.fit(x, y, epochs=2, batch_size=32)
+        assert pl.captured and not pl._active
+        files = glob.glob(os.path.join(d, "**", "*"), recursive=True)
+        assert any(os.path.isfile(f) for f in files)
