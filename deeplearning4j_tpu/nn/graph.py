@@ -110,6 +110,22 @@ class ElementWiseVertex(GraphVertex):
 
 @register_serde
 @dataclasses.dataclass(frozen=True)
+class PoolHelperVertex(GraphVertex):
+    """Strip the first spatial row+column of a pooled CNN activation —
+    the Caffe-import alignment shim. Reference:
+    `nn/graph/vertex/impl/PoolHelperVertex.java:67-78` (interval(1, size)
+    on the spatial dims; NCHW there, NHWC here)."""
+
+    def output_type(self, *input_types: InputType) -> InputType:
+        t = input_types[0]
+        return InputType.convolutional(t.height - 1, t.width - 1, t.channels)
+
+    def apply(self, params, inputs, **kw):
+        return inputs[0][:, 1:, 1:, :], kw.get("state")
+
+
+@register_serde
+@dataclasses.dataclass(frozen=True)
 class MergeVertex(GraphVertex):
     """Concatenate along the feature (trailing) axis. Reference:
     `nn/conf/graph/MergeVertex.java` (channel axis for CNN — trailing in

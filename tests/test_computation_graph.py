@@ -312,3 +312,33 @@ class TestGraphRnn:
         after = float(ae.reconstruction_score(
             net.params_tree["ae"], jnp.asarray(x)))
         assert after < before * 0.8, (before, after)
+
+
+def test_pool_helper_vertex():
+    """Reference: PoolHelperVertex.java:67-78 — strips the first spatial
+    row+column (Caffe pooling alignment)."""
+    import numpy as np
+    from deeplearning4j_tpu.models import ComputationGraph
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.graph import PoolHelperVertex
+    from deeplearning4j_tpu.nn.inputs import InputType
+    from deeplearning4j_tpu.nn.layers import GlobalPoolingLayer, OutputLayer
+
+    g = NeuralNetConfiguration.builder().seed(0).graph_builder()
+    g.add_inputs("in")
+    g.set_input_types(InputType.convolutional(5, 5, 3))
+    g.add_vertex("strip", PoolHelperVertex(), "in")
+    g.add_layer("gap", GlobalPoolingLayer(pooling="avg"), "strip")
+    g.add_layer("out", OutputLayer(n_in=3, n_out=2, activation="softmax",
+                                   loss="mcxent"), "gap")
+    g.set_outputs("out")
+    net = ComputationGraph(g.build()).init()
+    x = np.arange(2 * 5 * 5 * 3, dtype=np.float32).reshape(2, 5, 5, 3)
+    import jax.numpy as jnp
+    values, _, _ = net._forward(net.params_tree, net.state_tree,
+                                {"in": jnp.asarray(x)}, train=False,
+                                rng=None)
+    stripped = np.asarray(values["strip"])
+    assert stripped.shape == (2, 4, 4, 3)
+    np.testing.assert_array_equal(stripped, x[:, 1:, 1:, :])
+    assert np.asarray(net.output(x)).shape == (2, 2)
