@@ -118,6 +118,9 @@ class PoolHelperVertex(GraphVertex):
 
     def output_type(self, *input_types: InputType) -> InputType:
         t = input_types[0]
+        if t.kind != "cnn":
+            raise ValueError(
+                f"PoolHelperVertex needs a 4-D CNN (NHWC) input, got {t}")
         return InputType.convolutional(t.height - 1, t.width - 1, t.channels)
 
     def apply(self, params, inputs, **kw):
