@@ -1,0 +1,17 @@
+"""Shared example bootstrap: repo-root import path + CPU re-pin.
+
+Each example does `import _bootstrap  # noqa: F401` as its first import.
+The image's sitecustomize pins jax_platforms to "axon,cpu" at interpreter
+start; an explicit JAX_PLATFORMS=cpu request is honored with the same
+re-pin as tests/conftest.py and __graft_entry__.py.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+if "cpu" in os.environ.get("JAX_PLATFORMS", "").lower():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,48 @@
+"""Live training dashboard: attach a StatsListener + UIServer and watch
+param/update norms, histograms, activation stats and a t-SNE view at
+http://127.0.0.1:<port>/train/overview.html (reference: PlayUIServer +
+TrainModule)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _bootstrap  # noqa: F401,E402 — repo-root path + CPU re-pin
+
+import numpy as np
+
+from deeplearning4j_tpu.clustering.tsne import BarnesHutTsne
+from deeplearning4j_tpu.data.datasets import load_iris
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.optim.updaters import Adam
+from deeplearning4j_tpu.ui import InMemoryStatsStorage, StatsListener, UIServer
+
+
+def main(epochs: int = 30, serve_forever: bool = False):
+    server = UIServer.get_instance()
+    storage = InMemoryStatsStorage()
+    server.attach(storage)
+    print(f"dashboard: http://127.0.0.1:{server.port}/train/overview.html")
+    x, y = load_iris()
+    net = MultiLayerNetwork(
+        NeuralNetConfiguration.builder().seed(1).updater(Adam(0.05))
+        .list(DenseLayer(n_in=4, n_out=16, activation="tanh"),
+              OutputLayer(n_in=16, n_out=3, activation="softmax",
+                          loss="mcxent"))
+        .build()).init()
+    net.listeners.append(StatsListener(
+        storage, 1, collect_histograms=True, collect_activations=True))
+    net.fit(x, y, epochs=epochs, batch_size=50)
+    emb = BarnesHutTsne(n_components=2, n_iter=150, seed=3).fit_transform(
+        np.asarray(net.feed_forward(x)[0]))
+    server.upload_tsne(emb, [str(int(c)) for c in np.argmax(y, -1)])
+    print("t-SNE view:", f"http://127.0.0.1:{server.port}/tsne.html")
+    if serve_forever:
+        import threading
+        threading.Event().wait()
+
+
+if __name__ == "__main__":
+    main(serve_forever=True)

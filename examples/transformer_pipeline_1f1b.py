@@ -1,0 +1,46 @@
+"""Transformer LM trained with 1F1B pipeline parallelism over the `pipe`
+mesh axis — PipelinedNetwork partitions the configured model into
+prologue (embeddings) / uniform block trunk / output epilogue
+automatically (no reference counterpart: SURVEY §7 step 7 extension).
+
+Run:
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+  python examples/transformer_pipeline_1f1b.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _bootstrap  # noqa: F401,E402 — repo-root path + CPU re-pin
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from deeplearning4j_tpu.parallel import PipelinedNetwork
+from deeplearning4j_tpu.parallel.mesh import AXIS_PIPE
+from deeplearning4j_tpu.zoo.transformer import TextGenerationTransformer
+
+
+def main(stages: int = 4, steps: int = 8):
+    devs = jax.devices()[:stages]
+    mesh = Mesh(np.array(devs), (AXIS_PIPE,))
+    net = TextGenerationTransformer(
+        num_classes=64, input_shape=(16, 1), d_model=32, num_heads=4,
+        num_blocks=stages).init()
+    pp = PipelinedNetwork(net, mesh, n_micro=4)
+    rng = np.random.default_rng(0)
+    ids = rng.integers(1, 64, (32, 16, 1)).astype(np.float32)
+    labels = np.eye(64, dtype=np.float32)[
+        np.roll(ids[..., 0], -1, axis=1).astype(int)]
+    for i in range(steps):
+        loss = pp.fit_batch(ids, labels, it=i)
+        print(f"step {i}: loss {loss:.4f}")
+    pp.sync_to_net()
+    print("params synced back; net.output works:",
+          np.asarray(net.output(ids[:2])).shape)
+
+
+if __name__ == "__main__":
+    main()

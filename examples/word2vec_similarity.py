@@ -1,0 +1,42 @@
+"""Word2Vec over a sentence iterator with a preprocessor stack + Google-
+binary export (reference: Word2Vec.Builder + WordVectorSerializer)."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _bootstrap  # noqa: F401,E402 — repo-root path + CPU re-pin
+
+import tempfile
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp import (
+    CollectionSentenceIterator, CompositePreProcessor, LowCasePreProcessor,
+    StripSpecialCharsPreProcessor, Word2Vec, read_binary, write_binary,
+)
+
+
+def main():
+    rng = np.random.default_rng(0)
+    fruit = ["Apple", "Pear", "Fruit", "Juice"]
+    cars = ["Car", "Truck", "Wheel", "Motor"]
+    sents = [" ".join(rng.choice(fruit if rng.random() < .5 else cars, 6))
+             for _ in range(400)]
+    it = CollectionSentenceIterator(sents).set_pre_processor(
+        CompositePreProcessor(LowCasePreProcessor(),
+                              StripSpecialCharsPreProcessor()))
+    w2v = Word2Vec(layer_size=32, min_count=1, window=3, epochs=5, seed=1)
+    w2v.fit(it)
+    print("apple ~ pear:", round(w2v.similarity("apple", "pear"), 3))
+    print("apple ~ car: ", round(w2v.similarity("apple", "car"), 3))
+    print("nearest(apple):", w2v.words_nearest("apple", 3))
+    with tempfile.TemporaryDirectory() as d:
+        p = os.path.join(d, "vectors.bin")
+        write_binary(w2v, p)   # original word2vec/gensim-compatible layout
+        vocab, mat = read_binary(p)
+        print(f"binary round-trip: {len(vocab)} words x {mat.shape[1]} dims")
+
+
+if __name__ == "__main__":
+    main()
