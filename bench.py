@@ -323,10 +323,18 @@ def main():
         _child_main()
         return
 
-    timeout = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "1200"))
+    timeout = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "600"))
     backoffs = [15.0, 45.0, 90.0]
     errors = []
-    for i, (overrides, label) in enumerate(_attempt_plans()):
+    hangs = 0
+    plans = _attempt_plans()
+    for i, (overrides, label) in enumerate(plans):
+        if hangs >= 2 and not overrides.get("BENCH_FORCE_CPU") and \
+                i < len(plans) - 1:
+            # two full-timeout hangs mean the tunnel is dead, not flaky —
+            # don't burn the remaining TPU rungs, go straight to CPU
+            errors.append(f"{label}: skipped (tunnel hung twice)")
+            continue
         env = dict(os.environ, BENCH_CHILD="1", **overrides)
         try:
             proc = subprocess.run(
@@ -334,6 +342,7 @@ def main():
                 env=env, capture_output=True, text=True, timeout=timeout)
         except subprocess.TimeoutExpired:
             errors.append(f"{label}: timeout after {timeout}s")
+            hangs += 1
             continue
         if proc.returncode == 0:
             for line in reversed(proc.stdout.strip().splitlines()):

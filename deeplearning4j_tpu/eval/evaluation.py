@@ -77,6 +77,12 @@ class Evaluation:
             if mask is not None:
                 m = np.asarray(mask).reshape(B * T) > 0
                 labels, predictions = labels[m], predictions[m]
+        elif mask is not None:
+            # per-example mask for 2-D/1-D labels (padded batches)
+            m = np.asarray(mask).reshape(len(labels)) > 0
+            labels, predictions = labels[m], predictions[m]
+            if record_meta is not None:
+                record_meta = [r for r, keep in zip(record_meta, m) if keep]
         if labels.ndim == 2:
             actual = labels.argmax(axis=-1)
             n = labels.shape[-1]
@@ -116,6 +122,27 @@ class Evaluation:
             self.predictions.extend(
                 Prediction(int(a), int(p), m)
                 for a, p, m in zip(actual, predicted, record_meta))
+
+    def evaluate_iterator(self, iterator, *, output_fn, predict_indices_fn):
+        """Shared batch loop for model.evaluate (MultiLayerNetwork and
+        ComputationGraph): device-side argmax fast path for plain
+        per-example labels (only int32 indices cross to host via
+        `predict_indices_fn(features) -> (indices, head_width)`), full
+        softmax through `output_fn` for masked/time-series labels."""
+        for ds in iterator:
+            labels = np.asarray(ds.labels)
+            if labels.ndim == 3 or ds.labels_mask is not None:
+                self.eval(labels, np.asarray(output_fn(ds.features)),
+                          mask=ds.labels_mask)
+                continue
+            pred, width = predict_indices_fn(ds.features)
+            actual = (labels.argmax(-1) if labels.ndim == 2
+                      else labels.astype(np.int64))
+            # class count from the one-hot width, else the model head —
+            # a batch missing high classes must not shrink the matrix
+            n = labels.shape[-1] if labels.ndim == 2 else width
+            self.eval_indices(actual, np.asarray(pred), num_classes=n)
+        return self
 
     # ---- per-example accessors (reference: eval/meta + Evaluation
     #      getPredictionErrors/getPredictionsByActualClass/...) ----

@@ -485,23 +485,13 @@ class ComputationGraph:
         `ComputationGraph.evaluate(DataSetIterator)`."""
         from deeplearning4j_tpu.eval.evaluation import Evaluation
 
-        e = Evaluation()
-        for ds in iterator:
-            labels = np.asarray(ds.labels)
-            if labels.ndim == 3 or ds.labels_mask is not None:
-                out = self.output(ds.features)
-                e.eval(labels, np.asarray(out), mask=ds.labels_mask)
-                continue
-            out = self.output(ds.features)
-            pred_idx = jnp.argmax(out, axis=-1)   # stays on device
-            actual = (labels.argmax(-1) if labels.ndim == 2
-                      else labels.astype(np.int64))
-            # class count from the one-hot width, else the head width (a
-            # batch missing high classes must not shrink the matrix)
-            n = (labels.shape[-1] if labels.ndim == 2
-                 else int(out.shape[-1]))
-            e.eval_indices(actual, np.asarray(pred_idx), num_classes=n)
-        return e
+        def predict_indices(feats):
+            out = self.output(feats)
+            return jnp.argmax(out, axis=-1), int(out.shape[-1])
+
+        return Evaluation().evaluate_iterator(
+            iterator, output_fn=self.output,
+            predict_indices_fn=predict_indices)
 
     # ----------------------------------------------------- param views
     def params(self) -> np.ndarray:

@@ -470,7 +470,6 @@ class MultiLayerNetwork:
         from deeplearning4j_tpu.eval.evaluation import Evaluation
 
         self._check_init()
-        e = Evaluation()
         key = ("eval_argmax",)
         if key not in self._jit_cache:
             def pred_fn(params, states, feats):
@@ -478,25 +477,17 @@ class MultiLayerNetwork:
                                            train=False, rng=None)
                 return jnp.argmax(y, axis=-1).astype(jnp.int32)
             self._jit_cache[key] = jax.jit(pred_fn)
-        for ds in iterator:
-            labels = np.asarray(ds.labels)
-            if labels.ndim == 3 or ds.labels_mask is not None:
-                out = np.asarray(self.output(ds.features))
-                e.eval(labels, out, mask=ds.labels_mask)
-                continue
-            self._check_input(np.asarray(ds.features))
-            pred = np.asarray(self._jit_cache[key](
+
+        def predict_indices(feats):
+            self._check_input(np.asarray(feats))
+            idx = self._jit_cache[key](
                 self.params_tree, self.state_tree,
-                jnp.asarray(ds.features, self.dtype)))
-            actual = (labels.argmax(-1) if labels.ndim == 2
-                      else labels.astype(np.int64))
-            # class count from one-hot width, else the model's own head
-            # width (a first batch missing high classes must not shrink
-            # the confusion matrix)
-            n = (labels.shape[-1] if labels.ndim == 2
-                 else getattr(self.layers[-1], "n_out", None))
-            e.eval_indices(actual, pred, num_classes=n)
-        return e
+                jnp.asarray(feats, self.dtype))
+            return idx, getattr(self.layers[-1], "n_out", None)
+
+        return Evaluation().evaluate_iterator(
+            iterator, output_fn=self.output,
+            predict_indices_fn=predict_indices)
 
     # ----------------------------------------------------- rnn stepping
     def rnn_time_step(self, x):

@@ -225,3 +225,13 @@ class TestEvalRobustness:
         y = np.eye(2, dtype=np.float32)[[0, 1, 0, 1]]
         with pytest.raises(RuntimeError, match="not initialized"):
             net.evaluate(ArrayDataSetIterator(x, y, 2))
+
+    def test_per_example_mask_on_2d_labels(self):
+        """Padded batches: a per-example labels_mask must exclude padding
+        rows from the confusion matrix."""
+        ev = Evaluation()
+        labels = np.eye(2, dtype=np.float32)[[0, 1, 0, 0]]
+        preds = np.eye(2, dtype=np.float32)[[0, 1, 1, 1]]  # rows 2-3 'wrong'
+        ev.eval(labels, preds, mask=np.array([1, 1, 0, 0]))
+        assert ev.accuracy() == 1.0            # masked rows not counted
+        assert int(ev.confusion.matrix.sum()) == 2

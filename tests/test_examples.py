@@ -1,51 +1,58 @@
 """Every example script must actually run end-to-end (small settings) —
 the 'switching user' smoke tests."""
 
+import os
 import runpy
 import sys
 
 import pytest
+
+_EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+
+def _run(name):
+    return runpy.run_path(os.path.join(_EXAMPLES, name))
 
 
 @pytest.fixture(autouse=True)
 def _examples_path(monkeypatch):
     # runpy.run_path does NOT add the script's directory to sys.path, so
     # the examples' `import _bootstrap` needs it prepended here
-    monkeypatch.syspath_prepend("examples")
+    monkeypatch.syspath_prepend(_EXAMPLES)
 
 
 def test_lenet_mnist():
-    mod = runpy.run_path("examples/lenet_mnist.py")
+    mod = _run("lenet_mnist.py")
     acc = mod["main"](epochs=1, batch_size=128, examples=1024)
     assert 0.0 <= acc <= 1.0
 
 
 def test_word2vec_similarity(capsys):
-    mod = runpy.run_path("examples/word2vec_similarity.py")
+    mod = _run("word2vec_similarity.py")
     mod["main"]()
     out = capsys.readouterr().out
     assert "apple ~ pear" in out and "binary round-trip" in out
 
 
 def test_elastic_training(tmp_path):
-    mod = runpy.run_path("examples/elastic_training.py")
+    mod = _run("elastic_training.py")
     mod["main"](ckpt_dir=str(tmp_path / "ck"))
 
 
 def test_transformer_pipeline(devices8, capsys):
-    mod = runpy.run_path("examples/transformer_pipeline_1f1b.py")
+    mod = _run("transformer_pipeline_1f1b.py")
     mod["main"](stages=4, steps=2)
     assert "params synced back" in capsys.readouterr().out
 
 
 def test_resnet_data_parallel(devices8, capsys):
-    mod = runpy.run_path("examples/resnet50_data_parallel.py")
+    mod = _run("resnet50_data_parallel.py")
     mod["main"](steps=1, image=32, classes=8)
     assert "data-parallel over" in capsys.readouterr().out
 
 
 def test_training_dashboard(capsys):
-    mod = runpy.run_path("examples/training_dashboard.py")
+    mod = _run("training_dashboard.py")
     mod["main"](epochs=5, serve_forever=False)
     out = capsys.readouterr().out
     assert "dashboard:" in out and "t-SNE view:" in out
