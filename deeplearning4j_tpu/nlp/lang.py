@@ -7,12 +7,14 @@ morphological analyzers; shipping ~55 files of dictionary machinery is not
 what the TPU port needs, so these factories implement the standard
 lightweight equivalents:
 
-- Japanese: character-class run segmentation (kanji / hiragana / katakana /
-  latin / digit runs split at class boundaries) — the classic dictionary-
-  free baseline; a user dictionary can refine it via longest-match.
-- Chinese: greedy forward maximum-match over an optional user dictionary,
-  falling back to unigram characters (the reference ansj default degrades
-  the same way on OOV).
+- Japanese + Chinese: min-cost LATTICE segmentation (`LatticeSegmenter`,
+  the kuromoji/ansj algorithm core — Viterbi over dictionary + unknown
+  nodes, beating greedy longest-match on ambiguous spans like 研究生命),
+  seeded with small embedded high-frequency lexicons (JA_COMMON /
+  ZH_COMMON) and extended by user dictionaries (words or word→cost).
+  Japanese groups OOV same-script runs (katakana loanwords stay one
+  token); Chinese degrades to unigram characters on OOV spans, like the
+  reference's ansj fallback (`base_lexicon=()` for pure unigrams).
 - Korean: whitespace-delimited eojeol, optionally stripped of trailing
   particles (josa) from a small closed set.
 
@@ -24,7 +26,7 @@ Word2Vec/ParagraphVectors/BagOfWords accept them unchanged.
 from __future__ import annotations
 
 import unicodedata
-from typing import Iterable, List, Optional, Sequence, Set
+from typing import Iterable, List, Optional
 
 from deeplearning4j_tpu.nlp.tokenization import Tokenizer, TokenizerFactory
 
@@ -68,62 +70,175 @@ def _runs(text: str) -> List[str]:
     return out
 
 
-def _max_match(text: str, dictionary: Set[str], max_len: int) -> List[str]:
-    """Greedy forward longest-match; unmatched spans fall back per-char."""
-    out: List[str] = []
-    i = 0
-    while i < len(text):
-        match = None
-        for ln in range(min(max_len, len(text) - i), 1, -1):
-            if text[i:i + ln] in dictionary:
-                match = text[i:i + ln]
-                break
-        if match:
-            out.append(match)
-            i += len(match)
+class LatticeSegmenter:
+    """Min-cost lattice segmentation — the algorithmic core of the
+    reference's kuromoji/ansj analyzers: build a lattice of dictionary
+    entries + unknown-word nodes over the text and take the Viterbi
+    (min total cost) path, instead of greedy longest-match (which
+    mis-segments e.g. 研究生命 as 研究生|命 when 研究|生命 is cheaper).
+
+    `lexicon`: word → cost (lower = preferred). Plain iterables get a
+    default cost of `word_cost_base - word_cost_len * len(word)` so longer
+    dictionary words win unless explicit costs say otherwise. Unknown
+    characters cost `unk_cost` each, with a discount when they extend a
+    same-character-class run (kuromoji's unknown-word grouping)."""
+
+    def __init__(self, lexicon, *, unk_cost: float = 10.0,
+                 unk_run_cost: float = 6.0,
+                 word_cost_base: float = 8.0, word_cost_len: float = 3.0):
+        if isinstance(lexicon, dict):
+            self.costs = {w: float(c) for w, c in lexicon.items()}
         else:
-            out.append(text[i])
-            i += 1
+            self.costs = {
+                w: max(word_cost_base - word_cost_len * len(w), 1.0)
+                for w in (lexicon or ())}
+        self.max_len = max((len(w) for w in self.costs), default=1)
+        self.unk_cost = unk_cost
+        self.unk_run_cost = unk_run_cost
+
+    def segment(self, text: str) -> List[str]:
+        n = len(text)
+        INF = float("inf")
+        best = [INF] * (n + 1)
+        back: List[Optional[int]] = [None] * (n + 1)
+        best[0] = 0.0
+        for i in range(n):
+            if best[i] == INF:
+                continue
+            # dictionary edges
+            for ln in range(1, min(self.max_len, n - i) + 1):
+                w = text[i:i + ln]
+                c = self.costs.get(w)
+                if c is not None and best[i] + c < best[i + ln]:
+                    best[i + ln] = best[i] + c
+                    back[i + ln] = i
+            # unknown single char; cheaper when continuing a same-class run
+            # (so an OOV katakana loanword or digit string stays one token)
+            cont = (i > 0 and back[i] == i - 1
+                    and _char_class(text[i]) == _char_class(text[i - 1]))
+            c = self.unk_run_cost if cont else self.unk_cost
+            if best[i] + c < best[i + 1]:
+                best[i + 1] = best[i] + c
+                back[i + 1] = i
+        # reconstruct, merging adjacent same-class unknown chars into runs
+        cuts = []
+        j = n
+        while j > 0:
+            cuts.append(j)
+            j = back[j]
+        cuts.append(0)
+        cuts.reverse()
+        pieces = [text[a:b] for a, b in zip(cuts, cuts[1:])]
+        if self.unk_run_cost >= self.unk_cost:
+            return pieces   # run-grouping disabled: unknowns stay unigram
+        out: List[str] = []
+        for p in pieces:
+            if (out and len(p) == 1
+                    and out[-1] not in self.costs and p not in self.costs
+                    and _char_class(p) == _char_class(out[-1][-1])):
+                out[-1] += p
+            else:
+                out.append(p)
+        return out
+
+
+# Small embedded starter lexicons (high-frequency words/particles) so the
+# factories are useful out of the box; user dictionaries extend/override.
+# The reference ships full analyzer dictionaries (~MBs); these cover the
+# closed-class core the segmentation quality hinges on.
+ZH_COMMON = (
+    "的 了 是 在 不 我 有 他 这 中 大 来 上 国 个 到 说 们 为 子 和 你 地 出 道 "
+    "也 时 年 得 就 那 要 下 以 生 会 自 着 去 之 过 家 学 对 可 她 里 后 小 么 "
+    "我们 你们 他们 她们 这个 那个 什么 没有 知道 现在 时候 自己 大家 因为 "
+    "所以 但是 可以 已经 还是 如果 虽然 时间 问题 工作 学习 学生 老师 朋友 "
+    "中国 北京 研究 生命 科学 技术 经济 发展 社会 世界 国家 政府 人民 "
+    "今天 明天 昨天 东西 地方 事情 开始 结束 喜欢 觉得 认为 希望 需要"
+).split()
+
+JA_COMMON = (
+    "の は が を に で と も か ら な だ です ます した する いる ある なる "
+    "これ それ あれ この その あの ここ そこ どこ わたし あなた かれ かのじょ "
+    "こと もの とき ひと 私 僕 彼 彼女 日本 東京 学生 先生 学校 会社 仕事 "
+    "時間 今日 明日 昨日 今 年 月 日 人 何 言葉 勉強 研究 世界 国 家族 友達 "
+    "ありがとう こんにちは さようなら ください から まで より など について"
+).split()
+
+
+def _build_lexicon(base_words, user) -> dict:
+    """base + user lexicon merge with one shared cost formula (user words
+    cost slightly less, so they beat the embedded core at equal length)."""
+    def cost(w, base, floor):
+        return max(base - 3.0 * len(w), floor)
+
+    lex = {w: cost(w, 8.0, 1.0) for w in base_words}
+    if isinstance(user, dict):
+        lex.update({w: float(c) for w, c in user.items()})
+    else:
+        lex.update({w: cost(w, 7.0, 0.5) for w in (user or ())})
+    return lex
+
+
+def _spans(text: str, classes) -> List:
+    """Partition into (is_target, span) with CONSECUTIVE target-class runs
+    coalesced — Japanese words cross script boundaries (kanji+okurigana
+    like 食べる), so the lattice must see the whole CJK span."""
+    out: List = []
+    for run in _runs(text):
+        tgt = _char_class(run[0]) in classes
+        if out and out[-1][0] and tgt:
+            out[-1] = (True, out[-1][1] + run)
+        else:
+            out.append((tgt, run))
     return out
 
 
 class JapaneseTokenizerFactory(TokenizerFactory):
-    """Reference: `deeplearning4j-nlp-japanese` (kuromoji fork)."""
+    """Reference: `deeplearning4j-nlp-japanese` (kuromoji fork) — same
+    algorithm class: min-cost lattice segmentation over a lexicon
+    (LatticeSegmenter) seeded with the embedded JA_COMMON core; a user
+    dictionary (iterable of words or word→cost dict) extends it."""
 
-    def __init__(self, user_dictionary: Optional[Iterable[str]] = None):
+    _CJK = ("kanji", "hiragana", "katakana")
+
+    def __init__(self, user_dictionary: Optional[Iterable[str]] = None, *,
+                 base_lexicon: Optional[Iterable[str]] = None):
         super().__init__()
-        self._dict = set(user_dictionary or ())
-        self._max = max((len(w) for w in self._dict), default=0)
+        base = JA_COMMON if base_lexicon is None else base_lexicon
+        self._seg = LatticeSegmenter(_build_lexicon(base, user_dictionary))
 
     def create(self, text: str) -> Tokenizer:
         toks: List[str] = []
-        for run in _runs(unicodedata.normalize("NFKC", text)):
-            cls = _char_class(run[0])
-            if self._dict and cls in ("kanji", "hiragana", "katakana"):
-                toks.extend(_max_match(run, self._dict, self._max))
+        for is_cjk, span in _spans(unicodedata.normalize("NFKC", text),
+                                   self._CJK):
+            if is_cjk:
+                toks.extend(self._seg.segment(span))
             else:
-                toks.append(run)
+                toks.append(span)
         return _ListTokenizer(toks, self._pre)
 
 
 class ChineseTokenizerFactory(TokenizerFactory):
-    """Reference: `deeplearning4j-nlp-chinese` (ansj analyzer)."""
+    """Reference: `deeplearning4j-nlp-chinese` (ansj analyzer) — min-cost
+    lattice segmentation (ZH_COMMON core + user dictionary); degrades to
+    unigram characters on fully-OOV spans like the reference."""
 
-    def __init__(self, dictionary: Optional[Iterable[str]] = None):
+    def __init__(self, dictionary: Optional[Iterable[str]] = None, *,
+                 base_lexicon: Optional[Iterable[str]] = None):
         super().__init__()
-        self._dict = set(dictionary or ())
-        self._max = max((len(w) for w in self._dict), default=0)
+        base = ZH_COMMON if base_lexicon is None else base_lexicon
+        # Chinese unknowns should NOT merge into runs (OOV hanzi stay
+        # unigrams — the ansj fallback); a run discount would glue them.
+        self._seg = LatticeSegmenter(_build_lexicon(base, dictionary),
+                                     unk_run_cost=10.0)
 
     def create(self, text: str) -> Tokenizer:
         toks: List[str] = []
-        for run in _runs(unicodedata.normalize("NFKC", text)):
-            if _char_class(run[0]) == "kanji":
-                if self._dict:
-                    toks.extend(_max_match(run, self._dict, self._max))
-                else:
-                    toks.extend(run)  # unigram fallback
+        for is_hanzi, span in _spans(unicodedata.normalize("NFKC", text),
+                                     ("kanji",)):
+            if is_hanzi:
+                toks.extend(self._seg.segment(span))
             else:
-                toks.append(run)
+                toks.append(span)
         return _ListTokenizer(toks, self._pre)
 
 

@@ -98,8 +98,12 @@ class TestCJKTokenizers:
         assert "機械学習" in toks
 
     def test_chinese_unigram_and_dict(self):
+        # without ANY lexicon: pure unigram fallback
+        bare = ChineseTokenizerFactory(base_lexicon=())
+        assert bare.create("我爱北京").tokens() == ["我", "爱", "北", "京"]
+        # the embedded ZH_COMMON core knows 北京
         assert ChineseTokenizerFactory().create("我爱北京").tokens() == [
-            "我", "爱", "北", "京"]
+            "我", "爱", "北京"]
         toks = ChineseTokenizerFactory(["北京", "天安门"]).create(
             "我爱北京天安门").tokens()
         assert toks == ["我", "爱", "北京", "天安门"]
@@ -120,3 +124,68 @@ class TestCJKTokenizers:
                        seed=1, tokenizer_factory=ChineseTokenizerFactory())
         w2v.fit(["".join(s.split()) for s in sentences])
         assert w2v.word_vector("我") is not None
+
+
+class TestLatticeSegmentation:
+    """kuromoji/ansj-class min-cost lattice segmentation (the round-1
+    'far shallower than kuromoji' gap)."""
+
+    def test_viterbi_beats_greedy(self):
+        from deeplearning4j_tpu.nlp.lang import LatticeSegmenter
+
+        seg = LatticeSegmenter({"研究": 2.0, "研究生": 3.0, "生命": 2.0})
+        # greedy longest-match yields 研究生|命; min-cost finds 研究|生命
+        assert seg.segment("研究生命") == ["研究", "生命"]
+
+    def test_chinese_factory_embedded_lexicon(self):
+        from deeplearning4j_tpu.nlp.lang import ChineseTokenizerFactory
+
+        toks = ChineseTokenizerFactory().create("我们研究生命的起源").tokens()
+        assert "研究" in toks and "生命" in toks and "我们" in toks
+        # OOV hanzi degrade to unigrams (ansj fallback)
+        assert "起" in toks and "源" in toks
+
+    def test_japanese_factory_embedded_lexicon(self):
+        from deeplearning4j_tpu.nlp.lang import JapaneseTokenizerFactory
+
+        ja = JapaneseTokenizerFactory()
+        assert ja.create("私は日本の学生です").tokens() == \
+            ["私", "は", "日本", "の", "学生", "です"]
+        # OOV katakana loanword stays ONE token (unknown-run grouping)
+        toks = ja.create("コンピュータを勉強する").tokens()
+        assert "コンピュータ" in toks and "を" in toks
+
+    def test_user_dictionary_overrides(self):
+        from deeplearning4j_tpu.nlp.lang import ChineseTokenizerFactory
+
+        base = ChineseTokenizerFactory().create("深度学习框架").tokens()
+        custom = ChineseTokenizerFactory(
+            ["深度学习", "框架"]).create("深度学习框架").tokens()
+        assert custom == ["深度学习", "框架"]
+        assert custom != base
+
+    def test_word2vec_through_lattice_tokenizer(self):
+        import numpy as np
+        from deeplearning4j_tpu.nlp import Word2Vec
+        from deeplearning4j_tpu.nlp.lang import ChineseTokenizerFactory
+
+        rng = np.random.default_rng(0)
+        fruit = "苹果 水果 果汁"
+        cars = "汽车 轮子 发动机"
+        sents = []
+        for _ in range(150):
+            words = (fruit if rng.random() < 0.5 else cars).split()
+            sents.append("".join(rng.choice(words, 5)))  # no spaces (zh)
+        w2v = Word2Vec(layer_size=16, min_count=1, window=3, epochs=4,
+                       seed=2, tokenizer_factory=ChineseTokenizerFactory(
+                           ["苹果", "水果", "果汁", "汽车", "轮子", "发动机"]))
+        w2v.fit(sents)
+        assert w2v.similarity("苹果", "水果") > w2v.similarity("苹果", "汽车")
+
+    def test_japanese_mixed_script_dictionary_word(self):
+        """Kanji+okurigana words (most verbs) cross script boundaries —
+        the lattice must see the whole CJK span to match them."""
+        from deeplearning4j_tpu.nlp.lang import JapaneseTokenizerFactory
+
+        ja = JapaneseTokenizerFactory(user_dictionary=["食べる"])
+        assert "食べる" in ja.create("パンを食べる").tokens()
