@@ -569,8 +569,9 @@ def import_dl4j_model(path, *, input_type=None, updater=None, dtype=None):
                 break
 
     if "vertices" in conf_json:
-        raise ValueError(
-            "ComputationGraph zips are not supported yet; MLN zips only")
+        return _import_dl4j_graph(conf_json, coeffs, upd_raw,
+                                  updater=updater, dtype=dtype,
+                                  input_type=input_type)
 
     layers = []
     for conf in conf_json.get("confs", []):
@@ -598,23 +599,7 @@ def import_dl4j_model(path, *, input_type=None, updater=None, dtype=None):
     flat = np.asarray(coeffs, np.float32).ravel(order="C")
     off = 0
     for layer in net.layers:
-        try:
-            p, s, used = _params_from_flat(layer, flat[off:])
-        except ValueError as e:
-            raise ValueError(
-                f"coefficients.bin too short for layer {layer.name!r} "
-                f"({type(layer).__name__}) at offset {off}: {e}") from None
-        off += used
-        if p:
-            net.params_tree[layer.name] = {
-                k: jnp.asarray(v, net.params_tree[layer.name][k].dtype)
-                if k in net.params_tree[layer.name] else jnp.asarray(v)
-                for k, v in p.items()
-            }
-        if s:
-            net.state_tree[layer.name] = {
-                k: jnp.asarray(v) for k, v in s.items()
-            }
+        off = _assign_flat_segment(net, layer.name, layer, flat, off)
     if off != flat.size:
         raise ValueError(
             f"coefficients.bin has {flat.size} params, config consumes {off}")
@@ -622,14 +607,328 @@ def import_dl4j_model(path, *, input_type=None, updater=None, dtype=None):
     return net
 
 
+def _assign_flat_segment(net, name, layer, flat, off):
+    """Slice one layer's DL4J flat segment into net.params_tree/state_tree
+    with error context (shared by the MLN and graph importers)."""
+    import jax.numpy as jnp
+
+    try:
+        p, s, used = _params_from_flat(layer, flat[off:])
+    except ValueError as e:
+        raise ValueError(
+            f"coefficients.bin too short for layer {name!r} "
+            f"({type(layer).__name__}) at offset {off}: {e}") from None
+    if p:
+        net.params_tree[name] = {
+            k: jnp.asarray(v, net.params_tree[name][k].dtype)
+            if k in net.params_tree[name] else jnp.asarray(v)
+            for k, v in p.items()
+        }
+    if s:
+        net.state_tree[name] = {k: jnp.asarray(v) for k, v in s.items()}
+    return off + used
+
+
+def _dl4j_topo_order(network_inputs, vertex_names, vertex_inputs):
+    """Reproduce DL4J's topological order (`ComputationGraph.
+    topologicalSortOrder():1082` — Kahn's with a FIFO queue over integer
+    vertex ids: network inputs first, then vertices in JSON insertion
+    order; successor sets iterate in ascending id order, matching
+    HashSet<Integer> behavior for small ints). Flat params are sliced in
+    THIS order (`init():416-430`), so it defines coefficient layout."""
+    names = list(network_inputs) + list(vertex_names)
+    idx = {n: i for i, n in enumerate(names)}
+    preds = {i: set() for i in range(len(names))}
+    succs = {i: set() for i in range(len(names))}
+    for name in vertex_names:
+        for src in vertex_inputs.get(name, ()):
+            preds[idx[name]].add(idx[src])
+            succs[idx[src]].add(idx[name])
+    queue = [i for i in range(len(names)) if not preds[i]]
+    order = []
+    while queue:
+        nxt = queue.pop(0)
+        order.append(nxt)
+        for v in sorted(succs[nxt]):
+            preds[v].discard(nxt)
+            if not preds[v]:
+                queue.append(v)
+    if len(order) != len(names):
+        raise ValueError("cycle in ComputationGraph configuration")
+    return [names[i] for i in order]
+
+
+def _vertex_from_dl4j(type_name: str, body: Dict[str, Any]):
+    """DL4J graph-vertex JSON (wrapper-object unwrapped) → (our vertex,
+    layer-or-None). Reference: `nn/conf/graph/*` @JsonSubTypes names."""
+    from deeplearning4j_tpu.nn import graph as G
+
+    if type_name == "LayerVertex":
+        layer_wrapper = body["layerConf"]["layer"]
+        (ltype, ljson), = layer_wrapper.items()
+        layer = _layer_from_dl4j(ltype, ljson)
+        pre = None
+        pp = body.get("preProcessor")
+        if pp:
+            pre = _preprocessor_from_dl4j(pp)
+        return G.LayerVertex(layer=layer, preprocessor=pre), layer
+    if type_name == "MergeVertex":
+        return G.MergeVertex(), None
+    if type_name == "ElementWiseVertex":
+        return G.ElementWiseVertex(op=str(body.get("op", "Add")).lower()), None
+    if type_name == "SubsetVertex":
+        return G.SubsetVertex(from_=body.get("from", 0),
+                              to=body.get("to", 0)), None
+    if type_name == "ScaleVertex":
+        return G.ScaleVertex(scale=body.get("scaleFactor", 1.0)), None
+    if type_name == "StackVertex":
+        return G.StackVertex(), None
+    if type_name == "UnstackVertex":
+        return G.UnstackVertex(from_=body.get("from", 0),
+                               stack_size=body.get("stackSize", 1)), None
+    if type_name == "L2NormalizeVertex":
+        return G.L2NormalizeVertex(), None
+    if type_name == "L2Vertex":
+        return G.L2Vertex(), None
+    if type_name == "PoolHelperVertex":
+        return G.PoolHelperVertex(), None
+    if type_name == "LastTimeStepVertex":
+        return G.LastTimeStepVertex(
+            mask_input=body.get("maskArrayInputName")), None
+    if type_name == "DuplicateToTimeSeriesVertex":
+        # our vertex carries STATIC timesteps (XLA needs static shapes);
+        # DL4J reads T at runtime from the named input. Our exports write
+        # a "timesteps" key; genuine DL4J zips don't have one, and
+        # guessing would silently broadcast to the wrong length.
+        t = body.get("timesteps")
+        if t is None:
+            raise ValueError(
+                "DuplicateToTimeSeriesVertex in a DL4J zip carries no "
+                "static timestep count (DL4J resolves it at runtime from "
+                f"input {body.get('inputName')!r}); rebuild this vertex "
+                "with an explicit length after import")
+        return G.DuplicateToTimeSeriesVertex(timesteps=int(t)), None
+    raise ValueError(f"unsupported DL4J graph vertex type {type_name!r}")
+
+
+_PP_CLASS_BASE = "org.deeplearning4j.nn.conf.preprocessor."
+
+
+def _preprocessor_to_dl4j(pre) -> Dict[str, Any]:
+    from deeplearning4j_tpu.nn import preprocessors as P
+
+    if isinstance(pre, P.CnnToFeedForward):
+        return {"@class": _PP_CLASS_BASE + "CnnToFeedForwardPreProcessor",
+                "inputHeight": pre.height, "inputWidth": pre.width,
+                "numChannels": pre.channels}
+    if isinstance(pre, P.FeedForwardToCnn):
+        return {"@class": _PP_CLASS_BASE + "FeedForwardToCnnPreProcessor",
+                "inputHeight": pre.height, "inputWidth": pre.width,
+                "numChannels": pre.channels}
+    if isinstance(pre, P.FeedForwardToRnn):
+        return {"@class": _PP_CLASS_BASE + "FeedForwardToRnnPreProcessor"}
+    if isinstance(pre, P.RnnToFeedForward):
+        return {"@class": _PP_CLASS_BASE + "RnnToFeedForwardPreProcessor"}
+    if isinstance(pre, P.RnnToCnn):
+        return {"@class": _PP_CLASS_BASE + "RnnToCnnPreProcessor",
+                "inputHeight": pre.height, "inputWidth": pre.width,
+                "numChannels": pre.channels}
+    if isinstance(pre, P.CnnToRnn):
+        return {"@class": _PP_CLASS_BASE + "CnnToRnnPreProcessor"}
+    raise ValueError(
+        f"preprocessor {type(pre).__name__} has no DL4J JSON mapping")
+
+
+def _preprocessor_from_dl4j(pp: Dict[str, Any]):
+    from deeplearning4j_tpu.nn import preprocessors as P
+
+    cls = pp.get("@class", "")
+    if "CnnToFeedForward" in cls:
+        return P.CnnToFeedForward()
+    if "FeedForwardToCnn" in cls:
+        return P.FeedForwardToCnn(
+            height=pp.get("inputHeight"), width=pp.get("inputWidth"),
+            channels=pp.get("numChannels"))
+    if "FeedForwardToRnn" in cls:
+        return P.FeedForwardToRnn()
+    if "RnnToFeedForward" in cls:
+        return P.RnnToFeedForward()
+    if "RnnToCnn" in cls:
+        return P.RnnToCnn(height=pp.get("inputHeight"),
+                          width=pp.get("inputWidth"),
+                          channels=pp.get("numChannels"))
+    if "CnnToRnn" in cls:
+        return P.CnnToRnn()
+    raise ValueError(f"unsupported DL4J preprocessor {cls!r}")
+
+
+def _import_dl4j_graph(conf_json, coeffs, upd_raw, *, updater=None,
+                       dtype=None, input_type=None):
+    """DL4J ComputationGraph zip → ComputationGraph. Reference:
+    `nn/conf/ComputationGraphConfiguration.java` (vertices/vertexInputs/
+    networkInputs/networkOutputs JSON) + the topological flat-param
+    layout of `ComputationGraph.init():382-443`."""
+    from deeplearning4j_tpu.models import ComputationGraph
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.optim.updaters import Sgd
+
+    vertices_json = conf_json["vertices"]
+    vertex_inputs = {k: list(v)
+                     for k, v in conf_json.get("vertexInputs", {}).items()}
+    net_inputs = list(conf_json.get("networkInputs", []))
+    net_outputs = list(conf_json.get("networkOutputs", []))
+
+    g = (NeuralNetConfiguration.builder()
+         .updater(updater if updater is not None else Sgd(0.1))
+         .graph_builder())
+    g.add_inputs(*net_inputs)
+    if input_type is not None:
+        # single InputType (applied to the sole/first input) or a dict
+        # name → InputType for multi-input graphs
+        if isinstance(input_type, dict):
+            g.set_input_types(*[input_type[n] for n in net_inputs])
+        else:
+            g.set_input_types(input_type)
+    layer_for_vertex: Dict[str, Any] = {}
+    for name, wrapper in vertices_json.items():
+        (type_name, body), = wrapper.items()
+        vertex, layer = _vertex_from_dl4j(type_name, body)
+        if layer is not None:
+            layer_for_vertex[name] = layer
+            g.add_layer(name, layer, *vertex_inputs.get(name, ()),
+                        preprocessor=vertex.preprocessor)
+        else:
+            g.add_vertex(name, vertex, *vertex_inputs.get(name, ()))
+    g.set_outputs(*net_outputs)
+    conf = g.build()
+    if dtype is not None:
+        conf = dataclasses.replace(conf, dtype=dtype)
+    net = ComputationGraph(conf).init()
+
+    flat = np.asarray(coeffs, np.float32).ravel(order="C")
+    off = 0
+    for name in _dl4j_topo_order(net_inputs, vertices_json.keys(),
+                                 vertex_inputs):
+        if name not in layer_for_vertex:
+            continue
+        # our LayerVertex stores the (possibly n_in-inferred) layer copy
+        built = conf.vertices[name].layer
+        off = _assign_flat_segment(net, name, built, flat, off)
+    if off != flat.size:
+        raise ValueError(
+            f"coefficients.bin has {flat.size} params, graph config "
+            f"consumes {off}")
+    net.dl4j_updater_state = upd_raw
+    return net
+
+
+def _vertex_to_dl4j(vertex) -> Tuple[str, Dict[str, Any]]:
+    from deeplearning4j_tpu.nn import graph as G
+
+    if isinstance(vertex, G.LayerVertex):
+        ltype, ljson = _layer_to_dl4j(vertex.layer)
+        body: Dict[str, Any] = {"layerConf": {"layer": {ltype: ljson}}}
+        if vertex.preprocessor is not None:
+            body["preProcessor"] = _preprocessor_to_dl4j(vertex.preprocessor)
+        return "LayerVertex", body
+    if isinstance(vertex, G.MergeVertex):
+        return "MergeVertex", {}
+    if isinstance(vertex, G.ElementWiseVertex):
+        # canonical DL4J Op enum names (ElementWiseVertex.Op), not .title()
+        ops = {"add": "Add", "sub": "Subtract", "subtract": "Subtract",
+               "mul": "Product", "product": "Product",
+               "avg": "Average", "average": "Average", "max": "Max"}
+        return "ElementWiseVertex", {"op": ops.get(vertex.op.lower(),
+                                                   vertex.op.title())}
+    if isinstance(vertex, G.SubsetVertex):
+        return "SubsetVertex", {"from": vertex.from_, "to": vertex.to}
+    if isinstance(vertex, G.ScaleVertex):
+        return "ScaleVertex", {"scaleFactor": vertex.scale}
+    if isinstance(vertex, G.StackVertex):
+        return "StackVertex", {}
+    if isinstance(vertex, G.UnstackVertex):
+        return "UnstackVertex", {"from": vertex.from_,
+                                 "stackSize": vertex.stack_size}
+    if isinstance(vertex, G.L2NormalizeVertex):
+        return "L2NormalizeVertex", {}
+    if isinstance(vertex, G.L2Vertex):
+        return "L2Vertex", {}
+    if isinstance(vertex, G.PoolHelperVertex):
+        return "PoolHelperVertex", {}
+    if isinstance(vertex, G.LastTimeStepVertex):
+        return "LastTimeStepVertex", {"maskArrayInputName": vertex.mask_input}
+    if isinstance(vertex, G.DuplicateToTimeSeriesVertex):
+        # "timesteps" is our static-shape extension (see import side)
+        return "DuplicateToTimeSeriesVertex", {"timesteps": vertex.timesteps}
+    raise ValueError(
+        f"vertex type {type(vertex).__name__} has no DL4J JSON mapping")
+
+
+def _export_dl4j_graph(net, path, *, save_updater: bool = False) -> None:
+    """ComputationGraph → DL4J-layout zip (vertices/vertexInputs JSON +
+    topologically-ordered flat coefficients, matching
+    `ComputationGraph.init():416-430`)."""
+    conf = net.conf
+    net_inputs = list(conf.network_inputs)
+    vertices_json: Dict[str, Any] = {}
+    vertex_inputs: Dict[str, List[str]] = {}
+    for name, v in conf.vertices.items():
+        if name in net_inputs:
+            continue
+        type_name, body = _vertex_to_dl4j(v)
+        vertices_json[name] = {type_name: body}
+        vertex_inputs[name] = list(conf.vertex_inputs.get(name, ()))
+
+    conf_json = {
+        "vertices": vertices_json,
+        "vertexInputs": vertex_inputs,
+        "networkInputs": net_inputs,
+        "networkOutputs": list(conf.network_outputs),
+        "backprop": True, "pretrain": False,
+    }
+
+    segs: List[np.ndarray] = []
+    from deeplearning4j_tpu.nn.graph import LayerVertex
+
+    for name in _dl4j_topo_order(net_inputs, vertices_json.keys(),
+                                 vertex_inputs):
+        v = conf.vertices.get(name)
+        if not isinstance(v, LayerVertex):
+            continue
+        segs.append(_params_to_flat(
+            v.layer, net.params_tree.get(name, {}),
+            net.state_tree.get(name, {})))
+    flat = (np.concatenate([s for s in segs if s.size])
+            if any(s.size for s in segs) else np.zeros((0,), np.float32))
+
+    with zipfile.ZipFile(path, "w") as zf:
+        zf.writestr("configuration.json", json.dumps(conf_json, indent=2))
+        buf = io.BytesIO()
+        write_nd4j_array(buf, flat.reshape(1, -1))
+        zf.writestr("coefficients.bin", buf.getvalue())
+        if save_updater:
+            import jax
+
+            leaves = jax.tree_util.tree_leaves(net.updater_state)
+            state = (np.concatenate(
+                [np.asarray(l, np.float32).ravel() for l in leaves])
+                if leaves else np.zeros((0,), np.float32))
+            buf = io.BytesIO()
+            write_nd4j_array(buf, state.reshape(1, -1))
+            zf.writestr("updaterState.bin", buf.getvalue())
+
+
 def export_dl4j_model(net, path, *, save_updater: bool = False) -> None:
-    """Write `net` (MultiLayerNetwork) as a DL4J-layout zip: the reference's
-    ModelSerializer container (configuration.json + coefficients.bin).
+    """Write `net` as a DL4J-layout zip: the reference's ModelSerializer
+    container (configuration.json + coefficients.bin). MultiLayerNetwork
+    and ComputationGraph both supported.
 
     save_updater flattens this framework's optimizer pytree in parameter
     order — layout differs from DL4J's updater blocks (documented; primarily
     for round-trips within this framework).
     """
+    if hasattr(net.conf, "vertices"):
+        return _export_dl4j_graph(net, path, save_updater=save_updater)
     confs = []
     for layer in net.layers:
         type_name, layer_json = _layer_to_dl4j(layer)

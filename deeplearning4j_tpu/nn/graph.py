@@ -326,8 +326,11 @@ class LayerVertex(GraphVertex):
         return self.layer.output_type(it)
 
     def init_params(self, key, input_types, dtype=jnp.float32):
-        it = input_types[0]
-        if self.preprocessor is not None:
+        # input type may be unknown (no set_input_types + upstream shape
+        # not inferable, e.g. DL4J-imported configs with explicit nIn) —
+        # layers with explicit dims don't need it
+        it = input_types[0] if input_types else None
+        if it is not None and self.preprocessor is not None:
             it = self.preprocessor.output_type(it)
         return self.layer.init_params(key, it, dtype)
 

@@ -323,3 +323,141 @@ class TestReferenceLayout:
         self._write_zip(path, conf, np.zeros(5, np.float32))  # needs 8
         with pytest.raises(ValueError, match="coefficients.bin"):
             import_dl4j_model(path)
+
+
+class TestComputationGraphInterop:
+    """DL4J ComputationGraph zip containers (the format the published
+    pretrained zoo files use — VGG16/ResNet50 are graphs). Reference:
+    ComputationGraphConfiguration JSON + the topological flat-param
+    layout of ComputationGraph.init():382-443."""
+
+    def _branched_zip(self, path):
+        """Hand-built DL4J-layout graph: in -> (a: dense4, b: dense4) ->
+        merge -> out (softmax 2). Coefficients in DL4J topological order
+        (a, b, out) with 'f'-order dense blocks."""
+        rng = np.random.default_rng(5)
+        wa = rng.standard_normal((3, 4)).astype(np.float32)
+        ba = rng.standard_normal(4).astype(np.float32)
+        wb = rng.standard_normal((3, 4)).astype(np.float32)
+        bb = rng.standard_normal(4).astype(np.float32)
+        wo = rng.standard_normal((8, 2)).astype(np.float32)
+        bo = rng.standard_normal(2).astype(np.float32)
+
+        def dense_json(name, nin, nout, act, out=False):
+            d = {"layerName": name, "nin": nin, "nout": nout,
+                 "activationFn": {"@class":
+                                  "org.nd4j.linalg.activations.impl."
+                                  f"Activation{act}"},
+                 "weightInit": "XAVIER", "l1": 0.0, "l2": 0.0}
+            if out:
+                d["lossFn"] = {"@class": "org.nd4j.linalg.lossfunctions."
+                                         "impl.LossMCXENT"}
+            return {"layerConf": {"layer": {
+                ("output" if out else "dense"): d}}}
+
+        conf = {
+            "vertices": {
+                "a": {"LayerVertex": dense_json("a", 3, 4, "TanH")},
+                "b": {"LayerVertex": dense_json("b", 3, 4, "TanH")},
+                "merge": {"MergeVertex": {}},
+                "out": {"LayerVertex": dense_json("out", 8, 2, "Softmax",
+                                                  out=True)},
+            },
+            "vertexInputs": {"a": ["in"], "b": ["in"],
+                             "merge": ["a", "b"], "out": ["merge"]},
+            "networkInputs": ["in"],
+            "networkOutputs": ["out"],
+        }
+        flat = np.concatenate([
+            wa.reshape(-1, order="F"), ba,
+            wb.reshape(-1, order="F"), bb,
+            wo.reshape(-1, order="F"), bo,
+        ])
+        with zipfile.ZipFile(path, "w") as zf:
+            zf.writestr("configuration.json", json.dumps(conf))
+            buf = io.BytesIO()
+            write_nd4j_array(buf, flat.reshape(1, -1))
+            zf.writestr("coefficients.bin", buf.getvalue())
+        return wa, ba, wb, bb, wo, bo
+
+    def test_branched_graph_imports_and_predicts(self, tmp_path):
+        p = str(tmp_path / "graph.zip")
+        wa, ba, wb, bb, wo, bo = self._branched_zip(p)
+        net = import_dl4j_model(p)
+        x = np.random.default_rng(6).standard_normal((5, 3)).astype(np.float32)
+        got = np.asarray(net.output(x))
+        cat = np.concatenate([np.tanh(x @ wa + ba), np.tanh(x @ wb + bb)], -1)
+        z = cat @ wo + bo
+        want = np.exp(z - z.max(-1, keepdims=True))
+        want /= want.sum(-1, keepdims=True)
+        np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-6)
+
+    def test_graph_roundtrip_through_dl4j_layout(self, tmp_path):
+        """export our ComputationGraph as a DL4J zip -> import -> identical
+        predictions (coefficients laid out in DL4J topological order)."""
+        from deeplearning4j_tpu.models import ComputationGraph
+        from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.graph import ElementWiseVertex
+        from deeplearning4j_tpu.nn.inputs import InputType
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+        g = NeuralNetConfiguration.builder().seed(3).graph_builder()
+        g.add_inputs("in")
+        g.set_input_types(InputType.feed_forward(6))
+        g.add_layer("h1", DenseLayer(n_in=6, n_out=8, activation="tanh"),
+                    "in")
+        g.add_layer("h2", DenseLayer(n_in=6, n_out=8, activation="relu"),
+                    "in")
+        g.add_vertex("sum", ElementWiseVertex(op="add"), "h1", "h2")
+        g.add_layer("out", OutputLayer(n_in=8, n_out=3,
+                                       activation="softmax", loss="mcxent"),
+                    "sum")
+        g.set_outputs("out")
+        net = ComputationGraph(g.build()).init()
+
+        p = str(tmp_path / "rt.zip")
+        export_dl4j_model(net, p)
+        back = import_dl4j_model(p)
+        x = np.random.default_rng(7).standard_normal((4, 6)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(back.output(x)),
+                                   np.asarray(net.output(x)),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_imported_graph_is_trainable(self, tmp_path):
+        p = str(tmp_path / "graph2.zip")
+        self._branched_zip(p)
+        net = import_dl4j_model(p)
+        rng = np.random.default_rng(8)
+        x = rng.standard_normal((64, 3)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 64)]
+        s0 = net.score_ or 1e9
+        net.fit(x, y, epochs=10, batch_size=32)
+        assert np.isfinite(net.score_)
+
+    def test_graph_roundtrip_preserves_preprocessor(self, tmp_path):
+        """LayerVertex preProcessor must survive export -> import (rnn ->
+        dense via RnnToFeedForward)."""
+        from deeplearning4j_tpu.models import ComputationGraph
+        from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.inputs import InputType
+        from deeplearning4j_tpu.nn.layers import LSTM, OutputLayer
+        from deeplearning4j_tpu.nn.preprocessors import RnnToFeedForward
+
+        g = NeuralNetConfiguration.builder().seed(4).graph_builder()
+        g.add_inputs("in")
+        g.set_input_types(InputType.recurrent(3, 5))
+        g.add_layer("lstm", LSTM(n_in=3, n_out=4, activation="tanh"), "in")
+        g.add_layer("out",
+                    OutputLayer(n_in=4, n_out=2, activation="softmax",
+                                loss="mcxent"),
+                    "lstm", preprocessor=RnnToFeedForward())
+        g.set_outputs("out")
+        net = ComputationGraph(g.build()).init()
+        p = str(tmp_path / "pp.zip")
+        export_dl4j_model(net, p)
+        back = import_dl4j_model(p)
+        x = np.random.default_rng(9).standard_normal((2, 5, 3)).astype(
+            np.float32)
+        np.testing.assert_allclose(np.asarray(back.output(x)),
+                                   np.asarray(net.output(x)),
+                                   rtol=1e-5, atol=1e-6)
