@@ -461,3 +461,22 @@ class TestComputationGraphInterop:
         np.testing.assert_allclose(np.asarray(back.output(x)),
                                    np.asarray(net.output(x)),
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_zoo_lenet_roundtrips_through_dl4j_container(tmp_path):
+    """A real zoo model (LeNet: conv/pool/dense stack) survives the DL4J
+    zip container with identical predictions — the switching-user check
+    that our models interchange with the reference's serializer."""
+    from deeplearning4j_tpu.zoo import LeNet
+
+    net = LeNet(num_classes=10, input_shape=(28, 28, 1)).init()
+    p = str(tmp_path / "lenet_dl4j.zip")
+    export_dl4j_model(net, p)
+    back = import_dl4j_model(
+        p, input_type=__import__(
+            "deeplearning4j_tpu.nn.inputs", fromlist=["InputType"]
+        ).InputType.convolutional_flat(28, 28, 1))
+    x = np.random.default_rng(0).standard_normal((4, 784)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(back.output(x)),
+                               np.asarray(net.output(x)),
+                               rtol=1e-4, atol=1e-5)
