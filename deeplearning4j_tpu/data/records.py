@@ -10,7 +10,7 @@ from __future__ import annotations
 
 import csv
 import os
-from typing import Iterator, List, Optional, Sequence, Tuple
+from typing import Dict, Iterator, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -230,6 +230,123 @@ class SequenceRecordReaderDataSetIterator(DataSetIterator):
             y = np.eye(n, dtype=np.float32)[lab_raw.astype(np.int64)]
             y = y * mask[..., None]
         return DataSet(x, y, mask, mask)
+
+    @property
+    def batch_size(self):
+        return self.bs
+
+
+class RecordReaderMultiDataSetIterator(DataSetIterator):
+    """Multi-input/multi-output DataVec bridge — flexible column mappings
+    from one or more readers into MultiDataSet batches. Reference:
+    `datasets/datavec/RecordReaderMultiDataSetIterator.java` (Builder:
+    addReader / addInput(reader, from, to) / addOutput /
+    addOutputOneHot), the iterator ComputationGraph training feeds from.
+
+    Usage (builder-style, mirroring the reference):
+        it = (RecordReaderMultiDataSetIterator.builder(batch_size)
+              .add_reader("csv", reader)
+              .add_input("csv", 0, 3)           # columns 0..3 inclusive
+              .add_output_one_hot("csv", 4, 3)  # column 4 as 3-class 1-hot
+              .build())
+    """
+
+    def __init__(self, batch_size: int, readers, inputs, outputs):
+        self.bs = batch_size
+        self._readers = readers      # name -> RecordReader
+        self._inputs = inputs        # list of (reader, lo, hi)
+        self._outputs = outputs      # list of (reader, lo, hi, one_hot_n)
+        self._its: Optional[Dict[str, Iterator]] = None
+
+    # ------------------------------------------------------------ builder
+    class Builder:
+        def __init__(self, batch_size: int):
+            self._bs = batch_size
+            self._readers: Dict[str, RecordReader] = {}
+            self._inputs = []
+            self._outputs = []
+
+        def add_reader(self, name: str, reader: RecordReader):
+            self._readers[name] = reader
+            return self
+
+        def add_input(self, reader: str, col_from: int, col_to: int):
+            self._inputs.append((reader, col_from, col_to, None))
+            return self
+
+        def add_output(self, reader: str, col_from: int, col_to: int):
+            self._outputs.append((reader, col_from, col_to, None))
+            return self
+
+        def add_output_one_hot(self, reader: str, column: int,
+                               num_classes: int):
+            self._outputs.append((reader, column, column, num_classes))
+            return self
+
+        def build(self) -> "RecordReaderMultiDataSetIterator":
+            if not self._readers or not self._inputs:
+                raise ValueError("need at least one reader and one input")
+            for reader, *_ in self._inputs + self._outputs:
+                if reader not in self._readers:
+                    raise ValueError(f"unknown reader {reader!r}")
+            return RecordReaderMultiDataSetIterator(
+                self._bs, self._readers, self._inputs, self._outputs)
+
+    @staticmethod
+    def builder(batch_size: int) -> "RecordReaderMultiDataSetIterator.Builder":
+        return RecordReaderMultiDataSetIterator.Builder(batch_size)
+
+    # ----------------------------------------------------------- iterate
+    def reset(self):
+        self._its = {n: iter(r) for n, r in self._readers.items()}
+
+    def __next__(self):
+        from deeplearning4j_tpu.data.dataset import MultiDataSet
+
+        if self._its is None:
+            self.reset()
+        rows: Dict[str, list] = {n: [] for n in self._readers}
+        for _ in range(self.bs):
+            batch_row = {}
+            try:
+                for n, it in self._its.items():
+                    batch_row[n] = list(next(it))   # raw values; only
+                    # MAPPED columns get converted (mixed-type CSVs with
+                    # unmapped string columns must work, like DataVec)
+            except StopIteration:
+                break    # readers must align; stop at the shortest
+            for n, vals in batch_row.items():
+                rows[n].append(vals)
+        if not next(iter(rows.values())):
+            self._its = None
+            raise StopIteration
+
+        # validate mapped ranges against the actual record width ONCE per
+        # batch — Python slices would silently truncate out-of-range cols
+        for reader, lo, hi, _ in self._inputs + self._outputs:
+            width = len(rows[reader][0])
+            if lo < 0 or hi >= width:
+                raise ValueError(
+                    f"column range [{lo}, {hi}] out of bounds for reader "
+                    f"{reader!r} records of width {width}")
+
+        def slab(spec):
+            reader, lo, hi, one_hot = spec
+            arr = np.asarray(
+                [[float(v) for v in r[lo:hi + 1]] for r in rows[reader]],
+                np.float32)
+            if one_hot:
+                idx = arr[:, 0].astype(np.int64)
+                if ((idx < 0) | (idx >= one_hot)).any():
+                    raise ValueError(
+                        f"one-hot column {lo} of reader {reader!r} has "
+                        f"labels outside [0, {one_hot})")
+                arr = np.eye(one_hot, dtype=np.float32)[idx]
+            return arr
+
+        feats = [slab(s) for s in self._inputs]
+        labs = [slab(s) for s in self._outputs]
+        return MultiDataSet(feats, labs)
 
     @property
     def batch_size(self):

@@ -235,3 +235,112 @@ class TestEvalRobustness:
         ev.eval(labels, preds, mask=np.array([1, 1, 0, 0]))
         assert ev.accuracy() == 1.0            # masked rows not counted
         assert int(ev.confusion.matrix.sum()) == 2
+
+
+class TestRecordReaderMultiDataSetIterator:
+    """Reference: datasets/datavec/RecordReaderMultiDataSetIterator.java —
+    multi-input/output column mappings feeding ComputationGraph training."""
+
+    def _csv(self, tmp_path):
+        p = tmp_path / "multi.csv"
+        rows = ["%d,%d,%d,%d,%d" % (i, i + 1, i + 2, i + 3, i % 3)
+                for i in range(10)]
+        p.write_text("\n".join(rows) + "\n")
+        return str(p)
+
+    def test_builder_mappings(self, tmp_path):
+        from deeplearning4j_tpu.data.records import (
+            CSVRecordReader, RecordReaderMultiDataSetIterator,
+        )
+
+        it = (RecordReaderMultiDataSetIterator.builder(4)
+              .add_reader("csv", CSVRecordReader(self._csv(tmp_path)))
+              .add_input("csv", 0, 1)
+              .add_input("csv", 2, 3)
+              .add_output_one_hot("csv", 4, 3)
+              .build())
+        mds = next(it)
+        assert len(mds.features) == 2 and len(mds.labels) == 1
+        assert mds.features[0].shape == (4, 2)
+        assert mds.features[1].shape == (4, 2)
+        assert mds.labels[0].shape == (4, 3)
+        np.testing.assert_array_equal(mds.features[0][0], [0, 1])
+        np.testing.assert_array_equal(mds.labels[0][0],
+                                      [1, 0, 0])  # class 0
+
+    def test_feeds_computation_graph(self, tmp_path):
+        from deeplearning4j_tpu.data.records import (
+            CSVRecordReader, RecordReaderMultiDataSetIterator,
+        )
+        from deeplearning4j_tpu.models import ComputationGraph
+        from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.graph import MergeVertex
+        from deeplearning4j_tpu.nn.inputs import InputType
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+        g = NeuralNetConfiguration.builder().seed(0).graph_builder()
+        g.add_inputs("a", "b")
+        g.set_input_types(InputType.feed_forward(2),
+                          InputType.feed_forward(2))
+        g.add_layer("da", DenseLayer(n_in=2, n_out=4, activation="tanh"),
+                    "a")
+        g.add_layer("db", DenseLayer(n_in=2, n_out=4, activation="tanh"),
+                    "b")
+        g.add_vertex("m", MergeVertex(), "da", "db")
+        g.add_layer("out", OutputLayer(n_in=8, n_out=3,
+                                       activation="softmax", loss="mcxent"),
+                    "m")
+        g.set_outputs("out")
+        net = ComputationGraph(g.build()).init()
+        it = (RecordReaderMultiDataSetIterator.builder(5)
+              .add_reader("csv", CSVRecordReader(self._csv(tmp_path)))
+              .add_input("csv", 0, 1)
+              .add_input("csv", 2, 3)
+              .add_output_one_hot("csv", 4, 3)
+              .build())
+        for mds in it:
+            net.fit(mds)
+        assert np.isfinite(net.score_)
+
+    def test_validation(self, tmp_path):
+        from deeplearning4j_tpu.data.records import (
+            RecordReaderMultiDataSetIterator,
+        )
+
+        with pytest.raises(ValueError, match="unknown reader"):
+            (RecordReaderMultiDataSetIterator.builder(2)
+             .add_reader("a", None)
+             .add_input("missing", 0, 1).build())
+        with pytest.raises(ValueError, match="at least one"):
+            RecordReaderMultiDataSetIterator.builder(2).build()
+
+    def test_unmapped_string_columns_ok_and_ranges_validated(self, tmp_path):
+        from deeplearning4j_tpu.data.records import (
+            CollectionRecordReader, RecordReaderMultiDataSetIterator,
+        )
+
+        recs = [["1.0", "2.0", "some_id", "0"],
+                ["3.0", "4.0", "other_id", "2"]]
+        it = (RecordReaderMultiDataSetIterator.builder(2)
+              .add_reader("r", CollectionRecordReader(recs))
+              .add_input("r", 0, 1)
+              .add_output_one_hot("r", 3, 3)
+              .build())
+        mds = next(it)   # the string column is unmapped → no crash
+        np.testing.assert_array_equal(mds.labels[0].argmax(-1), [0, 2])
+
+        bad = (RecordReaderMultiDataSetIterator.builder(2)
+               .add_reader("r", CollectionRecordReader(recs))
+               .add_input("r", 0, 10)
+               .add_output_one_hot("r", 3, 3)
+               .build())
+        with pytest.raises(ValueError, match="out of bounds"):
+            next(bad)
+
+        neg = (RecordReaderMultiDataSetIterator.builder(1)
+               .add_reader("r", CollectionRecordReader([["1", "-1"]]))
+               .add_input("r", 0, 0)
+               .add_output_one_hot("r", 1, 3)
+               .build())
+        with pytest.raises(ValueError, match="outside"):
+            next(neg)
