@@ -507,8 +507,11 @@ def _params_to_flat(layer, params: Dict[str, Any],
     if isinstance(layer, ConvolutionLayer) \
             and type(layer).__name__ == "ConvolutionLayer":
         w = np.transpose(f32(params["W"]), (3, 2, 0, 1))  # HWIO -> OIHW
-        return np.concatenate([f32(params["b"]).ravel(),
-                               w.reshape(-1, order="C")])
+        # DL4J convs ALWAYS carry a bias; a has_bias=False conv (conv+BN
+        # stacks like ResNet) exports a zero bias — numerically identical
+        b = (f32(params["b"]) if "b" in params
+             else np.zeros((layer.n_out,), np.float32))
+        return np.concatenate([b.ravel(), w.reshape(-1, order="C")])
     if isinstance(layer, BatchNormalization):
         parts = []
         if not layer.lock_gamma_beta:
@@ -529,8 +532,11 @@ def _params_to_flat(layer, params: Dict[str, Any],
                                rw.reshape(-1, order="F"), b.ravel()])
     if isinstance(layer, (DenseLayer, EmbeddingLayer)):
         parts = [f32(params["W"]).reshape(-1, order="F")]
-        if "b" in params:
-            parts.append(f32(params["b"]).ravel())
+        # same asymmetry guard as the conv branch: the config JSON never
+        # carries hasBias, so the importer always reads a bias — export a
+        # zero one for has_bias=False layers to keep offsets aligned
+        parts.append(f32(params["b"]).ravel() if "b" in params
+                     else np.zeros((layer.n_out,), np.float32))
         return np.concatenate(parts)
     return np.zeros((0,), np.float32)
 

@@ -480,3 +480,46 @@ def test_zoo_lenet_roundtrips_through_dl4j_container(tmp_path):
     np.testing.assert_allclose(np.asarray(back.output(x)),
                                np.asarray(net.output(x)),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_zoo_resnet50_roundtrips_through_dl4j_container(tmp_path):
+    """The flagship zoo ComputationGraph (ResNet-50: conv/BN stacks,
+    ElementWise-add shortcuts, ~100 vertices) survives the DL4J container
+    with identical predictions; has_bias=False convs export the zero bias
+    DL4J's layout requires."""
+    from deeplearning4j_tpu.nn.inputs import InputType
+    from deeplearning4j_tpu.zoo import ResNet50
+
+    net = ResNet50(num_classes=8, input_shape=(32, 32, 3)).init()
+    p = str(tmp_path / "r50.zip")
+    export_dl4j_model(net, p)
+    back = import_dl4j_model(
+        p, input_type=InputType.convolutional(32, 32, 3))
+    x = np.random.default_rng(0).standard_normal((2, 32, 32, 3)).astype(
+        np.float32)
+    np.testing.assert_allclose(np.asarray(back.output(x)),
+                               np.asarray(net.output(x)),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_biasless_dense_roundtrips(tmp_path):
+    """has_bias=False dense layers must export a zero bias so the flat
+    offsets stay aligned on import (config JSON never carries hasBias)."""
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+    net = MultiLayerNetwork(
+        NeuralNetConfiguration.builder().seed(2)
+        .list(DenseLayer(n_in=5, n_out=7, activation="tanh",
+                         has_bias=False),
+              OutputLayer(n_in=7, n_out=3, activation="softmax",
+                          loss="mcxent"))
+        .build()).init()
+    p = str(tmp_path / "nb.zip")
+    export_dl4j_model(net, p)
+    back = import_dl4j_model(p)
+    x = np.random.default_rng(3).standard_normal((4, 5)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(back.output(x)),
+                               np.asarray(net.output(x)),
+                               rtol=1e-5, atol=1e-6)
