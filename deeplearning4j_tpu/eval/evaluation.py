@@ -123,6 +123,44 @@ class Evaluation:
                 Prediction(int(a), int(p), m)
                 for a, p, m in zip(actual, predicted, record_meta))
 
+    @staticmethod
+    def run_evaluation(evaluator, iterator, output_fn):
+        """Feed every batch's outputs into any batch-wise evaluator with an
+        `eval(labels, predictions)` method — backs the model-level
+        evaluate_regression / evaluate_roc / evaluate_roc_multi_class
+        (reference: MultiLayerNetwork.java:2668-2699).
+
+        Masking/time-series normalization happens HERE (flatten [B,T,C] to
+        [B·T, C] and drop masked rows, the reference's evalTimeSeries
+        path), so evaluators that don't understand masks (ROC family)
+        still get only valid examples. MultiDataSet batches evaluate the
+        FIRST output (the reference's single-output contract)."""
+        for ds in iterator:
+            if hasattr(ds, "labels_masks"):   # MultiDataSet
+                out = output_fn(*ds.features)
+                if isinstance(out, (list, tuple)):
+                    out = out[0]
+                labels = np.asarray(ds.labels[0])
+                mask = (ds.labels_masks[0]
+                        if ds.labels_masks else None)
+            else:
+                out = output_fn(ds.features)
+                labels = np.asarray(ds.labels)
+                mask = ds.labels_mask
+            preds = np.asarray(out)
+            if labels.ndim == 3:
+                B, T, C = labels.shape
+                labels = labels.reshape(B * T, C)
+                preds = preds.reshape(B * T, -1)
+                if mask is not None:
+                    keep = np.asarray(mask).reshape(B * T) > 0
+                    labels, preds = labels[keep], preds[keep]
+            elif mask is not None:
+                keep = np.asarray(mask).reshape(len(labels)) > 0
+                labels, preds = labels[keep], preds[keep]
+            evaluator.eval(labels, preds)
+        return evaluator
+
     def evaluate_iterator(self, iterator, *, output_fn, predict_indices_fn):
         """Shared batch loop for model.evaluate (MultiLayerNetwork and
         ComputationGraph): device-side argmax fast path for plain

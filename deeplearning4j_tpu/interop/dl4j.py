@@ -507,8 +507,10 @@ def _params_to_flat(layer, params: Dict[str, Any],
     if isinstance(layer, ConvolutionLayer) \
             and type(layer).__name__ == "ConvolutionLayer":
         w = np.transpose(f32(params["W"]), (3, 2, 0, 1))  # HWIO -> OIHW
-        # DL4J convs ALWAYS carry a bias; a has_bias=False conv (conv+BN
-        # stacks like ResNet) exports a zero bias — numerically identical
+        # DL4J convs ALWAYS carry a bias (this 0.8-era reference predates
+        # the hasBias option entirely — no such field exists in its conf
+        # package); a has_bias=False conv (conv+BN stacks like ResNet)
+        # exports a zero bias — numerically identical
         b = (f32(params["b"]) if "b" in params
              else np.zeros((layer.n_out,), np.float32))
         return np.concatenate([b.ravel(), w.reshape(-1, order="C")])

@@ -493,6 +493,31 @@ class ComputationGraph:
             iterator, output_fn=self.output,
             predict_indices_fn=predict_indices)
 
+    def evaluate_regression(self, iterator: DataSetIterator):
+        """Reference: `ComputationGraph.evaluateRegression:2780`."""
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        from deeplearning4j_tpu.eval.regression import RegressionEvaluation
+
+        return Evaluation.run_evaluation(
+            RegressionEvaluation(), iterator, self.output)
+
+    def evaluate_roc(self, iterator: DataSetIterator,
+                     threshold_steps: int = 0):
+        """Binary ROC over the (single) output. Reference: evaluateROC."""
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        from deeplearning4j_tpu.eval.roc import ROC
+
+        return Evaluation.run_evaluation(
+            ROC(threshold_steps), iterator, self.output)
+
+    def evaluate_roc_multi_class(self, iterator: DataSetIterator):
+        """Reference: evaluateROCMultiClass."""
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        from deeplearning4j_tpu.eval.roc import ROCMultiClass
+
+        return Evaluation.run_evaluation(
+            ROCMultiClass(), iterator, self.output)
+
     # ----------------------------------------------------- param views
     def params(self) -> np.ndarray:
         flat, _ = flatten_params(self.params_tree)

@@ -489,6 +489,35 @@ class MultiLayerNetwork:
             iterator, output_fn=self.output,
             predict_indices_fn=predict_indices)
 
+    def evaluate_regression(self, iterator: DataSetIterator):
+        """Reference: `MultiLayerNetwork.evaluateRegression:2668`."""
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        from deeplearning4j_tpu.eval.regression import RegressionEvaluation
+
+        self._check_init()
+        return Evaluation.run_evaluation(
+            RegressionEvaluation(), iterator, self.output)
+
+    def evaluate_roc(self, iterator: DataSetIterator,
+                     threshold_steps: int = 0):
+        """Binary ROC. Reference: `evaluateROC:2679`."""
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        from deeplearning4j_tpu.eval.roc import ROC
+
+        self._check_init()
+        return Evaluation.run_evaluation(
+            ROC(threshold_steps), iterator, self.output)
+
+    def evaluate_roc_multi_class(self, iterator: DataSetIterator):
+        """One-vs-all ROC per class. Reference:
+        `evaluateROCMultiClass:2690`."""
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+        from deeplearning4j_tpu.eval.roc import ROCMultiClass
+
+        self._check_init()
+        return Evaluation.run_evaluation(
+            ROCMultiClass(), iterator, self.output)
+
     # ----------------------------------------------------- rnn stepping
     def rnn_time_step(self, x):
         """Stateful single-step inference; carries persist across calls.

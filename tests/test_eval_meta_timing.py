@@ -344,3 +344,135 @@ class TestRecordReaderMultiDataSetIterator:
                .build())
         with pytest.raises(ValueError, match="outside"):
             next(neg)
+
+
+class TestModelLevelEvaluators:
+    """Reference: MultiLayerNetwork.evaluateRegression:2668 /
+    evaluateROC:2679 / evaluateROCMultiClass:2690 (+ the CG twins)."""
+
+    def _class_net(self, n_out=2):
+        from deeplearning4j_tpu.models import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.optim.updaters import Adam
+
+        return MultiLayerNetwork(
+            NeuralNetConfiguration.builder().seed(0).updater(Adam(0.05))
+            .list(DenseLayer(n_in=4, n_out=16, activation="tanh"),
+                  OutputLayer(n_in=16, n_out=n_out, activation="softmax",
+                              loss="mcxent"))
+            .build()).init()
+
+    def test_evaluate_roc(self):
+        from deeplearning4j_tpu.data.iterators import ArrayDataSetIterator
+
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((200, 4)).astype(np.float32)
+        yi = (x[:, 0] > 0).astype(int)
+        y = np.eye(2, dtype=np.float32)[yi]
+        net = self._class_net()
+        net.fit(x, y, epochs=30, batch_size=64)
+        roc = net.evaluate_roc(ArrayDataSetIterator(x, y, 64))
+        assert roc.calculate_auc() > 0.9
+
+    def test_evaluate_roc_multi_class(self):
+        from deeplearning4j_tpu.data.datasets import load_iris
+        from deeplearning4j_tpu.data.iterators import ArrayDataSetIterator
+
+        x, y = load_iris()
+        net = self._class_net(n_out=3)
+        net.fit(x, y, epochs=40, batch_size=50)
+        roc = net.evaluate_roc_multi_class(ArrayDataSetIterator(x, y, 50))
+        assert roc.average_auc() > 0.9
+
+    def test_evaluate_regression(self):
+        from deeplearning4j_tpu.data.iterators import ArrayDataSetIterator
+        from deeplearning4j_tpu.models import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.optim.updaters import Adam
+
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((256, 3)).astype(np.float32)
+        y = (x @ np.array([[1.0], [2.0], [-1.0]], np.float32)
+             + 0.05 * rng.standard_normal((256, 1)).astype(np.float32))
+        net = MultiLayerNetwork(
+            NeuralNetConfiguration.builder().seed(0).updater(Adam(0.02))
+            .list(DenseLayer(n_in=3, n_out=16, activation="tanh"),
+                  OutputLayer(n_in=16, n_out=1, activation="identity",
+                              loss="mse"))
+            .build()).init()
+        net.fit(x, y, epochs=60, batch_size=64)
+        re = net.evaluate_regression(ArrayDataSetIterator(x, y, 64))
+        assert re.correlation_r2(0) > 0.8
+
+    def test_graph_twins_exist(self):
+        from deeplearning4j_tpu.models import ComputationGraph
+
+        for m in ("evaluate_regression", "evaluate_roc",
+                  "evaluate_roc_multi_class"):
+            assert hasattr(ComputationGraph, m)
+
+    def test_roc_honors_labels_mask(self):
+        """ROC doesn't understand masks; run_evaluation must drop masked
+        rows before feeding it."""
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.data.iterators import ArrayDataSetIterator
+
+        rng = np.random.default_rng(2)
+        x = rng.standard_normal((128, 4)).astype(np.float32)
+        yi = (x[:, 0] > 0).astype(int)
+        y = np.eye(2, dtype=np.float32)[yi]
+        net = self._class_net()
+        net.fit(x, y, epochs=30, batch_size=64)
+
+        # corrupt the last 64 labels, then mask them out — AUC must stay
+        # high because those rows are excluded
+        y_bad = y.copy()
+        y_bad[64:] = y_bad[64:][:, ::-1]
+        mask = np.ones(128, np.float32)
+        mask[64:] = 0
+
+        class It:
+            def __iter__(self):
+                yield DataSet(x, y_bad, None, mask)
+            def reset(self):
+                pass
+
+        roc = net.evaluate_roc(It())
+        assert roc.calculate_auc() > 0.9
+
+    def test_roc_multidataset_iterator(self, tmp_path):
+        """CG evaluators accept MultiDataSet iterators (first output)."""
+        from deeplearning4j_tpu.data.dataset import MultiDataSet
+        from deeplearning4j_tpu.models import ComputationGraph
+        from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.inputs import InputType
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.optim.updaters import Adam
+
+        g = (NeuralNetConfiguration.builder().seed(0).updater(Adam(0.05))
+             .graph_builder())
+        g.add_inputs("in")
+        g.set_input_types(InputType.feed_forward(4))
+        g.add_layer("h", DenseLayer(n_in=4, n_out=8, activation="tanh"),
+                    "in")
+        g.add_layer("out", OutputLayer(n_in=8, n_out=2,
+                                       activation="softmax", loss="mcxent"),
+                    "h")
+        g.set_outputs("out")
+        net = ComputationGraph(g.build()).init()
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((96, 4)).astype(np.float32)
+        yi = (x[:, 0] > 0).astype(int)
+        y = np.eye(2, dtype=np.float32)[yi]
+        net.fit(x, y, epochs=30, batch_size=32)
+
+        class It:
+            def __iter__(self):
+                yield MultiDataSet([x], [y])
+            def reset(self):
+                pass
+
+        roc = net.evaluate_roc(It())
+        assert roc.calculate_auc() > 0.9
