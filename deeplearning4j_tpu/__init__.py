@@ -29,17 +29,48 @@ Top-level subpackages
 - ``utils``     serde, pytree/param-view helpers, dtype policy
 """
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 from deeplearning4j_tpu.nn.inputs import InputType
 from deeplearning4j_tpu.nn.activations import Activation
 from deeplearning4j_tpu.nn.losses import LossFunction
 from deeplearning4j_tpu.nn.initializers import WeightInit
 
+
+def __getattr__(name):
+    """Lazy convenience access to the workhorse classes (keeps bare
+    `import deeplearning4j_tpu` light — no jax-heavy submodule import
+    until first use)."""
+    lazy = {
+        "NeuralNetConfiguration": ("deeplearning4j_tpu.nn.config",
+                                   "NeuralNetConfiguration"),
+        "MultiLayerNetwork": ("deeplearning4j_tpu.models",
+                              "MultiLayerNetwork"),
+        "ComputationGraph": ("deeplearning4j_tpu.models",
+                             "ComputationGraph"),
+        "Evaluation": ("deeplearning4j_tpu.eval", "Evaluation"),
+        "save_model": ("deeplearning4j_tpu.models.serialize", "save_model"),
+        "load_model": ("deeplearning4j_tpu.models.serialize", "load_model"),
+    }
+    if name in lazy:
+        import importlib
+
+        mod, attr = lazy[name]
+        return getattr(importlib.import_module(mod), attr)
+    raise AttributeError(f"module 'deeplearning4j_tpu' has no "
+                         f"attribute {name!r}")
+
+
 __all__ = [
     "InputType",
     "Activation",
     "LossFunction",
     "WeightInit",
+    "NeuralNetConfiguration",
+    "MultiLayerNetwork",
+    "ComputationGraph",
+    "Evaluation",
+    "save_model",
+    "load_model",
     "__version__",
 ]
