@@ -37,26 +37,29 @@ from deeplearning4j_tpu.nn.losses import LossFunction
 from deeplearning4j_tpu.nn.initializers import WeightInit
 
 
+_LAZY = {
+    "NeuralNetConfiguration": ("deeplearning4j_tpu.nn.config",
+                               "NeuralNetConfiguration"),
+    "MultiLayerNetwork": ("deeplearning4j_tpu.models", "MultiLayerNetwork"),
+    "ComputationGraph": ("deeplearning4j_tpu.models", "ComputationGraph"),
+    "Evaluation": ("deeplearning4j_tpu.eval", "Evaluation"),
+    "save_model": ("deeplearning4j_tpu.models.serialize", "save_model"),
+    "load_model": ("deeplearning4j_tpu.models.serialize", "load_model"),
+}
+
+
 def __getattr__(name):
-    """Lazy convenience access to the workhorse classes (keeps bare
-    `import deeplearning4j_tpu` light — no jax-heavy submodule import
-    until first use)."""
-    lazy = {
-        "NeuralNetConfiguration": ("deeplearning4j_tpu.nn.config",
-                                   "NeuralNetConfiguration"),
-        "MultiLayerNetwork": ("deeplearning4j_tpu.models",
-                              "MultiLayerNetwork"),
-        "ComputationGraph": ("deeplearning4j_tpu.models",
-                             "ComputationGraph"),
-        "Evaluation": ("deeplearning4j_tpu.eval", "Evaluation"),
-        "save_model": ("deeplearning4j_tpu.models.serialize", "save_model"),
-        "load_model": ("deeplearning4j_tpu.models.serialize", "load_model"),
-    }
-    if name in lazy:
+    """Lazy convenience access to the workhorse classes — avoids importing
+    the heavier models/eval/serialize modules (and their transitive deps)
+    until first use; resolved attributes are cached in the module dict so
+    repeat accesses are plain lookups."""
+    if name in _LAZY:
         import importlib
 
-        mod, attr = lazy[name]
-        return getattr(importlib.import_module(mod), attr)
+        mod, attr = _LAZY[name]
+        value = getattr(importlib.import_module(mod), attr)
+        globals()[name] = value
+        return value
     raise AttributeError(f"module 'deeplearning4j_tpu' has no "
                          f"attribute {name!r}")
 
