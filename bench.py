@@ -318,6 +318,48 @@ def _attempt_plans():
     return plans
 
 
+_LAST_TPU_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "bench_last_tpu.json")
+
+
+def _record_last_tpu(result):
+    """Persist the last REAL-TPU measurement PER METRIC (tracked in git on
+    purpose: a meaningful artifact like BENCH_r*.json, carried across
+    checkouts so a tunnel outage is distinguishable from a perf
+    regression; keying by metric keeps a lenet-fallback TPU run from
+    masquerading as the resnet50 baseline). Atomic replace so a crash
+    can't truncate the file."""
+    try:
+        blob = {k: result[k] for k in
+                ("metric", "value", "unit", "vs_baseline",
+                 "per_step_ms", "mfu", "batch", "device")
+                if k in result}
+        blob["recorded_at_unix"] = time.time()
+        records = _load_tpu_records()
+        records[blob["metric"]] = blob
+        tmp = _LAST_TPU_FILE + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(records, f)
+        os.replace(tmp, _LAST_TPU_FILE)
+    except OSError:
+        pass
+
+
+def _load_tpu_records():
+    try:
+        with open(_LAST_TPU_FILE) as f:
+            blob = json.load(f)
+    except (OSError, json.JSONDecodeError):
+        return {}
+    if "metric" in blob:      # legacy single-record layout
+        return {blob["metric"]: blob}
+    return blob
+
+
+def _load_last_tpu(metric):
+    return _load_tpu_records().get(metric)
+
+
 def main():
     if os.environ.get("BENCH_CHILD"):
         _child_main()
@@ -358,6 +400,21 @@ def main():
             result["config"] = label
             if errors:
                 result["prior_errors"] = errors
+            if result.get("platform") == "tpu":
+                _record_last_tpu(result)
+            else:
+                # degraded (CPU-fallback) number: attach the last verified
+                # TPU measurement so an environmental tunnel outage isn't
+                # mistaken for a performance regression
+                # attach the PRIMARY model's verified-TPU record (what
+                # the degraded run failed to re-measure), not the
+                # fallback rung's own metric
+                model = os.environ.get("BENCH_MODEL", "resnet50")
+                primary_metric = _BENCHES.get(
+                    model, _BENCHES["resnet50"])[1]
+                last = _load_last_tpu(primary_metric)
+                if last:
+                    result["last_verified_tpu"] = last
             print(json.dumps(result))
             return
         tail = (proc.stderr or proc.stdout or "").strip().splitlines()
@@ -370,13 +427,17 @@ def main():
     # driver records WHY instead of a bare rc=1 like round 1.
     model = os.environ.get("BENCH_MODEL", "resnet50")
     _, metric, unit, _ = _BENCHES.get(model, _BENCHES["resnet50"])
-    print(json.dumps({
+    out = {
         "metric": metric,
         "value": 0.0,
         "unit": unit,
         "vs_baseline": 0.0,
         "error": errors,
-    }))
+    }
+    last = _load_last_tpu(metric)
+    if last:
+        out["last_verified_tpu"] = last
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":
