@@ -23,7 +23,7 @@ divergence."""
 from __future__ import annotations
 
 import threading
-from typing import Any, Dict, List, Optional
+from typing import List, Optional
 
 import jax
 import jax.numpy as jnp

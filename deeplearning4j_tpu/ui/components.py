@@ -17,7 +17,7 @@ from __future__ import annotations
 import dataclasses
 import json
 from html import escape
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from typing import Any, Dict, List, Sequence, Tuple
 
 COMPONENT_REGISTRY: Dict[str, type] = {}
 

@@ -28,7 +28,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
 from deeplearning4j_tpu.ui.components import (
-    ChartHistogram, ChartLine, ChartScatter, ComponentDiv, ComponentTable,
+    ChartLine, ChartScatter, ComponentDiv, ComponentTable,
     DecoratorAccordion, Style, histogram_component,
 )
 from deeplearning4j_tpu.ui.storage import (
