@@ -1,0 +1,144 @@
+"""Custom-extension plug-in contracts (SURVEY §4: the reference's
+custom-layer/updater/activation tests — `nn/layers/custom/testclasses/`,
+`nn/updater/custom/`): user-defined classes register through the same
+seams the built-ins use and work end-to-end, including JSON serde."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn.activations import Activation
+from deeplearning4j_tpu.nn.config import (
+    MultiLayerConfiguration, NeuralNetConfiguration,
+)
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.nn.layers.base import (
+    LAYER_REGISTRY, Layer, register_layer,
+)
+from deeplearning4j_tpu.optim.updaters import Updater, resolve_updater
+from deeplearning4j_tpu.utils.serde import register_serde
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
+class ScaledTanhLayer(Layer):
+    """Test custom layer: y = scale * tanh(x W) (reference analog:
+    nn/layers/custom/testclasses/CustomLayer)."""
+
+    n_in: int = 0
+    n_out: int = 0
+    scale: float = 2.0
+
+    def infer_n_in(self, input_type):
+        if not self.n_in:
+            return dataclasses.replace(self, n_in=input_type.size)
+        return self
+
+    def output_type(self, input_type):
+        from deeplearning4j_tpu.nn.inputs import InputType
+        return InputType.feed_forward(self.n_out)
+
+    def init_params(self, key, input_type, dtype=jnp.float32):
+        return {"W": self._winit()(key, (self.n_in, self.n_out), dtype)}, {}
+
+    def apply(self, params, x, *, state=None, train=False, rng=None,
+              mask=None):
+        return self.scale * jnp.tanh(x @ params["W"]), state
+
+
+@register_serde
+@dataclasses.dataclass(frozen=True)
+class HalvingSgd(Updater):
+    """Test custom updater (reference analog: nn/updater/custom/
+    CustomIUpdater): plain SGD at half the configured rate."""
+
+    learning_rate: float = 0.1
+
+    def apply(self, grads, state, params, step):
+        lr = 0.5 * self.learning_rate
+        return jax.tree_util.tree_map(lambda g: lr * g, grads), state
+
+
+class TestCustomLayer:
+    def _net(self):
+        return MultiLayerNetwork(
+            NeuralNetConfiguration.builder().seed(0)
+            .list(ScaledTanhLayer(n_in=4, n_out=8, scale=3.0),
+                  OutputLayer(n_in=8, n_out=2, activation="softmax",
+                              loss="mcxent"))
+            .build()).init()
+
+    def test_registered_and_trains(self):
+        assert "ScaledTanhLayer" in LAYER_REGISTRY
+        net = self._net()
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((64, 4)).astype(np.float32)
+        yi = (x[:, 0] > 0).astype(int)
+        y = np.eye(2, dtype=np.float32)[yi]
+        s0 = net.score(x, y)
+        net.fit(x, y, epochs=15, batch_size=32)
+        assert net.score(x, y) < s0
+
+    def test_custom_layer_json_roundtrip(self):
+        net = self._net()
+        conf2 = MultiLayerConfiguration.from_json(net.conf.to_json())
+        layer = conf2.layers[0]
+        assert isinstance(layer, ScaledTanhLayer)
+        assert layer.scale == 3.0
+        net2 = MultiLayerNetwork(conf2).init()
+        net2.params_tree = net.params_tree
+        x = np.random.default_rng(1).standard_normal((3, 4)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(net2.output(x)),
+                                   np.asarray(net.output(x)), rtol=1e-6)
+
+    def test_custom_forward_math(self):
+        net = self._net()
+        x = np.random.default_rng(2).standard_normal((5, 4)).astype(np.float32)
+        w = np.asarray(net.params_tree[net.conf.layers[0].name]["W"])
+        acts = net.feed_forward(x)
+        np.testing.assert_allclose(np.asarray(acts[0]),
+                                   3.0 * np.tanh(x @ w), rtol=1e-5)
+
+
+class TestCustomUpdater:
+    def test_resolves_and_halves_updates(self):
+        u = resolve_updater(HalvingSgd(0.2))
+        params = {"w": jnp.ones((3,))}
+        upd, _ = u.apply({"w": jnp.ones((3,))}, u.init(params), params, 0)
+        np.testing.assert_allclose(np.asarray(upd["w"]), 0.1)
+
+    def test_trains_through_builder(self):
+        net = MultiLayerNetwork(
+            NeuralNetConfiguration.builder().seed(0)
+            .updater(HalvingSgd(0.2))
+            .list(DenseLayer(n_in=4, n_out=8, activation="tanh"),
+                  OutputLayer(n_in=8, n_out=2, activation="softmax",
+                              loss="mcxent"))
+            .build()).init()
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((64, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[(x[:, 0] > 0).astype(int)]
+        s0 = net.score(x, y)
+        net.fit(x, y, epochs=20, batch_size=32)
+        assert net.score(x, y) < s0
+
+
+class TestCustomActivation:
+    def test_register_and_use(self):
+        Activation.register("doubled_tanh", lambda x: 2.0 * jnp.tanh(x))
+        net = MultiLayerNetwork(
+            NeuralNetConfiguration.builder().seed(0)
+            .list(DenseLayer(n_in=4, n_out=8, activation="doubled_tanh"),
+                  OutputLayer(n_in=8, n_out=2, activation="softmax",
+                              loss="mcxent"))
+            .build()).init()
+        x = np.random.default_rng(4).standard_normal((3, 4)).astype(np.float32)
+        w = np.asarray(net.params_tree[net.conf.layers[0].name]["W"])
+        b = np.asarray(net.params_tree[net.conf.layers[0].name]["b"])
+        acts = net.feed_forward(x)
+        np.testing.assert_allclose(np.asarray(acts[0]),
+                                   2.0 * np.tanh(x @ w + b), rtol=1e-5)
