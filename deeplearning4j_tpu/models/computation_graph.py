@@ -241,28 +241,31 @@ class ComputationGraph:
         return jax.jit(step_fn, donate_argnums=(0, 1, 2))
 
     # ---------------------------------------------------- data plumbing
-    def _to_dicts(self, ds: Union[DataSet, MultiDataSet]):
-        """Map a DataSet/MultiDataSet onto named inputs/outputs by order."""
+    def _to_dicts(self, ds: Union[DataSet, MultiDataSet], host: bool = False):
+        """Map a DataSet/MultiDataSet onto named inputs/outputs by order.
+        `host=True` keeps leaves as numpy (multi-controller feeding: the
+        caller lifts them into global arrays in one upload)."""
+        asarray = np.asarray if host else jnp.asarray
         ins = self.conf.network_inputs
         outs = self.conf.network_outputs
         if isinstance(ds, MultiDataSet):
-            feats = {n: jnp.asarray(f, self.dtype)
+            feats = {n: asarray(f, self.dtype)
                      for n, f in zip(ins, ds.features)}
-            labs = {n: jnp.asarray(l) for n, l in zip(outs, ds.labels)}
+            labs = {n: asarray(l) for n, l in zip(outs, ds.labels)}
             fmasks = {}
             if ds.features_masks:
-                fmasks = {n: jnp.asarray(m) for n, m in
+                fmasks = {n: asarray(m) for n, m in
                           zip(ins, ds.features_masks) if m is not None}
             lmasks = {}
             if ds.labels_masks:
-                lmasks = {n: jnp.asarray(m) for n, m in
+                lmasks = {n: asarray(m) for n, m in
                           zip(outs, ds.labels_masks) if m is not None}
             return feats, labs, fmasks or None, lmasks or None
-        feats = {ins[0]: jnp.asarray(ds.features, self.dtype)}
-        labs = {outs[0]: jnp.asarray(ds.labels)} if ds.labels is not None else {}
-        fmasks = ({ins[0]: jnp.asarray(ds.features_mask)}
+        feats = {ins[0]: asarray(ds.features, self.dtype)}
+        labs = {outs[0]: asarray(ds.labels)} if ds.labels is not None else {}
+        fmasks = ({ins[0]: asarray(ds.features_mask)}
                   if ds.features_mask is not None else None)
-        lmasks = ({outs[0]: jnp.asarray(ds.labels_mask)}
+        lmasks = ({outs[0]: asarray(ds.labels_mask)}
                   if ds.labels_mask is not None else None)
         return feats, labs, fmasks, lmasks
 

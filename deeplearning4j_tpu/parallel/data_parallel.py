@@ -25,6 +25,9 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
 from deeplearning4j_tpu.data.iterators import as_iterator
+from deeplearning4j_tpu.parallel.distributed import (
+    put_global, put_global_batch,
+)
 from deeplearning4j_tpu.parallel.mesh import AXIS_DATA, make_mesh
 from deeplearning4j_tpu.parallel.sharding import ShardingRules
 
@@ -72,14 +75,21 @@ class ParallelWrapper:
             raise ValueError(
                 f"Mesh {self.mesh.axis_names} has no {batch_axis!r} axis")
         self.data_size = self.mesh.shape[batch_axis]
+        # Multi-controller: each process feeds a host-LOCAL slice of every
+        # batch; padding must make the local slice divide the local devices.
+        self._nproc = jax.process_count()
+        self._local_divisor = max(1, self.data_size // self._nproc)
 
         self._rep = NamedSharding(self.mesh, P())
         self._params_sh = self._param_tree_sharding(net.params_tree)
         self._opt_sh = self._param_tree_sharding(net.updater_state)
-        net.params_tree = jax.device_put(net.params_tree, self._params_sh)
-        net.updater_state = jax.device_put(net.updater_state, self._opt_sh)
+        net.params_tree = jax.tree_util.tree_map(
+            put_global, net.params_tree, self._params_sh)
+        net.updater_state = jax.tree_util.tree_map(
+            put_global, net.updater_state, self._opt_sh)
         if net.state_tree:
-            net.state_tree = jax.device_put(net.state_tree, self._rep)
+            net.state_tree = jax.tree_util.tree_map(
+                lambda x: put_global(x, self._rep), net.state_tree)
 
     # ------------------------------------------------------- shardings
     def _param_tree_sharding(self, tree):
@@ -142,10 +152,11 @@ class ParallelWrapper:
 
     # -------------------------------------------------------------- fit
     def _pad_to_divisible(self, ds):
+        div = self._local_divisor if self._nproc > 1 else self.data_size
         b = ds.num_examples()
-        if b % self.data_size == 0:
+        if b % div == 0:
             return ds
-        pad = self.data_size - (b % self.data_size)
+        pad = div - (b % div)
         idx = np.concatenate([np.arange(b), np.zeros(pad, np.int64)])
         if isinstance(ds, MultiDataSet):
             return MultiDataSet(
@@ -164,6 +175,13 @@ class ParallelWrapper:
             stop_fn=None):
         """Reference: `ParallelWrapper.fit(DataSetIterator):409`. Partial
         final batches are padded by repetition to keep XLA shapes static.
+
+        Multi-controller (jax.process_count() > 1): `data` and
+        `batch_size` are PER-PROCESS — each controller feeds its host-local
+        slice and the global batch is their concatenation in process order
+        (global batch = batch_size * process_count). Pass GLOBAL sizes to
+        DistributedTrainingMaster.execute_training instead, which shards
+        and divides for you.
 
         `checkpointer` (a ShardedCheckpointer) saves sharded snapshots every
         `checkpoint_every` iterations, async. `resume` takes the position
@@ -225,12 +243,30 @@ class ParallelWrapper:
             checkpointer.wait()
         return net
 
+    def _put_batch(self, x):
+        """Multi-controller feed: lift this process's local slice into the
+        global batch array (concatenation over processes)."""
+        if x is None:
+            return None
+        if isinstance(x, dict):
+            return {k: self._put_batch(v) for k, v in x.items()}
+        return put_global_batch(x, self._batch_sharding_like(x))
+
     def _step(self, ds) -> float:
         net = self.net
         net._rng, k = jax.random.split(net._rng)
-        step = jnp.asarray(net.iteration, jnp.int32)
+        if self._nproc > 1:
+            step = put_global(np.int32(net.iteration), self._rep)
+            k = put_global(k, self._rep)
+        else:
+            step = jnp.asarray(net.iteration, jnp.int32)
         if self._graph:
-            feats, labs, fms, lms = net._to_dicts(ds)
+            feats, labs, fms, lms = net._to_dicts(ds, host=self._nproc > 1)
+            if self._nproc > 1:
+                feats, labs, fms, lms = (self._put_batch(feats),
+                                         self._put_batch(labs),
+                                         self._put_batch(fms),
+                                         self._put_batch(lms))
             args = (net.params_tree, net.updater_state, net.state_tree, step,
                     feats, labs, fms, lms, k)
             key = ("g", tuple(sorted(feats)), tuple(sorted(labs)),
@@ -239,14 +275,23 @@ class ParallelWrapper:
             (net.params_tree, net.updater_state, net.state_tree, loss
              ) = fn(*args)
         else:
+            # Multi-controller: keep the local slice on host (numpy) so
+            # put_global_batch uploads once — no device round-trip.
+            conv = (lambda a, dt=None: np.asarray(a, dt)) if self._nproc > 1 \
+                else jnp.asarray
+            feats = conv(ds.features, net.dtype)
+            labs = None if ds.labels is None else conv(ds.labels)
+            fm = (None if ds.features_mask is None
+                  else conv(ds.features_mask))
+            lm = (None if ds.labels_mask is None
+                  else conv(ds.labels_mask))
+            if self._nproc > 1:
+                feats, labs, fm, lm = (self._put_batch(feats),
+                                       self._put_batch(labs),
+                                       self._put_batch(fm),
+                                       self._put_batch(lm))
             args = (net.params_tree, net.updater_state, net.state_tree, step,
-                    jnp.asarray(ds.features, net.dtype),
-                    None if ds.labels is None else jnp.asarray(ds.labels),
-                    None if ds.features_mask is None
-                    else jnp.asarray(ds.features_mask),
-                    None if ds.labels_mask is None
-                    else jnp.asarray(ds.labels_mask),
-                    k, None)
+                    feats, labs, fm, lm, k, None)
             key = ("m", ds.features.ndim,
                    0 if ds.labels is None else ds.labels.ndim,
                    ds.features_mask is not None, ds.labels_mask is not None)

@@ -67,3 +67,42 @@ def sync_global_devices(tag: str = "barrier") -> None:
     from jax.experimental import multihost_utils
 
     multihost_utils.sync_global_devices(tag)
+
+
+def put_global(x, sharding):
+    """device_put that works in multi-controller mode.
+
+    Single-process this is `jax.device_put`. Multi-process, each host is
+    assumed to hold the SAME full value `x` (replicated params, scalars,
+    rng keys), and each process supplies only its addressable shards —
+    the multi-controller analogue of the reference's driver->executor
+    parameter broadcast (`ParameterAveragingTrainingMaster.java`
+    processResults re-broadcast)."""
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    try:
+        typed_key = jax.numpy.issubdtype(x.dtype, jax.dtypes.prng_key)
+    except Exception:
+        typed_key = False
+    if typed_key:  # typed PRNG keys: round-trip through raw key data
+        data = np.asarray(jax.random.key_data(x))
+        raw = jax.make_array_from_callback(
+            data.shape, sharding, lambda idx: data[idx])
+        return jax.random.wrap_key_data(raw)
+    x = np.asarray(x)
+    return jax.make_array_from_callback(
+        x.shape, sharding, lambda idx: x[idx])
+
+
+def put_global_batch(local, sharding):
+    """Assemble a GLOBAL batch from per-process local arrays.
+
+    Each process passes its `host_local_shard` slice; the global array is
+    their concatenation in process order along the sharded (batch) axis.
+    This is the input-feeding contract of multi-controller SPMD: no host
+    ever materializes the global batch (the reference instead ships
+    serialized DataSets through Spark; SURVEY §3.4)."""
+    if jax.process_count() == 1:
+        return jax.device_put(local, sharding)
+    return jax.make_array_from_process_local_data(
+        sharding, np.asarray(local))

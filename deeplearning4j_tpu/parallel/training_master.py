@@ -247,7 +247,8 @@ class DistributedTrainingMaster(TrainingMaster):
             host_local_shard, process_count,
         )
 
-        if process_count() > 1:
+        nproc = process_count()
+        if nproc > 1:
             if labels is None:
                 # Iterators/DataSets carry no global index to shard by;
                 # feeding them unsharded would silently duplicate every
@@ -258,6 +259,14 @@ class DistributedTrainingMaster(TrainingMaster):
                     "host_local_shard; pre-shard iterator inputs manually")
             sl = host_local_shard(len(data))
             data, labels = data[sl], labels[sl]
+            # batch_size is the GLOBAL batch: each process iterates its
+            # shard in host-local slices; ParallelWrapper._put_batch
+            # reassembles the global array (concatenation over processes).
+            if batch_size % nproc:
+                raise ValueError(
+                    f"global batch_size {batch_size} must divide over "
+                    f"{nproc} processes")
+            batch_size //= nproc
         start_ms = 0.0
         if self.collect_stats:
             from deeplearning4j_tpu.utils.timesource import (

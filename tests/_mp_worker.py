@@ -1,0 +1,126 @@
+"""Multi-controller worker process for test_distributed_multiprocess.py.
+
+Run as `python tests/_mp_worker.py` with env:
+  MP_NPROC / MP_PID / MP_DEVS   — process grid + local virtual devices
+  JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID — picked up
+      by initialize_distributed() (the env-var path under test)
+  MP_OUTDIR                     — shared scratch dir (checkpoints, results)
+
+This is the reference's "distributed without a cluster" strategy (Spark
+`local[N]` — spark/BaseSparkTest.java:89) mapped to JAX's multi-controller
+runtime: N real OS processes, each with a few virtual CPU devices, wired by
+`jax.distributed.initialize` over a localhost coordinator. Everything that
+would run on a real multi-host pod slice runs here: global mesh over all
+processes' devices, per-process host_local_shard feeding, cross-process
+collectives inside the jitted step, and the sharded checkpointer writing
+one `process-<k>/` directory per host.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+devs = int(os.environ.get("MP_DEVS", "2"))
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + f" --xla_force_host_platform_device_count={devs}").strip()
+
+import jax  # noqa: E402
+
+# sitecustomize pins jax_platforms to "axon,cpu"; re-pin AFTER import.
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from deeplearning4j_tpu import InputType  # noqa: E402
+from deeplearning4j_tpu.models import MultiLayerNetwork  # noqa: E402
+from deeplearning4j_tpu.nn.config import NeuralNetConfiguration  # noqa: E402
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer  # noqa: E402
+from deeplearning4j_tpu.optim.updaters import Sgd  # noqa: E402
+from deeplearning4j_tpu.parallel import ParallelWrapper, make_mesh  # noqa: E402
+from deeplearning4j_tpu.parallel.checkpoint import (  # noqa: E402
+    ShardedCheckpointer,
+)
+from deeplearning4j_tpu.parallel.distributed import (  # noqa: E402
+    initialize_distributed, process_count, process_index,
+    sync_global_devices,
+)
+from deeplearning4j_tpu.parallel.training_master import (  # noqa: E402
+    DistributedTrainingMaster,
+)
+
+N, D, CLASSES, BATCH, EPOCHS = 64, 8, 4, 16, 2
+
+
+def make_data():
+    rng = np.random.default_rng(123)
+    x = rng.standard_normal((N, D)).astype(np.float32)
+    w = rng.standard_normal((D, CLASSES))
+    y = np.eye(CLASSES, dtype=np.float32)[(x @ w).argmax(-1)]
+    return x, y
+
+
+def make_net():
+    return MultiLayerNetwork(
+        (NeuralNetConfiguration.builder()
+         .seed(7).updater(Sgd(0.1)).activation("tanh")
+         .list(DenseLayer(n_out=16),
+               OutputLayer(n_out=CLASSES, activation="softmax"))
+         .set_input_type(InputType.feed_forward(D))
+         .build())).init()
+
+
+def main():
+    nproc = int(os.environ["MP_NPROC"])
+    pid = int(os.environ["MP_PID"])
+    outdir = os.environ["MP_OUTDIR"]
+
+    initialize_distributed()  # env-var path: JAX_COORDINATOR_ADDRESS etc.
+    assert process_count() == nproc, (process_count(), nproc)
+    assert process_index() == pid, (process_index(), pid)
+    assert len(jax.devices()) == nproc * devs, jax.devices()
+    assert len(jax.local_devices()) == devs
+
+    x, y = make_data()
+    net = make_net()
+
+    master = DistributedTrainingMaster(mesh=make_mesh({"data": -1}),
+                                       collect_training_stats=True)
+    master.execute_training(net, x, y, batch_size=BATCH, epochs=EPOCHS)
+    stats = master.training_stats()
+    assert stats and np.isfinite(stats[-1].score), stats
+
+    # Sharded checkpoint: every process writes its own process-<k>/ dir.
+    ckpt = ShardedCheckpointer(os.path.join(outdir, "ckpt"), async_save=False)
+    ckpt.save(net, step=net.iteration, position={"batch_in_epoch": 0})
+    sync_global_devices("ckpt-written")
+
+    # Cross-process restore INSIDE the pod: a fresh model + wrapper on this
+    # same process grid restores the union of all processes' manifests.
+    net2 = make_net()
+    pw2 = ParallelWrapper(net2, mesh=make_mesh({"data": -1}),
+                          prefetch_buffer=0)
+    ckpt2 = ShardedCheckpointer(os.path.join(outdir, "ckpt"))
+    ckpt2.restore_into_wrapper(pw2)
+    for a, b in zip(jax.tree_util.tree_leaves(net.params_tree),
+                    jax.tree_util.tree_leaves(net2.params_tree)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-6, atol=1e-7)
+    assert net2.iteration == net.iteration
+
+    if pid == 0:
+        flat = {f"p{i}": np.asarray(l) for i, l in
+                enumerate(jax.tree_util.tree_leaves(net.params_tree))}
+        np.savez(os.path.join(outdir, "final_params.npz"),
+                 score=np.float64(net.score_),
+                 iteration=np.int64(net.iteration), **flat)
+    sync_global_devices("done")
+    print(f"WORKER_OK pid={pid} score={net.score_:.6f} "
+          f"iters={net.iteration}")
+
+
+if __name__ == "__main__":
+    main()
