@@ -27,6 +27,33 @@ class RecordReader:
     def reset(self):
         pass
 
+    def load_from_meta_data(self, metas) -> List[List]:
+        """Re-read the ORIGINAL records behind RecordMetaData entries
+        (locations are record indices assigned by the consuming iterator).
+        Reference: DataVec `RecordReader.loadFromMetaData` — what powers
+        `Prediction.getRecord()`-style 'show me the misclassified
+        example' workflows."""
+        src = str(getattr(self, "path", type(self).__name__))
+        wrong = [m for m in metas if m.source != src]
+        if wrong:
+            # index-only matching against a DIFFERENT source would silently
+            # return unrelated records (DataVec matches by URI)
+            raise ValueError(
+                f"RecordMetaData source {wrong[0].source!r} does not match "
+                f"this reader ({src!r})")
+        wanted = {int(m.location) for m in metas}
+        found: Dict[int, List] = {}
+        for i, rec in enumerate(self):
+            if i in wanted:
+                found[i] = rec
+                if len(found) == len(wanted):
+                    break
+        missing = wanted - found.keys()
+        if missing:
+            raise KeyError(
+                f"records {sorted(missing)} not found in {self!r}")
+        return [found[int(m.location)] for m in metas]
+
 
 class CSVRecordReader(RecordReader):
     """Reference: DataVec CSVRecordReader."""
@@ -153,27 +180,46 @@ class RecordReaderDataSetIterator(DataSetIterator):
             if metas is not None:
                 metas.append(RecordMetaData(src, self._record_index))
             self._record_index += 1
-            if isinstance(rec[0], np.ndarray):  # image record
-                feats.append(rec[0])
-                labs.append(rec[1])
-            else:
-                vals = [float(v) for v in rec]
-                li = self.label_index if self.label_index >= 0 \
-                    else len(vals) - 1
-                labs.append(vals[li])
-                feats.append(vals[:li] + vals[li + 1:])
+            self._append_parsed(rec, feats, labs)
         if not feats:
             self._it = None
             raise StopIteration
         self.last_meta = metas
+        return self._to_dataset(feats, labs)
+
+    def _append_parsed(self, rec, feats, labs):
+        if isinstance(rec[0], np.ndarray):  # image record
+            feats.append(rec[0])
+            labs.append(rec[1])
+        else:
+            vals = [float(v) for v in rec]
+            li = self.label_index if self.label_index >= 0 \
+                else len(vals) - 1
+            labs.append(vals[li])
+            feats.append(vals[:li] + vals[li + 1:])
+
+    def _to_dataset(self, feats, labs) -> DataSet:
         x = np.asarray(feats, np.float32)
         if self.regression:
             y = np.asarray(labs, np.float32).reshape(len(labs), -1)
         else:
             idx = np.asarray(labs, np.int64)
-            n = self.num_classes or int(idx.max()) + 1
+            # sticky width: once a class is seen, every later batch (and
+            # load_from_meta_data subsets) one-hots to the same width
+            n = max(self.num_classes or 0, int(idx.max()) + 1)
+            self.num_classes = n
             y = np.eye(n, dtype=np.float32)[idx]
         return DataSet(x, y)
+
+    def load_from_meta_data(self, metas) -> DataSet:
+        """Rebuild the exact (features, labels) DataSet for specific
+        RecordMetaData entries — e.g. `ev.get_prediction_errors()` →
+        inspect the misclassified inputs. Reference:
+        `RecordReaderDataSetIterator.loadFromMetaData`."""
+        feats, labs = [], []
+        for rec in self.reader.load_from_meta_data(metas):
+            self._append_parsed(rec, feats, labs)
+        return self._to_dataset(feats, labs)
 
     @property
     def batch_size(self):

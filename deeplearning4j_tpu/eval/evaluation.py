@@ -166,12 +166,20 @@ class Evaluation:
         ComputationGraph): device-side argmax fast path for plain
         per-example labels (only int32 indices cross to host via
         `predict_indices_fn(features) -> (indices, head_width)`), full
-        softmax through `output_fn` for masked/time-series labels."""
+        softmax through `output_fn` for masked/time-series labels.
+
+        If the iterator collects RecordMetaData (`set_collect_meta_data` —
+        `last_meta` set per batch), it flows into per-example Prediction
+        records, so `get_prediction_errors()` works straight off
+        `model.evaluate(it)` (reference: MultiLayerNetwork.doEvaluation
+        passing meta into eval(labels, out, meta))."""
         for ds in iterator:
             labels = np.asarray(ds.labels)
+            meta = getattr(iterator, "last_meta", None)
             if labels.ndim == 3 or ds.labels_mask is not None:
                 self.eval(labels, np.asarray(output_fn(ds.features)),
-                          mask=ds.labels_mask)
+                          mask=ds.labels_mask,
+                          record_meta=None if labels.ndim == 3 else meta)
                 continue
             pred, width = predict_indices_fn(ds.features)
             actual = (labels.argmax(-1) if labels.ndim == 2
@@ -179,7 +187,8 @@ class Evaluation:
             # class count from the one-hot width, else the model head —
             # a batch missing high classes must not shrink the matrix
             n = labels.shape[-1] if labels.ndim == 2 else width
-            self.eval_indices(actual, np.asarray(pred), num_classes=n)
+            self.eval_indices(actual, np.asarray(pred), num_classes=n,
+                              record_meta=meta)
         return self
 
     # ---- per-example accessors (reference: eval/meta + Evaluation
@@ -193,6 +202,24 @@ class Evaluation:
 
     def get_predictions_by_predicted_class(self, cls: int) -> list:
         return [p for p in self.predictions if p.predicted == cls]
+
+    def get_predictions(self, actual: int, predicted: int) -> list:
+        """Prediction records in one confusion cell. Reference:
+        `Evaluation.getPredictions(actualClass, predictedClass)`."""
+        return [p for p in self.predictions
+                if p.actual == actual and p.predicted == predicted]
+
+    def get_top_n_confusions(self, n: int = 5) -> list:
+        """Most frequent OFF-diagonal (actual, predicted, count) cells,
+        descending — 'what does the model confuse most'. Works off the
+        confusion matrix, so it needs no RecordMetaData collection."""
+        if self.confusion is None:
+            return []
+        m = self.confusion.matrix.copy()
+        np.fill_diagonal(m, 0)
+        pairs = np.argwhere(m > 0)
+        order = sorted(pairs.tolist(), key=lambda ij: -m[ij[0], ij[1]])
+        return [(int(a), int(p), int(m[a, p])) for a, p in order[:n]]
 
     # ---- metrics (reference method names) ----
     def _tp(self, c):

@@ -481,20 +481,103 @@ class ComputationGraph:
             return [np.asarray(jnp.argmax(o, -1)) for o in out]
         return np.asarray(jnp.argmax(out, -1))
 
-    def evaluate(self, iterator: DataSetIterator):
-        """Single-output evaluation; argmax happens ON DEVICE for plain
-        per-example labels (only int32 indices cross to host), matching
-        MultiLayerNetwork.evaluate. Reference:
-        `ComputationGraph.evaluate(DataSetIterator)`."""
+    def evaluate(self, iterator: DataSetIterator,
+                 output_name: Optional[str] = None):
+        """Classification evaluation of one head (default: first output),
+        with the device-side argmax fast path for plain per-example labels
+        (only int32 indices cross to host) — matching
+        MultiLayerNetwork.evaluate. `output_name` selects a specific head
+        of a multi-output graph (beyond the reference, whose
+        `ComputationGraph.evaluate(DataSetIterator)` is first-output-only).
+        Accepts DataSet batches (labels belong to the selected head) or
+        MultiDataSet batches (labels matched to outputs by position).
+        RecordMetaData from a meta-collecting iterator flows into
+        per-example Prediction records."""
         from deeplearning4j_tpu.eval.evaluation import Evaluation
 
-        def predict_indices(feats):
-            out = self.output(feats)
-            return jnp.argmax(out, axis=-1), int(out.shape[-1])
+        order = list(self.conf.network_outputs)
+        idx = 0
+        if output_name is not None:
+            if output_name not in order:
+                raise ValueError(
+                    f"Unknown output {output_name!r}; graph outputs: {order}")
+            idx = order.index(output_name)
 
-        return Evaluation().evaluate_iterator(
-            iterator, output_fn=self.output,
-            predict_indices_fn=predict_indices)
+        def head(out):
+            return out[idx] if isinstance(out, list) else out
+
+        ev = Evaluation()
+        for ds in iterator:
+            meta = getattr(iterator, "last_meta", None)
+            if isinstance(ds, MultiDataSet):
+                feats = list(ds.features)
+                lab = np.asarray(ds.labels[idx])
+                mask = ds.labels_masks[idx] if ds.labels_masks else None
+            else:
+                feats = [ds.features]
+                lab = np.asarray(ds.labels)
+                mask = ds.labels_mask
+            if lab.ndim == 3 or mask is not None:
+                ev.eval(lab, np.asarray(head(self.output(*feats))),
+                        mask=mask,
+                        record_meta=None if lab.ndim == 3 else meta)
+                continue
+            o = head(self.output(*feats))
+            pred = jnp.argmax(o, axis=-1)       # argmax on device
+            actual = (lab.argmax(-1) if lab.ndim == 2
+                      else lab.astype(np.int64))
+            n = lab.shape[-1] if lab.ndim == 2 else int(o.shape[-1])
+            ev.eval_indices(actual, np.asarray(pred), num_classes=n,
+                            record_meta=meta)
+        return ev
+
+    def evaluate_outputs(self, iterator,
+                         output_names: Optional[Sequence[str]] = None
+                         ) -> Dict[str, "Evaluation"]:
+        """Per-output metrics for multi-output graphs in ONE forward pass
+        per batch: returns {output_name: Evaluation}. Accepts DataSet
+        (single-output graphs) or MultiDataSet iterators (labels matched
+        to outputs by position, the _to_dicts ordering). RecordMetaData
+        from a meta-collecting iterator flows into every head's
+        Prediction records. Reference: `nn/graph/ComputationGraph.java`
+        evaluate family (single-output) — multi-output eval is a
+        capability extension."""
+        from deeplearning4j_tpu.data.dataset import MultiDataSet
+        from deeplearning4j_tpu.eval.evaluation import Evaluation
+
+        order = list(self.conf.network_outputs)
+        names = list(output_names) if output_names is not None else order
+        for n in names:
+            if n not in order:
+                raise ValueError(
+                    f"Unknown output {n!r}; graph outputs: {order}")
+        evals = {n: Evaluation() for n in names}
+        for ds in iterator:
+            if isinstance(ds, MultiDataSet):
+                feats = [np.asarray(f) for f in ds.features]
+                labels = {n: ds.labels[order.index(n)] for n in names}
+                masks = ({n: ds.labels_masks[order.index(n)] for n in names}
+                         if ds.labels_masks else {n: None for n in names})
+            else:
+                if len(order) > 1 and len(names) != 1:
+                    raise ValueError(
+                        "DataSet batches carry ONE labels array; evaluating "
+                        f"{len(names)} heads of a multi-output graph needs "
+                        "MultiDataSet batches (labels per output)")
+                # single head requested: the DataSet's labels are its labels
+                feats = [ds.features]
+                labels = {n: ds.labels for n in names}
+                masks = {n: ds.labels_mask for n in names}
+            outs = self.output(*feats)
+            if not isinstance(outs, list):
+                outs = [outs]
+            meta = getattr(iterator, "last_meta", None)
+            for n in names:
+                lab = np.asarray(labels[n])
+                evals[n].eval(
+                    lab, np.asarray(outs[order.index(n)]), mask=masks[n],
+                    record_meta=None if lab.ndim == 3 else meta)
+        return evals
 
     def evaluate_regression(self, iterator: DataSetIterator):
         """Reference: `ComputationGraph.evaluateRegression:2780`."""

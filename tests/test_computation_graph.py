@@ -342,3 +342,84 @@ def test_pool_helper_vertex():
     assert stripped.shape == (2, 4, 4, 3)
     np.testing.assert_array_equal(stripped, x[:, 1:, 1:, :])
     assert np.asarray(net.output(x)).shape == (2, 2)
+
+
+class TestMultiOutputEvaluation:
+    """Per-output metrics on multi-output graphs (capability extension:
+    the reference's ComputationGraph.evaluate is first-output-only)."""
+
+    def _two_head_net(self):
+        from deeplearning4j_tpu.data.dataset import MultiDataSet
+        rng = np.random.default_rng(5)
+        x = rng.standard_normal((96, 6)).astype(np.float32)
+        w = rng.standard_normal((6, 2))
+        ya = np.eye(2, dtype=np.float32)[(x @ w).argmax(-1)]
+        yb = np.eye(3, dtype=np.float32)[
+            (x @ rng.standard_normal((6, 3))).argmax(-1)]
+        conf = (NeuralNetConfiguration.builder()
+                .seed(2).updater(Sgd(0.2)).activation("tanh")
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("d", DenseLayer(n_out=16), "in")
+                .add_layer("outa", OutputLayer(n_out=2, activation="softmax"),
+                           "d")
+                .add_layer("outb", OutputLayer(n_out=3, activation="softmax"),
+                           "d")
+                .set_outputs("outa", "outb")
+                .set_input_types(InputType.feed_forward(6))
+                .build())
+        net = ComputationGraph(conf).init()
+        mds = MultiDataSet([x], [ya, yb])
+        for _ in range(60):
+            net.fit(mds)
+        return net, mds, ya, yb
+
+    def test_evaluate_outputs_per_head(self):
+        net, mds, ya, yb = self._two_head_net()
+        evals = net.evaluate_outputs([mds])
+        assert set(evals) == {"outa", "outb"}
+        assert evals["outa"].confusion.matrix.shape == (2, 2)
+        assert evals["outb"].confusion.matrix.shape == (3, 3)
+        # head A trains on a linearly-separable target: must beat chance
+        assert evals["outa"].accuracy() > 0.6
+        total = evals["outa"].confusion.matrix.sum()
+        assert total == ya.shape[0]
+
+    def test_evaluate_output_name_selects_head(self):
+        net, mds, ya, yb = self._two_head_net()
+        ev_b = net.evaluate(iter([mds]), output_name="outb")
+        assert ev_b.confusion.matrix.shape == (3, 3)
+        both = net.evaluate_outputs([mds], ["outb"])
+        np.testing.assert_array_equal(
+            ev_b.confusion.matrix, both["outb"].confusion.matrix)
+
+    def test_unknown_output_name_raises(self):
+        net, mds, _, _ = self._two_head_net()
+        with pytest.raises(ValueError, match="Unknown output"):
+            net.evaluate_outputs([mds], ["nope"])
+
+    def test_first_output_default_matches_subset(self):
+        from deeplearning4j_tpu.data.dataset import DataSet
+        net, mds, _, _ = self._two_head_net()
+        ev = net.evaluate(iter([DataSet(mds.features[0], mds.labels[0])]))
+        sub = net.evaluate_outputs([mds], ["outa"])["outa"]
+        np.testing.assert_array_equal(ev.confusion.matrix,
+                                      sub.confusion.matrix)
+
+    def test_dataset_iterator_with_output_name(self):
+        """DataSet batches + output_name: labels belong to the SELECTED
+        head (fast path, no MultiDataSet needed)."""
+        from deeplearning4j_tpu.data.dataset import DataSet
+        net, mds, ya, yb = self._two_head_net()
+        ds_b = DataSet(mds.features[0], mds.labels[1])   # labels for outb
+        ev = net.evaluate(iter([ds_b]), output_name="outb")
+        ref = net.evaluate_outputs([mds], ["outb"])["outb"]
+        np.testing.assert_array_equal(ev.confusion.matrix,
+                                      ref.confusion.matrix)
+
+    def test_evaluate_outputs_dataset_multihead_rejected(self):
+        from deeplearning4j_tpu.data.dataset import DataSet
+        net, mds, _, _ = self._two_head_net()
+        ds = DataSet(mds.features[0], mds.labels[0])
+        with pytest.raises(ValueError, match="MultiDataSet"):
+            net.evaluate_outputs([ds])

@@ -476,3 +476,124 @@ class TestModelLevelEvaluators:
 
         roc = net.evaluate_roc(It())
         assert roc.calculate_auc() > 0.9
+
+
+class TestPredictionErrorWorkflow:
+    """The full 'which examples were misclassified' loop: meta-collecting
+    iterator -> model.evaluate -> get_prediction_errors -> top confusions
+    -> load the original records back. Reference: eval/meta/Prediction.java
+    getRecord + Evaluation.getPredictions* + RecordReaderDataSetIterator
+    .loadFromMetaData."""
+
+    def _csv(self, tmp_path, n=48):
+        rng = np.random.default_rng(9)
+        x = rng.standard_normal((n, 4))
+        y = (x @ np.asarray([1.0, -1.0, 0.5, 0.0]) > 0).astype(int)
+        p = tmp_path / "data.csv"
+        with open(p, "w") as f:
+            for xi, yi in zip(x, y):
+                f.write(",".join(f"{v:.6f}" for v in xi) + f",{yi}\n")
+        return str(p)
+
+    def _fit_net(self, path):
+        from deeplearning4j_tpu import InputType
+        from deeplearning4j_tpu.data.records import (
+            CSVRecordReader, RecordReaderDataSetIterator,
+        )
+        from deeplearning4j_tpu.models import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+        from deeplearning4j_tpu.optim.updaters import Sgd
+
+        net = MultiLayerNetwork(
+            (NeuralNetConfiguration.builder()
+             .seed(0).updater(Sgd(0.3)).activation("tanh")
+             .list(DenseLayer(n_out=8),
+                   OutputLayer(n_out=2, activation="softmax"))
+             .set_input_type(InputType.feed_forward(4))
+             .build())).init()
+        it = RecordReaderDataSetIterator(
+            CSVRecordReader(path), batch_size=16, num_classes=2)
+        for _ in range(10):
+            for ds in it:
+                net.fit(ds.features, ds.labels, epochs=1,
+                        batch_size=ds.features.shape[0])
+        return net
+
+    def test_evaluate_collects_meta_and_loads_records(self, tmp_path):
+        from deeplearning4j_tpu.data.records import (
+            CSVRecordReader, RecordReaderDataSetIterator,
+        )
+
+        path = self._csv(tmp_path)
+        net = self._fit_net(path)
+        it = RecordReaderDataSetIterator(
+            CSVRecordReader(path), batch_size=16, num_classes=2,
+            collect_meta=True)
+        ev = net.evaluate(it)
+        assert len(ev.predictions) == 48      # every example got a record
+        errs = ev.get_prediction_errors()
+        assert len(errs) == 48 - int(
+            ev.confusion.matrix.trace())      # errors == off-diagonal count
+        # confusion-cell accessors agree with the matrix
+        for a in range(2):
+            for p in range(2):
+                assert len(ev.get_predictions(a, p)) == \
+                    int(ev.confusion.matrix[a, p])
+        # load the original CSV rows behind the first few errors
+        it2 = RecordReaderDataSetIterator(
+            CSVRecordReader(path), batch_size=16, num_classes=2)
+        if errs:
+            ds = it2.load_from_meta_data([e.record_meta for e in errs[:3]])
+            assert ds.features.shape == (min(3, len(errs)), 4)
+            # label in the reloaded record matches the actual class
+            assert ds.labels.argmax(-1).tolist() == \
+                [e.actual for e in errs[:3]]
+
+    def test_top_n_confusions(self):
+        ev = Evaluation(num_classes=3)
+        actual = np.array([0] * 5 + [1] * 5 + [2] * 5)
+        pred = np.array([0, 0, 1, 1, 1,   1, 1, 1, 1, 2,   2, 2, 2, 0, 0])
+        ev.eval_indices(actual, pred)
+        top = ev.get_top_n_confusions(2)
+        assert top[0] == (0, 1, 3)      # most confused cell first
+        assert top[1] == (2, 0, 2) or top[1] == (1, 2, 1)
+        assert ev.get_top_n_confusions(10)[-1][2] >= 1
+
+    def test_reader_load_missing_record_raises(self, tmp_path):
+        from deeplearning4j_tpu.data.records import CSVRecordReader
+        from deeplearning4j_tpu.eval.meta import RecordMetaData
+
+        path = self._csv(tmp_path, n=5)
+        with pytest.raises(KeyError):
+            CSVRecordReader(path).load_from_meta_data(
+                [RecordMetaData(path, 99)])
+
+    def test_reader_rejects_foreign_meta_source(self, tmp_path):
+        """Metas from a different file must not silently return unrelated
+        rows (DataVec matches by URI)."""
+        from deeplearning4j_tpu.data.records import CSVRecordReader
+        from deeplearning4j_tpu.eval.meta import RecordMetaData
+
+        path = self._csv(tmp_path, n=5)
+        with pytest.raises(ValueError, match="source"):
+            CSVRecordReader(path).load_from_meta_data(
+                [RecordMetaData("somewhere/else.csv", 0)])
+
+    def test_sticky_one_hot_width(self, tmp_path):
+        """A loaded subset one-hots to the width the iterator has already
+        seen, not the subset's own max class."""
+        from deeplearning4j_tpu.data.records import (
+            CSVRecordReader, RecordReaderDataSetIterator,
+        )
+        from deeplearning4j_tpu.eval.meta import RecordMetaData
+
+        p = tmp_path / "w.csv"
+        with open(p, "w") as f:
+            for i, c in enumerate([0, 1, 2, 0]):
+                f.write(f"{i}.0,{c}\n")
+        it = RecordReaderDataSetIterator(CSVRecordReader(str(p)), 4)
+        ds = next(it)
+        assert ds.labels.shape == (4, 3)
+        sub = it.load_from_meta_data([RecordMetaData(str(p), 0)])
+        assert sub.labels.shape == (1, 3)   # class-0-only subset keeps width
