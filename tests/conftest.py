@@ -25,6 +25,12 @@ jax.config.update("jax_enable_x64", True)
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: long-running scale tests (always on in CI; "
+        "deselect locally with -m 'not slow')")
+
+
 @pytest.fixture(scope="session")
 def devices8():
     d = jax.devices()

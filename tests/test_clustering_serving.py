@@ -181,3 +181,72 @@ class TestServers:
             np.testing.assert_allclose(got, want, rtol=1e-4)
         finally:
             srv.stop()
+
+
+class TestTsneBlocked:
+    """Blocked large-n path: exact repulsion in O(n·block) memory over a
+    kNN-sparse P (reference: BarnesHutTsne.java:65 scales via
+    VPTree+quadtree; here via blocked sweeps — SURVEY/VERDICT scale item)."""
+
+    def test_blocked_preserves_cluster_structure(self):
+        # n in the blocked path's intended regime (kNN-sparse attraction
+        # needs enough neighbors per cluster to be representative)
+        pts, labels = _blobs(n_per=200)
+        n = len(pts)
+        emb = BarnesHutTsne(n_iter=250, perplexity=30, seed=0,
+                            method="blocked", block=128).fit_transform(pts)
+        assert emb.shape == (n, 2)
+        within, cross = [], []
+        for i in range(0, n, 41):
+            for j in range(0, n, 53):
+                d = np.linalg.norm(emb[i] - emb[j])
+                (within if labels[i] == labels[j] else cross).append(d)
+        assert np.mean(within) < 0.5 * np.mean(cross)
+
+    def test_auto_dispatch(self):
+        t = BarnesHutTsne(method="auto", exact_threshold=10, n_iter=5)
+        pts, _ = _blobs(n_per=10)          # 30 points > threshold
+        t.fit_transform(pts)
+        # blocked path ran: float32 embedding (exact path is float64)
+        assert t.embedding_.dtype == np.float32
+
+    def test_bad_method_rejected(self):
+        with pytest.raises(ValueError, match="method"):
+            BarnesHutTsne(method="quantum")
+
+    def test_knn_blocked_matches_bruteforce(self):
+        from deeplearning4j_tpu.clustering.tsne import _knn_blocked
+        import jax.numpy as jnp
+        rng = np.random.default_rng(3)
+        x = rng.standard_normal((57, 5)).astype(np.float32)
+        d2, idx = _knn_blocked(jnp.asarray(x), 6, 16)
+        full = ((x[:, None, :] - x[None, :, :]) ** 2).sum(-1)
+        np.fill_diagonal(full, np.inf)
+        brute = np.argsort(full, axis=1)[:, :6]
+        # same neighbor SETS (ties may reorder)
+        for i in range(57):
+            assert set(np.asarray(idx)[i]) == set(brute[i]), i
+
+    @pytest.mark.slow
+    def test_scales_to_50k(self):
+        """The capability claim: n >= 50k runs in bounded memory (the
+        dense form would need a 50k x 50k = 10 GB matrix)."""
+        rng = np.random.default_rng(0)
+        n = 50_000
+        centers = rng.standard_normal((10, 8)) * 12.0
+        pts = (centers[rng.integers(0, 10, n)]
+               + rng.standard_normal((n, 8))).astype(np.float32)
+        t = BarnesHutTsne(n_iter=3, perplexity=20, method="blocked",
+                          block=512, n_neighbors=12, seed=0)
+        emb = t.fit_transform(pts)
+        assert emb.shape == (n, 2)
+        assert np.all(np.isfinite(emb))
+
+    def test_n_neighbors_clamped_and_validated(self):
+        pts, _ = _blobs(n_per=10)   # 30 points
+        t = BarnesHutTsne(method="blocked", n_iter=3, n_neighbors=64)
+        emb = t.fit_transform(pts)  # 64 > n-1: clamped, no XLA crash
+        assert emb.shape == (30, 2)
+        with pytest.raises(ValueError, match="n_neighbors"):
+            BarnesHutTsne(method="blocked",
+                          n_neighbors=0).fit_transform(pts)
