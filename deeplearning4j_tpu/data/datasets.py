@@ -30,6 +30,22 @@ def data_dir() -> str:
         os.path.join(os.path.expanduser("~"), ".deeplearning4j_tpu"))
 
 
+def _synthetic_surrogate(n: int, k: int, shape: Tuple[int, ...],
+                         proto_seed: int, sample_seed: int,
+                         blend: float = 0.6
+                         ) -> Tuple[np.ndarray, np.ndarray]:
+    """Deterministic stand-in when real files are absent (zero-egress):
+    k class prototypes blended with per-example noise — separable enough
+    to train on, identical shapes/dtypes to the real data."""
+    rng = np.random.default_rng(sample_seed)
+    protos = np.random.default_rng(proto_seed).random(
+        (k,) + shape).astype(np.float32)
+    labels = rng.integers(0, k, n)
+    x = (blend * protos[labels]
+         + (1.0 - blend) * rng.random((n,) + shape, dtype=np.float32))
+    return x.astype(np.float32), np.eye(k, dtype=np.float32)[labels]
+
+
 # --------------------------------------------------------------------- MNIST
 def _read_idx_images(path: str) -> np.ndarray:
     from deeplearning4j_tpu import native
@@ -70,14 +86,10 @@ def load_mnist(train: bool = True) -> Tuple[np.ndarray, np.ndarray, bool]:
         x = native.u8_to_f32(_read_idx_images(img)).reshape(-1, 784)
         y = native.one_hot(_read_idx_labels(lab), 10)
         return x, y, False
-    # Deterministic synthetic surrogate: 10 gaussian digit prototypes.
-    n = 60000 if train else 10000
-    rng = np.random.default_rng(42 if train else 43)
-    protos = np.random.default_rng(7).random((10, 784)).astype(np.float32)
-    labels = rng.integers(0, 10, n)
-    x = 0.6 * protos[labels] + 0.4 * rng.random((n, 784), dtype=np.float32)
-    y = np.eye(10, dtype=np.float32)[labels]
-    return x.astype(np.float32), y, True
+    x, y = _synthetic_surrogate(60000 if train else 10000, 10, (784,),
+                                proto_seed=7,
+                                sample_seed=42 if train else 43)
+    return x, y, True
 
 
 class MnistDataSetIterator(ArrayDataSetIterator):
@@ -109,12 +121,10 @@ def load_cifar10(train: bool = True) -> Tuple[np.ndarray, np.ndarray, bool]:
         x = np.concatenate(xs).astype(np.float32) / 255.0
         y = np.eye(10, dtype=np.float32)[np.concatenate(ys)]
         return x, y, False
-    n = 50000 if train else 10000
-    rng = np.random.default_rng(44 if train else 45)
-    protos = np.random.default_rng(8).random((10, 32, 32, 3)).astype(np.float32)
-    labels = rng.integers(0, 10, n)
-    x = 0.6 * protos[labels] + 0.4 * rng.random((n, 32, 32, 3), dtype=np.float32)
-    return x.astype(np.float32), np.eye(10, dtype=np.float32)[labels], True
+    x, y = _synthetic_surrogate(50000 if train else 10000, 10, (32, 32, 3),
+                                proto_seed=8,
+                                sample_seed=44 if train else 45)
+    return x, y, True
 
 
 class CifarDataSetIterator(ArrayDataSetIterator):
@@ -189,3 +199,79 @@ class IrisDataSetIterator(ArrayDataSetIterator):
                  seed: int = 123):
         x, y = load_iris()
         super().__init__(x, y, batch_size, shuffle=shuffle, seed=seed)
+
+
+# ---------------------------------------------------------------------- LFW
+def load_lfw(*, height: int = 64, width: int = 64, channels: int = 3,
+             num_labels: Optional[int] = None,
+             num_examples: Optional[int] = None
+             ) -> Tuple[np.ndarray, np.ndarray, list, bool]:
+    """Labeled Faces in the Wild. Returns (images [N,H,W,C] float32 in
+    [0,1], one-hot labels [N,num_labels], label_names, synthetic_flag).
+
+    Reference: `datasets/iterator/impl/LFWDataSetIterator.java` +
+    `datasets/fetchers/LFWDataFetcher` (downloads the lfw tarball and
+    reads the directory-per-person layout). Zero-egress here: reads the
+    same layout from `<data_dir>/lfw/<person>/<person>_NNNN.jpg`,
+    otherwise falls back to a deterministic synthetic face surrogate
+    (per-identity gaussian prototypes), flagged via the returned bool.
+    `num_labels` keeps only the people with the MOST images (the
+    reference's useSubset/numLabels knob); `num_examples` truncates."""
+    base = os.path.join(data_dir(), "lfw")
+    per_label: dict = {}
+    if os.path.isdir(base):
+        from deeplearning4j_tpu.data.records import ImageRecordReader
+
+        rr = ImageRecordReader(base, height=height, width=width,
+                               channels=channels)
+        for arr, li in rr:
+            per_label.setdefault(li, []).append(arr)
+    if per_label:
+        keep = sorted(per_label,
+                      key=lambda li: (-len(per_label[li]), li))
+        if num_labels:
+            keep = keep[:num_labels]
+        names = [rr.labels[li] for li in keep]
+        xs, ys = [], []
+        for new_li, li in enumerate(keep):
+            xs.extend(per_label[li])
+            ys.extend([new_li] * len(per_label[li]))
+        x = np.asarray(xs, np.float32)
+        y = np.eye(len(keep), dtype=np.float32)[np.asarray(ys)]
+        if num_examples:
+            x, y = x[:num_examples], y[:num_examples]
+        return x, y, names, False
+    # Absent OR empty/undecodable cache dir -> synthetic surrogate:
+    # per-identity prototypes, blended harder (0.7) because faces of one
+    # person are more alike than different samples of one digit class.
+    k = num_labels or 10
+    n = num_examples or 40 * k
+    x, y = _synthetic_surrogate(n, k, (height, width, channels),
+                                proto_seed=9, sample_seed=46, blend=0.7)
+    names = [f"person_{i:04d}" for i in range(k)]
+    return x, y, names, True
+
+
+class LFWDataSetIterator(ArrayDataSetIterator):
+    """Reference: `datasets/iterator/impl/LFWDataSetIterator.java` —
+    ctor knobs follow the reference (batchSize, imgDim, numExamples,
+    numLabels, train + splitTrainTest)."""
+
+    def __init__(self, batch_size: int, image_shape: Tuple[int, int, int]
+                 = (64, 64, 3), *, train: bool = True,
+                 split_train_test: float = 0.8, num_examples:
+                 Optional[int] = None, num_labels: Optional[int] = None,
+                 shuffle: bool = True, seed: int = 123):
+        h, w, c = image_shape
+        x, y, names, synthetic = load_lfw(
+            height=h, width=w, channels=c, num_labels=num_labels,
+            num_examples=num_examples)
+        # deterministic stratified-ish split (reference splitTrainTest)
+        rng = np.random.default_rng(seed)
+        perm = rng.permutation(len(x))
+        cut = int(len(x) * split_train_test)
+        sel = perm[:cut] if train else perm[cut:]
+        self.synthetic = synthetic
+        self.label_names = names
+        super().__init__(x[sel], y[sel], batch_size,
+                         shuffle=shuffle, seed=seed)

@@ -148,3 +148,86 @@ class TestConfigTimeShapeErrors:
         g.set_outputs("out")
         with pytest.raises(ValueError, match="sum"):
             g.build()
+
+
+class TestLFW:
+    """LFW canned dataset (reference:
+    datasets/iterator/impl/LFWDataSetIterator.java — the one SURVEY §2.2
+    dataset missing through round 2)."""
+
+    def test_synthetic_fallback_shapes(self, tmp_path, monkeypatch):
+        from deeplearning4j_tpu.data.datasets import (
+            LFWDataSetIterator, load_lfw,
+        )
+
+        monkeypatch.setenv("DL4J_TPU_DATA_DIR", str(tmp_path))  # no lfw/
+        x, y, names, synthetic = load_lfw(num_labels=4, num_examples=60)
+        assert synthetic
+        assert x.shape == (60, 64, 64, 3) and y.shape == (60, 4)
+        assert len(names) == 4
+        it = LFWDataSetIterator(batch_size=16, num_labels=4,
+                                num_examples=60)
+        assert it.synthetic
+        ds = next(it)
+        assert ds.features.shape == (16, 64, 64, 3)
+        # deterministic surrogate: same call -> same data
+        x2, _, _, _ = load_lfw(num_labels=4, num_examples=60)
+        np.testing.assert_array_equal(x, x2)
+
+    def _fake_lfw(self, tmp_path, people):
+        from PIL import Image
+        base = tmp_path / "lfw"
+        for name, count, color in people:
+            (base / name).mkdir(parents=True)
+            for i in range(count):
+                Image.new("RGB", (250, 250), color=color).save(
+                    base / name / f"{name}_{i:04d}.jpg")
+        return base
+
+    def test_reads_directory_per_person_layout(self, tmp_path, monkeypatch):
+        from deeplearning4j_tpu.data.datasets import (
+            LFWDataSetIterator, load_lfw,
+        )
+
+        self._fake_lfw(tmp_path, [("Aaron_Alpha", 4, (200, 30, 30)),
+                                  ("Betty_Beta", 6, (30, 200, 30)),
+                                  ("Carl_Gamma", 2, (30, 30, 200))])
+        monkeypatch.setenv("DL4J_TPU_DATA_DIR", str(tmp_path))
+        x, y, names, synthetic = load_lfw(height=32, width=32)
+        assert not synthetic
+        assert x.shape == (12, 32, 32, 3) and y.shape == (12, 3)
+        # identity with the most images is label 0 (useSubset ordering)
+        assert names[0] == "Betty_Beta"
+        # pixel content decoded: Betty's images are green-dominant
+        betty = x[y.argmax(-1) == 0]
+        assert betty[:, :, :, 1].mean() > betty[:, :, :, 0].mean()
+
+        # num_labels keeps the most-photographed people only
+        x2, y2, names2, _ = load_lfw(height=32, width=32, num_labels=2)
+        assert names2 == ["Betty_Beta", "Aaron_Alpha"]
+        assert x2.shape[0] == 10 and y2.shape[1] == 2
+
+    def test_train_test_split_partitions(self, tmp_path, monkeypatch):
+        from deeplearning4j_tpu.data.datasets import LFWDataSetIterator
+
+        self._fake_lfw(tmp_path, [("A_A", 5, (9, 9, 9)),
+                                  ("B_B", 5, (99, 99, 99))])
+        monkeypatch.setenv("DL4J_TPU_DATA_DIR", str(tmp_path))
+        tr = LFWDataSetIterator(batch_size=8, image_shape=(16, 16, 3),
+                                train=True, shuffle=False)
+        te = LFWDataSetIterator(batch_size=8, image_shape=(16, 16, 3),
+                                train=False, shuffle=False)
+        assert not tr.synthetic
+        n_tr = sum(b.features.shape[0] for b in tr)
+        n_te = sum(b.features.shape[0] for b in te)
+        assert n_tr == 8 and n_te == 2     # 80/20 of 10
+
+    def test_empty_lfw_dir_falls_back_to_synthetic(self, tmp_path,
+                                                   monkeypatch):
+        from deeplearning4j_tpu.data.datasets import load_lfw
+
+        (tmp_path / "lfw").mkdir()          # exists but empty
+        monkeypatch.setenv("DL4J_TPU_DATA_DIR", str(tmp_path))
+        x, y, names, synthetic = load_lfw(num_labels=3, num_examples=12)
+        assert synthetic
+        assert x.shape == (12, 64, 64, 3) and y.shape == (12, 3)
