@@ -239,7 +239,12 @@ def load_lfw(*, height: int = 64, width: int = 64, channels: int = 3,
         x = np.asarray(xs, np.float32)
         y = np.eye(len(keep), dtype=np.float32)[np.asarray(ys)]
         if num_examples:
-            x, y = x[:num_examples], y[:num_examples]
+            # shuffle before truncating (reference LFWDataFetcher does) —
+            # examples are grouped by identity, so a head-slice would keep
+            # only the most-photographed people
+            perm = np.random.default_rng(12345).permutation(len(x))
+            sel = perm[:num_examples]
+            x, y = x[sel], y[sel]
         return x, y, names, False
     # Absent OR empty/undecodable cache dir -> synthetic surrogate:
     # per-identity prototypes, blended harder (0.7) because faces of one

@@ -27,6 +27,7 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 
+from deeplearning4j_tpu.ui.client_js import APP_JS
 from deeplearning4j_tpu.ui.components import (
     ChartLine, ChartScatter, ComponentDiv, ComponentTable,
     DecoratorAccordion, Style, histogram_component,
@@ -52,14 +53,24 @@ _CSS = """
 """
 
 
-def _page(title: str, body_html: str) -> str:
+def _page(title: str, body_html: str, page: str = "") -> str:
+    """Page shell: server-rendered SVG snapshot inside #live (no-JS
+    fallback, refreshed by <noscript> meta), overwritten every 2 s by the
+    polling client /js/app.js (reference: the Play UI's flot-based JS
+    polling dashboards)."""
     nav = ('<nav><a href="/train/overview.html">overview</a>'
            '<a href="/train/model.html">model</a>'
+           '<a href="/train/histogram.html">histograms</a>'
+           '<a href="/train/flow.html">flow</a>'
            '<a href="/train/system.html">system</a>'
            '<a href="/tsne.html">t-SNE</a></nav>')
     return (f"<!doctype html><html><head><title>{title}</title>"
-            f"<style>{_CSS}</style><meta http-equiv=refresh content=5>"
-            f"</head><body><h1>{title}</h1>{nav}{body_html}</body></html>")
+            f"<style>{_CSS}</style>"
+            "<noscript><meta http-equiv=refresh content=5></noscript>"
+            f"</head><body data-page=\"{page}\"><h1>{title}</h1>{nav}"
+            '<div id=status class=meta></div>'
+            f"<div id=live>{body_html}</div>"
+            '<script src="/js/app.js"></script></body></html>')
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -71,30 +82,46 @@ class _Handler(BaseHTTPRequestHandler):
     # --------------------------------------------------------------- GET
     def do_GET(self):
         storage: Optional[StatsStorage] = self.server.ui.storage
-        path = self.path.split("?")[0].rstrip("/")
+        path, _, query = self.path.partition("?")
+        path = path.rstrip("/")
         routes = {
             "": lambda: self._send(200, _page(
-                "Training overview", self._overview_html(storage)),
-                "text/html"),
+                "Training overview", self._overview_html(storage),
+                "overview"), "text/html"),
             "/train": None, "/train/overview.html": None,
             "/train/overview": lambda: self._send_json(
                 self._overview(storage)),
             "/train/model": lambda: self._send_json(
                 self._model_data(storage)),
             "/train/model.html": lambda: self._send(200, _page(
-                "Model", self._model_html(storage)), "text/html"),
+                "Model", self._model_html(storage), "model"), "text/html"),
             "/train/model/components": lambda: self._send_json(
                 self._model_components(storage).to_dict()),
+            "/train/histogram": lambda: self._send_json(
+                self._histogram_data(storage)),
+            "/train/histogram.html": lambda: self._send(200, _page(
+                "Histograms", self._histogram_html(storage), "histogram"),
+                "text/html"),
+            "/train/flow": lambda: self._send_json(
+                self._flow_data(storage)),
+            "/train/flow.html": lambda: self._send(200, _page(
+                "Network flow", self._flow_html(storage), "flow"),
+                "text/html"),
+            "/train/updates": lambda: self._send_json(
+                self._updates_since(storage, query)),
             "/train/system": lambda: self._send_json(
                 self._system_data(storage)),
             "/train/system.html": lambda: self._send(200, _page(
-                "System", self._system_html(storage)), "text/html"),
+                "System", self._system_html(storage), "system"),
+                "text/html"),
             "/train/sessions": lambda: self._send_json(
                 {"sessions":
                  storage.list_session_ids() if storage else []}),
             "/tsne": lambda: self._send_json(self._tsne_data(storage)),
             "/tsne.html": lambda: self._send(200, _page(
-                "t-SNE", self._tsne_html(storage)), "text/html"),
+                "t-SNE", self._tsne_html(storage), "tsne"), "text/html"),
+            "/js/app.js": lambda: self._send(
+                200, APP_JS, "text/javascript"),
         }
         fn = routes.get(path, routes[""] if path == "/" else None)
         if fn is None and path in routes:   # aliases to overview page
@@ -194,6 +221,122 @@ class _Handler(BaseHTTPRequestHandler):
         return {"layers": layers, "activations": activations,
                 "param_histograms": histograms,
                 "update_histograms": update_hist}
+
+    def _updates_since(self, storage, query: str):
+        """Incremental polling endpoint: records newer than ?since=<ts>
+        (epoch seconds). The delta contract for programmatic clients —
+        the page client re-reads aggregates instead, but this endpoint
+        lets a tool tail a run without re-downloading history."""
+        since = 0.0
+        for part in query.split("&"):
+            if part.startswith("since="):
+                try:
+                    since = float(part[6:])
+                except ValueError:
+                    pass
+        # stamp 'now' BEFORE reading: a record landing during the read is
+        # then re-delivered on the next poll instead of skipped forever
+        now = time.time()
+        ups = [u for u in self._updates(storage) if u.timestamp > since]
+        return {"now": now,
+                "records": [{"timestamp": u.timestamp,
+                             "worker_id": u.worker_id,
+                             "content": u.content} for u in ups]}
+
+    def _histogram_data(self, storage):
+        """Latest param/update histograms — the HistogramModule payload
+        (reference: `ui/module/histogram/HistogramModule.java`)."""
+        ups = self._updates(storage)
+        out = {"iteration": None, "param_histograms": {},
+               "update_histograms": {}}
+        for u in ups:    # keep the LATEST report carrying histograms
+            c = u.content
+            if c.get("param_histograms") or c.get("update_histograms"):
+                out["iteration"] = c.get("iteration")
+                out["param_histograms"] = c.get("param_histograms") or {}
+                out["update_histograms"] = c.get("update_histograms") or {}
+        return out
+
+    def _flow_data(self, storage):
+        """Network topology + latest activation stats — the flow-module
+        payload (reference: `ui/module/flow/FlowIterationListener` network
+        structure + per-layer activations). Nodes/edges come from the
+        static report's config_json (MLN: layer chain; CG: vertex DAG)."""
+        st = self._static(storage)
+        nodes, edges = [], []
+        cj = st.get("config_json")
+        if cj:
+            try:
+                conf = json.loads(cj)
+            except (json.JSONDecodeError, TypeError):
+                conf = {}
+            if "vertices" in conf:              # ComputationGraph
+                for name in conf.get("network_inputs", []):
+                    nodes.append({"name": name, "type": "Input"})
+                order = conf.get("topological_order") or \
+                    list(conf["vertices"])
+                for name in order:
+                    v = conf["vertices"].get(name) or {}
+                    ltype = ((v.get("layer") or {}).get("@class")
+                             or v.get("@class") or "?")
+                    nodes.append({"name": name, "type": ltype})
+                for name, ins in (conf.get("vertex_inputs") or {}).items():
+                    for src in ins:
+                        edges.append([src, name])
+            elif "layers" in conf:              # MultiLayerNetwork chain
+                nodes.append({"name": "input", "type": "Input"})
+                prev = "input"
+                for layer in conf["layers"]:
+                    name = layer.get("name") or layer.get("@class")
+                    nodes.append({"name": name,
+                                  "type": layer.get("@class", "?")})
+                    edges.append([prev, name])
+                    prev = name
+        acts, param_stats = {}, {}
+        ups = self._updates(storage)
+        for u in ups:
+            if u.content.get("activation_stats"):
+                acts = u.content["activation_stats"]
+            if u.content.get("param_stats"):
+                param_stats = u.content["param_stats"]
+        return {"nodes": nodes, "edges": edges, "activations": acts,
+                "param_stats": param_stats}
+
+    def _flow_html(self, storage) -> str:
+        """Server-side flow snapshot (no-JS fallback; the JS client
+        replaces it with the heat-colored diagram)."""
+        d = self._flow_data(storage)
+        if not d["nodes"]:
+            return "<div class=card>no network structure yet</div>"
+        acts = d["activations"]
+        rows = []
+        for nd in d["nodes"]:
+            a = acts.get(nd["name"]) or {}
+            rows.append((nd["name"], nd["type"],
+                         f"{a.get('mean', 0):.4g}" if a else "-",
+                         f"{a.get('std', 0):.4g}" if a else "-"))
+        tbl = ComponentTable(
+            title="Network flow (layers in forward order)",
+            header=("layer", "type", "act mean", "act std"),
+            rows=tuple(rows)).render()
+        edges = ", ".join(f"{a}→{b}" for a, b in d["edges"])
+        return (f"<div class=card>{tbl}"
+                f"<div class=meta>edges: {edges}</div></div>")
+
+    def _histogram_html(self, storage) -> str:
+        d = self._histogram_data(storage)
+        parts = []
+        for kind, label in (("param_histograms", "parameters"),
+                            ("update_histograms", "updates")):
+            comps = [histogram_component(f"{n} ({label})", h)
+                     for n, h in (d[kind] or {}).items()]
+            if comps:
+                parts.append(ComponentDiv(children=tuple(comps)).render())
+        if not parts:
+            return ("<div class=card>no histograms — construct "
+                    "StatsListener(collect_histograms=True)</div>")
+        return "<div class=card>" + "</div><div class=card>".join(parts) \
+            + "</div>"
 
     def _system_data(self, storage):
         ups = self._updates(storage)
