@@ -64,7 +64,8 @@ def _page(title: str, body_html: str, page: str = "") -> str:
            '<a href="/train/flow.html">flow</a>'
            '<a href="/train/system.html">system</a>'
            '<a href="/tsne.html">t-SNE</a></nav>')
-    return (f"<!doctype html><html><head><title>{title}</title>"
+    return (f"<!doctype html><html><head><meta charset=utf-8>"
+            f"<title>{title}</title>"
             f"<style>{_CSS}</style>"
             "<noscript><meta http-equiv=refresh content=5></noscript>"
             f"</head><body data-page=\"{page}\"><h1>{title}</h1>{nav}"
@@ -234,10 +235,12 @@ class _Handler(BaseHTTPRequestHandler):
                     since = float(part[6:])
                 except ValueError:
                     pass
-        # stamp 'now' BEFORE reading: a record landing during the read is
-        # then re-delivered on the next poll instead of skipped forever
-        now = time.time()
         ups = [u for u in self._updates(storage) if u.timestamp > since]
+        # the cursor is the max DELIVERED record timestamp, not wall
+        # clock: a record stamped before this poll but stored after it
+        # (StatsListener stamps first, then builds histograms for tens of
+        # ms) still sorts after the cursor and is delivered next poll
+        now = max((u.timestamp for u in ups), default=since)
         return {"now": now,
                 "records": [{"timestamp": u.timestamp,
                              "worker_id": u.worker_id,
@@ -315,11 +318,14 @@ class _Handler(BaseHTTPRequestHandler):
             rows.append((nd["name"], nd["type"],
                          f"{a.get('mean', 0):.4g}" if a else "-",
                          f"{a.get('std', 0):.4g}" if a else "-"))
+        from html import escape
+
         tbl = ComponentTable(
             title="Network flow (layers in forward order)",
             header=("layer", "type", "act mean", "act std"),
             rows=tuple(rows)).render()
-        edges = ", ".join(f"{a}→{b}" for a, b in d["edges"])
+        edges = ", ".join(
+            f"{escape(a)}→{escape(b)}" for a, b in d["edges"])
         return (f"<div class=card>{tbl}"
                 f"<div class=meta>edges: {edges}</div></div>")
 
@@ -522,6 +528,8 @@ class _Handler(BaseHTTPRequestHandler):
     def _send(self, code, body, ctype):
         data = body.encode() if isinstance(body, str) else body
         self.send_response(code)
+        if ctype.startswith("text/") and "charset" not in ctype:
+            ctype += "; charset=utf-8"
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(data)))
         self.end_headers()

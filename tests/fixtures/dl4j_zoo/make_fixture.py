@@ -1,0 +1,156 @@
+"""Build a BIT-FAITHFUL miniature of a published DL4J zoo zip.
+
+Independent of deeplearning4j_tpu's own codec ON PURPOSE: every byte here
+is assembled with struct/zipfile/json straight from the reference's writer
+semantics, so the import test proves the framework reads what the real
+Java stack writes — not merely what its own exporter writes.
+
+Byte layout (studied from the reference, not copied):
+- zip entries `configuration.json` + `coefficients.bin`
+  (`deeplearning4j-nn/src/main/java/org/deeplearning4j/util/
+  ModelSerializer.java:80-119` — writeModel; saveUpdater=false as the
+  published `*_dl4j_inference.zip` artifacts do).
+- configuration.json: Jackson MultiLayerConfiguration with the 0.9.x-era
+  field set the zoo artifacts carry (`nn/conf/MultiLayerConfiguration.java:
+  56-77`, `nn/conf/NeuralNetConfiguration.java:88-124`), layers as
+  WRAPPER_OBJECT one-key dicts named per `nn/conf/layers/Layer.java:48-68`
+  ("dense", "output"), activation/loss as @class-bearing impl objects.
+- coefficients.bin: `Nd4j.write(model.params(), dos)` = two DataBuffers,
+  each `writeUTF(allocationMode) · writeInt(length) · writeUTF(dataType) ·
+  big-endian elements` (java.io.DataOutputStream semantics); first the
+  INT shape-info buffer [rank, *shape, *stride, offset, elementWiseStride,
+  order-char] for the [1, nParams] row vector, then the FLOAT data buffer.
+  Flat param order per `nn/params/DefaultParamInitializer.java:60-88`:
+  per layer [W ('f'-order), b].
+
+The zip itself is deterministic (fixed ZipInfo timestamps, stored — no
+compression), so its Adler32 is a stable catalog value:
+run `python make_fixture.py` to (re)generate and print it.
+"""
+
+import json
+import os
+import struct
+import zipfile
+
+import numpy as np
+
+N_IN, HIDDEN, CLASSES, SEED = 4, 8, 3, 12345
+
+
+def java_utf(s: str) -> bytes:
+    b = s.encode("utf-8")
+    return struct.pack(">H", len(b)) + b
+
+
+def data_buffer(dtype_name: str, fmt: str, values) -> bytes:
+    out = java_utf("DIRECT") + struct.pack(">i", len(values))
+    out += java_utf(dtype_name)
+    for v in values:
+        out += struct.pack(fmt, v)
+    return out
+
+
+def nd4j_row_vector(flat: np.ndarray) -> bytes:
+    n = flat.size
+    shape_info = [2, 1, n, n, 1, 0, 1, ord("c")]   # [1,n] row, c-order
+    return (data_buffer("INT", ">i", shape_info)
+            + data_buffer("FLOAT", ">f", [float(v) for v in flat]))
+
+
+def base_layer(name, act_cls, n_in, n_out, extra=None):
+    d = {
+        "activationFn": {
+            "@class": f"org.nd4j.linalg.activations.impl.{act_cls}"},
+        "adamMeanDecay": 0.9, "adamVarDecay": 0.999,
+        "biasInit": 0.0, "biasLearningRate": 0.1,
+        "dist": None, "dropOut": 0.0, "epsilon": 1e-8,
+        "gradientNormalization": "None",
+        "gradientNormalizationThreshold": 1.0,
+        "l1": 0.0, "l1Bias": 0.0, "l2": 0.0, "l2Bias": 0.0,
+        "layerName": name, "learningRate": 0.1,
+        "learningRateSchedule": None, "momentum": 0.9,
+        "momentumSchedule": None, "nin": n_in, "nout": n_out,
+        "rho": 0.0, "rmsDecay": 0.95, "updater": "SGD",
+        "weightInit": "XAVIER",
+    }
+    d.update(extra or {})
+    return d
+
+
+def layer_conf(wrapped_layer):
+    return {
+        "iterationCount": 0,
+        "l1ByParam": {}, "l2ByParam": {},
+        "layer": wrapped_layer,
+        "leakyreluAlpha": 0.01,
+        "learningRateByParam": {}, "learningRatePolicy": "None",
+        "lrPolicyDecayRate": 0.0, "lrPolicyPower": 0.0,
+        "lrPolicySteps": 0.0, "maxNumLineSearchIterations": 5,
+        "miniBatch": True, "minimize": True, "numIterations": 1,
+        "optimizationAlgo": "STOCHASTIC_GRADIENT_DESCENT",
+        "pretrain": False, "seed": SEED, "stepFunction": None,
+        "useDropConnect": False, "useRegularization": False,
+        "variables": ["W", "b"],
+    }
+
+
+def weights():
+    """Deterministic parameters, f-order-flattened like
+    DefaultParamInitializer's views over the flat row vector."""
+    rng = np.random.default_rng(SEED)
+    w1 = rng.standard_normal((N_IN, HIDDEN)).astype(np.float32) * 0.5
+    b1 = rng.standard_normal(HIDDEN).astype(np.float32) * 0.1
+    w2 = rng.standard_normal((HIDDEN, CLASSES)).astype(np.float32) * 0.5
+    b2 = rng.standard_normal(CLASSES).astype(np.float32) * 0.1
+    flat = np.concatenate([w1.reshape(-1, order="F"), b1,
+                           w2.reshape(-1, order="F"), b2])
+    return w1, b1, w2, b2, flat
+
+
+def expected_output(x: np.ndarray) -> np.ndarray:
+    """Reference forward math, straight numpy (the calibration target)."""
+    w1, b1, w2, b2, _ = weights()
+    h = np.tanh(x @ w1 + b1)
+    logits = h @ w2 + b2
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    return e / e.sum(-1, keepdims=True)
+
+
+def build(path: str) -> int:
+    conf = {
+        "backprop": True,
+        "backpropType": "Standard",
+        "confs": [
+            layer_conf({"dense": base_layer(
+                "fc1", "ActivationTanH", N_IN, HIDDEN)}),
+            layer_conf({"output": base_layer(
+                "out", "ActivationSoftmax", HIDDEN, CLASSES,
+                {"lossFn": {"@class":
+                            "org.nd4j.linalg.lossfunctions.impl."
+                            "LossMCXENT"}})}),
+        ],
+        "inputPreProcessors": {},
+        "iterationCount": 0,
+        "pretrain": False,
+        "tbpttBackLength": 20, "tbpttFwdLength": 20,
+    }
+    *_, flat = weights()
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_STORED) as zf:
+        for name, payload in (
+                ("configuration.json",
+                 json.dumps(conf, indent=2, sort_keys=True).encode()),
+                ("coefficients.bin", nd4j_row_vector(flat))):
+            info = zipfile.ZipInfo(name, date_time=(2017, 3, 2, 0, 0, 0))
+            zf.writestr(info, payload)
+    value = 1
+    with open(path, "rb") as f:
+        import zlib
+        value = zlib.adler32(f.read()) & 0xFFFFFFFF
+    return value
+
+
+if __name__ == "__main__":
+    dest = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "minimlp_dl4j_inference.v1.zip")
+    print(dest, "adler32 =", build(dest))
