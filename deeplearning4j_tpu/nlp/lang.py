@@ -279,3 +279,169 @@ class _ListTokenizer(Tokenizer):
         out = [self._pre.pre_process(t) if self._pre else t
                for t in self._toks]
         return [t for t in out if t]
+
+
+# --------------------------------------------------------------------------
+# Japanese morphological analysis (POS + readings + base forms)
+# --------------------------------------------------------------------------
+import dataclasses as _dc
+
+
+@_dc.dataclass(frozen=True)
+class Morpheme:
+    """One analyzed token — kuromoji Token analogue (reference:
+    deeplearning4j-nlp-japanese bundles a kuromoji fork whose Token
+    carries surface / part-of-speech / reading / base form)."""
+
+    surface: str
+    pos: str                      # kuromoji-style main category (動詞 etc.)
+    reading: Optional[str] = None   # katakana
+    base: Optional[str] = None      # dictionary (base) form
+
+
+def _hira_to_kata(s: str) -> str:
+    return "".join(chr(ord(c) + 0x60) if 0x3041 <= ord(c) <= 0x3096 else c
+                   for c in s)
+
+
+# surface -> (POS, katakana reading). Closed-class core + common verbs
+# (verbs carry a conjugation class for inflection generation below:
+# "1" ichidan, "5" godan, "irr" irregular).
+JA_MORPH: dict = {}
+for _w, _r in (("の", "ノ"), ("は", "ハ"), ("が", "ガ"), ("を", "ヲ"),
+               ("に", "ニ"), ("で", "デ"), ("と", "ト"), ("も", "モ"),
+               ("か", "カ"), ("から", "カラ"), ("まで", "マデ"),
+               ("より", "ヨリ"), ("など", "ナド"), ("について", "ニツイテ")):
+    JA_MORPH[_w] = ("助詞", _r)
+for _w, _r in (("です", "デス"), ("ます", "マス"), ("ました", "マシタ"),
+               ("ません", "マセン"), ("た", "タ"), ("だ", "ダ"),
+               ("ない", "ナイ"), ("な", "ナ"), ("ら", "ラ")):
+    JA_MORPH[_w] = ("助動詞", _r)
+for _w, _r in (("これ", "コレ"), ("それ", "ソレ"), ("あれ", "アレ"),
+               ("ここ", "ココ"), ("そこ", "ソコ"), ("どこ", "ドコ"),
+               ("わたし", "ワタシ"), ("あなた", "アナタ"), ("私", "ワタシ"),
+               ("僕", "ボク"), ("彼", "カレ"), ("彼女", "カノジョ"),
+               ("かれ", "カレ"), ("かのじょ", "カノジョ")):
+    JA_MORPH[_w] = ("代名詞", _r)
+for _w, _r in (("日本", "ニホン"), ("東京", "トウキョウ"),
+               ("学生", "ガクセイ"), ("先生", "センセイ"),
+               ("学校", "ガッコウ"), ("会社", "カイシャ"),
+               ("仕事", "シゴト"), ("時間", "ジカン"), ("今日", "キョウ"),
+               ("明日", "アシタ"), ("昨日", "キノウ"), ("今", "イマ"),
+               ("年", "トシ"), ("月", "ツキ"), ("日", "ヒ"), ("人", "ヒト"),
+               ("何", "ナニ"), ("言葉", "コトバ"), ("勉強", "ベンキョウ"),
+               ("研究", "ケンキュウ"), ("世界", "セカイ"), ("国", "クニ"),
+               ("家族", "カゾク"), ("友達", "トモダチ"), ("こと", "コト"),
+               ("もの", "モノ"), ("とき", "トキ"), ("ひと", "ヒト")):
+    JA_MORPH[_w] = ("名詞", _r)
+for _w, _r in (("ありがとう", "アリガトウ"), ("こんにちは", "コンニチハ"),
+               ("さようなら", "サヨウナラ")):
+    JA_MORPH[_w] = ("感動詞", _r)
+JA_MORPH["ください"] = ("動詞", "クダサイ")
+
+# verb dictionary: base form -> (reading, conjugation class)
+JA_VERBS = {
+    "する": ("スル", "irr"), "いる": ("イル", "1"), "ある": ("アル", "5"),
+    "なる": ("ナル", "5"), "食べる": ("タベル", "1"), "見る": ("ミル", "1"),
+    "行く": ("イク", "5"), "来る": ("クル", "irr"), "思う": ("オモウ", "5"),
+    "言う": ("イウ", "5"), "分かる": ("ワカル", "5"), "書く": ("カク", "5"),
+    "読む": ("ヨム", "5"), "話す": ("ハナス", "5"), "使う": ("ツカウ", "5"),
+    "作る": ("ツクル", "5"), "持つ": ("モツ", "5"), "出る": ("デル", "1"),
+    "入る": ("ハイル", "5"), "待つ": ("マツ", "5"), "買う": ("カウ", "5"),
+    "飲む": ("ノム", "5"), "泳ぐ": ("オヨグ", "5"), "死ぬ": ("シヌ", "5"),
+    "遊ぶ": ("アソブ", "5"), "休む": ("ヤスム", "5"),
+}
+
+# godan final-kana -> (masu-stem kana, ta/te euphonic past, negative stem)
+_GODAN = {
+    "う": ("い", "った", "わ"), "つ": ("ち", "った", "た"),
+    "る": ("り", "った", "ら"), "む": ("み", "んだ", "ま"),
+    "ぶ": ("び", "んだ", "ば"), "ぬ": ("に", "んだ", "な"),
+    "く": ("き", "いた", "か"), "ぐ": ("ぎ", "いだ", "が"),
+    "す": ("し", "した", "さ"),
+}
+
+
+def _inflections(base: str, reading: str, klass: str):
+    """Generate common inflected (surface, reading) pairs for one verb.
+
+    Regular verbs substitute only the FINAL kana, so readings follow the
+    same substitution on the base reading. Irregular verbs (する/来る)
+    carry explicit stem readings — 来る's stem kanji reads ク only in the
+    dictionary form (来た=キタ, 来ない=コナイ), which no suffix rule can
+    derive."""
+    rstem = reading[:-1]              # reading minus the final ル/ウ row kana
+    if klass == "irr":
+        stems = {"する": (("し", "シ"), ("した", "シタ"), ("し", "シ")),
+                 "来る": (("来", "キ"), ("来た", "キタ"), ("来", "コ"))}
+        (stem, stem_r), (past, past_r), (neg, neg_r) = stems[base]
+    elif klass == "1":
+        stem, stem_r = base[:-1], rstem
+        past, past_r = stem + "た", rstem + "タ"
+        neg, neg_r = stem, rstem
+    else:
+        k = base[-1]
+        ms, pa, ns = _GODAN[k]
+        stem, stem_r = base[:-1] + ms, rstem + _hira_to_kata(ms)
+        past, past_r = base[:-1] + pa, rstem + _hira_to_kata(pa)
+        neg, neg_r = base[:-1] + ns, rstem + _hira_to_kata(ns)
+        if base == "行く":        # the one godan euphonic exception
+            past, past_r = "行った", "イッタ"
+    yield base, reading
+    yield past, past_r                            # plain past
+    te = "で" if past.endswith("だ") else "て"
+    yield past[:-1] + te, past_r[:-1] + _hira_to_kata(te)   # te-form
+    for suf in ("ます", "ました", "ません", "ましょう"):
+        yield stem + suf, stem_r + _hira_to_kata(suf)       # polite row
+    yield neg + "ない", neg_r + "ナイ"            # plain negative
+
+
+# inflected surface -> (base form, reading) — built once
+JA_INFLECTED = {}
+for _b, (_r, _k) in JA_VERBS.items():
+    for _surf, _read in _inflections(_b, _r, _k):
+        JA_INFLECTED.setdefault(_surf, (_b, _read))
+
+
+class JapaneseMorphologicalAnalyzer:
+    """kuromoji-capability analogue: segment + POS-tag + readings + base
+    forms. Segmentation is the same min-cost lattice as
+    JapaneseTokenizerFactory, with the verb dictionary's generated
+    inflected surfaces added so conjugated verbs stay one token
+    (kuromoji's dictionary stores inflected entries the same way)."""
+
+    def __init__(self, user_dictionary=None):
+        words = dict(_build_lexicon(JA_COMMON, user_dictionary))
+        for surf in JA_INFLECTED:
+            words.setdefault(surf, max(8.0 - 3.0 * len(surf), 0.4))
+        self._seg = LatticeSegmenter(words)
+
+    def analyze(self, text: str) -> List[Morpheme]:
+        # same NFKC normalization as JapaneseTokenizerFactory.create, so
+        # half-width katakana / full-width latin take the same path
+        text = unicodedata.normalize("NFKC", text)
+        out: List[Morpheme] = []
+        for is_cjk, span in _spans(text, ("kanji", "hiragana", "katakana")):
+            if not is_cjk:
+                for tok in span.split():
+                    if tok:
+                        out.append(Morpheme(
+                            tok, "名詞" if tok[0].isalnum() else "記号"))
+                continue
+            for tok in self._seg.segment(span):
+                out.append(self._morpheme(tok))
+        return out
+
+    def _morpheme(self, tok: str) -> Morpheme:
+        if tok in JA_INFLECTED:
+            base, reading = JA_INFLECTED[tok]
+            return Morpheme(tok, "動詞", reading, base)
+        if tok in JA_MORPH:
+            pos, reading = JA_MORPH[tok]
+            return Morpheme(tok, pos, reading, tok)
+        cls = _char_class(tok[0])
+        if cls == "katakana":
+            return Morpheme(tok, "名詞", tok, tok)
+        if cls == "hiragana":
+            return Morpheme(tok, "助詞", _hira_to_kata(tok), tok)
+        return Morpheme(tok, "名詞", None, tok)   # unknown kanji

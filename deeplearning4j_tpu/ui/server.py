@@ -236,11 +236,16 @@ class _Handler(BaseHTTPRequestHandler):
                 except ValueError:
                     pass
         ups = [u for u in self._updates(storage) if u.timestamp > since]
-        # the cursor is the max DELIVERED record timestamp, not wall
-        # clock: a record stamped before this poll but stored after it
-        # (StatsListener stamps first, then builds histograms for tens of
-        # ms) still sorts after the cursor and is delivered next poll
-        now = max((u.timestamp for u in ups), default=since)
+        # At-least-once contract: the cursor trails the max delivered
+        # record timestamp by a grace window, because listeners stamp
+        # BEFORE storing (tens of ms of histogram building) and multiple
+        # workers' stamps interleave — a strict max cursor would skip a
+        # record stamped before the poll but stored after it. Clients
+        # dedup by (worker_id, timestamp); records inside the window are
+        # re-delivered, never lost.
+        grace = 1.0
+        now = max((u.timestamp for u in ups), default=since + grace) - grace
+        now = max(now, since)    # cursor never moves backwards
         return {"now": now,
                 "records": [{"timestamp": u.timestamp,
                              "worker_id": u.worker_id,

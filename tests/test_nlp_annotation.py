@@ -1,0 +1,194 @@
+"""Annotation pipeline (UIMA-analogue) + Japanese morphology tests.
+
+Reference: deeplearning4j-nlp-uima (SentenceAnnotator, TokenizerAnnotator,
+PoStagger, StemmerAnnotator, PosUimaTokenizer, UimaSentenceIterator,
+StemmingPreprocessor) and deeplearning4j-nlp-japanese (kuromoji Token:
+POS / readings / base forms)."""
+
+import pytest
+
+from deeplearning4j_tpu.nlp.annotation import (
+    AnnotationPipeline, AnnotationSentenceIterator, PorterStemmer,
+    PosFilteredTokenizerFactory, StemmingPreprocessor, TYPE_SENTENCE,
+    TYPE_TOKEN,
+)
+
+
+class TestSentenceAnnotator:
+    def test_splits_on_terminal_punct(self):
+        doc = AnnotationPipeline.default(pos=False, stem=False).process(
+            "Hello world. How are you? Fine!")
+        sents = [a.covered_text(doc.text)
+                 for a in doc.select(TYPE_SENTENCE)]
+        assert sents == ["Hello world.", "How are you?", "Fine!"]
+
+    def test_abbreviations_do_not_split(self):
+        doc = AnnotationPipeline.default(pos=False, stem=False).process(
+            "Dr. Smith met Mr. Jones. They talked.")
+        sents = [a.covered_text(doc.text)
+                 for a in doc.select(TYPE_SENTENCE)]
+        assert len(sents) == 2
+        assert sents[0] == "Dr. Smith met Mr. Jones."
+
+    def test_cjk_terminators(self):
+        doc = AnnotationPipeline.default(pos=False, stem=False).process(
+            "これはペンです。あれは本です。")
+        assert len(doc.select(TYPE_SENTENCE)) == 2
+
+    def test_no_terminal_punct_is_one_sentence(self):
+        doc = AnnotationPipeline.default(pos=False, stem=False).process(
+            "no punctuation here")
+        assert len(doc.select(TYPE_SENTENCE)) == 1
+
+
+class TestTokenAndPos:
+    def test_tokens_have_spans_and_pos(self):
+        doc = AnnotationPipeline.default().process(
+            "The quick brown fox jumped over the lazy dog.")
+        toks = doc.select(TYPE_TOKEN)
+        words = [t.covered_text(doc.text) for t in toks]
+        assert words[0] == "The" and "fox" in words
+        by_word = {t.covered_text(doc.text): t.features for t in toks}
+        assert by_word["The"]["pos"] == "DT"
+        assert by_word["quick"]["pos"] == "JJ"
+        assert by_word["jumped"]["pos"] in ("VB", "VBD")
+        assert by_word["fox"]["pos"] == "NN"
+        # spans index the original text exactly
+        for t in toks:
+            assert doc.text[t.begin:t.end] == t.features["word"]
+
+    def test_pos_shape_rules(self):
+        doc = AnnotationPipeline.default(stem=False).process(
+            "Alice saw 42 birds flying happily")
+        by_word = {t.covered_text(doc.text): t.features["pos"]
+                   for t in doc.select(TYPE_TOKEN)}
+        assert by_word["42"] == "CD"
+        assert by_word["birds"] == "NNS"
+        assert by_word["flying"] == "VBG"
+        assert by_word["happily"] == "RB"
+
+
+class TestPorterStemmer:
+    def test_canonical_examples(self):
+        st = PorterStemmer()
+        # examples straight from the Porter (1980) paper
+        for word, want in (("caresses", "caress"), ("ponies", "poni"),
+                           ("ties", "ti"), ("caress", "caress"),
+                           ("cats", "cat"), ("feed", "feed"),
+                           ("agreed", "agre"), ("plastered", "plaster"),
+                           ("motoring", "motor"), ("sing", "sing"),
+                           ("conflated", "conflat"), ("sized", "size"),
+                           ("hopping", "hop"), ("falling", "fall"),
+                           ("hissing", "hiss"), ("happy", "happi"),
+                           ("relational", "relat"),
+                           ("conditional", "condit"),
+                           ("vietnamization", "vietnam"),
+                           ("predication", "predic"),
+                           ("operator", "oper"), ("triplicate", "triplic"),
+                           ("formative", "form"), ("formalize", "formal"),
+                           ("electricity", "electr"),
+                           ("hopefulness", "hope"),
+                           ("goodness", "good"), ("revival", "reviv"),
+                           ("allowance", "allow"), ("inference", "infer"),
+                           ("airliner", "airlin"), ("adjustable", "adjust"),
+                           ("defensible", "defens"), ("replacement", "replac"),
+                           ("adjustment", "adjust"), ("effective", "effect"),
+                           ("probate", "probat"), ("rate", "rate"),
+                           ("controll", "control"), ("roll", "roll")):
+            assert st.stem(word) == want, word
+
+    def test_preprocessor_plugs_into_tokenizer_spi(self):
+        from deeplearning4j_tpu.nlp.tokenization import (
+            DefaultTokenizerFactory,
+        )
+        f = DefaultTokenizerFactory()
+        f.set_token_pre_processor(StemmingPreprocessor())
+        assert f.create("running dogs happily").tokens() == \
+            ["run", "dog", "happili"]
+
+
+class TestPosFilteredTokenizer:
+    def test_keeps_allowed_pos_nones_for_rest(self):
+        f = PosFilteredTokenizerFactory({"NN", "NNS"}, use_stem=False)
+        toks = f.create("The quick fox saw two birds.").tokens()
+        assert "fox" in toks and "birds" in toks
+        assert "NONE" in toks            # disallowed become NONE
+        f2 = PosFilteredTokenizerFactory({"NN", "NNS"}, strip_nones=True,
+                                         use_stem=False)
+        toks2 = f2.create("The quick fox saw two birds.").tokens()
+        assert "NONE" not in toks2
+
+    def test_prefers_stem(self):
+        f = PosFilteredTokenizerFactory({"NNS"}, strip_nones=True)
+        assert f.create("many dogs running").tokens() == ["dog"]
+
+
+class TestAnnotationSentenceIterator:
+    def test_iterates_pipeline_sentences(self):
+        it = AnnotationSentenceIterator(
+            ["One. Two.", "Three!"])
+        assert list(it) == ["One.", "Two.", "Three!"]
+        # works with Word2Vec-style consumers (SentenceIterator SPI)
+        assert list(it) == ["One.", "Two.", "Three!"]   # re-iterable
+
+
+class TestJapaneseMorphology:
+    def test_full_sentence_analysis(self):
+        from deeplearning4j_tpu.nlp.lang import (
+            JapaneseMorphologicalAnalyzer,
+        )
+        a = JapaneseMorphologicalAnalyzer()
+        ms = a.analyze("私は昨日学校で日本語を勉強しました")
+        by_surface = {m.surface: m for m in ms}
+        assert by_surface["私"].pos == "代名詞"
+        assert by_surface["私"].reading == "ワタシ"
+        assert by_surface["は"].pos == "助詞"
+        assert by_surface["学校"].reading == "ガッコウ"
+        assert by_surface["しました"].pos == "動詞"
+        assert by_surface["しました"].base == "する"
+        assert by_surface["しました"].reading == "シマシタ"
+
+    def test_conjugated_verbs_deinflect(self):
+        from deeplearning4j_tpu.nlp.lang import (
+            JapaneseMorphologicalAnalyzer,
+        )
+        a = JapaneseMorphologicalAnalyzer()
+        for text, base in (("食べました", "食べる"), ("行った", "行く"),
+                           ("飲んだ", "飲む"), ("書いて", "書く"),
+                           ("待たない", "待つ"), ("見ます", "見る")):
+            ms = a.analyze(text)
+            assert ms[0].base == base, (text, ms)
+            assert ms[0].pos == "動詞"
+
+    def test_katakana_loanword_reading_is_surface(self):
+        from deeplearning4j_tpu.nlp.lang import (
+            JapaneseMorphologicalAnalyzer,
+        )
+        ms = JapaneseMorphologicalAnalyzer().analyze("コンピュータ")
+        assert ms[0].pos == "名詞" and ms[0].reading == "コンピュータ"
+
+    def test_hiragana_reading_katakanaized(self):
+        from deeplearning4j_tpu.nlp.lang import (
+            JapaneseMorphologicalAnalyzer,
+        )
+        ms = JapaneseMorphologicalAnalyzer().analyze("ありがとう")
+        assert ms[0].reading == "アリガトウ"
+
+    def test_irregular_kuru_readings(self):
+        """来る's stem kanji reads キ/コ in inflected forms (no suffix
+        rule can derive this — explicit stem readings required)."""
+        from deeplearning4j_tpu.nlp.lang import (
+            JapaneseMorphologicalAnalyzer,
+        )
+        a = JapaneseMorphologicalAnalyzer()
+        for text, reading in (("来る", "クル"), ("来た", "キタ"),
+                              ("来ます", "キマス"), ("来ない", "コナイ")):
+            m = a.analyze(text)[0]
+            assert (m.reading, m.base) == (reading, "来る"), text
+
+    def test_halfwidth_katakana_normalized(self):
+        from deeplearning4j_tpu.nlp.lang import (
+            JapaneseMorphologicalAnalyzer,
+        )
+        m = JapaneseMorphologicalAnalyzer().analyze("ｶﾀｶﾅ")[0]
+        assert m.surface == "カタカナ" and m.reading == "カタカナ"

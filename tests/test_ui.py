@@ -402,6 +402,9 @@ class TestLiveModules:
             server.stop()
 
     def test_updates_since_is_incremental(self):
+        """At-least-once tailing: nothing lost across cursor hops; the
+        grace-window cursor may re-deliver, clients dedup by
+        (worker_id, timestamp)."""
         server, url = self._serve_trained(collect_histograms=False,
                                           collect_activations=False)
         try:
@@ -412,9 +415,19 @@ class TestLiveModules:
             d1 = json.loads(urllib.request.urlopen(
                 url + f"/train/updates?since={mid}").read())
             assert len(d1["records"]) == 1    # only the newer record
+            # chained polling loses nothing: union of pages == all records
+            seen = {(r["worker_id"], r["timestamp"])
+                    for r in d0["records"]}
             d2 = json.loads(urllib.request.urlopen(
                 url + f"/train/updates?since={d0['now']}").read())
-            assert d2["records"] == []
+            seen |= {(r["worker_id"], r["timestamp"])
+                     for r in d2["records"]}
+            assert len(seen) == 2
+            # cursor never regresses and far-future since yields nothing
+            assert d2["now"] >= d0["now"]
+            d3 = json.loads(urllib.request.urlopen(
+                url + f"/train/updates?since={d0['now'] + 60}").read())
+            assert d3["records"] == []
         finally:
             server.stop()
 
