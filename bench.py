@@ -15,8 +15,10 @@ training throughput of ~2500 img/s/chip (MLPerf-era mixed precision), so
 vs_baseline = value / (0.7 * 2500) — i.e. vs_baseline >= 1.0 meets the
 target on a per-chip basis.
 
-Env knobs: BENCH_MODEL=resnet50|vgg16|lstm|lenet, BENCH_BATCH, BENCH_STEPS,
-BENCH_DTYPE, BENCH_ATTEMPT_TIMEOUT (s), BENCH_NO_FALLBACK=1.
+Env knobs: BENCH_MODEL=resnet50|vgg16|lstm|sentiment|inception|lenet
+(comma-separate several to sweep the BASELINE configs, one JSON line
+each), BENCH_BATCH, BENCH_STEPS, BENCH_DTYPE, BENCH_ATTEMPT_TIMEOUT (s),
+BENCH_NO_FALLBACK=1.
 """
 
 from __future__ import annotations
@@ -246,6 +248,120 @@ def _bench_vgg16(batch: int, steps: int, dtype: str):
     return _timed_ips(run, batch, steps) + (flops,)
 
 
+def _bench_sentiment(batch: int, steps: int, dtype: str):
+    """BASELINE config #3: Word2Vec-embedded sequences -> LSTM -> global
+    max-pool -> binary sentiment head, with per-timestep feature masks
+    (the reference's Word2VecSentimentRNN example shape: 300-d vectors,
+    ~256-step reviews)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.inputs import InputType
+    from deeplearning4j_tpu.nn.layers import (
+        GlobalPoolingLayer, OutputLayer,
+    )
+    from deeplearning4j_tpu.nn.layers.recurrent import GravesLSTM
+    from deeplearning4j_tpu.optim.updaters import Adam
+
+    T, F, H, C = 256, 300, 256, 2
+    net = MultiLayerNetwork(
+        (NeuralNetConfiguration.builder()
+         .seed(0).updater(Adam(2e-3)).activation("tanh")
+         .list(GravesLSTM(n_out=H),
+               GlobalPoolingLayer(pooling="max"),
+               OutputLayer(n_out=C, activation="softmax"))
+         .set_input_type(InputType.recurrent(F))
+         .build())).init()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((batch, T, F)), jnp.float32)
+    lens = rng.integers(T // 4, T, batch)
+    fmask = jnp.asarray(
+        (np.arange(T)[None, :] < lens[:, None]).astype(np.float32))
+    y = jnp.asarray(np.eye(C, dtype=np.float32)[rng.integers(0, C, batch)])
+    state = [net.params_tree, net.updater_state, net.state_tree]
+    key = jax.random.PRNGKey(0)
+    step_fn, flops = _compile(
+        net.make_step_fn(), (0, 1, 2),
+        state[0], state[1], state[2], jnp.asarray(0, jnp.int32),
+        x, y, fmask, None, key, None)
+
+    def run(n):
+        loss = None
+        for i in range(n):
+            state[0], state[1], state[2], loss = step_fn(
+                state[0], state[1], state[2], jnp.asarray(i, jnp.int32),
+                x, y, fmask, None, key, None)[:4]
+        return loss
+
+    return _timed_ips(run, batch, steps) + (flops,)
+
+
+def _inception_h5_path() -> str:
+    """Generate (once, cached) a full-channel-width InceptionV3 .h5 via
+    the genuine-topology builder (tests/keras_fixtures.py — 94 Conv2D +
+    94 BN, asymmetric 7x1/1x7 branches, nested concats)."""
+    from deeplearning4j_tpu.data.datasets import data_dir
+
+    dest = os.path.join(data_dir(), "bench", "inception_v3_s2.h5")
+    if not os.path.exists(dest):
+        os.makedirs(os.path.dirname(dest), exist_ok=True)
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "tests"))
+        try:
+            from keras_fixtures import make_inception_v3_h5
+        finally:
+            sys.path.pop(0)
+        # scale=2 halves channel widths: full 299x299 topology, ~6M
+        # params — keeps one-time h5 generation under a minute.
+        # Write-then-rename so a killed generation can't poison the cache.
+        tmp = dest + ".tmp"
+        make_inception_v3_h5(tmp, scale=2, classes=1000, input_size=299)
+        os.replace(tmp, dest)
+    return dest
+
+
+def _bench_inception(batch: int, steps: int, dtype: str):
+    """BASELINE config #4: Keras modelimport InceptionV3 .h5 -> graph ->
+    inference throughput on TPU (the import-path capability: the
+    reference zoo serves imported Keras models for inference)."""
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.keras_import import (
+        import_keras_model_and_weights,
+    )
+
+    net = import_keras_model_and_weights(_inception_h5_path())
+    rng = np.random.default_rng(0)
+    # imported weights keep their own dtype (f32 import fidelity)
+    x = jnp.asarray(rng.standard_normal((batch, 299, 299, 3)), net.dtype)
+    in_name = net.conf.network_inputs[0]
+
+    def fwd(params, states, feats):
+        values, _, _ = net._forward(params, states, feats,
+                                    train=False, rng=None)
+        return values[net.conf.network_outputs[0]]
+
+    fwd_c, flops = _compile(fwd, (), net.params_tree, net.state_tree,
+                            {in_name: x})
+
+    def run(n):
+        out = None
+        for _ in range(n):
+            out = fwd_c(net.params_tree, net.state_tree, {in_name: x})
+        return jnp.max(out)
+
+    return _timed_ips(run, batch, steps) + (flops,)
+
+
+# per-model batch ceilings (memory/compile-time bounds), shared by the
+# child and the fallback-ladder planner so degrade rungs actually degrade
+_BATCH_CAPS = {"lstm": 64, "vgg16": 128, "sentiment": 32, "inception": 32}
+_FIXED_DTYPE = {"lstm": "float32", "sentiment": "float32",
+                "inception": "float32"}
+
 _BENCHES = {
     "resnet50": (_bench_resnet50, "resnet50_train_images_per_sec_per_chip",
                  "images/sec", TARGET_FRACTION * A100_REF_IMG_S),
@@ -253,6 +369,12 @@ _BENCHES = {
               "images/sec", TARGET_FRACTION * 1100.0),  # A100 VGG16 ~1100
     "lstm": (_bench_lstm, "lstm_train_sequences_per_sec",
              "sequences/sec", 100.0),   # no published reference; nominal
+    "sentiment": (_bench_sentiment,
+                  "w2v_lstm_sentiment_train_sequences_per_sec",
+                  "sequences/sec", 100.0),  # nominal (config #3)
+    "inception": (_bench_inception,
+                  "keras_inception_v3_inference_images_per_sec",
+                  "images/sec", 1000.0),    # nominal (config #4)
     "lenet": (_bench_lenet, "lenet_mnist_train_images_per_sec",
               "images/sec", 10000.0),   # no published reference; nominal
 }
@@ -272,12 +394,13 @@ def _child_main():
 
     dev = jax.devices()[0]
     bench_fn, metric, unit, anchor = _BENCHES[model]
-    if model == "lstm":
-        batch = min(batch, 64)
-    elif model == "vgg16":
-        batch = min(batch, 128)
+    if model in _BATCH_CAPS:
+        batch = min(batch, _BATCH_CAPS[model])
 
     ips, per_step, loss, flops = bench_fn(batch, steps, dtype)
+    # models that fix their own precision regardless of BENCH_DTYPE:
+    # lstm/sentiment build float32 nets, inception keeps imported weights
+    dtype = _FIXED_DTYPE.get(model, dtype)
     peak = _peak_flops(getattr(dev, "device_kind", ""))
     mfu = (flops / per_step / peak) if (flops and peak) else None
     print(json.dumps({
@@ -303,12 +426,14 @@ def _attempt_plans():
     driver always records a structured number."""
     model = os.environ.get("BENCH_MODEL", "resnet50")
     batch = int(os.environ.get("BENCH_BATCH", "128"))
+    batch = min(batch, _BATCH_CAPS.get(model, batch))  # label = real batch
     plans = [
         ({}, f"{model} b{batch}"),
         ({}, f"{model} b{batch} retry"),
-        ({"BENCH_BATCH": str(max(32, batch // 2))},
-         f"{model} b{max(32, batch // 2)}"),
     ]
+    half = max(8, batch // 2)
+    if half < batch:        # a capped model at its floor has no half rung
+        plans.append(({"BENCH_BATCH": str(half)}, f"{model} b{half}"))
     if not os.environ.get("BENCH_NO_FALLBACK"):
         if model != "lenet":
             plans.append(({"BENCH_MODEL": "lenet", "BENCH_BATCH": "1024"},
@@ -365,6 +490,20 @@ def main():
         _child_main()
         return
 
+    models = os.environ.get("BENCH_MODEL", "resnet50")
+    if "," in models:
+        # multi-config sweep (BASELINE configs 1-4 in one invocation):
+        # one JSON line per model, each through the same child-process
+        # ladder + TPU persistence. The driver's default single-model
+        # invocation still prints exactly one line.
+        for m in [m.strip() for m in models.split(",") if m.strip()]:
+            os.environ["BENCH_MODEL"] = m
+            _run_ladder()
+        return
+    _run_ladder()
+
+
+def _run_ladder():
     timeout = float(os.environ.get("BENCH_ATTEMPT_TIMEOUT", "600"))
     backoffs = [15.0, 45.0, 90.0]
     errors = []
