@@ -106,8 +106,9 @@ def _bench_resnet50(batch: int, steps: int, dtype: str):
     from deeplearning4j_tpu.optim.updaters import Nesterovs
     from deeplearning4j_tpu.zoo import ResNet50
 
+    extra = {"stem": "s2d"} if os.environ.get("BENCH_S2D") else {}
     model = ResNet50(num_classes=1000, input_shape=(224, 224, 3),
-                     updater=Nesterovs(0.1, 0.9))
+                     updater=Nesterovs(0.1, 0.9), **extra)
     conf = dataclasses.replace(model.conf(), dtype=dtype)
     from deeplearning4j_tpu.models import ComputationGraph
 

@@ -16,6 +16,7 @@ from deeplearning4j_tpu.nn.layers.convolution import (
     ConvolutionLayer, Convolution1DLayer, SubsamplingLayer, Subsampling1DLayer,
     ZeroPaddingLayer, Upsampling2DLayer, SeparableConvolution2DLayer,
     Deconvolution2DLayer, DepthwiseConvolution2DLayer, Cropping2DLayer,
+    SpaceToDepthLayer,
 )
 from deeplearning4j_tpu.nn.layers.normalization import (
     BatchNormalization, LocalResponseNormalization, LayerNormalization,
@@ -37,7 +38,7 @@ __all__ = [
     "ConvolutionLayer", "Convolution1DLayer", "SubsamplingLayer",
     "Subsampling1DLayer", "ZeroPaddingLayer", "Upsampling2DLayer",
     "SeparableConvolution2DLayer", "Deconvolution2DLayer",
-    "DepthwiseConvolution2DLayer", "Cropping2DLayer",
+    "DepthwiseConvolution2DLayer", "Cropping2DLayer", "SpaceToDepthLayer",
     "BatchNormalization", "LocalResponseNormalization", "LayerNormalization",
     "GlobalPoolingLayer", "PoolingType",
     "LSTM", "GravesLSTM", "GravesBidirectionalLSTM", "SimpleRnn", "GRU",

@@ -379,6 +379,41 @@ class Upsampling2DLayer(Layer):
 
 @register_layer
 @dataclasses.dataclass(frozen=True)
+class SpaceToDepthLayer(Layer):
+    """Fold `block`×`block` spatial tiles into channels:
+    [B, H, W, C] -> [B, H/b, W/b, b*b*C], channel order (dy, dx, c).
+
+    TPU-native extension (no counterpart in the 0.9-era reference; later
+    DL4J adds SpaceToDepthLayer): the MXU reads 128-channel tiles, so a
+    stem conv over 3-channel images wastes >95% of the systolic array —
+    folding space into channels first (with the stem kernel folded to
+    match, see zoo/resnet.py `fold_stem_kernel`) is the standard MLPerf
+    ResNet optimization."""
+
+    block: int = 2
+
+    def output_type(self, input_type: InputType) -> InputType:
+        b = self.block
+        if input_type.height % b or input_type.width % b:
+            raise ValueError(
+                f"SpaceToDepth block {b} must divide spatial dims "
+                f"({input_type.height}x{input_type.width})")
+        return InputType.convolutional(
+            input_type.height // b, input_type.width // b,
+            input_type.channels * b * b)
+
+    def apply(self, params, x, *, state=None, train=False, rng=None,
+              mask=None):
+        b = self.block
+        B, H, W, C = x.shape
+        y = x.reshape(B, H // b, b, W // b, b, C)
+        y = y.transpose(0, 1, 3, 2, 4, 5).reshape(
+            B, H // b, W // b, b * b * C)
+        return y, state
+
+
+@register_layer
+@dataclasses.dataclass(frozen=True)
 class Convolution1DLayer(Layer):
     """1-D (temporal) conv over [batch, time, features]. Reference:
     `nn/conf/layers/Convolution1DLayer.java`."""

@@ -8,15 +8,45 @@ NHWC with BN folded next to each conv — the layout XLA fuses best on TPU.
 
 from __future__ import annotations
 
+import numpy as np
+
 from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
 from deeplearning4j_tpu.nn.graph import ElementWiseVertex
 from deeplearning4j_tpu.nn.inputs import InputType
 from deeplearning4j_tpu.nn.layers import (
     ActivationLayer, BatchNormalization, ConvolutionLayer, GlobalPoolingLayer,
-    OutputLayer, SubsamplingLayer, ZeroPaddingLayer,
+    OutputLayer, SpaceToDepthLayer, SubsamplingLayer, ZeroPaddingLayer,
 )
 from deeplearning4j_tpu.optim.updaters import Nesterovs
 from deeplearning4j_tpu.zoo.base import ZooModel, register_zoo
+
+
+def fold_stem_kernel(w, block: int = 2, pad: int = 3):
+    """Fold a stride-`block` stem kernel [K, K, C, O] (HWIO) into the
+    kernel of the mathematically IDENTICAL stride-1 conv over the
+    space-to-depth input: conv(x, w, stride=2, pad=3) ==
+    conv(s2d(x), fold(w), stride=1, explicit pad (2,1)).
+
+    Derivation: index i-pad = block*a + d decomposes every original tap
+    into a folded tap `a` and an input channel slot `d` — the MLPerf
+    ResNet stem transform, giving the MXU block²·C input channels
+    instead of C."""
+    w = np.asarray(w)
+    K, _, C, O = w.shape
+    s = block
+    taps = []
+    for i in range(K):
+        d = (i - pad) % s
+        taps.append(((i - pad - d) // s, d))
+    amin = min(a for a, _ in taps)
+    amax = max(a for a, _ in taps)
+    Ka = amax - amin + 1
+    out = np.zeros((Ka, Ka, s * s * C, O), w.dtype)
+    for i, (ai, dy) in enumerate(taps):
+        for j, (aj, dx) in enumerate(taps):
+            out[ai - amin, aj - amin,
+                (dy * s + dx) * C:(dy * s + dx) * C + C] = w[i, j]
+    return out, (-amin, Ka - 1 + amin)   # kernel + (pad_before, pad_after)
 
 
 @register_zoo
@@ -70,9 +100,19 @@ class ResNet50(ZooModel):
              .add_inputs("input")
              .set_input_types(InputType.convolutional(h, w, c)))
 
-        # Stem (reference: graphBuilder `:173` stem section)
-        g.add_layer("pad0", ZeroPaddingLayer(pad=(3, 3)), "input")
-        x = self._conv_bn(g, "stem", "pad0", 64, (7, 7), (2, 2))
+        # Stem (reference: graphBuilder `:173` stem section).
+        # stem="s2d": space-to-depth variant — identical math (see
+        # fold_stem_kernel), but the conv reads 12 input channels instead
+        # of 3, quadrupling MXU input-channel utilization (MLPerf ResNet
+        # optimization; opt-in, default stem matches the reference).
+        if self.kw.get("stem") == "s2d":
+            g.add_layer("s2d", SpaceToDepthLayer(block=2), "input")
+            g.add_layer("pad0", ZeroPaddingLayer(pad=((2, 1), (2, 1))),
+                        "s2d")
+            x = self._conv_bn(g, "stem", "pad0", 64, (4, 4), (1, 1))
+        else:
+            g.add_layer("pad0", ZeroPaddingLayer(pad=(3, 3)), "input")
+            x = self._conv_bn(g, "stem", "pad0", 64, (7, 7), (2, 2))
         g.add_layer("pool0",
                     SubsamplingLayer(pooling="max", kernel=(3, 3),
                                      stride=(2, 2), convolution_mode="same"),
