@@ -1,0 +1,57 @@
+"""Annotation pipeline: sentences -> tokens -> POS -> stems, then feed
+POS-filtered, stemmed tokens into Word2Vec (the UIMA-module workflow:
+UimaSentenceIterator + PosUimaTokenizerFactory + StemmingPreprocessor).
+Also shows kuromoji-style Japanese morphology (POS/readings/base forms).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _bootstrap  # noqa: F401,E402
+
+from deeplearning4j_tpu.nlp import Word2Vec  # noqa: E402
+from deeplearning4j_tpu.nlp.annotation import (  # noqa: E402
+    AnnotationPipeline, AnnotationSentenceIterator,
+    PosFilteredTokenizerFactory, StemmingPreprocessor, TYPE_TOKEN,
+)
+from deeplearning4j_tpu.nlp.lang import (  # noqa: E402
+    JapaneseMorphologicalAnalyzer,
+)
+from deeplearning4j_tpu.nlp.tokenization import (  # noqa: E402
+    DefaultTokenizerFactory,
+)
+
+
+def main():
+    text = ("Dr. Smith was running experiments quickly. "
+            "The experiments produced surprising results!")
+    doc = AnnotationPipeline.default().process(text)
+    print("tokens / POS / stems:")
+    for t in doc.select(TYPE_TOKEN)[:10]:
+        print(f"  {t.covered_text(doc.text):<12} "
+              f"{t.features.get('pos', '?'):<5} "
+              f"{t.features.get('stem', '')}")
+
+    nouns = PosFilteredTokenizerFactory({"NN", "NNS"}, strip_nones=True)
+    print("noun stems only:", nouns.create(text).tokens())
+
+    docs = ["Dogs chase cats. Cats chase mice.",
+            "The running dogs were chasing the sleeping cats."] * 20
+    factory = DefaultTokenizerFactory()
+    factory.set_token_pre_processor(StemmingPreprocessor())
+    w2v = Word2Vec(tokenizer_factory=factory, layer_size=16, min_count=1,
+                   epochs=3, seed=0)
+    w2v.fit(AnnotationSentenceIterator(docs))
+    print("similarity(dog, cat) on stemmed corpus:",
+          round(float(w2v.similarity("dog", "cat")), 3))
+
+    print("\nJapanese morphology:")
+    for m in JapaneseMorphologicalAnalyzer().analyze(
+            "私は昨日東京で日本語を勉強しました"):
+        print(f"  {m.surface:<8} {m.pos:<4} reading={m.reading} "
+              f"base={m.base}")
+
+
+if __name__ == "__main__":
+    main()

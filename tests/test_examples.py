@@ -56,3 +56,12 @@ def test_training_dashboard(capsys):
     mod["main"](epochs=5, serve_forever=False)
     out = capsys.readouterr().out
     assert "dashboard:" in out and "t-SNE view:" in out
+
+
+def test_nlp_annotation_pipeline(capsys):
+    mod = _run("nlp_annotation_pipeline.py")
+    mod["main"]()
+    out = capsys.readouterr().out
+    assert "noun stems only:" in out
+    assert "similarity(dog, cat)" in out
+    assert "する" in out          # Japanese de-inflection shown
