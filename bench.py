@@ -395,6 +395,10 @@ def _child_main():
 
     dev = jax.devices()[0]
     bench_fn, metric, unit, anchor = _BENCHES[model]
+    if model == "resnet50" and os.environ.get("BENCH_S2D"):
+        # stem experiment gets its own metric so it can't mask the
+        # standard-stem record in bench_last_tpu.json
+        metric = "resnet50_s2d_train_images_per_sec_per_chip"
     if model in _BATCH_CAPS:
         batch = min(batch, _BATCH_CAPS[model])
 
@@ -453,8 +457,9 @@ def _record_last_tpu(result):
     purpose: a meaningful artifact like BENCH_r*.json, carried across
     checkouts so a tunnel outage is distinguishable from a perf
     regression; keying by metric keeps a lenet-fallback TPU run from
-    masquerading as the resnet50 baseline). Atomic replace so a crash
-    can't truncate the file."""
+    masquerading as the resnet50 baseline — variants like the s2d stem
+    carry their own metric name for the same reason). Atomic replace so
+    a crash can't truncate the file."""
     try:
         blob = {k: result[k] for k in
                 ("metric", "value", "unit", "vs_baseline",
