@@ -357,6 +357,17 @@ def _bench_inception(batch: int, steps: int, dtype: str):
     return _timed_ips(run, batch, steps) + (flops,)
 
 
+def _metric_name(model: str) -> str:
+    """Metric key for a model, shared by the child AND the ladder's
+    degraded/failure paths so every record of one experiment carries one
+    name. The s2d stem experiment gets its own metric so it can't mask
+    the standard-stem record in bench_last_tpu.json."""
+    metric = _BENCHES.get(model, _BENCHES["resnet50"])[1]
+    if model == "resnet50" and os.environ.get("BENCH_S2D"):
+        return "resnet50_s2d_train_images_per_sec_per_chip"
+    return metric
+
+
 # per-model batch ceilings (memory/compile-time bounds), shared by the
 # child and the fallback-ladder planner so degrade rungs actually degrade
 _BATCH_CAPS = {"lstm": 64, "vgg16": 128, "sentiment": 32, "inception": 32}
@@ -394,11 +405,8 @@ def _child_main():
     dtype = os.environ.get("BENCH_DTYPE", "bfloat16")
 
     dev = jax.devices()[0]
-    bench_fn, metric, unit, anchor = _BENCHES[model]
-    if model == "resnet50" and os.environ.get("BENCH_S2D"):
-        # stem experiment gets its own metric so it can't mask the
-        # standard-stem record in bench_last_tpu.json
-        metric = "resnet50_s2d_train_images_per_sec_per_chip"
+    bench_fn, _, unit, anchor = _BENCHES[model]
+    metric = _metric_name(model)
     if model in _BATCH_CAPS:
         batch = min(batch, _BATCH_CAPS[model])
 
@@ -555,9 +563,7 @@ def _run_ladder():
                 # the degraded run failed to re-measure), not the
                 # fallback rung's own metric
                 model = os.environ.get("BENCH_MODEL", "resnet50")
-                primary_metric = _BENCHES.get(
-                    model, _BENCHES["resnet50"])[1]
-                last = _load_last_tpu(primary_metric)
+                last = _load_last_tpu(_metric_name(model))
                 if last:
                     result["last_verified_tpu"] = last
             print(json.dumps(result))
@@ -571,7 +577,8 @@ def _run_ladder():
     # Every attempt failed: still emit the structured line (rc 0) so the
     # driver records WHY instead of a bare rc=1 like round 1.
     model = os.environ.get("BENCH_MODEL", "resnet50")
-    _, metric, unit, _ = _BENCHES.get(model, _BENCHES["resnet50"])
+    _, _, unit, _ = _BENCHES.get(model, _BENCHES["resnet50"])
+    metric = _metric_name(model)
     out = {
         "metric": metric,
         "value": 0.0,

@@ -111,6 +111,32 @@ def main():
                                    rtol=1e-6, atol=1e-7)
     assert net2.iteration == net.iteration
 
+    # Sequence parallelism ACROSS processes: ring attention's ppermute
+    # ring spans both hosts (the multi-host long-context path; single-
+    # process coverage lives in test_parallel.py).
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from deeplearning4j_tpu.parallel.distributed import put_global
+    from deeplearning4j_tpu.parallel.ring_attention import (
+        attention, ring_self_attention,
+    )
+
+    mesh2 = make_mesh({"seq": -1})
+    r = np.random.default_rng(5)
+    q, k, v = (r.standard_normal((2, 8, 2, 4)).astype(np.float32)
+               for _ in range(3))
+    sh = NamedSharding(mesh2, P(None, "seq", None, None))
+    out = ring_self_attention(put_global(q, sh), put_global(k, sh),
+                              put_global(v, sh), mesh2, axis="seq",
+                              causal=True)
+    ref = np.asarray(attention(jnp.asarray(q), jnp.asarray(k),
+                               jnp.asarray(v), causal=True))
+    for shd in out.addressable_shards:   # local shards vs global oracle
+        np.testing.assert_allclose(np.asarray(shd.data), ref[shd.index],
+                                   rtol=1e-4, atol=1e-5)
+    sync_global_devices("ring-checked")
+
     if pid == 0:
         flat = {f"p{i}": np.asarray(l) for i, l in
                 enumerate(jax.tree_util.tree_leaves(net.params_tree))}
@@ -119,7 +145,7 @@ def main():
                  iteration=np.int64(net.iteration), **flat)
     sync_global_devices("done")
     print(f"WORKER_OK pid={pid} score={net.score_:.6f} "
-          f"iters={net.iteration}")
+          f"iters={net.iteration} ring=ok")
 
 
 if __name__ == "__main__":

@@ -67,6 +67,7 @@ def _spawn_pod(outdir):
     for p, out in zip(procs, outs):
         assert p.returncode == 0, f"worker failed:\n{out}"
         assert "WORKER_OK" in out, out
+        assert "ring=ok" in out, out   # cross-process ring attention ran
     return outs
 
 
