@@ -16,10 +16,13 @@ from deeplearning4j_tpu.graph.api import (
 from deeplearning4j_tpu.graph.walks import (
     Node2VecWalker, RandomWalker, WeightedWalker, generate_walks,
 )
-from deeplearning4j_tpu.graph.deepwalk import DeepWalk, GraphHuffman
+from deeplearning4j_tpu.graph.deepwalk import (
+    DeepWalk, GraphHuffman, Node2Vec,
+)
 
 __all__ = [
     "Edge", "Graph", "NoEdgeHandling", "Vertex", "load_edge_list",
     "load_weighted_edge_list", "Node2VecWalker", "RandomWalker",
     "WeightedWalker", "generate_walks", "DeepWalk", "GraphHuffman",
+    "Node2Vec",
 ]

@@ -225,3 +225,28 @@ class DeepWalk:
                 vecs[int(idx)] = np.array(rest.split(), dtype=np.float32)
         dw.vertex_vectors = vecs
         return dw
+
+
+class Node2Vec(DeepWalk):
+    """node2vec = DeepWalk's trainer over p/q-biased second-order walks
+    (Grover & Leskovec 2016). Capability extension: the reference's NLP
+    stack names `models/node2vec/` but ships no complete trainer; here
+    the biased `Node2VecWalker` feeds the same hierarchical-softmax
+    skip-gram engine as DeepWalk."""
+
+    def __init__(self, *, p: float = 1.0, q: float = 1.0, **kw):
+        super().__init__(**kw)
+        self.p = p
+        self.q = q
+
+    def fit(self, graph: Graph, walk_length: int = 10) -> "Node2Vec":
+        from deeplearning4j_tpu.graph.walks import Node2VecWalker
+
+        if self.huffman is None:
+            self.initialize(graph)
+        walker = Node2VecWalker(graph, walk_length, p=self.p, q=self.q,
+                                seed=self.seed)
+        starts = np.tile(np.arange(graph.num_vertices(), dtype=np.int64),
+                         self.walks_per_vertex)
+        self.fit_walks(walker.walks(starts))
+        return self

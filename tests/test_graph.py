@@ -166,3 +166,44 @@ class TestDeepWalk:
         dw2 = DeepWalk.load(p)
         np.testing.assert_allclose(dw2.vertex_vectors, dw.vertex_vectors,
                                    rtol=1e-6)
+
+
+class TestNode2Vec:
+    """node2vec trainer over p/q-biased walks (Grover & Leskovec 2016;
+    the reference names models/node2vec/ but ships no trainer)."""
+
+    def _two_communities(self, k=8):
+        # two dense cliques joined by one bridge edge
+        g = Graph(2 * k)
+        for base in (0, k):
+            for i in range(k):
+                for j in range(i + 1, k):
+                    g.add_edge(base + i, base + j)
+        g.add_edge(0, k)
+        return g
+
+    def test_embeds_communities_closer(self):
+        from deeplearning4j_tpu.graph import Node2Vec
+
+        g = self._two_communities()
+        n2v = Node2Vec(vector_size=16, walks_per_vertex=24, p=1.0, q=0.5,
+                       epochs=4, seed=3)
+        n2v.fit(g, walk_length=8)
+        emb = n2v.vertex_vectors
+        assert emb.shape == (16, 16)
+
+        def cos(a, b):
+            return float(np.dot(a, b)
+                         / (np.linalg.norm(a) * np.linalg.norm(b) + 1e-9))
+
+        within = np.mean([cos(emb[1], emb[i]) for i in range(2, 8)])
+        cross = np.mean([cos(emb[1], emb[i]) for i in range(9, 16)])
+        assert within > cross, (within, cross)
+
+    def test_pq_bias_changes_walks(self):
+        from deeplearning4j_tpu.graph.walks import Node2VecWalker
+
+        g = self._two_communities()
+        w_bfs = Node2VecWalker(g, 12, p=0.25, q=4.0, seed=0).walks()
+        w_dfs = Node2VecWalker(g, 12, p=4.0, q=0.25, seed=0).walks()
+        assert not np.array_equal(w_bfs, w_dfs)
