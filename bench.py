@@ -18,7 +18,8 @@ target on a per-chip basis.
 Env knobs: BENCH_MODEL=resnet50|vgg16|lstm|sentiment|inception|lenet
 (comma-separate several to sweep the BASELINE configs, one JSON line
 each), BENCH_BATCH, BENCH_STEPS, BENCH_DTYPE, BENCH_ATTEMPT_TIMEOUT (s),
-BENCH_NO_FALLBACK=1.
+BENCH_NO_FALLBACK=1, BENCH_S2D=1 (space-to-depth ResNet stem, own
+metric), BENCH_PROFILE=<dir> (jax.profiler trace of post-warmup steps).
 """
 
 from __future__ import annotations
@@ -84,9 +85,18 @@ def _timed_ips(run, batch: int, steps: int):
     block_until_ready returns early and every host fetch pays seconds of
     relay latency: run N1 and N2 chained steps, force completion by fetching
     only the SCALAR loss each time, and difference out the constant
-    latency: per_step = (t2 - t1) / (N2 - N1)."""
+    latency: per_step = (t2 - t1) / (N2 - N1).
+
+    BENCH_PROFILE=<dir>: capture a jax.profiler trace of a few post-warmup
+    steps into <dir> (the utils/profiling.py seam, for MFU analysis)."""
     loss = run(3)           # compile + warmup
     _ = float(loss)
+    prof_dir = os.environ.get("BENCH_PROFILE")
+    if prof_dir:
+        from deeplearning4j_tpu.utils.profiling import trace
+
+        with trace(prof_dir):
+            _ = float(run(3))
     n1 = max(2, steps // 4)
     n2 = max(steps, n1 + 1)
     t0 = time.perf_counter()
