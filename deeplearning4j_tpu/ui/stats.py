@@ -121,6 +121,15 @@ class StatsListener(TrainingListener):
                         "norm2": float(np.linalg.norm(u.ravel()))}
                     for n, u in zip(names, upd)
                 }
+                if self.collect_histograms:
+                    # gradient/update histograms — the HistogramModule's
+                    # second panel (reference: BaseStatsListener update
+                    # histogram collection)
+                    report["update_histograms"] = {
+                        n: _histogram(np.asarray(u).ravel(),
+                                      self.histogram_bins)
+                        for n, u in zip(names, upd)
+                    }
             if self.collect_histograms:
                 names = _leaf_names(params)
                 report["param_histograms"] = {

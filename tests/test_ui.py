@@ -448,3 +448,20 @@ class TestLiveModules:
                 assert 'id=live' in html
         finally:
             server.stop()
+
+    def test_update_histograms_collected(self):
+        """Listener emits update (gradient-delta) histograms from the 2nd
+        report on; the histogram page shows both panels."""
+        server, url = self._serve_trained()
+        try:
+            d = json.loads(urllib.request.urlopen(
+                url + "/train/histogram").read())
+            assert d["update_histograms"], "update histograms missing"
+            one = next(iter(d["update_histograms"].values()))
+            assert sum(one["counts"]) > 0
+            page = urllib.request.urlopen(
+                url + "/train/histogram.html").read().decode()
+            assert "(updates)" in page     # server-rendered updates panel
+            assert "(parameters)" in page
+        finally:
+            server.stop()
