@@ -8,12 +8,11 @@ MaxTimeIterationTerminationCondition), `saver/` (InMemory, LocalFile).
 
 from __future__ import annotations
 
-import copy
 import dataclasses
 import math
 import os
 import time
-from typing import Any, Callable, List, Optional
+from typing import Any, List, Optional
 
 import numpy as np
 

@@ -16,12 +16,12 @@ params, step)``; caller does ``params = params - updates`` (the reference's
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional
+from typing import Any
 
 import jax
 import jax.numpy as jnp
 
-from deeplearning4j_tpu.optim.schedules import Schedule, as_schedule
+from deeplearning4j_tpu.optim.schedules import as_schedule
 from deeplearning4j_tpu.utils.serde import register_serde
 
 _tmap = jax.tree_util.tree_map
