@@ -71,6 +71,18 @@ class TrainingMaster:
         return []
 
 
+def _allgather_host(value):
+    """Gather a HOST-side value (or pytree) from every process in ONE
+    collective; each leaf gains a leading process-index axis. The DCN
+    hop of parameter averaging — processes hold different values after
+    training their own shards (contrast distributed.put_global, which
+    assumes identical values)."""
+    from jax.experimental import multihost_utils
+
+    return jax.tree_util.tree_map(
+        np.asarray, multihost_utils.process_allgather(value))
+
+
 def _tree_reduce_pairwise(trees: List[Any], depth: int):
     """Sum pytrees with a bounded-depth reduction tree — the moral
     equivalent of RDD.treeAggregate(depth) (`:860-867`): pairwise rounds
@@ -137,6 +149,25 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
     def execute_training(self, net, data, labels=None, *,
                          batch_size: Optional[int] = None,
                          epochs: int = 1) -> None:
+        """Multi-controller (jax.process_count() > 1): each process runs
+        its `num_workers` LOCAL workers over its `host_local_shard` of the
+        data, then params/updater state are averaged ACROSS processes too
+        — local SGD over DCN, the Spark driver↔executor flow
+        (`ParameterAveragingTrainingMaster.java` processResults) where
+        per-step allreduce is too chatty. Global worker count =
+        num_workers * process_count."""
+        from deeplearning4j_tpu.parallel.distributed import (
+            host_local_shard, process_count,
+        )
+
+        if process_count() > 1:
+            if labels is None:
+                raise NotImplementedError(
+                    "multi-controller execute_training requires (features, "
+                    "labels) arrays so each process can take its "
+                    "host_local_shard")
+            sl = host_local_shard(len(data))
+            data, labels = data[sl], labels[sl]
         bs = batch_size or self.batch_size
         step = jax.jit(net.make_step_fn())
         graph = hasattr(net, "conf") and hasattr(net.conf, "vertices")
@@ -171,7 +202,11 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
             opt = net.updater_state
             states = net.state_tree
             itn = jnp.asarray(net.iteration, jnp.int32)
-            wrng = jax.random.fold_in(jax.random.PRNGKey(net.iteration), w)
+            # fold in the GLOBAL worker index so multi-controller pods
+            # give every logical worker a distinct stream (and match the
+            # equivalent single-process num_workers*nproc run exactly)
+            gw = jax.process_index() * self.num_workers + w
+            wrng = jax.random.fold_in(jax.random.PRNGKey(net.iteration), gw)
             loss = None
             for k in range(self.averaging_frequency):
                 rng = jax.random.fold_in(wrng, k)  # fresh dropout per step
@@ -202,6 +237,22 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
                 [r[1] for r in results], self.aggregation_depth))
         else:
             avg_opt = net.updater_state
+        if jax.process_count() > 1:
+            # second aggregation level: across controller processes
+            # (the treeAggregate->driver hop; every process ends the
+            # split with IDENTICAL averaged state). ONE gather carries
+            # params + opt state + score — a single DCN collective per
+            # split, not one per pytree leaf.
+            bundle = {"p": avg_params, "s": np.float64(score)}
+            if self.average_updater_state:
+                bundle["o"] = avg_opt
+            gathered = _allgather_host(bundle)
+            mean = jax.tree_util.tree_map(lambda g: g.mean(axis=0),
+                                          gathered)
+            avg_params = mean["p"]
+            score = float(mean["s"])
+            if self.average_updater_state:
+                avg_opt = mean["o"]
         t2 = time.perf_counter()
         # "Broadcast": install averaged state as the next split's start —
         # dtype-preserving, like `params.divi(aggCount)` + setParameters.

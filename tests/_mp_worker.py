@@ -49,7 +49,8 @@ from deeplearning4j_tpu.parallel.distributed import (  # noqa: E402
     sync_global_devices,
 )
 from deeplearning4j_tpu.parallel.training_master import (  # noqa: E402
-    DistributedTrainingMaster,
+    DistributedTrainingMaster, ParameterAveragingTrainingMaster,
+    _allgather_host,
 )
 
 N, D, CLASSES, BATCH, EPOCHS = 64, 8, 4, 16, 2
@@ -110,6 +111,22 @@ def main():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-6, atol=1e-7)
     assert net2.iteration == net.iteration
+
+    # Parameter averaging ACROSS processes: local SGD over DCN — each
+    # process trains num_workers logical workers on its host shard, then
+    # params average over the process boundary (the Spark
+    # driver<->executor flow; global workers = 2 procs x 2 = 4).
+    net_pa = make_net()
+    pam = ParameterAveragingTrainingMaster(
+        num_workers=2, batch_size=8, averaging_frequency=2)
+    pam.execute_training(net_pa, x, y, epochs=1)
+    flat_pa = np.concatenate(
+        [np.asarray(l).ravel()
+         for l in jax.tree_util.tree_leaves(net_pa.params_tree)])
+    g = _allgather_host(flat_pa.astype(np.float64))
+    np.testing.assert_allclose(g[0], g[1], rtol=1e-6, atol=1e-8)
+    if pid == 0:
+        np.save(os.path.join(outdir, "pa_params.npy"), flat_pa)
 
     # Sequence parallelism ACROSS processes: ring attention's ppermute
     # ring spans both hosts (the multi-host long-context path; single-

@@ -129,3 +129,25 @@ def test_checkpoint_restores_across_process_boundary(pod_result):
     for i, leaf in enumerate(jax.tree_util.tree_leaves(net.params_tree)):
         np.testing.assert_allclose(np.asarray(leaf), blob[f"p{i}"],
                                    rtol=1e-6, atol=1e-7)
+
+
+def test_parameter_averaging_parity_across_processes(pod_result):
+    """2-process x 2-worker local SGD with cross-host averaging ==
+    single-process 4-worker ParameterAveragingTrainingMaster (the Spark
+    executors-per-JVM decomposition is math-invariant)."""
+    outdir, _ = pod_result
+    from tests._mp_worker import make_data, make_net
+    from deeplearning4j_tpu.parallel.training_master import (
+        ParameterAveragingTrainingMaster,
+    )
+
+    got = np.load(os.path.join(outdir, "pa_params.npy"))
+    x, y = make_data()
+    net = make_net()
+    ParameterAveragingTrainingMaster(
+        num_workers=4, batch_size=8, averaging_frequency=2
+    ).execute_training(net, x, y, epochs=1)
+    want = np.concatenate(
+        [np.asarray(l).ravel()
+         for l in jax.tree_util.tree_leaves(net.params_tree)])
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-6)
