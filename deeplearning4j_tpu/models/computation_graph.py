@@ -28,7 +28,7 @@ from deeplearning4j_tpu.nn.graph import (
 )
 from deeplearning4j_tpu.nn.layers.special import CenterLossOutputLayer
 from deeplearning4j_tpu.models.multilayer import (
-    _dtype_of, _is_recurrent, _normalize_grads,
+    _checkpointed, _dtype_of, _is_recurrent, _normalize_grads,
 )
 from deeplearning4j_tpu.optim.listeners import TrainingListener
 from deeplearning4j_tpu.optim.updaters import NoOp, Updater, resolve_updater
@@ -145,6 +145,14 @@ class ComputationGraph:
                 out_inputs[name] = x
                 y, new_st = v.layer.apply(
                     params[name], x, state=st, train=train, rng=lrng, mask=mask)
+            elif (train and self.conf.gradient_checkpointing
+                  and isinstance(v, LayerVertex)):
+                # remat this layer vertex in the backward pass; cheap
+                # parameterless vertices (merge/elementwise/...) are NOT
+                # wrapped — their outputs are checkpoint residuals
+                # anyway, so wrapping buys nothing and blocks CSE
+                y, new_st = _checkpointed(v.apply, mask)(
+                    params[name], ins, st, lrng)
             else:
                 y, new_st = v.apply(
                     params[name], ins, state=st, train=train, rng=lrng, mask=mask)

@@ -53,6 +53,16 @@ def _is_recurrent(layer: Layer) -> bool:
     )
 
 
+def _checkpointed(apply_fn, mask):
+    """Wrap one layer/vertex apply in jax.checkpoint for the TRAIN path
+    (gradient_checkpointing): its activations are rematerialized in the
+    backward pass instead of stored. Shared by MultiLayerNetwork and
+    ComputationGraph so the remat semantics can't drift."""
+    return jax.checkpoint(
+        lambda p, x, st, lr, _a=apply_fn:
+        _a(p, x, state=st, train=True, rng=lr, mask=mask))
+
+
 def _normalize_grads(grads, mode: str, threshold: float):
     """Gradient normalization/clipping per layer subtree.
     Reference: `nn/conf/GradientNormalization.java` applied in BaseLayer."""
@@ -162,9 +172,18 @@ class MultiLayerNetwork:
             if carries is not None and layer.name in carries:
                 st = carries[layer.name]
             lrng = None if rng is None else jax.random.fold_in(rng, i)
-            x, new_st = layer.apply(
-                params[layer.name], x, state=st, train=train, rng=lrng, mask=fmask
-            )
+            if (train and self.conf.gradient_checkpointing
+                    and not (layer.is_output_layer and i == n - 1)):
+                # remat this layer's activations in the backward pass
+                # (memory ∝ depth → memory ∝ 1, +~33% FLOPs); the output
+                # layer is skipped — its input is retained for the loss
+                # anyway
+                x, new_st = _checkpointed(layer.apply, fmask)(
+                    params[layer.name], x, st, lrng)
+            else:
+                x, new_st = layer.apply(
+                    params[layer.name], x, state=st, train=train,
+                    rng=lrng, mask=fmask)
             new_states[layer.name] = new_st
             if collect:
                 acts.append(x)

@@ -48,6 +48,11 @@ class MultiLayerConfiguration:
     gradient_normalization: str = "none"
     gradient_normalization_threshold: float = 1.0
     mini_batch: bool = True
+    # remat every layer's activations in the backward pass — trades
+    # ~33% more FLOPs for O(depth) less activation memory (the
+    # jax.checkpoint lever for deep nets / long context; TPU-native
+    # extension, no reference counterpart)
+    gradient_checkpointing: bool = False
     tbptt_fwd_length: int = 0       # 0 = no truncated BPTT
     tbptt_back_length: int = 0
     backprop: bool = True
@@ -101,6 +106,7 @@ class Builder:
         self._grad_norm: str = "none"
         self._grad_norm_threshold: float = 1.0
         self._mini_batch = True
+        self._grad_ckpt = False
         self._opt_algo = "stochastic_gradient_descent"
         self._solver_iterations = 100
 
@@ -148,6 +154,12 @@ class Builder:
 
     def mini_batch(self, v: bool) -> "Builder":
         self._mini_batch = v
+        return self
+
+    def gradient_checkpointing(self, v: bool = True) -> "Builder":
+        """Rematerialize layer activations in the backward pass
+        (jax.checkpoint per layer/vertex) — memory for FLOPs."""
+        self._grad_ckpt = v
         return self
 
     def optimization_algo(self, algo: str,
@@ -288,6 +300,7 @@ class ListBuilder:
             gradient_normalization=self._base._grad_norm,
             gradient_normalization_threshold=self._base._grad_norm_threshold,
             mini_batch=self._base._mini_batch,
+            gradient_checkpointing=self._base._grad_ckpt,
             tbptt_fwd_length=self._tbptt_fwd,
             tbptt_back_length=self._tbptt_back,
             backprop=self._backprop,

@@ -358,6 +358,7 @@ class ComputationGraphConfiguration:
     dtype: str = "float32"
     gradient_normalization: str = "none"
     gradient_normalization_threshold: float = 1.0
+    gradient_checkpointing: bool = False
     tbptt_fwd_length: int = 0
     tbptt_back_length: int = 0
     optimization_algo: str = "stochastic_gradient_descent"
@@ -496,6 +497,7 @@ class GraphBuilder:
             dtype=self._base._dtype,
             gradient_normalization=self._base._grad_norm,
             gradient_normalization_threshold=self._base._grad_norm_threshold,
+            gradient_checkpointing=self._base._grad_ckpt,
             tbptt_fwd_length=self._tbptt_fwd,
             tbptt_back_length=self._tbptt_back,
             optimization_algo=self._base._opt_algo,

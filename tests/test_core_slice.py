@@ -174,3 +174,74 @@ class TestEvaluation:
         s = np.array([0.1, 0.4, 0.35, 0.8])
         roc.eval(y, s)
         assert roc.calculate_auc() == pytest.approx(0.75)
+
+
+class TestGradientCheckpointing:
+    """jax.checkpoint per layer/vertex — the memory-for-FLOPs lever for
+    deep nets and long context (TPU-native extension; charter item)."""
+
+    def _mln(self, ckpt):
+        b = (NeuralNetConfiguration.builder().seed(3).updater(Sgd(0.1))
+             .activation("tanh"))
+        if ckpt:
+            b = b.gradient_checkpointing()
+        return MultiLayerNetwork(
+            b.list(DenseLayer(n_out=16), DenseLayer(n_out=16),
+                   OutputLayer(n_out=3, activation="softmax"))
+            .set_input_type(InputType.feed_forward(8)).build()).init()
+
+    def test_mln_training_identical_with_remat(self):
+        import jax
+
+        a, b = self._mln(False), self._mln(True)
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((16, 8)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+        for _ in range(4):
+            a.fit(x, y, epochs=1, batch_size=16)
+            b.fit(x, y, epochs=1, batch_size=16)
+        np.testing.assert_allclose(a.params(), b.params(),
+                                   rtol=1e-5, atol=1e-6)
+        # the backward graph actually carries remat
+        import jax.numpy as jnp
+
+        def loss(p):
+            return b._loss(p, b.state_tree, jnp.asarray(x),
+                           jnp.asarray(y), None, None, None,
+                           train=True)[0]
+        jaxpr = str(jax.make_jaxpr(jax.grad(loss))(b.params_tree))
+        assert "remat" in jaxpr or "checkpoint" in jaxpr
+
+    def test_cg_training_identical_with_remat(self):
+        from deeplearning4j_tpu.models import ComputationGraph
+
+        def build(ckpt):
+            b = (NeuralNetConfiguration.builder().seed(5).updater(Sgd(0.1))
+                 .activation("tanh"))
+            if ckpt:
+                b = b.gradient_checkpointing()
+            g = (b.graph_builder().add_inputs("in")
+                 .add_layer("d1", DenseLayer(n_out=12), "in")
+                 .add_layer("d2", DenseLayer(n_out=12), "d1")
+                 .add_layer("out", OutputLayer(n_out=2,
+                                               activation="softmax"), "d2")
+                 .set_outputs("out")
+                 .set_input_types(InputType.feed_forward(6)).build())
+            return ComputationGraph(g).init()
+
+        a, b = build(False), build(True)
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((8, 6)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 8)]
+        for _ in range(3):
+            a.fit(x, y, epochs=1)
+            b.fit(x, y, epochs=1)
+        np.testing.assert_allclose(a.params(), b.params(),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_conf_serde_carries_flag(self):
+        conf = self._mln(True).conf
+        from deeplearning4j_tpu.nn.config import MultiLayerConfiguration
+
+        assert MultiLayerConfiguration.from_json(
+            conf.to_json()).gradient_checkpointing
