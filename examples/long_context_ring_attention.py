@@ -1,0 +1,90 @@
+"""Long-context training levers, demonstrated together:
+
+1. sequence-parallel RING ATTENTION — the context length is sharded over
+   a mesh axis and K/V blocks rotate via ppermute, so no device ever
+   materializes the full T x T score matrix (charter: long-context is
+   first-class; run on the 8-device virtual CPU mesh or a real slice);
+2. GRADIENT CHECKPOINTING — per-layer rematerialization drops stored
+   activations from O(depth) to O(1) layers for ~33% extra backward
+   FLOPs (builder().gradient_checkpointing()).
+
+Run: JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+       python examples/long_context_ring_attention.py
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+import _bootstrap  # noqa: F401,E402
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from deeplearning4j_tpu import InputType  # noqa: E402
+from deeplearning4j_tpu.models import MultiLayerNetwork  # noqa: E402
+from deeplearning4j_tpu.nn.config import NeuralNetConfiguration  # noqa: E402
+from deeplearning4j_tpu.nn.layers import (  # noqa: E402
+    LSTM, RnnOutputLayer,
+)
+from deeplearning4j_tpu.optim.updaters import Adam  # noqa: E402
+from deeplearning4j_tpu.parallel import make_mesh  # noqa: E402
+from deeplearning4j_tpu.parallel.ring_attention import (  # noqa: E402
+    attention, ring_self_attention,
+)
+
+
+def ring_attention_demo(T=4096, block_check=256):
+    """Attention over a 4k context, sequence-sharded over every device."""
+    mesh = make_mesh({"seq": -1})
+    n_dev = mesh.shape["seq"]
+    B, H, D = 1, 4, 32
+    rng = np.random.default_rng(0)
+    q, k, v = (jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+               for _ in range(3))
+    out = ring_self_attention(q, k, v, mesh, axis="seq", causal=True)
+    # spot-check a block against the dense oracle (dense on 4k is fine on
+    # host; on a real long context it would not be)
+    ref = attention(q[:, :block_check], k[:, :block_check],
+                    v[:, :block_check], causal=True)
+    np.testing.assert_allclose(np.asarray(out[:, :block_check]),
+                               np.asarray(ref), rtol=2e-4, atol=2e-5)
+    print(f"ring attention: T={T} sharded over {n_dev} devices, "
+          f"per-device score block {T // n_dev}x{T} "
+          f"(dense would be {T}x{T}); first {block_check} steps match "
+          "the dense oracle")
+
+
+def remat_training_demo(T=512):
+    """Deep recurrent stack over a long sequence with per-layer remat."""
+    def build(ckpt):
+        b = (NeuralNetConfiguration.builder().seed(0)
+             .updater(Adam(1e-3)).activation("tanh"))
+        if ckpt:
+            b = b.gradient_checkpointing()
+        return MultiLayerNetwork(
+            b.list(LSTM(n_out=48), LSTM(n_out=48), LSTM(n_out=48),
+                   RnnOutputLayer(n_out=4, activation="softmax"))
+            .set_input_type(InputType.recurrent(16)).build()).init()
+
+    rng = np.random.default_rng(1)
+    x = rng.standard_normal((2, T, 16)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, (2, T))]
+    plain, remat = build(False), build(True)
+    plain.fit(x, y, epochs=1, batch_size=2)
+    remat.fit(x, y, epochs=1, batch_size=2)
+    diff = float(np.abs(plain.params() - remat.params()).max())
+    print(f"gradient checkpointing: 3-layer LSTM over T={T}, "
+          f"remat-vs-plain max param diff {diff:.2e} (identical math, "
+          "O(1)-layer activation memory)")
+
+
+def main():
+    print(f"devices: {jax.device_count()}")
+    ring_attention_demo()
+    remat_training_demo()
+
+
+if __name__ == "__main__":
+    main()

@@ -65,3 +65,11 @@ def test_nlp_annotation_pipeline(capsys):
     assert "noun stems only:" in out
     assert "similarity(dog, cat)" in out
     assert "する" in out          # Japanese de-inflection shown
+
+
+def test_long_context_ring_attention(devices8, capsys):
+    mod = _run("long_context_ring_attention.py")
+    mod["ring_attention_demo"](T=512, block_check=128)
+    mod["remat_training_demo"](T=128)
+    out = capsys.readouterr().out
+    assert "ring attention" in out and "gradient checkpointing" in out
