@@ -232,31 +232,63 @@ class Evaluation:
         total = m.sum()
         return float(np.trace(m) / total) if total else 0.0
 
-    def precision(self, cls: Optional[int] = None) -> float:
+    def precision(self, cls: Optional[int] = None,
+                  averaging: str = "macro") -> float:
+        """Per-class, or averaged: "macro" (mean of per-class values,
+        the reference default) or "micro" (global TP/(TP+FP) — equals
+        accuracy for single-label multiclass). Reference:
+        `eval/EvaluationAveraging.java` + Evaluation.precision."""
         if self.confusion is None:
             return 0.0
         m = self.confusion.matrix
         if cls is not None:
             denom = m[:, cls].sum()
             return float(m[cls, cls] / denom) if denom else 0.0
+        if averaging == "micro":   # == accuracy for single-label multiclass
+            return self.accuracy()
+        if averaging != "macro":
+            raise ValueError(f"averaging must be macro|micro, got {averaging!r}")
         vals = [self.precision(c) for c in range(self.num_classes)
                 if m[:, c].sum() > 0]
         return float(np.mean(vals)) if vals else 0.0
 
-    def recall(self, cls: Optional[int] = None) -> float:
+    def recall(self, cls: Optional[int] = None,
+               averaging: str = "macro") -> float:
         if self.confusion is None:
             return 0.0
         m = self.confusion.matrix
         if cls is not None:
             denom = m[cls, :].sum()
             return float(m[cls, cls] / denom) if denom else 0.0
+        if averaging == "micro":   # == accuracy for single-label multiclass
+            return self.accuracy()
+        if averaging != "macro":
+            raise ValueError(f"averaging must be macro|micro, got {averaging!r}")
         vals = [self.recall(c) for c in range(self.num_classes)
                 if m[c, :].sum() > 0]
         return float(np.mean(vals)) if vals else 0.0
 
-    def f1(self, cls: Optional[int] = None) -> float:
-        p, r = self.precision(cls), self.recall(cls)
-        return 2 * p * r / (p + r) if (p + r) else 0.0
+    def f1(self, cls: Optional[int] = None,
+           averaging: str = "macro") -> float:
+        return self.f_beta(1.0, cls, averaging)
+
+    def f_beta(self, beta: float, cls: Optional[int] = None,
+               averaging: str = "macro") -> float:
+        """Reference: `eval/EvaluationUtils.java` fBeta."""
+        p = self.precision(cls, averaging)
+        r = self.recall(cls, averaging)
+        if p == 0.0 or r == 0.0:
+            return 0.0
+        b2 = beta * beta
+        return float((1 + b2) * p * r / (b2 * p + r))
+
+    def g_measure(self, cls: Optional[int] = None,
+                  averaging: str = "macro") -> float:
+        """Geometric mean of precision and recall. Reference:
+        `eval/EvaluationUtils.java` gMeasure."""
+        p = self.precision(cls, averaging)
+        r = self.recall(cls, averaging)
+        return float(np.sqrt(p * r))
 
     def false_positive_rate(self, cls: int) -> float:
         m = self.confusion.matrix

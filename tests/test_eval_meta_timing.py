@@ -597,3 +597,49 @@ class TestPredictionErrorWorkflow:
         assert ds.labels.shape == (4, 3)
         sub = it.load_from_meta_data([RecordMetaData(str(p), 0)])
         assert sub.labels.shape == (1, 3)   # class-0-only subset keeps width
+
+
+class TestAveragingAndFMeasures:
+    """Micro/macro averaging + fBeta/gMeasure (reference:
+    eval/EvaluationAveraging.java, eval/EvaluationUtils.java)."""
+
+    def _ev(self):
+        ev = Evaluation(num_classes=3)
+        actual = np.array([0] * 6 + [1] * 3 + [2] * 1)
+        pred = np.array([0, 0, 0, 0, 1, 2,  1, 1, 0,  2])
+        ev.eval_indices(actual, pred)
+        return ev
+
+    def test_micro_equals_accuracy(self):
+        ev = self._ev()
+        assert ev.precision(averaging="micro") == pytest.approx(
+            ev.accuracy())
+        assert ev.recall(averaging="micro") == pytest.approx(ev.accuracy())
+
+    def test_macro_is_classwise_mean(self):
+        ev = self._ev()
+        per_class = [ev.precision(c) for c in range(3)]
+        assert ev.precision() == pytest.approx(np.mean(per_class))
+
+    def test_f_beta_limits(self):
+        ev = self._ev()
+        # beta=1 == f1; large beta -> recall; small beta -> precision
+        assert ev.f_beta(1.0) == pytest.approx(ev.f1())
+        assert abs(ev.f_beta(10.0) - ev.recall()) < \
+            abs(ev.f_beta(10.0) - ev.precision()) or \
+            ev.recall() == ev.precision()
+        p, r = ev.precision(2), ev.recall(2)
+        assert ev.f_beta(0.5, 2) == pytest.approx(
+            1.25 * p * r / (0.25 * p + r))
+
+    def test_g_measure(self):
+        ev = self._ev()
+        assert ev.g_measure(0) == pytest.approx(
+            np.sqrt(ev.precision(0) * ev.recall(0)))
+
+    def test_unknown_averaging_rejected(self):
+        ev = self._ev()
+        with pytest.raises(ValueError, match="averaging"):
+            ev.precision(averaging="weighted")
+        with pytest.raises(ValueError, match="averaging"):
+            ev.recall(averaging="Micro")
