@@ -44,6 +44,9 @@ from deeplearning4j_tpu.nlp.serializer import (
     write_word_vectors, read_word_vectors, write_binary, read_binary,
 )
 from deeplearning4j_tpu.nlp.bow import BagOfWordsVectorizer, TfidfVectorizer
+from deeplearning4j_tpu.nlp.stopwords import (
+    StopWords, StopWordsRemovalPreprocessor,
+)
 
 __all__ = [
     "VocabCache", "VocabWord", "build_vocab", "HuffmanTree",
@@ -53,6 +56,7 @@ __all__ = [
     "AggregatingSentenceIterator", "MultipleEpochsSentenceIterator",
     "PrefetchingSentenceIterator", "LabelAwareSentenceIterator",
     "LabelAwareListSentenceIterator",
+    "StopWords", "StopWordsRemovalPreprocessor",
     "DocumentIterator", "CollectionDocumentIterator",
     "FileDocumentIterator", "LabelAwareIterator", "LabelledDocument",
     "LabelsSource", "SimpleLabelAwareIterator",

@@ -140,3 +140,53 @@ class TestVectorizers:
         m = tv.fit_transform([["a", "b"], ["a", "c"], ["a", "d"]])
         ia, ib = tv.vocab.index_of("a"), tv.vocab.index_of("b")
         assert m[0, ia] < m[0, ib]
+
+
+class TestStopWords:
+    """Reference: text/stopwords/StopWords.java + stopwords.txt filtering
+    in the Word2Vec vocab pipeline."""
+
+    def test_get_stop_words(self):
+        from deeplearning4j_tpu.nlp import StopWords
+        sw = StopWords.get_stop_words()
+        assert "the" in sw and "and" in sw and len(sw) > 100
+        assert "zebra" not in sw
+        assert "custom" in StopWords.get_stop_words(extra=["custom"])
+
+    def test_preprocessor_filters_through_tokenizer(self):
+        from deeplearning4j_tpu.nlp import StopWordsRemovalPreprocessor
+        from deeplearning4j_tpu.nlp.tokenization import (
+            CommonPreprocessor, DefaultTokenizerFactory,
+        )
+        f = DefaultTokenizerFactory()
+        f.set_token_pre_processor(StopWordsRemovalPreprocessor(
+            inner=CommonPreprocessor()))
+        toks = f.create("The quick fox and the lazy dog!").tokens()
+        assert toks == ["quick", "fox", "lazy", "dog"]
+
+    def test_vocab_excludes_stopwords(self):
+        from deeplearning4j_tpu.nlp import (
+            StopWordsRemovalPreprocessor, Word2Vec,
+        )
+        from deeplearning4j_tpu.nlp.tokenization import (
+            DefaultTokenizerFactory,
+        )
+        f = DefaultTokenizerFactory()
+        f.set_token_pre_processor(StopWordsRemovalPreprocessor())
+        w2v = Word2Vec(tokenizer_factory=f, layer_size=8, min_count=1,
+                       epochs=1, seed=0)
+        w2v.fit(["the dog and the cat ran", "a dog or a cat sat"] * 5)
+        words = {vw.word for vw in w2v.vocab.words}
+        assert "dog" in words and "cat" in words
+        assert "the" not in words and "and" not in words
+
+    def test_contractions_filtered_through_inner_preprocessor(self):
+        from deeplearning4j_tpu.nlp import StopWordsRemovalPreprocessor
+        from deeplearning4j_tpu.nlp.tokenization import (
+            CommonPreprocessor, DefaultTokenizerFactory,
+        )
+        f = DefaultTokenizerFactory()
+        f.set_token_pre_processor(StopWordsRemovalPreprocessor(
+            inner=CommonPreprocessor()))
+        toks = f.create("I don't know, he's gone and they're tall").tokens()
+        assert toks == ["know", "gone", "tall"]
