@@ -1,0 +1,107 @@
+"""Byte-faithful miniature of a DL4J ComputationGraph zoo zip.
+
+Companion to make_fixture.py (MLN): same independent byte assembly, for
+the graph container the published CG zoo zips use
+(`resnet50_dl4j_inference.zip`-style). Shape studied from the reference:
+- top level `nn/conf/ComputationGraphConfiguration.java` (vertices /
+  vertexInputs / networkInputs / networkOutputs + trainer fields);
+- vertices as WRAPPER_OBJECT one-key dicts named per
+  `nn/conf/graph/GraphVertex.java:39-50` ("LayerVertex", "MergeVertex");
+- each LayerVertex holds a FULL NeuralNetConfiguration under
+  `layerConf` (the Java class embeds one), whose `layer` is the same
+  wrapper-object dict as in the MLN confs array;
+- coefficients.bin = Nd4j.write of the flat params in the graph's
+  topological order (`nn/graph/ComputationGraph.java` init():382-443 —
+  Kahn/FIFO over vertexInputs), per-layer [W ('f'-order), b].
+
+Topology: in -> dense a (4->8, tanh); in -> dense b (4->8, tanh);
+merge(a, b); output (16->3, softmax, MCXENT).
+
+Run `python make_graph_fixture.py` to (re)generate + print the Adler32.
+"""
+
+import json
+import os
+import zipfile
+
+import numpy as np
+
+from make_fixture import base_layer, java_utf, layer_conf, nd4j_row_vector
+
+N_IN, HIDDEN, CLASSES, SEED = 4, 8, 3, 777
+
+del java_utf  # re-exported by make_fixture; only nd4j_row_vector is used
+
+
+def graph_weights():
+    rng = np.random.default_rng(SEED)
+    wa = rng.standard_normal((N_IN, HIDDEN)).astype(np.float32) * 0.5
+    ba = rng.standard_normal(HIDDEN).astype(np.float32) * 0.1
+    wb = rng.standard_normal((N_IN, HIDDEN)).astype(np.float32) * 0.5
+    bb = rng.standard_normal(HIDDEN).astype(np.float32) * 0.1
+    wo = rng.standard_normal((2 * HIDDEN, CLASSES)).astype(np.float32) * 0.5
+    bo = rng.standard_normal(CLASSES).astype(np.float32) * 0.1
+    # flat order = topological: a, b, out (Kahn/FIFO from the one input)
+    flat = np.concatenate([wa.reshape(-1, order="F"), ba,
+                           wb.reshape(-1, order="F"), bb,
+                           wo.reshape(-1, order="F"), bo])
+    return (wa, ba, wb, bb, wo, bo), flat
+
+
+def expected_output(x: np.ndarray) -> np.ndarray:
+    (wa, ba, wb, bb, wo, bo), _ = graph_weights()
+    h = np.concatenate([np.tanh(x @ wa + ba), np.tanh(x @ wb + bb)], axis=1)
+    logits = h @ wo + bo
+    e = np.exp(logits - logits.max(-1, keepdims=True))
+    return e / e.sum(-1, keepdims=True)
+
+
+def _layer_vertex(wrapped_layer):
+    return {"LayerVertex": {
+        "layerConf": layer_conf(wrapped_layer),
+        "preProcessor": None,
+    }}
+
+
+def build(path: str) -> int:
+    conf = {
+        "backprop": True,
+        "backpropType": "Standard",
+        "networkInputs": ["in"],
+        "networkOutputs": ["out"],
+        "pretrain": False,
+        "tbpttBackLength": 20, "tbpttFwdLength": 20,
+        "vertexInputs": {
+            "a": ["in"], "b": ["in"], "merge": ["a", "b"],
+            "out": ["merge"],
+        },
+        "vertices": {
+            "a": _layer_vertex({"dense": base_layer(
+                "a", "ActivationTanH", N_IN, HIDDEN)}),
+            "b": _layer_vertex({"dense": base_layer(
+                "b", "ActivationTanH", N_IN, HIDDEN)}),
+            "merge": {"MergeVertex": {}},
+            "out": _layer_vertex({"output": base_layer(
+                "out", "ActivationSoftmax", 2 * HIDDEN, CLASSES,
+                {"lossFn": {"@class":
+                            "org.nd4j.linalg.lossfunctions.impl."
+                            "LossMCXENT"}})}),
+        },
+    }
+    _, flat = graph_weights()
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_STORED) as zf:
+        for name, payload in (
+                ("configuration.json",
+                 json.dumps(conf, indent=2, sort_keys=True).encode()),
+                ("coefficients.bin", nd4j_row_vector(flat))):
+            info = zipfile.ZipInfo(name, date_time=(2017, 3, 2, 0, 0, 0))
+            zf.writestr(info, payload)
+    import zlib
+    with open(path, "rb") as f:
+        return zlib.adler32(f.read()) & 0xFFFFFFFF
+
+
+if __name__ == "__main__":
+    dest = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "minigraph_dl4j_inference.v1.zip")
+    print(dest, "adler32 =", build(dest))

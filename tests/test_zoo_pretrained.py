@@ -150,6 +150,17 @@ class TestImageNetLabels:
         assert single[0][0][1] == "name_1"
 
 
+def _import_fixture_module(name):
+    """Import a builder module from tests/fixtures/dl4j_zoo."""
+    import sys
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__),
+                                    "fixtures", "dl4j_zoo"))
+    try:
+        return __import__(name)
+    finally:
+        sys.path.pop(0)
+
+
 class TestByteFaithfulZooArtifact:
     """The full pretrained path against a BIT-FAITHFUL miniature of a
     published DL4J zoo zip, assembled byte-by-byte from the reference's
@@ -163,14 +174,7 @@ class TestByteFaithfulZooArtifact:
     ADLER32 = 30806505          # stable: fixture zip is deterministic
 
     def _builder(self):
-        import sys
-        sys.path.insert(0, os.path.join(os.path.dirname(__file__),
-                                        "fixtures", "dl4j_zoo"))
-        try:
-            import make_fixture
-        finally:
-            sys.path.pop(0)
-        return make_fixture
+        return _import_fixture_module("make_fixture")
 
     def test_fixture_is_deterministic_and_checksummed(self, tmp_path):
         """Regenerating the artifact yields byte-identical content — the
@@ -225,3 +229,43 @@ class TestByteFaithfulZooArtifact:
             ["DenseLayer", "OutputLayer"]
         assert net.conf.layers[0].activation == "tanh"
         assert net.conf.layers[1].loss == "mcxent"
+
+
+class TestByteFaithfulGraphArtifact:
+    """ComputationGraph analogue of TestByteFaithfulZooArtifact: the
+    published CG zoo zips' container (LayerVertex/MergeVertex Jackson
+    wrappers, layerConf-embedded NeuralNetConfiguration, topological
+    flat params), hand-assembled byte-by-byte
+    (tests/fixtures/dl4j_zoo/make_graph_fixture.py)."""
+
+    FIXTURE = os.path.join(os.path.dirname(__file__), "fixtures",
+                           "dl4j_zoo", "minigraph_dl4j_inference.v1.zip")
+    ADLER32 = 3925201636
+
+    def _builder(self):
+        return _import_fixture_module("make_graph_fixture")
+
+    def test_fixture_deterministic(self, tmp_path):
+        mg = self._builder()
+        p = str(tmp_path / "regen.zip")
+        assert mg.build(p) == self.ADLER32
+        with open(p, "rb") as a, open(self.FIXTURE, "rb") as b:
+            assert a.read() == b.read(), "committed fixture drifted"
+
+    def test_imports_with_calibrated_predictions(self):
+        from deeplearning4j_tpu.interop import import_dl4j_model
+        from deeplearning4j_tpu.nn.inputs import InputType
+
+        mg = self._builder()
+        assert sniff_format(self.FIXTURE) == "dl4j"
+        net = import_dl4j_model(self.FIXTURE,
+                                input_type=InputType.feed_forward(4))
+        assert type(net).__name__ == "ComputationGraph"
+        x = np.random.default_rng(3).standard_normal(
+            (8, mg.N_IN)).astype(np.float32)
+        np.testing.assert_allclose(
+            np.asarray(net.output(x)), mg.expected_output(x),
+            rtol=1e-5, atol=1e-6)
+        # graph structure came through: merge fan-in + vertex kinds
+        assert set(net.conf.vertex_inputs["merge"]) == {"a", "b"}
+        assert type(net.conf.vertices["merge"]).__name__ == "MergeVertex"
