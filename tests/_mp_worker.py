@@ -74,6 +74,27 @@ def make_net():
          .build())).init()
 
 
+def flat_params(net):
+    """All param leaves flattened into one float64 vector (parity checks)."""
+    return np.concatenate(
+        [np.asarray(l).ravel().astype(np.float64)
+         for l in jax.tree_util.tree_leaves(net.params_tree)])
+
+
+def make_graph_net():
+    from deeplearning4j_tpu.models import ComputationGraph
+
+    conf = (NeuralNetConfiguration.builder()
+            .seed(11).updater(Sgd(0.1)).activation("tanh")
+            .graph_builder().add_inputs("in")
+            .add_layer("d", DenseLayer(n_out=8), "in")
+            .add_layer("out", OutputLayer(n_out=CLASSES,
+                                          activation="softmax"), "d")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(D)).build())
+    return ComputationGraph(conf).init()
+
+
 def main():
     nproc = int(os.environ["MP_NPROC"])
     pid = int(os.environ["MP_PID"])
@@ -112,6 +133,17 @@ def main():
                                    rtol=1e-6, atol=1e-7)
     assert net2.iteration == net.iteration
 
+    # ComputationGraph DP across processes: dict-shaped batches flow
+    # through _to_dicts(host=True) + per-process global-batch assembly.
+    gnet = make_graph_net()
+    DistributedTrainingMaster(mesh=make_mesh({"data": -1})).execute_training(
+        gnet, x, y, batch_size=BATCH, epochs=1)
+    gflat = flat_params(gnet)
+    gg = _allgather_host(gflat)
+    np.testing.assert_allclose(gg[0], gg[1], rtol=1e-6, atol=1e-8)
+    if pid == 0:
+        np.save(os.path.join(outdir, "cg_params.npy"), gflat)
+
     # Parameter averaging ACROSS processes: local SGD over DCN — each
     # process trains num_workers logical workers on its host shard, then
     # params average over the process boundary (the Spark
@@ -120,10 +152,8 @@ def main():
     pam = ParameterAveragingTrainingMaster(
         num_workers=2, batch_size=8, averaging_frequency=2)
     pam.execute_training(net_pa, x, y, epochs=1)
-    flat_pa = np.concatenate(
-        [np.asarray(l).ravel()
-         for l in jax.tree_util.tree_leaves(net_pa.params_tree)])
-    g = _allgather_host(flat_pa.astype(np.float64))
+    flat_pa = flat_params(net_pa)
+    g = _allgather_host(flat_pa)
     np.testing.assert_allclose(g[0], g[1], rtol=1e-6, atol=1e-8)
     if pid == 0:
         np.save(os.path.join(outdir, "pa_params.npy"), flat_pa)

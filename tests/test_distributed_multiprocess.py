@@ -32,6 +32,17 @@ WORKER = os.path.join(REPO, "tests", "_mp_worker.py")
 NPROC, DEVS = 2, 2
 
 
+def _global_order(n, nproc, batch):
+    """Row order that makes a single-process run see the SAME global
+    batches a pod assembles (concat of per-process host-local slices)."""
+    half, loc = n // nproc, batch // nproc
+    return np.concatenate([
+        np.concatenate([np.arange(p * half + i * loc,
+                                  p * half + (i + 1) * loc)
+                        for p in range(nproc)])
+        for i in range(half // loc)])
+
+
 def _free_port():
     with socket.socket() as s:
         s.bind(("localhost", 0))
@@ -96,14 +107,7 @@ def test_parity_with_single_process(pod_result):
 
     blob = np.load(os.path.join(outdir, "final_params.npz"))
     x, y = make_data()
-    # Global batch i = concat over processes of each host-local slice:
-    # process k holds rows [k*N/2, (k+1)*N/2), feeds BATCH/2 per step.
-    half, loc = N // NPROC, BATCH // NPROC
-    order = np.concatenate([
-        np.concatenate([np.arange(p * half + i * loc,
-                                  p * half + (i + 1) * loc)
-                        for p in range(NPROC)])
-        for i in range(half // loc)])
+    order = _global_order(N, NPROC, BATCH)
     net = make_net()
     net.fit(x[order], y[order], epochs=EPOCHS, batch_size=BATCH)
     leaves = jax.tree_util.tree_leaves(net.params_tree)
@@ -147,7 +151,24 @@ def test_parameter_averaging_parity_across_processes(pod_result):
     ParameterAveragingTrainingMaster(
         num_workers=4, batch_size=8, averaging_frequency=2
     ).execute_training(net, x, y, epochs=1)
-    want = np.concatenate(
-        [np.asarray(l).ravel()
-         for l in jax.tree_util.tree_leaves(net.params_tree)])
+    from tests._mp_worker import flat_params
+    want = flat_params(net)
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-6)
+
+
+def test_cg_dp_parity_across_processes(pod_result):
+    """ComputationGraph multi-controller DP (dict-shaped batches) ==
+    single-process training on the equivalent global batch order."""
+    outdir, _ = pod_result
+    from tests._mp_worker import (
+        BATCH, N, make_data, make_graph_net,
+    )
+
+    got = np.load(os.path.join(outdir, "cg_params.npy"))
+    x, y = make_data()
+    order = _global_order(N, NPROC, BATCH)
+    net = make_graph_net()
+    net.fit(x[order], y[order], epochs=1, batch_size=BATCH)
+    from tests._mp_worker import flat_params
+    want = flat_params(net)
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-6)
