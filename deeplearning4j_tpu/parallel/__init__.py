@@ -33,7 +33,8 @@ from deeplearning4j_tpu.parallel.moe import (
 )
 from deeplearning4j_tpu.parallel.training_master import (
     TrainingMaster, ParameterAveragingTrainingMaster,
-    DistributedTrainingMaster, PhaseStats, export_timeline_html,
+    DistributedTrainingMaster, PhaseStats, distributed_evaluate,
+    export_timeline_html,
 )
 from deeplearning4j_tpu.parallel.estimator import NetworkEstimator
 from deeplearning4j_tpu.parallel.checkpoint import ShardedCheckpointer
@@ -54,4 +55,5 @@ __all__ = [
     "expert_mesh",
     "TrainingMaster", "ParameterAveragingTrainingMaster",
     "DistributedTrainingMaster", "PhaseStats", "NetworkEstimator",
+    "distributed_evaluate", "export_timeline_html",
 ]

@@ -338,6 +338,44 @@ class DistributedTrainingMaster(TrainingMaster):
         return self._stats
 
 
+def distributed_evaluate(net, features, labels, *, batch_size: int = 32):
+    """Distributed classification evaluation: each controller process
+    evaluates its `host_local_shard`, confusion matrices sum across
+    processes in one gather. The Spark evaluation seam
+    (`SparkDl4jMultiLayer.evaluate(JavaRDD)` -> executor-side eval +
+    treeAggregate merge of Evaluation objects), multi-controller style.
+    Single-process it degrades to a plain `net.evaluate`."""
+    from deeplearning4j_tpu.data.iterators import ArrayDataSetIterator
+    from deeplearning4j_tpu.eval import Evaluation
+    from deeplearning4j_tpu.parallel.distributed import (
+        process_count, process_index,
+    )
+
+    nproc = process_count()
+    n = len(features)
+    n_classes = int(np.asarray(labels).shape[-1])
+    if nproc > 1:
+        # Unlike training shards, eval shards need not be equal-sized
+        # (the only collective is the fixed-shape confusion gather), so
+        # the LAST process takes the remainder — no example dropped.
+        per, k = n // nproc, process_index()
+        sl = slice(k * per, (k + 1) * per if k < nproc - 1 else n)
+    else:
+        sl = slice(None)
+    ev = net.evaluate(ArrayDataSetIterator(
+        features[sl], labels[sl], batch_size, shuffle=False))
+    ev._ensure(n_classes)          # empty shard: zero matrix, not None
+    if nproc > 1:
+        mats = _allgather_host(np.asarray(ev.confusion.matrix))  # [P,C,C]
+        merged = Evaluation(num_classes=ev.num_classes,
+                            labels=ev.label_names)
+        merged._ensure(ev.num_classes)
+        merged.confusion.matrix = np.asarray(mats).sum(
+            axis=0, dtype=np.int64)
+        return merged
+    return ev
+
+
 def export_timeline_html(stats: List[PhaseStats], path: str, *,
                          title: str = "Training phase timeline") -> str:
     """Render collected PhaseStats as an HTML timeline + summary table.

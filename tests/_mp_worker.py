@@ -144,6 +144,18 @@ def main():
     if pid == 0:
         np.save(os.path.join(outdir, "cg_params.npy"), gflat)
 
+    # Distributed evaluation: per-shard eval + cross-process merge
+    # (SparkDl4jMultiLayer.evaluate(JavaRDD) analogue).
+    from deeplearning4j_tpu.parallel.training_master import (
+        distributed_evaluate,
+    )
+
+    ev = distributed_evaluate(net, x, y, batch_size=BATCH)
+    assert int(ev.confusion.matrix.sum()) == N   # every example counted once
+    if pid == 0:
+        np.save(os.path.join(outdir, "eval_confusion.npy"),
+                np.asarray(ev.confusion.matrix))
+
     # Parameter averaging ACROSS processes: local SGD over DCN — each
     # process trains num_workers logical workers on its host shard, then
     # params average over the process boundary (the Spark

@@ -172,3 +172,28 @@ def test_cg_dp_parity_across_processes(pod_result):
     from tests._mp_worker import flat_params
     want = flat_params(net)
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=1e-6)
+
+
+def test_distributed_evaluation_matches_single_process(pod_result):
+    """Per-shard eval + cross-process confusion merge == one-process eval
+    of the full dataset (the Spark evaluate(JavaRDD) flow)."""
+    outdir, _ = pod_result
+    from tests._mp_worker import BATCH, make_data, make_net
+    from deeplearning4j_tpu.parallel.training_master import (
+        distributed_evaluate,
+    )
+
+    got = np.load(os.path.join(outdir, "eval_confusion.npy"))
+    # the pod's net finished training with params saved in final_params;
+    # rebuild that exact net and evaluate the full data single-process
+    blob = np.load(os.path.join(outdir, "final_params.npz"))
+    net = make_net()
+    flat_leaves = [blob[f"p{i}"] for i in range(
+        sum(1 for k in blob.files if k.startswith("p")))]
+    import jax
+    leaves, treedef = jax.tree_util.tree_flatten(net.params_tree)
+    net.params_tree = jax.tree_util.tree_unflatten(
+        treedef, [jax.numpy.asarray(v) for v in flat_leaves])
+    x, y = make_data()
+    ev = distributed_evaluate(net, x, y, batch_size=BATCH)
+    np.testing.assert_array_equal(got, np.asarray(ev.confusion.matrix))

@@ -128,3 +128,58 @@ class TestEstimator:
         assert est.epochs == 7
         with pytest.raises(RuntimeError):
             est.predict(np.zeros((1, 8), np.float32))
+
+
+class TestDistributedEvaluate:
+    """distributed_evaluate shard math + merge (Spark evaluate(JavaRDD)
+    analogue; cross-process end-to-end runs in
+    test_distributed_multiprocess.py)."""
+
+    def _net_and_data(self, n):
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((n, 4)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, n)]
+        net = MultiLayerNetwork(
+            (NeuralNetConfiguration.builder()
+             .seed(1).updater(Sgd(0.1)).activation("tanh")
+             .list(DenseLayer(n_out=8),
+                   OutputLayer(n_out=3, activation="softmax"))
+             .set_input_type(InputType.feed_forward(4))
+             .build())).init()
+        return net, x, y
+
+    def test_single_process_equals_plain_evaluate(self):
+        from deeplearning4j_tpu.data.iterators import ArrayDataSetIterator
+        from deeplearning4j_tpu.parallel import distributed_evaluate
+
+        net, x, y = self._net_and_data(50)
+        a = distributed_evaluate(net, x, y, batch_size=16)
+        b = net.evaluate(ArrayDataSetIterator(x, y, 16))
+        np.testing.assert_array_equal(a.confusion.matrix,
+                                      b.confusion.matrix)
+
+    def test_uneven_shards_cover_every_example(self, monkeypatch):
+        """With n % nproc != 0 the LAST process takes the remainder —
+        shards partition the data exactly."""
+        import deeplearning4j_tpu.parallel.distributed as dist
+        from deeplearning4j_tpu.parallel import distributed_evaluate
+
+        net, x, y = self._net_and_data(65)
+        monkeypatch.setattr(dist, "process_count", lambda: 2)
+        totals = []
+        for k in (0, 1):
+            monkeypatch.setattr(dist, "process_index", lambda k=k: k)
+            ev = distributed_evaluate(net, x, y, batch_size=16)
+            totals.append(int(ev.confusion.matrix.sum()))
+        assert totals == [32, 33]      # 32 + 33 == 65, nothing dropped
+
+    def test_empty_shard_yields_zero_matrix(self, monkeypatch):
+        import deeplearning4j_tpu.parallel.distributed as dist
+        from deeplearning4j_tpu.parallel import distributed_evaluate
+
+        net, x, y = self._net_and_data(3)
+        monkeypatch.setattr(dist, "process_count", lambda: 4)
+        monkeypatch.setattr(dist, "process_index", lambda: 1)
+        ev = distributed_evaluate(net, x, y, batch_size=4)
+        assert ev.confusion.matrix.shape == (3, 3)
+        assert int(ev.confusion.matrix.sum()) == 0
