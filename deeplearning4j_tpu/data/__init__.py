@@ -9,12 +9,13 @@ from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
 from deeplearning4j_tpu.data.iterators import (
     DataSetIterator, ArrayDataSetIterator, AsyncDataSetIterator,
     MultipleEpochsIterator, EarlyTerminationDataSetIterator,
-    BenchmarkDataSetIterator, as_iterator,
+    BenchmarkDataSetIterator, FileSplitDataSetIterator, as_iterator,
 )
 
 __all__ = [
     "DataSet", "MultiDataSet", "DataSetIterator", "ArrayDataSetIterator",
     "AsyncDataSetIterator", "MultipleEpochsIterator",
     "EarlyTerminationDataSetIterator", "BenchmarkDataSetIterator",
+    "FileSplitDataSetIterator",
     "as_iterator",
 ]

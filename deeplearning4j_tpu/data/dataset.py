@@ -64,6 +64,28 @@ class DataSet:
             cat([d.labels_mask for d in datasets]),
         )
 
+    def save(self, path: str) -> str:
+        """Persist as one .npz — the pre-saved-minibatch flow the
+        reference drives with DataSet.save + ExistingMiniBatch/FileSplit
+        iterators and Spark's fitPaths (SparkDl4jMultiLayer.java:259)."""
+        if not path.endswith(".npz"):
+            path += ".npz"       # keep directory iterators able to see it
+        arrays = {"features": self.features}
+        for k in ("labels", "features_mask", "labels_mask"):
+            v = getattr(self, k)
+            if v is not None:
+                arrays[k] = v
+        with open(path, "wb") as f:
+            np.savez(f, **arrays)
+        return path
+
+    @staticmethod
+    def load(path: str) -> "DataSet":
+        blob = np.load(path)
+        g = lambda k: blob[k] if k in blob.files else None
+        return DataSet(blob["features"], g("labels"),
+                       g("features_mask"), g("labels_mask"))
+
 
 @dataclasses.dataclass
 class MultiDataSet:

@@ -14,6 +14,7 @@ augmentation, numpy collation), exactly the role the reference gives it.
 
 from __future__ import annotations
 
+import os
 import queue
 import threading
 from typing import Callable, Iterator, List, Optional
@@ -261,3 +262,40 @@ def as_iterator(data, labels=None, batch_size: int = 32) -> DataSetIterator:
             data.features_mask, data.labels_mask,
         )
     return ArrayDataSetIterator(data, labels, batch_size)
+
+
+class FileSplitDataSetIterator(DataSetIterator):
+    """One pre-saved DataSet file per step. Reference:
+    `datasets/iterator/FileSplitDataSetIterator.java` (file list + load
+    callback) / `ExistingMiniBatchDataSetIterator` — the executor side of
+    Spark's fitPaths (`SparkDl4jMultiLayer.java:259`): minibatches are
+    materialized to storage once, then any number of training runs
+    stream them back. `files`: an iterable of paths or a directory
+    (sorted *.npz); `loader` defaults to DataSet.load."""
+
+    def __init__(self, files, loader=None):
+        if isinstance(files, (str, os.PathLike)):
+            d = os.fspath(files)
+            self.files = [
+                os.path.join(d, n) for n in sorted(os.listdir(d))
+                if n.endswith(".npz")]
+        else:
+            self.files = [os.fspath(f) for f in files]
+        if not self.files:
+            raise ValueError("FileSplitDataSetIterator: no files")
+        self.loader = loader or DataSet.load
+        self._i = 0
+
+    def reset(self):
+        self._i = 0
+
+    def __next__(self):
+        if self._i >= len(self.files):
+            raise StopIteration    # stays exhausted; __iter__ resets
+        ds = self.loader(self.files[self._i])
+        self._i += 1
+        return ds
+
+    @property
+    def batch_size(self):
+        return None   # per-file batch sizes may vary

@@ -245,3 +245,79 @@ class TestGradientCheckpointing:
 
         assert MultiLayerConfiguration.from_json(
             conf.to_json()).gradient_checkpointing
+
+
+class TestFitPathsFlow:
+    """Pre-saved minibatch training: DataSet.save -> FileSplit iterator ->
+    fit/execute_training (reference: DataSet.save +
+    FileSplitDataSetIterator/ExistingMiniBatchDataSetIterator, the
+    executor side of SparkDl4jMultiLayer.fitPaths:259)."""
+
+    def test_save_load_roundtrip_with_masks(self, tmp_path):
+        from deeplearning4j_tpu.data.dataset import DataSet
+
+        rng = np.random.default_rng(0)
+        ds = DataSet(rng.standard_normal((4, 3, 2)).astype(np.float32),
+                     rng.standard_normal((4, 3, 2)).astype(np.float32),
+                     (rng.random((4, 3)) > 0.5).astype(np.float32),
+                     (rng.random((4, 3)) > 0.5).astype(np.float32))
+        p = ds.save(str(tmp_path / "mb.npz"))
+        back = DataSet.load(p)
+        np.testing.assert_array_equal(back.features, ds.features)
+        np.testing.assert_array_equal(back.labels, ds.labels)
+        np.testing.assert_array_equal(back.features_mask, ds.features_mask)
+        np.testing.assert_array_equal(back.labels_mask, ds.labels_mask)
+
+    def test_train_from_saved_minibatches(self, tmp_path):
+        from deeplearning4j_tpu.data import FileSplitDataSetIterator
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.parallel import (
+            ParameterAveragingTrainingMaster,
+        )
+
+        rng = np.random.default_rng(1)
+        x = rng.standard_normal((64, 4)).astype(np.float32)
+        w = rng.standard_normal((4, 3))
+        y = np.eye(3, dtype=np.float32)[(x @ w).argmax(-1)]
+        for i, lo in enumerate(range(0, 64, 16)):
+            DataSet(x[lo:lo + 16], y[lo:lo + 16]).save(
+                str(tmp_path / f"dataset-{i:03d}.npz"))
+
+        net = MultiLayerNetwork(
+            (NeuralNetConfiguration.builder()
+             .seed(2).updater(Sgd(0.3)).activation("tanh")
+             .list(DenseLayer(n_out=8),
+                   OutputLayer(n_out=3, activation="softmax"))
+             .set_input_type(InputType.feed_forward(4))
+             .build())).init()
+        it = FileSplitDataSetIterator(str(tmp_path))
+        assert len(it.files) == 4
+        tm = ParameterAveragingTrainingMaster(
+            num_workers=2, batch_size=8, averaging_frequency=2)
+        s0 = None
+        for _ in range(6):
+            tm.execute_training(net, it)
+            s0 = s0 if s0 is not None else tm.training_stats()[0].score
+        assert tm.training_stats()[-1].score < s0
+
+    def test_missing_dir_raises(self, tmp_path):
+        from deeplearning4j_tpu.data import FileSplitDataSetIterator
+
+        (tmp_path / "empty").mkdir()
+        with pytest.raises(ValueError, match="no files"):
+            FileSplitDataSetIterator(str(tmp_path / "empty"))
+
+    def test_pathlib_dir_and_extension_appended(self, tmp_path):
+        from deeplearning4j_tpu.data import FileSplitDataSetIterator
+        from deeplearning4j_tpu.data.dataset import DataSet
+
+        rng = np.random.default_rng(2)
+        p = DataSet(rng.standard_normal((2, 3)).astype(np.float32)).save(
+            str(tmp_path / "mb"))          # no extension given
+        assert p.endswith("mb.npz")
+        it = FileSplitDataSetIterator(tmp_path)   # pathlib.Path dir
+        batches = list(it)
+        assert len(batches) == 1 and batches[0].features.shape == (2, 3)
+        # exhausted iterator stays exhausted until reset
+        assert next(it, None) is None
+        assert len(list(it)) == 1          # __iter__ resets
