@@ -9,6 +9,7 @@ device (one transfer per batch; double-buffered by AsyncDataSetIterator).
 from __future__ import annotations
 
 import dataclasses
+import os
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -64,10 +65,11 @@ class DataSet:
             cat([d.labels_mask for d in datasets]),
         )
 
-    def save(self, path: str) -> str:
+    def save(self, path) -> str:
         """Persist as one .npz — the pre-saved-minibatch flow the
         reference drives with DataSet.save + ExistingMiniBatch/FileSplit
         iterators and Spark's fitPaths (SparkDl4jMultiLayer.java:259)."""
+        path = os.fspath(path)
         if not path.endswith(".npz"):
             path += ".npz"       # keep directory iterators able to see it
         arrays = {"features": self.features}
@@ -80,11 +82,11 @@ class DataSet:
         return path
 
     @staticmethod
-    def load(path: str) -> "DataSet":
-        blob = np.load(path)
-        g = lambda k: blob[k] if k in blob.files else None
-        return DataSet(blob["features"], g("labels"),
-                       g("features_mask"), g("labels_mask"))
+    def load(path) -> "DataSet":
+        with np.load(os.fspath(path)) as blob:
+            g = lambda k: blob[k] if k in blob.files else None
+            return DataSet(blob["features"], g("labels"),
+                           g("features_mask"), g("labels_mask"))
 
 
 @dataclasses.dataclass
@@ -98,3 +100,43 @@ class MultiDataSet:
 
     def num_examples(self) -> int:
         return int(self.features[0].shape[0])
+
+    def save(self, path) -> str:
+        """One .npz per MultiDataSet (reference: ND4J MultiDataSet.save);
+        arrays keyed f<i>/l<i>/fm<i>/lm<i>, masks optional per slot."""
+        path = os.fspath(path)
+        if not path.endswith(".npz"):
+            path += ".npz"
+        arrays = {}
+        for i, f in enumerate(self.features):
+            arrays[f"f{i}"] = f
+        for i, l in enumerate(self.labels):
+            arrays[f"l{i}"] = l
+        for key, group in (("fm", self.features_masks),
+                           ("lm", self.labels_masks)):
+            for i, m in enumerate(group or []):
+                if m is not None:
+                    arrays[f"{key}{i}"] = m
+        with open(path, "wb") as f:
+            np.savez(f, **arrays)
+        return path
+
+    @staticmethod
+    def load(path) -> "MultiDataSet":
+        blob = np.load(os.fspath(path))
+
+        def group(prefix, n):
+            out = [blob.get(f"{prefix}{i}") for i in range(n)]
+            return out if any(m is not None for m in out) else None
+
+        nf = sum(1 for k in blob.files if k.startswith("f")
+                 and not k.startswith("fm"))
+        nl = sum(1 for k in blob.files if k.startswith("l")
+                 and not k.startswith("lm"))
+        data = dict(blob)
+        blob.close()
+        blob = data
+        return MultiDataSet(
+            [blob[f"f{i}"] for i in range(nf)],
+            [blob[f"l{i}"] for i in range(nl)],
+            group("fm", nf), group("lm", nl))

@@ -295,7 +295,3 @@ class FileSplitDataSetIterator(DataSetIterator):
         ds = self.loader(self.files[self._i])
         self._i += 1
         return ds
-
-    @property
-    def batch_size(self):
-        return None   # per-file batch sizes may vary

@@ -321,3 +321,22 @@ class TestFitPathsFlow:
         # exhausted iterator stays exhausted until reset
         assert next(it, None) is None
         assert len(list(it)) == 1          # __iter__ resets
+
+    def test_multidataset_save_load(self, tmp_path):
+        from deeplearning4j_tpu.data.dataset import MultiDataSet
+
+        rng = np.random.default_rng(3)
+        mds = MultiDataSet(
+            [rng.standard_normal((4, 3)).astype(np.float32),
+             rng.standard_normal((4, 5)).astype(np.float32)],
+            [rng.standard_normal((4, 2)).astype(np.float32)],
+            None,
+            [(rng.random((4,)) > 0.5).astype(np.float32)])
+        p = mds.save(str(tmp_path / "multi"))
+        back = MultiDataSet.load(p)
+        assert len(back.features) == 2 and len(back.labels) == 1
+        np.testing.assert_array_equal(back.features[1], mds.features[1])
+        np.testing.assert_array_equal(back.labels[0], mds.labels[0])
+        assert back.features_masks is None
+        np.testing.assert_array_equal(back.labels_masks[0],
+                                      mds.labels_masks[0])
