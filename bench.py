@@ -520,9 +520,12 @@ def main():
         # one JSON line per model, each through the same child-process
         # ladder + TPU persistence. The driver's default single-model
         # invocation still prints exactly one line.
-        for m in [m.strip() for m in models.split(",") if m.strip()]:
-            os.environ["BENCH_MODEL"] = m
-            _run_ladder()
+        try:
+            for m in [m.strip() for m in models.split(",") if m.strip()]:
+                os.environ["BENCH_MODEL"] = m
+                _run_ladder()
+        finally:  # restore the caller's comma list — in-process callers
+            os.environ["BENCH_MODEL"] = models  # must not see the last model
         return
     _run_ladder()
 

@@ -145,6 +145,11 @@ class RecordReaderDataSetIterator(DataSetIterator):
         self.bs = batch_size
         self.label_index = label_index
         self.num_classes = num_classes
+        # remember whether the caller pinned the width: pinned widths are
+        # validated in _to_dataset (a corrupt label raises rather than
+        # silently widening the one-hot / confusion-matrix width);
+        # inferred widths stay sticky-growing
+        self._num_classes_pinned = num_classes is not None
         self.regression = regression
         # reference: RecordReaderDataSetIterator.setCollectMetaData(true) —
         # each batch then exposes per-example RecordMetaData via
@@ -204,6 +209,14 @@ class RecordReaderDataSetIterator(DataSetIterator):
             y = np.asarray(labs, np.float32).reshape(len(labs), -1)
         else:
             idx = np.asarray(labs, np.int64)
+            if int(idx.min()) < 0:
+                raise ValueError(
+                    f"negative class label {int(idx.min())} — labels must "
+                    "be non-negative integers")
+            if self._num_classes_pinned and int(idx.max()) >= self.num_classes:
+                raise ValueError(
+                    f"label {int(idx.max())} out of range for the "
+                    f"explicitly configured num_classes={self.num_classes}")
             # sticky width: once a class is seen, every later batch (and
             # load_from_meta_data subsets) one-hots to the same width
             n = max(self.num_classes or 0, int(idx.max()) + 1)

@@ -158,6 +158,20 @@ class TokenAnnotator(Annotator):
     def process(self, doc: AnnotatedDocument) -> None:
         sentences = doc.select(TYPE_SENTENCE) or [
             Annotation(TYPE_SENTENCE, 0, len(doc.text))]
+        # case-insensitive fallback text, computed lazily on the first
+        # failed exact find; offsets in it only map back when lowering is
+        # length-preserving (e.g. Turkish dotted capital I lowers to two
+        # code points) — otherwise the fallback stays disabled
+        lowered: Optional[str] = None
+
+        def _lowered() -> str:
+            nonlocal lowered
+            if lowered is None:
+                lowered = doc.text.lower()
+                if len(lowered) != len(doc.text):
+                    lowered = ""
+            return lowered
+
         for s in sentences:
             if self.factory is None:
                 for m in _WORD_RE.finditer(doc.text[s.begin:s.end]):
@@ -169,8 +183,19 @@ class TokenAnnotator(Annotator):
             for tok in self.factory.create(
                     doc.text[s.begin:s.end]).tokens():
                 at = doc.text.find(tok, cursor, s.end)
-                if at < 0:      # preprocessor changed the surface: span
-                    at = cursor  # it best-effort at the cursor
+                if at < 0 and _lowered():
+                    # surface changed (e.g. lowercasing preprocessor):
+                    # retry case-insensitively so spans still point at
+                    # the right characters
+                    at = _lowered().find(tok.lower(), cursor, s.end)
+                if at < 0:
+                    # the preprocessor rewrote the token beyond recovery
+                    # (stemming, n-grams): record a zero-width annotation
+                    # at the cursor rather than spanning wrong characters
+                    # — covered_text() is then "" instead of garbage
+                    doc.add(Annotation(TYPE_TOKEN, cursor, cursor,
+                                       {"word": tok}))
+                    continue
                 doc.add(Annotation(TYPE_TOKEN, at, at + len(tok),
                                    {"word": tok}))
                 cursor = at + len(tok)

@@ -53,13 +53,24 @@ def process_index() -> int:
     return jax.process_index()
 
 
-def host_local_shard(n_examples: int) -> slice:
+def host_local_shard(n_examples: int, balanced: bool = False) -> slice:
     """Deterministic per-host data shard [start, stop) — the input-pipeline
     contract for multi-host data parallelism (each host feeds only its local
-    devices' portion of the global batch)."""
-    per = n_examples // jax.process_count()
-    start = jax.process_index() * per
-    return slice(start, start + per)
+    devices' portion of the global batch).
+
+    With ``balanced=False`` (default) the ``n_examples % process_count``
+    tail is DROPPED — every process gets the same count (what SPMD batch
+    assembly requires). ``balanced=True`` round-robins the remainder to
+    the first processes instead, so the union of shards covers every
+    example (local-SGD / evaluation flows where counts may differ)."""
+    nproc = jax.process_count()
+    per, rem = divmod(n_examples, nproc)
+    pi = jax.process_index()
+    if not balanced:
+        start = pi * per
+        return slice(start, start + per)
+    start = pi * per + min(pi, rem)
+    return slice(start, start + per + (1 if pi < rem else 0))
 
 
 def sync_global_devices(tag: str = "barrier") -> None:
@@ -86,12 +97,37 @@ def put_global(x, sharding):
         typed_key = False
     if typed_key:  # typed PRNG keys: round-trip through raw key data
         data = np.asarray(jax.random.key_data(x))
+        _check_replicated_consistency(data)
         raw = jax.make_array_from_callback(
             data.shape, sharding, lambda idx: data[idx])
         return jax.random.wrap_key_data(raw)
     x = np.asarray(x)
+    _check_replicated_consistency(x)
     return jax.make_array_from_callback(
         x.shape, sharding, lambda idx: x[idx])
+
+
+def _check_replicated_consistency(x) -> None:
+    """Debug guard (DL4J_TPU_CHECK_REPLICATED=1): allgather a checksum of
+    the supposedly process-replicated value and fail fast if hosts
+    diverge (differently seeded nets, drifted RNG streams) instead of
+    silently assembling a global array that mixes values from different
+    hosts. Off by default — it costs one DCN collective per call."""
+    import os
+
+    if os.environ.get("DL4J_TPU_CHECK_REPLICATED") != "1":
+        return
+    import zlib
+
+    from jax.experimental import multihost_utils
+
+    digest = np.uint32(zlib.adler32(np.ascontiguousarray(x).tobytes()))
+    all_digests = np.asarray(multihost_utils.process_allgather(digest))
+    if not (all_digests == all_digests[0]).all():
+        raise AssertionError(
+            "put_global: replicated value differs across processes "
+            f"(per-process adler32 = {all_digests.tolist()}); every host "
+            "must hold an identical copy")
 
 
 def put_global_batch(local, sharding):

@@ -166,8 +166,31 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
                     "multi-controller execute_training requires (features, "
                     "labels) arrays so each process can take its "
                     "host_local_shard")
-            sl = host_local_shard(len(data))
+            # balanced: the n % nproc tail is round-robined across
+            # processes instead of silently dropped (advisor r3 finding).
+            # Shards may then differ by one example; pad the short ones
+            # (wrap-around) up to the max shard size so every process runs
+            # the SAME number of splits — the per-split allgather below
+            # deadlocks if split counts drift.
+            n_all = len(data)
+            if n_all < process_count():
+                # deterministic on every process (same n_all), so all
+                # raise together instead of the empty-shard processes
+                # crashing while the rest deadlock in the allgather
+                raise ValueError(
+                    f"dataset of {n_all} examples cannot shard over "
+                    f"{process_count()} processes")
+            sl = host_local_shard(n_all, balanced=True)
             data, labels = data[sl], labels[sl]
+            target = -(-n_all // process_count())  # ceil = max shard size
+            if len(data) < target:
+                import numpy as _np
+
+                fill = _np.arange(target - len(data)) % len(data)
+                data = _np.concatenate([_np.asarray(data),
+                                        _np.asarray(data)[fill]])
+                labels = _np.concatenate([_np.asarray(labels),
+                                          _np.asarray(labels)[fill]])
         bs = batch_size or self.batch_size
         step = jax.jit(net.make_step_fn())
         graph = hasattr(net, "conf") and hasattr(net.conf, "vertices")
@@ -308,7 +331,21 @@ class DistributedTrainingMaster(TrainingMaster):
                     "multi-controller execute_training requires (features, "
                     "labels) arrays so each process can take its "
                     "host_local_shard; pre-shard iterator inputs manually")
+            if len(data) < nproc:
+                # deterministic on every process: all raise together
+                raise ValueError(
+                    f"dataset of {len(data)} examples cannot shard over "
+                    f"{nproc} processes")
             sl = host_local_shard(len(data))
+            dropped = len(data) % nproc
+            if dropped:
+                import warnings
+
+                warnings.warn(
+                    f"DistributedTrainingMaster: {dropped} of {len(data)} "
+                    "examples dropped (dataset does not divide over "
+                    f"{nproc} processes; SPMD batch assembly needs equal "
+                    "per-host counts — pad the dataset to keep them)")
             data, labels = data[sl], labels[sl]
             # batch_size is the GLOBAL batch: each process iterates its
             # shard in host-local slices; ParallelWrapper._put_batch

@@ -643,3 +643,49 @@ class TestAveragingAndFMeasures:
             ev.precision(averaging="weighted")
         with pytest.raises(ValueError, match="averaging"):
             ev.recall(averaging="Micro")
+
+
+def test_pinned_num_classes_rejects_out_of_range_label():
+    """An explicitly configured num_classes must VALIDATE labels: a
+    corrupt label raises instead of silently widening the one-hot width
+    (advisor r3). Inferred widths (num_classes=None) stay sticky."""
+    import pytest
+
+    from deeplearning4j_tpu.data.records import (
+        CollectionRecordReader, RecordReaderDataSetIterator,
+    )
+
+    recs = [["0.1", "0.2", "0"], ["0.3", "0.4", "5"]]
+    it = RecordReaderDataSetIterator(
+        CollectionRecordReader(recs), batch_size=4, num_classes=2)
+    with pytest.raises(ValueError, match="out of range"):
+        next(it)
+    # inferred width: same data is accepted and widens to 6
+    it2 = RecordReaderDataSetIterator(
+        CollectionRecordReader(recs), batch_size=4)
+    ds = next(it2)
+    assert ds.labels.shape[1] == 6
+
+
+def test_host_local_shard_balanced_covers_all(monkeypatch):
+    """balanced=True round-robins the n % nproc remainder instead of
+    dropping it: shard union == range(n), sizes differ by <= 1."""
+    import jax
+
+    from deeplearning4j_tpu.parallel import distributed as dist
+
+    monkeypatch.setattr(jax, "process_count", lambda: 3)
+    for n in (7, 9, 10, 2):
+        seen = []
+        sizes = []
+        for pi in range(3):
+            monkeypatch.setattr(jax, "process_index", lambda pi=pi: pi)
+            sl = dist.host_local_shard(n, balanced=True)
+            seen.extend(range(n)[sl])
+            sizes.append(len(range(n)[sl]))
+        assert sorted(seen) == list(range(n))
+        assert max(sizes) - min(sizes) <= 1
+        # default (SPMD) mode still gives equal sizes, dropping the tail
+        monkeypatch.setattr(jax, "process_index", lambda: 1)
+        per = len(range(n)[dist.host_local_shard(n)])
+        assert per == n // 3
