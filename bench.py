@@ -19,6 +19,7 @@ Env knobs: BENCH_MODEL=resnet50|vgg16|lstm|sentiment|inception|lenet
 (comma-separate several to sweep the BASELINE configs, one JSON line
 each), BENCH_BATCH, BENCH_STEPS, BENCH_DTYPE, BENCH_ATTEMPT_TIMEOUT (s),
 BENCH_NO_FALLBACK=1, BENCH_S2D=1 (space-to-depth ResNet stem, own
+metric), BENCH_FUSED=1 (Pallas conv-epilogue fusion, own
 metric), BENCH_PROFILE=<dir> (jax.profiler trace of post-warmup steps).
 """
 
@@ -117,6 +118,8 @@ def _bench_resnet50(batch: int, steps: int, dtype: str):
     from deeplearning4j_tpu.zoo import ResNet50
 
     extra = {"stem": "s2d"} if os.environ.get("BENCH_S2D") else {}
+    if os.environ.get("BENCH_FUSED"):  # Pallas conv-epilogue fusion
+        extra["fused"] = True          # (ops/conv_fused.py)
     model = ResNet50(num_classes=1000, input_shape=(224, 224, 3),
                      updater=Nesterovs(0.1, 0.9), **extra)
     conf = dataclasses.replace(model.conf(), dtype=dtype)
@@ -373,8 +376,14 @@ def _metric_name(model: str) -> str:
     name. The s2d stem experiment gets its own metric so it can't mask
     the standard-stem record in bench_last_tpu.json."""
     metric = _BENCHES.get(model, _BENCHES["resnet50"])[1]
-    if model == "resnet50" and os.environ.get("BENCH_S2D"):
-        return "resnet50_s2d_train_images_per_sec_per_chip"
+    if model == "resnet50":
+        tag = ""
+        if os.environ.get("BENCH_S2D"):
+            tag += "_s2d"
+        if os.environ.get("BENCH_FUSED"):
+            tag += "_fused"
+        if tag:
+            return f"resnet50{tag}_train_images_per_sec_per_chip"
     return metric
 
 

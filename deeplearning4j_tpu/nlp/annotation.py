@@ -183,11 +183,13 @@ class TokenAnnotator(Annotator):
             for tok in self.factory.create(
                     doc.text[s.begin:s.end]).tokens():
                 at = doc.text.find(tok, cursor, s.end)
-                if at < 0 and _lowered():
+                ltok = tok.lower()
+                if at < 0 and len(ltok) == len(tok) and _lowered():
                     # surface changed (e.g. lowercasing preprocessor):
                     # retry case-insensitively so spans still point at
-                    # the right characters
-                    at = _lowered().find(tok.lower(), cursor, s.end)
+                    # the right characters (only when the token's own
+                    # lowering is length-preserving too)
+                    at = _lowered().find(ltok, cursor, s.end)
                 if at < 0:
                     # the preprocessor rewrote the token beyond recovery
                     # (stemming, n-grams): record a zero-width annotation

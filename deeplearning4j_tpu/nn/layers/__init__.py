@@ -16,6 +16,7 @@ from deeplearning4j_tpu.nn.layers.convolution import (
     ConvolutionLayer, Convolution1DLayer, SubsamplingLayer, Subsampling1DLayer,
     ZeroPaddingLayer, Upsampling2DLayer, SeparableConvolution2DLayer,
     Deconvolution2DLayer, DepthwiseConvolution2DLayer, Cropping2DLayer,
+    FusedConvBNLayer,
     SpaceToDepthLayer,
 )
 from deeplearning4j_tpu.nn.layers.normalization import (
@@ -39,6 +40,7 @@ __all__ = [
     "Subsampling1DLayer", "ZeroPaddingLayer", "Upsampling2DLayer",
     "SeparableConvolution2DLayer", "Deconvolution2DLayer",
     "DepthwiseConvolution2DLayer", "Cropping2DLayer", "SpaceToDepthLayer",
+    "FusedConvBNLayer",
     "BatchNormalization", "LocalResponseNormalization", "LayerNormalization",
     "GlobalPoolingLayer", "PoolingType",
     "LSTM", "GravesLSTM", "GravesBidirectionalLSTM", "SimpleRnn", "GRU",

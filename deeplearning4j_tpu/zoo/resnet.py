@@ -14,8 +14,9 @@ from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
 from deeplearning4j_tpu.nn.graph import ElementWiseVertex
 from deeplearning4j_tpu.nn.inputs import InputType
 from deeplearning4j_tpu.nn.layers import (
-    ActivationLayer, BatchNormalization, ConvolutionLayer, GlobalPoolingLayer,
-    OutputLayer, SpaceToDepthLayer, SubsamplingLayer, ZeroPaddingLayer,
+    ActivationLayer, BatchNormalization, ConvolutionLayer, FusedConvBNLayer,
+    GlobalPoolingLayer, OutputLayer, SpaceToDepthLayer, SubsamplingLayer,
+    ZeroPaddingLayer,
 )
 from deeplearning4j_tpu.optim.updaters import Nesterovs
 from deeplearning4j_tpu.zoo.base import ZooModel, register_zoo
@@ -56,6 +57,17 @@ class ResNet50(ZooModel):
 
     def _conv_bn(self, g, name, inp, n_out, kernel, stride=(1, 1),
                  pad=(0, 0), act="relu", mode="truncate"):
+        # fused=True: the bottleneck 1x1s (reduce/expand/projection —
+        # ~2/3 of the conv FLOPs) run as ONE Pallas matmul+BN-stats
+        # kernel instead of conv->stats->normalize HBM sweeps
+        # (ops/conv_fused.py; opt-in like stem="s2d" until measured)
+        if (self.kw.get("fused") and tuple(kernel) == (1, 1)
+                and tuple(pad) == (0, 0) and mode != "same"):
+            g.add_layer(f"{name}_convbn",
+                        FusedConvBNLayer(n_out=n_out, stride=stride,
+                                         activation=act),
+                        inp)
+            return f"{name}_convbn"
         g.add_layer(f"{name}_conv",
                     ConvolutionLayer(n_out=n_out, kernel=kernel, stride=stride,
                                      padding=pad, convolution_mode=mode,

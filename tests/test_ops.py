@@ -223,3 +223,147 @@ class TestFlashAttention:
         for a, b in zip(gf, gr):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-3, atol=1e-5)
+
+
+class TestFusedConvBN:
+    """ops/conv_fused.py — the Pallas conv-epilogue fusion (PERF_NOTES
+    sink #2; reference seam: `ConvolutionLayer.java:67-77` +
+    `CudnnBatchNormalizationHelper.java`)."""
+
+    def _ref(self, x, w, gamma, beta, eps=1e-5, relu=True):
+        import jax.numpy as jnp
+
+        y = jnp.einsum("bhwc,cn->bhwn", x, w)
+        m = y.mean(axis=(0, 1, 2))
+        v = y.var(axis=(0, 1, 2))
+        o = gamma * (y - m) / jnp.sqrt(v + eps) + beta
+        return (jnp.maximum(o, 0) if relu else o), m, v
+
+    def _data(self, B=4, H=8, W=8, C=16, N=32, seed=0):
+        import jax.numpy as jnp
+
+        r = np.random.default_rng(seed)
+        return (jnp.asarray(r.standard_normal((B, H, W, C)), jnp.float32),
+                jnp.asarray(r.standard_normal((C, N)) * 0.1, jnp.float32),
+                jnp.asarray(r.random(N) + 0.5, jnp.float32),
+                jnp.asarray(r.standard_normal(N) * 0.1, jnp.float32))
+
+    @pytest.mark.parametrize("relu", [True, False])
+    def test_train_forward_matches_reference(self, relu):
+        from deeplearning4j_tpu.ops.conv_fused import conv1x1_bn_act
+
+        x, w, gamma, beta = self._data()
+        o1, m1, v1 = conv1x1_bn_act(x, w, gamma, beta, train=True,
+                                    relu=relu, interpret=True)
+        o2, m2, v2 = self._ref(x, w, gamma, beta, relu=relu)
+        np.testing.assert_allclose(o1, o2, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(m1, m2, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(v1, v2, rtol=1e-5, atol=1e-6)
+
+    def test_channel_stats_ride_the_matmul(self):
+        from deeplearning4j_tpu.ops.conv_fused import (
+            matmul_with_channel_stats,
+        )
+
+        x, w, _, _ = self._data()
+        x2d = x.reshape(-1, x.shape[-1])
+        y, s, q = matmul_with_channel_stats(x2d, w, interpret=True)
+        ref = np.asarray(x2d) @ np.asarray(w)
+        np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(s, ref.sum(0), rtol=1e-4, atol=1e-3)
+        np.testing.assert_allclose(q, (ref * ref).sum(0), rtol=1e-4,
+                                   atol=1e-3)
+
+    @pytest.mark.parametrize("relu", [True, False])
+    def test_gradients_match_autodiff_reference(self, relu):
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.ops.conv_fused import conv1x1_bn_act
+
+        x, w, gamma, beta = self._data(B=2, H=4, W=4, C=8, N=16, seed=3)
+
+        def lf(x, w, g, b):
+            o, _, _ = conv1x1_bn_act(x, w, g, b, train=True, relu=relu,
+                                     interpret=True)
+            return jnp.sum(jnp.sin(o))
+
+        def lr(x, w, g, b):
+            o, _, _ = self._ref(x, w, g, b, relu=relu)
+            return jnp.sum(jnp.sin(o))
+
+        g1 = jax.grad(lf, argnums=(0, 1, 2, 3))(x, w, gamma, beta)
+        g2 = jax.grad(lr, argnums=(0, 1, 2, 3))(x, w, gamma, beta)
+        for a, b_, name in zip(g1, g2, ("x", "w", "gamma", "beta")):
+            np.testing.assert_allclose(a, b_, rtol=1e-3, atol=1e-3,
+                                       err_msg=name)
+
+    def test_stride_equals_subsampled_conv(self):
+        from deeplearning4j_tpu.ops.conv_fused import conv1x1_bn_act
+
+        x, w, gamma, beta = self._data()
+        o, m, v = conv1x1_bn_act(x, w, gamma, beta, train=True,
+                                 stride=(2, 2), interpret=True)
+        o2, m2, v2 = self._ref(x[:, ::2, ::2, :], w, gamma, beta)
+        np.testing.assert_allclose(o, o2, rtol=1e-4, atol=1e-5)
+
+    def test_layer_matches_conv_plus_bn_stack(self):
+        """FusedConvBNLayer == ConvolutionLayer + BatchNormalization to
+        float32 accuracy, including the running-stat update and the eval
+        path."""
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.nn.inputs import InputType
+        from deeplearning4j_tpu.nn.layers import (
+            BatchNormalization, ConvolutionLayer, FusedConvBNLayer,
+        )
+
+        it = InputType.convolutional(8, 8, 16)
+        key = jax.random.PRNGKey(0)
+        fused = FusedConvBNLayer(n_out=32, stride=(2, 2),
+                                 activation="relu",
+                                 weight_init="xavier").infer_n_in(it)
+        conv = ConvolutionLayer(n_out=32, kernel=(1, 1), stride=(2, 2),
+                                has_bias=False, activation="identity",
+                                weight_init="xavier").infer_n_in(it)
+        bn = BatchNormalization(activation="relu").infer_n_in(
+            conv.output_type(it))
+        pf, sf = fused.init_params(key, it)
+        pc, _ = conv.init_params(key, it)
+        pb, sb = bn.init_params(key, conv.output_type(it))
+        pc["W"] = pf["W"]  # same weights
+
+        x = jnp.asarray(np.random.default_rng(5).standard_normal(
+            (4, 8, 8, 16)), jnp.float32)
+        of, sf2 = fused.apply(pf, x, state=sf, train=True)
+        oc, _ = conv.apply(pc, x, train=True)
+        ob, sb2 = bn.apply(pb, oc, state=sb, train=True)
+        np.testing.assert_allclose(of, ob, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(sf2["mean"], sb2["mean"], rtol=1e-4,
+                                   atol=1e-6)
+        np.testing.assert_allclose(sf2["var"], sb2["var"], rtol=1e-4,
+                                   atol=1e-6)
+        # eval path with the updated running stats
+        oe, _ = fused.apply(pf, x, state=sf2, train=False)
+        oce, _ = conv.apply(pc, x, train=False)
+        obe, _ = bn.apply(pb, oce, state=sb2, train=False)
+        np.testing.assert_allclose(oe, obe, rtol=1e-4, atol=1e-5)
+
+    def test_fallback_on_untileable_shape(self):
+        """Shapes that do not tile (e.g. prime M) fall back to XLA and
+        stay correct."""
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.ops.conv_fused import (
+            matmul_with_channel_stats, pick_blocks,
+        )
+
+        assert pick_blocks(7 * 13, 3, 5) is None
+        r = np.random.default_rng(0)
+        x2d = jnp.asarray(r.standard_normal((91, 3)), jnp.float32)
+        w = jnp.asarray(r.standard_normal((3, 5)), jnp.float32)
+        y, s, q = matmul_with_channel_stats(x2d, w, interpret=True)
+        ref = np.asarray(x2d) @ np.asarray(w)
+        np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(s, ref.sum(0), rtol=1e-5, atol=1e-4)

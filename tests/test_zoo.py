@@ -241,3 +241,52 @@ class TestSpaceToDepthStem:
         with pytest.raises(ValueError, match="divide"):
             SpaceToDepthLayer(block=2).output_type(
                 InputType.convolutional(15, 16, 3))
+
+
+class TestFusedResNet:
+    def test_fused_resnet_matches_unfused(self):
+        """ResNet50(fused=True) reproduces the unfused graph's forward
+        output when given the same weights (the fused layer replaces each
+        bottleneck 1x1 conv+BN pair)."""
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.models import ComputationGraph
+        from deeplearning4j_tpu.zoo import ResNet50
+
+        kw = dict(num_classes=6, input_shape=(64, 64, 3))
+        std = ComputationGraph(ResNet50(**kw).conf()).init()
+        fus = ComputationGraph(ResNet50(fused=True, **kw).conf()).init()
+        # copy weights: {name}_conv/W + {name}_bn/{gamma,beta} ->
+        # {name}_convbn/{W,gamma,beta}
+        for lname, p in fus.params_tree.items():
+            if lname.endswith("_convbn"):
+                base = lname[:-len("_convbn")]
+                p["W"] = std.params_tree[f"{base}_conv"]["W"]
+                p["gamma"] = std.params_tree[f"{base}_bn"]["gamma"]
+                p["beta"] = std.params_tree[f"{base}_bn"]["beta"]
+            elif lname in std.params_tree:
+                for k in p:
+                    p[k] = std.params_tree[lname][k]
+        x = jnp.asarray(np.random.default_rng(2).standard_normal(
+            (2, 64, 64, 3)), jnp.float32)
+        a = np.asarray(std.output(x))
+        b = np.asarray(fus.output(x))
+        np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-4)
+
+    def test_fused_resnet_trains(self):
+        from deeplearning4j_tpu.data.dataset import MultiDataSet
+        from deeplearning4j_tpu.models import ComputationGraph
+        from deeplearning4j_tpu.optim.updaters import Sgd
+        from deeplearning4j_tpu.zoo import ResNet50
+
+        net = ComputationGraph(ResNet50(
+            num_classes=4, input_shape=(64, 64, 3), fused=True,
+            updater=Sgd(1e-3)).conf()).init()
+        rng = np.random.default_rng(0)
+        x = rng.standard_normal((4, 64, 64, 3)).astype(np.float32)
+        y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 4)]
+        mds = MultiDataSet([x], [y])
+        s0 = net.score(mds)
+        for _ in range(6):
+            net.fit(mds)
+        s1 = net.score(mds)
+        assert np.isfinite(s1) and s1 < s0
