@@ -494,6 +494,29 @@ def _record_last_tpu(result):
                 if k in result}
         blob["recorded_at_unix"] = time.time()
         records = _load_tpu_records()
+        prev = records.get(blob["metric"])
+        # in-tree perf regression guard (reference precedent:
+        # BenchmarkDataSetIterator throughput fixtures): a new TPU
+        # measurement >5% below the carried record is flagged loudly on
+        # stderr AND in the record itself — the carried value keeps the
+        # best measurement so a flaky slow run can't lower the bar
+        if prev and "value" in prev and prev["value"] > 0:
+            # compare against the best value ever carried, not just the
+            # last record — otherwise repeated sub-5% drops could ratchet
+            # the bar down without ever flagging
+            best = max(prev["value"], prev.get("best_value", 0.0))
+            ratio = blob["value"] / best
+            if ratio < 0.95:
+                blob["regression_vs_last"] = round(ratio, 4)
+                print(f"[bench] PERF REGRESSION: {blob['metric']} "
+                      f"{blob['value']:.1f} is {100 * (1 - ratio):.1f}% "
+                      f"below the carried TPU record {best:.1f}",
+                      file=sys.stderr)
+                records[blob["metric"] + "__regressed"] = blob
+                blob = prev  # keep the best verified record
+            else:
+                blob["best_value"] = max(blob["value"], best)
+                records.pop(blob["metric"] + "__regressed", None)
         records[blob["metric"]] = blob
         tmp = _LAST_TPU_FILE + ".tmp"
         with open(tmp, "w") as f:
