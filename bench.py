@@ -507,7 +507,7 @@ def _record_last_tpu(result):
             best = max(prev["value"], prev.get("best_value", 0.0))
             ratio = blob["value"] / best
             if ratio < 0.95:
-                blob["regression_vs_last"] = round(ratio, 4)
+                blob["regression_vs_best"] = round(ratio, 4)
                 print(f"[bench] PERF REGRESSION: {blob['metric']} "
                       f"{blob['value']:.1f} is {100 * (1 - ratio):.1f}% "
                       f"below the carried TPU record {best:.1f}",

@@ -148,7 +148,8 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
 
     def execute_training(self, net, data, labels=None, *,
                          batch_size: Optional[int] = None,
-                         epochs: int = 1) -> None:
+                         epochs: int = 1, start_split: int = 0,
+                         on_split_end=None) -> None:
         """Multi-controller (jax.process_count() > 1): each process runs
         its `num_workers` LOCAL workers over its `host_local_shard` of the
         data, then params/updater state are averaged ACROSS processes too
@@ -194,10 +195,22 @@ class ParameterAveragingTrainingMaster(TrainingMaster):
         bs = batch_size or self.batch_size
         step = jax.jit(net.make_step_fn())
         graph = hasattr(net, "conf") and hasattr(net.conf, "vertices")
+        # `si` counts splits GLOBALLY across epochs so preemption
+        # recovery can skip already-trained splits (`start_split`) after
+        # a checkpoint restore — the restored net already carries their
+        # effect (params + iteration), so skipped splits touch nothing.
+        # `on_split_end(si, net)` is the per-split hook (the reference's
+        # TrainingHook / ParameterAveragingTrainingHook seam,
+        # `spark/parameterserver/ParameterServerTrainingHook.java:22`).
+        si = 0
         for _ in range(epochs):
             it = as_iterator(data, labels, bs)
-            for si, (xs, ys) in enumerate(self._splits(it)):
-                self._run_split(net, step, si, xs, ys, bs, graph)
+            for xs, ys in self._splits(it):
+                if si >= start_split:
+                    self._run_split(net, step, si, xs, ys, bs, graph)
+                    if on_split_end is not None:
+                        on_split_end(si, net)
+                si += 1
         net.score_ = self._stats[-1].score if self._stats else net.score_
 
     def _run_split(self, net, step, si, xs, ys, bs, graph):
