@@ -57,13 +57,26 @@ def _page(title: str, body_html: str, page: str = "") -> str:
     """Page shell: server-rendered SVG snapshot inside #live (no-JS
     fallback, refreshed by <noscript> meta), overwritten every 2 s by the
     polling client /js/app.js (reference: the Play UI's flot-based JS
-    polling dashboards)."""
-    nav = ('<nav><a href="/train/overview.html">overview</a>'
-           '<a href="/train/model.html">model</a>'
-           '<a href="/train/histogram.html">histograms</a>'
-           '<a href="/train/flow.html">flow</a>'
-           '<a href="/train/system.html">system</a>'
-           '<a href="/tsne.html">t-SNE</a></nav>')
+    polling dashboards). Nav chrome is localized through the i18n layer
+    (reference: DefaultI18N + train.nav.* resource keys)."""
+    from deeplearning4j_tpu.ui.i18n import i18n
+
+    t = i18n().get_message
+    langs = "".join(
+        f'<a href="/setlang/{code}">{code}</a>'
+        for code in i18n().languages())
+    nav = (f'<nav><a href="/train/overview.html">'
+           f'{t("train.nav.overview")}</a>'
+           f'<a href="/train/model.html">{t("train.nav.model")}</a>'
+           f'<a href="/train/histogram.html">'
+           f'{t("train.nav.histogram")}</a>'
+           f'<a href="/train/flow.html">{t("train.nav.flow")}</a>'
+           f'<a href="/train/system.html">{t("train.nav.system")}</a>'
+           f'<a href="/tsne.html">{t("train.nav.tsne")}</a>'
+           f'<a href="/train/activations.html">'
+           f'{t("train.nav.activations")}</a>'
+           f'<span class=meta> {t("train.nav.language")}: {langs}'
+           '</span></nav>')
     return (f"<!doctype html><html><head><meta charset=utf-8>"
             f"<title>{title}</title>"
             f"<style>{_CSS}</style>"
@@ -123,13 +136,62 @@ class _Handler(BaseHTTPRequestHandler):
                 "t-SNE", self._tsne_html(storage), "tsne"), "text/html"),
             "/js/app.js": lambda: self._send(
                 200, APP_JS, "text/javascript"),
+            # reference: ConvolutionalListenerModule routes /activations
+            # (page) + /activations/data (latest rendered image)
+            "/train/activations.html": lambda: self._send(
+                200, self._activations_html(), "text/html"),
+            "/train/activations": lambda: self._send(
+                200, self._activations_html(), "text/html"),
+            "/train/activations/data": lambda: self._send(
+                200, self._activations_png(storage), "image/png"),
+            "/lang": lambda: self._send_json(self._lang_data()),
         }
+        if path.startswith("/setlang/"):
+            return self._set_lang(path.rsplit("/", 1)[1])
         fn = routes.get(path, routes[""] if path == "/" else None)
         if fn is None and path in routes:   # aliases to overview page
             fn = routes[""]
         if fn is None:
             return self._send(404, "not found", "text/plain")
         return fn()
+
+    # ------------------------------------------- conv activations + i18n
+    def _activations_png(self, storage) -> bytes:
+        from deeplearning4j_tpu.ui.convolutional import (
+            empty_png, latest_activation_png,
+        )
+
+        if storage is None:
+            return empty_png()
+        return latest_activation_png(storage)
+
+    def _activations_html(self) -> str:
+        from deeplearning4j_tpu.ui.i18n import i18n
+
+        title = i18n().get_message("train.activations.title")
+        body = ('<img id=actimg src="/train/activations/data" '
+                'alt="conv activations" '
+                'style="image-rendering:pixelated;min-width:256px">'
+                "<script>setInterval(function(){"
+                "document.getElementById('actimg').src="
+                "'/train/activations/data?t='+Date.now();},2000);"
+                "</script>")
+        return _page(title, body, "activations")
+
+    def _lang_data(self):
+        from deeplearning4j_tpu.ui.i18n import i18n
+
+        return {"current": i18n().get_default_language(),
+                "available": i18n().languages()}
+
+    def _set_lang(self, code: str):
+        from deeplearning4j_tpu.ui.i18n import i18n
+
+        if code in i18n().languages():
+            i18n().set_default_language(code)
+        self.send_response(302)
+        self.send_header("Location", "/train/overview.html")
+        self.end_headers()
 
     # ----------------------------------------------------- data assembly
     def _updates(self, storage) -> List[Persistable]:
@@ -153,12 +215,18 @@ class _Handler(BaseHTTPRequestHandler):
     def _static(self, storage) -> Dict[str, Any]:
         if storage is None:
             return {}
-        for sid in reversed(storage.list_session_ids()):
-            for tid in storage.list_type_ids(sid):
-                for wid in storage.list_worker_ids(sid, tid):
-                    st = storage.get_static_info(sid, tid, wid)
-                    if st:
-                        return st.content
+        # image-typed records (ConvolutionalListener) also live in static
+        # storage; the dashboards' metadata must come from a model-info
+        # record, so prefer StatsListener and fall back to anything else
+        for only_stats in (True, False):
+            for sid in reversed(storage.list_session_ids()):
+                for tid in storage.list_type_ids(sid):
+                    if only_stats != (tid == "StatsListener"):
+                        continue
+                    for wid in storage.list_worker_ids(sid, tid):
+                        st = storage.get_static_info(sid, tid, wid)
+                        if st:
+                            return st.content
         return {}
 
     def _overview(self, storage):

@@ -465,3 +465,134 @@ class TestLiveModules:
             assert "(parameters)" in page
         finally:
             server.stop()
+
+
+class TestConvolutionalModule:
+    """Reference: ConvolutionalListenerModule.java:29-52 +
+    ConvolutionalIterationListener — feature maps rendered server-side,
+    latest image served at /train/activations/data."""
+
+    def _conv_net(self):
+        from deeplearning4j_tpu import InputType
+        from deeplearning4j_tpu.models import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.layers import (
+            ConvolutionLayer, DenseLayer, OutputLayer, SubsamplingLayer,
+        )
+        from deeplearning4j_tpu.optim.updaters import Sgd
+
+        conf = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(0.1))
+                .activation("relu")
+                .list(ConvolutionLayer(n_out=4, kernel=(3, 3)),
+                      SubsamplingLayer(pooling="max", kernel=(2, 2),
+                                       stride=(2, 2)),
+                      DenseLayer(n_out=16),
+                      OutputLayer(n_out=3, activation="softmax"))
+                .set_input_type(InputType.convolutional(10, 10, 1))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def test_png_encoder_roundtrip(self):
+        import struct
+        import zlib
+
+        from deeplearning4j_tpu.ui.convolutional import (
+            encode_grayscale_png,
+        )
+
+        img = (np.arange(48).reshape(6, 8) * 5).astype(np.uint8)
+        png = encode_grayscale_png(img)
+        assert png[:8] == b"\x89PNG\r\n\x1a\n"
+        w, h = struct.unpack(">II", png[16:24])
+        assert (w, h) == (8, 6)
+        # decode the IDAT scanlines back (filter byte 0 per row)
+        idat_len = struct.unpack(">I", png[33:37])[0]
+        raw = zlib.decompress(png[41:41 + idat_len])
+        rows = [raw[r * 9 + 1:(r + 1) * 9] for r in range(6)]
+        np.testing.assert_array_equal(
+            np.frombuffer(b"".join(rows), np.uint8).reshape(6, 8), img)
+
+    def test_tile_feature_maps_grid(self):
+        from deeplearning4j_tpu.ui.convolutional import tile_feature_maps
+
+        act = np.random.default_rng(0).random((5, 5, 7)).astype(np.float32)
+        grid = tile_feature_maps(act)
+        # 7 maps -> 3x3 grid with 1px separators
+        assert grid.shape == (3 * 6 + 1, 3 * 6 + 1)
+        assert grid.dtype == np.uint8
+        # first map occupies [1:6, 1:6] normalized to 0..255
+        m0 = act[:, :, 0]
+        want = ((m0 - m0.min()) / (m0.max() - m0.min()) * 255).astype(
+            np.uint8)
+        np.testing.assert_array_equal(grid[1:6, 1:6], want)
+
+    def test_listener_posts_and_server_serves_png(self):
+        from deeplearning4j_tpu.ui.convolutional import (
+            ConvolutionalIterationListener, empty_png,
+        )
+
+        server = UIServer(port=0)
+        try:
+            st = InMemoryStatsStorage()
+            server.attach(st)
+            url = f"http://127.0.0.1:{server.port}"
+            # before any report: the placeholder image
+            before = urllib.request.urlopen(
+                url + "/train/activations/data").read()
+            assert before == empty_png()
+            net = self._conv_net()
+            net.set_listeners(ConvolutionalIterationListener(
+                st, frequency=1))
+            r = np.random.default_rng(0)
+            x = r.random((8, 10, 10, 1)).astype(np.float32)
+            y = np.eye(3, dtype=np.float32)[r.integers(0, 3, 8)]
+            net.fit(x, y, epochs=1, batch_size=8)
+            png = urllib.request.urlopen(
+                url + "/train/activations/data").read()
+            assert png[:8] == b"\x89PNG\r\n\x1a\n"
+            assert len(png) > len(before)
+            sid = st.list_session_ids()[0]
+            rec = st.get_static_info(sid, "ConvolutionalListener", "local")
+            # conv + pooling layers (4D activations) are both rendered
+            assert len(rec.content["layers"]) == 2
+            page = urllib.request.urlopen(
+                url + "/train/activations.html").read().decode()
+            assert "actimg" in page and "/train/activations/data" in page
+        finally:
+            server.stop()
+
+
+class TestI18N:
+    def test_message_lookup_and_fallback(self):
+        from deeplearning4j_tpu.ui.i18n import DefaultI18N
+
+        i = DefaultI18N()  # fresh instance, not the singleton
+        assert i.get_message("train.nav.overview") == "Overview"
+        assert i.get_message("train.nav.overview", "ja") == "概要"
+        assert i.get_message("train.nav.overview", "de") == "Übersicht"
+        # missing key in selected language falls back to en, then key
+        i.load_properties("xx", "train.custom=Xx!")
+        assert i.get_message("train.custom", "xx") == "Xx!"
+        assert i.get_message("train.nav.overview", "xx") == "Overview"
+        assert i.get_message("no.such.key") == "no.such.key"
+        # reference language set: the six shipped by the Play UI
+        assert set(i.languages()) >= {"de", "en", "ja", "ko", "ru", "zh"}
+
+    def test_server_nav_localizes(self):
+        from deeplearning4j_tpu.ui.i18n import i18n
+
+        server = UIServer(port=0)
+        try:
+            url = f"http://127.0.0.1:{server.port}"
+            page = urllib.request.urlopen(url + "/").read().decode()
+            assert ">Overview</a>" in page
+            # switch language via the /setlang route (302 redirect)
+            urllib.request.urlopen(url + "/setlang/ja")
+            page = urllib.request.urlopen(url + "/").read().decode()
+            assert "概要" in page
+            data = json.loads(urllib.request.urlopen(
+                url + "/lang").read())
+            assert data["current"] == "ja"
+        finally:
+            i18n().set_default_language("en")
+            server.stop()
