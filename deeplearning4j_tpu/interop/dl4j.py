@@ -79,7 +79,13 @@ def _read_buffer(f) -> Tuple[str, np.ndarray]:
     if dtype not in _DTYPES:
         raise ValueError(f"unsupported ND4J buffer dtype {dtype!r}")
     fmt, size = _DTYPES[dtype]
-    data = np.frombuffer(f.read(length * size), dtype=fmt, count=length)
+    raw = f.read(length * size)
+    if len(raw) != length * size:
+        raise ValueError(
+            f"truncated ND4J buffer: header promises {length} {dtype} "
+            f"elements ({length * size} bytes) but only {len(raw)} bytes "
+            "remain — corrupt or cut-off coefficients/updater stream")
+    data = np.frombuffer(raw, dtype=fmt, count=length)
     return alloc, data
 
 
@@ -572,8 +578,15 @@ def import_dl4j_model(path, *, input_type=None, updater=None, dtype=None):
             if entry in zf.namelist():
                 try:
                     upd_raw = read_nd4j_array(zf.read(entry))
-                except ValueError:
-                    pass   # old updater.bin is Java serialization, skip
+                except ValueError as e:
+                    # old updater.bin is Java serialization — silent skip
+                    # is correct; a corrupt/truncated updaterState.bin
+                    # must be VISIBLE (params still import fine)
+                    if entry == "updaterState.bin":
+                        import warnings
+
+                        warnings.warn(
+                            f"ignoring unreadable {entry}: {e}")
                 break
 
     if "vertices" in conf_json:

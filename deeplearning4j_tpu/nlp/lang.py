@@ -445,3 +445,256 @@ class JapaneseMorphologicalAnalyzer:
         if cls == "hiragana":
             return Morpheme(tok, "助詞", _hira_to_kata(tok), tok)
         return Morpheme(tok, "名詞", None, tok)   # unknown kanji
+
+
+# --------------------------------------------------------------------------
+# Korean morphological analysis (stem/josa/eomi decomposition + POS)
+# --------------------------------------------------------------------------
+# Reference: `deeplearning4j-nlp-korean/.../KoreanTokenizer.java:34` wraps
+# twitter-korean-text, whose tokenizer is MORPHOLOGY-based: each eojeol
+# (space unit) decomposes into stem + particle (josa) / verb ending
+# (eomi), tagged with KoreanPos (Noun, Verb, Adjective, Josa, Eomi,
+# Number, Foreign, Punctuation, ...), with conjugated verbs recovered to
+# their dictionary form. Same capability here on an embedded dictionary
+# core (like JA above): jamo-aware de-conjugation handles the 았/었
+# contraction (가+았→갔, 하+았→했, ...) arithmetically.
+
+_HANGUL_BASE = 0xAC00
+_JUNGSEONG = 21
+_JONGSEONG = 28
+# jongseong (final consonant) index of ㅆ in the syllable formula
+_JONG_SS = 20
+
+
+def _hangul_decompose(ch: str):
+    """Syllable -> (initial, vowel, final) indices, or None."""
+    code = ord(ch) - _HANGUL_BASE
+    if not 0 <= code < 11172:
+        return None
+    return (code // (_JUNGSEONG * _JONGSEONG),
+            (code // _JONGSEONG) % _JUNGSEONG,
+            code % _JONGSEONG)
+
+
+def _hangul_compose(ini: int, vow: int, fin: int) -> str:
+    return chr(_HANGUL_BASE + (ini * _JUNGSEONG + vow) * _JONGSEONG + fin)
+
+
+# contracted-syllable vowel -> [(stem vowel, 았/었), ...] candidates:
+# the ㅆ-final syllable's vowel encodes which stem vowel absorbed the
+# 아/어 row.  가+았→갔 (ㅏ→ㅏ), 하+였→했 (ㅐ→ㅏ irregular), 오+았→왔 /
+# 보+았→봤 (ㅘ→ㅗ), 주+었→줬 (ㅝ→ㅜ), 되+었→됐 (ㅙ→ㅚ), 마시+었→마셨
+# (ㅕ→ㅣ), 서+었→섰 (ㅓ→ㅓ). Multiple candidates (e.g. ㅐ could be a
+# genuine ㅐ stem) are all tried against the stem dictionary.
+_PAST_BY_VOWEL = {
+    0: [(0, "았")],               # ㅏ
+    1: [(0, "았"), (1, "었")],    # ㅐ: 하-irregular first, ㅐ stems second
+    9: [(8, "았")],               # ㅘ -> ㅗ
+    4: [(4, "었")],               # ㅓ
+    14: [(13, "었")],             # ㅝ -> ㅜ
+    10: [(11, "었")],             # ㅙ -> ㅚ
+    6: [(20, "었")],              # ㅕ -> ㅣ
+    20: [(20, "었")],             # ㅣ
+}
+
+KO_NOUNS = set(
+    "학교 학생 선생님 친구 사람 시간 오늘 내일 어제 한국 서울 책 물 밥 집 "
+    "회사 일 말 나라 세계 문제 공부 연구 영화 음식 음악 아침 저녁 점심 "
+    "이름 생각 마음 이야기 가족 아버지 어머니 동생 언니 형 누나".split())
+KO_PRONOUNS = set("나 너 저 우리 그 그녀 누구 무엇 이것 그것 저것".split())
+KO_ADVERBS = set("매우 아주 너무 잘 못 더 다시 같이 빨리 천천히 많이".split())
+# verb/adjective STEMS -> (dictionary form, pos)
+KO_STEMS = {}
+for _stem in "가 오 하 먹 보 있 없 되 주 받 만나 사 배우 읽 듣 마시 만들".split():
+    KO_STEMS[_stem] = (_stem + "다", "Verb")
+for _stem in "좋 크 작 예쁘 많 적 높 낮 길 짧".split():
+    KO_STEMS[_stem] = (_stem + "다", "Adjective")
+for _stem in "좋아하 공부하 일하 사랑하 말하 생각하".split():
+    KO_STEMS[_stem] = (_stem + "다", "Verb")
+
+# verb endings (eomi), matched longest-first AFTER de-contraction
+_KO_EOMI = ("습니다", "ㅂ니다", "었습니다", "았습니다", "어요", "아요",
+            "었어요", "았어요", "었다", "았다", "는다", "ㄴ다", "지만",
+            "어서", "아서", "으면", "고", "면", "게", "기", "며", "다")
+_KO_EOMI_BY_LEN = tuple(sorted(_KO_EOMI, key=len, reverse=True))
+_JOSA_BY_LEN = tuple(sorted(_JOSA, key=len, reverse=True))
+
+
+@_dc.dataclass(frozen=True)
+class KoMorpheme:
+    """twitter-korean-text KoreanToken analogue: surface + KoreanPos tag
+    + dictionary base form for inflected stems."""
+
+    surface: str
+    pos: str                      # Noun/Verb/Adjective/Josa/Eomi/...
+    base: Optional[str] = None    # 가 -> 가다 for verb/adjective stems
+
+
+class KoreanMorphologicalAnalyzer:
+    """Morphology-based Korean analysis (the reference tokenizer's
+    capability): eojeol -> stem + josa / eomi with POS tags and
+    de-conjugated dictionary forms."""
+
+    def __init__(self, user_nouns=None):
+        self.nouns = set(KO_NOUNS)
+        if user_nouns:
+            self.nouns.update(user_nouns)
+
+    # ---- de-contraction: expand 갔 -> 가았, 왔 -> 오았, 했 -> 하았 ----
+    @staticmethod
+    def _expand_past(word: str) -> List[str]:
+        out: List[str] = []
+        for i, ch in enumerate(word):
+            d = _hangul_decompose(ch)
+            if d is None or d[2] != _JONG_SS:
+                continue
+            ini, vow, _ = d
+            for stem_vow, past in _PAST_BY_VOWEL.get(vow, ()):
+                stem_ch = _hangul_compose(ini, stem_vow, 0)
+                out.append(word[:i] + stem_ch + past + word[i + 1:])
+        return out
+
+    def _try_stem(self, w: str):
+        """Match stem + eomi (after de-contraction); None if not verbal."""
+        for cand in (w, *self._expand_past(w)):
+            for eomi in _KO_EOMI_BY_LEN:
+                if not cand.endswith(eomi) or len(cand) <= len(eomi):
+                    continue
+                stem = cand[:-len(eomi)]
+                if stem in KO_STEMS:
+                    base, pos = KO_STEMS[stem]
+                    return [KoMorpheme(stem, pos, base),
+                            KoMorpheme(eomi, "Eomi")]
+        return None
+
+    def _split_josa(self, w: str):
+        for josa in _JOSA_BY_LEN:
+            if len(w) > len(josa) and w.endswith(josa):
+                return w[:-len(josa)], josa
+        return w, None
+
+    def analyze(self, text: str) -> List[KoMorpheme]:
+        out: List[KoMorpheme] = []
+        for word in text.split():
+            core = word.strip(".,!?…·()[]\"'")
+            at = word.find(core) if core else len(word)
+            for ch in word[:at]:
+                out.append(KoMorpheme(ch, "Punctuation"))
+            if core:
+                out.extend(self._analyze_word(core))
+            for ch in word[at + len(core):]:
+                out.append(KoMorpheme(ch, "Punctuation"))
+        return out
+
+    def _analyze_word(self, w: str) -> List[KoMorpheme]:
+        if w.isdigit():
+            return [KoMorpheme(w, "Number")]
+        if all(_char_class(c) != "hangul" for c in w):
+            return [KoMorpheme(w, "Foreign")]
+        verbal = self._try_stem(w)
+        if verbal is not None:
+            return verbal
+        stem, josa = self._split_josa(w)
+        if josa is not None:
+            morphs = (self._try_stem(stem)
+                      if stem not in self.nouns
+                      and stem not in KO_PRONOUNS else None)
+            if morphs is None:
+                pos = "Pronoun" if stem in KO_PRONOUNS else "Noun"
+                morphs = [KoMorpheme(stem, pos)]
+            return morphs + [KoMorpheme(josa, "Josa")]
+        if w in KO_PRONOUNS:
+            return [KoMorpheme(w, "Pronoun")]
+        if w in KO_ADVERBS:
+            return [KoMorpheme(w, "Adverb")]
+        return [KoMorpheme(w, "Noun")]
+
+
+class KoreanMorphologicalTokenizerFactory(TokenizerFactory):
+    """Tokenizer over the morphological analysis (the reference
+    KoreanTokenizer emits every morpheme — stems AND particles — as
+    tokens; `KoreanTokenizer.java:41-48`)."""
+
+    def __init__(self, keep_particles: bool = False, user_nouns=None):
+        super().__init__()
+        self.keep_particles = keep_particles
+        self._an = KoreanMorphologicalAnalyzer(user_nouns)
+
+    def create(self, text: str) -> Tokenizer:
+        toks = []
+        for m in self._an.analyze(text):
+            if m.pos == "Punctuation":
+                continue
+            if not self.keep_particles and m.pos in ("Josa", "Eomi"):
+                continue
+            toks.append(m.surface)
+        return _ListTokenizer(toks, self._pre)
+
+
+# --------------------------------------------------------------------------
+# Chinese part-of-speech tagging (ansj nature analogue)
+# --------------------------------------------------------------------------
+# Reference: `deeplearning4j-nlp-chinese/.../ChineseTokenizer.java` wraps
+# the ansj analyzer, whose terms carry a "nature" POS tag (n/v/a/d/r/
+# m/q/p/c/u/w/en). Same tag alphabet here over the lattice segmentation.
+
+_ZH_POS: dict = {}
+for _w in "的 了 着 过 之 地 得".split():
+    _ZH_POS[_w] = "u"        # particle
+for _w in ("我 你 他 她 它 我们 你们 他们 她们 自己 大家 这 那 这个 那个 "
+           "什么 谁").split():
+    _ZH_POS[_w] = "r"        # pronoun
+for _w in ("是 有 来 到 说 去 会 要 知道 喜欢 觉得 认为 希望 需要 学习 "
+           "工作 研究 发展 开始 结束 出 可以 没有").split():
+    _ZH_POS[_w] = "v"        # verb
+for _w in "大 小 好 新 高 美 多 少 长 短 快 慢".split():
+    _ZH_POS[_w] = "a"        # adjective
+for _w in "很 也 就 都 不 还 已经 再 只 更 最".split():
+    _ZH_POS[_w] = "d"        # adverb
+for _w in "在 从 对 为 把 被 向 于 给".split():
+    _ZH_POS[_w] = "p"        # preposition
+for _w in "和 与 或 但是 因为 所以 如果 虽然 而且".split():
+    _ZH_POS[_w] = "c"        # conjunction
+for _w in "个 只 本 张 条 件 位 次 种".split():
+    _ZH_POS[_w] = "q"        # measure word
+for _w in "一 二 三 四 五 六 七 八 九 十 百 千 万 亿 两".split():
+    _ZH_POS[_w] = "m"        # numeral
+
+
+@_dc.dataclass(frozen=True)
+class ZhTerm:
+    """ansj Term analogue: surface + nature (POS) tag."""
+
+    surface: str
+    nature: str
+
+
+class ChineseMorphologicalAnalyzer:
+    """Segmentation + ansj-style nature tagging: dictionary tags for the
+    closed classes, digit/latin/punct detection, noun default (ansj's
+    unknown-word behavior)."""
+
+    def __init__(self, dictionary=None, user_pos=None):
+        self._factory = ChineseTokenizerFactory(dictionary)
+        self._pos = dict(_ZH_POS)
+        if user_pos:
+            self._pos.update(user_pos)
+
+    def analyze(self, text: str) -> List[ZhTerm]:
+        out: List[ZhTerm] = []
+        for tok in self._factory.create(text).tokens():
+            for piece in tok.split():
+                out.append(ZhTerm(piece, self._tag(piece)))
+        return out
+
+    def _tag(self, w: str) -> str:
+        if w in self._pos:
+            return self._pos[w]
+        if any(c.isdigit() for c in w) and all(
+                c in "0123456789.%" for c in w):
+            return "m"
+        if all(ord(c) < 128 for c in w):
+            return "en" if w[0].isalpha() else "w"
+        if all(not c.isalnum() for c in w):
+            return "w"
+        return "n"

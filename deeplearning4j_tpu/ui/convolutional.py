@@ -56,12 +56,12 @@ def empty_png() -> bytes:
 
 
 def tile_feature_maps(act: np.ndarray, *, max_maps: int = 64,
-                      pad: int = 1) -> np.ndarray:
+                      pad: int = 1, example: int = 0) -> np.ndarray:
     """Tile one example's [H, W, C] feature maps into a near-square
     [rows*H', cols*W'] uint8 grid, each map min-max normalized (the
     reference normalizes per-map before drawing into the grid)."""
     if act.ndim == 4:
-        act = act[0]
+        act = act[example]
     h, w, c = act.shape
     c = min(c, max_maps)
     cols = int(np.ceil(np.sqrt(c)))
@@ -81,11 +81,16 @@ def tile_feature_maps(act: np.ndarray, *, max_maps: int = 64,
 
 
 def render_activation_grid(acts: List[np.ndarray], *,
-                           max_maps: int = 64) -> bytes:
+                           max_maps: int = 64,
+                           examples: int = 1) -> bytes:
     """Stack each conv layer's tiled grid vertically into one PNG (the
-    reference's single combined BufferedImage)."""
-    tiles = [tile_feature_maps(np.asarray(a), max_maps=max_maps)
-             for a in acts]
+    reference's single combined BufferedImage); with examples > 1 each
+    layer contributes one tiled grid per rendered example."""
+    tiles = [tile_feature_maps(np.asarray(a), max_maps=max_maps,
+                               example=e)
+             for a in acts
+             for e in range(min(examples, np.asarray(a).shape[0])
+                            if np.asarray(a).ndim == 4 else 1)]
     if not tiles:
         return empty_png()
     width = max(t.shape[1] for t in tiles)
@@ -139,7 +144,8 @@ class ConvolutionalIterationListener(TrainingListener):
             return
         import time
 
-        png = render_activation_grid(conv_acts, max_maps=self.max_maps)
+        png = render_activation_grid(conv_acts, max_maps=self.max_maps,
+                                     examples=self.examples)
         self.router.put_static_info(Persistable(
             session_id=self.session_id, type_id=TYPE_ID,
             worker_id=self.worker_id, timestamp=time.time(),

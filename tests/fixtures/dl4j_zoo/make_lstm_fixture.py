@@ -1,0 +1,130 @@
+"""Build a byte-faithful GravesLSTM DL4J zip whose predictions DEPEND on
+the gate-order permutation.
+
+The LSTM column permutation (`interop/dl4j.py:_lstm_col_perm` — DL4J
+blocks [candidate, forget, output, input] -> framework [i, f, g, o],
+peephole cols wFF/wOO/wGG) is exactly where a silent wrong-answer bug
+would live: with symmetric weights a dropped permutation changes nothing.
+This fixture carries DISTINCT per-gate weights and a committed oracle
+output computed straight from `LSTMHelpers.java` gate semantics in numpy
+(independent of the framework's importer AND of its LSTM layer), so:
+
+- `import + output == expected.npz`  proves the permutation is applied;
+- knocking the permutation out (tests monkeypatch it to identity) makes
+  the same comparison FAIL — the guard is demonstrably live.
+
+Bytes follow `util/ModelSerializer.java:80-119` + `nn/params/
+GravesLSTMParamInitializer.java:57-120` ([W ('f',(nIn,4H)), RW ('f',
+(H,4H+3)), b(4H)]); deterministic zip (fixed ZipInfo, stored).
+Run `python make_lstm_fixture.py` to (re)generate and print the Adler32.
+"""
+
+import json
+import os
+import struct
+import zipfile
+import zlib
+
+import numpy as np
+
+N_IN, H, N_OUT, SEED = 3, 4, 2, 777
+B, T = 2, 5
+
+
+def java_utf(s: str) -> bytes:
+    b = s.encode("utf-8")
+    return struct.pack(">H", len(b)) + b
+
+
+def data_buffer(dtype_name: str, fmt: str, values) -> bytes:
+    out = java_utf("DIRECT") + struct.pack(">i", len(values))
+    out += java_utf(dtype_name)
+    for v in values:
+        out += struct.pack(fmt, v)
+    return out
+
+
+def nd4j_row_vector(flat: np.ndarray) -> bytes:
+    n = flat.size
+    shape_info = [2, 1, n, n, 1, 0, 1, ord("c")]
+    return (data_buffer("INT", ">i", shape_info)
+            + data_buffer("FLOAT", ">f", [float(v) for v in flat]))
+
+
+def weights():
+    rng = np.random.default_rng(SEED)
+    w = rng.standard_normal((N_IN, 4 * H)).astype(np.float32) * 0.6
+    rw = rng.standard_normal((H, 4 * H + 3)).astype(np.float32) * 0.4
+    b = rng.standard_normal(4 * H).astype(np.float32) * 0.2
+    w_out = rng.standard_normal((H, N_OUT)).astype(np.float32)
+    b_out = rng.standard_normal(N_OUT).astype(np.float32) * 0.1
+    flat = np.concatenate([
+        w.reshape(-1, order="F"), rw.reshape(-1, order="F"), b,
+        w_out.reshape(-1, order="F"), b_out])
+    return w, rw, b, w_out, b_out, flat
+
+
+def example_input():
+    return np.random.default_rng(SEED + 1).standard_normal(
+        (B, T, N_IN)).astype(np.float32)
+
+
+def expected_output(x: np.ndarray) -> np.ndarray:
+    """Independent numpy oracle per LSTMHelpers.java: block0 = tanh
+    candidate, block1 = forget, block2 = output, block3 = input gate;
+    peepholes wFF (col 4H, on prev cell), wOO (4H+1, on new cell),
+    wGG (4H+2, on prev cell)."""
+    w, rw, b, w_out, b_out, _ = weights()
+    rw4 = rw[:, :4 * H]
+    wff, woo, wgg = rw[:, 4 * H], rw[:, 4 * H + 1], rw[:, 4 * H + 2]
+    sig = lambda v: 1.0 / (1.0 + np.exp(-v))  # noqa: E731
+    hs = np.zeros((x.shape[0], H), np.float32)
+    cs = np.zeros((x.shape[0], H), np.float32)
+    outs = []
+    for t in range(x.shape[1]):
+        z = x[:, t] @ w + hs @ rw4 + b
+        cand = np.tanh(z[:, 0:H])
+        fg = sig(z[:, H:2 * H] + cs * wff)
+        ig = sig(z[:, 3 * H:4 * H] + cs * wgg)
+        c_new = fg * cs + ig * cand
+        og = sig(z[:, 2 * H:3 * H] + c_new * woo)
+        hs = og * np.tanh(c_new)
+        cs = c_new
+        outs.append(hs @ w_out + b_out)
+    return np.stack(outs, axis=1)
+
+
+def build(path: str) -> int:
+    conf = {"backprop": True, "backpropType": "Standard", "confs": [
+        {"layer": {"gravesLSTM": {
+            "activationFn": {"@class":
+                "org.nd4j.linalg.activations.impl.ActivationTanH"},
+            "layerName": "lstm", "nin": N_IN, "nout": H,
+            "forgetGateBiasInit": 0.0}}},
+        {"layer": {"rnnoutput": {
+            "activationFn": {"@class":
+                "org.nd4j.linalg.activations.impl.ActivationIdentity"},
+            "lossFn": {"@class":
+                "org.nd4j.linalg.lossfunctions.impl.LossMSE"},
+            "layerName": "out", "nin": H, "nout": N_OUT}}},
+    ]}
+    flat = weights()[-1]
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_STORED) as zf:
+        for name, payload in (
+                ("configuration.json",
+                 json.dumps(conf, sort_keys=True).encode()),
+                ("coefficients.bin", nd4j_row_vector(flat))):
+            info = zipfile.ZipInfo(name, date_time=(2017, 1, 1, 0, 0, 0))
+            zf.writestr(info, payload)
+    with open(path, "rb") as f:
+        return zlib.adler32(f.read()) & 0xFFFFFFFF
+
+
+if __name__ == "__main__":
+    here = os.path.dirname(os.path.abspath(__file__))
+    zip_path = os.path.join(here, "graveslstm_dl4j_inference.v1.zip")
+    checksum = build(zip_path)
+    x = example_input()
+    np.savez(os.path.join(here, "graveslstm_expected.npz"),
+             x=x, y=expected_output(x))
+    print(f"{zip_path}: adler32={checksum}")

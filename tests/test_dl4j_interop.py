@@ -11,6 +11,7 @@ round-trip symmetry. Reference: `util/ModelSerializer.java:37-119`.
 """
 
 import io
+import os
 import json
 import zipfile
 
@@ -523,3 +524,90 @@ def test_biasless_dense_roundtrips(tmp_path):
     np.testing.assert_allclose(np.asarray(back.output(x)),
                                np.asarray(net.output(x)),
                                rtol=1e-5, atol=1e-6)
+
+
+class TestAdversarialFixtures:
+    """Seeded-corruption tests (VERDICT r3 #6): the interop path must
+    FAIL LOUDLY on corrupt bytes, and the committed GravesLSTM byte
+    fixture must fail if the gate-order permutation is dropped —
+    exactly where a silent wrong-answer bug would live
+    (`interop/dl4j.py:_lstm_col_perm`,
+    `nn/params/GravesLSTMParamInitializer.java:57-120`)."""
+
+    FIXDIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "fixtures", "dl4j_zoo")
+    LSTM_ZIP = os.path.join(FIXDIR, "graveslstm_dl4j_inference.v1.zip")
+    MLP_ZIP = os.path.join(FIXDIR, "minimlp_dl4j_inference.v1.zip")
+
+    def test_lstm_fixture_matches_committed_oracle(self):
+        """The committed zip's predictions reproduce the committed
+        LSTMHelpers-semantics numpy oracle (computed independently of
+        the importer AND the framework LSTM)."""
+        net = import_dl4j_model(self.LSTM_ZIP)
+        blob = np.load(os.path.join(self.FIXDIR,
+                                    "graveslstm_expected.npz"))
+        got = np.asarray(net.output(blob["x"]))
+        np.testing.assert_allclose(got, blob["y"], rtol=1e-4, atol=1e-5)
+
+    def test_lstm_fixture_fails_without_gate_permutation(self, monkeypatch):
+        """Knock the column permutation out (identity): the SAME fixture
+        must now disagree with the oracle — proving the fixture actually
+        guards the permutation rather than passing by symmetry."""
+        from deeplearning4j_tpu.interop import dl4j as mod
+
+        monkeypatch.setattr(
+            mod, "_lstm_col_perm",
+            lambda h, to_framework: np.arange(4 * h))
+        net = import_dl4j_model(self.LSTM_ZIP)
+        blob = np.load(os.path.join(self.FIXDIR,
+                                    "graveslstm_expected.npz"))
+        got = np.asarray(net.output(blob["x"]))
+        assert np.abs(got - blob["y"]).max() > 1e-2, (
+            "dropping the gate permutation went undetected — the fixture "
+            "no longer guards it")
+
+    def test_truncated_coefficients_raise_with_clear_message(self, tmp_path):
+        """Cut coefficients.bin short (zip CRC recomputed so only OUR
+        codec can catch it): import must raise a 'truncated' ValueError,
+        not a cryptic numpy error or a silent short read."""
+        out = tmp_path / "trunc.zip"
+        with zipfile.ZipFile(self.MLP_ZIP) as zin, \
+                zipfile.ZipFile(out, "w") as zout:
+            for info in zin.infolist():
+                data = zin.read(info.filename)
+                if info.filename == "coefficients.bin":
+                    data = data[:len(data) - 40]
+                zout.writestr(info.filename, data)
+        with pytest.raises(ValueError, match="truncated"):
+            import_dl4j_model(out)
+
+    def test_flipped_byte_fails_zip_crc(self, tmp_path):
+        """A raw byte flip inside the stored coefficients entry trips the
+        zip CRC on read — corrupt downloads cannot import silently."""
+        raw = bytearray(open(self.LSTM_ZIP, "rb").read())
+        # flip a byte inside the coefficients.bin PAYLOAD: right after
+        # its local file header (first occurrence of the entry name;
+        # the second lives in the central directory)
+        at = raw.find(b"coefficients.bin") + len(b"coefficients.bin") + 64
+        raw[at] ^= 0xFF
+        bad = tmp_path / "flipped.zip"
+        bad.write_bytes(bytes(raw))
+        with pytest.raises(Exception) as ei:
+            import_dl4j_model(bad)
+        assert isinstance(ei.value, (zipfile.BadZipFile, ValueError))
+
+    def test_updater_state_truncation_detected(self, tmp_path):
+        """Same guard on updaterState.bin."""
+        src = zipfile.ZipFile(self.MLP_ZIP)
+        coeff = src.read("coefficients.bin")
+        out = tmp_path / "badupd.zip"
+        with zipfile.ZipFile(out, "w") as zf:
+            zf.writestr("configuration.json",
+                        src.read("configuration.json"))
+            zf.writestr("coefficients.bin", coeff)
+            zf.writestr("updaterState.bin", coeff[:30])
+        # params must still import (updater state is auxiliary), but the
+        # corruption is surfaced as a warning, never swallowed silently
+        with pytest.warns(UserWarning, match="updaterState"):
+            net = import_dl4j_model(out)
+        assert net.num_params() > 0

@@ -189,3 +189,98 @@ class TestLatticeSegmentation:
 
         ja = JapaneseTokenizerFactory(user_dictionary=["食べる"])
         assert "食べる" in ja.create("パンを食べる").tokens()
+
+
+class TestKoreanMorphology:
+    """Reference: deeplearning4j-nlp-korean KoreanTokenizer.java:34 —
+    twitter-korean-text morphology: stem/josa/eomi decomposition, POS
+    tags, de-conjugated dictionary forms."""
+
+    def test_noun_josa_decomposition(self):
+        from deeplearning4j_tpu.nlp.lang import KoreanMorphologicalAnalyzer
+
+        ms = KoreanMorphologicalAnalyzer().analyze("나는 학교에 갔다")
+        got = [(m.surface, m.pos) for m in ms]
+        assert got == [("나", "Pronoun"), ("는", "Josa"),
+                       ("학교", "Noun"), ("에", "Josa"),
+                       ("가", "Verb"), ("았다", "Eomi")]
+        # the conjugated 갔다 recovered its dictionary form
+        assert ms[4].base == "가다"
+
+    def test_past_tense_contraction_reversal(self):
+        """갔/났/했/왔/됐 syllables expand arithmetically via jamo math
+        (ㅏ+았, ㅐ→하+았 irregular, ㅚ+었)."""
+        from deeplearning4j_tpu.nlp.lang import KoreanMorphologicalAnalyzer
+
+        an = KoreanMorphologicalAnalyzer()
+        for text, stem, base in (
+                ("만났어요", "만나", "만나다"),
+                ("공부했습니다", "공부하", "공부하다"),
+                ("왔다", "오", "오다"),
+                ("됐어요", "되", "되다"),
+                ("봤다", "보", "보다")):
+            ms = an.analyze(text)
+            assert ms[0].surface == stem and ms[0].base == base, (text, ms)
+            assert ms[1].pos == "Eomi", (text, ms)
+
+    def test_adjective_number_foreign_punct(self):
+        from deeplearning4j_tpu.nlp.lang import KoreanMorphologicalAnalyzer
+
+        ms = KoreanMorphologicalAnalyzer().analyze("날씨가 좋다! 3 TPU")
+        got = {(m.surface, m.pos) for m in ms}
+        assert ("좋", "Adjective") in got
+        assert ("다", "Eomi") in got
+        assert ("!", "Punctuation") in got
+        assert ("3", "Number") in got
+        assert ("TPU", "Foreign") in got
+
+    def test_morphological_factory_tokens(self):
+        from deeplearning4j_tpu.nlp.lang import (
+            KoreanMorphologicalTokenizerFactory,
+        )
+
+        toks = KoreanMorphologicalTokenizerFactory().create(
+            "친구를 만났어요").tokens()
+        assert toks == ["친구", "만나"]   # particles/endings dropped
+        toks = KoreanMorphologicalTokenizerFactory(
+            keep_particles=True).create("친구를 만났어요").tokens()
+        assert toks == ["친구", "를", "만나", "았어요"]
+
+    def test_user_nouns_extend_dictionary(self):
+        from deeplearning4j_tpu.nlp.lang import KoreanMorphologicalAnalyzer
+
+        an = KoreanMorphologicalAnalyzer(user_nouns=["텐서플로"])
+        ms = an.analyze("텐서플로를")
+        assert [(m.surface, m.pos) for m in ms] == [
+            ("텐서플로", "Noun"), ("를", "Josa")]
+
+
+class TestChinesePOS:
+    """Reference: deeplearning4j-nlp-chinese ChineseTokenizer.java (ansj
+    analyzer) — terms carry nature tags; same tag alphabet here."""
+
+    def test_nature_tags(self):
+        from deeplearning4j_tpu.nlp.lang import ChineseMorphologicalAnalyzer
+
+        terms = ChineseMorphologicalAnalyzer().analyze("我们在北京学习和工作")
+        got = [(t.surface, t.nature) for t in terms]
+        assert got == [("我们", "r"), ("在", "p"), ("北京", "n"),
+                       ("学习", "v"), ("和", "c"), ("工作", "v")]
+
+    def test_particles_numbers_latin(self):
+        from deeplearning4j_tpu.nlp.lang import ChineseMorphologicalAnalyzer
+
+        an = ChineseMorphologicalAnalyzer()
+        tags = {t.surface: t.nature for t in an.analyze("我的3个GPU")}
+        assert tags["的"] == "u"
+        assert tags["3"] == "m"
+        assert tags["个"] == "q"
+        assert tags["GPU"] == "en"
+
+    def test_user_pos_overrides(self):
+        from deeplearning4j_tpu.nlp.lang import ChineseMorphologicalAnalyzer
+
+        an = ChineseMorphologicalAnalyzer(dictionary=["深度学习"],
+                                          user_pos={"深度学习": "nz"})
+        terms = an.analyze("我喜欢深度学习")
+        assert ("深度学习", "nz") in [(t.surface, t.nature) for t in terms]
