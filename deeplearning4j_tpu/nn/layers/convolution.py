@@ -505,6 +505,8 @@ class FusedConvBNLayer(Layer):
     followed by BatchNormalization(activation=...), to float32 accuracy.
     """
 
+    CONSUMES = "cnn"   # drives preprocessor auto-insertion (NHWC input)
+
     n_in: Optional[int] = None
     n_out: Optional[int] = None
     stride: Any = (1, 1)

@@ -303,6 +303,10 @@ class _Handler(BaseHTTPRequestHandler):
                     since = float(part[6:])
                 except ValueError:
                     pass
+        # one collection path for first and incremental polls, so the
+        # session scope never shifts between them (the latest session,
+        # via _updates) — a per-timestamp storage index can slot in here
+        # if linear rescans ever show up in profiles
         ups = [u for u in self._updates(storage) if u.timestamp > since]
         # At-least-once contract: the cursor trails the max delivered
         # record timestamp by a grace window, because listeners stamp

@@ -73,3 +73,13 @@ def test_long_context_ring_attention(devices8, capsys):
     mod["remat_training_demo"](T=128)
     out = capsys.readouterr().out
     assert "ring attention" in out and "gradient checkpointing" in out
+
+
+def test_multiprocess_pod(tmp_path, capsys):
+    mod = _run("multiprocess_pod.py")
+    mod["main"](nproc=2, devs=2, ckpt_dir=str(tmp_path / "ck"))
+    out = capsys.readouterr().out
+    assert "pod run complete" in out
+    # BOTH processes wrote their per-process checkpoint shard dirs
+    shard_dirs = {p.name for p in (tmp_path / "ck").rglob("process-*")}
+    assert {"process-0", "process-1"} <= shard_dirs, shard_dirs

@@ -367,3 +367,53 @@ class TestFusedConvBN:
         ref = np.asarray(x2d) @ np.asarray(w)
         np.testing.assert_allclose(y, ref, rtol=1e-5, atol=1e-5)
         np.testing.assert_allclose(s, ref.sum(0), rtol=1e-5, atol=1e-4)
+
+    def test_bf16_inputs_f32_accumulation(self):
+        """The bench path runs bf16 activations/weights: the kernel must
+        accumulate in f32 (stats especially — bf16 sums of squares lose
+        catastrophically) and stay near the f32 oracle."""
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.ops.conv_fused import conv1x1_bn_act
+
+        x, w, gamma, beta = self._data(B=4, H=8, W=8, C=32, N=64, seed=7)
+        o32, m32, v32 = conv1x1_bn_act(x, w, gamma, beta, train=True,
+                                       interpret=True)
+        o16, m16, v16 = conv1x1_bn_act(
+            x.astype(jnp.bfloat16), w.astype(jnp.bfloat16),
+            gamma, beta, train=True, interpret=True)
+        assert o16.dtype == jnp.bfloat16
+        assert m16.dtype == jnp.float32 and v16.dtype == jnp.float32
+        np.testing.assert_allclose(np.asarray(o16, np.float32),
+                                   np.asarray(o32), rtol=0.1, atol=0.1)
+        np.testing.assert_allclose(m16, m32, rtol=0.05, atol=0.05)
+        np.testing.assert_allclose(v16, v32, rtol=0.05, atol=0.08)
+
+    def test_layer_serde_and_mln_builder_flow(self):
+        """FusedConvBNLayer round-trips through config JSON and wires
+        correctly in the .list() builder (CNN input type preserved, no
+        spurious flattening preprocessor)."""
+        import numpy as np
+
+        from deeplearning4j_tpu.models import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.inputs import InputType
+        from deeplearning4j_tpu.nn.layers import (
+            FusedConvBNLayer, OutputLayer,
+        )
+
+        conf = (NeuralNetConfiguration.builder().seed(0)
+                .list(FusedConvBNLayer(n_out=8, stride=(2, 2),
+                                       activation="relu"),
+                      OutputLayer(n_out=3, activation="softmax"))
+                .set_input_type(InputType.convolutional(8, 8, 3)).build())
+        conf2 = type(conf).from_json(conf.to_json())
+        l0 = conf2.layers[0]
+        assert l0.n_out == 8 and tuple(l0.stride) == (2, 2)
+        assert l0.n_in == 3   # inferred from the CNN input type
+        net = MultiLayerNetwork(conf).init()
+        r = np.random.default_rng(0)
+        x = r.random((4, 8, 8, 3)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[r.integers(0, 3, 4)]
+        net.fit(x, y, epochs=2, batch_size=4)
+        assert np.isfinite(net.score_)

@@ -290,3 +290,23 @@ class TestFusedResNet:
             net.fit(mds)
         s1 = net.score(mds)
         assert np.isfinite(s1) and s1 < s0
+
+
+def test_fused_resnet_under_data_parallel_mesh():
+    """ResNet50(fused=True) trains under the 8-device DP mesh (the
+    Pallas path must stay shardable; interpret mode on CPU, see
+    PERF_NOTES multichip caveat for real-TPU status)."""
+    from deeplearning4j_tpu.models import ComputationGraph
+    from deeplearning4j_tpu.optim.updaters import Sgd
+    from deeplearning4j_tpu.parallel import ParallelWrapper, make_mesh
+    from deeplearning4j_tpu.zoo import ResNet50
+
+    net = ComputationGraph(ResNet50(
+        num_classes=4, input_shape=(32, 32, 3), fused=True,
+        updater=Sgd(1e-3)).conf()).init()
+    r = np.random.default_rng(0)
+    x = r.standard_normal((16, 32, 32, 3)).astype(np.float32)
+    y = np.eye(4, dtype=np.float32)[r.integers(0, 4, 16)]
+    ParallelWrapper(net, mesh=make_mesh({"data": 8}),
+                    prefetch_buffer=0).fit(x, y, epochs=1, batch_size=16)
+    assert np.isfinite(net.score_)
