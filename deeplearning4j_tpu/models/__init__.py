@@ -7,5 +7,6 @@ becomes one jitted XLA computation per train step here.
 
 from deeplearning4j_tpu.models.multilayer import MultiLayerNetwork
 from deeplearning4j_tpu.models.computation_graph import ComputationGraph
+from deeplearning4j_tpu.models.fusion import fuse_conv_bn
 
-__all__ = ["MultiLayerNetwork", "ComputationGraph"]
+__all__ = ["MultiLayerNetwork", "ComputationGraph", "fuse_conv_bn"]

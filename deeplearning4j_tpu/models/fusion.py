@@ -1,0 +1,178 @@
+"""Graph-level conv+BN fusion pass.
+
+Reference seam: DL4J points conv/BN layers at hand-fused cuDNN helpers
+chosen reflectively per layer (`ConvolutionLayer.java:67-77`); here the
+equivalent "use the fast kernel" decision is a MODEL TRANSFORM — any
+network (zoo builder, DL4J import, Keras import) can have its eligible
+1x1-conv -> batch-norm pairs rewritten into `FusedConvBNLayer`
+(`ops/conv_fused.py`: the Pallas matmul with in-kernel BN statistics)
+after construction, without per-builder flags. The inverse of torch's
+inference-only `fuse_modules`: this fusion is TRAINING-mode (batch
+statistics ride the matmul), eval folding stays in XLA.
+
+Eligibility (both checked structurally, nothing silently approximated):
+- ConvolutionLayer with kernel (1,1), no bias, identity activation,
+  stride dilation-free, zero padding (for k=1 SAME==VALID, so any mode);
+- whose ONLY consumer is a BatchNormalization vertex with learnable
+  gamma+beta, itself not consuming anything else.
+
+The fused vertex keeps the BN vertex's NAME, so downstream edges and
+checkpoint keys for everything else are untouched; conv weights and BN
+gamma/beta/mean/var transfer over. Per-layer updater state for the fused
+pair is re-initialized (the DL4J transfer-learning behavior when layers
+are replaced)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.layers.convolution import _pair
+
+
+def _copy_tree(tree):
+    # fresh buffers: the source net's jitted step DONATES its param
+    # arrays, so shared leaves would be deleted under the new net
+    return jax.tree_util.tree_map(jnp.array, tree)
+
+
+def _eligible_conv(layer) -> bool:
+    from deeplearning4j_tpu.nn.layers import ConvolutionLayer
+
+    if type(layer) is not ConvolutionLayer:
+        return False
+    if _pair(layer.kernel) != (1, 1) or _pair(layer.dilation) != (1, 1):
+        return False
+    if _pair(layer.padding) != (0, 0) or layer.has_bias:
+        return False
+    return (layer.activation or "identity") == "identity" \
+        and not layer.dropout
+
+
+def _eligible_bn(layer) -> bool:
+    from deeplearning4j_tpu.nn.layers import BatchNormalization
+
+    return (type(layer) is BatchNormalization
+            and not layer.lock_gamma_beta
+            and layer.scale and layer.center)
+
+
+_CARRIED = ("l1", "l2", "l1_bias", "l2_bias", "updater", "learning_rate",
+            "frozen")
+
+
+def _pair_config_matches(conv, bn) -> bool:
+    # the fused layer has ONE set of per-layer training knobs; fusing a
+    # pair whose knobs differ would silently change regularization /
+    # optimizer / trainability semantics — such pairs stay unfused
+    return all(getattr(conv, k) == getattr(bn, k) for k in _CARRIED)
+
+
+def fuse_conv_bn(net):
+    """Rewrite eligible 1x1-conv -> BN pairs of a ComputationGraph into
+    FusedConvBNLayer vertices, transferring weights and running stats.
+    Returns a NEW initialized network (the input is untouched);
+    `net.fused_pairs` on the result lists the (conv, bn) names rewritten.
+    """
+    from deeplearning4j_tpu.models import ComputationGraph
+    from deeplearning4j_tpu.nn.graph import LayerVertex, toposort
+    from deeplearning4j_tpu.nn.layers import FusedConvBNLayer
+
+    conf = net.conf
+    if not hasattr(conf, "vertices"):
+        raise TypeError(
+            "fuse_conv_bn operates on ComputationGraph models; wrap "
+            "sequential nets as graphs (to_computation_graph) first")
+    consumers: Dict[str, list] = {}
+    for name, ins in conf.vertex_inputs.items():
+        for i in ins:
+            consumers.setdefault(i, []).append(name)
+
+    pairs = []   # (conv_name, bn_name)
+    for cname, v in conf.vertices.items():
+        if not isinstance(v, LayerVertex) or not _eligible_conv(v.layer):
+            continue
+        if getattr(v, "preprocessor", None) is not None:
+            continue
+        if cname in conf.network_outputs:
+            continue
+        cons = consumers.get(cname, [])
+        if len(cons) != 1:
+            continue
+        b = conf.vertices[cons[0]]
+        if not isinstance(b, LayerVertex) or not _eligible_bn(b.layer):
+            continue
+        if getattr(b, "preprocessor", None) is not None:
+            continue
+        if conf.vertex_inputs[cons[0]] != (cname,):
+            continue
+        if not _pair_config_matches(v.layer, b.layer):
+            continue
+        pairs.append((cname, cons[0]))
+
+    if not pairs:
+        out = ComputationGraph(conf).init()
+        out.params_tree = _copy_tree(net.params_tree)
+        out.state_tree = _copy_tree(net.state_tree)
+        out.updater_state = _copy_tree(net.updater_state)
+        out.fused_pairs = []
+        return out
+
+    vertices = dict(conf.vertices)
+    vertex_inputs = {k: tuple(v) for k, v in conf.vertex_inputs.items()}
+    for conv_name, bn_name in pairs:
+        conv = vertices[conv_name].layer
+        bn = vertices[bn_name].layer
+        fused = FusedConvBNLayer(
+            name=bn_name, n_in=conv.n_in, n_out=conv.n_out,
+            stride=_pair(conv.stride), decay=bn.decay, eps=bn.eps,
+            activation=bn.activation or "identity",
+            weight_init=conv.weight_init,
+            # per-layer training knobs carry over (eligibility already
+            # requires conv and BN to agree on them)
+            **{k: getattr(conv, k) for k in _CARRIED})
+        vertices[bn_name] = dataclasses.replace(
+            vertices[bn_name], layer=fused)
+        vertex_inputs[bn_name] = vertex_inputs[conv_name]
+        del vertices[conv_name]
+        del vertex_inputs[conv_name]
+
+    new_conf = dataclasses.replace(
+        conf, vertices=vertices, vertex_inputs=vertex_inputs,
+        topological_order=tuple(toposort(vertex_inputs,
+                                         conf.network_inputs)))
+    fused_net = ComputationGraph(new_conf).init()
+
+    # transfer params/state: untouched vertices copy through; fused
+    # vertices take conv W + BN gamma/beta (+ running stats as f32)
+    params = dict(net.params_tree)
+    states = dict(net.state_tree)
+    fused_names = set()
+    for conv_name, bn_name in pairs:
+        fused_names.add(bn_name)
+        fused_net.params_tree[bn_name] = _copy_tree({
+            "W": params[conv_name]["W"],
+            "gamma": params[bn_name]["gamma"],
+            "beta": params[bn_name]["beta"],
+        })
+        fused_net.state_tree[bn_name] = {
+            "mean": jnp.array(states[bn_name]["mean"], jnp.float32),
+            "var": jnp.array(states[bn_name]["var"], jnp.float32),
+        }
+        del params[conv_name]
+    for name, p in params.items():
+        if name not in fused_names:
+            fused_net.params_tree[name] = _copy_tree(p)
+            if name in states:
+                fused_net.state_tree[name] = _copy_tree(states[name])
+            if name in net.updater_state:
+                # optimizer state carries over for untouched layers;
+                # only the fused pair restarts its moments (their param
+                # structure changed — the DL4J replaced-layer behavior)
+                fused_net.updater_state[name] = _copy_tree(
+                    net.updater_state[name])
+    fused_net.fused_pairs = pairs
+    return fused_net
