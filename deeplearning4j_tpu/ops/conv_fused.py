@@ -57,7 +57,8 @@ def pick_blocks(m: int, k: int, n: int
     return bm, bk, bn
 
 
-def _mm_stats_kernel(x_ref, w_ref, y_ref, s_ref, q_ref, acc):
+def _mm_stats_kernel(x_ref, w_ref, y_ref, s_ref, q_ref, acc,
+                     acc_dtype=jnp.float32):
     """One (i, j) output tile: accumulate over k in VMEM, then emit the
     y tile plus its per-channel partial sum / sum-of-squares."""
     k = pl.program_id(2)
@@ -67,7 +68,7 @@ def _mm_stats_kernel(x_ref, w_ref, y_ref, s_ref, q_ref, acc):
         acc[:] = jnp.zeros_like(acc)
 
     acc[:] += jnp.dot(x_ref[:], w_ref[:],
-                      preferred_element_type=jnp.float32)
+                      preferred_element_type=acc_dtype)
 
     @pl.when(k == pl.num_programs(2) - 1)
     def _():
@@ -77,23 +78,31 @@ def _mm_stats_kernel(x_ref, w_ref, y_ref, s_ref, q_ref, acc):
         q_ref[:] = (t * t).sum(axis=0, keepdims=True)[None]
 
 
+def _acc_dtype(dtype):
+    """f32 accumulation normally; f64 when the inputs are f64 (the
+    gradient-check path runs the whole net in double precision)."""
+    return jnp.promote_types(dtype, jnp.float32)
+
+
 def matmul_with_channel_stats(x2d, w, *, interpret: bool = False):
     """y = x2d @ w plus per-output-channel (sum, sum_of_squares) of y,
     computed inside the matmul kernel. Returns (y [M,N] in x2d.dtype,
-    sums [N] f32, sumsqs [N] f32). Falls back to plain XLA when the shape
-    does not tile."""
+    sums [N], sumsqs [N] in the accumulation dtype — f32, or f64 under
+    double precision). Falls back to plain XLA when the shape does not
+    tile."""
     m, k = x2d.shape
     k2, n = w.shape
     assert k == k2, (x2d.shape, w.shape)
+    acc = _acc_dtype(x2d.dtype)
     blocks = pick_blocks(m, k, n)
     if blocks is None:
-        y = jnp.dot(x2d, w, preferred_element_type=jnp.float32)
+        y = jnp.dot(x2d, w, preferred_element_type=acc)
         return (y.astype(x2d.dtype), jnp.sum(y, axis=0),
                 jnp.sum(y * y, axis=0))
     bm, bk, bn = blocks
     nm, nn, nk = m // bm, n // bn, k // bk
     y, ps, pq = pl.pallas_call(
-        _mm_stats_kernel,
+        functools.partial(_mm_stats_kernel, acc_dtype=acc),
         grid=(nm, nn, nk),
         in_specs=[
             pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
@@ -108,10 +117,10 @@ def matmul_with_channel_stats(x2d, w, *, interpret: bool = False):
         ],
         out_shape=[
             jax.ShapeDtypeStruct((m, n), x2d.dtype),
-            jax.ShapeDtypeStruct((nm, 1, n), jnp.float32),
-            jax.ShapeDtypeStruct((nm, 1, n), jnp.float32),
+            jax.ShapeDtypeStruct((nm, 1, n), acc),
+            jax.ShapeDtypeStruct((nm, 1, n), acc),
         ],
-        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        scratch_shapes=[pltpu.VMEM((bm, bn), acc)],
         interpret=interpret,
     )(x2d, w)
     return y, ps.sum(axis=(0, 1)), pq.sum(axis=(0, 1))
@@ -127,13 +136,14 @@ def _conv1x1_bn_train(x2d, w, gamma, beta, eps, relu, interpret):
 
 def _train_fwd_impl(x2d, w, gamma, beta, eps, relu, interpret):
     mval = x2d.shape[0]
+    acc = _acc_dtype(x2d.dtype)
     y, s, q = matmul_with_channel_stats(x2d, w, interpret=interpret)
     mean = s / mval
     var = jnp.maximum(q / mval - mean * mean, 0.0)  # biased, clamped
     inv = jax.lax.rsqrt(var + eps)
-    scale = gamma.astype(jnp.float32) * inv
-    shift = beta.astype(jnp.float32) - mean * scale
-    pre = y.astype(jnp.float32) * scale + shift
+    scale = gamma.astype(acc) * inv
+    shift = beta.astype(acc) - mean * scale
+    pre = y.astype(acc) * scale + shift
     out = jnp.maximum(pre, 0.0) if relu else pre
     return out.astype(x2d.dtype), y, mean, var
 
@@ -150,22 +160,22 @@ def _train_vjp_bwd(eps, relu, interpret, res, cts):
     dout = cts[0]
     x2d, w, gamma, beta, y, mean, var = res
     mval = x2d.shape[0]
-    f32 = jnp.float32
+    ct = _acc_dtype(x2d.dtype)
     inv = jax.lax.rsqrt(var + eps)
-    xhat = (y.astype(f32) - mean) * inv
-    g = dout.astype(f32)
+    xhat = (y.astype(ct) - mean) * inv
+    g = dout.astype(ct)
     if relu:
-        g = g * ((gamma.astype(f32) * xhat + beta.astype(f32)) > 0)
+        g = g * ((gamma.astype(ct) * xhat + beta.astype(ct)) > 0)
     dbeta = g.sum(axis=0)
     dgamma = (g * xhat).sum(axis=0)
-    dxhat = g * gamma.astype(f32)
+    dxhat = g * gamma.astype(ct)
     # training-mode BN backward: mean/var depend on every row
     dy = inv * (dxhat - dxhat.mean(axis=0)
                 - xhat * (dxhat * xhat).mean(axis=0))
-    dx = jnp.dot(dy, w.astype(f32).T,
-                 preferred_element_type=f32).astype(x2d.dtype)
-    dw = jnp.dot(x2d.astype(f32).T, dy,
-                 preferred_element_type=f32).astype(w.dtype)
+    dx = jnp.dot(dy, w.astype(ct).T,
+                 preferred_element_type=ct).astype(x2d.dtype)
+    dw = jnp.dot(x2d.astype(ct).T, dy,
+                 preferred_element_type=ct).astype(w.dtype)
     return dx, dw, dgamma.astype(gamma.dtype), dbeta.astype(beta.dtype)
 
 
@@ -199,10 +209,11 @@ def conv1x1_bn_act(x, w, gamma, beta, *, mean=None, var=None,
         return (out2d.reshape(b, h, wd, n),
                 jax.lax.stop_gradient(bmean),
                 jax.lax.stop_gradient(bvar))
-    inv = jax.lax.rsqrt(var.astype(jnp.float32) + eps)
-    scale = gamma.astype(jnp.float32) * inv
-    shift = beta.astype(jnp.float32) - mean.astype(jnp.float32) * scale
-    pre = jnp.dot(x2d, w, preferred_element_type=jnp.float32)
+    acc = _acc_dtype(x.dtype)
+    inv = jax.lax.rsqrt(var.astype(acc) + eps)
+    scale = gamma.astype(acc) * inv
+    shift = beta.astype(acc) - mean.astype(acc) * scale
+    pre = jnp.dot(x2d, w, preferred_element_type=acc)
     pre = pre * scale + shift
     if relu:
         pre = jnp.maximum(pre, 0.0)

@@ -156,3 +156,29 @@ def test_training_config_and_updater_state_carry_over():
     assert any(np.abs(np.asarray(l)).max() > 0 for l in new_m)
     # scores (incl. l2 term) agree
     assert net.score(mds) == pytest.approx(fused.score(mds), rel=1e-5)
+
+
+def test_fused_layer_central_difference_gradients():
+    """The reference's correctness backbone applied to the fused layer:
+    numeric central-difference vs analytic gradients through a graph
+    containing FusedConvBNLayer (f64, interpret-mode Pallas)."""
+    from deeplearning4j_tpu.gradientcheck import check_gradients
+
+    net = _graph()
+    fused = fuse_conv_bn(net)
+
+    class _Shim:   # dict-IO adapter, the CG gradient-check convention
+        params_tree = fused.params_tree
+        state_tree = fused.state_tree
+
+        @staticmethod
+        def _loss(params, states, features, labels, fmask, lmask, rng,
+                  train=False):
+            return fused._loss(
+                params, states, {"in": features}, {"output": labels},
+                None, None, rng, train=train)
+
+    r = np.random.default_rng(3)
+    x = r.standard_normal((3, 8, 8, 3)).astype(np.float64)
+    y = np.eye(3, dtype=np.float64)[r.integers(0, 3, 3)]
+    assert check_gradients(_Shim, x, y, subset=40)
