@@ -4,15 +4,17 @@ Reference seam: DL4J points conv/BN layers at hand-fused cuDNN helpers
 chosen reflectively per layer (`ConvolutionLayer.java:67-77`); here the
 equivalent "use the fast kernel" decision is a MODEL TRANSFORM — any
 network (zoo builder, DL4J import, Keras import) can have its eligible
-1x1-conv -> batch-norm pairs rewritten into `FusedConvBNLayer`
-(`ops/conv_fused.py`: the Pallas matmul with in-kernel BN statistics)
-after construction, without per-builder flags. The inverse of torch's
+conv -> batch-norm pairs (1x1 any stride; 3x3 stride-1 SAME) rewritten
+into `FusedConvBNLayer` (`ops/conv_fused.py`: Pallas conv kernels with
+in-kernel BN statistics) after construction, without per-builder flags. The inverse of torch's
 inference-only `fuse_modules`: this fusion is TRAINING-mode (batch
 statistics ride the matmul), eval folding stays in XLA.
 
 Eligibility (both checked structurally, nothing silently approximated):
-- ConvolutionLayer with kernel (1,1), no bias, identity activation,
-  stride dilation-free, zero padding (for k=1 SAME==VALID, so any mode);
+- ConvolutionLayer with no bias, identity activation, dilation-free, and
+  a fusable shape: kernel (1,1) with zero explicit padding (SAME==VALID
+  at k=1, so any mode), or kernel (3,3) stride-1 with SAME-equivalent
+  padding;
 - whose ONLY consumer is a BatchNormalization vertex with learnable
   gamma+beta, itself not consuming anything else.
 
@@ -39,17 +41,37 @@ def _copy_tree(tree):
     return jax.tree_util.tree_map(jnp.array, tree)
 
 
+def fusable_conv_shape(kernel, stride, padding, mode) -> bool:
+    """Whether a conv of this geometry has a fused Pallas conv+BN kernel
+    (`ops/conv_fused.py`). The single source of truth for the shape
+    predicate — used by this transform's eligibility check and by zoo
+    builders deciding to emit FusedConvBNLayer directly."""
+    k = _pair(kernel)
+    if k == (1, 1):
+        # for k=1, SAME == VALID, so any padding mode; explicit pad must
+        # be zero
+        return _pair(padding) == (0, 0)
+    if k == (3, 3):
+        # the fused 3x3 kernel is stride-1 SAME only
+        if _pair(stride) != (1, 1):
+            return False
+        return (mode == "same"
+                or (_pair(padding) == (1, 1)
+                    and mode in ("strict", "truncate")))
+    return False
+
+
 def _eligible_conv(layer) -> bool:
     from deeplearning4j_tpu.nn.layers import ConvolutionLayer
 
     if type(layer) is not ConvolutionLayer:
         return False
-    if _pair(layer.kernel) != (1, 1) or _pair(layer.dilation) != (1, 1):
+    if _pair(layer.dilation) != (1, 1) or layer.has_bias:
         return False
-    if _pair(layer.padding) != (0, 0) or layer.has_bias:
+    if (layer.activation or "identity") != "identity" or layer.dropout:
         return False
-    return (layer.activation or "identity") == "identity" \
-        and not layer.dropout
+    return fusable_conv_shape(layer.kernel, layer.stride, layer.padding,
+                              layer.convolution_mode)
 
 
 def _eligible_bn(layer) -> bool:
@@ -72,11 +94,11 @@ def _pair_config_matches(conv, bn) -> bool:
 
 
 def fuse_conv_bn(net):
-    """Rewrite eligible 1x1-conv -> BN pairs of a ComputationGraph into
-    FusedConvBNLayer vertices, transferring weights and running stats.
-    Returns a NEW initialized network (the input is untouched);
-    `net.fused_pairs` on the result lists the (conv, bn) names rewritten.
-    """
+    """Rewrite eligible conv -> BN pairs (1x1 any stride; 3x3 stride-1
+    SAME) of a ComputationGraph into FusedConvBNLayer vertices,
+    transferring weights and running stats. Returns a NEW initialized
+    network (the input is untouched); `net.fused_pairs` on the result
+    lists the (conv, bn) names rewritten."""
     from deeplearning4j_tpu.models import ComputationGraph
     from deeplearning4j_tpu.nn.graph import LayerVertex, toposort
     from deeplearning4j_tpu.nn.layers import FusedConvBNLayer
@@ -128,6 +150,7 @@ def fuse_conv_bn(net):
         bn = vertices[bn_name].layer
         fused = FusedConvBNLayer(
             name=bn_name, n_in=conv.n_in, n_out=conv.n_out,
+            kernel=_pair(conv.kernel),
             stride=_pair(conv.stride), decay=bn.decay, eps=bn.eps,
             activation=bn.activation or "identity",
             weight_init=conv.weight_init,

@@ -492,16 +492,18 @@ class Subsampling1DLayer(Layer):
 @register_layer
 @dataclasses.dataclass(frozen=True)
 class FusedConvBNLayer(Layer):
-    """1x1 conv + batch norm + activation as ONE fused op (Pallas): the
-    BN batch statistics are accumulated inside the matmul kernel while
+    """Conv + batch norm + activation as ONE fused op (Pallas): the
+    BN batch statistics are accumulated inside the conv kernel while
     the output tile is in VMEM, saving a full HBM sweep per conv+BN pair
     (see `ops/conv_fused.py`). This is the framework's answer to the
     reference's cuDNN helper seam (`ConvolutionLayer.java:67-77`,
-    `CudnnBatchNormalizationHelper.java`) for the ResNet bottleneck 1x1s.
+    `CudnnBatchNormalizationHelper.java`). Two kernel shapes are fused:
+    (1, 1) any stride (the ResNet bottleneck reduce/expand/projection
+    matmuls) and (3, 3) stride-1 SAME (the bottleneck middle convs).
 
-    Parameters: W [1, 1, n_in, n_out] (HWIO, same shape as
+    Parameters: W [kh, kw, n_in, n_out] (HWIO, same shape as
     ConvolutionLayer's), gamma/beta; state: running mean/var. Equivalent
-    to ConvolutionLayer(kernel=(1,1), has_bias=False, activation=identity)
+    to ConvolutionLayer(kernel, has_bias=False, activation=identity)
     followed by BatchNormalization(activation=...), to float32 accuracy.
     """
 
@@ -509,9 +511,18 @@ class FusedConvBNLayer(Layer):
 
     n_in: Optional[int] = None
     n_out: Optional[int] = None
+    kernel: Any = (1, 1)
     stride: Any = (1, 1)
     decay: float = 0.9
     eps: float = 1e-5
+
+    def __post_init__(self):
+        k = _pair(self.kernel)
+        if k not in ((1, 1), (3, 3)):
+            raise ValueError(f"FusedConvBNLayer supports kernels (1,1) "
+                             f"and (3,3), got {k}")
+        if k == (3, 3) and _pair(self.stride) != (1, 1):
+            raise ValueError("the fused 3x3 path is stride-1 SAME only")
 
     def infer_n_in(self, input_type: InputType) -> "FusedConvBNLayer":
         if self.n_in is None and input_type.kind in ("cnn", "cnn_flat"):
@@ -519,15 +530,17 @@ class FusedConvBNLayer(Layer):
         return self
 
     def output_type(self, input_type: InputType) -> InputType:
+        # (1,1): stride applies as input subsampling, out = ceil(in/s),
+        # identical to a VALID-padded strided 1x1 conv. (3,3): stride-1
+        # SAME, spatial dims unchanged.
         sh, sw = _pair(self.stride)
-        # stride applies as input subsampling: out = ceil(in / stride),
-        # identical to a VALID-padded strided 1x1 conv
         return InputType.convolutional(
             -(-input_type.height // sh), -(-input_type.width // sw),
             self.n_out)
 
     def init_params(self, key, input_type, dtype=jnp.float32):
-        w = self._winit()(key, (1, 1, self.n_in, self.n_out), dtype)
+        kh, kw = _pair(self.kernel)
+        w = self._winit()(key, (kh, kw, self.n_in, self.n_out), dtype)
         params = {
             "W": w,
             "gamma": jnp.ones((self.n_out,), dtype),
@@ -539,29 +552,42 @@ class FusedConvBNLayer(Layer):
 
     def apply(self, params, x, *, state=None, train=False, rng=None,
               mask=None):
-        from deeplearning4j_tpu.ops.conv_fused import conv1x1_bn_act
+        from deeplearning4j_tpu.ops.conv_fused import (
+            conv1x1_bn_act, conv3x3_bn_act)
 
         x = self._maybe_dropout(x, train, rng)
         act = self.activation or "identity"
         relu = act == "relu"
-        w = params["W"][0, 0]
         interpret = jax.default_backend() != "tpu"
+        is3x3 = _pair(self.kernel) == (3, 3)
         if train:
-            out, m, v = conv1x1_bn_act(
-                x, w, params["gamma"], params["beta"],
-                stride=_pair(self.stride), eps=self.eps, relu=relu,
-                train=True, interpret=interpret)
+            if is3x3:
+                out, m, v = conv3x3_bn_act(
+                    x, params["W"], params["gamma"], params["beta"],
+                    eps=self.eps, relu=relu, train=True,
+                    interpret=interpret)
+            else:
+                out, m, v = conv1x1_bn_act(
+                    x, params["W"][0, 0], params["gamma"], params["beta"],
+                    stride=_pair(self.stride), eps=self.eps, relu=relu,
+                    train=True, interpret=interpret)
             d = self.decay
             new_state = {
                 "mean": d * state["mean"] + (1 - d) * m,
                 "var": d * state["var"] + (1 - d) * v,
             }
         else:
-            out = conv1x1_bn_act(
-                x, w, params["gamma"], params["beta"],
-                mean=state["mean"], var=state["var"],
-                stride=_pair(self.stride), eps=self.eps, relu=relu,
-                train=False)
+            if is3x3:
+                out = conv3x3_bn_act(
+                    x, params["W"], params["gamma"], params["beta"],
+                    mean=state["mean"], var=state["var"],
+                    eps=self.eps, relu=relu, train=False)
+            else:
+                out = conv1x1_bn_act(
+                    x, params["W"][0, 0], params["gamma"], params["beta"],
+                    mean=state["mean"], var=state["var"],
+                    stride=_pair(self.stride), eps=self.eps, relu=relu,
+                    train=False)
             new_state = state
         if not relu and act != "identity":
             out = self._act(out)

@@ -1,5 +1,9 @@
-"""Fused 1x1-conv + batch-norm statistics (Pallas TPU) — the conv-epilogue
+"""Fused conv + batch-norm statistics (Pallas TPU) — the conv-epilogue
 fusion targeting the HBM-bound BN sweeps of ResNet-style bottlenecks.
+Two kernel shapes are fused: 1x1 any stride (`conv1x1_bn_act`, a matmul)
+and 3x3 stride-1 SAME (`conv3x3_bn_act`, nine shifted matmuls over a
+VMEM halo) — together they cover every conv+BN pair in a ResNet-50
+bottleneck; only the 7x7 stem stays on plain XLA.
 
 Reference parity: the cuDNN helper seam
 (`nn/layers/convolution/ConvolutionLayer.java:67-77` +
@@ -126,6 +130,147 @@ def matmul_with_channel_stats(x2d, w, *, interpret: bool = False):
     return y, ps.sum(axis=(0, 1)), pq.sum(axis=(0, 1))
 
 
+# ----------------------------------------------------- 3x3 conv variant
+def _pick_conv3_blocks(b: int, h: int, w: int, cin: int, cout: int,
+                       itemsize: int) -> Optional[Tuple[int, int]]:
+    """(nb, bn) batch-group / cout-tile sizes for the 3x3 kernel, or None
+    to fall back to XLA. nb groups images so the matmul M-dim (nb*h*w)
+    feeds the MXU properly even at late-stage 7x7 maps; the VMEM guard
+    keeps xpad + weight + accumulator tiles comfortably on-core."""
+    nb = None
+    for cand in (1, 2, 4, 8, 16, 32):
+        if b % cand == 0 and cand * h * w >= 256:
+            nb = cand
+            break
+    if nb is None:
+        nb = b
+    bn = _divisor_block(cout, (256, 128, 64, 32, 16, 8))
+    if bn is None:
+        return None
+    xblk = nb * h * w * cin * itemsize
+    wblk = 9 * cin * bn * itemsize
+    yblk = nb * h * w * bn * itemsize
+    xpad = nb * (h + 2) * (w + 2) * cin * itemsize
+    acc = nb * h * w * bn * jnp.dtype(jnp.float32).itemsize
+    # in/out blocks are double-buffered by the pipeline; scratch and the
+    # accumulator temp are not. Budget well under the ~16MB/core VMEM.
+    if 2 * (xblk + wblk + yblk) + xpad + acc > 10 * 1024 * 1024:
+        return None
+    return nb, bn
+
+
+def _conv3_stats_kernel(x_ref, w_ref, y_ref, s_ref, q_ref, xpad,
+                        acc_dtype=jnp.float32):
+    """One (batch-group i, cout-tile j) step: zero-padded halo copy of the
+    input group into VMEM, nine shifted matmuls (the 3x3 taps), then the
+    output tile plus its per-channel partial sum / sum-of-squares — the
+    BN statistics ride the conv exactly as in the 1x1 kernel."""
+    nb, h, w, cin = x_ref.shape
+    bn = w_ref.shape[3]
+
+    # j (cout tiles) is the innermost grid axis and the x block depends
+    # only on i, so the halo copy persists in scratch across the j sweep
+    @pl.when(pl.program_id(1) == 0)
+    def _():
+        xpad[:] = jnp.zeros(xpad.shape, xpad.dtype)
+        xpad[:, 1:h + 1, 1:w + 1, :] = x_ref[:]
+
+    m = nb * h * w
+    tot = jnp.zeros((m, bn), acc_dtype)
+    for dh in range(3):
+        for dw in range(3):
+            xs = xpad[:, dh:dh + h, dw:dw + w, :].reshape(m, cin)
+            tot += jnp.dot(xs, w_ref[dh, dw],
+                           preferred_element_type=acc_dtype)
+    y_ref[:] = tot.reshape(nb, h, w, bn).astype(y_ref.dtype)
+    s_ref[:] = tot.sum(axis=0, keepdims=True)[None]
+    q_ref[:] = (tot * tot).sum(axis=0, keepdims=True)[None]
+
+
+def _conv3_xla(x, w, acc_dtype):
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(1, 1), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        preferred_element_type=acc_dtype)
+
+
+def conv3x3_with_channel_stats(x, w, *, interpret: bool = False):
+    """y = conv2d(x, w, stride 1, SAME) plus per-output-channel
+    (sum, sum_of_squares) of y computed inside the conv kernel.
+    x: [B, H, W, C_in] NHWC; w: [3, 3, C_in, C_out] HWIO. Returns
+    (y in x.dtype, sums [C_out], sumsqs [C_out] in the accumulation
+    dtype). Falls back to XLA conv + XLA reductions when the shape does
+    not tile or would overflow VMEM."""
+    b, h, wd, cin = x.shape
+    assert w.shape[:2] == (3, 3) and w.shape[2] == cin, (x.shape, w.shape)
+    cout = w.shape[3]
+    acc = _acc_dtype(x.dtype)
+    blocks = _pick_conv3_blocks(b, h, wd, cin, cout, x.dtype.itemsize)
+    if blocks is None:
+        y = _conv3_xla(x, w, acc)
+        return (y.astype(x.dtype), jnp.sum(y, axis=(0, 1, 2)),
+                jnp.sum(y * y, axis=(0, 1, 2)))
+    nb, bn = blocks
+    nm, nn = b // nb, cout // bn
+    y, ps, pq = pl.pallas_call(
+        functools.partial(_conv3_stats_kernel, acc_dtype=acc),
+        grid=(nm, nn),
+        in_specs=[
+            pl.BlockSpec((nb, h, wd, cin), lambda i, j: (i, 0, 0, 0)),
+            pl.BlockSpec((3, 3, cin, bn), lambda i, j: (0, 0, 0, j)),
+        ],
+        out_specs=[
+            pl.BlockSpec((nb, h, wd, bn), lambda i, j: (i, 0, 0, j)),
+            pl.BlockSpec((1, 1, bn), lambda i, j: (i, 0, j)),
+            pl.BlockSpec((1, 1, bn), lambda i, j: (i, 0, j)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, h, wd, cout), x.dtype),
+            jax.ShapeDtypeStruct((nm, 1, cout), acc),
+            jax.ShapeDtypeStruct((nm, 1, cout), acc),
+        ],
+        scratch_shapes=[pltpu.VMEM((nb, h + 2, wd + 2, cin), x.dtype)],
+        interpret=interpret,
+    )(x, w)
+    return y, ps.sum(axis=(0, 1)), pq.sum(axis=(0, 1))
+
+
+# --------------------------------------------------- shared BN epilogue
+def _bn_train_epilogue(y, s, q, mval, gamma, beta, eps, relu, acc):
+    """Normalize a linear-op output y from its in-kernel (sum, sumsq)
+    partials: returns (out in acc dtype, batch mean, biased clamped
+    batch var). Shared by the 1x1 (reduce over rows) and 3x3 (reduce
+    over B,H,W) paths — the per-channel stats broadcast identically."""
+    mean = s / mval
+    var = jnp.maximum(q / mval - mean * mean, 0.0)  # biased, clamped
+    inv = jax.lax.rsqrt(var + eps)
+    scale = gamma.astype(acc) * inv
+    shift = beta.astype(acc) - mean * scale
+    pre = y.astype(acc) * scale + shift
+    out = jnp.maximum(pre, 0.0) if relu else pre
+    return out, mean, var
+
+
+def _bn_backward(dout, y, gamma, beta, mean, var, eps, relu, axes, mval,
+                 ct):
+    """Training-mode BN backward through the epilogue: returns (dy wrt
+    the linear-op output, dgamma, dbeta) in the accumulation dtype; the
+    caller finishes with the linear op's own transpose (matmul or conv
+    VJP). `axes` are the reduction axes of the batch statistics, whose
+    mean/var depend on every element of the reduction group."""
+    inv = jax.lax.rsqrt(var + eps)
+    xhat = (y.astype(ct) - mean) * inv
+    g = dout.astype(ct)
+    if relu:
+        g = g * ((gamma.astype(ct) * xhat + beta.astype(ct)) > 0)
+    dbeta = g.sum(axis=axes)
+    dgamma = (g * xhat).sum(axis=axes)
+    dxhat = g * gamma.astype(ct)
+    dy = inv * (dxhat - dxhat.sum(axis=axes) / mval
+                - xhat * (dxhat * xhat).sum(axis=axes) / mval)
+    return dy, dgamma, dbeta
+
+
 # ------------------------------------------------------------- train path
 @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
 def _conv1x1_bn_train(x2d, w, gamma, beta, eps, relu, interpret):
@@ -135,16 +280,10 @@ def _conv1x1_bn_train(x2d, w, gamma, beta, eps, relu, interpret):
 
 
 def _train_fwd_impl(x2d, w, gamma, beta, eps, relu, interpret):
-    mval = x2d.shape[0]
     acc = _acc_dtype(x2d.dtype)
     y, s, q = matmul_with_channel_stats(x2d, w, interpret=interpret)
-    mean = s / mval
-    var = jnp.maximum(q / mval - mean * mean, 0.0)  # biased, clamped
-    inv = jax.lax.rsqrt(var + eps)
-    scale = gamma.astype(acc) * inv
-    shift = beta.astype(acc) - mean * scale
-    pre = y.astype(acc) * scale + shift
-    out = jnp.maximum(pre, 0.0) if relu else pre
+    out, mean, var = _bn_train_epilogue(y, s, q, x2d.shape[0], gamma,
+                                        beta, eps, relu, acc)
     return out.astype(x2d.dtype), y, mean, var
 
 
@@ -159,19 +298,9 @@ def _train_vjp_bwd(eps, relu, interpret, res, cts):
     # running-stat outputs, so d_mean/d_var are structurally zero here
     dout = cts[0]
     x2d, w, gamma, beta, y, mean, var = res
-    mval = x2d.shape[0]
     ct = _acc_dtype(x2d.dtype)
-    inv = jax.lax.rsqrt(var + eps)
-    xhat = (y.astype(ct) - mean) * inv
-    g = dout.astype(ct)
-    if relu:
-        g = g * ((gamma.astype(ct) * xhat + beta.astype(ct)) > 0)
-    dbeta = g.sum(axis=0)
-    dgamma = (g * xhat).sum(axis=0)
-    dxhat = g * gamma.astype(ct)
-    # training-mode BN backward: mean/var depend on every row
-    dy = inv * (dxhat - dxhat.mean(axis=0)
-                - xhat * (dxhat * xhat).mean(axis=0))
+    dy, dgamma, dbeta = _bn_backward(dout, y, gamma, beta, mean, var,
+                                     eps, relu, (0,), x2d.shape[0], ct)
     dx = jnp.dot(dy, w.astype(ct).T,
                  preferred_element_type=ct).astype(x2d.dtype)
     dw = jnp.dot(x2d.astype(ct).T, dy,
@@ -218,3 +347,72 @@ def conv1x1_bn_act(x, w, gamma, beta, *, mean=None, var=None,
     if relu:
         pre = jnp.maximum(pre, 0.0)
     return pre.astype(x.dtype).reshape(b, h, wd, n)
+
+
+# --------------------------------------------- 3x3 train path + public API
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+def _conv3x3_bn_train(x, w, gamma, beta, eps, relu, interpret):
+    out, _, mean, var = _conv3_train_fwd_impl(x, w, gamma, beta, eps,
+                                              relu, interpret)
+    return out, mean, var
+
+
+def _conv3_train_fwd_impl(x, w, gamma, beta, eps, relu, interpret):
+    b, h, wd, _ = x.shape
+    acc = _acc_dtype(x.dtype)
+    y, s, q = conv3x3_with_channel_stats(x, w, interpret=interpret)
+    out, mean, var = _bn_train_epilogue(y, s, q, b * h * wd, gamma,
+                                        beta, eps, relu, acc)
+    return out.astype(x.dtype), y, mean, var
+
+
+def _conv3_vjp_fwd(x, w, gamma, beta, eps, relu, interpret):
+    out, y, mean, var = _conv3_train_fwd_impl(x, w, gamma, beta, eps,
+                                              relu, interpret)
+    return (out, mean, var), (x, w, gamma, beta, y, mean, var)
+
+
+def _conv3_vjp_bwd(eps, relu, interpret, res, cts):
+    # shared BN backward, then the conv's own VJP instead of the matmul
+    # transposes (XLA derives the flipped-kernel conv for dx and the
+    # patch correlation for dw)
+    dout = cts[0]
+    x, w, gamma, beta, y, mean, var = res
+    b, h, wd, _ = x.shape
+    ct = _acc_dtype(x.dtype)
+    dy, dgamma, dbeta = _bn_backward(dout, y, gamma, beta, mean, var,
+                                     eps, relu, (0, 1, 2), b * h * wd, ct)
+    _, conv_vjp = jax.vjp(
+        lambda xx, ww: _conv3_xla(xx, ww, ct),
+        x.astype(ct), w.astype(ct))
+    dx, dw = conv_vjp(dy)
+    return (dx.astype(x.dtype), dw.astype(w.dtype),
+            dgamma.astype(gamma.dtype), dbeta.astype(beta.dtype))
+
+
+_conv3x3_bn_train.defvjp(_conv3_vjp_fwd, _conv3_vjp_bwd)
+
+
+def conv3x3_bn_act(x, w, gamma, beta, *, mean=None, var=None,
+                   eps: float = 1e-5, relu: bool = True,
+                   train: bool = False, interpret: bool = False):
+    """Fused 3x3 stride-1 SAME conv + batch norm + (optional) ReLU over
+    NHWC input — the 3x3 sibling of `conv1x1_bn_act`, covering the
+    remaining third of ResNet-50's conv FLOPs (the bottleneck middle
+    convs are all 3x3/1/SAME). Same contract: train=True returns
+    (out, batch_mean, batch_var) with the statistics accumulated inside
+    the conv kernel; train=False folds the running stats into one XLA
+    conv+affine(+relu) epilogue."""
+    if train:
+        out, bmean, bvar = _conv3x3_bn_train(x, w, gamma, beta, eps,
+                                             relu, interpret)
+        return (out, jax.lax.stop_gradient(bmean),
+                jax.lax.stop_gradient(bvar))
+    acc = _acc_dtype(x.dtype)
+    inv = jax.lax.rsqrt(var.astype(acc) + eps)
+    scale = gamma.astype(acc) * inv
+    shift = beta.astype(acc) - mean.astype(acc) * scale
+    pre = _conv3_xla(x, w, acc) * scale + shift
+    if relu:
+        pre = jnp.maximum(pre, 0.0)
+    return pre.astype(x.dtype)

@@ -57,15 +57,18 @@ class ResNet50(ZooModel):
 
     def _conv_bn(self, g, name, inp, n_out, kernel, stride=(1, 1),
                  pad=(0, 0), act="relu", mode="truncate"):
-        # fused=True: the bottleneck 1x1s (reduce/expand/projection —
-        # ~2/3 of the conv FLOPs) run as ONE Pallas matmul+BN-stats
+        # fused=True: the bottleneck convs run as ONE Pallas conv+BN-stats
         # kernel instead of conv->stats->normalize HBM sweeps
-        # (ops/conv_fused.py; opt-in like stem="s2d" until measured)
-        if (self.kw.get("fused") and tuple(kernel) == (1, 1)
-                and tuple(pad) == (0, 0) and mode != "same"):
+        # (ops/conv_fused.py; opt-in like stem="s2d" until measured).
+        # Covers the 1x1s (reduce/expand/projection, ~2/3 of conv FLOPs)
+        # and the 3x3 stride-1 SAME middles (the remaining third).
+        from deeplearning4j_tpu.models.fusion import fusable_conv_shape
+
+        if self.kw.get("fused") and fusable_conv_shape(kernel, stride,
+                                                       pad, mode):
             g.add_layer(f"{name}_convbn",
-                        FusedConvBNLayer(n_out=n_out, stride=stride,
-                                         activation=act),
+                        FusedConvBNLayer(n_out=n_out, kernel=kernel,
+                                         stride=stride, activation=act),
                         inp)
             return f"{name}_convbn"
         g.add_layer(f"{name}_conv",

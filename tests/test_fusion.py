@@ -17,7 +17,7 @@ from deeplearning4j_tpu.optim.updaters import Sgd
 
 
 def _graph(conv_kw=None, two_consumers=False):
-    """input -> conv1x1 -> bn -> [gap] -> output (+ optional second
+    """input -> conv -> bn -> [gap] -> output (+ optional second
     consumer of the conv)."""
     from deeplearning4j_tpu.nn.layers import GlobalPoolingLayer
 
@@ -75,7 +75,10 @@ def test_pair_rewritten_with_exact_parity():
 
 
 @pytest.mark.parametrize("conv_kw", [
-    {"kernel": (3, 3)},           # not 1x1
+    {"kernel": (3, 3)},           # 3x3 VALID (not SAME) stays unfused
+    {"kernel": (3, 3), "convolution_mode": "same",
+     "stride": (2, 2)},           # 3x3 strided stays unfused
+    {"kernel": (5, 5), "convolution_mode": "same"},   # unsupported shape
     {"has_bias": True},           # biased conv
     {"activation": "relu"},       # non-identity conv activation
 ])
@@ -92,16 +95,49 @@ def test_multi_consumer_conv_not_fused():
     assert fused.fused_pairs == []
 
 
-def test_resnet50_fuses_all_bottleneck_1x1s():
+def test_3x3_same_pair_rewritten_with_exact_parity():
+    """The 3x3 stride-1 SAME conv+BN pair fuses (the in-kernel-stats
+    Pallas conv, `ops/conv_fused.py:conv3x3_with_channel_stats`) with
+    forward and one-step training parity against the unfused graph."""
+    net = _graph({"kernel": (3, 3), "convolution_mode": "same"})
+    fused = fuse_conv_bn(net)
+    assert fused.fused_pairs == [("c", "b")]
+    layer = fused.conf.vertices["b"].layer
+    assert isinstance(layer, FusedConvBNLayer)
+    assert tuple(layer.kernel) == (3, 3)
+    mds = _data()
+    x = np.asarray(mds.features[0])
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               np.asarray(fused.output(x)),
+                               rtol=1e-5, atol=1e-6)
+    net.fit(mds)
+    fused.fit(mds)
+    np.testing.assert_allclose(np.asarray(net.output(x)),
+                               np.asarray(fused.output(x)),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(
+        np.asarray(net.state_tree["b"]["mean"]),
+        np.asarray(fused.state_tree["b"]["mean"]), rtol=1e-5, atol=1e-6)
+
+
+def test_3x3_explicit_pad_same_equivalent_fuses():
+    """padding=(1,1) truncate-mode is SAME for a stride-1 3x3 — the
+    structural eligibility accepts the explicit-pad spelling too."""
+    net = _graph({"kernel": (3, 3), "padding": (1, 1)})
+    fused = fuse_conv_bn(net)
+    assert fused.fused_pairs == [("c", "b")]
+
+
+def test_resnet50_fuses_all_bottleneck_convs():
     from deeplearning4j_tpu.zoo import ResNet50
 
     net = ComputationGraph(ResNet50(
         num_classes=4, input_shape=(32, 32, 3),
         updater=Sgd(1e-3)).conf()).init()
     fused = fuse_conv_bn(net)
-    # 16 blocks x 2 bottleneck 1x1s + 4 projection shortcuts = 36; the
-    # 3x3s and the 7x7 stem stay (VERDICT r3: 1x1s are ~2/3 of FLOPs)
-    assert len(fused.fused_pairs) == 36
+    # 16 blocks x (2 bottleneck 1x1s + 1 stride-1 SAME 3x3) + 4
+    # projection shortcuts = 52; only the 7x7 stem stays unfused
+    assert len(fused.fused_pairs) == 52
     x = np.random.default_rng(2).standard_normal(
         (2, 32, 32, 3)).astype(np.float32)
     np.testing.assert_allclose(np.asarray(net.output(x)),
@@ -158,14 +194,19 @@ def test_training_config_and_updater_state_carry_over():
     assert net.score(mds) == pytest.approx(fused.score(mds), rel=1e-5)
 
 
-def test_fused_layer_central_difference_gradients():
+@pytest.mark.parametrize("conv_kw", [
+    None,                                              # 1x1
+    {"kernel": (3, 3), "convolution_mode": "same"},    # 3x3 SAME
+], ids=["1x1", "3x3"])
+def test_fused_layer_central_difference_gradients(conv_kw):
     """The reference's correctness backbone applied to the fused layer:
     numeric central-difference vs analytic gradients through a graph
     containing FusedConvBNLayer (f64, interpret-mode Pallas)."""
     from deeplearning4j_tpu.gradientcheck import check_gradients
 
-    net = _graph()
+    net = _graph(conv_kw)
     fused = fuse_conv_bn(net)
+    assert fused.fused_pairs == [("c", "b")]
 
     class _Shim:   # dict-IO adapter, the CG gradient-check convention
         params_tree = fused.params_tree
