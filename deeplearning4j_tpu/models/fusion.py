@@ -48,9 +48,9 @@ def fusable_conv_shape(kernel, stride, padding, mode) -> bool:
     builders deciding to emit FusedConvBNLayer directly."""
     k = _pair(kernel)
     if k == (1, 1):
-        # for k=1, SAME == VALID, so any padding mode; explicit pad must
-        # be zero
-        return _pair(padding) == (0, 0)
+        # for k=1, SAME == VALID; same-mode ignores explicit padding
+        # entirely, other modes need it to actually be zero
+        return mode == "same" or _pair(padding) == (0, 0)
     if k == (3, 3):
         # the fused 3x3 kernel is stride-1 SAME only
         if _pair(stride) != (1, 1):

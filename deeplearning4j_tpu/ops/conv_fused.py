@@ -251,6 +251,19 @@ def _bn_train_epilogue(y, s, q, mval, gamma, beta, eps, relu, acc):
     return out, mean, var
 
 
+def _bn_eval_fold(y, gamma, beta, mean, var, eps, relu, acc, out_dtype):
+    """Eval-mode fold: running stats become one affine(+relu) epilogue
+    on the linear-op output (XLA fuses this into the producing kernel).
+    Shared by the 1x1 and 3x3 eval paths."""
+    inv = jax.lax.rsqrt(var.astype(acc) + eps)
+    scale = gamma.astype(acc) * inv
+    shift = beta.astype(acc) - mean.astype(acc) * scale
+    pre = y * scale + shift
+    if relu:
+        pre = jnp.maximum(pre, 0.0)
+    return pre.astype(out_dtype)
+
+
 def _bn_backward(dout, y, gamma, beta, mean, var, eps, relu, axes, mval,
                  ct):
     """Training-mode BN backward through the epilogue: returns (dy wrt
@@ -339,14 +352,9 @@ def conv1x1_bn_act(x, w, gamma, beta, *, mean=None, var=None,
                 jax.lax.stop_gradient(bmean),
                 jax.lax.stop_gradient(bvar))
     acc = _acc_dtype(x.dtype)
-    inv = jax.lax.rsqrt(var.astype(acc) + eps)
-    scale = gamma.astype(acc) * inv
-    shift = beta.astype(acc) - mean.astype(acc) * scale
     pre = jnp.dot(x2d, w, preferred_element_type=acc)
-    pre = pre * scale + shift
-    if relu:
-        pre = jnp.maximum(pre, 0.0)
-    return pre.astype(x.dtype).reshape(b, h, wd, n)
+    return _bn_eval_fold(pre, gamma, beta, mean, var, eps, relu, acc,
+                         x.dtype).reshape(b, h, wd, n)
 
 
 # --------------------------------------------- 3x3 train path + public API
@@ -409,10 +417,5 @@ def conv3x3_bn_act(x, w, gamma, beta, *, mean=None, var=None,
         return (out, jax.lax.stop_gradient(bmean),
                 jax.lax.stop_gradient(bvar))
     acc = _acc_dtype(x.dtype)
-    inv = jax.lax.rsqrt(var.astype(acc) + eps)
-    scale = gamma.astype(acc) * inv
-    shift = beta.astype(acc) - mean.astype(acc) * scale
-    pre = _conv3_xla(x, w, acc) * scale + shift
-    if relu:
-        pre = jnp.maximum(pre, 0.0)
-    return pre.astype(x.dtype)
+    return _bn_eval_fold(_conv3_xla(x, w, acc), gamma, beta, mean, var,
+                         eps, relu, acc, x.dtype)

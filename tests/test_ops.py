@@ -307,6 +307,125 @@ class TestFusedConvBN:
         o2, m2, v2 = self._ref(x[:, ::2, ::2, :], w, gamma, beta)
         np.testing.assert_allclose(o, o2, rtol=1e-4, atol=1e-5)
 
+    # ---------------------------------------------------- 3x3 variant
+    def _ref3(self, x, w, gamma, beta, eps=1e-5, relu=True):
+        import jax
+        import jax.numpy as jnp
+
+        y = jax.lax.conv_general_dilated(
+            x, w, (1, 1), "SAME",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        m = y.mean(axis=(0, 1, 2))
+        v = y.var(axis=(0, 1, 2))
+        o = gamma * (y - m) / jnp.sqrt(v + eps) + beta
+        return (jnp.maximum(o, 0) if relu else o), m, v
+
+    def _data3(self, B=4, H=8, W=8, C=16, N=32, seed=0):
+        import jax.numpy as jnp
+
+        r = np.random.default_rng(seed)
+        return (jnp.asarray(r.standard_normal((B, H, W, C)), jnp.float32),
+                jnp.asarray(r.standard_normal((3, 3, C, N)) * 0.1,
+                            jnp.float32),
+                jnp.asarray(r.random(N) + 0.5, jnp.float32),
+                jnp.asarray(r.standard_normal(N) * 0.1, jnp.float32))
+
+    @pytest.mark.parametrize("relu", [True, False])
+    def test_3x3_train_forward_matches_reference(self, relu):
+        from deeplearning4j_tpu.ops.conv_fused import conv3x3_bn_act
+
+        x, w, gamma, beta = self._data3()
+        o1, m1, v1 = conv3x3_bn_act(x, w, gamma, beta, train=True,
+                                    relu=relu, interpret=True)
+        o2, m2, v2 = self._ref3(x, w, gamma, beta, relu=relu)
+        np.testing.assert_allclose(o1, o2, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(m1, m2, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(v1, v2, rtol=1e-5, atol=1e-6)
+
+    def test_3x3_channel_stats_ride_the_conv(self):
+        """The halo-copy Pallas kernel (not the XLA fallback) produces
+        conv + per-channel sums: the SAME-padding borders are the risky
+        part, so check a shape the block picker accepts."""
+        from deeplearning4j_tpu.ops.conv_fused import (
+            _conv3_xla, _pick_conv3_blocks, conv3x3_with_channel_stats,
+        )
+        import jax.numpy as jnp
+
+        x, w, _, _ = self._data3()
+        assert _pick_conv3_blocks(*x.shape, w.shape[3],
+                                  x.dtype.itemsize) is not None
+        y, s, q = conv3x3_with_channel_stats(x, w, interpret=True)
+        ref = _conv3_xla(x, w, jnp.float32)
+        np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(s, ref.sum((0, 1, 2)), rtol=1e-4,
+                                   atol=1e-3)
+        np.testing.assert_allclose(q, (ref * ref).sum((0, 1, 2)),
+                                   rtol=1e-4, atol=1e-3)
+
+    @pytest.mark.parametrize("relu", [True, False])
+    def test_3x3_gradients_match_autodiff_reference(self, relu):
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.ops.conv_fused import conv3x3_bn_act
+
+        x, w, gamma, beta = self._data3(B=2, H=4, W=4, C=8, N=16, seed=3)
+
+        def lf(x, w, g, b):
+            o, _, _ = conv3x3_bn_act(x, w, g, b, train=True, relu=relu,
+                                     interpret=True)
+            return jnp.sum(jnp.sin(o))
+
+        def lr(x, w, g, b):
+            o, _, _ = self._ref3(x, w, g, b, relu=relu)
+            return jnp.sum(jnp.sin(o))
+
+        g1 = jax.grad(lf, argnums=(0, 1, 2, 3))(x, w, gamma, beta)
+        g2 = jax.grad(lr, argnums=(0, 1, 2, 3))(x, w, gamma, beta)
+        for a, b_, name in zip(g1, g2, ("x", "w", "gamma", "beta")):
+            np.testing.assert_allclose(a, b_, rtol=1e-3, atol=1e-3,
+                                       err_msg=name)
+
+    def test_3x3_multi_step_grid_halo_reuse(self):
+        """A shape whose grid has BOTH nm>1 (several batch groups) and
+        nn>1 (several cout tiles): the halo scratch must be re-copied at
+        each new batch group and persist unchanged across the cout-tile
+        sweep (`@pl.when(program_id(1) == 0)`). Single-step grids cannot
+        catch a stale or re-zeroed halo."""
+        from deeplearning4j_tpu.ops.conv_fused import (
+            _conv3_xla, _pick_conv3_blocks, conv3x3_with_channel_stats,
+        )
+        import jax.numpy as jnp
+
+        x, w, _, _ = self._data3(B=8, H=8, W=8, C=16, N=24, seed=7)
+        blocks = _pick_conv3_blocks(*x.shape, 24, x.dtype.itemsize)
+        assert blocks is not None
+        nb, bn = blocks
+        assert 8 // nb > 1 and 24 // bn > 1, (nb, bn)
+        y, s, q = conv3x3_with_channel_stats(x, w, interpret=True)
+        ref = _conv3_xla(x, w, jnp.float32)
+        np.testing.assert_allclose(y, ref, rtol=1e-4, atol=1e-4)
+        np.testing.assert_allclose(s, ref.sum((0, 1, 2)), rtol=1e-4,
+                                   atol=1e-3)
+        np.testing.assert_allclose(q, (ref * ref).sum((0, 1, 2)),
+                                   rtol=1e-4, atol=1e-3)
+
+    def test_3x3_untileable_shape_falls_back_exactly(self):
+        """cout that doesn't tile (e.g. 12) routes to the XLA fallback
+        with identical results — the picker's None path is load-bearing,
+        not dead code."""
+        from deeplearning4j_tpu.ops.conv_fused import (
+            _pick_conv3_blocks, conv3x3_bn_act,
+        )
+
+        x, w, gamma, beta = self._data3(N=12, seed=5)
+        assert _pick_conv3_blocks(*x.shape, 12, x.dtype.itemsize) is None
+        o1, m1, v1 = conv3x3_bn_act(x, w, gamma, beta, train=True,
+                                    interpret=True)
+        o2, m2, v2 = self._ref3(x, w, gamma, beta)
+        np.testing.assert_allclose(o1, o2, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(v1, v2, rtol=1e-5, atol=1e-6)
+
     def test_layer_matches_conv_plus_bn_stack(self):
         """FusedConvBNLayer == ConvolutionLayer + BatchNormalization to
         float32 accuracy, including the running-stat update and the eval
