@@ -12,9 +12,9 @@ statistics ride the matmul), eval folding stays in XLA.
 
 Eligibility (both checked structurally, nothing silently approximated):
 - ConvolutionLayer with no bias, identity activation, dilation-free, and
-  a fusable shape: kernel (1,1) with zero explicit padding (SAME==VALID
-  at k=1, so any mode), or kernel (3,3) stride-1 with SAME-equivalent
-  padding;
+  a fusable shape: kernel (1,1) in same mode (explicit padding is
+  ignored under same) or in strict/truncate mode with zero explicit
+  padding, or kernel (3,3) stride-1 with SAME-equivalent padding;
 - whose ONLY consumer is a BatchNormalization vertex with learnable
   gamma+beta, itself not consuming anything else.
 
