@@ -43,11 +43,16 @@ def ring_attention_demo(T=4096, block_check=256):
     rng = np.random.default_rng(0)
     q, k, v = (jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
                for _ in range(3))
-    out = ring_self_attention(q, k, v, mesh, axis="seq", causal=True)
-    # spot-check a block against the dense oracle (dense on 4k is fine on
-    # host; on a real long context it would not be)
-    ref = attention(q[:, :block_check], k[:, :block_check],
-                    v[:, :block_check], causal=True)
+    # Pin matmul precision for the parity check: TPU's default rounds
+    # f32 matmul inputs to bf16, which would force a ~1000x looser
+    # tolerance and hide real ~1% ring-path bugs. At float32 precision
+    # the tight bound holds on every platform.
+    with jax.default_matmul_precision("float32"):
+        out = ring_self_attention(q, k, v, mesh, axis="seq", causal=True)
+        # spot-check a block against the dense oracle (dense on 4k is
+        # fine on host; on a real long context it would not be)
+        ref = attention(q[:, :block_check], k[:, :block_check],
+                        v[:, :block_check], causal=True)
     np.testing.assert_allclose(np.asarray(out[:, :block_check]),
                                np.asarray(ref), rtol=2e-4, atol=2e-5)
     print(f"ring attention: T={T} sharded over {n_dev} devices, "
