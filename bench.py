@@ -638,11 +638,16 @@ def _run_ladder():
     degens = 0
     plans = _attempt_plans()
     for i, (overrides, label) in enumerate(plans):
-        if hangs >= 2 and not overrides.get("BENCH_FORCE_CPU") and \
+        if (hangs >= 2 or degens >= 2) and \
+                not overrides.get("BENCH_FORCE_CPU") and \
                 i < len(plans) - 1:
-            # two full-timeout hangs mean the tunnel is dead, not flaky —
+            # two full-timeout hangs mean the tunnel is dead (not
+            # flaky), and two degenerate timings mean its latency noise
+            # deterministically swamps this model's steps — either way,
             # don't burn the remaining TPU rungs, go straight to CPU
-            errors.append(f"{label}: skipped (tunnel hung twice)")
+            # (which has no tunnel and so no fetch-latency noise)
+            errors.append(f"{label}: skipped "
+                          f"({'tunnel hung' if hangs >= 2 else 'timing degenerate'} twice)")
             continue
         env = dict(os.environ, BENCH_CHILD="1", **overrides)
         try:
@@ -689,10 +694,9 @@ def _run_ladder():
             # measurement noise, not backend flakiness: one immediate
             # retry is worth it (noise varies run to run) but backoffs
             # and batch-halving cannot help — shorter steps only make
-            # the dominance condition harder
+            # the dominance condition harder. After two, the skip
+            # condition above routes straight to the CPU rung.
             degens += 1
-            if degens >= 2:
-                break
             continue
         if i < len(backoffs):
             time.sleep(backoffs[i])
