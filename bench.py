@@ -15,7 +15,8 @@ training throughput of ~2500 img/s/chip (MLPerf-era mixed precision), so
 vs_baseline = value / (0.7 * 2500) — i.e. vs_baseline >= 1.0 meets the
 target on a per-chip basis.
 
-Env knobs: BENCH_MODEL=resnet50|vgg16|lstm|sentiment|inception|lenet
+Env knobs: BENCH_MODEL=resnet50|vgg16|lstm|sentiment|inception|lenet|transformer
+(BENCH_SEQ_LEN sets the transformer rung's sequence length, default 2048),
 (comma-separate several to sweep the BASELINE configs, one JSON line
 each), BENCH_BATCH, BENCH_STEPS, BENCH_DTYPE, BENCH_ATTEMPT_TIMEOUT (s),
 BENCH_NO_FALLBACK=1, BENCH_S2D=1 (space-to-depth ResNet stem, own
@@ -432,6 +433,52 @@ def _bench_inception(batch: int, steps: int, dtype: str):
     return _timed_ips(run, batch, steps) + (flops,)
 
 
+def _bench_transformer(batch: int, steps: int, dtype: str):
+    """GPT-style causal transformer LM train step at long T — the
+    long-context rung (charter extension; no reference counterpart). On
+    TPU the attention core is the Pallas flash kernel, forward AND
+    blockwise FlashAttention-2-style backward (`ops/attention.py`), so
+    the [T, T] score matrix never materializes. Rate is tokens/sec
+    (= sequences/sec * T). MFU caveat: HLO cost_analysis cannot see
+    inside pallas_call, so the attention share of FLOPs is missing from
+    the mfu field (same caveat as the fused-conv rungs, PERF_NOTES)."""
+    import dataclasses as _dc
+
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.zoo.transformer import TextGenerationTransformer
+
+    T = int(os.environ.get("BENCH_SEQ_LEN", "2048"))
+    conf = _dc.replace(
+        TextGenerationTransformer(input_shape=(T, 1), d_model=512,
+                                  num_heads=8, num_blocks=6).conf(),
+        dtype=dtype)
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.integers(0, 256, (batch, T, 1)), jnp.float32)
+    y = jnp.asarray(np.eye(256, dtype=np.float32)[
+        rng.integers(0, 256, (batch, T))])
+    state = [net.params_tree, net.updater_state, net.state_tree]
+    key = jax.random.PRNGKey(0)
+    step_fn, flops = _compile(
+        net.make_step_fn(), (0, 1, 2),
+        state[0], state[1], state[2], jnp.asarray(0, jnp.int32),
+        x, y, None, None, key, None)
+
+    def run(n):
+        loss = None
+        for i in range(n):
+            state[0], state[1], state[2], loss = step_fn(
+                state[0], state[1], state[2], jnp.asarray(i, jnp.int32),
+                x, y, None, None, key, None)[:4]
+        return loss
+
+    # tokens/sec: hand _timed_ips the token count per step as the rate unit
+    return _timed_ips(run, batch * T, steps) + (flops,)
+
+
 def _metric_name(model: str) -> str:
     """Metric key for a model, shared by the child AND the ladder's
     degraded/failure paths so every record of one experiment carries one
@@ -451,7 +498,8 @@ def _metric_name(model: str) -> str:
 
 # per-model batch ceilings (memory/compile-time bounds), shared by the
 # child and the fallback-ladder planner so degrade rungs actually degrade
-_BATCH_CAPS = {"lstm": 64, "vgg16": 128, "sentiment": 32, "inception": 32}
+_BATCH_CAPS = {"lstm": 64, "vgg16": 128, "sentiment": 32, "inception": 32,
+               "transformer": 8}
 _FIXED_DTYPE = {"lstm": "float32", "sentiment": "float32",
                 "inception": "float32"}
 
@@ -470,6 +518,8 @@ _BENCHES = {
                   "images/sec", 1000.0),    # nominal (config #4)
     "lenet": (_bench_lenet, "lenet_mnist_train_images_per_sec",
               "images/sec", 10000.0),   # no published reference; nominal
+    "transformer": (_bench_transformer, "transformer_train_tokens_per_sec",
+                    "tokens/sec", 100000.0),  # nominal (charter extension)
 }
 
 

@@ -67,13 +67,18 @@ class MultiHeadAttention(Layer):
             return (x @ w).reshape(B, T, H, Dh)
 
         q, k, v = split(params["Wq"]), split(params["Wk"]), split(params["Wv"])
-        if mask is not None:
-            # Padding mask: large negative bias on masked keys before softmax
-            # (combined with the causal band when both apply).
-            o = self._masked_attention(q, k, v, mask, self.causal)
-        elif (not train and jax.default_backend() == "tpu" and T % 128 == 0):
-            # Fused blockwise kernel (ops/attention.py), inference only: its
-            # backward is a dense recompute, so training keeps the XLA path.
+        drop = (self.attn_dropout
+                if train and self.attn_dropout and rng is not None else 0.0)
+        if mask is not None or drop:
+            # Padding mask and/or attention-weight dropout need the dense
+            # path (dropout perturbs the post-softmax weights, which never
+            # materialize inside the flash kernel).
+            o = self._masked_attention(q, k, v, mask, self.causal,
+                                       dropout=drop, rng=rng)
+        elif jax.default_backend() == "tpu" and T % 128 == 0:
+            # Fused blockwise kernel (ops/attention.py) for inference AND
+            # training: the backward is the blockwise Pallas rematerializing
+            # pass, so the [T, T] score matrix never materializes either way.
             from deeplearning4j_tpu.ops.attention import flash_attention
 
             o = flash_attention(q, k, v, self.causal)
@@ -84,15 +89,24 @@ class MultiHeadAttention(Layer):
         return self._act(y), state
 
     @staticmethod
-    def _masked_attention(q, k, v, mask, causal=False):
+    def _masked_attention(q, k, v, mask, causal=False, dropout=0.0,
+                          rng=None):
         d = q.shape[-1]
         s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(d)
-        bias = jnp.where(mask[:, None, None, :] > 0, 0.0, -1e30)
+        bias = jnp.zeros((), s.dtype)
+        if mask is not None:
+            bias = jnp.where(mask[:, None, None, :] > 0, 0.0, -1e30)
         if causal:
             t = s.shape[-1]
             band = jnp.tril(jnp.ones((t, t), jnp.bool_))
             bias = bias + jnp.where(band[None, None], 0.0, -1e30)
         p = jax.nn.softmax(s + bias, axis=-1)
+        if dropout:
+            # Inverted dropout on the attention weights (the standard
+            # attention-dropout placement, post-softmax pre-V).
+            keep = 1.0 - dropout
+            keep_mask = jax.random.bernoulli(rng, keep, p.shape)
+            p = jnp.where(keep_mask, p / keep, 0.0)
         return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 

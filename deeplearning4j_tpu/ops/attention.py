@@ -11,9 +11,17 @@ online-softmax statistics (running max m, normalizer l, accumulator) carried
 in VMEM scratch across the innermost K grid dimension, so neither the
 [T, T] score matrix nor the full K/V sequence ever sits in VMEM/HBM at
 once. Causal masking skips dead K blocks' FLOPs via block-index
-comparison. The backward pass recomputes
-attention with XLA (rematerialization — the standard flash trade: O(T)
-memory for extra FLOPs) via `jax.custom_vjp`.
+comparison.
+
+Backward (FlashAttention-2 style, `backward="pallas"`, the default): the
+forward rule additionally saves the per-row log-sum-exp L = m + log(l)
+(O(T) residual memory — q/k/v/o/L, never the [T, T] scores). Two Pallas
+kernels then rematerialize score tiles blockwise: a dK/dV kernel with the
+K/V tile pinned in VMEM scratch while sweeping Q blocks, and a dQ kernel
+with the Q tile pinned while sweeping K blocks, using the softmax-vjp
+identity ds = p * (dp - Δ) with Δ = rowsum(do · o) precomputed by XLA.
+`backward="dense"` keeps the previous whole-[T, T] XLA recompute as a
+fallback/oracle path.
 """
 
 from __future__ import annotations
@@ -27,6 +35,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 _NEG_INF = -1e30
+_LSE_LANES = 128   # lane width for per-row statistics outputs (TPU tiling)
 
 
 def _dense_attention(q, k, v, causal: bool, scale: float):
@@ -40,12 +49,23 @@ def _dense_attention(q, k, v, causal: bool, scale: float):
     return jnp.einsum("bqk,bkd->bqd", w, v)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_scr, m_scr, l_scr, *,
-                  causal: bool, scale: float):
+def _prec(dtype):
+    return (jax.lax.Precision.HIGHEST if dtype == jnp.float32
+            else jax.lax.Precision.DEFAULT)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, causal: bool,
+                  scale: float, with_lse: bool):
     """Grid = (batch·heads, q blocks, K blocks): the K/V HBM→VMEM transfer
     is blocked by the grid itself (one [Bk, D] tile resident at a time),
     with the online-softmax state carried in VMEM scratch across the
-    innermost (K) grid dimension."""
+    innermost (K) grid dimension. With `with_lse` the per-row
+    log-sum-exp L = m + log(l) is emitted too (the training-path residual
+    the Pallas backward rematerializes scores from)."""
+    if with_lse:
+        lse_ref, acc_scr, m_scr, l_scr = rest
+    else:
+        acc_scr, m_scr, l_scr = rest
     qb = pl.program_id(1)
     kb = pl.program_id(2)
     nk = pl.num_programs(2)
@@ -67,8 +87,7 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_scr, m_scr, l_scr, *,
     def _():
         k = k_ref[0]                              # [Bk, D]
         v = v_ref[0]
-        prec = (jax.lax.Precision.HIGHEST if q.dtype == jnp.float32
-                else jax.lax.Precision.DEFAULT)
+        prec = _prec(q.dtype)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
                     precision=prec) * scale
         if causal:
@@ -89,20 +108,44 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, acc_scr, m_scr, l_scr, *,
 
     @pl.when(kb == nk - 1)
     def _():
-        o_ref[0] = (acc_scr[:] / jnp.maximum(l_scr[:], 1e-30)
-                    ).astype(o_ref.dtype)
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+        if with_lse:
+            # Per-row scalar broadcast across a 128-lane last dim — the
+            # narrowest output layout Mosaic accepts for row statistics
+            # (cf. MIN_BLOCK_SIZE in jax's in-tree TPU flash kernel).
+            lse_ref[0] = jnp.broadcast_to(m_scr[:] + jnp.log(l),
+                                          (bq, _LSE_LANES))
+
+
+def _fit_block(block: int, t: int) -> int:
+    """Largest block <= requested that divides t (t must be a multiple of
+    the 128-lane minimum; measured on v5e, bigger blocks win decisively —
+    512^2 tiles run ~4x faster than 128^2, see tools/kernel_bench.py)."""
+    block = min(block, t)
+    while block > 128 and t % block:
+        block -= 128
+    if t % block:
+        raise ValueError(f"seq len {t} not divisible by any block <= "
+                         f"{block} (need a multiple of 128)")
+    return block
 
 
 def _run_flash(q, k, v, *, causal: bool, scale: float, block_q: int,
-               block_k: int, interpret: bool):
+               block_k: int, interpret: bool, with_lse: bool = False):
     bh, t, d = q.shape
-    block_q = min(block_q, t)
-    block_k = min(block_k, t)
-    if t % block_q or t % block_k:
-        raise ValueError(f"seq len {t} not divisible by blocks "
-                         f"({block_q}, {block_k})")
-    kernel = functools.partial(_flash_kernel, causal=causal, scale=scale)
-    return pl.pallas_call(
+    block_q = _fit_block(block_q, t)
+    block_k = _fit_block(block_k, t)
+    kernel = functools.partial(_flash_kernel, causal=causal, scale=scale,
+                               with_lse=with_lse)
+    out_specs = [pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))]
+    out_shape = [jax.ShapeDtypeStruct((bh, t, d), q.dtype)]
+    if with_lse:
+        out_specs.append(pl.BlockSpec((1, block_q, _LSE_LANES),
+                                      lambda b, i, j: (b, i, 0)))
+        out_shape.append(
+            jax.ShapeDtypeStruct((bh, t, _LSE_LANES), jnp.float32))
+    out = pl.pallas_call(
         kernel,
         grid=(bh, t // block_q, t // block_k),
         in_specs=[
@@ -110,68 +153,286 @@ def _run_flash(q, k, v, *, causal: bool, scale: float, block_q: int,
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        out_specs=out_specs if with_lse else out_specs[0],
+        out_shape=tuple(out_shape) if with_lse else out_shape[0],
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
+        # batch/Q-block dims have no cross-step state -> Mosaic may
+        # parallelize and pipeline them; the K sweep carries scratch.
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v)
+    if with_lse:
+        o, lse = out
+        # Keep only one lane of the lane-broadcast row stats: residual
+        # memory between forward and backward is O(T), not O(128*T).
+        return o, lse[..., 0]
+    return out, None
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def flash_attention(q, k, v, causal: bool = False,
-                    scale: Optional[float] = None, block_q: int = 128,
-                    block_k: int = 128, interpret: bool = False):
-    """Fused attention. q/k/v: [B, T, H, D] or [BH, T, D]; returns same
-    layout.
-
-    Forward saves only q/k/v (O(T) residual memory). The backward, however,
-    is currently a DENSE recompute via XLA — it materializes the [T, T]
-    scores again — so for training at long T prefer the plain XLA path (the
-    MultiHeadAttention layer auto-uses this kernel for inference only); a
-    blockwise Pallas backward is future work."""
-    s = scale if scale is not None else q.shape[-1] ** -0.5
-    mh = q.ndim == 4
-    if mh:
-        b, t, h, d = q.shape
-        fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-        q3, k3, v3 = fold(q), fold(k), fold(v)
-    else:
-        q3, k3, v3 = q, k, v
-    o = _run_flash(q3, k3, v3, causal=causal, scale=s, block_q=block_q,
-                   block_k=block_k, interpret=interpret)
-    if mh:
-        o = o.reshape(b, h, t, d).transpose(0, 2, 1, 3)
-    return o
+# ----------------------------------------------------- blockwise backward
+def _bwd_tile(q, k, v, do, lse_col, delta_col, qb, kb, bq, block_k, causal,
+              scale):
+    """Shared score-tile rematerialization for both backward kernels:
+    p = exp(s - L) row-wise, ds = p * (do·vᵀ - Δ) * scale."""
+    prec = _prec(q.dtype)
+    s = jnp.dot(q, k.T, preferred_element_type=jnp.float32,
+                precision=prec) * scale
+    if causal:
+        q_ids = (qb * bq
+                 + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 0))
+        k_ids = (kb * block_k
+                 + jax.lax.broadcasted_iota(jnp.int32, (bq, block_k), 1))
+        s = jnp.where(q_ids >= k_ids, s, _NEG_INF)
+    p = jnp.exp(s - lse_col)                       # [Bq, Bk] f32
+    dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32,
+                 precision=prec)
+    ds = p * (dp - delta_col) * scale
+    return p, ds
 
 
-def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
-    return (flash_attention(q, k, v, causal, scale, block_q, block_k,
-                            interpret),
-            (q, k, v))
+def _flash_bwd_dkdv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                           dk_ref, dv_ref, dk_scr, dv_scr, *, causal: bool,
+                           scale: float):
+    """Grid = (batch·heads, K blocks, Q blocks): the K/V tile's gradient
+    accumulates in VMEM scratch across the innermost Q sweep."""
+    kb = pl.program_id(1)
+    qb = pl.program_id(2)
+    nq = pl.num_programs(2)
+    q = q_ref[0]
+    bq = q.shape[0]
+    block_k = k_ref.shape[1]
+
+    @pl.when(qb == 0)
+    def _():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    # Causal: Q blocks entirely above this K block's first row are dead.
+    relevant = ((qb + 1) * bq - 1 >= kb * block_k) if causal else (qb >= 0)
+
+    @pl.when(relevant)
+    def _():
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        prec = _prec(q.dtype)
+        p, ds = _bwd_tile(q, k, v, do, lse_ref[0, :, 0:1],
+                          delta_ref[0, :, 0:1], qb, kb, bq, block_k,
+                          causal, scale)
+        dv_scr[:] += jnp.dot(p.astype(do.dtype).T, do,
+                             preferred_element_type=jnp.float32, precision=prec)
+        dk_scr[:] += jnp.dot(ds.astype(q.dtype).T, q,
+                             preferred_element_type=jnp.float32, precision=prec)
+
+    @pl.when(qb == nq - 1)
+    def _():
+        dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _flash_bwd(causal, scale, block_q, block_k, interpret, res, do):
-    q, k, v = res
-    s = scale if scale is not None else q.shape[-1] ** -0.5
-    mh = q.ndim == 4
-    if mh:
-        b, t, h, d = q.shape
-        fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
-        unfold = lambda x: x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
-        q3, k3, v3, do3 = fold(q), fold(k), fold(v), fold(do)
-    else:
-        q3, k3, v3, do3 = q, k, v, do
-    _, vjp = jax.vjp(
-        lambda qq, kk, vv: _dense_attention(qq, kk, vv, causal, s),
-        q3, k3, v3)
-    dq, dk, dv = vjp(do3)
-    if mh:
-        dq, dk, dv = unfold(dq), unfold(dk), unfold(dv)
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dq_scr, *, causal: bool, scale: float):
+    """Grid = (batch·heads, Q blocks, K blocks): the Q tile's gradient
+    accumulates in VMEM scratch across the innermost K sweep."""
+    qb = pl.program_id(1)
+    kb = pl.program_id(2)
+    nk = pl.num_programs(2)
+    q = q_ref[0]
+    bq = q.shape[0]
+    block_k = k_ref.shape[1]
+
+    @pl.when(kb == 0)
+    def _():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    relevant = (kb * block_k <= (qb + 1) * bq - 1) if causal else (kb >= 0)
+
+    @pl.when(relevant)
+    def _():
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
+        prec = _prec(q.dtype)
+        _, ds = _bwd_tile(q, k, v, do, lse_ref[0, :, 0:1],
+                          delta_ref[0, :, 0:1], qb, kb, bq, block_k,
+                          causal, scale)
+        dq_scr[:] += jnp.dot(ds.astype(k.dtype), k,
+                             preferred_element_type=jnp.float32, precision=prec)
+
+    @pl.when(kb == nk - 1)
+    def _():
+        dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _run_flash_bwd(q, k, v, o, lse, do, *, causal: bool, scale: float,
+                   block_q: int, block_k: int, interpret: bool,
+                   dlse=None):
+    """Blockwise dq/dk/dv from O(T) residuals (q, k, v, o, L).
+
+    `lse` is the narrow [BH, T] log-sum-exp saved by the forward; both
+    row stats are re-broadcast here to the lane-wide layout the kernels
+    read. `dlse` (optional, [BH, T]) is the cotangent of the emitted
+    log-sum-exp when the caller exposes it as an output (ring attention's
+    merge does): since dL/ds_ij = p_ij, it folds into the softmax-vjp
+    identity as a shift on Δ — ds = p * (dp - (Δ - dL)).
+    """
+    bh, t, d = q.shape
+    block_q = _fit_block(block_q, t)
+    block_k = _fit_block(block_k, t)
+    lse = jnp.broadcast_to(lse[..., None], (bh, t, _LSE_LANES))
+    # Δ = rowsum(do · o): one cheap fused elementwise+reduce in XLA.
+    delta2 = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                     axis=-1, keepdims=True)
+    if dlse is not None:
+        delta2 = delta2 - dlse.astype(jnp.float32)[..., None]
+    delta = jnp.broadcast_to(delta2, (bh, t, _LSE_LANES))
+    q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
+    row_spec = pl.BlockSpec((1, block_q, _LSE_LANES),
+                            lambda b, i, j: (b, i, 0))
+    kv_spec = pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0))
+    # dK/dV: K/V tile pinned (grid dim 1), Q swept (innermost dim 2)
+    q_spec_t = pl.BlockSpec((1, block_q, d), lambda b, j, i: (b, i, 0))
+    row_spec_t = pl.BlockSpec((1, block_q, _LSE_LANES),
+                              lambda b, j, i: (b, i, 0))
+    kv_spec_t = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkdv_kernel, causal=causal, scale=scale),
+        grid=(bh, t // block_k, t // block_q),
+        in_specs=[q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t,
+                  row_spec_t],
+        out_specs=[kv_spec_t, kv_spec_t],
+        out_shape=[jax.ShapeDtypeStruct((bh, t, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, t, d), v.dtype)],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, causal=causal, scale=scale),
+        grid=(bh, t // block_q, t // block_k),
+        in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
     return dq, dk, dv
 
 
+def _fold3(x):
+    """[B, T, H, D] → [BH, T, D] (identity for 3-D inputs)."""
+    if x.ndim == 3:
+        return x, None
+    b, t, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, d), (b, t, h, d)
+
+
+def _unfold3(x, shape):
+    if shape is None:
+        return x
+    b, t, h, d = shape
+    return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def flash_attention(q, k, v, causal: bool = False,
+                    scale: Optional[float] = None, block_q: int = 512,
+                    block_k: int = 512, interpret: bool = False,
+                    backward: str = "pallas"):
+    """Fused attention. q/k/v: [B, T, H, D] or [BH, T, D]; returns same
+    layout.
+
+    Residual memory is O(T) either way: the forward rule saves q/k/v/o and
+    the per-row log-sum-exp. `backward` selects how dq/dk/dv are produced:
+    "pallas" (default) rematerializes score tiles blockwise in two Pallas
+    kernels — the [T, T] matrix never exists; "dense" is the whole-matrix
+    XLA recompute kept as the oracle/fallback path."""
+    s = scale if scale is not None else q.shape[-1] ** -0.5
+    q3, shape = _fold3(q)
+    k3, _ = _fold3(k)
+    v3, _ = _fold3(v)
+    o, _ = _run_flash(q3, k3, v3, causal=causal, scale=s, block_q=block_q,
+                      block_k=block_k, interpret=interpret)
+    return _unfold3(o, shape)
+
+
+def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
+               backward):
+    s = scale if scale is not None else q.shape[-1] ** -0.5
+    q3, shape = _fold3(q)
+    k3, _ = _fold3(k)
+    v3, _ = _fold3(v)
+    o3, lse = _run_flash(q3, k3, v3, causal=causal, scale=s,
+                         block_q=block_q, block_k=block_k,
+                         interpret=interpret,
+                         with_lse=(backward == "pallas"))
+    return _unfold3(o3, shape), (q3, k3, v3, o3, lse, shape)
+
+
+def _flash_bwd(causal, scale, block_q, block_k, interpret, backward, res,
+               do):
+    q3, k3, v3, o3, lse, shape = res
+    s = scale if scale is not None else q3.shape[-1] ** -0.5
+    do3, _ = _fold3(do)
+    if backward == "pallas":
+        dq, dk, dv = _run_flash_bwd(q3, k3, v3, o3, lse, do3, causal=causal,
+                                    scale=s, block_q=block_q,
+                                    block_k=block_k, interpret=interpret)
+    else:
+        _, vjp = jax.vjp(
+            lambda qq, kk, vv: _dense_attention(qq, kk, vv, causal, s),
+            q3, k3, v3)
+        dq, dk, dv = vjp(do3)
+    return (_unfold3(dq, shape), _unfold3(dk, shape), _unfold3(dv, shape))
+
+
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention_with_lse(q, k, v, causal: bool = False,
+                             scale: Optional[float] = None,
+                             block_q: int = 512, block_k: int = 512,
+                             interpret: bool = False):
+    """Fused attention over 3-D [BH, T, D] inputs returning
+    (o [BH, T, D], lse [BH, T]) — the building block for attention
+    protocols that merge partial results across K/V shards (ring
+    attention): two shards' outputs combine exactly via
+    lse' = logaddexp(lse_a, lse_b), o' = o_a·e^{lse_a−lse'} +
+    o_b·e^{lse_b−lse'}. Differentiable in both outputs (the lse
+    cotangent rides the Pallas backward's Δ term)."""
+    s = scale if scale is not None else q.shape[-1] ** -0.5
+    o, lse = _run_flash(q, k, v, causal=causal, scale=s, block_q=block_q,
+                        block_k=block_k, interpret=interpret, with_lse=True)
+    return o, lse
+
+
+def _flash_lse_fwd(q, k, v, causal, scale, block_q, block_k, interpret):
+    s = scale if scale is not None else q.shape[-1] ** -0.5
+    o, lse = _run_flash(q, k, v, causal=causal, scale=s, block_q=block_q,
+                        block_k=block_k, interpret=interpret, with_lse=True)
+    return (o, lse), (q, k, v, o, lse)
+
+
+def _flash_lse_bwd(causal, scale, block_q, block_k, interpret, res, cts):
+    do, dlse = cts
+    q, k, v, o, lse = res
+    s = scale if scale is not None else q.shape[-1] ** -0.5
+    dq, dk, dv = _run_flash_bwd(q, k, v, o, lse, do, causal=causal,
+                                scale=s, block_q=block_q, block_k=block_k,
+                                interpret=interpret, dlse=dlse)
+    return dq, dk, dv
+
+
+flash_attention_with_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)

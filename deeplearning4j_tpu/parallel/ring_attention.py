@@ -67,19 +67,83 @@ def attention(q, k, v, *, causal: bool = False, scale: Optional[float] = None):
     return jnp.einsum("bhqk,bkhd->bqhd", p, v)
 
 
+def _flash_shard(q, k, v, causal: bool, scale: float, interpret: bool):
+    """One K/V shard through the Pallas kernel; [B,T,H,D] in/out with
+    per-row lse [B,H,Tq] for cross-shard merging."""
+    from deeplearning4j_tpu.ops.attention import (_fold3, _unfold3,
+                                                  flash_attention_with_lse)
+
+    B, T, H, _ = q.shape
+    q3, shape = _fold3(q)
+    k3, _ = _fold3(k)
+    v3, _ = _fold3(v)
+    o, lse = flash_attention_with_lse(q3, k3, v3, causal, scale, 512, 512,
+                                      interpret)
+    return _unfold3(o, shape), lse.reshape(B, H, T)
+
+
 def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
-                          scale: Optional[float]):
+                          scale: Optional[float], use_flash: bool = False,
+                          interpret: bool = False):
     """Per-shard body (runs under shard_map). q/k/v: local blocks
-    [B, T_local, H, D]."""
+    [B, T_local, H, D].
+
+    Two per-shard compute paths: the XLA online-softmax accumulation
+    (any backend/shape), or the Pallas flash kernel (`use_flash`) where
+    each held shard is one of exactly three causal cases — fully visible
+    (src < my: plain kernel), diagonal (src == my: the kernel's aligned
+    causal mask), or fully masked (src > my: skipped, zero FLOPs) — and
+    partial outputs merge via logaddexp of the emitted lse."""
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
     B, Tq, H, D = q.shape
     scale_ = scale if scale is not None else 1.0 / (D ** 0.5)
+    perm = [(j, (j + 1) % n) for j in range(n)]
+
+    if use_flash:
+        o0 = jnp.zeros((B, Tq, H, D), jnp.float32)
+        lse0 = jnp.full((B, H, Tq), -jnp.inf, jnp.float32)
+
+        def body(i, carry):
+            k_blk, v_blk, o, lse = carry
+            src = (my - i) % n
+            k_nxt = lax.ppermute(k_blk, axis_name, perm)
+            v_nxt = lax.ppermute(v_blk, axis_name, perm)
+            if causal:
+                def diag(args):
+                    return _flash_shard(*args, True, scale_, interpret)
+
+                def full(args):
+                    return _flash_shard(*args, False, scale_, interpret)
+
+                def dead(args):
+                    return (jnp.zeros((B, Tq, H, D), q.dtype),
+                            jnp.full((B, H, Tq), -jnp.inf, jnp.float32))
+
+                o_i, lse_i = lax.cond(
+                    src == my, diag,
+                    lambda args: lax.cond(src < my, full, dead, args),
+                    (q, k_blk, v_blk))
+            else:
+                o_i, lse_i = _flash_shard(q, k_blk, v_blk, False, scale_,
+                                          interpret)
+            lse_new = jnp.logaddexp(lse, lse_i)
+            # exp(-inf - -inf) guard: a row with no visible keys yet
+            w_old = jnp.where(jnp.isneginf(lse_new), 0.0,
+                              jnp.exp(lse - lse_new))
+            w_new = jnp.where(jnp.isneginf(lse_new), 0.0,
+                              jnp.exp(lse_i - lse_new))
+            o = (o * w_old.transpose(0, 2, 1)[..., None]
+                 + o_i.astype(jnp.float32)
+                 * w_new.transpose(0, 2, 1)[..., None])
+            return (k_nxt, v_nxt, o, lse_new)
+
+        _, _, o, _ = lax.fori_loop(0, n, body, (k, v, o0, lse0))
+        return o.astype(q.dtype)
 
     m0 = jnp.full((B, H, Tq), -jnp.inf, q.dtype)
     l0 = jnp.zeros((B, H, Tq), q.dtype)
     o0 = jnp.zeros_like(q)
-    perm = [(j, (j + 1) % n) for j in range(n)]
 
     def body(i, carry):
         k_blk, v_blk, m, l, o = carry
@@ -100,9 +164,17 @@ def _ring_attention_local(q, k, v, *, axis_name: str, causal: bool,
 
 def ring_self_attention(q, k, v, mesh: Mesh, *, axis: str = AXIS_SEQ,
                         causal: bool = False,
-                        scale: Optional[float] = None):
+                        scale: Optional[float] = None,
+                        use_flash: Optional[bool] = None,
+                        interpret: bool = False):
     """Sequence-parallel attention: q/k/v [B, T, H, D] with T sharded over
-    `axis`. Returns output with the same sharding."""
+    `axis`. Returns output with the same sharding.
+
+    use_flash: route each shard's block math through the Pallas flash
+    kernel (ops/attention.py) instead of the XLA online-softmax sweep.
+    Default (None) = auto: on when running on TPU and the local sequence
+    block is 128-lane tileable. `interpret=True` runs the kernel in
+    interpret mode so the flash path is testable on a CPU mesh."""
     try:
         from jax import shard_map
         kw = {"check_vma": False}
@@ -110,10 +182,16 @@ def ring_self_attention(q, k, v, mesh: Mesh, *, axis: str = AXIS_SEQ,
         from jax.experimental.shard_map import shard_map
         kw = {"check_rep": False}
 
+    if use_flash is None:
+        t_local = q.shape[1] // mesh.shape[axis]
+        use_flash = (jax.default_backend() == "tpu"
+                     and t_local % 128 == 0 and k.shape[1] == q.shape[1])
+
     spec = P(None, axis, None, None)
     fn = shard_map(
         functools.partial(_ring_attention_local, axis_name=axis,
-                          causal=causal, scale=scale),
+                          causal=causal, scale=scale, use_flash=use_flash,
+                          interpret=interpret),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,

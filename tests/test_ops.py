@@ -225,6 +225,110 @@ class TestFlashAttention:
                                        rtol=1e-3, atol=1e-5)
 
 
+class TestFlashPallasBackward:
+    """The blockwise (FlashAttention-2 style) backward: dq/dk/dv from O(T)
+    residuals via score-tile rematerialization — vs dense autodiff."""
+
+    @staticmethod
+    def _rand(shape, seed):
+        rng = np.random.default_rng(seed)
+        return jnp.asarray(rng.standard_normal(shape), jnp.float32)
+
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("backward", ["pallas", "dense"])
+    def test_grads_match_dense_autodiff(self, causal, backward):
+        bh, t, d = 2, 48, 16   # 6x6 blocks of 8: multi-block both axes
+        q, k, v = (self._rand((bh, t, d), s) for s in (0, 1, 2))
+        do = self._rand((bh, t, d), 3)
+        with jax.default_matmul_precision("highest"):
+            def loss(fn):
+                return lambda q, k, v: jnp.vdot(fn(q, k, v), do)
+
+            flash = loss(lambda q, k, v: flash_attention(
+                q, k, v, causal, None, 8, 8, True, backward))
+            ref = loss(lambda q, k, v: _dense_attention(
+                q, k, v, causal, d ** -0.5))
+            gf = jax.grad(flash, argnums=(0, 1, 2))(q, k, v)
+            gr = jax.grad(ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-3, atol=1e-4)
+
+    def test_forward_emits_correct_lse(self):
+        from deeplearning4j_tpu.ops.attention import _run_flash
+        bh, t, d = 2, 32, 8
+        q, k, v = (self._rand((bh, t, d), s) for s in (4, 5, 6))
+        with jax.default_matmul_precision("highest"):
+            _, lse = _run_flash(q, k, v, causal=False, scale=d ** -0.5,
+                                block_q=8, block_k=8, interpret=True,
+                                with_lse=True)
+            scores = jnp.einsum("bqd,bkd->bqk", q, k) * d ** -0.5
+            lse_ref = jax.scipy.special.logsumexp(scores, axis=-1)
+        # the kernel emits lane-broadcast stats; _run_flash returns the
+        # narrow [bh, t] view (O(T) residual memory, not O(128*T))
+        assert lse.shape == (bh, t)
+        np.testing.assert_allclose(np.asarray(lse), np.asarray(lse_ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_multihead_grads(self):
+        b, t, h, d = 2, 32, 2, 8
+        q, k, v = (self._rand((b, t, h, d), s) for s in (7, 8, 9))
+        do = self._rand((b, t, h, d), 10)
+        with jax.default_matmul_precision("highest"):
+            def flash(q, k, v):
+                return jnp.vdot(
+                    flash_attention(q, k, v, True, None, 8, 8, True), do)
+
+            def ref(q, k, v):
+                fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+                o = _dense_attention(fold(q), fold(k), fold(v), True,
+                                     d ** -0.5)
+                return jnp.vdot(
+                    o.reshape(b, h, t, d).transpose(0, 2, 1, 3), do)
+
+            gf = jax.grad(flash, argnums=(0, 1, 2))(q, k, v)
+            gr = jax.grad(ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b2 in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b2),
+                                       rtol=1e-3, atol=1e-4)
+
+    def test_bf16_grads_close(self):
+        bh, t, d = 2, 32, 8
+        q, k, v = (self._rand((bh, t, d), s).astype(jnp.bfloat16)
+                   for s in (11, 12, 13))
+
+        def loss(q, k, v):
+            o = flash_attention(q, k, v, True, None, 8, 8, True)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+
+        def ref(q, k, v):
+            o = _dense_attention(q.astype(jnp.float32),
+                                 k.astype(jnp.float32),
+                                 v.astype(jnp.float32), True, d ** -0.5)
+            return jnp.sum(o ** 2)
+
+        gf = jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+        with jax.default_matmul_precision("highest"):
+            gr = jax.grad(ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(
+                np.asarray(a, np.float32), np.asarray(b), rtol=0.1,
+                atol=0.15)
+
+    def test_residuals_are_linear_in_t(self):
+        """The saved residuals must be O(T): q/k/v/o/lse only — no [T, T]."""
+        bh, t, d = 1, 64, 8
+        q, k, v = (self._rand((bh, t, d), s) for s in (14, 15, 16))
+        _, vjp = jax.vjp(
+            lambda q, k, v: flash_attention(q, k, v, True, None, 8, 8, True),
+            q, k, v)
+        leaves = jax.tree_util.tree_leaves(vjp)
+        total = sum(x.size for x in leaves if hasattr(x, "size"))
+        # 4 [bh,t,d] tensors + lane-broadcast lse [bh,t,128] — all O(t);
+        # a dense residual would add t*t per head, quadratic in t.
+        assert total <= bh * t * (6 * d + 130), total
+
+
 class TestFusedConvBN:
     """ops/conv_fused.py — the Pallas conv-epilogue fusion (PERF_NOTES
     sink #2; reference seam: `ConvolutionLayer.java:67-77` +

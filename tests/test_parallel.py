@@ -156,6 +156,45 @@ class TestRingAttention:
             np.testing.assert_allclose(
                 np.asarray(gr), np.asarray(gd), rtol=5e-4, atol=5e-5)
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_flash_path_matches_dense(self, devices8, causal):
+        """Pallas-kernel per-shard path (use_flash) — same answer as the
+        XLA online-softmax path and the dense oracle."""
+        mesh = make_mesh({"seq": 8})
+        rng = np.random.default_rng(2)
+        B, T, H, D = 2, 32, 4, 16
+        q = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+        dense = attention(q, k, v, causal=causal)
+        ring = ring_self_attention(q, k, v, mesh, axis="seq", causal=causal,
+                                   use_flash=True, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(dense), np.asarray(ring), rtol=2e-4, atol=2e-5)
+
+    def test_flash_path_gradients(self, devices8):
+        """dq/dk/dv through ppermute + lse merge + Pallas backward."""
+        mesh = make_mesh({"seq": 8})
+        rng = np.random.default_rng(3)
+        B, T, H, D = 1, 16, 2, 8
+        q = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((B, T, H, D)), jnp.float32)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(ring_self_attention(
+                q, k, v, mesh, causal=True, use_flash=True,
+                interpret=True) ** 2)
+
+        def loss_dense(q, k, v):
+            return jnp.sum(attention(q, k, v, causal=True) ** 2)
+
+        g_ring = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        g_dense = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for gr, gd in zip(g_ring, g_dense):
+            np.testing.assert_allclose(
+                np.asarray(gr), np.asarray(gd), rtol=5e-4, atol=5e-5)
+
 
 class TestAttentionLayer:
     def test_mha_in_network(self):
@@ -173,6 +212,34 @@ class TestAttentionLayer:
         y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
         net.fit(x, y, epochs=3, batch_size=8)
         assert np.asarray(net.output(x)).shape == (16, 2)
+
+    def test_attn_dropout_perturbs_training_only(self):
+        """attn_dropout must actually drop attention weights in training
+        (it was once accepted-but-ignored config) and leave inference
+        deterministic."""
+        import jax as _jax
+        import jax.numpy as _jnp
+        layer = MultiHeadAttention(num_heads=2, n_in=8, n_out=8,
+                                   attn_dropout=0.5)
+        layer = layer.infer_n_in(InputType.recurrent(8))
+        params, _ = layer.init_params(_jax.random.PRNGKey(0),
+                                      InputType.recurrent(8))
+        x = _jnp.asarray(np.random.default_rng(0).standard_normal(
+            (2, 6, 8)), _jnp.float32)
+        eval_out, _ = layer.apply(params, x, train=False)
+        train1, _ = layer.apply(params, x, train=True,
+                                rng=_jax.random.PRNGKey(1))
+        train2, _ = layer.apply(params, x, train=True,
+                                rng=_jax.random.PRNGKey(2))
+        assert not np.allclose(np.asarray(train1), np.asarray(eval_out))
+        assert not np.allclose(np.asarray(train1), np.asarray(train2))
+        # rate 0 (or no rng): deterministic and equal to eval
+        nodrop = MultiHeadAttention(num_heads=2, n_in=8, n_out=8)
+        same, _ = nodrop.apply(params, x, train=True,
+                               rng=_jax.random.PRNGKey(1))
+        np.testing.assert_allclose(np.asarray(same),
+                                   np.asarray(eval_out), rtol=1e-5,
+                                   atol=1e-6)
 
     def test_mha_gradcheck(self):
         from deeplearning4j_tpu.nn.layers import GlobalPoolingLayer
