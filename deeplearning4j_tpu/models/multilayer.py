@@ -540,15 +540,26 @@ class MultiLayerNetwork:
     # ----------------------------------------------------- rnn stepping
     def rnn_time_step(self, x):
         """Stateful single-step inference; carries persist across calls.
-        Reference: `rnnTimeStep` + `rnnClearPreviousState`."""
+        Reference: `rnnTimeStep` + `rnnClearPreviousState`. Attention
+        stacks step the same way: layers exposing `decode_carry` (KV
+        cache, position offset) are seeded on the first call, so a
+        transformer generates token-by-token without re-running the
+        prefix."""
         x = jnp.asarray(x, self.dtype)
         if x.ndim == 2:
             x = x[:, None, :]
+        if not self._rnn_carries:
+            for l in self.layers:
+                if hasattr(l, "decode_carry"):
+                    self._rnn_carries[l.name] = l.decode_carry(
+                        x.shape[0], self.dtype)
         out, _, new_states, _ = self._forward(
             self.params_tree, self.state_tree, x, train=False, rng=None,
             carries=self._rnn_carries or None)
+        stateful = set(self._rnn_layer_names) | {
+            l.name for l in self.layers if hasattr(l, "decode_carry")}
         self._rnn_carries = {
-            n: new_states[n] for n in self._rnn_layer_names
+            n: new_states[n] for n in stateful
         }
         return out
 

@@ -31,6 +31,7 @@ class MultiHeadAttention(Layer):
     num_heads: int = 4
     causal: bool = False
     attn_dropout: float = 0.0
+    max_cache: int = 1024             # KV-cache length for decode stepping
 
     def infer_n_in(self, input_type: InputType):
         upd = {}
@@ -58,7 +59,61 @@ class MultiHeadAttention(Layer):
             "b": jnp.zeros((d,), dtype),
         }, {}
 
+    def decode_carry(self, batch: int, dtype=jnp.float32):
+        """Preallocated KV cache for incremental decoding (the transformer
+        analogue of the reference's rnnTimeStep statefulness,
+        `MultiLayerNetwork.java:rnnTimeStep`): fixed [B, max_cache, H, Dh]
+        buffers + a write position, so every step reuses one compiled
+        program instead of growing shapes."""
+        H = self.num_heads
+        Dh = self.n_out // H
+        L = self.max_cache
+        return {
+            "cache_k": jnp.zeros((batch, L, H, Dh), dtype),
+            "cache_v": jnp.zeros((batch, L, H, Dh), dtype),
+            "pos": jnp.zeros((), jnp.int32),
+        }
+
+    def _decode(self, params, x, state):
+        """One decode step: append this block's K/V at `pos`, attend the
+        incoming queries over the visible cache prefix."""
+        B, T, _ = x.shape
+        H = self.num_heads
+        Dh = self.n_out // H
+        L = state["cache_k"].shape[1]
+        if T > L:
+            raise ValueError(f"decode step of {T} tokens > max_cache {L}")
+        pos = state["pos"]
+        if not isinstance(pos, jax.core.Tracer) and int(pos) + T > L:
+            raise ValueError(
+                f"KV cache overflow: pos {int(pos)} + step {T} > "
+                f"max_cache {L}; raise max_cache or clear state")
+
+        def split(w):
+            return (x @ w).reshape(B, T, H, Dh)
+
+        q, k, v = split(params["Wq"]), split(params["Wk"]), split(params["Wv"])
+        z = jnp.zeros((), pos.dtype)   # index dtypes must match `pos`
+        ck = jax.lax.dynamic_update_slice(
+            state["cache_k"], k.astype(state["cache_k"].dtype),
+            (z, pos, z, z))
+        cv = jax.lax.dynamic_update_slice(
+            state["cache_v"], v.astype(state["cache_v"].dtype),
+            (z, pos, z, z))
+        s = jnp.einsum("bqhd,bkhd->bhqk", q, ck) / jnp.sqrt(Dh)
+        k_ids = jnp.arange(L)[None, :]
+        q_ids = pos + jnp.arange(T)[:, None]
+        # causal: each new query sees cache + itself; non-causal: the
+        # whole written prefix (still never the unwritten tail)
+        vis = k_ids <= q_ids if self.causal else k_ids < pos + T
+        s = jnp.where(vis[None, None], s, -1e30)
+        o = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1), cv)
+        y = o.reshape(B, T, self.n_out) @ params["Wo"] + params["b"]
+        return self._act(y), {"cache_k": ck, "cache_v": cv, "pos": pos + T}
+
     def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
+        if state is not None and "cache_k" in state:
+            return self._decode(params, x, state)
         B, T, _ = x.shape
         H = self.num_heads
         Dh = self.n_out // H
@@ -75,10 +130,13 @@ class MultiHeadAttention(Layer):
             # materialize inside the flash kernel).
             o = self._masked_attention(q, k, v, mask, self.causal,
                                        dropout=drop, rng=rng)
-        elif jax.default_backend() == "tpu" and T % 128 == 0:
+        elif jax.default_backend() == "tpu" and T % 128 == 0 and T >= 512:
             # Fused blockwise kernel (ops/attention.py) for inference AND
             # training: the backward is the blockwise Pallas rematerializing
-            # pass, so the [T, T] score matrix never materializes either way.
+            # pass, so the [T, T] score matrix never materializes either
+            # way. T >= 512 because the kernel's measured win needs
+            # 512-wide tiles (tools/kernel_bench.py: at <=256-wide tiles
+            # XLA dense is 2-5x faster); short sequences keep XLA.
             from deeplearning4j_tpu.ops.attention import flash_attention
 
             o = flash_attention(q, k, v, self.causal)
@@ -131,12 +189,27 @@ class PositionEmbeddingLayer(Layer):
         return {"P": 0.02 * jax.random.normal(
             key, (self.max_length, d), dtype)}, {}
 
+    def decode_carry(self, batch: int, dtype=jnp.float32):
+        return {"pos": jnp.zeros((), jnp.int32)}
+
     def apply(self, params, x, *, state=None, train=False, rng=None,
               mask=None):
         t = x.shape[1]
         if t > self.max_length:
             raise ValueError(f"sequence length {t} > max_length "
                              f"{self.max_length}")
+        if state is not None and "pos" in state:
+            # decode stepping: positions continue from the carry offset
+            pos = state["pos"]
+            if (not isinstance(pos, jax.core.Tracer)
+                    and int(pos) + t > self.max_length):
+                raise ValueError(
+                    f"decode position {int(pos)} + {t} > max_length "
+                    f"{self.max_length}")
+            p = jax.lax.dynamic_slice(
+                params["P"], (pos, jnp.zeros((), pos.dtype)),
+                (t, params["P"].shape[1]))
+            return x + p[None], {"pos": pos + t}
         return x + params["P"][None, :t, :], state
 
 
@@ -160,6 +233,7 @@ class TransformerEncoderBlock(Layer):
     causal: bool = True
     n_experts: int = 0            # 0 = dense FFN; >0 = MoE
     moe_k: int = 2
+    max_cache: int = 1024         # KV-cache length for decode stepping
 
     def infer_n_in(self, input_type: InputType):
         if self.n_in is None:
@@ -173,7 +247,8 @@ class TransformerEncoderBlock(Layer):
         d = self.n_in
         attn = MultiHeadAttention(
             n_in=d, n_out=d, num_heads=self.num_heads, causal=self.causal,
-            activation="identity", weight_init=self.weight_init)
+            activation="identity", weight_init=self.weight_init,
+            max_cache=self.max_cache)
         if self.n_experts > 0:
             from deeplearning4j_tpu.parallel.moe import MoEFeedForward
 
@@ -215,15 +290,23 @@ class TransformerEncoderBlock(Layer):
         var = jnp.var(x, axis=-1, keepdims=True)
         return (x - mu) * jax.lax.rsqrt(var + 1e-5) * g + b
 
+    def decode_carry(self, batch: int, dtype=jnp.float32):
+        attn, _ = self._sub()
+        return {"attn": attn.decode_carry(batch, dtype)}
+
     def apply(self, params, x, *, state=None, train=False, rng=None,
               mask=None):
         attn, moe = self._sub()
         ap = {k[5:]: v for k, v in params.items() if k.startswith("attn_")}
         h = self._ln(x, params["ln1_g"], params["ln1_b"])
-        a, _ = attn.apply(ap, h, state=None, train=train, rng=rng, mask=mask)
+        attn_carry = state.get("attn") if state else None
+        a, a_st = attn.apply(ap, h, state=attn_carry, train=train, rng=rng,
+                             mask=mask)
         x = x + a
         h = self._ln(x, params["ln2_g"], params["ln2_b"])
         new_state = {}
+        if attn_carry is not None:
+            new_state["attn"] = a_st
         if moe is not None:
             mp = {k[4:]: v for k, v in params.items() if k.startswith("moe_")}
             b_, t_, d_ = h.shape

@@ -1,0 +1,73 @@
+"""Autoregressive text generation via stateful stepping.
+
+Reference parity: the DL4J text-generation flow samples one token at a
+time through `rnnTimeStep` (`zoo/model/TextGenerationLSTM.java` trains
+the model; the sampling loop lives in the GravesLSTM character-modelling
+example pattern built on `MultiLayerNetwork.rnnTimeStep`). This helper
+drives the same contract on this framework's networks and works for
+both statefulness mechanisms: LSTM h/c carries and transformer KV
+caches (`decode_carry` seeding in `MultiLayerNetwork.rnn_time_step`) —
+so a prompt is consumed once and each new token costs one step, not a
+full-prefix re-run.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def _input_encoding(net) -> str:
+    """'ids' for embedding-fronted stacks ([B, T, 1] token ids), 'onehot'
+    for vocab-width inputs ([B, T, V])."""
+    from deeplearning4j_tpu.nn.layers.feedforward import (
+        EmbeddingSequenceLayer,
+    )
+
+    return ("ids" if isinstance(net.layers[0], EmbeddingSequenceLayer)
+            else "onehot")
+
+
+def _encode(ids: np.ndarray, encoding: str, vocab: int) -> np.ndarray:
+    """ids: [B, T] -> model input [B, T, 1] or one-hot [B, T, V]."""
+    if encoding == "ids":
+        return ids[..., None].astype(np.float32)
+    return np.eye(vocab, dtype=np.float32)[ids]
+
+
+def generate(net, prompt_ids, n_tokens: int, *, temperature: float = 1.0,
+             greedy: bool = False,
+             rng: Optional[np.random.Generator] = None) -> np.ndarray:
+    """Sample `n_tokens` continuations of `prompt_ids` ([B, Tp] ints).
+
+    The network's output layer must produce per-timestep class
+    probabilities (softmax). `temperature` rescales them (p^(1/τ),
+    renormalized); `greedy` takes the argmax instead of sampling.
+    Returns the sampled ids, [B, n_tokens]."""
+    prompt_ids = np.asarray(prompt_ids)
+    if prompt_ids.ndim == 1:
+        prompt_ids = prompt_ids[None, :]
+    B = prompt_ids.shape[0]
+    vocab = net.layers[-1].n_out
+    encoding = _input_encoding(net)
+    if rng is None:
+        rng = np.random.default_rng(0)
+
+    net.rnn_clear_previous_state()
+    out = np.asarray(net.rnn_time_step(_encode(prompt_ids, encoding, vocab)))
+    generated = np.empty((B, n_tokens), dtype=np.int64)
+    for i in range(n_tokens):
+        p = out[:, -1, :].astype(np.float64)
+        if greedy:
+            tok = p.argmax(axis=-1)
+        else:
+            if temperature != 1.0:
+                p = np.power(np.maximum(p, 1e-30), 1.0 / temperature)
+            p = p / p.sum(axis=-1, keepdims=True)
+            tok = np.array([rng.choice(vocab, p=p[b]) for b in range(B)])
+        generated[:, i] = tok
+        if i + 1 < n_tokens:
+            out = np.asarray(net.rnn_time_step(
+                _encode(tok[:, None], encoding, vocab)))
+    return generated

@@ -27,6 +27,15 @@ def test_lenet_mnist():
     assert 0.0 <= acc <= 1.0
 
 
+def test_transformer_text_generation(capsys):
+    mod = _run("transformer_text_generation.py")
+    loss, text = mod["main"](epochs=6, T=32, n_gen=16)
+    # untrained uniform is ~log(28) ≈ 3.33; 6 epochs reach ~0.47, so 1.5
+    # separates "learned the corpus" from "learned nothing"
+    assert loss < 1.5
+    assert len(text) == 16
+
+
 def test_word2vec_similarity(capsys):
     mod = _run("word2vec_similarity.py")
     mod["main"]()

@@ -34,6 +34,86 @@ def test_transformer_forward_shapes():
     np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-4)
 
 
+def test_transformer_incremental_decode_matches_full_forward():
+    """KV-cache stepping (rnn_time_step on an attention stack) must
+    reproduce the full teacher-forced forward column-for-column — the
+    transformer analogue of the reference's rnnTimeStep contract."""
+    from deeplearning4j_tpu.zoo.transformer import TextGenerationTransformer
+
+    T = 12
+    net = TextGenerationTransformer(num_classes=17, input_shape=(T, 1),
+                                    d_model=16, num_heads=2,
+                                    num_blocks=2).init()
+    rng = np.random.default_rng(5)
+    x = rng.integers(0, 17, (2, T, 1)).astype(np.float32)
+    full = np.asarray(net.output(x))              # [2, T, 17]
+
+    # prefix of 5 in one call, then the rest token-by-token
+    net.rnn_clear_previous_state()
+    outs = [np.asarray(net.rnn_time_step(x[:, :5, :]))]
+    for t in range(5, T):
+        outs.append(np.asarray(net.rnn_time_step(x[:, t:t + 1, :])))
+    stepped = np.concatenate(outs, axis=1)
+    np.testing.assert_allclose(stepped, full, rtol=1e-4, atol=1e-5)
+
+    # clearing state restarts decoding from position 0
+    net.rnn_clear_previous_state()
+    again = np.asarray(net.rnn_time_step(x[:, :5, :]))
+    np.testing.assert_allclose(again, outs[0], rtol=1e-6, atol=1e-7)
+
+
+def test_generate_matches_full_forward_rollout():
+    """Greedy generation through the KV cache must equal the naive
+    rollout that re-runs the growing sequence through output() each
+    step — the decode cache must not change what gets generated."""
+    from deeplearning4j_tpu.utils.textgen import generate
+    from deeplearning4j_tpu.zoo.transformer import TextGenerationTransformer
+
+    V, T = 13, 16
+    net = TextGenerationTransformer(num_classes=V, input_shape=(T, 1),
+                                    d_model=16, num_heads=2,
+                                    num_blocks=2).init()
+    rng = np.random.default_rng(7)
+    prompt = rng.integers(0, V, (2, 4))
+    got = generate(net, prompt, 6, greedy=True)
+
+    # oracle: full forward over the growing sequence (zero-padded to the
+    # configured T — causal masking makes the tail inert), argmax at the
+    # last real column each step
+    seq = prompt.copy()
+    want = []
+    for _ in range(6):
+        cur = seq.shape[1]
+        padded = np.zeros((2, T), seq.dtype)
+        padded[:, :cur] = seq
+        probs = np.asarray(net.output(padded[..., None].astype(np.float32)))
+        tok = probs[:, cur - 1, :].argmax(-1)
+        want.append(tok)
+        seq = np.concatenate([seq, tok[:, None]], axis=1)
+    np.testing.assert_array_equal(got, np.stack(want, axis=1))
+
+
+def test_generate_lstm_smoke():
+    """The same helper drives LSTM carries (one-hot input encoding)."""
+    from deeplearning4j_tpu.utils.textgen import generate
+    from deeplearning4j_tpu.zoo.models import TextGenerationLSTM
+
+    net = TextGenerationLSTM(num_classes=11, input_shape=(8, 11)).init()
+    prompt = np.array([[1, 2, 3]])
+    out1 = generate(net, prompt, 5, greedy=True)
+    out2 = generate(net, prompt, 5, greedy=True)
+    assert out1.shape == (1, 5)
+    assert ((0 <= out1) & (out1 < 11)).all()
+    np.testing.assert_array_equal(out1, out2)  # stateless across calls
+    # temperature sampling stays in-range and is reproducible per rng
+    s1 = generate(net, prompt, 5, temperature=0.8,
+                  rng=np.random.default_rng(3))
+    s2 = generate(net, prompt, 5, temperature=0.8,
+                  rng=np.random.default_rng(3))
+    np.testing.assert_array_equal(s1, s2)
+    assert ((0 <= s1) & (s1 < 11)).all()
+
+
 def _img_batch(shape, n=2, seed=0):
     return np.random.default_rng(seed).standard_normal(
         (n, *shape)).astype(np.float32)
