@@ -388,18 +388,36 @@ class ComputationGraph:
     # ----------------------------------------------------- rnn stepping
     def rnn_time_step(self, *xs):
         """Stateful single-step inference; RNN vertex carries persist across
-        calls. Reference: `ComputationGraph.rnnTimeStep`."""
+        calls. Reference: `ComputationGraph.rnnTimeStep`. Attention
+        vertices step the same way via their decode carries (KV cache),
+        mirroring `MultiLayerNetwork.rnn_time_step`."""
         inputs = {}
         for n, x in zip(self.conf.network_inputs, xs):
             x = jnp.asarray(x, self.dtype)
             if x.ndim == 2:
                 x = x[:, None, :]
             inputs[n] = x
+        decode_names = [
+            n for n, v in self.conf.vertices.items()
+            if isinstance(v, LayerVertex)
+            and hasattr(v.layer, "decode_carry")
+        ]
+        if not self._rnn_carries and decode_names:
+            batch = next(iter(inputs.values())).shape[0]
+            for n in decode_names:
+                layer = self.conf.vertices[n].layer
+                if not getattr(layer, "causal", True):
+                    raise ValueError(
+                        f"rnn_time_step requires causal attention; vertex "
+                        f"{n!r} is non-causal (stepped decoding cannot "
+                        f"reproduce a bidirectional forward)")
+                self._rnn_carries[n] = layer.decode_carry(batch, self.dtype)
         values, _, new_states = self._forward(
             self.params_tree, self.state_tree, inputs, train=False, rng=None,
             carries=self._rnn_carries or None)
         self._rnn_carries = {
-            n: new_states[n] for n in self._rnn_vertex_names
+            n: new_states[n]
+            for n in set(self._rnn_vertex_names) | set(decode_names)
         }
         outs = [values[o] for o in self.conf.network_outputs]
         return outs[0] if len(outs) == 1 else outs

@@ -551,6 +551,12 @@ class MultiLayerNetwork:
         if not self._rnn_carries:
             for l in self.layers:
                 if hasattr(l, "decode_carry"):
+                    if not getattr(l, "causal", True):
+                        raise ValueError(
+                            f"rnn_time_step requires causal attention; "
+                            f"layer {l.name!r} is non-causal (stepped "
+                            f"decoding cannot see future tokens, so it "
+                            f"cannot reproduce a bidirectional forward)")
                     self._rnn_carries[l.name] = l.decode_carry(
                         x.shape[0], self.dtype)
         out, _, new_states, _ = self._forward(

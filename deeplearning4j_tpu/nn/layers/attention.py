@@ -93,6 +93,11 @@ class MultiHeadAttention(Layer):
             return (x @ w).reshape(B, T, H, Dh)
 
         q, k, v = split(params["Wq"]), split(params["Wk"]), split(params["Wv"])
+        # Tracer-safe overflow poison: under jit the eager check above
+        # cannot fire, and dynamic_update_slice would silently clamp the
+        # write into the last rows — poison the output with NaN instead
+        # so overflow is loud, not wrong.
+        q = jnp.where(pos + T <= L, q, jnp.nan)
         z = jnp.zeros((), pos.dtype)   # index dtypes must match `pos`
         ck = jax.lax.dynamic_update_slice(
             state["cache_k"], k.astype(state["cache_k"].dtype),
@@ -209,6 +214,8 @@ class PositionEmbeddingLayer(Layer):
             p = jax.lax.dynamic_slice(
                 params["P"], (pos, jnp.zeros((), pos.dtype)),
                 (t, params["P"].shape[1]))
+            # tracer-safe overflow poison (see MultiHeadAttention._decode)
+            p = jnp.where(pos + t <= self.max_length, p, jnp.nan)
             return x + p[None], {"pos": pos + t}
         return x + params["P"][None, :t, :], state
 

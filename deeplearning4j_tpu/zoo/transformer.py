@@ -48,7 +48,10 @@ class TextGenerationTransformer(ZooModel):
         blocks = [
             TransformerEncoderBlock(
                 num_heads=self.num_heads, causal=True,
-                n_experts=self.n_experts)
+                n_experts=self.n_experts,
+                # positions cap decode length at t, so a bigger KV cache
+                # would be unreachable memory/FLOPs per decode step
+                max_cache=t)
             for _ in range(self.num_blocks)
         ]
         return (NeuralNetConfiguration.builder()

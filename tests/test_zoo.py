@@ -93,6 +93,86 @@ def test_generate_matches_full_forward_rollout():
     np.testing.assert_array_equal(got, np.stack(want, axis=1))
 
 
+def test_cg_transformer_incremental_decode():
+    """The same decode-carry stepping works through ComputationGraph
+    vertices (reference: `ComputationGraph.rnnTimeStep`)."""
+    from deeplearning4j_tpu.models import ComputationGraph
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.inputs import InputType
+    from deeplearning4j_tpu.nn.layers.attention import (
+        PositionEmbeddingLayer, TransformerEncoderBlock,
+    )
+    from deeplearning4j_tpu.nn.layers.feedforward import (
+        EmbeddingSequenceLayer,
+    )
+    from deeplearning4j_tpu.nn.layers.recurrent import RnnOutputLayer
+    from deeplearning4j_tpu.optim.updaters import Adam
+
+    V, T = 11, 10
+    conf = (NeuralNetConfiguration.builder()
+            .seed(0).updater(Adam(1e-3)).activation("identity")
+            .graph_builder()
+            .add_inputs("in")
+            .add_layer("emb", EmbeddingSequenceLayer(n_in=V, n_out=12), "in")
+            .add_layer("pos", PositionEmbeddingLayer(max_length=T), "emb")
+            .add_layer("blk", TransformerEncoderBlock(num_heads=2), "pos")
+            .add_layer("out", RnnOutputLayer(n_out=V, activation="softmax"),
+                       "blk")
+            .set_outputs("out")
+            .set_input_types(InputType.recurrent(1, T))
+            .build())
+    net = ComputationGraph(conf).init()
+    rng = np.random.default_rng(9)
+    x = rng.integers(0, V, (2, T, 1)).astype(np.float32)
+    full = np.asarray(net.output(x))
+
+    net.rnn_clear_previous_state()
+    outs = [np.asarray(net.rnn_time_step(x[:, :4, :]))]
+    for t in range(4, T):
+        outs.append(np.asarray(net.rnn_time_step(x[:, t:t + 1, :])))
+    stepped = np.concatenate(outs, axis=1)
+    np.testing.assert_allclose(stepped, full, rtol=1e-4, atol=1e-5)
+
+
+def test_rnn_time_step_rejects_non_causal_attention():
+    """Stepped decoding cannot reproduce a bidirectional forward, so
+    seeding must refuse non-causal attention instead of silently
+    diverging from output()."""
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.inputs import InputType
+    from deeplearning4j_tpu.nn.layers.attention import MultiHeadAttention
+    from deeplearning4j_tpu.nn.layers.recurrent import RnnOutputLayer
+    from deeplearning4j_tpu.optim.updaters import Sgd
+
+    net = MultiLayerNetwork(
+        (NeuralNetConfiguration.builder()
+         .seed(0).updater(Sgd(0.1)).activation("identity")
+         .list(MultiHeadAttention(num_heads=2, causal=False),
+               RnnOutputLayer(n_out=3, activation="softmax"))
+         .set_input_type(InputType.recurrent(4, 6))
+         .build())).init()
+    with pytest.raises(ValueError, match="causal"):
+        net.rnn_time_step(np.zeros((1, 2, 4), np.float32))
+
+
+def test_decode_overflow_raises_eagerly():
+    """Stepping past max_cache must fail loudly, not clamp silently."""
+    from deeplearning4j_tpu.nn.layers.attention import MultiHeadAttention
+    from deeplearning4j_tpu.nn.inputs import InputType
+    import jax as _jax
+
+    layer = MultiHeadAttention(num_heads=2, n_in=8, n_out=8, causal=True,
+                               max_cache=4)
+    params, _ = layer.init_params(_jax.random.PRNGKey(0),
+                                  InputType.recurrent(8))
+    carry = layer.decode_carry(1)
+    x = np.zeros((1, 3, 8), np.float32)
+    _, carry = layer.apply(params, x, state=carry)
+    with pytest.raises(ValueError, match="overflow"):
+        layer.apply(params, x, state=carry)   # 3 + 3 > 4
+
+
 def test_generate_lstm_smoke():
     """The same helper drives LSTM carries (one-hot input encoding)."""
     from deeplearning4j_tpu.utils.textgen import generate
