@@ -57,8 +57,35 @@ class ComputationGraph:
         self._rnn_carries: Dict[str, Any] = {}  # rnnTimeStep statefulness
         self._stateful: set = set()
         self._vertex_updaters: Dict[str, Updater] = {}
-        self._jit_cache: Dict[Any, Any] = {}
-        self._solver = None                     # full-batch solver cache
+        self._jit_caches: Dict[Any, Dict[Any, Any]] = {}
+        self._solvers: Dict[Any, Any] = {}      # full-batch solver cache
+
+    @property
+    def _jit_cache(self) -> Dict[Any, Any]:
+        """Compiled-fn cache, partitioned by the active sequence-parallel
+        context (see MultiLayerNetwork._jit_cache)."""
+        from deeplearning4j_tpu.parallel.ring_attention import (
+            current_sequence_mesh,
+        )
+
+        return self._jit_caches.setdefault(current_sequence_mesh(), {})
+
+    @property
+    def _solver(self):
+        """Partitioned like _jit_cache (see MultiLayerNetwork._solver)."""
+        from deeplearning4j_tpu.parallel.ring_attention import (
+            current_sequence_mesh,
+        )
+
+        return self._solvers.get(current_sequence_mesh())
+
+    @_solver.setter
+    def _solver(self, value):
+        from deeplearning4j_tpu.parallel.ring_attention import (
+            current_sequence_mesh,
+        )
+
+        self._solvers[current_sequence_mesh()] = value
 
     # ------------------------------------------------------------- init
     def init(self) -> "ComputationGraph":
@@ -110,6 +137,17 @@ class ComputationGraph:
                 if isinstance(v, LayerVertex) and _is_recurrent(v.layer)
             ]
         return self._rnn_names_cache
+
+    @property
+    def _decode_vertex_names(self) -> List[str]:
+        """Vertices with KV-cache decode carries (attention stepping)."""
+        if not hasattr(self, "_decode_names_cache"):
+            self._decode_names_cache = [
+                n for n, v in self.conf.vertices.items()
+                if isinstance(v, LayerVertex)
+                and hasattr(v.layer, "decode_carry")
+            ]
+        return self._decode_names_cache
 
     def _forward(self, params, states, inputs: Dict[str, Any], *, train, rng,
                  fmasks: Optional[Dict[str, Any]] = None,
@@ -397,21 +435,21 @@ class ComputationGraph:
             if x.ndim == 2:
                 x = x[:, None, :]
             inputs[n] = x
-        decode_names = [
-            n for n, v in self.conf.vertices.items()
-            if isinstance(v, LayerVertex)
-            and hasattr(v.layer, "decode_carry")
-        ]
+        decode_names = self._decode_vertex_names
         if not self._rnn_carries and decode_names:
             batch = next(iter(inputs.values())).shape[0]
+            # validate ALL before seeding ANY: a mid-loop raise would
+            # leave partial carries behind and disarm this guard forever
             for n in decode_names:
-                layer = self.conf.vertices[n].layer
-                if not getattr(layer, "causal", True):
+                if not getattr(self.conf.vertices[n].layer, "causal", True):
                     raise ValueError(
                         f"rnn_time_step requires causal attention; vertex "
                         f"{n!r} is non-causal (stepped decoding cannot "
                         f"reproduce a bidirectional forward)")
-                self._rnn_carries[n] = layer.decode_carry(batch, self.dtype)
+            for n in decode_names:
+                self._rnn_carries[n] = (
+                    self.conf.vertices[n].layer.decode_carry(
+                        batch, self.dtype))
         values, _, new_states = self._forward(
             self.params_tree, self.state_tree, inputs, train=False, rng=None,
             carries=self._rnn_carries or None)

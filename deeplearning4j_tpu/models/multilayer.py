@@ -111,9 +111,39 @@ class MultiLayerNetwork:
         self._rng = jax.random.PRNGKey(conf.seed)
         self._stateful: set = set()           # layers with persistent state (BN)
         self._layer_updaters: Dict[str, Updater] = {}
-        self._jit_cache: Dict[Any, Any] = {}
+        self._jit_caches: Dict[Any, Dict[Any, Any]] = {}
         self._rnn_carries: Dict[str, Any] = {}  # rnnTimeStep statefulness
-        self._solver = None                     # full-batch solver cache
+        self._solvers: Dict[Any, Any] = {}      # full-batch solver cache
+
+    @property
+    def _jit_cache(self) -> Dict[Any, Any]:
+        """Compiled-fn cache, partitioned by the active sequence-parallel
+        context: a trace made inside `sequence_parallel(mesh)` closes
+        over the ring-attention swap, so it must never be reused outside
+        that context (nor a dense trace inside it)."""
+        from deeplearning4j_tpu.parallel.ring_attention import (
+            current_sequence_mesh,
+        )
+
+        return self._jit_caches.setdefault(current_sequence_mesh(), {})
+
+    @property
+    def _solver(self):
+        """Full-batch solver cache, partitioned like _jit_cache (the
+        solver holds its own compiled traces of the forward)."""
+        from deeplearning4j_tpu.parallel.ring_attention import (
+            current_sequence_mesh,
+        )
+
+        return self._solvers.get(current_sequence_mesh())
+
+    @_solver.setter
+    def _solver(self, value):
+        from deeplearning4j_tpu.parallel.ring_attention import (
+            current_sequence_mesh,
+        )
+
+        self._solvers[current_sequence_mesh()] = value
 
     # ------------------------------------------------------------- init
     def init(self) -> "MultiLayerNetwork":
@@ -243,6 +273,14 @@ class MultiLayerNetwork:
             self._rnn_names_cache = [
                 l.name for l in self.layers if _is_recurrent(l)]
         return self._rnn_names_cache
+
+    @property
+    def _decode_layer_names(self):
+        """Layers with KV-cache decode carries (attention stepping)."""
+        if not hasattr(self, "_decode_names_cache"):
+            self._decode_names_cache = [
+                l.name for l in self.layers if hasattr(l, "decode_carry")]
+        return self._decode_names_cache
 
     def _build_step(self, key, jit: bool):
         has_fmask, has_lmask, tbptt = key[0], key[1], key[2]
@@ -548,24 +586,27 @@ class MultiLayerNetwork:
         x = jnp.asarray(x, self.dtype)
         if x.ndim == 2:
             x = x[:, None, :]
-        if not self._rnn_carries:
-            for l in self.layers:
-                if hasattr(l, "decode_carry"):
-                    if not getattr(l, "causal", True):
-                        raise ValueError(
-                            f"rnn_time_step requires causal attention; "
-                            f"layer {l.name!r} is non-causal (stepped "
-                            f"decoding cannot see future tokens, so it "
-                            f"cannot reproduce a bidirectional forward)")
-                    self._rnn_carries[l.name] = l.decode_carry(
-                        x.shape[0], self.dtype)
+        if not self._rnn_carries and self._decode_layer_names:
+            decode = [l for l in self.layers
+                      if l.name in set(self._decode_layer_names)]
+            # validate ALL before seeding ANY: a mid-loop raise would
+            # leave partial carries behind and disarm this guard forever
+            for l in decode:
+                if not getattr(l, "causal", True):
+                    raise ValueError(
+                        f"rnn_time_step requires causal attention; "
+                        f"layer {l.name!r} is non-causal (stepped "
+                        f"decoding cannot see future tokens, so it "
+                        f"cannot reproduce a bidirectional forward)")
+            for l in decode:
+                self._rnn_carries[l.name] = l.decode_carry(
+                    x.shape[0], self.dtype)
         out, _, new_states, _ = self._forward(
             self.params_tree, self.state_tree, x, train=False, rng=None,
             carries=self._rnn_carries or None)
-        stateful = set(self._rnn_layer_names) | {
-            l.name for l in self.layers if hasattr(l, "decode_carry")}
         self._rnn_carries = {
-            n: new_states[n] for n in stateful
+            n: new_states[n]
+            for n in set(self._rnn_layer_names) | set(self._decode_layer_names)
         }
         return out
 

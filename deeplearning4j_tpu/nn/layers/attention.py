@@ -127,9 +127,37 @@ class MultiHeadAttention(Layer):
             return (x @ w).reshape(B, T, H, Dh)
 
         q, k, v = split(params["Wq"]), split(params["Wk"]), split(params["Wv"])
+        from deeplearning4j_tpu.parallel.ring_attention import (
+            current_sequence_mesh,
+        )
+
+        seq_ctx = current_sequence_mesh()
         drop = (self.attn_dropout
                 if train and self.attn_dropout and rng is not None else 0.0)
-        if mask is not None or drop:
+        if seq_ctx is not None and (drop or mask is not None):
+            # The user asked for sequence parallelism (usually because T
+            # is too long for dense attention) but attention-dropout or a
+            # padding mask forces the dense path — degrade loudly.
+            import warnings
+
+            why = "attn_dropout" if drop else "a padding mask"
+            warnings.warn(
+                f"sequence_parallel is active but {why} forces the dense "
+                f"[T, T] attention path; the ring is bypassed for this "
+                f"layer", stacklevel=2)
+            seq_ctx = None
+        if seq_ctx is not None:
+            # sequence_parallel(mesh) context: T is sharded over the seq
+            # axis; K/V ride the ring (parallel.ring_attention) so no
+            # device holds the [T, T] scores. Padding masks and
+            # attention-dropout keep the dense path.
+            from deeplearning4j_tpu.parallel.ring_attention import (
+                ring_self_attention,
+            )
+
+            o = ring_self_attention(q, k, v, seq_ctx.mesh,
+                                    axis=seq_ctx.axis, causal=self.causal)
+        elif mask is not None or drop:
             # Padding mask and/or attention-weight dropout need the dense
             # path (dropout perturbs the post-softmax weights, which never
             # materialize inside the flash kernel).

@@ -29,6 +29,7 @@ from deeplearning4j_tpu.parallel.distributed import (
     put_global, put_global_batch,
 )
 from deeplearning4j_tpu.parallel.mesh import AXIS_DATA, make_mesh
+from deeplearning4j_tpu.parallel.ring_attention import SeqCtxJitCache
 from deeplearning4j_tpu.parallel.sharding import ShardingRules
 
 
@@ -38,7 +39,7 @@ def _is_graph(net) -> bool:
     return isinstance(net, ComputationGraph)
 
 
-class ParallelWrapper:
+class ParallelWrapper(SeqCtxJitCache):
     """Data-parallel trainer over a mesh.
 
     Kwargs mirror the reference Builder (`ParallelWrapper.java:562-715`)
@@ -67,7 +68,6 @@ class ParallelWrapper:
         self.param_rules = param_rules
         self.prefetch = prefetch_buffer
         self._graph = _is_graph(net)
-        self._jit_cache: Dict[Any, Any] = {}
         self.last_batch_index = -1   # in-epoch position (elastic resume)
         self.stopped_early = False   # did the last fit() stop via stop_fn?
 

@@ -22,6 +22,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.parallel.mesh import AXIS_DATA, make_mesh
+from deeplearning4j_tpu.parallel.ring_attention import SeqCtxJitCache
 
 
 class InferenceMode:
@@ -31,7 +32,7 @@ class InferenceMode:
     BATCHED = "batched"
 
 
-class ParallelInference:
+class ParallelInference(SeqCtxJitCache):
     def __init__(self, net, *, mesh: Optional[Mesh] = None,
                  mode: str = InferenceMode.BATCHED,
                  max_batch_size: int = 64, max_wait_ms: float = 5.0,
@@ -43,7 +44,6 @@ class ParallelInference:
         self.max_wait = max_wait_ms / 1e3
         self.buckets = sorted(batch_buckets or [1, 8, 32, max_batch_size])
         self._queue: "queue.Queue" = queue.Queue()
-        self._jit_cache = {}
         self._stop = threading.Event()
         self._worker: Optional[threading.Thread] = None
         if mode == InferenceMode.BATCHED:

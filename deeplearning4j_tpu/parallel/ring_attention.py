@@ -17,6 +17,9 @@ asynchronously).
 
 from __future__ import annotations
 
+import contextlib
+import contextvars
+import dataclasses
 import functools
 from typing import Optional
 
@@ -26,6 +29,53 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.parallel.mesh import AXIS_SEQ
+
+
+# ---------------------------------------------------- layer integration
+@dataclasses.dataclass(frozen=True)
+class _SeqParallelCtx:
+    mesh: Mesh
+    axis: str
+
+
+_SEQ_CTX: contextvars.ContextVar[Optional[_SeqParallelCtx]] = \
+    contextvars.ContextVar("sequence_parallel_ctx", default=None)
+
+
+@contextlib.contextmanager
+def sequence_parallel(mesh: Mesh, axis: str = AXIS_SEQ):
+    """Route every MultiHeadAttention (and thus TransformerEncoderBlock)
+    applied inside this context through ring attention over `axis` —
+    sequence parallelism at the model level, no layer changes:
+
+        with sequence_parallel(make_mesh({"seq": 8})):
+            net.fit(x, y, ...)
+
+    The swap happens at TRACE time: wrap the calls that trace/compile
+    (fit/output); a step compiled inside the context stays
+    sequence-parallel when reused."""
+    token = _SEQ_CTX.set(_SeqParallelCtx(mesh, axis))
+    try:
+        yield
+    finally:
+        _SEQ_CTX.reset(token)
+
+
+def current_sequence_mesh() -> Optional[_SeqParallelCtx]:
+    return _SEQ_CTX.get()
+
+
+class SeqCtxJitCache:
+    """Mixin: a `_jit_cache` dict partitioned by the active
+    sequence-parallel context. Any object caching compiled traces of a
+    forward that consults `current_sequence_mesh()` at trace time must
+    never reuse a trace across context boundaries — a ring trace outside
+    the context (or a dense trace inside it) is silently wrong."""
+
+    @property
+    def _jit_cache(self):
+        caches = self.__dict__.setdefault("_seq_jit_caches", {})
+        return caches.setdefault(current_sequence_mesh(), {})
 
 
 def _block_accumulate(q, k, v, m, l, o, *, scale, q_off, k_off, causal):

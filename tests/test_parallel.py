@@ -196,6 +196,95 @@ class TestRingAttention:
                 np.asarray(gr), np.asarray(gd), rtol=5e-4, atol=5e-5)
 
 
+class TestSequenceParallelContext:
+    """sequence_parallel(mesh): model-level sequence parallelism — the
+    attention layers swap their core to ring attention at trace time."""
+
+    def test_layer_swaps_to_ring_and_matches(self, devices8):
+        import jax as _jax
+        from deeplearning4j_tpu.parallel.ring_attention import (
+            sequence_parallel,
+        )
+        mesh = make_mesh({"seq": 8})
+        layer = MultiHeadAttention(num_heads=2, n_in=8, n_out=8,
+                                   causal=True)
+        layer = layer.infer_n_in(InputType.recurrent(8))
+        params, _ = layer.init_params(_jax.random.PRNGKey(0),
+                                      InputType.recurrent(8))
+        x = jnp.asarray(np.random.default_rng(0).standard_normal(
+            (2, 16, 8)), jnp.float32)
+        base, _ = layer.apply(params, x)
+        with sequence_parallel(mesh):
+            sp, _ = layer.apply(params, x)
+        np.testing.assert_allclose(np.asarray(sp), np.asarray(base),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_net_jit_cache_partitioned_by_context(self, devices8):
+        """A dense-compiled output() must not be reused inside the
+        context (and vice versa) — the caches are per-context."""
+        from deeplearning4j_tpu.parallel.ring_attention import (
+            sequence_parallel,
+        )
+        from deeplearning4j_tpu.zoo.transformer import (
+            TextGenerationTransformer,
+        )
+        mesh = make_mesh({"seq": 8})
+        net = TextGenerationTransformer(num_classes=9, input_shape=(16, 1),
+                                        d_model=16, num_heads=2,
+                                        num_blocks=1).init()
+        x = np.random.default_rng(1).integers(
+            0, 9, (2, 16, 1)).astype(np.float32)
+        dense = np.asarray(net.output(x))
+        with sequence_parallel(mesh):
+            sp = np.asarray(net.output(x))
+        again = np.asarray(net.output(x))
+        np.testing.assert_allclose(sp, dense, rtol=2e-4, atol=2e-5)
+        np.testing.assert_allclose(again, dense, rtol=1e-6, atol=1e-7)
+        assert len(net._jit_caches) == 2   # one per context
+
+    def test_mask_bypasses_ring_with_warning(self, devices8):
+        """Inside sequence_parallel, a padding mask forces the dense
+        path — that degradation must be loud (warning), not silent."""
+        import warnings as _warnings
+
+        import jax as _jax
+        from deeplearning4j_tpu.parallel.ring_attention import (
+            sequence_parallel,
+        )
+        mesh = make_mesh({"seq": 8})
+        layer = MultiHeadAttention(num_heads=2, n_in=8, n_out=8,
+                                   causal=True)
+        layer = layer.infer_n_in(InputType.recurrent(8))
+        params, _ = layer.init_params(_jax.random.PRNGKey(0),
+                                      InputType.recurrent(8))
+        x = jnp.asarray(np.random.default_rng(0).standard_normal(
+            (2, 16, 8)), jnp.float32)
+        fmask = jnp.ones((2, 16), jnp.float32)
+        with sequence_parallel(mesh):
+            with _warnings.catch_warnings(record=True) as caught:
+                _warnings.simplefilter("always")
+                layer.apply(params, x, mask=fmask)
+        assert any("ring is bypassed" in str(w.message) for w in caught)
+
+    def test_fit_under_context(self, devices8):
+        from deeplearning4j_tpu.parallel.ring_attention import (
+            sequence_parallel,
+        )
+        from deeplearning4j_tpu.zoo.transformer import (
+            TextGenerationTransformer,
+        )
+        mesh = make_mesh({"seq": 8})
+        net = TextGenerationTransformer(num_classes=9, input_shape=(16, 1),
+                                        d_model=16, num_heads=2,
+                                        num_blocks=1).init()
+        rng = np.random.default_rng(2)
+        x = rng.integers(0, 9, (4, 16, 1)).astype(np.float32)
+        y = np.eye(9, dtype=np.float32)[rng.integers(0, 9, (4, 16))]
+        with sequence_parallel(mesh):
+            net.fit(x, y, epochs=2, batch_size=4)
+        assert net.score_ is not None and np.isfinite(net.score_)
+
+
 class TestAttentionLayer:
     def test_mha_in_network(self):
         from deeplearning4j_tpu.nn.layers import GlobalPoolingLayer

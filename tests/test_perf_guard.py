@@ -146,6 +146,38 @@ def test_no_recompile_across_steps(data):
     assert n == 1, f"{n} compilations of step_fn — recompiles:\n{logs}"
 
 
+def test_decode_steps_do_not_recompile():
+    """KV-cache stepping promises fixed shapes — after the first
+    one-token step compiles, every further token must reuse it (a
+    recompile per token is the classic silent 100x in generation)."""
+    from deeplearning4j_tpu.zoo.transformer import TextGenerationTransformer
+
+    net = TextGenerationTransformer(num_classes=9, input_shape=(16, 1),
+                                    d_model=16, num_heads=2,
+                                    num_blocks=1).init()
+    x = np.random.default_rng(0).integers(
+        0, 9, (1, 16, 1)).astype(np.float32)
+    net.rnn_clear_previous_state()
+    net.rnn_time_step(x[:, :4, :])       # prefix (its own shape, compiles)
+    net.rnn_time_step(x[:, 4:5, :])      # first 1-token step compiles
+    with jax.log_compiles(True):
+        import io
+        import logging
+
+        buf = io.StringIO()
+        handler = logging.StreamHandler(buf)
+        logging.getLogger("jax").addHandler(handler)
+        try:
+            for t in range(5, 12):
+                out = net.rnn_time_step(x[:, t:t + 1, :])
+            jax.block_until_ready(out)
+        finally:
+            logging.getLogger("jax").removeHandler(handler)
+        logs = buf.getvalue()
+    n = logs.count("Finished XLA compilation")
+    assert n == 0, f"{n} recompiles during steady-state decode:\n{logs}"
+
+
 def test_bench_regression_guard_keeps_best_record(tmp_path, monkeypatch):
     """bench.py's TPU record: a new measurement >5% below the carried
     record is flagged (metric__regressed) and the best value is kept, so

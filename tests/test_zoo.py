@@ -148,10 +148,15 @@ def test_rnn_time_step_rejects_non_causal_attention():
     net = MultiLayerNetwork(
         (NeuralNetConfiguration.builder()
          .seed(0).updater(Sgd(0.1)).activation("identity")
-         .list(MultiHeadAttention(num_heads=2, causal=False),
+         .list(MultiHeadAttention(num_heads=2, causal=True),
+               MultiHeadAttention(num_heads=2, causal=False),
                RnnOutputLayer(n_out=3, activation="softmax"))
          .set_input_type(InputType.recurrent(4, 6))
          .build())).init()
+    with pytest.raises(ValueError, match="causal"):
+        net.rnn_time_step(np.zeros((1, 2, 4), np.float32))
+    # the guard must not be disarmed by a partial seed from the first
+    # failure (validate-all-before-seed-any)
     with pytest.raises(ValueError, match="causal"):
         net.rnn_time_step(np.zeros((1, 2, 4), np.float32))
 
