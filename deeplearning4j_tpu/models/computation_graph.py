@@ -32,6 +32,9 @@ from deeplearning4j_tpu.models.multilayer import (
 )
 from deeplearning4j_tpu.optim.listeners import TrainingListener
 from deeplearning4j_tpu.optim.updaters import NoOp, Updater, resolve_updater
+from deeplearning4j_tpu.parallel.ring_attention import (
+    SeqCtxJitCache, SeqCtxSolverCache,
+)
 from deeplearning4j_tpu.utils.pytrees import (
     flatten_params, param_count, unflatten_params,
 )
@@ -39,7 +42,7 @@ from deeplearning4j_tpu.utils.pytrees import (
 _tmap = jax.tree_util.tree_map
 
 
-class ComputationGraph:
+class ComputationGraph(SeqCtxJitCache, SeqCtxSolverCache):
     """DAG network runtime over a ComputationGraphConfiguration."""
 
     def __init__(self, conf: ComputationGraphConfiguration):
@@ -59,33 +62,6 @@ class ComputationGraph:
         self._vertex_updaters: Dict[str, Updater] = {}
         self._jit_caches: Dict[Any, Dict[Any, Any]] = {}
         self._solvers: Dict[Any, Any] = {}      # full-batch solver cache
-
-    @property
-    def _jit_cache(self) -> Dict[Any, Any]:
-        """Compiled-fn cache, partitioned by the active sequence-parallel
-        context (see MultiLayerNetwork._jit_cache)."""
-        from deeplearning4j_tpu.parallel.ring_attention import (
-            current_sequence_mesh,
-        )
-
-        return self._jit_caches.setdefault(current_sequence_mesh(), {})
-
-    @property
-    def _solver(self):
-        """Partitioned like _jit_cache (see MultiLayerNetwork._solver)."""
-        from deeplearning4j_tpu.parallel.ring_attention import (
-            current_sequence_mesh,
-        )
-
-        return self._solvers.get(current_sequence_mesh())
-
-    @_solver.setter
-    def _solver(self, value):
-        from deeplearning4j_tpu.parallel.ring_attention import (
-            current_sequence_mesh,
-        )
-
-        self._solvers[current_sequence_mesh()] = value
 
     # ------------------------------------------------------------- init
     def init(self) -> "ComputationGraph":

@@ -35,6 +35,9 @@ from deeplearning4j_tpu.nn.layers.recurrent import (
 from deeplearning4j_tpu.nn.layers.special import CenterLossOutputLayer
 from deeplearning4j_tpu.optim.listeners import TrainingListener
 from deeplearning4j_tpu.optim.updaters import NoOp, Updater, resolve_updater
+from deeplearning4j_tpu.parallel.ring_attention import (
+    SeqCtxJitCache, SeqCtxSolverCache,
+)
 from deeplearning4j_tpu.utils.pytrees import (
     flatten_params, param_count, tree_norm, unflatten_params,
 )
@@ -93,7 +96,7 @@ def _normalize_grads(grads, mode: str, threshold: float):
     return {name: per_layer(sub) for name, sub in grads.items()}
 
 
-class MultiLayerNetwork:
+class MultiLayerNetwork(SeqCtxJitCache, SeqCtxSolverCache):
     """Sequential network runtime over a MultiLayerConfiguration."""
 
     def __init__(self, conf: MultiLayerConfiguration):
@@ -114,36 +117,6 @@ class MultiLayerNetwork:
         self._jit_caches: Dict[Any, Dict[Any, Any]] = {}
         self._rnn_carries: Dict[str, Any] = {}  # rnnTimeStep statefulness
         self._solvers: Dict[Any, Any] = {}      # full-batch solver cache
-
-    @property
-    def _jit_cache(self) -> Dict[Any, Any]:
-        """Compiled-fn cache, partitioned by the active sequence-parallel
-        context: a trace made inside `sequence_parallel(mesh)` closes
-        over the ring-attention swap, so it must never be reused outside
-        that context (nor a dense trace inside it)."""
-        from deeplearning4j_tpu.parallel.ring_attention import (
-            current_sequence_mesh,
-        )
-
-        return self._jit_caches.setdefault(current_sequence_mesh(), {})
-
-    @property
-    def _solver(self):
-        """Full-batch solver cache, partitioned like _jit_cache (the
-        solver holds its own compiled traces of the forward)."""
-        from deeplearning4j_tpu.parallel.ring_attention import (
-            current_sequence_mesh,
-        )
-
-        return self._solvers.get(current_sequence_mesh())
-
-    @_solver.setter
-    def _solver(self, value):
-        from deeplearning4j_tpu.parallel.ring_attention import (
-            current_sequence_mesh,
-        )
-
-        self._solvers[current_sequence_mesh()] = value
 
     # ------------------------------------------------------------- init
     def init(self) -> "MultiLayerNetwork":
@@ -587,8 +560,7 @@ class MultiLayerNetwork:
         if x.ndim == 2:
             x = x[:, None, :]
         if not self._rnn_carries and self._decode_layer_names:
-            decode = [l for l in self.layers
-                      if l.name in set(self._decode_layer_names)]
+            decode = [l for l in self.layers if hasattr(l, "decode_carry")]
             # validate ALL before seeding ANY: a mid-loop raise would
             # leave partial carries behind and disarm this guard forever
             for l in decode:

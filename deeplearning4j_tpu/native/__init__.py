@@ -78,6 +78,9 @@ def _bind(lib: ctypes.CDLL) -> ctypes.CDLL:
     lib.dl4j_u8_to_f32.argtypes = [u8p, i64, ctypes.c_float, f32p]
     lib.dl4j_one_hot.restype = None
     lib.dl4j_one_hot.argtypes = [i32p, i64, ctypes.c_int32, f32p]
+    lib.dl4j_w2v_parse.restype = i64
+    lib.dl4j_w2v_parse.argtypes = [u8p, i64, i64, i64, f32p, u8p, i64,
+                                   ctypes.POINTER(i64)]
     lib.dl4j_arena_create.restype = ctypes.c_void_p
     lib.dl4j_arena_create.argtypes = [i64]
     lib.dl4j_arena_destroy.restype = None
@@ -271,6 +274,37 @@ def one_hot(labels: np.ndarray, num_classes: int) -> np.ndarray:
     out = np.empty((labels.size, num_classes), dtype=np.float32)
     lib.dl4j_one_hot(_i32p(labels), labels.size, num_classes, _f32p(out))
     return out
+
+
+def w2v_parse(body: bytes, n_words: int, dim: int):
+    """Google word2vec binary body (after the "V D\\n" header) →
+    (words list[str], vectors [V, D] float32) in one C++ scan with bulk
+    vector memcpy — the host-side hot path for GB-scale pretrained
+    embedding loads (WordVectorSerializer.loadGoogleModel equivalent).
+
+    Returns None when the native library is unavailable or the host is
+    big-endian (format floats are little-endian); callers then use their
+    Python path."""
+    import sys
+
+    lib = get_lib()
+    if lib is None or sys.byteorder != "little":
+        return None
+    buf = np.frombuffer(body, dtype=np.uint8)
+    vecs = np.empty((n_words, dim), dtype=np.float32)
+    words_buf = np.empty(max(buf.size, 1), dtype=np.uint8)
+    offsets = np.zeros(n_words + 1, dtype=np.int64)
+    consumed = lib.dl4j_w2v_parse(
+        _u8p(buf), buf.size, n_words, dim, _f32p(vecs), _u8p(words_buf),
+        words_buf.size,
+        offsets.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
+    if consumed < 0:
+        raise ValueError(
+            "malformed word2vec binary body (truncated record, missing "
+            "separator, or empty word)")
+    words = [bytes(words_buf[offsets[i]:offsets[i + 1]]).decode("utf-8")
+             for i in range(n_words)]
+    return words, vecs
 
 
 # ---------------------------------------------------------------------------

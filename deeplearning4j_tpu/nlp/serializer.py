@@ -54,10 +54,27 @@ def write_binary(model, path: str) -> None:
 
 
 def read_binary(path: str) -> Tuple[VocabCache, np.ndarray]:
-    """Reference: WordVectorSerializer.loadGoogleModel(binary=true)."""
+    """Reference: WordVectorSerializer.loadGoogleModel(binary=true).
+
+    Hot path: the body is parsed by the native C++ codec
+    (`native.w2v_parse` — one scan, bulk vector memcpy, the host-side
+    equivalent of the reference's buffered-stream loader for GB-scale
+    files); byte-by-byte Python remains as the no-toolchain fallback."""
     with open(path, "rb") as f:
         header = f.readline().decode().strip().split()
         V, D = int(header[0]), int(header[1])
+        body_start = f.tell()
+        from deeplearning4j_tpu import native
+
+        parsed = native.w2v_parse(f.read(), V, D) if native.available() \
+            else None
+        if parsed is not None:
+            words, mat = parsed
+            vocab = VocabCache()
+            for w in words:
+                vocab.add(VocabWord(word=w, count=1))
+            return vocab, mat
+        f.seek(body_start)
         vocab = VocabCache()
         mat = np.zeros((V, D), np.float32)
         for i in range(V):

@@ -58,7 +58,12 @@ class ParallelInference(SeqCtxJitCache):
         if self.mode == InferenceMode.INPLACE:
             return self._run(x)
         fut: Future = Future()
-        self._queue.put((x, fut))
+        # Capture the caller's contextvars (e.g. an active
+        # sequence_parallel context): the collector thread starts from an
+        # empty Context, so tracing there would silently drop the swap.
+        import contextvars
+
+        self._queue.put((x, fut, contextvars.copy_context()))
         return fut.result()
 
     def shutdown(self):
@@ -134,12 +139,15 @@ class ParallelInference(SeqCtxJitCache):
                 total += nxt[0].shape[0]
             xs = np.concatenate([b[0] for b in batch], axis=0)
             try:
-                ys = self._run(xs)
+                # Run under the FIRST request's captured context; a batch
+                # coalescing requests from different sequence_parallel
+                # contexts is driven by whoever arrived first.
+                ys = batch[0][2].run(self._run, xs)
                 off = 0
-                for x, fut in batch:
+                for x, fut, _ in batch:
                     fut.set_result(ys[off:off + x.shape[0]])
                     off += x.shape[0]
             except BaseException as e:
-                for _, fut in batch:
+                for _, fut, _ctx in batch:
                     if not fut.done():
                         fut.set_exception(e)

@@ -74,8 +74,23 @@ class SeqCtxJitCache:
 
     @property
     def _jit_cache(self):
-        caches = self.__dict__.setdefault("_seq_jit_caches", {})
+        caches = self.__dict__.setdefault("_jit_caches", {})
         return caches.setdefault(current_sequence_mesh(), {})
+
+
+class SeqCtxSolverCache:
+    """Mixin: the full-batch `_solver` cache, partitioned like
+    SeqCtxJitCache (the solver holds its own compiled forward traces)."""
+
+    @property
+    def _solver(self):
+        return self.__dict__.setdefault("_solvers", {}).get(
+            current_sequence_mesh())
+
+    @_solver.setter
+    def _solver(self, value):
+        self.__dict__.setdefault("_solvers", {})[
+            current_sequence_mesh()] = value
 
 
 def _block_accumulate(q, k, v, m, l, o, *, scale, q_off, k_off, causal):

@@ -185,3 +185,48 @@ def test_accumulator_rejects_mismatched_size():
     acc = GradientsAccumulator(8)
     with pytest.raises(ValueError):
         acc.receive_update(np.array([2]), 0.1, n=4)
+
+
+# ------------------------------------------------------------- w2v codec
+def test_w2v_parse_matches_python_fallback(tmp_path, monkeypatch):
+    """The C++ Google-binary body parser must agree byte-for-byte with
+    the Python reader on the same file (UTF-8 words included)."""
+    from deeplearning4j_tpu import native
+    from deeplearning4j_tpu.nlp.serializer import read_binary
+
+    if not native.available():
+        pytest.skip("no native toolchain")
+    rng = np.random.default_rng(0)
+    words = ["hello", "wörld", "日本語", "a" * 50, "x"]
+    D = 7
+    mat = rng.standard_normal((len(words), D)).astype("<f4")
+    p = tmp_path / "vecs.bin"
+    with open(p, "wb") as f:
+        f.write(f"{len(words)} {D}\n".encode())
+        for w, row in zip(words, mat):
+            f.write(w.encode("utf-8") + b" " + row.tobytes() + b"\n")
+
+    vocab_n, mat_n = read_binary(str(p))          # native path
+    monkeypatch.setattr(native, "available", lambda: False)
+    vocab_p, mat_p = read_binary(str(p))          # python fallback
+    np.testing.assert_array_equal(mat_n, mat_p)
+    for w in words:
+        assert vocab_n.index_of(w) == vocab_p.index_of(w)
+
+
+def test_w2v_parse_rejects_corrupt_bodies():
+    from deeplearning4j_tpu import native
+
+    if not native.available():
+        pytest.skip("no native toolchain")
+    D = 3
+    good = b"abc " + np.arange(D, dtype="<f4").tobytes() + b"\n"
+    # truncated vector
+    with pytest.raises(ValueError):
+        native.w2v_parse(good[:-8], 1, D)
+    # missing separator (word runs to EOF)
+    with pytest.raises(ValueError):
+        native.w2v_parse(b"abcdef", 1, D)
+    # empty word (double space)
+    with pytest.raises(ValueError):
+        native.w2v_parse(b"  " + np.arange(D, dtype="<f4").tobytes(), 1, D)

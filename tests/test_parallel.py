@@ -266,6 +266,33 @@ class TestSequenceParallelContext:
                 layer.apply(params, x, mask=fmask)
         assert any("ring is bypassed" in str(w.message) for w in caught)
 
+    def test_batched_inference_worker_sees_context(self, devices8):
+        """BATCHED-mode ParallelInference traces in a worker thread,
+        which starts from an empty contextvars Context — the caller's
+        sequence_parallel context must be captured per request and the
+        forward run under it (observable via the per-context cache key)."""
+        from deeplearning4j_tpu.parallel import ParallelInference
+        from deeplearning4j_tpu.parallel.ring_attention import (
+            sequence_parallel,
+        )
+        net = MultiLayerNetwork(
+            (NeuralNetConfiguration.builder()
+             .seed(0).updater(Sgd(0.1)).activation("relu")
+             .list(DenseLayer(n_out=8),
+                   OutputLayer(n_out=3, activation="softmax"))
+             .set_input_type(InputType.feed_forward(6))
+             .build())).init()
+        pi = ParallelInference(net, mode="batched", max_batch_size=8)
+        try:
+            seq_mesh = make_mesh({"seq": 8})
+            with sequence_parallel(seq_mesh):
+                y = pi.output(np.zeros((2, 6), np.float32))
+            assert y.shape == (2, 3)
+            assert any(k is not None for k in pi._jit_caches), \
+                "worker thread traced outside the caller's context"
+        finally:
+            pi.shutdown()
+
     def test_fit_under_context(self, devices8):
         from deeplearning4j_tpu.parallel.ring_attention import (
             sequence_parallel,
