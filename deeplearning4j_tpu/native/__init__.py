@@ -292,7 +292,11 @@ def w2v_parse(body: bytes, n_words: int, dim: int):
         return None
     buf = np.frombuffer(body, dtype=np.uint8)
     vecs = np.empty((n_words, dim), dtype=np.float32)
-    words_buf = np.empty(max(buf.size, 1), dtype=np.uint8)
+    # Tight word-bytes bound: the body is words + separators + vectors,
+    # so word bytes <= body - vectors (allocating the full body size
+    # would double peak memory on GB-scale loads).
+    words_cap = max(buf.size - n_words * dim * 4, 1)
+    words_buf = np.empty(words_cap, dtype=np.uint8)
     offsets = np.zeros(n_words + 1, dtype=np.int64)
     consumed = lib.dl4j_w2v_parse(
         _u8p(buf), buf.size, n_words, dim, _f32p(vecs), _u8p(words_buf),

@@ -83,7 +83,7 @@ def read_binary(path: str) -> Tuple[VocabCache, np.ndarray]:
                 ch = f.read(1)
                 if ch in (b" ", b""):
                     break
-                if ch != b"\n":
+                if ch not in (b"\n", b"\r"):   # CRLF files: match native
                     word.extend(ch)
             mat[i] = np.frombuffer(f.read(4 * D), "<f4")
             nl = f.read(1)

@@ -294,6 +294,83 @@ class LastTimeStepVertex(GraphVertex):
 
 @register_serde
 @dataclasses.dataclass(frozen=True)
+class CrossAttentionVertex(GraphVertex):
+    """Cross-attention DAG node: queries from inputs[0], keys/values from
+    inputs[1] — the encoder-decoder attention pattern. Modern extension
+    (the RNN-era reference has no attention, SURVEY §5); non-causal by
+    definition (the context is fully visible to every query). On TPU
+    with 128-lane-tileable Tq/Tk of at least 512, the core runs the
+    Pallas flash kernel (`ops/attention.py`, which supports Tq != Tk);
+    otherwise XLA dense attention."""
+
+    num_heads: int = 4
+    n_out: Optional[int] = None
+
+    def output_type(self, *input_types: InputType) -> InputType:
+        d = self.n_out or input_types[0].size
+        return InputType.recurrent(d, input_types[0].timesteps)
+
+    def init_params(self, key, input_types: Sequence[InputType],
+                    dtype=jnp.float32):
+        from deeplearning4j_tpu.nn.initializers import xavier
+
+        d_q = input_types[0].size
+        d_kv = input_types[1].size
+        d = self.n_out or d_q
+        if d % self.num_heads:
+            raise ValueError(
+                f"n_out {d} not divisible by num_heads {self.num_heads}")
+        ks = jax.random.split(key, 4)
+        return {
+            "Wq": xavier(ks[0], (d_q, d), dtype),
+            "Wk": xavier(ks[1], (d_kv, d), dtype),
+            "Wv": xavier(ks[2], (d_kv, d), dtype),
+            "Wo": xavier(ks[3], (d, d), dtype),
+            "b": jnp.zeros((d,), dtype),
+        }, {}
+
+    def apply(self, params, inputs, *, state=None, train=False, rng=None,
+              mask=None):
+        x, ctx = inputs
+        B, Tq, _ = x.shape
+        Tk = ctx.shape[1]
+        d = params["Wo"].shape[0]
+        H = self.num_heads
+        Dh = d // H
+        q = (x @ params["Wq"]).reshape(B, Tq, H, Dh)
+        k = (ctx @ params["Wk"]).reshape(B, Tk, H, Dh)
+        v = (ctx @ params["Wv"]).reshape(B, Tk, H, Dh)
+        key_mask = None
+        if mask is not None:
+            # A mask whose time axis matches the CONTEXT length masks the
+            # keys (padded encoder positions must get zero weight). A
+            # query-length mask carries no attention semantics here —
+            # output positions are masked by the loss — and is ignored.
+            # Ambiguity (Tq == Tk) is resolved as a key mask.
+            if mask.shape[1] == Tk:
+                key_mask = mask
+            elif mask.shape[1] != Tq:
+                raise ValueError(
+                    f"mask time axis {mask.shape[1]} matches neither the "
+                    f"query length {Tq} nor the context length {Tk}")
+        from deeplearning4j_tpu.ops.attention import flash_eligible
+
+        if key_mask is None and flash_eligible(Tq, Tk):
+            from deeplearning4j_tpu.ops.attention import flash_attention
+
+            o = flash_attention(q, k, v, False)
+        else:
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(Dh)
+            if key_mask is not None:
+                s = s + jnp.where(key_mask[:, None, None, :] > 0, 0.0,
+                                  -1e30)
+            o = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1), v)
+        y = o.reshape(B, Tq, d) @ params["Wo"] + params["b"]
+        return y, state
+
+
+@register_serde
+@dataclasses.dataclass(frozen=True)
 class DuplicateToTimeSeriesVertex(GraphVertex):
     """[B,F] → [B,T,F] broadcast over the timesteps of a reference input.
     Reference: `nn/conf/graph/rnn/DuplicateToTimeSeriesVertex.java`."""

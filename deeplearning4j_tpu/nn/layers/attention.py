@@ -163,13 +163,12 @@ class MultiHeadAttention(Layer):
             # materialize inside the flash kernel).
             o = self._masked_attention(q, k, v, mask, self.causal,
                                        dropout=drop, rng=rng)
-        elif jax.default_backend() == "tpu" and T % 128 == 0 and T >= 512:
+        elif self._flash_ok(T):
             # Fused blockwise kernel (ops/attention.py) for inference AND
             # training: the backward is the blockwise Pallas rematerializing
             # pass, so the [T, T] score matrix never materializes either
-            # way. T >= 512 because the kernel's measured win needs
-            # 512-wide tiles (tools/kernel_bench.py: at <=256-wide tiles
-            # XLA dense is 2-5x faster); short sequences keep XLA.
+            # way. Eligibility (backend/tile/length) is the shared
+            # heuristic in ops.attention.flash_eligible.
             from deeplearning4j_tpu.ops.attention import flash_attention
 
             o = flash_attention(q, k, v, self.causal)
@@ -178,6 +177,12 @@ class MultiHeadAttention(Layer):
         o = o.reshape(B, T, self.n_out)
         y = o @ params["Wo"] + params["b"]
         return self._act(y), state
+
+    @staticmethod
+    def _flash_ok(tq, tk=None):
+        from deeplearning4j_tpu.ops.attention import flash_eligible
+
+        return flash_eligible(tq, tk)
 
     @staticmethod
     def _masked_attention(q, k, v, mask, causal=False, dropout=0.0,

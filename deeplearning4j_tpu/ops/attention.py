@@ -133,21 +133,26 @@ def _fit_block(block: int, t: int) -> int:
 
 def _run_flash(q, k, v, *, causal: bool, scale: float, block_q: int,
                block_k: int, interpret: bool, with_lse: bool = False):
-    bh, t, d = q.shape
-    block_q = _fit_block(block_q, t)
-    block_k = _fit_block(block_k, t)
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    if causal and tq != tk:
+        raise ValueError(
+            f"causal attention requires Tq == Tk (got {tq} vs {tk}); "
+            "cross-attention is non-causal")
+    block_q = _fit_block(block_q, tq)
+    block_k = _fit_block(block_k, tk)
     kernel = functools.partial(_flash_kernel, causal=causal, scale=scale,
                                with_lse=with_lse)
     out_specs = [pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))]
-    out_shape = [jax.ShapeDtypeStruct((bh, t, d), q.dtype)]
+    out_shape = [jax.ShapeDtypeStruct((bh, tq, d), q.dtype)]
     if with_lse:
         out_specs.append(pl.BlockSpec((1, block_q, _LSE_LANES),
                                       lambda b, i, j: (b, i, 0)))
         out_shape.append(
-            jax.ShapeDtypeStruct((bh, t, _LSE_LANES), jnp.float32))
+            jax.ShapeDtypeStruct((bh, tq, _LSE_LANES), jnp.float32))
     out = pl.pallas_call(
         kernel,
-        grid=(bh, t // block_q, t // block_k),
+        grid=(bh, tq // block_q, tk // block_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
@@ -274,23 +279,24 @@ def _run_flash_bwd(q, k, v, o, lse, do, *, causal: bool, scale: float,
                    dlse=None):
     """Blockwise dq/dk/dv from O(T) residuals (q, k, v, o, L).
 
-    `lse` is the narrow [BH, T] log-sum-exp saved by the forward; both
+    `lse` is the narrow [BH, Tq] log-sum-exp saved by the forward; both
     row stats are re-broadcast here to the lane-wide layout the kernels
-    read. `dlse` (optional, [BH, T]) is the cotangent of the emitted
+    read. `dlse` (optional, [BH, Tq]) is the cotangent of the emitted
     log-sum-exp when the caller exposes it as an output (ring attention's
     merge does): since dL/ds_ij = p_ij, it folds into the softmax-vjp
     identity as a shift on Δ — ds = p * (dp - (Δ - dL)).
     """
-    bh, t, d = q.shape
-    block_q = _fit_block(block_q, t)
-    block_k = _fit_block(block_k, t)
-    lse = jnp.broadcast_to(lse[..., None], (bh, t, _LSE_LANES))
+    bh, tq, d = q.shape
+    tk = k.shape[1]
+    block_q = _fit_block(block_q, tq)
+    block_k = _fit_block(block_k, tk)
+    lse = jnp.broadcast_to(lse[..., None], (bh, tq, _LSE_LANES))
     # Δ = rowsum(do · o): one cheap fused elementwise+reduce in XLA.
     delta2 = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                      axis=-1, keepdims=True)
     if dlse is not None:
         delta2 = delta2 - dlse.astype(jnp.float32)[..., None]
-    delta = jnp.broadcast_to(delta2, (bh, t, _LSE_LANES))
+    delta = jnp.broadcast_to(delta2, (bh, tq, _LSE_LANES))
     q_spec = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
     row_spec = pl.BlockSpec((1, block_q, _LSE_LANES),
                             lambda b, i, j: (b, i, 0))
@@ -302,12 +308,12 @@ def _run_flash_bwd(q, k, v, o, lse, do, *, causal: bool, scale: float,
     kv_spec_t = pl.BlockSpec((1, block_k, d), lambda b, j, i: (b, j, 0))
     dk, dv = pl.pallas_call(
         functools.partial(_flash_bwd_dkdv_kernel, causal=causal, scale=scale),
-        grid=(bh, t // block_k, t // block_q),
+        grid=(bh, tk // block_k, tq // block_q),
         in_specs=[q_spec_t, kv_spec_t, kv_spec_t, q_spec_t, row_spec_t,
                   row_spec_t],
         out_specs=[kv_spec_t, kv_spec_t],
-        out_shape=[jax.ShapeDtypeStruct((bh, t, d), k.dtype),
-                   jax.ShapeDtypeStruct((bh, t, d), v.dtype)],
+        out_shape=[jax.ShapeDtypeStruct((bh, tk, d), k.dtype),
+                   jax.ShapeDtypeStruct((bh, tk, d), v.dtype)],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
@@ -318,16 +324,26 @@ def _run_flash_bwd(q, k, v, o, lse, do, *, causal: bool, scale: float,
     )(q, k, v, do, lse, delta)
     dq = pl.pallas_call(
         functools.partial(_flash_bwd_dq_kernel, causal=causal, scale=scale),
-        grid=(bh, t // block_q, t // block_k),
+        grid=(bh, tq // block_q, tk // block_k),
         in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
         out_specs=q_spec,
-        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh, tq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v, do, lse, delta)
     return dq, dk, dv
+
+
+def flash_eligible(tq: int, tk: Optional[int] = None) -> bool:
+    """Single source of truth for the flash-kernel dispatch heuristic:
+    TPU backend, 128-lane-tileable sequence lengths, and >= 512 (the
+    measured win region — tools/kernel_bench.py shows XLA dense is 2-5x
+    faster at narrower tiles)."""
+    tk = tq if tk is None else tk
+    return (jax.default_backend() == "tpu" and tq % 128 == 0
+            and tk % 128 == 0 and min(tq, tk) >= 512)
 
 
 def _fold3(x):
@@ -370,19 +386,19 @@ def flash_attention(q, k, v, causal: bool = False,
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
                backward):
     s = scale if scale is not None else q.shape[-1] ** -0.5
-    q3, shape = _fold3(q)
-    k3, _ = _fold3(k)
+    q3, shape_q = _fold3(q)
+    k3, shape_k = _fold3(k)   # cross-attention: Tk may differ from Tq
     v3, _ = _fold3(v)
     o3, lse = _run_flash(q3, k3, v3, causal=causal, scale=s,
                          block_q=block_q, block_k=block_k,
                          interpret=interpret,
                          with_lse=(backward == "pallas"))
-    return _unfold3(o3, shape), (q3, k3, v3, o3, lse, shape)
+    return _unfold3(o3, shape_q), (q3, k3, v3, o3, lse, shape_q, shape_k)
 
 
 def _flash_bwd(causal, scale, block_q, block_k, interpret, backward, res,
                do):
-    q3, k3, v3, o3, lse, shape = res
+    q3, k3, v3, o3, lse, shape_q, shape_k = res
     s = scale if scale is not None else q3.shape[-1] ** -0.5
     do3, _ = _fold3(do)
     if backward == "pallas":
@@ -394,7 +410,8 @@ def _flash_bwd(causal, scale, block_q, block_k, interpret, backward, res,
             lambda qq, kk, vv: _dense_attention(qq, kk, vv, causal, s),
             q3, k3, v3)
         dq, dk, dv = vjp(do3)
-    return (_unfold3(dq, shape), _unfold3(dk, shape), _unfold3(dv, shape))
+    return (_unfold3(dq, shape_q), _unfold3(dk, shape_k),
+            _unfold3(dv, shape_k))
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)

@@ -248,12 +248,10 @@ def ring_self_attention(q, k, v, mesh: Mesh, *, axis: str = AXIS_SEQ,
         kw = {"check_rep": False}
 
     if use_flash is None:
+        from deeplearning4j_tpu.ops.attention import flash_eligible
+
         t_local = q.shape[1] // mesh.shape[axis]
-        # >= 512: the kernel's measured win needs 512-wide tiles
-        # (tools/kernel_bench.py); shorter local blocks keep XLA.
-        use_flash = (jax.default_backend() == "tpu"
-                     and t_local % 128 == 0 and t_local >= 512
-                     and k.shape[1] == q.shape[1])
+        use_flash = flash_eligible(t_local) and k.shape[1] == q.shape[1]
 
     spec = P(None, axis, None, None)
     fn = shard_map(

@@ -423,3 +423,124 @@ class TestMultiOutputEvaluation:
         ds = DataSet(mds.features[0], mds.labels[0])
         with pytest.raises(ValueError, match="MultiDataSet"):
             net.evaluate_outputs([ds])
+
+
+class TestCrossAttentionVertex:
+    """Encoder-decoder cross-attention DAG node (modern extension)."""
+
+    @staticmethod
+    def _seq2seq_net(Tq=6, Tk=9, d=8, classes=5):
+        from deeplearning4j_tpu.nn.graph import CrossAttentionVertex
+        from deeplearning4j_tpu.nn.layers.recurrent import (
+            LSTM, RnnOutputLayer,
+        )
+        from deeplearning4j_tpu.optim.updaters import Adam
+
+        conf = (NeuralNetConfiguration.builder()
+                .seed(0).updater(Adam(5e-3)).activation("tanh")
+                .graph_builder()
+                .add_inputs("dec", "enc")
+                .add_layer("enc_rnn", LSTM(n_out=d), "enc")
+                .add_layer("dec_rnn", LSTM(n_out=d), "dec")
+                .add_vertex("xattn",
+                            CrossAttentionVertex(num_heads=2, n_out=d),
+                            "dec_rnn", "enc_rnn")
+                .add_layer("out",
+                           RnnOutputLayer(n_out=classes,
+                                          activation="softmax"), "xattn")
+                .set_outputs("out")
+                .set_input_types(InputType.recurrent(4, Tq),
+                                 InputType.recurrent(3, Tk))
+                .build())
+        return ComputationGraph(conf).init()
+
+    def test_shapes_and_learning(self):
+        from deeplearning4j_tpu.data.dataset import MultiDataSet
+
+        net = self._seq2seq_net()
+        rng = np.random.default_rng(0)
+        dec = rng.standard_normal((8, 6, 4)).astype(np.float32)
+        enc = rng.standard_normal((8, 9, 3)).astype(np.float32)
+        y = np.eye(5, dtype=np.float32)[rng.integers(0, 5, (8, 6))]
+        out = np.asarray(net.output(dec, enc))
+        assert out.shape == (8, 6, 5)
+        np.testing.assert_allclose(out.sum(-1), 1.0, rtol=1e-4)
+        mds = MultiDataSet([dec, enc], [y])
+        losses = []
+        for _ in range(15):
+            net.fit(mds)
+            losses.append(net.score_)
+        assert losses[-1] < losses[0] - 0.05, losses[::5]
+
+    def test_gradcheck(self):
+        from deeplearning4j_tpu.gradientcheck import check_gradients
+
+        net = self._seq2seq_net(Tq=4, Tk=5, d=4, classes=3)
+        rng = np.random.default_rng(1)
+        dec = rng.standard_normal((2, 4, 4))
+        enc = rng.standard_normal((2, 5, 3))
+        y = np.eye(3)[rng.integers(0, 3, (2, 4))]
+
+        import jax.numpy as _jnp
+
+        enc_fixed = _jnp.asarray(enc)
+
+        class _Shim:
+            params_tree = net.params_tree
+            state_tree = net.state_tree
+
+            @staticmethod
+            def _loss(params, states, features, labels, fmask, lmask,
+                      rng=None, train=False):
+                # the harness perturbs params only; the second input can
+                # ride in the closure
+                return net._loss(
+                    params, states, {"dec": features, "enc": enc_fixed},
+                    {"out": labels}, None, None, rng, train=train)
+
+        assert check_gradients(_Shim, _jnp.asarray(dec), y, subset=40)
+
+    def test_serde_round_trip(self):
+        net = self._seq2seq_net()
+        js = net.conf.to_json()
+        conf2 = ComputationGraphConfiguration.from_json(js)
+        net2 = ComputationGraph(conf2).init()
+        net2.set_params(net.params())
+        rng = np.random.default_rng(2)
+        dec = rng.standard_normal((2, 6, 4)).astype(np.float32)
+        enc = rng.standard_normal((2, 9, 3)).astype(np.float32)
+        np.testing.assert_allclose(np.asarray(net.output(dec, enc)),
+                                   np.asarray(net2.output(dec, enc)),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_key_mask_zeroes_padded_context(self):
+        """An encoder padding mask must remove padded keys: outputs with
+        a masked-out tail equal outputs over the truncated context."""
+        import jax.numpy as _jnp
+        from deeplearning4j_tpu.nn.graph import CrossAttentionVertex
+
+        v = CrossAttentionVertex(num_heads=2, n_out=8)
+        params, _ = v.init_params(
+            __import__("jax").random.PRNGKey(0),
+            [InputType.recurrent(8, 4), InputType.recurrent(8, 6)])
+        rng = np.random.default_rng(3)
+        x = _jnp.asarray(rng.standard_normal((2, 4, 8)), _jnp.float32)
+        ctx = _jnp.asarray(rng.standard_normal((2, 6, 8)), _jnp.float32)
+        mask = _jnp.asarray(np.array([[1, 1, 1, 1, 0, 0]] * 2, np.float32))
+        masked, _ = v.apply(params, [x, ctx], mask=mask)
+        trunc, _ = v.apply(params, [x, ctx[:, :4]])
+        np.testing.assert_allclose(np.asarray(masked), np.asarray(trunc),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_bad_mask_length_raises(self):
+        import jax.numpy as _jnp
+        from deeplearning4j_tpu.nn.graph import CrossAttentionVertex
+
+        v = CrossAttentionVertex(num_heads=2, n_out=8)
+        params, _ = v.init_params(
+            __import__("jax").random.PRNGKey(0),
+            [InputType.recurrent(8, 4), InputType.recurrent(8, 6)])
+        x = _jnp.zeros((1, 4, 8))
+        ctx = _jnp.zeros((1, 6, 8))
+        with pytest.raises(ValueError, match="neither"):
+            v.apply(params, [x, ctx], mask=_jnp.ones((1, 5)))

@@ -230,3 +230,27 @@ def test_w2v_parse_rejects_corrupt_bodies():
     # empty word (double space)
     with pytest.raises(ValueError):
         native.w2v_parse(b"  " + np.arange(D, dtype="<f4").tobytes(), 1, D)
+
+
+def test_w2v_parse_crlf_parity(tmp_path, monkeypatch):
+    """CRLF record terminators: native and Python paths must produce the
+    same vocab (a '\\r' must never leak into a word)."""
+    from deeplearning4j_tpu import native
+    from deeplearning4j_tpu.nlp.serializer import read_binary
+
+    if not native.available():
+        pytest.skip("no native toolchain")
+    D = 3
+    words = ["aa", "bb", "cc"]
+    mat = np.arange(len(words) * D, dtype="<f4").reshape(len(words), D)
+    p = tmp_path / "crlf.bin"
+    with open(p, "wb") as f:
+        f.write(f"{len(words)} {D}\n".encode())
+        for w, row in zip(words, mat):
+            f.write(w.encode() + b" " + row.tobytes() + b"\r\n")
+    vocab_n, mat_n = read_binary(str(p))
+    monkeypatch.setattr(native, "available", lambda: False)
+    vocab_p, mat_p = read_binary(str(p))
+    np.testing.assert_array_equal(mat_n, mat_p)
+    for w in words:
+        assert vocab_n.index_of(w) == vocab_p.index_of(w) >= 0

@@ -315,6 +315,35 @@ class TestFlashPallasBackward:
                 np.asarray(a, np.float32), np.asarray(b), rtol=0.1,
                 atol=0.15)
 
+    def test_cross_attention_tq_ne_tk(self):
+        """Kernel handles distinct Tq/Tk (encoder-decoder attention):
+        forward and Pallas backward vs the dense oracle."""
+        bh, tq, tk, d = 2, 24, 40, 16
+        q = self._rand((bh, tq, d), 20)
+        k = self._rand((bh, tk, d), 21)
+        v = self._rand((bh, tk, d), 22)
+        do = self._rand((bh, tq, d), 23)
+        with jax.default_matmul_precision("highest"):
+            o = flash_attention(q, k, v, False, None, 8, 8, True)
+            ref = _dense_attention(q, k, v, False, d ** -0.5)
+            np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                                       rtol=1e-4, atol=1e-5)
+            gf = jax.grad(lambda q, k, v: jnp.vdot(
+                flash_attention(q, k, v, False, None, 8, 8, True), do),
+                argnums=(0, 1, 2))(q, k, v)
+            gr = jax.grad(lambda q, k, v: jnp.vdot(
+                _dense_attention(q, k, v, False, d ** -0.5), do),
+                argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-3, atol=1e-4)
+
+    def test_causal_rejects_tq_ne_tk(self):
+        q = self._rand((1, 16, 8), 24)
+        k = self._rand((1, 24, 8), 25)
+        with pytest.raises(ValueError, match="causal"):
+            flash_attention(q, k, k, True, None, 8, 8, True)
+
     def test_residuals_are_linear_in_t(self):
         """The saved residuals must be O(T): q/k/v/o/lse only — no [T, T]."""
         bh, t, d = 1, 64, 8
