@@ -28,7 +28,8 @@ from deeplearning4j_tpu.nn.graph import (
 )
 from deeplearning4j_tpu.nn.layers.special import CenterLossOutputLayer
 from deeplearning4j_tpu.models.multilayer import (
-    _checkpointed, _dtype_of, _is_recurrent, _normalize_grads,
+    _checkpointed, _decode_limit, _dtype_of, _is_recurrent,
+    _normalize_grads,
 )
 from deeplearning4j_tpu.optim.listeners import TrainingListener
 from deeplearning4j_tpu.optim.updaters import NoOp, Updater, resolve_updater
@@ -148,10 +149,18 @@ class ComputationGraph(SeqCtxJitCache, SeqCtxSolverCache):
             lrng = None if rng is None else jax.random.fold_in(rng, idx)
             mask = None
             if fmasks:
-                for i in self.conf.vertex_inputs[name]:
-                    if i in fmasks:
-                        mask = fmasks[i]
-                        break
+                # A vertex may name the network input whose mask it wants
+                # (CrossAttentionVertex.key_mask_input — the generic
+                # first-match rule below would deliver the wrong stream's
+                # mask to a two-input attention vertex).
+                pref = getattr(v, "key_mask_input", None)
+                if pref is not None and pref in fmasks:
+                    mask = fmasks[pref]
+                else:
+                    for i in self.conf.vertex_inputs[name]:
+                        if i in fmasks:
+                            mask = fmasks[i]
+                            break
             if isinstance(v, LayerVertex) and v.layer.is_output_layer:
                 x = ins[0]
                 if v.preprocessor is not None:
@@ -412,6 +421,26 @@ class ComputationGraph(SeqCtxJitCache, SeqCtxSolverCache):
                 x = x[:, None, :]
             inputs[n] = x
         decode_names = self._decode_vertex_names
+        t_step = None
+        if decode_names:
+            # Host-side decode-length guard (under jit the layers' eager
+            # overflow checks cannot fire — see MultiLayerNetwork). Only
+            # meaningful when every input steps by the same length; a
+            # multi-length graph (e.g. full encoder context + one decoder
+            # token per call) has no single counter, so the in-kernel NaN
+            # poison is the remaining overflow signal there.
+            lens = {v.shape[1] for v in inputs.values() if v.ndim >= 3}
+            if len(lens) == 1:
+                t_step = lens.pop()
+                limit = _decode_limit(
+                    self.conf.vertices[n].layer for n in decode_names)
+                pos0 = getattr(self, "_decode_pos", 0)
+                if limit is not None and pos0 + t_step > limit:
+                    raise ValueError(
+                        f"decode position {pos0} + step {t_step} exceeds "
+                        f"the smallest cache/position limit {limit}; raise "
+                        f"max_cache/max_length or "
+                        f"rnn_clear_previous_state()")
         if not self._rnn_carries and decode_names:
             batch = next(iter(inputs.values())).shape[0]
             # validate ALL before seeding ANY: a mid-loop raise would
@@ -426,19 +455,35 @@ class ComputationGraph(SeqCtxJitCache, SeqCtxSolverCache):
                 self._rnn_carries[n] = (
                     self.conf.vertices[n].layer.decode_carry(
                         batch, self.dtype))
-        values, _, new_states = self._forward(
-            self.params_tree, self.state_tree, inputs, train=False, rng=None,
-            carries=self._rnn_carries or None)
-        self._rnn_carries = {
-            n: new_states[n]
-            for n in set(self._rnn_vertex_names) | set(decode_names)
-        }
+        stateful = set(self._rnn_vertex_names) | set(decode_names)
+        carries = self._rnn_carries or None
+        # One jitted program per (step shapes, carry presence) — see
+        # MultiLayerNetwork.rnn_time_step for why eager per-op dispatch
+        # is unacceptable in a per-token decode loop on TPU.
+        key = ("rnn_step",
+               tuple(sorted((n, v.shape) for n, v in inputs.items())),
+               carries is not None)
+        if key not in self._jit_cache:
+            def step_fn(params, states, inputs_, carries_):
+                values, _, new_states = self._forward(
+                    params, states, inputs_, train=False, rng=None,
+                    carries=carries_)
+                return ({o: values[o] for o in self.conf.network_outputs},
+                        {n: new_states[n] for n in stateful})
+
+            self._jit_cache[key] = jax.jit(step_fn)
+        values, self._rnn_carries = self._jit_cache[key](
+            self.params_tree, self.state_tree, inputs, carries)
+        if t_step is not None:
+            # advance only after a successful step
+            self._decode_pos = getattr(self, "_decode_pos", 0) + t_step
         outs = [values[o] for o in self.conf.network_outputs]
         return outs[0] if len(outs) == 1 else outs
 
     def rnn_clear_previous_state(self):
         """Reference: `ComputationGraph.rnnClearPreviousState`."""
         self._rnn_carries = {}
+        self._decode_pos = 0
 
     # -------------------------------------------------------- pretrain
     def pretrain(self, data, *, epochs: int = 1, batch_size: int = 32):

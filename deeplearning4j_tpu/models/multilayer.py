@@ -56,6 +56,19 @@ def _is_recurrent(layer: Layer) -> bool:
     )
 
 
+def _decode_limit(decode_layers) -> Optional[int]:
+    """Smallest KV-cache/position bound among decode-capable layers —
+    the host-side decode-length guard's ceiling (under the jitted
+    stepping path the layers' own eager overflow checks cannot fire)."""
+    limits = [
+        lim for l in decode_layers
+        for lim in (getattr(l, "max_cache", None),
+                    getattr(l, "max_length", None))
+        if lim is not None
+    ]
+    return min(limits) if limits else None
+
+
 def _checkpointed(apply_fn, mask):
     """Wrap one layer/vertex apply in jax.checkpoint for the TRAIN path
     (gradient_checkpointing): its activations are rematerialized in the
@@ -573,17 +586,40 @@ class MultiLayerNetwork(SeqCtxJitCache, SeqCtxSolverCache):
             for l in decode:
                 self._rnn_carries[l.name] = l.decode_carry(
                     x.shape[0], self.dtype)
-        out, _, new_states, _ = self._forward(
-            self.params_tree, self.state_tree, x, train=False, rng=None,
-            carries=self._rnn_carries or None)
-        self._rnn_carries = {
-            n: new_states[n]
-            for n in set(self._rnn_layer_names) | set(self._decode_layer_names)
-        }
+        stateful = set(self._rnn_layer_names) | set(self._decode_layer_names)
+        if self._decode_layer_names:
+            limit = _decode_limit(
+                l for l in self.layers if hasattr(l, "decode_carry"))
+            pos0 = getattr(self, "_decode_pos", 0)
+            if limit is not None and pos0 + x.shape[1] > limit:
+                raise ValueError(
+                    f"decode position {pos0} + step {x.shape[1]} exceeds "
+                    f"the smallest cache/position limit {limit}; raise "
+                    f"max_cache/max_length or rnn_clear_previous_state()")
+        carries = self._rnn_carries or None
+        # One jitted program per (step shape, carry presence): token-by-
+        # token decoding is a fixed-shape loop, so eager per-op dispatch
+        # (a device round-trip per op per token) would dominate on TPU.
+        key = ("rnn_step", x.shape, carries is not None)
+        if key not in self._jit_cache:
+            def step_fn(params, states, feats, carries_):
+                out, _, new_states, _ = self._forward(
+                    params, states, feats, train=False, rng=None,
+                    carries=carries_)
+                return out, {n: new_states[n] for n in stateful}
+
+            self._jit_cache[key] = jax.jit(step_fn)
+        out, self._rnn_carries = self._jit_cache[key](
+            self.params_tree, self.state_tree, x, carries)
+        if self._decode_layer_names:
+            # advance only after a successful step (a raise above or a
+            # trace failure must not burn decode budget)
+            self._decode_pos = getattr(self, "_decode_pos", 0) + x.shape[1]
         return out
 
     def rnn_clear_previous_state(self):
         self._rnn_carries = {}
+        self._decode_pos = 0
 
     # -------------------------------------------------------- pretrain
     def pretrain(self, data, *, epochs: int = 1, batch_size: int = 32):

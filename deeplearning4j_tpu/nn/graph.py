@@ -305,6 +305,10 @@ class CrossAttentionVertex(GraphVertex):
 
     num_heads: int = 4
     n_out: Optional[int] = None
+    # Name of the network input whose padding mask masks the KEYS (the
+    # encoder stream). Without it, a mask is only applied when its length
+    # unambiguously matches the context (Tk != Tq).
+    key_mask_input: Optional[str] = None
 
     def output_type(self, *input_types: InputType) -> InputType:
         d = self.n_out or input_types[0].size
@@ -346,9 +350,22 @@ class CrossAttentionVertex(GraphVertex):
             # keys (padded encoder positions must get zero weight). A
             # query-length mask carries no attention semantics here —
             # output positions are masked by the loss — and is ignored.
-            # Ambiguity (Tq == Tk) is resolved as a key mask.
-            if mask.shape[1] == Tk:
+            # With key_mask_input configured, the graph runtime delivers
+            # the named input's mask and it must match Tk; without it,
+            # Tq == Tk is ambiguous and refused.
+            if self.key_mask_input is not None:
+                if mask.shape[1] != Tk:
+                    raise ValueError(
+                        f"key_mask_input mask length {mask.shape[1]} != "
+                        f"context length {Tk}")
                 key_mask = mask
+            elif mask.shape[1] == Tk and Tq != Tk:
+                key_mask = mask
+            elif mask.shape[1] == Tk and Tq == Tk:
+                raise ValueError(
+                    "ambiguous mask (Tq == Tk): set key_mask_input to "
+                    "the encoder input's name so the key mask is "
+                    "delivered unambiguously")
             elif mask.shape[1] != Tq:
                 raise ValueError(
                     f"mask time axis {mask.shape[1]} matches neither the "

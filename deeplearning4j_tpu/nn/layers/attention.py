@@ -127,6 +127,9 @@ class MultiHeadAttention(Layer):
             return (x @ w).reshape(B, T, H, Dh)
 
         q, k, v = split(params["Wq"]), split(params["Wk"]), split(params["Wv"])
+        from deeplearning4j_tpu.ops.attention import (
+            flash_eligible as _flash_eligible,
+        )
         from deeplearning4j_tpu.parallel.ring_attention import (
             current_sequence_mesh,
         )
@@ -163,7 +166,7 @@ class MultiHeadAttention(Layer):
             # materialize inside the flash kernel).
             o = self._masked_attention(q, k, v, mask, self.causal,
                                        dropout=drop, rng=rng)
-        elif self._flash_ok(T):
+        elif _flash_eligible(T):
             # Fused blockwise kernel (ops/attention.py) for inference AND
             # training: the backward is the blockwise Pallas rematerializing
             # pass, so the [T, T] score matrix never materializes either
@@ -177,12 +180,6 @@ class MultiHeadAttention(Layer):
         o = o.reshape(B, T, self.n_out)
         y = o @ params["Wo"] + params["b"]
         return self._act(y), state
-
-    @staticmethod
-    def _flash_ok(tq, tk=None):
-        from deeplearning4j_tpu.ops.attention import flash_eligible
-
-        return flash_eligible(tq, tk)
 
     @staticmethod
     def _masked_attention(q, k, v, mask, causal=False, dropout=0.0,

@@ -532,6 +532,63 @@ class TestCrossAttentionVertex:
         np.testing.assert_allclose(np.asarray(masked), np.asarray(trunc),
                                    rtol=1e-5, atol=1e-6)
 
+
+    def test_ambiguous_mask_requires_key_mask_input(self):
+        import jax.numpy as _jnp
+        from deeplearning4j_tpu.nn.graph import CrossAttentionVertex
+
+        v = CrossAttentionVertex(num_heads=2, n_out=8)
+        params, _ = v.init_params(
+            __import__("jax").random.PRNGKey(0),
+            [InputType.recurrent(8, 4), InputType.recurrent(8, 4)])
+        x = _jnp.zeros((1, 4, 8))
+        with pytest.raises(ValueError, match="key_mask_input"):
+            v.apply(params, [x, x], mask=_jnp.ones((1, 4)))
+
+    def test_key_mask_input_delivers_encoder_mask_in_graph(self):
+        """key_mask_input plumbing: the graph runtime must hand the
+        NAMED network input's mask to the vertex (the generic first-match
+        rule would deliver the decoder's), and masked-out encoder tail
+        must equal a truncated context."""
+        import jax.numpy as _jnp
+        from deeplearning4j_tpu.nn.graph import CrossAttentionVertex
+
+        conf = (NeuralNetConfiguration.builder()
+                .seed(0).updater(Adam(1e-3)).activation("identity")
+                .graph_builder()
+                .add_inputs("dec", "enc")
+                .add_vertex("xattn",
+                            CrossAttentionVertex(num_heads=2, n_out=8,
+                                                 key_mask_input="enc"),
+                            "dec", "enc")
+                .add_layer("out",
+                           __import__(
+                               "deeplearning4j_tpu.nn.layers.recurrent",
+                               fromlist=["RnnOutputLayer"]
+                           ).RnnOutputLayer(n_out=3, activation="softmax"),
+                           "xattn")
+                .set_outputs("out")
+                .set_input_types(InputType.recurrent(8, 6),
+                                 InputType.recurrent(8, 6))
+                .build())
+        net = ComputationGraph(conf).init()
+        rng = np.random.default_rng(4)
+        dec = _jnp.asarray(rng.standard_normal((2, 6, 8)), _jnp.float32)
+        enc = _jnp.asarray(rng.standard_normal((2, 6, 8)), _jnp.float32)
+        enc_mask = _jnp.asarray(np.array([[1, 1, 1, 1, 0, 0]] * 2,
+                                         np.float32))
+        vals, _, _ = net._forward(
+            net.params_tree, net.state_tree, {"dec": dec, "enc": enc},
+            train=False, rng=None,
+            fmasks={"enc": enc_mask, "dec": _jnp.ones((2, 6))})
+        # oracle: context truncated to the unmasked prefix
+        vals_t, _, _ = net._forward(
+            net.params_tree, net.state_tree,
+            {"dec": dec, "enc": enc[:, :4]}, train=False, rng=None)
+        np.testing.assert_allclose(np.asarray(vals["xattn"]),
+                                   np.asarray(vals_t["xattn"]),
+                                   rtol=1e-5, atol=1e-6)
+
     def test_bad_mask_length_raises(self):
         import jax.numpy as _jnp
         from deeplearning4j_tpu.nn.graph import CrossAttentionVertex

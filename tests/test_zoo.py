@@ -161,6 +161,26 @@ def test_rnn_time_step_rejects_non_causal_attention():
         net.rnn_time_step(np.zeros((1, 2, 4), np.float32))
 
 
+def test_net_level_decode_overflow_raises():
+    """The jitted stepping path cannot run the layers' eager overflow
+    checks, so the network keeps a host-side position counter that must
+    still fail loudly past the smallest cache/position limit."""
+    from deeplearning4j_tpu.zoo.transformer import TextGenerationTransformer
+
+    T = 8
+    net = TextGenerationTransformer(num_classes=7, input_shape=(T, 1),
+                                    d_model=8, num_heads=2,
+                                    num_blocks=1).init()
+    x = np.zeros((1, 5, 1), np.float32)
+    net.rnn_clear_previous_state()
+    net.rnn_time_step(x)                       # pos 5
+    net.rnn_time_step(x[:, :3, :])             # pos 8 == limit, ok
+    with pytest.raises(ValueError, match="exceeds"):
+        net.rnn_time_step(x[:, :1, :])         # pos 9 > 8
+    net.rnn_clear_previous_state()
+    net.rnn_time_step(x)                       # counter reset works
+
+
 def test_decode_overflow_raises_eagerly():
     """Stepping past max_cache must fail loudly, not clamp silently."""
     from deeplearning4j_tpu.nn.layers.attention import MultiHeadAttention

@@ -198,6 +198,12 @@ def main():
             bench_attention, 2048, False, True, True, bq, bk)))
         jobs.append(("sweeptrain", functools.partial(
             bench_attention, 2048, True, True, True, bq, bk)))
+    # does the win keep growing past 512-wide tiles at longer T?
+    for bq, bk in ((1024, 1024), (512, 1024), (1024, 512)):
+        jobs.append(("sweep", functools.partial(
+            bench_attention, 4096, False, True, True, bq, bk)))
+        jobs.append(("sweeptrain", functools.partial(
+            bench_attention, 4096, True, True, True, bq, bk)))
     for train in (False, True):
         for fused in (False, True):
             jobs.append(("lstm", functools.partial(bench_lstm, train,
