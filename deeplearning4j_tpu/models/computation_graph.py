@@ -28,7 +28,7 @@ from deeplearning4j_tpu.nn.graph import (
 )
 from deeplearning4j_tpu.nn.layers.special import CenterLossOutputLayer
 from deeplearning4j_tpu.models.multilayer import (
-    _checkpointed, _decode_limit, _dtype_of, _is_recurrent,
+    _check_decode_budget, _checkpointed, _dtype_of, _is_recurrent,
     _normalize_grads,
 )
 from deeplearning4j_tpu.optim.listeners import TrainingListener
@@ -154,8 +154,11 @@ class ComputationGraph(SeqCtxJitCache, SeqCtxSolverCache):
                 # first-match rule below would deliver the wrong stream's
                 # mask to a two-input attention vertex).
                 pref = getattr(v, "key_mask_input", None)
-                if pref is not None and pref in fmasks:
-                    mask = fmasks[pref]
+                if pref is not None:
+                    # Named-input mask ONLY — falling back to first-match
+                    # would hand a different stream's mask to a vertex
+                    # that trusts whatever it receives as a key mask.
+                    mask = fmasks.get(pref)
                 else:
                     for i in self.conf.vertex_inputs[name]:
                         if i in fmasks:
@@ -432,15 +435,10 @@ class ComputationGraph(SeqCtxJitCache, SeqCtxSolverCache):
             lens = {v.shape[1] for v in inputs.values() if v.ndim >= 3}
             if len(lens) == 1:
                 t_step = lens.pop()
-                limit = _decode_limit(
-                    self.conf.vertices[n].layer for n in decode_names)
-                pos0 = getattr(self, "_decode_pos", 0)
-                if limit is not None and pos0 + t_step > limit:
-                    raise ValueError(
-                        f"decode position {pos0} + step {t_step} exceeds "
-                        f"the smallest cache/position limit {limit}; raise "
-                        f"max_cache/max_length or "
-                        f"rnn_clear_previous_state()")
+                _check_decode_budget(
+                    self,
+                    (self.conf.vertices[n].layer for n in decode_names),
+                    t_step)
         if not self._rnn_carries and decode_names:
             batch = next(iter(inputs.values())).shape[0]
             # validate ALL before seeding ANY: a mid-loop raise would

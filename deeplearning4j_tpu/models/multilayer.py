@@ -69,6 +69,19 @@ def _decode_limit(decode_layers) -> Optional[int]:
     return min(limits) if limits else None
 
 
+def _check_decode_budget(model, decode_layers, t_step: int) -> None:
+    """The shared host-side decode-length guard: raises before a step
+    that would run past the smallest cache/position limit. The caller
+    advances `model._decode_pos` only after a successful step."""
+    limit = _decode_limit(decode_layers)
+    pos0 = getattr(model, "_decode_pos", 0)
+    if limit is not None and pos0 + t_step > limit:
+        raise ValueError(
+            f"decode position {pos0} + step {t_step} exceeds the "
+            f"smallest cache/position limit {limit}; raise "
+            f"max_cache/max_length or rnn_clear_previous_state()")
+
+
 def _checkpointed(apply_fn, mask):
     """Wrap one layer/vertex apply in jax.checkpoint for the TRAIN path
     (gradient_checkpointing): its activations are rematerialized in the
@@ -588,14 +601,9 @@ class MultiLayerNetwork(SeqCtxJitCache, SeqCtxSolverCache):
                     x.shape[0], self.dtype)
         stateful = set(self._rnn_layer_names) | set(self._decode_layer_names)
         if self._decode_layer_names:
-            limit = _decode_limit(
-                l for l in self.layers if hasattr(l, "decode_carry"))
-            pos0 = getattr(self, "_decode_pos", 0)
-            if limit is not None and pos0 + x.shape[1] > limit:
-                raise ValueError(
-                    f"decode position {pos0} + step {x.shape[1]} exceeds "
-                    f"the smallest cache/position limit {limit}; raise "
-                    f"max_cache/max_length or rnn_clear_previous_state()")
+            _check_decode_budget(
+                self, (l for l in self.layers if hasattr(l, "decode_carry")),
+                x.shape[1])
         carries = self._rnn_carries or None
         # One jitted program per (step shape, carry presence): token-by-
         # token decoding is a fixed-shape loop, so eager per-op dispatch

@@ -36,6 +36,12 @@ def test_transformer_text_generation(capsys):
     assert len(text) == 16
 
 
+def test_seq2seq_cross_attention(capsys):
+    mod = _run("seq2seq_cross_attention.py")
+    acc = mod["main"](epochs=120, n=64)
+    assert acc > 0.8, acc
+
+
 def test_word2vec_similarity(capsys):
     mod = _run("word2vec_similarity.py")
     mod["main"]()
