@@ -266,6 +266,30 @@ class TestSequenceParallelContext:
                 layer.apply(params, x, mask=fmask)
         assert any("ring is bypassed" in str(w.message) for w in caught)
 
+    def test_moe_block_composes_with_context(self, devices8):
+        """A MoE transformer block under sequence_parallel: the attention
+        core swaps to the ring while expert routing is untouched — the
+        output must equal the dense-context forward."""
+        import jax as _jax
+        from deeplearning4j_tpu.nn.layers.attention import (
+            TransformerEncoderBlock,
+        )
+        from deeplearning4j_tpu.parallel.ring_attention import (
+            sequence_parallel,
+        )
+        mesh = make_mesh({"seq": 8})
+        blk = TransformerEncoderBlock(n_in=8, num_heads=2, causal=True,
+                                      n_experts=2)
+        params, _ = blk.init_params(_jax.random.PRNGKey(0),
+                                    InputType.recurrent(8))
+        x = jnp.asarray(np.random.default_rng(0).standard_normal(
+            (2, 16, 8)), jnp.float32)
+        dense, _ = blk.apply(params, x)
+        with sequence_parallel(mesh):
+            sp, _ = blk.apply(params, x)
+        np.testing.assert_allclose(np.asarray(sp), np.asarray(dense),
+                                   rtol=2e-4, atol=2e-5)
+
     def test_batched_inference_worker_sees_context(self, devices8):
         """BATCHED-mode ParallelInference traces in a worker thread,
         which starts from an empty contextvars Context — the caller's
