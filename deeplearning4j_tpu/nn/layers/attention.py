@@ -21,6 +21,24 @@ from deeplearning4j_tpu.nn.layers.base import Layer, register_layer
 from deeplearning4j_tpu.parallel.ring_attention import attention
 
 
+def rope_rotate(x, positions, base: float = 10000.0):
+    """Rotary position embedding (RoPE): rotate [B, T, H, Dh] per-head
+    pairs by position-dependent angles. Attention scores between rotated
+    q/k depend only on RELATIVE distance, so there is no learned
+    position table and no absolute-length cap (modern extension; the
+    RNN-era reference has no positional encodings at all)."""
+    dh = x.shape[-1]
+    if dh % 2:
+        raise ValueError(f"RoPE needs an even head dim, got {dh}")
+    half = dh // 2
+    freqs = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # [T, half]
+    c = jnp.cos(ang)[None, :, None, :].astype(x.dtype)
+    s = jnp.sin(ang)[None, :, None, :].astype(x.dtype)
+    x1, x2 = x[..., :half], x[..., half:]
+    return jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
+
+
 @register_layer
 @dataclasses.dataclass(frozen=True)
 class MultiHeadAttention(Layer):
@@ -32,6 +50,7 @@ class MultiHeadAttention(Layer):
     causal: bool = False
     attn_dropout: float = 0.0
     max_cache: int = 1024             # KV-cache length for decode stepping
+    rope: bool = False                # rotary position embedding on q/k
 
     def infer_n_in(self, input_type: InputType):
         upd = {}
@@ -93,6 +112,12 @@ class MultiHeadAttention(Layer):
             return (x @ w).reshape(B, T, H, Dh)
 
         q, k, v = split(params["Wq"]), split(params["Wk"]), split(params["Wv"])
+        if self.rope:
+            # rotate with ABSOLUTE positions continuing from the carry;
+            # the cache stores rotated keys (standard RoPE decoding)
+            positions = pos + jnp.arange(T)
+            q = rope_rotate(q, positions)
+            k = rope_rotate(k, positions)
         # Tracer-safe overflow poison: under jit the eager check above
         # cannot fire, and dynamic_update_slice would silently clamp the
         # write into the last rows — poison the output with NaN instead
@@ -127,6 +152,10 @@ class MultiHeadAttention(Layer):
             return (x @ w).reshape(B, T, H, Dh)
 
         q, k, v = split(params["Wq"]), split(params["Wk"]), split(params["Wv"])
+        if self.rope:
+            positions = jnp.arange(T)
+            q = rope_rotate(q, positions)
+            k = rope_rotate(k, positions)
         from deeplearning4j_tpu.ops.attention import (
             flash_eligible as _flash_eligible,
         )
@@ -271,6 +300,7 @@ class TransformerEncoderBlock(Layer):
     n_experts: int = 0            # 0 = dense FFN; >0 = MoE
     moe_k: int = 2
     max_cache: int = 1024         # KV-cache length for decode stepping
+    rope: bool = False            # rotary position embedding on q/k
 
     def infer_n_in(self, input_type: InputType):
         if self.n_in is None:
@@ -285,7 +315,7 @@ class TransformerEncoderBlock(Layer):
         attn = MultiHeadAttention(
             n_in=d, n_out=d, num_heads=self.num_heads, causal=self.causal,
             activation="identity", weight_init=self.weight_init,
-            max_cache=self.max_cache)
+            max_cache=self.max_cache, rope=self.rope)
         if self.n_experts > 0:
             from deeplearning4j_tpu.parallel.moe import MoEFeedForward
 

@@ -35,25 +35,39 @@ class TextGenerationTransformer(ZooModel):
     input_shape = (256, 1)        # (timesteps, 1 token-id channel)
 
     def __init__(self, *args, d_model: int = 256, num_heads: int = 8,
-                 num_blocks: int = 4, n_experts: int = 0, **kw):
+                 num_blocks: int = 4, n_experts: int = 0,
+                 pos_encoding: str = "learned", max_decode: int = 0, **kw):
         super().__init__(*args, **kw)
         self.d_model = d_model
         self.num_heads = num_heads
         self.num_blocks = num_blocks
         self.n_experts = n_experts
+        if pos_encoding not in ("learned", "rope"):
+            raise ValueError(f"pos_encoding must be 'learned' or 'rope', "
+                             f"got {pos_encoding!r}")
+        if max_decode and pos_encoding != "rope":
+            raise ValueError(
+                "max_decode extends generation past the training length, "
+                "which needs pos_encoding='rope' (learned positions are "
+                "hard-capped at the table size)")
+        self.pos_encoding = pos_encoding
+        self.max_decode = max_decode   # rope only: decode budget beyond t
 
     def conf(self):
         t = self.input_shape[0]
         vocab = self.num_classes
+        rope = self.pos_encoding == "rope"
+        # learned positions cap decode length at t, so a bigger KV cache
+        # would be unreachable; RoPE has no absolute-position table, so
+        # the cache (and thus generation) may extend past the training t
+        cache = max(t, self.max_decode) if rope else t
         blocks = [
             TransformerEncoderBlock(
                 num_heads=self.num_heads, causal=True,
-                n_experts=self.n_experts,
-                # positions cap decode length at t, so a bigger KV cache
-                # would be unreachable memory/FLOPs per decode step
-                max_cache=t)
+                n_experts=self.n_experts, max_cache=cache, rope=rope)
             for _ in range(self.num_blocks)
         ]
+        pos = [] if rope else [PositionEmbeddingLayer(max_length=t)]
         return (NeuralNetConfiguration.builder()
                 .seed(self.seed)
                 .updater(self.kw.get("updater", Adam(3e-4)))
@@ -62,7 +76,7 @@ class TextGenerationTransformer(ZooModel):
                 .list(
                     EmbeddingSequenceLayer(n_in=vocab, n_out=self.d_model,
                                            activation="identity"),
-                    PositionEmbeddingLayer(max_length=t),
+                    *pos,
                     *blocks,
                     RnnOutputLayer(n_out=vocab, activation="softmax",
                                    loss="mcxent"))

@@ -134,6 +134,84 @@ def test_cg_transformer_incremental_decode():
     np.testing.assert_allclose(stepped, full, rtol=1e-4, atol=1e-5)
 
 
+class TestRoPE:
+    def test_scores_depend_only_on_relative_distance(self):
+        """The defining RoPE property: q_i · k_j after rotation is
+        invariant under a common position shift."""
+        import jax.numpy as _jnp
+        from deeplearning4j_tpu.nn.layers.attention import rope_rotate
+
+        rng = np.random.default_rng(0)
+        B, T, H, Dh = 1, 6, 2, 8
+        q = _jnp.asarray(rng.standard_normal((B, T, H, Dh)), _jnp.float32)
+        k = _jnp.asarray(rng.standard_normal((B, T, H, Dh)), _jnp.float32)
+        for shift in (5, 173):
+            s0 = np.einsum("bqhd,bkhd->bhqk",
+                           rope_rotate(q, _jnp.arange(T)),
+                           rope_rotate(k, _jnp.arange(T)))
+            s1 = np.einsum("bqhd,bkhd->bhqk",
+                           rope_rotate(q, shift + _jnp.arange(T)),
+                           rope_rotate(k, shift + _jnp.arange(T)))
+            np.testing.assert_allclose(s0, s1, rtol=1e-4, atol=1e-4)
+
+    def test_odd_head_dim_rejected(self):
+        import jax.numpy as _jnp
+        from deeplearning4j_tpu.nn.layers.attention import rope_rotate
+
+        with pytest.raises(ValueError, match="even"):
+            rope_rotate(_jnp.zeros((1, 4, 2, 7)), _jnp.arange(4))
+
+    def test_rope_transformer_decode_parity_and_serde(self):
+        from deeplearning4j_tpu.models import MultiLayerNetwork
+        from deeplearning4j_tpu.nn.config import MultiLayerConfiguration
+        from deeplearning4j_tpu.zoo.transformer import (
+            TextGenerationTransformer,
+        )
+
+        T = 12
+        net = TextGenerationTransformer(
+            num_classes=11, input_shape=(T, 1), d_model=16, num_heads=2,
+            num_blocks=2, pos_encoding="rope").init()
+        rng = np.random.default_rng(6)
+        x = rng.integers(0, 11, (2, T, 1)).astype(np.float32)
+        full = np.asarray(net.output(x))
+        net.rnn_clear_previous_state()
+        outs = [np.asarray(net.rnn_time_step(x[:, :4, :]))]
+        for t in range(4, T):
+            outs.append(np.asarray(net.rnn_time_step(x[:, t:t + 1, :])))
+        np.testing.assert_allclose(np.concatenate(outs, axis=1), full,
+                                   rtol=1e-4, atol=1e-5)
+        # serde round-trips the rope flag (outputs must match, and the
+        # decode behavior must survive the round trip)
+        net2 = MultiLayerNetwork(MultiLayerConfiguration.from_json(
+            net.conf.to_json())).init()
+        net2.set_params(net.params())
+        np.testing.assert_allclose(np.asarray(net2.output(x)), full,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_rope_decodes_past_training_length(self):
+        """No learned position table -> generation may extend past the
+        training context (max_decode sizes the KV cache)."""
+        from deeplearning4j_tpu.utils.textgen import generate
+        from deeplearning4j_tpu.zoo.transformer import (
+            TextGenerationTransformer,
+        )
+
+        net = TextGenerationTransformer(
+            num_classes=9, input_shape=(8, 1), d_model=16, num_heads=2,
+            num_blocks=1, pos_encoding="rope", max_decode=24).init()
+        prompt = np.array([[1, 2, 3]])
+        out = generate(net, prompt, 20, greedy=True)   # 3 + 20 > 8
+        assert out.shape == (1, 20)
+        assert ((0 <= out) & (out < 9)).all()
+        # the learned-positions variant must refuse the same request
+        net_l = TextGenerationTransformer(
+            num_classes=9, input_shape=(8, 1), d_model=16, num_heads=2,
+            num_blocks=1).init()
+        with pytest.raises(ValueError, match="exceeds"):
+            generate(net_l, prompt, 20, greedy=True)
+
+
 def test_rnn_time_step_rejects_non_causal_attention():
     """Stepped decoding cannot reproduce a bidirectional forward, so
     seeding must refuse non-causal attention instead of silently
@@ -495,3 +573,11 @@ def test_fused_resnet_under_data_parallel_mesh():
     ParallelWrapper(net, mesh=make_mesh({"data": 8}),
                     prefetch_buffer=0).fit(x, y, epochs=1, batch_size=16)
     assert np.isfinite(net.score_)
+
+
+def test_max_decode_requires_rope():
+    from deeplearning4j_tpu.zoo.transformer import TextGenerationTransformer
+
+    with pytest.raises(ValueError, match="rope"):
+        TextGenerationTransformer(num_classes=9, input_shape=(8, 1),
+                                  max_decode=32)
