@@ -370,12 +370,14 @@ class CrossAttentionVertex(GraphVertex):
                 raise ValueError(
                     f"mask time axis {mask.shape[1]} matches neither the "
                     f"query length {Tq} nor the context length {Tk}")
-        from deeplearning4j_tpu.ops.attention import flash_eligible
+        from deeplearning4j_tpu.ops.kernel_defaults import attention_policy
 
-        if key_mask is None and flash_eligible(Tq, Tk):
+        pol = attention_policy(Tq, Tk, train=train)
+        if key_mask is None and pol.kind == "flash":
             from deeplearning4j_tpu.ops.attention import flash_attention
 
-            o = flash_attention(q, k, v, False)
+            o = flash_attention(q, k, v, False, None, pol.block_q,
+                                pol.block_k, False, pol.backward)
         else:
             s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(Dh)
             if key_mask is not None:

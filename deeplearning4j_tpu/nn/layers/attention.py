@@ -156,9 +156,6 @@ class MultiHeadAttention(Layer):
             positions = jnp.arange(T)
             q = rope_rotate(q, positions)
             k = rope_rotate(k, positions)
-        from deeplearning4j_tpu.ops.attention import (
-            flash_eligible as _flash_eligible,
-        )
         from deeplearning4j_tpu.parallel.ring_attention import (
             current_sequence_mesh,
         )
@@ -195,17 +192,25 @@ class MultiHeadAttention(Layer):
             # materialize inside the flash kernel).
             o = self._masked_attention(q, k, v, mask, self.causal,
                                        dropout=drop, rng=rng)
-        elif _flash_eligible(T):
-            # Fused blockwise kernel (ops/attention.py) for inference AND
-            # training: the backward is the blockwise Pallas rematerializing
-            # pass, so the [T, T] score matrix never materializes either
-            # way. Eligibility (backend/tile/length) is the shared
-            # heuristic in ops.attention.flash_eligible.
-            from deeplearning4j_tpu.ops.attention import flash_attention
-
-            o = flash_attention(q, k, v, self.causal)
         else:
-            o = attention(q, k, v, causal=self.causal)
+            # Flash-vs-dense, tile config, and backward selection all come
+            # from the measured-winner policy (ops/kernel_defaults.py) —
+            # the kernel must have a recorded hardware row beating XLA
+            # dense at this mode/length, or dense memory pressure must
+            # make the O(T) path mandatory. Env hatches: DL4J_TPU_ATTN*.
+            from deeplearning4j_tpu.ops.kernel_defaults import (
+                attention_policy,
+            )
+
+            pol = attention_policy(T, train=train)
+            if pol.kind == "flash":
+                from deeplearning4j_tpu.ops.attention import flash_attention
+
+                o = flash_attention(q, k, v, self.causal, None,
+                                    pol.block_q, pol.block_k, False,
+                                    pol.backward)
+            else:
+                o = attention(q, k, v, causal=self.causal)
         o = o.reshape(B, T, self.n_out)
         y = o @ params["Wo"] + params["b"]
         return self._act(y), state

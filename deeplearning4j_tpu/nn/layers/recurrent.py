@@ -106,7 +106,10 @@ class LSTM(BaseRecurrentLayer):
                     f"activation='tanh'; got {self.gate_activation!r}/"
                     f"{self.activation!r}")
             return self.fused
-        return ok and jax.default_backend() == "tpu"
+        from deeplearning4j_tpu.ops.kernel_defaults import lstm_policy
+
+        return (ok and jax.default_backend() == "tpu"
+                and lstm_policy() == "fused")
 
     def _step(self, params, carry, xw_t, m_t):
         """One scan step. xw_t: precomputed x_t @ W + b, [B, 4H]."""

@@ -13,7 +13,7 @@ in VMEM scratch across the innermost K grid dimension, so neither the
 once. Causal masking skips dead K blocks' FLOPs via block-index
 comparison.
 
-Backward (FlashAttention-2 style, `backward="pallas"`, the default): the
+Backward (FlashAttention-2 style, `backward="pallas"`): the
 forward rule additionally saves the per-row log-sum-exp L = m + log(l)
 (O(T) residual memory — q/k/v/o/L, never the [T, T] scores). Two Pallas
 kernels then rematerialize score tiles blockwise: a dK/dV kernel with the
@@ -21,7 +21,9 @@ K/V tile pinned in VMEM scratch while sweeping Q blocks, and a dQ kernel
 with the Q tile pinned while sweeping K blocks, using the softmax-vjp
 identity ds = p * (dp - Δ) with Δ = rowsum(do · o) precomputed by XLA.
 `backward="dense"` keeps the previous whole-[T, T] XLA recompute as a
-fallback/oracle path.
+fallback/oracle path. The default (`backward=None`) resolves from the
+measured-winner table in `ops/kernel_defaults.py` — see that module for
+the dispatch policy and its env escape hatches.
 """
 
 from __future__ import annotations
@@ -120,8 +122,9 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *rest, causal: bool,
 
 def _fit_block(block: int, t: int) -> int:
     """Largest block <= requested that divides t (t must be a multiple of
-    the 128-lane minimum; measured on v5e, bigger blocks win decisively —
-    512^2 tiles run ~4x faster than 128^2, see tools/kernel_bench.py)."""
+    the 128-lane minimum). Block size is the decisive perf lever on TPU;
+    the production sizes come from the measured-winner table in
+    ops/kernel_defaults.py, populated by tools/kernel_bench.py."""
     block = min(block, t)
     while block > 128 and t % block:
         block -= 128
@@ -337,10 +340,13 @@ def _run_flash_bwd(q, k, v, o, lse, do, *, causal: bool, scale: float,
 
 
 def flash_eligible(tq: int, tk: Optional[int] = None) -> bool:
-    """Single source of truth for the flash-kernel dispatch heuristic:
-    TPU backend, 128-lane-tileable sequence lengths, and >= 512 (the
-    measured win region — tools/kernel_bench.py shows XLA dense is 2-5x
-    faster at narrower tiles)."""
+    """SHAPE eligibility for the flash kernel: TPU backend and
+    128-lane-tileable sequence lengths of at least 512 (below that the
+    kernel cannot amortize its block machinery). This answers "can flash
+    run here"; whether it SHOULD — the measured flash-vs-dense verdict,
+    block sizes, backward selection — is `kernel_defaults.attention_policy`.
+    Structural users that need flash's lse output regardless of speed
+    (ring attention's shard merge) gate on this alone."""
     tk = tq if tk is None else tk
     return (jax.default_backend() == "tpu" and tq % 128 == 0
             and tk % 128 == 0 and min(tq, tk) >= 512)
@@ -361,19 +367,34 @@ def _unfold3(x, shape):
     return x.reshape(b, h, t, d).transpose(0, 2, 1, 3)
 
 
+def _resolve_backward(backward: Optional[str], tq: int, tk: int) -> str:
+    """None -> the measured-winner default (kernel_defaults). Resolved
+    ONCE, in the forward rule; the backward rule keys off whether lse
+    was actually saved, so a mid-process env flip can never make the
+    two rules disagree."""
+    if backward is not None:
+        return backward
+    from deeplearning4j_tpu.ops.kernel_defaults import attention_backward
+
+    return attention_backward(tq, tk)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def flash_attention(q, k, v, causal: bool = False,
                     scale: Optional[float] = None, block_q: int = 512,
                     block_k: int = 512, interpret: bool = False,
-                    backward: str = "pallas"):
+                    backward: Optional[str] = None):
     """Fused attention. q/k/v: [B, T, H, D] or [BH, T, D]; returns same
     layout.
 
-    Residual memory is O(T) either way: the forward rule saves q/k/v/o and
-    the per-row log-sum-exp. `backward` selects how dq/dk/dv are produced:
-    "pallas" (default) rematerializes score tiles blockwise in two Pallas
-    kernels — the [T, T] matrix never exists; "dense" is the whole-matrix
-    XLA recompute kept as the oracle/fallback path."""
+    Residual memory of the forward is O(T) either way: the forward rule
+    saves q/k/v/o and the per-row log-sum-exp. `backward` selects how
+    dq/dk/dv are produced: "pallas" rematerializes score tiles blockwise
+    in two Pallas kernels — the [T, T] matrix never exists; "dense" is
+    the whole-matrix XLA recompute kept as the oracle/fallback path.
+    None (default) resolves to the measured winner via
+    `kernel_defaults.attention_backward` (env hatch:
+    DL4J_TPU_ATTN_BACKWARD)."""
     s = scale if scale is not None else q.shape[-1] ** -0.5
     q3, shape = _fold3(q)
     k3, _ = _fold3(k)
@@ -385,6 +406,7 @@ def flash_attention(q, k, v, causal: bool = False,
 
 def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
                backward):
+    backward = _resolve_backward(backward, q.shape[1], k.shape[1])
     s = scale if scale is not None else q.shape[-1] ** -0.5
     q3, shape_q = _fold3(q)
     k3, shape_k = _fold3(k)   # cross-attention: Tk may differ from Tq
@@ -399,6 +421,12 @@ def _flash_fwd(q, k, v, causal, scale, block_q, block_k, interpret,
 def _flash_bwd(causal, scale, block_q, block_k, interpret, backward, res,
                do):
     q3, k3, v3, o3, lse, shape_q, shape_k = res
+    if backward is None:
+        # Follow the forward rule's resolved choice (visible as whether
+        # it saved the lse residual) rather than re-consulting the env —
+        # re-resolving could pick "pallas" with lse=None after a
+        # mid-process DL4J_TPU_ATTN_BACKWARD flip.
+        backward = "pallas" if lse is not None else "dense"
     s = scale if scale is not None else q3.shape[-1] ** -0.5
     do3, _ = _fold3(do)
     if backward == "pallas":

@@ -277,7 +277,8 @@ class TestFlashPallasBackward:
         with jax.default_matmul_precision("highest"):
             def flash(q, k, v):
                 return jnp.vdot(
-                    flash_attention(q, k, v, True, None, 8, 8, True), do)
+                    flash_attention(q, k, v, True, None, 8, 8, True,
+                                    "pallas"), do)
 
             def ref(q, k, v):
                 fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
@@ -298,7 +299,7 @@ class TestFlashPallasBackward:
                    for s in (11, 12, 13))
 
         def loss(q, k, v):
-            o = flash_attention(q, k, v, True, None, 8, 8, True)
+            o = flash_attention(q, k, v, True, None, 8, 8, True, "pallas")
             return jnp.sum(o.astype(jnp.float32) ** 2)
 
         def ref(q, k, v):
@@ -329,7 +330,8 @@ class TestFlashPallasBackward:
             np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
                                        rtol=1e-4, atol=1e-5)
             gf = jax.grad(lambda q, k, v: jnp.vdot(
-                flash_attention(q, k, v, False, None, 8, 8, True), do),
+                flash_attention(q, k, v, False, None, 8, 8, True,
+                                "pallas"), do),
                 argnums=(0, 1, 2))(q, k, v)
             gr = jax.grad(lambda q, k, v: jnp.vdot(
                 _dense_attention(q, k, v, False, d ** -0.5), do),
@@ -349,7 +351,8 @@ class TestFlashPallasBackward:
         bh, t, d = 1, 64, 8
         q, k, v = (self._rand((bh, t, d), s) for s in (14, 15, 16))
         _, vjp = jax.vjp(
-            lambda q, k, v: flash_attention(q, k, v, True, None, 8, 8, True),
+            lambda q, k, v: flash_attention(q, k, v, True, None, 8, 8,
+                                            True, "pallas"),
             q, k, v)
         leaves = jax.tree_util.tree_leaves(vjp)
         total = sum(x.size for x in leaves if hasattr(x, "size"))

@@ -77,9 +77,8 @@ def _loop(body, x0):
 
 
 # ------------------------------------------------------------- attention
-def bench_attention(t, train, flash, causal=True, block_q=512, block_k=512):
-    # default blocks track the shipped kernel default (ops/attention.py)
-    # so the unsuffixed attn_* rows measure the production configuration
+def bench_attention(t, train, flash, causal=True, block_q=512, block_k=512,
+                    backward="pallas"):
     from deeplearning4j_tpu.ops.attention import (_dense_attention,
                                                   flash_attention)
     bh, d = 32, 64  # [BH, T, D] layout: no head transposes in either path
@@ -91,7 +90,8 @@ def bench_attention(t, train, flash, causal=True, block_q=512, block_k=512):
 
     if flash:
         attn = lambda q, k, v: flash_attention(q, k, v, causal, None,
-                                               block_q, block_k)
+                                               block_q, block_k, False,
+                                               backward)
     else:
         attn = lambda q, k, v: _dense_attention(q, k, v, causal, d ** -0.5)
 
@@ -119,15 +119,23 @@ def bench_attention(t, train, flash, causal=True, block_q=512, block_k=512):
     factor = 0.5 if causal else 1.0
     fwd_flops = 4 * bh * t * t * d * factor
     flops = fwd_flops * (3.5 if train else 1.0)
-    blk = (f"_bq{block_q}_bk{block_k}"
-           if (block_q, block_k) != (512, 512) else "")
-    return {
+    # Flash rows carry their full config both in the name (rows never
+    # collide across configs) and as explicit fields (the defaults
+    # updater reads fields, not name parsing, for new rows).
+    blk = f"_bq{block_q}_bk{block_k}" if flash else ""
+    bwd = "_bwddense" if (flash and train and backward == "dense") else ""
+    r = {
         "name": f"attn_t{t}_{'train' if train else 'fwd'}_"
-                f"{'flash' if flash else 'dense'}{blk}",
+                f"{'flash' if flash else 'dense'}{blk}{bwd}",
         "per_iter_ms": round(per_iter * 1e3, 3),
         "tflops_per_s": round(flops / per_iter / 1e12, 2),
         "shape": f"bh{bh} t{t} d{d} causal={causal} bf16",
     }
+    if flash:
+        r.update(block_q=block_q, block_k=block_k)
+        if train:
+            r["backward"] = backward
+    return r
 
 
 # ------------------------------------------------------------------ lstm
@@ -192,6 +200,12 @@ def main():
             for flash in (False, True):
                 jobs.append(("attn", functools.partial(bench_attention, t,
                                                        train, flash)))
+            if train:
+                # backward ablation at the 512^2 production tiles: the
+                # Pallas blockwise bwd vs the dense XLA recompute bwd
+                jobs.append(("attn", functools.partial(
+                    bench_attention, t, True, True, True, 512, 512,
+                    "dense")))
     for bq, bk in ((128, 128), (256, 256), (512, 256), (256, 512),
                    (128, 512)):
         jobs.append(("sweep", functools.partial(

@@ -1,0 +1,17 @@
+#!/bin/bash
+# Probe loop: checks whether the axon TPU tunnel serves. Exits 0 the moment
+# a TPU device is visible; exits 1 after ~9.5 minutes of failed probes so the
+# caller can re-arm. Each probe is a fresh python (the tunnel hang is
+# per-process) killed at 75 s.
+deadline=$((SECONDS + 570))
+while [ $SECONDS -lt $deadline ]; do
+  out=$(timeout 75 python -c "import jax; print(jax.devices()[0].platform)" 2>/dev/null)
+  if [ "$out" = "tpu" ]; then
+    echo "TPU_UP $(date -u +%H:%M:%S)"
+    exit 0
+  fi
+  echo "probe: down ($(date -u +%H:%M:%S))"
+  sleep 45
+done
+echo "TPU_DOWN after window"
+exit 1

@@ -1,0 +1,123 @@
+"""Regenerate the MEASURED table in ops/kernel_defaults.py from
+tools/kernel_bench_results.json.
+
+Run after every kernel-bench session on real hardware:
+
+    python tools/kernel_bench.py          # writes kernel_bench_results.json
+    python tools/update_kernel_defaults.py
+
+The suite guard (tests/test_kernel_defaults.py) fails if the embedded
+table drifts from the results file, so a kernel default can never ship
+without a recorded measurement backing it.
+
+Row-name grammar (kernel_bench.py):
+    attn_t{T}_{fwd|train}_{flash|dense}[_bq{B}_bk{B}][_bwddense]
+    lstm_{fwd|train}_{fused|scan}
+Legacy flash rows without a block suffix or explicit fields were measured
+at the then-default 128x128 tiles with the pre-Pallas (dense-recompute)
+backward; they are read as such.
+"""
+import json
+import os
+import pprint
+import re
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+RESULTS = os.path.join(HERE, "kernel_bench_results.json")
+TARGET = os.path.join(REPO, "deeplearning4j_tpu", "ops",
+                      "kernel_defaults.py")
+BEGIN = "# --- BEGIN GENERATED (tools/update_kernel_defaults.py) ---"
+END = "# --- END GENERATED ---"
+
+_ATTN = re.compile(
+    r"^attn_t(?P<t>\d+)_(?P<mode>fwd|train)_(?P<kind>flash|dense)"
+    r"(?:_bq(?P<bq>\d+)_bk(?P<bk>\d+))?(?P<bwd>_bwddense)?$")
+_LSTM = re.compile(r"^lstm_(?P<mode>fwd|train)_(?P<kind>fused|scan)$")
+
+
+def build_table(rows: dict) -> dict:
+    attn = {}   # mode -> T -> {dense_ms, flash candidates}
+    lstm = {}   # mode -> {fused_ms, scan_ms}
+    devices = set()
+    for name, row in rows.items():
+        if "error" in row or "per_iter_ms" not in row:
+            continue
+        devices.add(row.get("device", "?"))
+        m = _ATTN.match(name)
+        if m:
+            t = int(m.group("t"))
+            slot = attn.setdefault(m.group("mode"), {}).setdefault(
+                t, {"dense_ms": None, "flash": []})
+            if m.group("kind") == "dense":
+                slot["dense_ms"] = row["per_iter_ms"]
+            else:
+                bq = row.get("block_q") or (
+                    int(m.group("bq")) if m.group("bq") else 128)
+                bk = row.get("block_k") or (
+                    int(m.group("bk")) if m.group("bk") else 128)
+                bwd = row.get("backward") or (
+                    "dense" if (m.group("bwd")
+                                or m.group("mode") == "train") else "n/a")
+                slot["flash"].append(
+                    {"ms": row["per_iter_ms"], "block_q": bq,
+                     "block_k": bk, "backward": bwd})
+            continue
+        m = _LSTM.match(name)
+        if m:
+            lstm.setdefault(m.group("mode"), {})[
+                m.group("kind") + "_ms"] = row["per_iter_ms"]
+
+    out_attn = {}
+    for mode, by_t in attn.items():
+        for t, slot in sorted(by_t.items()):
+            if slot["dense_ms"] is None or not slot["flash"]:
+                continue   # verdict needs both contenders
+            best = min(slot["flash"], key=lambda f: f["ms"])
+            out_attn.setdefault(mode, {})[t] = {
+                "dense_ms": slot["dense_ms"],
+                "flash_ms": best["ms"],
+                "block_q": best["block_q"],
+                "block_k": best["block_k"],
+                "backward": best["backward"],
+                "winner": ("flash" if best["ms"] < slot["dense_ms"]
+                           else "dense"),
+            }
+    out_lstm = {}
+    for mode, d in lstm.items():
+        if "fused_ms" in d and "scan_ms" in d:
+            out_lstm[mode] = {
+                "fused_ms": d["fused_ms"], "scan_ms": d["scan_ms"],
+                "winner": ("fused" if d["fused_ms"] < d["scan_ms"]
+                           else "scan"),
+            }
+    return {"attention": out_attn, "lstm": out_lstm,
+            "devices": sorted(devices)}
+
+
+def main():
+    with open(RESULTS) as fh:
+        rows = json.load(fh)
+    table = build_table(rows)
+    body = "MEASURED: dict = " + pprint.pformat(table, width=72,
+                                                sort_dicts=True)
+    with open(TARGET) as fh:
+        src = fh.read()
+    pre, rest = src.split(BEGIN)
+    _, post = rest.split(END)
+    new = pre + BEGIN + "\n" + body + "\n" + END + post
+    if new != src:
+        with open(TARGET, "w") as fh:
+            fh.write(new)
+        print(f"updated {TARGET}")
+    else:
+        print("no change")
+    print(json.dumps({"attention_modes": {
+        m: {t: v["winner"] for t, v in by_t.items()}
+        for m, by_t in table["attention"].items()},
+        "lstm": {m: v["winner"] for m, v in table["lstm"].items()}}))
+
+
+if __name__ == "__main__":
+    main()
