@@ -142,10 +142,10 @@ def _blocks_from_env() -> Optional[tuple]:
 
 
 def _shape_eligible(tq: int, tk: int) -> bool:
-    import jax
+    # one canonical predicate for "can flash run here" — ops.attention
+    from deeplearning4j_tpu.ops.attention import flash_eligible
 
-    return (jax.default_backend() == "tpu" and tq % 128 == 0
-            and tk % 128 == 0 and min(tq, tk) >= 128)
+    return flash_eligible(tq, tk)
 
 
 def attention_backward(tq: int, tk: Optional[int] = None) -> str:
@@ -220,12 +220,16 @@ def attention_policy(tq: int, tk: Optional[int] = None,
 
 
 def _best_measured_flash(mode: str, t: int) -> Optional[dict]:
+    """Tile config worth adopting: only a WINNING flash row — a losing
+    row's blocks are the measured-worst configuration (128^2 runs 2-5x
+    behind dense), exactly what the memory-necessity path must not
+    inherit. No winning row -> caller falls back to the 512^2 default."""
     table = MEASURED.get("attention", {}).get(mode, {})
     mt = _nearest_measured(table, t)
     if mt is None:
         return None
     row = table[mt]
-    return row if row.get("block_q") else None
+    return row if (row.get("block_q") and row["winner"] == "flash") else None
 
 
 def lstm_policy(train: bool = True) -> str:

@@ -57,13 +57,25 @@ class ParallelInference(SeqCtxJitCache):
         x = np.asarray(x)
         if self.mode == InferenceMode.INPLACE:
             return self._run(x)
+        if self._stop.is_set():
+            raise RuntimeError("ParallelInference is shut down")
         fut: Future = Future()
         # Capture the caller's contextvars (e.g. an active
         # sequence_parallel context): the collector thread starts from an
         # empty Context, so tracing there would silently drop the swap.
+        # The seq context itself is ALSO captured as the batching key —
+        # the collector must never coalesce requests from different
+        # contexts into one batch (the trace runs under the first
+        # arrival's context, and another context's mesh can have
+        # incompatible sharding-divisibility constraints).
         import contextvars
 
-        self._queue.put((x, fut, contextvars.copy_context()))
+        from deeplearning4j_tpu.parallel.ring_attention import (
+            current_sequence_mesh,
+        )
+
+        self._queue.put((x, fut, contextvars.copy_context(),
+                         current_sequence_mesh()))
         return fut.result()
 
     def shutdown(self):
@@ -111,15 +123,26 @@ class ParallelInference(SeqCtxJitCache):
 
     def _collector(self):
         """Coalesce concurrent requests into one device batch.
-        Reference: BatchedInferenceObservable + ObservablesProvider."""
+        Reference: BatchedInferenceObservable + ObservablesProvider.
+
+        Requests are grouped by their captured sequence_parallel context:
+        a batch only ever contains requests that share one context, so
+        the single trace (run under that context) is correct for every
+        member. A request from a different context ends the current
+        batch and seeds the next one."""
+        held = None
         while not self._stop.is_set():
-            try:
-                item = self._queue.get(timeout=0.1)
-            except queue.Empty:
-                continue
+            if held is not None:
+                item, held = held, None
+            else:
+                try:
+                    item = self._queue.get(timeout=0.1)
+                except queue.Empty:
+                    continue
             if item is None:
                 break
             batch = [item]
+            seq_key = item[3]
             total = item[0].shape[0]
             deadline = self.max_wait
             import time
@@ -135,19 +158,36 @@ class ParallelInference(SeqCtxJitCache):
                 if nxt is None:
                     self._stop.set()
                     break
+                if nxt[3] != seq_key:
+                    held = nxt       # different context: next batch's seed
+                    break
                 batch.append(nxt)
                 total += nxt[0].shape[0]
             xs = np.concatenate([b[0] for b in batch], axis=0)
             try:
-                # Run under the FIRST request's captured context; a batch
-                # coalescing requests from different sequence_parallel
-                # contexts is driven by whoever arrived first.
                 ys = batch[0][2].run(self._run, xs)
                 off = 0
-                for x, fut, _ in batch:
+                for x, fut, _ctx, _key in batch:
                     fut.set_result(ys[off:off + x.shape[0]])
                     off += x.shape[0]
             except BaseException as e:
-                for _, fut, _ctx in batch:
+                for _x, fut, _ctx, _key in batch:
                     if not fut.done():
                         fut.set_exception(e)
+        # Drain on exit: a parked next-batch seed (`held`) or requests
+        # still queued at shutdown must fail loudly — a silently dropped
+        # Future would block its caller in fut.result() forever.
+        leftovers = [held] if held is not None else []
+        while True:
+            try:
+                leftovers.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        for item in leftovers:
+            if item is None:
+                continue
+            fut = item[1]
+            if not fut.done():
+                fut.set_exception(RuntimeError(
+                    "ParallelInference shut down before serving this "
+                    "request"))

@@ -18,14 +18,54 @@ from typing import Optional
 import numpy as np
 
 
-def _input_encoding(net) -> str:
+def _resolve_net(net):
+    """(first_layer, vocab) for a MultiLayerNetwork or a single-input /
+    single-output ComputationGraph (the two shapes `rnn_time_step` can
+    drive one autoregressive stream through)."""
+    if hasattr(net, "layers"):            # MultiLayerNetwork
+        return net.layers[0], net.layers[-1].n_out
+    conf = getattr(net, "conf", None)
+    if conf is None or not hasattr(conf, "network_inputs"):
+        raise TypeError(
+            f"generate() needs a MultiLayerNetwork or ComputationGraph, "
+            f"got {type(net).__name__}")
+    if len(conf.network_inputs) != 1 or len(conf.network_outputs) != 1:
+        raise ValueError(
+            "generate() drives one autoregressive stream: the graph must "
+            "have exactly one network input and one output (got "
+            f"{list(conf.network_inputs)} -> "
+            f"{list(conf.network_outputs)}); drive multi-IO graphs "
+            "through rnn_time_step directly")
+    # first layer = first layer-bearing vertex downstream of the input
+    frontier = {conf.network_inputs[0]}
+    first = None
+    for name in conf.topological_order:
+        if frontier & set(conf.vertex_inputs.get(name, ())):
+            lyr = getattr(conf.vertices[name], "layer", None)
+            if lyr is not None:
+                first = lyr
+                break
+            frontier.add(name)           # pass-through vertex: keep walking
+    if first is None:
+        raise ValueError("no layer vertex found downstream of the "
+                         "network input")
+    out_v = conf.vertices[conf.network_outputs[0]]
+    vocab = getattr(getattr(out_v, "layer", None) or out_v, "n_out", None)
+    if vocab is None:
+        raise ValueError(
+            f"output vertex {conf.network_outputs[0]!r} has no n_out; "
+            "generate() needs a per-timestep classification head")
+    return first, vocab
+
+
+def _input_encoding(first_layer) -> str:
     """'ids' for embedding-fronted stacks ([B, T, 1] token ids), 'onehot'
     for vocab-width inputs ([B, T, V])."""
     from deeplearning4j_tpu.nn.layers.feedforward import (
         EmbeddingSequenceLayer,
     )
 
-    return ("ids" if isinstance(net.layers[0], EmbeddingSequenceLayer)
+    return ("ids" if isinstance(first_layer, EmbeddingSequenceLayer)
             else "onehot")
 
 
@@ -49,8 +89,8 @@ def generate(net, prompt_ids, n_tokens: int, *, temperature: float = 1.0,
     if prompt_ids.ndim == 1:
         prompt_ids = prompt_ids[None, :]
     B = prompt_ids.shape[0]
-    vocab = net.layers[-1].n_out
-    encoding = _input_encoding(net)
+    first_layer, vocab = _resolve_net(net)
+    encoding = _input_encoding(first_layer)
     if rng is None:
         rng = np.random.default_rng(0)
 

@@ -418,3 +418,38 @@ class TestParallelInference:
                 direct, np.concatenate(outs, axis=0), rtol=1e-5)
         finally:
             pi.shutdown()
+
+    def test_batched_inference_groups_by_context(self, devices8):
+        """Coalescing must never mix requests from different
+        sequence_parallel contexts into one batch (ADVICE r4): the whole
+        batch is traced under the first arrival's context, and another
+        context's mesh can impose incompatible sharding divisibility.
+        Observable: each context gets its own trace-cache partition."""
+        from deeplearning4j_tpu.parallel.ring_attention import (
+            sequence_parallel,
+        )
+        net = _net()
+        x, _ = _toy(n=8)
+        direct = np.asarray(net.output(x))
+        pi = ParallelInference(net, mesh=make_mesh({"data": 8}),
+                               max_batch_size=64, max_wait_ms=300)
+        seq_mesh = make_mesh({"seq": 8})
+        try:
+            import concurrent.futures as cf
+
+            def in_ctx():
+                with sequence_parallel(seq_mesh):
+                    return pi.output(x)
+
+            with cf.ThreadPoolExecutor(4) as ex:
+                futs = [ex.submit(in_ctx), ex.submit(pi.output, x),
+                        ex.submit(in_ctx), ex.submit(pi.output, x)]
+                outs = [f.result(timeout=120) for f in futs]
+            for o in outs:
+                np.testing.assert_allclose(o, direct, rtol=1e-5)
+            keys = set(pi._jit_caches)
+            assert len(keys) == 2 and None in keys, (
+                f"expected separate trace partitions per context, got "
+                f"{keys}")
+        finally:
+            pi.shutdown()

@@ -133,6 +133,59 @@ def test_cg_transformer_incremental_decode():
     stepped = np.concatenate(outs, axis=1)
     np.testing.assert_allclose(stepped, full, rtol=1e-4, atol=1e-5)
 
+    # generate() drives ComputationGraph models too (ADVICE r4): the
+    # embedding-fronted graph is detected as id-encoded via its input
+    # vertex chain, and greedy decode matches the full-forward rollout
+    from deeplearning4j_tpu.utils.textgen import generate
+
+    net.rnn_clear_previous_state()
+    prompt = rng.integers(0, V, (2, 3))
+    got = generate(net, prompt, 4, greedy=True)
+    seq = prompt.copy()
+    want = []
+    for _ in range(4):
+        cur = seq.shape[1]
+        padded = np.zeros((2, T), seq.dtype)
+        padded[:, :cur] = seq
+        probs = np.asarray(net.output(padded[..., None].astype(np.float32)))
+        tok = probs[:, cur - 1, :].argmax(-1)
+        want.append(tok)
+        seq = np.concatenate([seq, tok[:, None]], axis=1)
+    np.testing.assert_array_equal(got, np.stack(want, axis=1))
+
+
+def test_generate_refuses_multi_io_graph():
+    """Multi-input graphs have no single autoregressive stream for
+    generate() to drive; the error must say so (not AttributeError)."""
+    from deeplearning4j_tpu.models import ComputationGraph
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.inputs import InputType
+    from deeplearning4j_tpu.nn.layers.feedforward import (
+        EmbeddingSequenceLayer,
+    )
+    from deeplearning4j_tpu.nn.layers.recurrent import RnnOutputLayer
+    from deeplearning4j_tpu.optim.updaters import Adam
+    from deeplearning4j_tpu.utils.textgen import generate
+
+    V, T = 7, 6
+    conf = (NeuralNetConfiguration.builder()
+            .seed(0).updater(Adam(1e-3)).activation("identity")
+            .graph_builder()
+            .add_inputs("a", "b")
+            .add_layer("emb", EmbeddingSequenceLayer(n_in=V, n_out=8), "a")
+            .add_layer("emb2", EmbeddingSequenceLayer(n_in=V, n_out=8), "b")
+            .add_layer("out", RnnOutputLayer(n_out=V, activation="softmax"),
+                       "emb")
+            .add_layer("out2", RnnOutputLayer(n_out=V, activation="softmax"),
+                       "emb2")
+            .set_outputs("out", "out2")
+            .set_input_types(InputType.recurrent(1, T),
+                             InputType.recurrent(1, T))
+            .build())
+    net = ComputationGraph(conf).init()
+    with pytest.raises(ValueError, match="exactly one network input"):
+        generate(net, np.zeros((1, 2), np.int64), 2)
+
 
 class TestRoPE:
     def test_scores_depend_only_on_relative_distance(self):
