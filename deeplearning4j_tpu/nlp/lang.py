@@ -152,15 +152,17 @@ ZH_COMMON = (
     "我们 你们 他们 她们 这个 那个 什么 没有 知道 现在 时候 自己 大家 因为 "
     "所以 但是 可以 已经 还是 如果 虽然 时间 问题 工作 学习 学生 老师 朋友 "
     "中国 北京 研究 生命 科学 技术 经济 发展 社会 世界 国家 政府 人民 "
-    "今天 明天 昨天 东西 地方 事情 开始 结束 喜欢 觉得 认为 希望 需要"
+    "今天 明天 昨天 东西 地方 事情 开始 结束 喜欢 觉得 认为 希望 需要 "
+    "音乐 电影"
 ).split()
 
 JA_COMMON = (
     "の は が を に で と も か ら な だ です ます した する いる ある なる "
     "これ それ あれ この その あの ここ そこ どこ わたし あなた かれ かのじょ "
     "こと もの とき ひと 私 僕 彼 彼女 日本 東京 学生 先生 学校 会社 仕事 "
-    "時間 今日 明日 昨日 今 年 月 日 人 何 言葉 勉強 研究 世界 国 家族 友達 "
-    "ありがとう こんにちは さようなら ください から まで より など について"
+    "時間 今日 明日 昨日 毎日 今 年 月 日 人 何 言葉 勉強 研究 世界 国 家族 "
+    "友達 ありがとう こんにちは さようなら ください から まで より など "
+    "について"
 ).split()
 
 
@@ -327,7 +329,8 @@ for _w, _r in (("日本", "ニホン"), ("東京", "トウキョウ"),
                ("学生", "ガクセイ"), ("先生", "センセイ"),
                ("学校", "ガッコウ"), ("会社", "カイシャ"),
                ("仕事", "シゴト"), ("時間", "ジカン"), ("今日", "キョウ"),
-               ("明日", "アシタ"), ("昨日", "キノウ"), ("今", "イマ"),
+               ("明日", "アシタ"), ("昨日", "キノウ"), ("毎日", "マイニチ"),
+               ("今", "イマ"),
                ("年", "トシ"), ("月", "ツキ"), ("日", "ヒ"), ("人", "ヒト"),
                ("何", "ナニ"), ("言葉", "コトバ"), ("勉強", "ベンキョウ"),
                ("研究", "ケンキュウ"), ("世界", "セカイ"), ("国", "クニ"),
@@ -554,9 +557,29 @@ class KoreanMorphologicalAnalyzer:
                 out.append(word[:i] + stem_ch + past + word[i + 1:])
         return out
 
+    # batchim-contracted eomi: the ending's initial consonant fuses into
+    # the stem's final open syllable as a jongseong — 배우+ㄴ다→배운다,
+    # 일하+ㅂ니다→일합니다. Decompose arithmetically like the past
+    # contraction: (jongseong index, compatibility-jamo ending prefix).
+    _BATCHIM_EOMI = ((4, "ㄴ"), (17, "ㅂ"))   # ㄴ(는다 row), ㅂ(니다 row)
+
+    @classmethod
+    def _expand_batchim(cls, word: str) -> List[str]:
+        out: List[str] = []
+        for i, ch in enumerate(word):
+            d = _hangul_decompose(ch)
+            if d is None:
+                continue
+            ini, vow, fin = d
+            for jong, jamo in cls._BATCHIM_EOMI:
+                if fin == jong:
+                    out.append(word[:i] + _hangul_compose(ini, vow, 0)
+                               + jamo + word[i + 1:])
+        return out
+
     def _try_stem(self, w: str):
         """Match stem + eomi (after de-contraction); None if not verbal."""
-        for cand in (w, *self._expand_past(w)):
+        for cand in (w, *self._expand_past(w), *self._expand_batchim(w)):
             for eomi in _KO_EOMI_BY_LEN:
                 if not cand.endswith(eomi) or len(cand) <= len(eomi):
                     continue
@@ -594,6 +617,12 @@ class KoreanMorphologicalAnalyzer:
         verbal = self._try_stem(w)
         if verbal is not None:
             return verbal
+        # closed-class exact matches BEFORE the josa split: 같이 is the
+        # adverb, not 같+이 (noun+josa)
+        if w in KO_PRONOUNS:
+            return [KoMorpheme(w, "Pronoun")]
+        if w in KO_ADVERBS:
+            return [KoMorpheme(w, "Adverb")]
         stem, josa = self._split_josa(w)
         if josa is not None:
             morphs = (self._try_stem(stem)
@@ -603,10 +632,6 @@ class KoreanMorphologicalAnalyzer:
                 pos = "Pronoun" if stem in KO_PRONOUNS else "Noun"
                 morphs = [KoMorpheme(stem, pos)]
             return morphs + [KoMorpheme(josa, "Josa")]
-        if w in KO_PRONOUNS:
-            return [KoMorpheme(w, "Pronoun")]
-        if w in KO_ADVERBS:
-            return [KoMorpheme(w, "Adverb")]
         return [KoMorpheme(w, "Noun")]
 
 
@@ -639,13 +664,14 @@ class KoreanMorphologicalTokenizerFactory(TokenizerFactory):
 # m/q/p/c/u/w/en). Same tag alphabet here over the lattice segmentation.
 
 _ZH_POS: dict = {}
-for _w in "的 了 着 过 之 地 得".split():
-    _ZH_POS[_w] = "u"        # particle
+for _w in "的 了 着 过 之 地 得 吗 呢 吧 啊".split():
+    _ZH_POS[_w] = "u"        # particle (incl. sentence-final 吗/呢/吧/啊)
 for _w in ("我 你 他 她 它 我们 你们 他们 她们 自己 大家 这 那 这个 那个 "
            "什么 谁").split():
     _ZH_POS[_w] = "r"        # pronoun
 for _w in ("是 有 来 到 说 去 会 要 知道 喜欢 觉得 认为 希望 需要 学习 "
-           "工作 研究 发展 开始 结束 出 可以 没有").split():
+           "工作 研究 发展 开始 结束 出 可以 没有 听 看 想 走 吃 喝 写 "
+           "买 卖 读 用").split():
     _ZH_POS[_w] = "v"        # verb
 for _w in "大 小 好 新 高 美 多 少 长 短 快 慢".split():
     _ZH_POS[_w] = "a"        # adjective
@@ -655,8 +681,8 @@ for _w in "在 从 对 为 把 被 向 于 给".split():
     _ZH_POS[_w] = "p"        # preposition
 for _w in "和 与 或 但是 因为 所以 如果 虽然 而且".split():
     _ZH_POS[_w] = "c"        # conjunction
-for _w in "个 只 本 张 条 件 位 次 种".split():
-    _ZH_POS[_w] = "q"        # measure word
+for _w in "个 只 本 张 条 件 位 次 种 年 岁".split():
+    _ZH_POS[_w] = "q"        # measure word (incl. time-quantity 年/岁)
 for _w in "一 二 三 四 五 六 七 八 九 十 百 千 万 亿 两".split():
     _ZH_POS[_w] = "m"        # numeral
 

@@ -339,17 +339,20 @@ def _run_flash_bwd(q, k, v, o, lse, do, *, causal: bool, scale: float,
     return dq, dk, dv
 
 
-def flash_eligible(tq: int, tk: Optional[int] = None) -> bool:
+def flash_eligible(tq: int, tk: Optional[int] = None, *,
+                   min_t: int = 512) -> bool:
     """SHAPE eligibility for the flash kernel: TPU backend and
-    128-lane-tileable sequence lengths of at least 512 (below that the
-    kernel cannot amortize its block machinery). This answers "can flash
-    run here"; whether it SHOULD — the measured flash-vs-dense verdict,
-    block sizes, backward selection — is `kernel_defaults.attention_policy`.
-    Structural users that need flash's lse output regardless of speed
-    (ring attention's shard merge) gate on this alone."""
+    128-lane-tileable sequence lengths. `min_t` is a PERF floor, not a
+    capability one — the kernel runs from 128 up, but below ~512 it
+    cannot amortize its block machinery, so the default floor suits
+    structural users (ring attention's lse merge) that gate on this
+    alone. The measured flash-vs-dense verdict, block sizes, and
+    backward selection live in `kernel_defaults.attention_policy`,
+    which consults capability (min_t=128) for the memory-necessity
+    path."""
     tk = tq if tk is None else tk
     return (jax.default_backend() == "tpu" and tq % 128 == 0
-            and tk % 128 == 0 and min(tq, tk) >= 512)
+            and tk % 128 == 0 and min(tq, tk) >= min_t)
 
 
 def _fold3(x):

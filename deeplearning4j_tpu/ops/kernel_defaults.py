@@ -141,11 +141,13 @@ def _blocks_from_env() -> Optional[tuple]:
     return bq, bk
 
 
-def _shape_eligible(tq: int, tk: int) -> bool:
-    # one canonical predicate for "can flash run here" — ops.attention
+def _shape_eligible(tq: int, tk: int, *, min_t: int = 512) -> bool:
+    # one canonical predicate for "can flash run here" — ops.attention.
+    # min_t=128 is raw kernel capability (memory-necessity path); the
+    # default 512 is the perf floor for measured-verdict consults.
     from deeplearning4j_tpu.ops.attention import flash_eligible
 
-    return flash_eligible(tq, tk)
+    return flash_eligible(tq, tk, min_t=min_t)
 
 
 def attention_backward(tq: int, tk: Optional[int] = None) -> str:
@@ -178,7 +180,7 @@ def attention_policy(tq: int, tk: Optional[int] = None,
     tk = tq if tk is None else tk
     t = _t_eff(tq, tk)
     forced = _env("DL4J_TPU_ATTN")
-    eligible = _shape_eligible(tq, tk)
+    can_flash = _shape_eligible(tq, tk, min_t=128)   # kernel capability
     blocks = _blocks_from_env()
 
     def flash(bq, bk, reason):
@@ -193,17 +195,22 @@ def attention_policy(tq: int, tk: Optional[int] = None,
     if forced == "dense":
         return dense("forced by DL4J_TPU_ATTN=dense")
     if forced == "flash":
-        if not eligible:
+        if not can_flash:
             return dense("DL4J_TPU_ATTN=flash but shape ineligible "
                          f"(backend/tiling, tq={tq} tk={tk})")
         return flash(512, 512, "forced by DL4J_TPU_ATTN=flash")
-    if not eligible:
+    if not can_flash:
         return dense(f"shape ineligible (tq={tq}, tk={tk})")
     if _mem_hazard(tq, tk):
+        # capability floor (128), not the perf floor: a short-query
+        # cross-attention over a huge context must still avoid the
+        # [Tq, Tk] dense materialization
         row = _best_measured_flash("train" if train else "fwd", t)
         bq, bk = (row["block_q"], row["block_k"]) if row else (512, 512)
         return flash(bq, bk,
                      f"memory necessity: Tq*Tk >= {dense_max_t()}^2")
+    if not _shape_eligible(tq, tk):     # perf floor for measured consults
+        return dense(f"below flash perf floor (tq={tq}, tk={tk})")
     mode = "train" if train else "fwd"
     table = MEASURED.get("attention", {}).get(mode, {})
     mt = _nearest_measured(table, t)

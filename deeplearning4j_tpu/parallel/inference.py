@@ -76,6 +76,16 @@ class ParallelInference(SeqCtxJitCache):
 
         self._queue.put((x, fut, contextvars.copy_context(),
                          current_sequence_mesh()))
+        # Close the put-after-drain race: if shutdown landed between the
+        # check above and the put, the collector's exit drain may already
+        # have run and this item would hang forever. The collector's
+        # completions are done-guarded, so failing here is safe either way.
+        if self._stop.is_set() and not fut.done():
+            try:
+                fut.set_exception(RuntimeError(
+                    "ParallelInference is shut down"))
+            except Exception:
+                pass   # collector won the race and completed it
         return fut.result()
 
     def shutdown(self):
@@ -168,7 +178,8 @@ class ParallelInference(SeqCtxJitCache):
                 ys = batch[0][2].run(self._run, xs)
                 off = 0
                 for x, fut, _ctx, _key in batch:
-                    fut.set_result(ys[off:off + x.shape[0]])
+                    if not fut.done():   # output() may have failed it
+                        fut.set_result(ys[off:off + x.shape[0]])
                     off += x.shape[0]
             except BaseException as e:
                 for _x, fut, _ctx, _key in batch:

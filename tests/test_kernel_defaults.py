@@ -23,9 +23,11 @@ RESULTS = os.path.join(HERE, "..", "tools", "kernel_bench_results.json")
 
 def _tpu_shapes(monkeypatch):
     """Simulate the TPU shape gate so policy decisions are testable on
-    the CPU suite."""
-    monkeypatch.setattr(kd, "_shape_eligible",
-                        lambda tq, tk: tq % 128 == 0 and tk % 128 == 0)
+    the CPU suite (same tiling/floor logic, minus the backend check)."""
+    monkeypatch.setattr(
+        kd, "_shape_eligible",
+        lambda tq, tk, min_t=512: (tq % 128 == 0 and tk % 128 == 0
+                                   and min(tq, tk) >= min_t))
 
 
 def test_embedded_table_matches_results_file():
@@ -109,6 +111,12 @@ def test_memory_necessity_overrides_speed(monkeypatch):
     assert pol.kind == "flash"
     assert pol.backward == "pallas"
     assert kd.attention_backward(t // 4, t * 4) == "pallas"
+    # ...even when the query side is below the 512 perf floor — the
+    # kernel capability floor (128) governs the memory-necessity path
+    pol = kd.attention_policy(256, 2 * t * t // 256, train=True)
+    assert pol.kind == "flash", pol
+    # but below the perf floor WITHOUT memory pressure, dense wins
+    assert kd.attention_policy(256, 256, train=True).kind == "dense"
 
 
 def test_env_escape_hatches(monkeypatch):
