@@ -1,5 +1,9 @@
 """Graph-level conv+BN fusion pass.
 
+STATUS: FROZEN/EXPERIMENTAL (2026-07-31) — the fused kernels measured
+2x slower than XLA on the flagship (PERF_NOTES "DECISION"); this pass
+stays opt-in and gets no new feature work.
+
 Reference seam: DL4J points conv/BN layers at hand-fused cuDNN helpers
 chosen reflectively per layer (`ConvolutionLayer.java:67-77`); here the
 equivalent "use the fast kernel" decision is a MODEL TRANSFORM — any

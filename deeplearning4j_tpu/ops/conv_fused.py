@@ -1,5 +1,10 @@
 """Fused conv + batch-norm statistics (Pallas TPU) — the conv-epilogue
 fusion targeting the HBM-bound BN sweeps of ResNet-style bottlenecks.
+
+STATUS: FROZEN/EXPERIMENTAL (2026-07-31) — measured 2x SLOWER than XLA
+on the flagship (PERF_NOTES "DECISION"); kept opt-in for numerics and
+as the cuDNN-helper-seam analogue. No new feature work; prefer deletion
+over rework if a layer change would require touching the kernels.
 Two kernel shapes are fused: 1x1 any stride (`conv1x1_bn_act`, a matmul)
 and 3x3 stride-1 SAME (`conv3x3_bn_act`, nine shifted matmuls over a
 VMEM halo) — together they cover every conv+BN pair in a ResNet-50
