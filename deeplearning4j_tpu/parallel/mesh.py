@@ -79,3 +79,18 @@ def make_mesh(axes: Optional[Dict[str, int]] = None,
     shape = tuple(sizes[n] for n in names)
     arr = np.asarray(devices).reshape(shape)
     return Mesh(arr, names)
+
+
+def shard_map_compat(fn, mesh, in_specs, out_specs):
+    """One shard_map entry point across jax versions: new-API
+    `jax.shard_map` (check_vma) or the old experimental import
+    (check_rep). Every shard_map call site in the package routes
+    through here so an API change is a one-line fix."""
+    try:
+        from jax import shard_map
+        kw = {"check_vma": False}
+    except ImportError:                      # older jax
+        from jax.experimental.shard_map import shard_map
+        kw = {"check_rep": False}
+    return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                     out_specs=out_specs, **kw)

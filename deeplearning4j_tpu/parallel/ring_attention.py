@@ -240,12 +240,7 @@ def ring_self_attention(q, k, v, mesh: Mesh, *, axis: str = AXIS_SEQ,
     Default (None) = auto: on when running on TPU and the local sequence
     block is 128-lane tileable. `interpret=True` runs the kernel in
     interpret mode so the flash path is testable on a CPU mesh."""
-    try:
-        from jax import shard_map
-        kw = {"check_vma": False}
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map
-        kw = {"check_rep": False}
+    from deeplearning4j_tpu.parallel.mesh import shard_map_compat
 
     if use_flash is None:
         from deeplearning4j_tpu.ops.attention import flash_eligible
@@ -254,13 +249,9 @@ def ring_self_attention(q, k, v, mesh: Mesh, *, axis: str = AXIS_SEQ,
         use_flash = flash_eligible(t_local) and k.shape[1] == q.shape[1]
 
     spec = P(None, axis, None, None)
-    fn = shard_map(
+    fn = shard_map_compat(
         functools.partial(_ring_attention_local, axis_name=axis,
                           causal=causal, scale=scale, use_flash=use_flash,
                           interpret=interpret),
-        mesh=mesh,
-        in_specs=(spec, spec, spec),
-        out_specs=spec,
-        **kw,
-    )
+        mesh, (spec, spec, spec), spec)
     return fn(q, k, v)
