@@ -81,16 +81,19 @@ def make_mesh(axes: Optional[Dict[str, int]] = None,
     return Mesh(arr, names)
 
 
-def shard_map_compat(fn, mesh, in_specs, out_specs):
+def shard_map_compat(fn, mesh, in_specs, out_specs, *, check: bool = False):
     """One shard_map entry point across jax versions: new-API
     `jax.shard_map` (check_vma) or the old experimental import
     (check_rep). Every shard_map call site in the package routes
-    through here so an API change is a one-line fix."""
+    through here so an API change is a one-line fix. `check=True`
+    keeps jax's default replication/vma checking (pipeline's psum-
+    reduced outputs pass it); False disables it (ring attention's
+    merged partials do not)."""
     try:
         from jax import shard_map
-        kw = {"check_vma": False}
+        kw = {} if check else {"check_vma": False}
     except ImportError:                      # older jax
         from jax.experimental.shard_map import shard_map
-        kw = {"check_rep": False}
+        kw = {} if check else {"check_rep": False}
     return shard_map(fn, mesh=mesh, in_specs=in_specs,
                      out_specs=out_specs, **kw)

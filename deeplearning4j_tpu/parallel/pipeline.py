@@ -30,13 +30,10 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-try:
-    from jax import shard_map
-except ImportError:  # older jax keeps it in experimental
-    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from deeplearning4j_tpu.parallel.mesh import AXIS_DATA, AXIS_PIPE
+from deeplearning4j_tpu.parallel.mesh import (AXIS_DATA, AXIS_PIPE,
+                                              shard_map_compat)
 
 _tmap = jax.tree_util.tree_map
 
@@ -112,8 +109,8 @@ def make_pipeline_fn(stage_fn: Callable[[Any, jax.Array], jax.Array],
 
     in_x = P(None, data_axis) if data_axis else P()
     out_y = P(None, data_axis) if data_axis else P()
-    return shard_map(local_fn, mesh=mesh,
-                     in_specs=(P(axis), in_x), out_specs=out_y)
+    return shard_map_compat(local_fn, mesh, (P(axis), in_x), out_y,
+                            check=True)
 
 
 def make_pipeline_1f1b_fn(stage_fn: Callable[[Any, jax.Array], jax.Array],
@@ -251,10 +248,9 @@ def make_pipeline_1f1b_fn(stage_fn: Callable[[Any, jax.Array], jax.Array],
         gacc = _tmap(lambda g: g[None] / B, gacc)   # [1,...] per stage slice
         return loss_mean, gacc, epi_g, dx_all
 
-    return shard_map(
-        local_fn, mesh=mesh,
-        in_specs=(P(axis), P(), P(), P()),
-        out_specs=(P(), P(axis), P(), P()))
+    return shard_map_compat(
+        local_fn, mesh, (P(axis), P(), P(), P()),
+        (P(), P(axis), P(), P()), check=True)
 
 
 class PipelineParallel:

@@ -236,7 +236,7 @@ def test_pod4_all_parallelism_flavors_cross_process(pod4_result):
     for out in outs:
         line = [ln for ln in out.splitlines() if "WORKER_OK" in ln][0]
         for flavor in ("dp=ok", "tp=ok", "fsdp=ok", "ring=ok", "pp=ok",
-                       "moe=ok", "uneven=ok"):
+                       "moe=ok", "uneven=ok", "decode=ok", "sp=ok"):
             assert flavor in line, line
 
 
@@ -281,6 +281,25 @@ def test_pod4_pipeline_loss_matches_single_process(pod4_result):
         np.roll(ids[..., 0], -1, axis=1).astype(int)]
     want = float(ppn.fit_batch(ids, labs))
     assert abs(got - want) < 1e-4, (got, want)
+
+
+def test_pod4_decode_tokens_match_single_process(pod4_result):
+    """Greedy generation with FSDP-sharded params across the 4-process
+    pod emitted exactly the tokens of a single-replica rollout computed
+    here (the pod's SPMD decode changes layout, never sampling)."""
+    outdir, _ = pod4_result
+    from deeplearning4j_tpu.utils.textgen import generate
+    from deeplearning4j_tpu.zoo.transformer import (
+        TextGenerationTransformer,
+    )
+
+    got = np.load(os.path.join(outdir, "decode4_tokens.npy"))
+    net = TextGenerationTransformer(
+        num_classes=13, input_shape=(8, 1), d_model=16, num_heads=2,
+        num_blocks=2).init()
+    prompt = np.random.default_rng(11).integers(0, 13, (4, 3))
+    want = generate(net, prompt, 4, greedy=True)
+    np.testing.assert_array_equal(got, want)
 
 
 def test_pod4_kill_and_resume_exact(tmp_path_factory, pod4_result):

@@ -29,15 +29,18 @@ import numpy as np
 if os.environ.get("SMOKE_INTERPRET"):
     jax.config.update("jax_platforms", "cpu")
 
-from jax.sharding import Mesh, PartitionSpec as P  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
 
-from deeplearning4j_tpu.parallel.mesh import shard_map_compat as _sm  # noqa: E402
+from deeplearning4j_tpu.parallel.mesh import (  # noqa: E402
+    make_mesh, shard_map_compat as _sm,
+)
 
 INTERPRET = bool(os.environ.get("SMOKE_INTERPRET"))
 
 
 def _mesh(axis="data"):
-    return Mesh(np.array(jax.devices()), (axis,))
+    # the package's own mesh construction (device ordering included)
+    return make_mesh({axis: -1})
 
 
 def _maxerr(a, b):
