@@ -435,13 +435,16 @@ def _bench_inception(batch: int, steps: int, dtype: str):
 
 def _bench_transformer(batch: int, steps: int, dtype: str):
     """GPT-style causal transformer LM train step at long T — the
-    long-context rung (charter extension; no reference counterpart). On
-    TPU the attention core is the Pallas flash kernel, forward AND
-    blockwise FlashAttention-2-style backward (`ops/attention.py`), so
-    the [T, T] score matrix never materializes. Rate is tokens/sec
-    (= sequences/sec * T). MFU caveat: HLO cost_analysis cannot see
-    inside pallas_call, so the attention share of FLOPs is missing from
-    the mfu field (same caveat as the fused-conv rungs, PERF_NOTES)."""
+    long-context rung (charter extension; no reference counterpart).
+    The attention core follows the measured-winner policy
+    (`ops/kernel_defaults.attention_policy`): XLA dense or the Pallas
+    flash kernel with the blockwise FlashAttention-2 backward, whichever
+    the recorded rows say wins at this T (env hatches DL4J_TPU_ATTN* run
+    the ablation — each forced configuration gets its own metric name).
+    Rate is tokens/sec (= sequences/sec * T). MFU caveat: HLO
+    cost_analysis cannot see inside pallas_call, so when flash engages
+    the attention share of FLOPs is missing from the mfu field (same
+    caveat as the fused-conv rungs, PERF_NOTES)."""
     import dataclasses as _dc
 
     import jax
@@ -493,6 +496,12 @@ def _metric_name(model: str) -> str:
             tag += "_fused"
         if tag:
             return f"resnet50{tag}_train_images_per_sec_per_chip"
+    if model == "transformer":
+        forced = os.environ.get("DL4J_TPU_ATTN", "").strip().lower()
+        if forced in ("flash", "dense"):
+            # ablation runs must not overwrite the production-config
+            # record in bench_last_tpu.json (keyed by metric)
+            return f"transformer_train_tokens_per_sec_attn{forced}"
     return metric
 
 

@@ -42,11 +42,21 @@ def rope_rotate(x, positions, base: float = 10000.0):
 @register_layer
 @dataclasses.dataclass(frozen=True)
 class MultiHeadAttention(Layer):
-    """Self-attention over [batch, time, features]."""
+    """Self-attention over [batch, time, features].
+
+    `num_kv_heads < num_heads` enables grouped-query attention (GQA):
+    K/V project to fewer heads and each group of `num_heads //
+    num_kv_heads` query heads shares one KV head. The KV cache (and its
+    per-token decode HBM traffic — the binding resource of
+    autoregressive decoding on TPU) shrinks by the group factor;
+    num_kv_heads=1 is multi-query attention. Modern extension (the
+    RNN-era reference has no attention); default (None) is standard MHA.
+    """
 
     n_in: Optional[int] = None
     n_out: Optional[int] = None       # model dim (defaults to n_in)
     num_heads: int = 4
+    num_kv_heads: Optional[int] = None  # None -> num_heads (standard MHA)
     causal: bool = False
     attn_dropout: float = 0.0
     max_cache: int = 1024             # KV-cache length for decode stepping
@@ -63,17 +73,30 @@ class MultiHeadAttention(Layer):
     def output_type(self, input_type: InputType) -> InputType:
         return InputType.recurrent(self.n_out, input_type.timesteps)
 
+    @property
+    def _kv_heads(self) -> int:
+        return (self.num_kv_heads if self.num_kv_heads is not None
+                else self.num_heads)
+
+    def _check_heads(self):
+        H, Hkv = self.num_heads, self._kv_heads
+        if self.n_out % H:
+            raise ValueError(
+                f"n_out {self.n_out} not divisible by num_heads {H}")
+        if not 1 <= Hkv <= H or H % Hkv:
+            raise ValueError(
+                f"num_kv_heads {Hkv} must divide num_heads {H}")
+
     def init_params(self, key, input_type, dtype=jnp.float32):
         d = self.n_out
-        if d % self.num_heads:
-            raise ValueError(
-                f"n_out {d} not divisible by num_heads {self.num_heads}")
+        self._check_heads()
+        dkv = self._kv_heads * (d // self.num_heads)
         ks = jax.random.split(key, 4)
         winit = self._winit()
         return {
             "Wq": winit(ks[0], (self.n_in, d), dtype),
-            "Wk": winit(ks[1], (self.n_in, d), dtype),
-            "Wv": winit(ks[2], (self.n_in, d), dtype),
+            "Wk": winit(ks[1], (self.n_in, dkv), dtype),
+            "Wv": winit(ks[2], (self.n_in, dkv), dtype),
             "Wo": winit(ks[3], (d, d), dtype),
             "b": jnp.zeros((d,), dtype),
         }, {}
@@ -81,15 +104,17 @@ class MultiHeadAttention(Layer):
     def decode_carry(self, batch: int, dtype=jnp.float32):
         """Preallocated KV cache for incremental decoding (the transformer
         analogue of the reference's rnnTimeStep statefulness,
-        `MultiLayerNetwork.java:rnnTimeStep`): fixed [B, max_cache, H, Dh]
-        buffers + a write position, so every step reuses one compiled
-        program instead of growing shapes."""
-        H = self.num_heads
-        Dh = self.n_out // H
+        `MultiLayerNetwork.java:rnnTimeStep`): fixed [B, max_cache, Hkv,
+        Dh] buffers + a write position, so every step reuses one compiled
+        program instead of growing shapes. Under GQA the cache holds only
+        the Hkv KV heads — the group factor comes straight off decode's
+        per-token HBM traffic."""
+        Dh = self.n_out // self.num_heads
         L = self.max_cache
+        Hkv = self._kv_heads
         return {
-            "cache_k": jnp.zeros((batch, L, H, Dh), dtype),
-            "cache_v": jnp.zeros((batch, L, H, Dh), dtype),
+            "cache_k": jnp.zeros((batch, L, Hkv, Dh), dtype),
+            "cache_v": jnp.zeros((batch, L, Hkv, Dh), dtype),
             "pos": jnp.zeros((), jnp.int32),
         }
 
@@ -98,6 +123,7 @@ class MultiHeadAttention(Layer):
         incoming queries over the visible cache prefix."""
         B, T, _ = x.shape
         H = self.num_heads
+        Hkv = self._kv_heads
         Dh = self.n_out // H
         L = state["cache_k"].shape[1]
         if T > L:
@@ -108,10 +134,12 @@ class MultiHeadAttention(Layer):
                 f"KV cache overflow: pos {int(pos)} + step {T} > "
                 f"max_cache {L}; raise max_cache or clear state")
 
-        def split(w):
-            return (x @ w).reshape(B, T, H, Dh)
+        def split(w, heads):
+            return (x @ w).reshape(B, T, heads, Dh)
 
-        q, k, v = split(params["Wq"]), split(params["Wk"]), split(params["Wv"])
+        q = split(params["Wq"], H)
+        k = split(params["Wk"], Hkv)
+        v = split(params["Wv"], Hkv)
         if self.rope:
             # rotate with ABSOLUTE positions continuing from the carry;
             # the cache stores rotated keys (standard RoPE decoding)
@@ -130,14 +158,28 @@ class MultiHeadAttention(Layer):
         cv = jax.lax.dynamic_update_slice(
             state["cache_v"], v.astype(state["cache_v"].dtype),
             (z, pos, z, z))
-        s = jnp.einsum("bqhd,bkhd->bhqk", q, ck) / jnp.sqrt(Dh)
         k_ids = jnp.arange(L)[None, :]
         q_ids = pos + jnp.arange(T)[:, None]
         # causal: each new query sees cache + itself; non-causal: the
         # whole written prefix (still never the unwritten tail)
         vis = k_ids <= q_ids if self.causal else k_ids < pos + T
-        s = jnp.where(vis[None, None], s, -1e30)
-        o = jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, axis=-1), cv)
+        if Hkv != H:
+            # GQA: group the query heads against the Hkv-wide cache in
+            # the einsum itself — the cache is never broadcast to H
+            # heads, so the per-token HBM sweep (decode's binding
+            # resource) really is Hkv/H of full MHA
+            G = H // Hkv
+            qg = q.reshape(B, T, Hkv, G, Dh)
+            s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, ck) / jnp.sqrt(Dh)
+            s = jnp.where(vis[None, None, None], s, -1e30)
+            o = jnp.einsum("bhgqk,bkhd->bqhgd",
+                           jax.nn.softmax(s, axis=-1), cv)
+            o = o.reshape(B, T, H, Dh)
+        else:
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, ck) / jnp.sqrt(Dh)
+            s = jnp.where(vis[None, None], s, -1e30)
+            o = jnp.einsum("bhqk,bkhd->bqhd",
+                           jax.nn.softmax(s, axis=-1), cv)
         y = o.reshape(B, T, self.n_out) @ params["Wo"] + params["b"]
         return self._act(y), {"cache_k": ck, "cache_v": cv, "pos": pos + T}
 
@@ -146,16 +188,25 @@ class MultiHeadAttention(Layer):
             return self._decode(params, x, state)
         B, T, _ = x.shape
         H = self.num_heads
+        Hkv = self._kv_heads
         Dh = self.n_out // H
 
-        def split(w):
-            return (x @ w).reshape(B, T, H, Dh)
+        def split(w, heads):
+            return (x @ w).reshape(B, T, heads, Dh)
 
-        q, k, v = split(params["Wq"]), split(params["Wk"]), split(params["Wv"])
+        q = split(params["Wq"], H)
+        k = split(params["Wk"], Hkv)
+        v = split(params["Wv"], Hkv)
         if self.rope:
             positions = jnp.arange(T)
             q = rope_rotate(q, positions)
             k = rope_rotate(k, positions)
+        if Hkv != H:
+            # GQA full-sequence path: broadcast KV heads to the query
+            # heads for the attention core (training materializes full
+            # activations anyway; the cache savings are the decode win)
+            k = jnp.repeat(k, H // Hkv, axis=2)
+            v = jnp.repeat(v, H // Hkv, axis=2)
         from deeplearning4j_tpu.parallel.ring_attention import (
             current_sequence_mesh,
         )
@@ -300,6 +351,7 @@ class TransformerEncoderBlock(Layer):
 
     n_in: Optional[int] = None
     num_heads: int = 4
+    num_kv_heads: Optional[int] = None   # < num_heads -> GQA (see MHA)
     ffn_mult: int = 4
     causal: bool = True
     n_experts: int = 0            # 0 = dense FFN; >0 = MoE
@@ -318,7 +370,8 @@ class TransformerEncoderBlock(Layer):
     def _sub(self):
         d = self.n_in
         attn = MultiHeadAttention(
-            n_in=d, n_out=d, num_heads=self.num_heads, causal=self.causal,
+            n_in=d, n_out=d, num_heads=self.num_heads,
+            num_kv_heads=self.num_kv_heads, causal=self.causal,
             activation="identity", weight_init=self.weight_init,
             max_cache=self.max_cache, rope=self.rope)
         if self.n_experts > 0:

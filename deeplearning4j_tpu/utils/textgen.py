@@ -76,18 +76,48 @@ def _encode(ids: np.ndarray, encoding: str, vocab: int) -> np.ndarray:
     return np.eye(vocab, dtype=np.float32)[ids]
 
 
+def _truncate(p: np.ndarray, top_k: Optional[int],
+              top_p: Optional[float]) -> np.ndarray:
+    """Nucleus/top-k truncation of a [B, V] probability matrix: zero out
+    everything outside the k most probable tokens and/or the smallest
+    prefix whose mass reaches top_p (the token crossing the threshold is
+    kept, per the nucleus-sampling convention)."""
+    if top_k is not None and top_k < p.shape[-1]:
+        kth = np.sort(p, axis=-1)[:, -top_k][:, None]
+        p = np.where(p >= kth, p, 0.0)
+    if top_p is not None and top_p < 1.0:
+        order = np.argsort(-p, axis=-1)
+        sorted_p = np.take_along_axis(p, order, axis=-1)
+        csum = np.cumsum(sorted_p, axis=-1)
+        # keep tokens strictly before the threshold crossing, plus the
+        # crossing token itself (never empty)
+        keep_sorted = (csum - sorted_p) < top_p * csum[:, -1:]
+        keep = np.zeros_like(p, dtype=bool)
+        np.put_along_axis(keep, order, keep_sorted, axis=-1)
+        p = np.where(keep, p, 0.0)
+    return p
+
+
 def generate(net, prompt_ids, n_tokens: int, *, temperature: float = 1.0,
-             greedy: bool = False,
+             greedy: bool = False, top_k: Optional[int] = None,
+             top_p: Optional[float] = None,
              rng: Optional[np.random.Generator] = None) -> np.ndarray:
     """Sample `n_tokens` continuations of `prompt_ids` ([B, Tp] ints).
 
     The network's output layer must produce per-timestep class
-    probabilities (softmax). `temperature` rescales them (p^(1/τ),
-    renormalized); `greedy` takes the argmax instead of sampling.
-    Returns the sampled ids, [B, n_tokens]."""
+    probabilities (softmax). Decoding controls compose in the standard
+    order: `temperature` rescales (p^(1/τ)), then `top_k` keeps the k
+    most probable tokens, then `top_p` keeps the smallest nucleus
+    reaching that cumulative mass; `greedy` takes the argmax instead of
+    sampling (ignoring the truncation knobs). Returns the sampled ids,
+    [B, n_tokens]."""
     prompt_ids = np.asarray(prompt_ids)
     if prompt_ids.ndim == 1:
         prompt_ids = prompt_ids[None, :]
+    if top_k is not None and top_k < 1:
+        raise ValueError(f"top_k must be >= 1, got {top_k}")
+    if top_p is not None and not 0.0 < top_p <= 1.0:
+        raise ValueError(f"top_p must be in (0, 1], got {top_p}")
     B = prompt_ids.shape[0]
     first_layer, vocab = _resolve_net(net)
     encoding = _input_encoding(first_layer)
@@ -104,6 +134,7 @@ def generate(net, prompt_ids, n_tokens: int, *, temperature: float = 1.0,
         else:
             if temperature != 1.0:
                 p = np.power(np.maximum(p, 1e-30), 1.0 / temperature)
+            p = _truncate(p, top_k, top_p)
             p = p / p.sum(axis=-1, keepdims=True)
             tok = np.array([rng.choice(vocab, p=p[b]) for b in range(B)])
         generated[:, i] = tok

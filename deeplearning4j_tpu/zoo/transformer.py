@@ -35,11 +35,12 @@ class TextGenerationTransformer(ZooModel):
     input_shape = (256, 1)        # (timesteps, 1 token-id channel)
 
     def __init__(self, *args, d_model: int = 256, num_heads: int = 8,
-                 num_blocks: int = 4, n_experts: int = 0,
+                 num_kv_heads=None, num_blocks: int = 4, n_experts: int = 0,
                  pos_encoding: str = "learned", max_decode: int = 0, **kw):
         super().__init__(*args, **kw)
         self.d_model = d_model
         self.num_heads = num_heads
+        self.num_kv_heads = num_kv_heads   # < num_heads -> GQA
         self.num_blocks = num_blocks
         self.n_experts = n_experts
         if pos_encoding not in ("learned", "rope"):
@@ -63,8 +64,9 @@ class TextGenerationTransformer(ZooModel):
         cache = max(t, self.max_decode) if rope else t
         blocks = [
             TransformerEncoderBlock(
-                num_heads=self.num_heads, causal=True,
-                n_experts=self.n_experts, max_cache=cache, rope=rope)
+                num_heads=self.num_heads, num_kv_heads=self.num_kv_heads,
+                causal=True, n_experts=self.n_experts, max_cache=cache,
+                rope=rope)
             for _ in range(self.num_blocks)
         ]
         pos = [] if rope else [PositionEmbeddingLayer(max_length=t)]

@@ -154,6 +154,76 @@ def test_cg_transformer_incremental_decode():
     np.testing.assert_array_equal(got, np.stack(want, axis=1))
 
 
+class TestSamplingControls:
+    """top-k / nucleus truncation for generate() (modern decode controls
+    on the reference's temperature-sampling flow)."""
+
+    def test_truncate_math(self):
+        from deeplearning4j_tpu.utils.textgen import _truncate
+
+        p = np.array([[0.5, 0.3, 0.15, 0.05]])
+        np.testing.assert_allclose(_truncate(p, 2, None),
+                                   [[0.5, 0.3, 0.0, 0.0]])
+        # nucleus: tokens whose PRECEDING mass is < 0.8 stay (0.5, 0.3)
+        np.testing.assert_allclose(_truncate(p, None, 0.8),
+                                   [[0.5, 0.3, 0.0, 0.0]])
+        # the crossing token itself is kept — never an empty support
+        np.testing.assert_allclose(_truncate(p, None, 1e-9),
+                                   [[0.5, 0.0, 0.0, 0.0]])
+        # unsorted rows and per-row independence
+        p2 = np.array([[0.1, 0.7, 0.2], [0.3, 0.3, 0.4]])
+        out = _truncate(p2, 1, None)
+        np.testing.assert_allclose(out, [[0.0, 0.7, 0.0], [0.0, 0.0, 0.4]])
+
+    def test_top_k1_equals_greedy(self):
+        from deeplearning4j_tpu.utils.textgen import generate
+        from deeplearning4j_tpu.zoo.transformer import (
+            TextGenerationTransformer,
+        )
+
+        net = TextGenerationTransformer(num_classes=11, input_shape=(12, 1),
+                                        d_model=16, num_heads=2,
+                                        num_blocks=1).init()
+        prompt = np.random.default_rng(3).integers(0, 11, (2, 3))
+        g = generate(net, prompt, 5, greedy=True)
+        k1 = generate(net, prompt, 5, top_k=1,
+                      rng=np.random.default_rng(0))
+        np.testing.assert_array_equal(g, k1)
+        # a vanishing nucleus also degenerates to greedy
+        p0 = generate(net, prompt, 5, top_p=1e-9,
+                      rng=np.random.default_rng(1))
+        np.testing.assert_array_equal(g, p0)
+
+    def test_top_p1_is_plain_sampling(self):
+        from deeplearning4j_tpu.utils.textgen import generate
+        from deeplearning4j_tpu.zoo.transformer import (
+            TextGenerationTransformer,
+        )
+
+        net = TextGenerationTransformer(num_classes=11, input_shape=(12, 1),
+                                        d_model=16, num_heads=2,
+                                        num_blocks=1).init()
+        prompt = np.random.default_rng(4).integers(0, 11, (1, 3))
+        a = generate(net, prompt, 6, rng=np.random.default_rng(9))
+        b = generate(net, prompt, 6, top_p=1.0,
+                     rng=np.random.default_rng(9))
+        np.testing.assert_array_equal(a, b)
+
+    def test_validation(self):
+        from deeplearning4j_tpu.utils.textgen import generate
+        from deeplearning4j_tpu.zoo.transformer import (
+            TextGenerationTransformer,
+        )
+
+        net = TextGenerationTransformer(num_classes=5, input_shape=(8, 1),
+                                        d_model=8, num_heads=2,
+                                        num_blocks=1).init()
+        with pytest.raises(ValueError, match="top_k"):
+            generate(net, np.zeros((1, 2), np.int64), 2, top_k=0)
+        with pytest.raises(ValueError, match="top_p"):
+            generate(net, np.zeros((1, 2), np.int64), 2, top_p=0.0)
+
+
 def test_generate_refuses_multi_io_graph():
     """Multi-input graphs have no single autoregressive stream for
     generate() to drive; the error must say so (not AttributeError)."""
@@ -185,6 +255,139 @@ def test_generate_refuses_multi_io_graph():
     net = ComputationGraph(conf).init()
     with pytest.raises(ValueError, match="exactly one network input"):
         generate(net, np.zeros((1, 2), np.int64), 2)
+
+
+class TestGQA:
+    """Grouped-query attention: fewer KV heads, shared per query group
+    (modern decode-bandwidth extension — num_kv_heads on MHA/blocks)."""
+
+    def _mha(self, kv, d=16, heads=4, rope=False):
+        from deeplearning4j_tpu.nn.inputs import InputType
+        from deeplearning4j_tpu.nn.layers.attention import (
+            MultiHeadAttention,
+        )
+        m = MultiHeadAttention(n_in=d, n_out=d, num_heads=heads,
+                               num_kv_heads=kv, causal=True, rope=rope,
+                               activation="identity", max_cache=16)
+        import jax
+        p, _ = m.init_params(jax.random.PRNGKey(0),
+                             InputType.recurrent(d, 8))
+        return m, p
+
+    def test_equivalent_to_mha_with_repeated_kv(self):
+        """GQA(kv=2, H=4) == standard MHA whose Wk/Wv columns are the
+        GQA weights repeated per group — the defining reduction."""
+        import jax
+        import jax.numpy as _jnp
+
+        d, H, kv = 16, 4, 2
+        gqa, p = self._mha(kv)
+        mha, pf = self._mha(None)
+        Dh = d // H
+
+        def widen(w):   # [n_in, kv*Dh] -> [n_in, H*Dh] by group repeat
+            wk = w.reshape(d, kv, Dh)
+            return _jnp.repeat(wk, H // kv, axis=1).reshape(d, H * Dh)
+
+        pf = dict(pf, Wq=p["Wq"], Wk=widen(p["Wk"]), Wv=widen(p["Wv"]),
+                  Wo=p["Wo"], b=p["b"])
+        x = _jnp.asarray(np.random.default_rng(0).standard_normal(
+            (2, 8, d)), _jnp.float32)
+        og, _ = gqa.apply(p, x)
+        om, _ = mha.apply(pf, x)
+        np.testing.assert_allclose(np.asarray(og), np.asarray(om),
+                                   rtol=1e-5, atol=1e-6)
+
+    @pytest.mark.parametrize("kv,rope", [(1, False), (2, False), (2, True)])
+    def test_decode_matches_full_forward(self, kv, rope):
+        import jax.numpy as _jnp
+
+        layer, p = self._mha(kv, rope=rope)
+        x = _jnp.asarray(np.random.default_rng(1).standard_normal(
+            (2, 8, 16)), _jnp.float32)
+        full, _ = layer.apply(p, x)
+        st = layer.decode_carry(2)
+        outs = []
+        for t in range(8):
+            o, st = layer.apply(p, x[:, t:t + 1, :], state=st)
+            outs.append(np.asarray(o))
+        np.testing.assert_allclose(np.concatenate(outs, axis=1),
+                                   np.asarray(full), rtol=2e-4, atol=2e-5)
+
+    def test_cache_is_group_factor_smaller(self):
+        layer, _ = self._mha(1)     # multi-query: H=4 -> 1 KV head
+        full, _ = self._mha(None)
+        c = layer.decode_carry(2)
+        cf = full.decode_carry(2)
+        assert c["cache_k"].shape[2] * 4 == cf["cache_k"].shape[2]
+        assert c["cache_k"].size * 4 == cf["cache_k"].size
+
+    def test_invalid_kv_heads_rejected(self):
+        from deeplearning4j_tpu.nn.inputs import InputType
+        from deeplearning4j_tpu.nn.layers.attention import (
+            MultiHeadAttention,
+        )
+        import jax
+        for bad in (3, 0, 8):       # not a divisor / zero / > heads
+            m = MultiHeadAttention(n_in=16, n_out=16, num_heads=4,
+                                   num_kv_heads=bad)
+            with pytest.raises(ValueError, match="num_kv_heads"):
+                m.init_params(jax.random.PRNGKey(0),
+                              InputType.recurrent(16, 8))
+
+    def test_gqa_transformer_trains_and_generates(self):
+        from deeplearning4j_tpu.gradientcheck import check_gradients
+        from deeplearning4j_tpu.utils.textgen import generate
+        from deeplearning4j_tpu.zoo.transformer import (
+            TextGenerationTransformer,
+        )
+
+        V, T = 11, 8
+        net = TextGenerationTransformer(
+            num_classes=V, input_shape=(T, 1), d_model=16, num_heads=4,
+            num_kv_heads=2, num_blocks=1).init()
+        rng = np.random.default_rng(2)
+        x = rng.integers(0, V, (4, T, 1)).astype(np.float32)
+        y = np.eye(V, dtype=np.float32)[
+            np.roll(x[..., 0], -1, axis=1).astype(int)]
+        assert check_gradients(net, x, y, subset=40)
+        # decode parity against the full-forward rollout (cache is GQA)
+        prompt = rng.integers(0, V, (2, 3))
+        got = generate(net, prompt, 4, greedy=True)
+        seq = prompt.copy()
+        for _ in range(4):
+            cur = seq.shape[1]
+            padded = np.zeros((2, T), seq.dtype)
+            padded[:, :cur] = seq
+            probs = np.asarray(net.output(
+                padded[..., None].astype(np.float32)))
+            tok = probs[:, cur - 1, :].argmax(-1)
+            seq = np.concatenate([seq, tok[:, None]], axis=1)
+        np.testing.assert_array_equal(got, seq[:, 3:])
+
+    def test_serde_round_trip(self):
+        from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.inputs import InputType
+        from deeplearning4j_tpu.nn.layers.attention import (
+            TransformerEncoderBlock,
+        )
+        from deeplearning4j_tpu.nn.layers.feedforward import (
+            EmbeddingSequenceLayer,
+        )
+        from deeplearning4j_tpu.nn.layers.recurrent import RnnOutputLayer
+        from deeplearning4j_tpu.optim.updaters import Adam
+
+        conf = (NeuralNetConfiguration.builder()
+                .seed(0).updater(Adam(1e-3)).activation("identity")
+                .list(EmbeddingSequenceLayer(n_in=7, n_out=8),
+                      TransformerEncoderBlock(num_heads=4, num_kv_heads=2),
+                      RnnOutputLayer(n_out=7, activation="softmax"))
+                .set_input_type(InputType.recurrent(1, 6))
+                .build())
+        conf2 = type(conf).from_json(conf.to_json())
+        blk = [l for l in conf2.layers
+               if type(l).__name__ == "TransformerEncoderBlock"][0]
+        assert blk.num_kv_heads == 2
 
 
 class TestRoPE:

