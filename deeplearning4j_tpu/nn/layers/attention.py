@@ -338,13 +338,19 @@ class PositionEmbeddingLayer(Layer):
 @register_layer
 @dataclasses.dataclass(frozen=True)
 class TransformerEncoderBlock(Layer):
-    """Pre-LN transformer block: x + MHA(LN(x)), then x + FFN(LN(x)).
+    """Pre-norm transformer block: x + MHA(norm(x)), then x + FFN(norm(x)).
 
     Modern extension (no reference counterpart — SURVEY §5 notes the
     reference predates attention). Composes the framework's own pieces:
-    MultiHeadAttention (flash kernel on TPU inference, ring attention under
-    a seq mesh) and either a dense FFN or a MoEFeedForward
-    (set n_experts > 0) for conditional compute.
+    MultiHeadAttention (measured-policy attention core, ring attention
+    under a seq mesh, GQA via num_kv_heads) and either a dense FFN or a
+    MoEFeedForward (set n_experts > 0) for conditional compute.
+
+    `norm="rms"` swaps LayerNorm for RMSNorm (no centering, no bias —
+    one fewer reduction sweep per norm, the TPU-friendly modern choice)
+    and `ffn_activation="swiglu"` swaps the GELU MLP for the gated
+    SwiGLU variant; together with rope=True and num_kv_heads they make
+    the block Llama-architecture-shaped.
     """
 
     CONSUMES = "rnn"   # [B, T, d] sequence activations
@@ -358,6 +364,8 @@ class TransformerEncoderBlock(Layer):
     moe_k: int = 2
     max_cache: int = 1024         # KV-cache length for decode stepping
     rope: bool = False            # rotary position embedding on q/k
+    norm: str = "layer"           # "layer" | "rms"
+    ffn_activation: str = "gelu"  # "gelu" | "swiglu"
 
     def infer_n_in(self, input_type: InputType):
         if self.n_in is None:
@@ -387,12 +395,25 @@ class TransformerEncoderBlock(Layer):
 
     def init_params(self, key, input_type, dtype=jnp.float32):
         d = self.n_in
+        if self.norm not in ("layer", "rms"):
+            raise ValueError(f"norm must be 'layer' or 'rms', "
+                             f"got {self.norm!r}")
+        if self.ffn_activation not in ("gelu", "swiglu"):
+            raise ValueError(f"ffn_activation must be 'gelu' or 'swiglu', "
+                             f"got {self.ffn_activation!r}")
+        if self.ffn_activation == "swiglu" and self.n_experts > 0:
+            raise ValueError(
+                "ffn_activation='swiglu' applies to the dense FFN; with "
+                "n_experts > 0 the MoE experts define their own "
+                "activation (a silently-ignored config must not serde "
+                "round-trip as if it trained SwiGLU)")
         ks = jax.random.split(key, 4)
         attn, moe = self._sub()
-        params = {
-            "ln1_g": jnp.ones((d,), dtype), "ln1_b": jnp.zeros((d,), dtype),
-            "ln2_g": jnp.ones((d,), dtype), "ln2_b": jnp.zeros((d,), dtype),
-        }
+        params = {"ln1_g": jnp.ones((d,), dtype),
+                  "ln2_g": jnp.ones((d,), dtype)}
+        if self.norm == "layer":    # RMSNorm is bias-free
+            params["ln1_b"] = jnp.zeros((d,), dtype)
+            params["ln2_b"] = jnp.zeros((d,), dtype)
         ap, _ = attn.init_params(ks[0], input_type, dtype)
         params.update({f"attn_{k}": v for k, v in ap.items()})
         if moe is not None:
@@ -407,13 +428,22 @@ class TransformerEncoderBlock(Layer):
                 "ffn_w2": winit(ks[2], (h, d), dtype),
                 "ffn_b2": jnp.zeros((d,), dtype),
             })
+            if self.ffn_activation == "swiglu":
+                # gated branch: silu(x W1) * (x W3) -> W2 (bias-free
+                # gate matrix, the standard SwiGLU parameterization)
+                params["ffn_w3"] = winit(ks[3], (d, h), dtype)
         return params, {}
 
-    @staticmethod
-    def _ln(x, g, b):
+    def _norm_apply(self, x, params, prefix):
+        g = params[f"{prefix}_g"]
+        if self.norm == "rms":
+            # no centering, no bias: one reduction sweep instead of two
+            ms = jnp.mean(x * x, axis=-1, keepdims=True)
+            return x * jax.lax.rsqrt(ms + 1e-5) * g
         mu = jnp.mean(x, axis=-1, keepdims=True)
         var = jnp.var(x, axis=-1, keepdims=True)
-        return (x - mu) * jax.lax.rsqrt(var + 1e-5) * g + b
+        return (x - mu) * jax.lax.rsqrt(var + 1e-5) * g \
+            + params[f"{prefix}_b"]
 
     def decode_carry(self, batch: int, dtype=jnp.float32):
         attn, _ = self._sub()
@@ -423,12 +453,12 @@ class TransformerEncoderBlock(Layer):
               mask=None):
         attn, moe = self._sub()
         ap = {k[5:]: v for k, v in params.items() if k.startswith("attn_")}
-        h = self._ln(x, params["ln1_g"], params["ln1_b"])
+        h = self._norm_apply(x, params, "ln1")
         attn_carry = state.get("attn") if state else None
         a, a_st = attn.apply(ap, h, state=attn_carry, train=train, rng=rng,
                              mask=mask)
         x = x + a
-        h = self._ln(x, params["ln2_g"], params["ln2_b"])
+        h = self._norm_apply(x, params, "ln2")
         new_state = {}
         if attn_carry is not None:
             new_state["attn"] = a_st
@@ -440,6 +470,10 @@ class TransformerEncoderBlock(Layer):
             y = y.reshape(b_, t_, d_)
             if "aux_loss" in st:
                 new_state["aux_loss"] = st["aux_loss"]
+        elif self.ffn_activation == "swiglu":
+            gate = jax.nn.silu(h @ params["ffn_w1"] + params["ffn_b1"])
+            y = (gate * (h @ params["ffn_w3"])) @ params["ffn_w2"] \
+                + params["ffn_b2"]
         else:
             y = jax.nn.gelu(h @ params["ffn_w1"] + params["ffn_b1"])
             y = y @ params["ffn_w2"] + params["ffn_b2"]

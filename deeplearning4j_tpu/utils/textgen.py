@@ -83,8 +83,12 @@ def _truncate(p: np.ndarray, top_k: Optional[int],
     prefix whose mass reaches top_p (the token crossing the threshold is
     kept, per the nucleus-sampling convention)."""
     if top_k is not None and top_k < p.shape[-1]:
-        kth = np.sort(p, axis=-1)[:, -top_k][:, None]
-        p = np.where(p >= kth, p, 0.0)
+        # exactly k survivors even under ties; stable order on -p makes
+        # k=1 coincide with argmax (first occurrence wins)
+        order = np.argsort(-p, axis=-1, kind="stable")[:, :top_k]
+        keep = np.zeros_like(p, dtype=bool)
+        np.put_along_axis(keep, order, True, axis=-1)
+        p = np.where(keep, p, 0.0)
     if top_p is not None and top_p < 1.0:
         order = np.argsort(-p, axis=-1)
         sorted_p = np.take_along_axis(p, order, axis=-1)

@@ -21,7 +21,7 @@ stamp=$(date -u +%Y%m%dT%H%M%S)
 phase() {
   local name=$1 tmo=$2; shift 2
   echo "=== PHASE $name (timeout ${tmo}s) $(date -u +%H:%M:%S) ==="
-  timeout "$tmo" "$@" 2>&1 | tee "$LOG/${stamp}_${name}.log" | tail -5
+  timeout -k 30 "$tmo" "$@" 2>&1 | tee "$LOG/${stamp}_${name}.log" | tail -5
   local rc=${PIPESTATUS[0]}   # the benchmark's status, not tail's
   echo "=== PHASE $name rc=$rc$( [ "$rc" = 124 ] && echo ' (TIMEOUT)') ==="
 }
