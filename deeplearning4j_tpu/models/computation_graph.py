@@ -478,6 +478,15 @@ class ComputationGraph(SeqCtxJitCache, SeqCtxSolverCache):
         outs = [values[o] for o in self.conf.network_outputs]
         return outs[0] if len(outs) == 1 else outs
 
+    def rnn_reorder_state(self, idx) -> None:
+        """Reorder/expand decode carries along the batch dimension (see
+        `MultiLayerNetwork.rnn_reorder_state` — the beam-search carry
+        contract is identical for graph vertices)."""
+        ix = jnp.asarray(np.asarray(idx))
+        self._rnn_carries = jax.tree_util.tree_map(
+            lambda a: a[ix] if getattr(a, "ndim", 0) >= 1 else a,
+            self._rnn_carries)
+
     def rnn_clear_previous_state(self):
         """Reference: `ComputationGraph.rnnClearPreviousState`."""
         self._rnn_carries = {}

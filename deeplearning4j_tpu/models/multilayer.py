@@ -629,6 +629,20 @@ class MultiLayerNetwork(SeqCtxJitCache, SeqCtxSolverCache):
         self._rnn_carries = {}
         self._decode_pos = 0
 
+    def rnn_reorder_state(self, idx) -> None:
+        """Reorder (or expand) the stateful-decoding carries along the
+        batch dimension — beam-search reselection gathers each beam's KV
+        cache/h/c rows to follow its chosen parent. Every non-scalar
+        carry leaf is batch-leading by the decode-carry contract
+        (`decode_carry`/`initial_carry`); scalar leaves (decode
+        positions) are shared across the batch and pass through."""
+        import jax.numpy as jnp
+
+        ix = jnp.asarray(np.asarray(idx))
+        self._rnn_carries = jax.tree_util.tree_map(
+            lambda a: a[ix] if getattr(a, "ndim", 0) >= 1 else a,
+            self._rnn_carries)
+
     # -------------------------------------------------------- pretrain
     def pretrain(self, data, *, epochs: int = 1, batch_size: int = 32):
         """Greedy layerwise unsupervised pretraining for pretrainable layers

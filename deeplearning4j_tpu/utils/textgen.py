@@ -146,3 +146,91 @@ def generate(net, prompt_ids, n_tokens: int, *, temperature: float = 1.0,
             out = np.asarray(net.rnn_time_step(
                 _encode(tok[:, None], encoding, vocab)))
     return generated
+
+
+def beam_search(net, prompt_ids, n_tokens: int, *, beam_width: int = 4,
+                length_penalty: float = 0.6,
+                eos_id: Optional[int] = None) -> np.ndarray:
+    """Beam-search decoding over the same stateful stepping as
+    `generate`. The prompt is prefilled ONCE per batch row; the KV
+    caches are then tiled to the beams (`net.rnn_reorder_state`) and
+    gathered to each beam's chosen parent on reselection, so no prefix
+    is ever recomputed.
+
+    Scores are sum-of-log-probs normalized by the GNMT length penalty
+    ((5+len)/6)^alpha with alpha=`length_penalty` (0 disables). With
+    `eos_id`, finished beams stop growing (further steps append eos at
+    no cost) and the best-scoring finished-or-final beam wins. Returns
+    [B, n_tokens] ids (the best beam per batch row, padded with eos
+    after finish)."""
+    prompt_ids = np.asarray(prompt_ids)
+    if prompt_ids.ndim == 1:
+        prompt_ids = prompt_ids[None, :]
+    if beam_width < 1:
+        raise ValueError(f"beam_width must be >= 1, got {beam_width}")
+    B = prompt_ids.shape[0]
+    W = beam_width
+    if n_tokens < 1:
+        return np.zeros((B, 0), dtype=np.int64)
+    first_layer, vocab = _resolve_net(net)
+    encoding = _input_encoding(first_layer)
+
+    net.rnn_clear_previous_state()
+    # prefill once per row, then tile the carries to the W beams
+    out = np.asarray(net.rnn_time_step(
+        _encode(prompt_ids, encoding, vocab)))
+    net.rnn_reorder_state(np.repeat(np.arange(B), W))
+    # every beam of a row starts from the same distribution: [B, 1, V]
+    # broadcasts against the [B, W] scores
+    logp_next = np.log(np.maximum(out[:, -1, :], 1e-30))[:, None, :]
+
+    scores = np.full((B, W), -np.inf)
+    scores[:, 0] = 0.0        # identical beams: expand only beam 0 first
+    tokens = np.zeros((B, W, n_tokens), dtype=np.int64)
+    done = np.zeros((B, W), dtype=bool)
+    identity = np.arange(B * W)
+
+    def _norm(s, length):
+        if not length_penalty:
+            return s
+        return s / (((5.0 + length) / 6.0) ** length_penalty)
+
+    for t in range(n_tokens):
+        cand = scores[:, :, None] + logp_next            # [B, W, V]
+        if eos_id is not None:
+            # finished beams extend ONLY with eos, at no cost
+            frozen = np.full((vocab,), -np.inf)
+            frozen[eos_id] = 0.0
+            cand = np.where(done[:, :, None],
+                            scores[:, :, None] + frozen[None, None], cand)
+        flat = np.broadcast_to(cand, (B, W, vocab)).reshape(B, W * vocab)
+        top = np.argsort(-flat, axis=-1, kind="stable")[:, :W]
+        parent = top // vocab                            # [B, W]
+        tok = top % vocab
+        scores = np.take_along_axis(flat, top, axis=-1)
+        tokens = np.take_along_axis(
+            tokens, parent[:, :, None], axis=1)
+        tokens[:, :, t] = tok
+        done = np.take_along_axis(done, parent, axis=1)
+        if eos_id is not None:
+            done = done | (tok == eos_id)
+        # reorder the KV caches to the chosen parents (skip the common
+        # identity case — a full cache gather per token is pure HBM
+        # waste when every beam kept its own parent), then step
+        flat_idx = (np.arange(B)[:, None] * W + parent).reshape(-1)
+        if not np.array_equal(flat_idx, identity):
+            net.rnn_reorder_state(flat_idx)
+        if t + 1 < n_tokens and not done.all():
+            out = np.asarray(net.rnn_time_step(
+                _encode(tok.reshape(-1, 1), encoding, vocab)))
+            logp_next = np.log(np.maximum(out[:, -1, :], 1e-30)).reshape(
+                B, W, vocab)
+    if eos_id is not None:
+        finished = (tokens == eos_id).any(-1)
+        lengths = np.where(finished,
+                           np.argmax(tokens == eos_id, axis=-1) + 1,
+                           n_tokens)
+    else:
+        lengths = np.full((B, W), n_tokens)
+    best = np.argmax(_norm(scores, lengths), axis=-1)    # [B]
+    return tokens[np.arange(B), best]
