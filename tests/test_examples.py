@@ -36,6 +36,14 @@ def test_transformer_text_generation(capsys):
     assert len(text) == 16
 
 
+def test_modern_llm_decode(capsys):
+    mod = _run("modern_llm_decode.py")
+    loss, outs = mod["main"](epochs=6, T=32, n_gen=12)
+    assert loss < 2.0          # RMS/SwiGLU/GQA stack learns the corpus
+    assert set(outs) == {"greedy", "nucleus", "beam"}
+    assert all(len(v) == 12 for v in outs.values())
+
+
 def test_seq2seq_cross_attention(capsys):
     mod = _run("seq2seq_cross_attention.py")
     acc = mod["main"](epochs=120, n=64)
