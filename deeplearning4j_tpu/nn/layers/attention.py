@@ -61,6 +61,11 @@ class MultiHeadAttention(Layer):
     attn_dropout: float = 0.0
     max_cache: int = 1024             # KV-cache length for decode stepping
     rope: bool = False                # rotary position embedding on q/k
+    window: Optional[int] = None      # sliding-window (local) attention:
+    # each position sees at most `window` keys back (causal) or within
+    # |i-j| < window (bidirectional) — Mistral-style locality; O(T*w)
+    # useful score mass. Windowed layers use the dense band-masked path
+    # (the flash kernel and the ring are full-context codepaths).
 
     def infer_n_in(self, input_type: InputType):
         upd = {}
@@ -90,6 +95,8 @@ class MultiHeadAttention(Layer):
     def init_params(self, key, input_type, dtype=jnp.float32):
         d = self.n_out
         self._check_heads()
+        if self.window is not None and self.window < 1:
+            raise ValueError(f"window must be >= 1, got {self.window}")
         dkv = self._kv_heads * (d // self.num_heads)
         ks = jax.random.split(key, 4)
         winit = self._winit()
@@ -163,6 +170,13 @@ class MultiHeadAttention(Layer):
         # causal: each new query sees cache + itself; non-causal: the
         # whole written prefix (still never the unwritten tail)
         vis = k_ids <= q_ids if self.causal else k_ids < pos + T
+        if self.window is not None:
+            # sliding window: `window` keys back; bidirectional also
+            # bounds the forward side (|i-j| < window, matching the
+            # dense band — still never past the written prefix)
+            vis = vis & (k_ids > q_ids - self.window)
+            if not self.causal:
+                vis = vis & (k_ids < q_ids + self.window)
         if Hkv != H:
             # GQA: group the query heads against the Hkv-wide cache in
             # the einsum itself — the cache is never broadcast to H
@@ -214,13 +228,17 @@ class MultiHeadAttention(Layer):
         seq_ctx = current_sequence_mesh()
         drop = (self.attn_dropout
                 if train and self.attn_dropout and rng is not None else 0.0)
-        if seq_ctx is not None and (drop or mask is not None):
+        if seq_ctx is not None and (drop or mask is not None
+                                    or self.window is not None):
             # The user asked for sequence parallelism (usually because T
-            # is too long for dense attention) but attention-dropout or a
-            # padding mask forces the dense path — degrade loudly.
+            # is too long for dense attention) but attention-dropout, a
+            # padding mask, or a sliding window forces the dense path —
+            # degrade loudly.
             import warnings
 
-            why = "attn_dropout" if drop else "a padding mask"
+            why = ("attn_dropout" if drop
+                   else "a sliding window" if self.window is not None
+                   else "a padding mask")
             warnings.warn(
                 f"sequence_parallel is active but {why} forces the dense "
                 f"[T, T] attention path; the ring is bypassed for this "
@@ -237,12 +255,14 @@ class MultiHeadAttention(Layer):
 
             o = ring_self_attention(q, k, v, seq_ctx.mesh,
                                     axis=seq_ctx.axis, causal=self.causal)
-        elif mask is not None or drop:
-            # Padding mask and/or attention-weight dropout need the dense
-            # path (dropout perturbs the post-softmax weights, which never
-            # materialize inside the flash kernel).
+        elif mask is not None or drop or self.window is not None:
+            # Padding mask, attention-weight dropout, and the sliding
+            # window all need the dense path (dropout perturbs the
+            # post-softmax weights, which never materialize inside the
+            # flash kernel; the band mask is a score-level bias).
             o = self._masked_attention(q, k, v, mask, self.causal,
-                                       dropout=drop, rng=rng)
+                                       dropout=drop, rng=rng,
+                                       window=self.window)
         else:
             # Flash-vs-dense, tile config, and backward selection all come
             # from the measured-winner policy (ops/kernel_defaults.py) —
@@ -268,7 +288,7 @@ class MultiHeadAttention(Layer):
 
     @staticmethod
     def _masked_attention(q, k, v, mask, causal=False, dropout=0.0,
-                          rng=None):
+                          rng=None, window=None):
         d = q.shape[-1]
         s = jnp.einsum("bqhd,bkhd->bhqk", q, k) / jnp.sqrt(d)
         bias = jnp.zeros((), s.dtype)
@@ -278,6 +298,15 @@ class MultiHeadAttention(Layer):
             t = s.shape[-1]
             band = jnp.tril(jnp.ones((t, t), jnp.bool_))
             bias = bias + jnp.where(band[None, None], 0.0, -1e30)
+        if window is not None:
+            # sliding window: `window` keys back (causal combines with
+            # the tril above); bidirectional keeps |i-j| < window
+            tq, tk = s.shape[-2], s.shape[-1]
+            qi = jnp.arange(tq)[:, None]
+            ki = jnp.arange(tk)[None, :]
+            local = (ki > qi - window) if causal else (
+                jnp.abs(qi - ki) < window)
+            bias = bias + jnp.where(local[None, None], 0.0, -1e30)
         p = jax.nn.softmax(s + bias, axis=-1)
         if dropout:
             # Inverted dropout on the attention weights (the standard
@@ -366,6 +395,7 @@ class TransformerEncoderBlock(Layer):
     rope: bool = False            # rotary position embedding on q/k
     norm: str = "layer"           # "layer" | "rms"
     ffn_activation: str = "gelu"  # "gelu" | "swiglu"
+    window: Optional[int] = None  # sliding-window attention (see MHA)
 
     def infer_n_in(self, input_type: InputType):
         if self.n_in is None:
@@ -381,7 +411,7 @@ class TransformerEncoderBlock(Layer):
             n_in=d, n_out=d, num_heads=self.num_heads,
             num_kv_heads=self.num_kv_heads, causal=self.causal,
             activation="identity", weight_init=self.weight_init,
-            max_cache=self.max_cache, rope=self.rope)
+            max_cache=self.max_cache, rope=self.rope, window=self.window)
         if self.n_experts > 0:
             from deeplearning4j_tpu.parallel.moe import MoEFeedForward
 

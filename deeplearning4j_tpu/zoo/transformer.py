@@ -37,7 +37,8 @@ class TextGenerationTransformer(ZooModel):
     def __init__(self, *args, d_model: int = 256, num_heads: int = 8,
                  num_kv_heads=None, num_blocks: int = 4, n_experts: int = 0,
                  pos_encoding: str = "learned", max_decode: int = 0,
-                 norm: str = "layer", ffn_activation: str = "gelu", **kw):
+                 norm: str = "layer", ffn_activation: str = "gelu",
+                 window=None, **kw):
         super().__init__(*args, **kw)
         self.d_model = d_model
         self.num_heads = num_heads
@@ -48,6 +49,7 @@ class TextGenerationTransformer(ZooModel):
         # num_kv_heads < num_heads = the Llama-architecture block shape
         self.norm = norm
         self.ffn_activation = ffn_activation
+        self.window = window               # sliding-window attention
         if pos_encoding not in ("learned", "rope"):
             raise ValueError(f"pos_encoding must be 'learned' or 'rope', "
                              f"got {pos_encoding!r}")
@@ -72,7 +74,7 @@ class TextGenerationTransformer(ZooModel):
                 num_heads=self.num_heads, num_kv_heads=self.num_kv_heads,
                 causal=True, n_experts=self.n_experts, max_cache=cache,
                 rope=rope, norm=self.norm,
-                ffn_activation=self.ffn_activation)
+                ffn_activation=self.ffn_activation, window=self.window)
             for _ in range(self.num_blocks)
         ]
         pos = [] if rope else [PositionEmbeddingLayer(max_length=t)]

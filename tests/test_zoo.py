@@ -395,6 +395,111 @@ class TestGQA:
         assert blk.num_kv_heads == 2
 
 
+class TestSlidingWindow:
+    """window=w local attention (Mistral-style band masking) on the
+    dense and decode paths."""
+
+    def _mha(self, window, causal=True, d=16, T=10):
+        import jax
+        from deeplearning4j_tpu.nn.inputs import InputType
+        from deeplearning4j_tpu.nn.layers.attention import (
+            MultiHeadAttention,
+        )
+        m = MultiHeadAttention(n_in=d, n_out=d, num_heads=2, causal=causal,
+                               window=window, activation="identity",
+                               max_cache=T)
+        p, _ = m.init_params(jax.random.PRNGKey(0),
+                             InputType.recurrent(d, T))
+        x = np.random.default_rng(0).standard_normal((2, T, d)).astype(
+            np.float32)
+        return m, p, x
+
+    def test_window_geq_t_equals_full(self):
+        import dataclasses as _dc
+        import jax.numpy as _jnp
+        m, p, x = self._mha(window=10)
+        full = _dc.replace(m, window=None)
+        a, _ = m.apply(p, _jnp.asarray(x))
+        b, _ = full.apply(p, _jnp.asarray(x))
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-5, atol=1e-6)
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_band_matches_manual_reference(self, causal):
+        import jax.numpy as _jnp
+        w = 3
+        m, p, x = self._mha(window=w, causal=causal)
+        got, _ = m.apply(p, _jnp.asarray(x))
+        # manual reference: per-head softmax over the banded scores
+        d = 16
+        H, Dh = 2, 8
+        q = (x @ np.asarray(p["Wq"])).reshape(2, 10, H, Dh)
+        k = (x @ np.asarray(p["Wk"])).reshape(2, 10, H, Dh)
+        v = (x @ np.asarray(p["Wv"])).reshape(2, 10, H, Dh)
+        s = np.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(Dh)
+        qi = np.arange(10)[:, None]
+        ki = np.arange(10)[None, :]
+        vis = (ki > qi - w) & (ki <= qi) if causal else np.abs(qi - ki) < w
+        s = np.where(vis[None, None], s, -1e30)
+        e = np.exp(s - s.max(-1, keepdims=True))
+        pr = e / e.sum(-1, keepdims=True)
+        o = np.einsum("bhqk,bkhd->bqhd", pr, v).reshape(2, 10, d)
+        want = o @ np.asarray(p["Wo"]) + np.asarray(p["b"])
+        np.testing.assert_allclose(np.asarray(got), want, rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_decode_matches_full_forward(self):
+        import jax.numpy as _jnp
+        m, p, x = self._mha(window=3)
+        full, _ = m.apply(p, _jnp.asarray(x))
+        st = m.decode_carry(2)
+        outs = []
+        for t in range(10):
+            o, st = m.apply(p, x[:, t:t + 1, :], state=st)
+            outs.append(np.asarray(o))
+        np.testing.assert_allclose(np.concatenate(outs, axis=1),
+                                   np.asarray(full), rtol=2e-4, atol=2e-5)
+
+    def test_bidirectional_decode_single_chunk_matches_dense(self):
+        """Non-causal windowed decode must enforce BOTH band bounds:
+        fed the whole sequence as one decode chunk, it equals the dense
+        |i-j| < window forward (token-by-token streaming of a
+        bidirectional layer inherently sees only the written prefix, so
+        single-chunk is the parity case)."""
+        import jax.numpy as _jnp
+        m, p, x = self._mha(window=3, causal=False)
+        full, _ = m.apply(p, _jnp.asarray(x))
+        st = m.decode_carry(2)
+        o, _ = m.apply(p, x, state=st)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(full),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_zoo_block_passthrough_and_serde(self):
+        from deeplearning4j_tpu.zoo.transformer import (
+            TextGenerationTransformer,
+        )
+        net = TextGenerationTransformer(
+            num_classes=7, input_shape=(8, 1), d_model=16, num_heads=2,
+            num_blocks=1, window=3).init()
+        conf2 = type(net.conf).from_json(net.conf.to_json())
+        blk = [l for l in conf2.layers
+               if type(l).__name__ == "TransformerEncoderBlock"][0]
+        assert blk.window == 3
+        x = np.random.default_rng(1).integers(0, 7, (2, 8, 1)).astype(
+            np.float32)
+        assert np.isfinite(np.asarray(net.output(x))).all()
+
+    def test_invalid_window_rejected(self):
+        import jax
+        from deeplearning4j_tpu.nn.inputs import InputType
+        from deeplearning4j_tpu.nn.layers.attention import (
+            MultiHeadAttention,
+        )
+        m = MultiHeadAttention(n_in=8, n_out=8, num_heads=2, window=0)
+        with pytest.raises(ValueError, match="window"):
+            m.init_params(jax.random.PRNGKey(0), InputType.recurrent(8, 4))
+
+
 class TestBeamSearch:
     def _net(self, V=9, T=10):
         from deeplearning4j_tpu.zoo.transformer import (
