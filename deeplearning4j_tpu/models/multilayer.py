@@ -59,13 +59,18 @@ def _is_recurrent(layer: Layer) -> bool:
 def _decode_limit(decode_layers) -> Optional[int]:
     """Smallest KV-cache/position bound among decode-capable layers —
     the host-side decode-length guard's ceiling (under the jitted
-    stepping path the layers' own eager overflow checks cannot fire)."""
-    limits = [
-        lim for l in decode_layers
-        for lim in (getattr(l, "max_cache", None),
-                    getattr(l, "max_length", None))
-        if lim is not None
-    ]
+    stepping path the layers' own eager overflow checks cannot fire).
+    Rolling-cache layers stream in fixed memory, so their max_cache is
+    a buffer size, not a length bound."""
+    limits = []
+    for l in decode_layers:
+        if not getattr(l, "rolling_cache", False):
+            mc = getattr(l, "max_cache", None)
+            if mc is not None:
+                limits.append(mc)
+        ml = getattr(l, "max_length", None)
+        if ml is not None:
+            limits.append(ml)
     return min(limits) if limits else None
 
 

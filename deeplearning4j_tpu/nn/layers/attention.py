@@ -66,6 +66,10 @@ class MultiHeadAttention(Layer):
     # |i-j| < window (bidirectional) — Mistral-style locality; O(T*w)
     # useful score mass. Windowed layers use the dense band-masked path
     # (the flash kernel and the ring are full-context codepaths).
+    rolling_cache: bool = False       # causal+window decode streams in a
+    # FIXED max_cache-slot ring buffer (Mistral's rolling KV cache):
+    # slot = position % max_cache, so generation length is unbounded in
+    # O(window) memory. Each step needs max_cache >= T + window - 1.
 
     def infer_n_in(self, input_type: InputType):
         upd = {}
@@ -97,6 +101,15 @@ class MultiHeadAttention(Layer):
         self._check_heads()
         if self.window is not None and self.window < 1:
             raise ValueError(f"window must be >= 1, got {self.window}")
+        if self.rolling_cache:
+            if self.window is None or not self.causal:
+                raise ValueError(
+                    "rolling_cache needs causal=True and a window (the "
+                    "ring buffer only ever holds the last `window` keys)")
+            if self.max_cache < self.window:
+                raise ValueError(
+                    f"rolling_cache: max_cache {self.max_cache} < window "
+                    f"{self.window}; the buffer cannot hold the band")
         dkv = self._kv_heads * (d // self.num_heads)
         ks = jax.random.split(key, 4)
         winit = self._winit()
@@ -133,10 +146,20 @@ class MultiHeadAttention(Layer):
         Hkv = self._kv_heads
         Dh = self.n_out // H
         L = state["cache_k"].shape[1]
-        if T > L:
+        if self.rolling_cache:
+            # per-step feasibility is static: the T new keys plus the
+            # window tail of the oldest query must coexist in the ring
+            if T + self.window - 1 > L:
+                raise ValueError(
+                    f"rolling decode step of {T} tokens needs max_cache "
+                    f">= {T + self.window - 1} (window {self.window}), "
+                    f"have {L}")
+        elif T > L:
             raise ValueError(f"decode step of {T} tokens > max_cache {L}")
         pos = state["pos"]
-        if not isinstance(pos, jax.core.Tracer) and int(pos) + T > L:
+        if (not self.rolling_cache
+                and not isinstance(pos, jax.core.Tracer)
+                and int(pos) + T > L):
             raise ValueError(
                 f"KV cache overflow: pos {int(pos)} + step {T} > "
                 f"max_cache {L}; raise max_cache or clear state")
@@ -153,30 +176,49 @@ class MultiHeadAttention(Layer):
             positions = pos + jnp.arange(T)
             q = rope_rotate(q, positions)
             k = rope_rotate(k, positions)
-        # Tracer-safe overflow poison: under jit the eager check above
-        # cannot fire, and dynamic_update_slice would silently clamp the
-        # write into the last rows — poison the output with NaN instead
-        # so overflow is loud, not wrong.
-        q = jnp.where(pos + T <= L, q, jnp.nan)
-        z = jnp.zeros((), pos.dtype)   # index dtypes must match `pos`
-        ck = jax.lax.dynamic_update_slice(
-            state["cache_k"], k.astype(state["cache_k"].dtype),
-            (z, pos, z, z))
-        cv = jax.lax.dynamic_update_slice(
-            state["cache_v"], v.astype(state["cache_v"].dtype),
-            (z, pos, z, z))
-        k_ids = jnp.arange(L)[None, :]
-        q_ids = pos + jnp.arange(T)[:, None]
-        # causal: each new query sees cache + itself; non-causal: the
-        # whole written prefix (still never the unwritten tail)
-        vis = k_ids <= q_ids if self.causal else k_ids < pos + T
-        if self.window is not None:
-            # sliding window: `window` keys back; bidirectional also
-            # bounds the forward side (|i-j| < window, matching the
-            # dense band — still never past the written prefix)
-            vis = vis & (k_ids > q_ids - self.window)
-            if not self.causal:
-                vis = vis & (k_ids < q_ids + self.window)
+        if self.rolling_cache:
+            # Mistral-style ring buffer: slot = global position mod L.
+            # The write is a scatter (it may wrap the boundary); each
+            # slot's CURRENT occupant is recovered arithmetically from
+            # the newest written global position, so visibility needs no
+            # stored metadata.
+            slots = (pos + jnp.arange(T)) % L
+            ck = state["cache_k"].at[:, slots].set(
+                k.astype(state["cache_k"].dtype))
+            cv = state["cache_v"].at[:, slots].set(
+                v.astype(state["cache_v"].dtype))
+            end = pos + T - 1               # newest written global pos
+            j = jnp.arange(L)
+            held = end - ((end - j) % L)    # global pos held in slot j
+            q_ids = pos + jnp.arange(T)[:, None]
+            vis = ((held[None, :] >= 0)     # slot ever written
+                   & (held[None, :] <= q_ids)          # causal
+                   & (held[None, :] > q_ids - self.window))
+        else:
+            # Tracer-safe overflow poison: under jit the eager check
+            # above cannot fire, and dynamic_update_slice would silently
+            # clamp the write into the last rows — poison the output
+            # with NaN instead so overflow is loud, not wrong.
+            q = jnp.where(pos + T <= L, q, jnp.nan)
+            z = jnp.zeros((), pos.dtype)   # index dtypes must match `pos`
+            ck = jax.lax.dynamic_update_slice(
+                state["cache_k"], k.astype(state["cache_k"].dtype),
+                (z, pos, z, z))
+            cv = jax.lax.dynamic_update_slice(
+                state["cache_v"], v.astype(state["cache_v"].dtype),
+                (z, pos, z, z))
+            k_ids = jnp.arange(L)[None, :]
+            q_ids = pos + jnp.arange(T)[:, None]
+            # causal: each new query sees cache + itself; non-causal:
+            # the whole written prefix (never the unwritten tail)
+            vis = k_ids <= q_ids if self.causal else k_ids < pos + T
+            if self.window is not None:
+                # sliding window: `window` keys back; bidirectional also
+                # bounds the forward side (|i-j| < window, matching the
+                # dense band — still never past the written prefix)
+                vis = vis & (k_ids > q_ids - self.window)
+                if not self.causal:
+                    vis = vis & (k_ids < q_ids + self.window)
         if Hkv != H:
             # GQA: group the query heads against the Hkv-wide cache in
             # the einsum itself — the cache is never broadcast to H
@@ -396,6 +438,7 @@ class TransformerEncoderBlock(Layer):
     norm: str = "layer"           # "layer" | "rms"
     ffn_activation: str = "gelu"  # "gelu" | "swiglu"
     window: Optional[int] = None  # sliding-window attention (see MHA)
+    rolling_cache: bool = False   # ring-buffer decode cache (see MHA)
 
     def infer_n_in(self, input_type: InputType):
         if self.n_in is None:
@@ -411,7 +454,8 @@ class TransformerEncoderBlock(Layer):
             n_in=d, n_out=d, num_heads=self.num_heads,
             num_kv_heads=self.num_kv_heads, causal=self.causal,
             activation="identity", weight_init=self.weight_init,
-            max_cache=self.max_cache, rope=self.rope, window=self.window)
+            max_cache=self.max_cache, rope=self.rope, window=self.window,
+            rolling_cache=self.rolling_cache)
         if self.n_experts > 0:
             from deeplearning4j_tpu.parallel.moe import MoEFeedForward
 

@@ -38,7 +38,7 @@ class TextGenerationTransformer(ZooModel):
                  num_kv_heads=None, num_blocks: int = 4, n_experts: int = 0,
                  pos_encoding: str = "learned", max_decode: int = 0,
                  norm: str = "layer", ffn_activation: str = "gelu",
-                 window=None, **kw):
+                 window=None, rolling_cache: bool = False, **kw):
         super().__init__(*args, **kw)
         self.d_model = d_model
         self.num_heads = num_heads
@@ -50,6 +50,16 @@ class TextGenerationTransformer(ZooModel):
         self.norm = norm
         self.ffn_activation = ffn_activation
         self.window = window               # sliding-window attention
+        if rolling_cache and (window is None or pos_encoding != "rope"):
+            raise ValueError(
+                "rolling_cache streams unbounded generation in O(window) "
+                "memory: it needs window=w and pos_encoding='rope' "
+                "(learned positions cap decode length anyway)")
+        if rolling_cache and max_decode:
+            raise ValueError(
+                "rolling_cache makes generation length unbounded — "
+                "max_decode would be silently ignored; drop one of them")
+        self.rolling_cache = rolling_cache
         if pos_encoding not in ("learned", "rope"):
             raise ValueError(f"pos_encoding must be 'learned' or 'rope', "
                              f"got {pos_encoding!r}")
@@ -67,14 +77,20 @@ class TextGenerationTransformer(ZooModel):
         rope = self.pos_encoding == "rope"
         # learned positions cap decode length at t, so a bigger KV cache
         # would be unreachable; RoPE has no absolute-position table, so
-        # the cache (and thus generation) may extend past the training t
-        cache = max(t, self.max_decode) if rope else t
+        # the cache (and thus generation) may extend past the training t.
+        # A rolling cache needs only prefill + window slots — generation
+        # length is unbounded in that fixed buffer.
+        if self.rolling_cache:
+            cache = t + self.window - 1
+        else:
+            cache = max(t, self.max_decode) if rope else t
         blocks = [
             TransformerEncoderBlock(
                 num_heads=self.num_heads, num_kv_heads=self.num_kv_heads,
                 causal=True, n_experts=self.n_experts, max_cache=cache,
                 rope=rope, norm=self.norm,
-                ffn_activation=self.ffn_activation, window=self.window)
+                ffn_activation=self.ffn_activation, window=self.window,
+                rolling_cache=self.rolling_cache)
             for _ in range(self.num_blocks)
         ]
         pos = [] if rope else [PositionEmbeddingLayer(max_length=t)]

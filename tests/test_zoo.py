@@ -500,6 +500,123 @@ class TestSlidingWindow:
             m.init_params(jax.random.PRNGKey(0), InputType.recurrent(8, 4))
 
 
+class TestRollingCache:
+    """Mistral-style ring-buffer KV cache: unbounded causal+windowed
+    generation in O(window) memory (slot = position mod L)."""
+
+    def _mha(self, L, w, rope=False, d=16, T=10):
+        import jax
+        from deeplearning4j_tpu.nn.inputs import InputType
+        from deeplearning4j_tpu.nn.layers.attention import (
+            MultiHeadAttention,
+        )
+        m = MultiHeadAttention(n_in=d, n_out=d, num_heads=2, causal=True,
+                               window=w, rolling_cache=True, max_cache=L,
+                               rope=rope, activation="identity")
+        p, _ = m.init_params(jax.random.PRNGKey(0),
+                             InputType.recurrent(d, T))
+        return m, p
+
+    @pytest.mark.parametrize("rope", [False, True])
+    def test_long_decode_matches_windowed_full_forward(self, rope):
+        """25 steps through an 8-slot ring (window 4) equal the dense
+        windowed forward over the whole 25-token sequence."""
+        import dataclasses as _dc
+        import jax.numpy as _jnp
+        N, L, w = 25, 8, 4
+        m, p = self._mha(L, w, rope=rope, T=N)
+        x = np.random.default_rng(0).standard_normal((2, N, 16)).astype(
+            np.float32)
+        dense = _dc.replace(m, rolling_cache=False, max_cache=N)
+        full, _ = dense.apply(p, _jnp.asarray(x))
+        st = m.decode_carry(2)
+        outs = []
+        for t in range(N):
+            o, st = m.apply(p, x[:, t:t + 1, :], state=st)
+            outs.append(np.asarray(o))
+        np.testing.assert_allclose(np.concatenate(outs, axis=1),
+                                   np.asarray(full), rtol=3e-4, atol=3e-5)
+        # the buffer really is L slots, not N
+        assert st["cache_k"].shape[1] == L
+
+    def test_chunks_wrapping_the_ring_boundary(self):
+        """Multi-token chunks whose scatter wraps slot L-1 -> 0 stay
+        exact (prefill 5, then 3-token chunks through an 8-slot ring:
+        every chunk past the first crosses the modulo boundary)."""
+        import dataclasses as _dc
+        import jax.numpy as _jnp
+        N, L, w = 17, 8, 4
+        m, p = self._mha(L, w, T=N)
+        x = np.random.default_rng(1).standard_normal((1, N, 16)).astype(
+            np.float32)
+        dense = _dc.replace(m, rolling_cache=False, max_cache=N)
+        full, _ = dense.apply(p, _jnp.asarray(x))
+        st = m.decode_carry(1)
+        outs = []
+        o, st = m.apply(p, x[:, :5, :], state=st)
+        outs.append(np.asarray(o))
+        for s in range(5, N, 3):
+            o, st = m.apply(p, x[:, s:s + 3, :], state=st)
+            outs.append(np.asarray(o))
+        np.testing.assert_allclose(np.concatenate(outs, axis=1),
+                                   np.asarray(full), rtol=3e-4, atol=3e-5)
+
+    def test_step_too_big_for_ring_raises(self):
+        m, p = self._mha(L=6, w=4)
+        st = m.decode_carry(1)
+        x = np.zeros((1, 4, 16), np.float32)   # needs 4+4-1=7 > 6 slots
+        with pytest.raises(ValueError, match="rolling decode step"):
+            m.apply(p, x, state=st)
+
+    def test_invalid_configs_rejected(self):
+        import jax
+        from deeplearning4j_tpu.nn.inputs import InputType
+        from deeplearning4j_tpu.nn.layers.attention import (
+            MultiHeadAttention,
+        )
+        for kw in ({"rolling_cache": True},                    # no window
+                   {"rolling_cache": True, "window": 4,
+                    "causal": False},                          # not causal
+                   {"rolling_cache": True, "window": 8,
+                    "max_cache": 4}):                          # L < window
+            m = MultiHeadAttention(n_in=8, n_out=8, num_heads=2,
+                                   causal=kw.pop("causal", True), **kw)
+            with pytest.raises(ValueError):
+                m.init_params(jax.random.PRNGKey(0),
+                              InputType.recurrent(8, 4))
+
+    def test_generation_unbounded_and_token_exact(self):
+        """End-to-end: a rolling-cache zoo transformer generates 40
+        tokens — far past its 11-slot buffer — emitting EXACTLY the
+        tokens of the same-seed model with a big linear cache."""
+        from deeplearning4j_tpu.utils.textgen import generate
+        from deeplearning4j_tpu.zoo.transformer import (
+            TextGenerationTransformer,
+        )
+        V, T, w = 11, 8, 4
+        mk = dict(num_classes=V, input_shape=(T, 1), d_model=16,
+                  num_heads=2, num_blocks=1, pos_encoding="rope",
+                  window=w)
+        roll = TextGenerationTransformer(rolling_cache=True, **mk).init()
+        big = TextGenerationTransformer(max_decode=64, **mk).init()
+        prompt = np.random.default_rng(3).integers(0, V, (2, 5))
+        a = generate(roll, prompt, 40, greedy=True)
+        b = generate(big, prompt, 40, greedy=True)
+        np.testing.assert_array_equal(a, b)
+        # the rolling net's cache really is prefill+window sized
+        blk = [l for l in roll.layers
+               if type(l).__name__ == "TransformerEncoderBlock"][0]
+        assert blk.max_cache == T + w - 1 == 11
+
+    def test_zoo_rolling_requires_rope_and_window(self):
+        from deeplearning4j_tpu.zoo.transformer import (
+            TextGenerationTransformer,
+        )
+        with pytest.raises(ValueError, match="rolling_cache"):
+            TextGenerationTransformer(num_classes=5, input_shape=(8, 1),
+                                      rolling_cache=True)
+
+
 class TestBeamSearch:
     def _net(self, V=9, T=10):
         from deeplearning4j_tpu.zoo.transformer import (
