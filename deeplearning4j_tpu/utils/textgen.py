@@ -102,9 +102,27 @@ def _truncate(p: np.ndarray, top_k: Optional[int],
     return p
 
 
+def _prefill(net, prompt_ids, encoding, vocab, chunk: Optional[int]):
+    """Feed the prompt through the stateful stepper, optionally in
+    fixed-size chunks (bounds prefill memory; REQUIRED when a
+    rolling-cache layer's ring cannot hold the whole prompt in one
+    step). Returns the last chunk's output."""
+    if chunk is None or prompt_ids.shape[1] <= chunk:
+        return np.asarray(net.rnn_time_step(
+            _encode(prompt_ids, encoding, vocab)))
+    if chunk < 1:
+        raise ValueError(f"prefill_chunk must be >= 1, got {chunk}")
+    out = None
+    for s in range(0, prompt_ids.shape[1], chunk):
+        out = np.asarray(net.rnn_time_step(
+            _encode(prompt_ids[:, s:s + chunk], encoding, vocab)))
+    return out
+
+
 def generate(net, prompt_ids, n_tokens: int, *, temperature: float = 1.0,
              greedy: bool = False, top_k: Optional[int] = None,
              top_p: Optional[float] = None,
+             prefill_chunk: Optional[int] = None,
              rng: Optional[np.random.Generator] = None) -> np.ndarray:
     """Sample `n_tokens` continuations of `prompt_ids` ([B, Tp] ints).
 
@@ -113,8 +131,10 @@ def generate(net, prompt_ids, n_tokens: int, *, temperature: float = 1.0,
     order: `temperature` rescales (p^(1/τ)), then `top_k` keeps the k
     most probable tokens, then `top_p` keeps the smallest nucleus
     reaching that cumulative mass; `greedy` takes the argmax instead of
-    sampling (ignoring the truncation knobs). Returns the sampled ids,
-    [B, n_tokens]."""
+    sampling (ignoring the truncation knobs). `prefill_chunk` feeds the
+    prompt in chunks of that many tokens (bounds prefill memory; lets a
+    rolling-cache net consume prompts longer than its ring allows in
+    one step). Returns the sampled ids, [B, n_tokens]."""
     prompt_ids = np.asarray(prompt_ids)
     if prompt_ids.ndim == 1:
         prompt_ids = prompt_ids[None, :]
@@ -129,7 +149,7 @@ def generate(net, prompt_ids, n_tokens: int, *, temperature: float = 1.0,
         rng = np.random.default_rng(0)
 
     net.rnn_clear_previous_state()
-    out = np.asarray(net.rnn_time_step(_encode(prompt_ids, encoding, vocab)))
+    out = _prefill(net, prompt_ids, encoding, vocab, prefill_chunk)
     generated = np.empty((B, n_tokens), dtype=np.int64)
     for i in range(n_tokens):
         p = out[:, -1, :].astype(np.float64)
@@ -150,7 +170,8 @@ def generate(net, prompt_ids, n_tokens: int, *, temperature: float = 1.0,
 
 def beam_search(net, prompt_ids, n_tokens: int, *, beam_width: int = 4,
                 length_penalty: float = 0.6,
-                eos_id: Optional[int] = None) -> np.ndarray:
+                eos_id: Optional[int] = None,
+                prefill_chunk: Optional[int] = None) -> np.ndarray:
     """Beam-search decoding over the same stateful stepping as
     `generate`. The prompt is prefilled ONCE per batch row; the KV
     caches are then tiled to the beams (`net.rnn_reorder_state`) and
@@ -177,8 +198,7 @@ def beam_search(net, prompt_ids, n_tokens: int, *, beam_width: int = 4,
 
     net.rnn_clear_previous_state()
     # prefill once per row, then tile the carries to the W beams
-    out = np.asarray(net.rnn_time_step(
-        _encode(prompt_ids, encoding, vocab)))
+    out = _prefill(net, prompt_ids, encoding, vocab, prefill_chunk)
     net.rnn_reorder_state(np.repeat(np.arange(B), W))
     # every beam of a row starts from the same distribution: [B, 1, V]
     # broadcasts against the [B, W] scores

@@ -608,6 +608,33 @@ class TestRollingCache:
                if type(l).__name__ == "TransformerEncoderBlock"][0]
         assert blk.max_cache == T + w - 1 == 11
 
+    def test_chunked_prefill_handles_prompt_longer_than_ring(self):
+        """A prompt the ring cannot hold in one step works via
+        prefill_chunk, and the tokens equal the unchunked big-cache
+        model's (chunking changes memory, never results)."""
+        from deeplearning4j_tpu.utils.textgen import beam_search, generate
+        from deeplearning4j_tpu.zoo.transformer import (
+            TextGenerationTransformer,
+        )
+        V, T, w = 11, 8, 4
+        mk = dict(num_classes=V, input_shape=(T, 1), d_model=16,
+                  num_heads=2, num_blocks=1, pos_encoding="rope",
+                  window=w)
+        roll = TextGenerationTransformer(rolling_cache=True, **mk).init()
+        big = TextGenerationTransformer(max_decode=64, **mk).init()
+        # prompt of 20 > ring feasibility (11 slots, max step 8)
+        prompt = np.random.default_rng(4).integers(0, V, (2, 20))
+        with pytest.raises(ValueError, match="rolling decode step"):
+            generate(roll, prompt, 2, greedy=True)
+        a = generate(roll, prompt, 8, greedy=True, prefill_chunk=4)
+        b = generate(big, prompt, 8, greedy=True)
+        np.testing.assert_array_equal(a, b)
+        # beam search accepts the same knob
+        ab = beam_search(roll, prompt, 4, beam_width=2,
+                         length_penalty=0.0, prefill_chunk=4)
+        bb = beam_search(big, prompt, 4, beam_width=2, length_penalty=0.0)
+        np.testing.assert_array_equal(ab, bb)
+
     def test_zoo_rolling_requires_rope_and_window(self):
         from deeplearning4j_tpu.zoo.transformer import (
             TextGenerationTransformer,
