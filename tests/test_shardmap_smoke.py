@@ -30,7 +30,7 @@ def _check_names():
     # names mirror CHECKS; the count assertion below keeps them in sync
     return ["flash_fwd_shardmap", "flash_bwd_shardmap",
             "fused_lstm_shardmap", "conv_fused_shardmap", "ring_flash",
-            "kv_decode"]
+            "kv_decode", "kv_decode_gqa_rolling"]
 
 
 def test_name_list_matches_tool(smoke):

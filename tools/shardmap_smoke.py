@@ -238,9 +238,34 @@ def check_kv_decode():
     return {"max_err": _maxerr(stepped, full), "tol": 2e-3}
 
 
+def check_kv_decode_gqa_rolling():
+    """The modern decode compositions — GQA (grouped einsum against the
+    narrow cache) + sliding window + the mod-L ring-buffer scatter —
+    compile and generate on this device, token-exact vs the linear
+    big-cache model."""
+    from deeplearning4j_tpu.utils.textgen import generate
+    from deeplearning4j_tpu.zoo.transformer import TextGenerationTransformer
+    V, T, w = 13, 8, 4
+    mk = dict(num_classes=V, input_shape=(T, 1), d_model=32, num_heads=4,
+              num_kv_heads=2, num_blocks=2, pos_encoding="rope",
+              norm="rms", ffn_activation="swiglu", window=w)
+    roll = TextGenerationTransformer(rolling_cache=True, **mk).init()
+    big = TextGenerationTransformer(max_decode=64, **mk).init()
+    prompt = np.random.default_rng(6).integers(0, V, (2, 5))
+    a = generate(roll, prompt, 24, greedy=True)
+    b = generate(big, prompt, 24, greedy=True)
+    # compare token AGREEMENT with slack for one near-tie argmax flip:
+    # ring and linear caches sum attention in different orders, so an
+    # ulp-level probability difference may flip a single greedy pick on
+    # hardware (the CPU-suite parity test pins exactness; this check's
+    # job is compile+run on the chip)
+    return {"max_err": float((a != b).mean()), "tol": 0.05,
+            "note": "token mismatch fraction, ring vs linear cache"}
+
+
 CHECKS = [check_flash_fwd_shardmap, check_flash_bwd_shardmap,
           check_fused_lstm_shardmap, check_conv_fused_shardmap,
-          check_ring_flash, check_kv_decode]
+          check_ring_flash, check_kv_decode, check_kv_decode_gqa_rolling]
 
 
 def main():
