@@ -49,7 +49,19 @@ class TextGenerationTransformer(ZooModel):
         # num_kv_heads < num_heads = the Llama-architecture block shape
         self.norm = norm
         self.ffn_activation = ffn_activation
-        self.window = window               # sliding-window attention
+        # window: int applies to every block; a list/tuple gives each
+        # block its own (None = full attention) — the alternating
+        # local/global pattern (Gemma-style) is window=[w, None]*k
+        self.window = window
+        if isinstance(window, (list, tuple)):
+            if len(window) != num_blocks:
+                raise ValueError(
+                    f"per-block window list has {len(window)} entries "
+                    f"for {num_blocks} blocks")
+            if rolling_cache and any(w is None for w in window):
+                raise ValueError(
+                    "rolling_cache needs a window on EVERY block (a "
+                    "full-attention block's cache cannot roll)")
         if rolling_cache and (window is None or pos_encoding != "rope"):
             raise ValueError(
                 "rolling_cache streams unbounded generation in O(window) "
@@ -80,18 +92,23 @@ class TextGenerationTransformer(ZooModel):
         # the cache (and thus generation) may extend past the training t.
         # A rolling cache needs only prefill + window slots — generation
         # length is unbounded in that fixed buffer.
-        if self.rolling_cache:
-            cache = t + self.window - 1
-        else:
-            cache = max(t, self.max_decode) if rope else t
+        per_block = (list(self.window)
+                     if isinstance(self.window, (list, tuple))
+                     else [self.window] * self.num_blocks)
+
+        def block_cache(w):
+            if self.rolling_cache:
+                return t + w - 1     # prefill + window ring slots
+            return max(t, self.max_decode) if rope else t
+
         blocks = [
             TransformerEncoderBlock(
                 num_heads=self.num_heads, num_kv_heads=self.num_kv_heads,
-                causal=True, n_experts=self.n_experts, max_cache=cache,
-                rope=rope, norm=self.norm,
-                ffn_activation=self.ffn_activation, window=self.window,
+                causal=True, n_experts=self.n_experts,
+                max_cache=block_cache(w), rope=rope, norm=self.norm,
+                ffn_activation=self.ffn_activation, window=w,
                 rolling_cache=self.rolling_cache)
-            for _ in range(self.num_blocks)
+            for w in per_block
         ]
         pos = [] if rope else [PositionEmbeddingLayer(max_length=t)]
         return (NeuralNetConfiguration.builder()

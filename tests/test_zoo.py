@@ -474,6 +474,44 @@ class TestSlidingWindow:
         np.testing.assert_allclose(np.asarray(o), np.asarray(full),
                                    rtol=2e-4, atol=2e-5)
 
+    def test_per_block_window_pattern(self):
+        """window=[w, None] gives alternating local/global blocks
+        (Gemma-style); decode parity still holds through the mix."""
+        from deeplearning4j_tpu.utils.textgen import generate
+        from deeplearning4j_tpu.zoo.transformer import (
+            TextGenerationTransformer,
+        )
+        V, T = 9, 8
+        net = TextGenerationTransformer(
+            num_classes=V, input_shape=(T, 1), d_model=16, num_heads=2,
+            num_blocks=2, window=[3, None]).init()
+        blks = [l for l in net.layers
+                if type(l).__name__ == "TransformerEncoderBlock"]
+        assert [b.window for b in blks] == [3, None]
+        rng = np.random.default_rng(5)
+        prompt = rng.integers(0, V, (2, 3))
+        got = generate(net, prompt, 4, greedy=True)
+        # oracle: growing full-forward rollout
+        seq = prompt.copy()
+        for _ in range(4):
+            cur = seq.shape[1]
+            padded = np.zeros((2, T), seq.dtype)
+            padded[:, :cur] = seq
+            probs = np.asarray(net.output(
+                padded[..., None].astype(np.float32)))
+            tok = probs[:, cur - 1, :].argmax(-1)
+            seq = np.concatenate([seq, tok[:, None]], axis=1)
+        np.testing.assert_array_equal(got, seq[:, 3:])
+        # validation: wrong length, and rolling with a global block
+        with pytest.raises(ValueError, match="per-block window"):
+            TextGenerationTransformer(num_classes=V, input_shape=(T, 1),
+                                      num_blocks=3, window=[3, None])
+        with pytest.raises(ValueError, match="EVERY block"):
+            TextGenerationTransformer(
+                num_classes=V, input_shape=(T, 1), num_blocks=2,
+                window=[3, None], rolling_cache=True,
+                pos_encoding="rope")
+
     def test_zoo_block_passthrough_and_serde(self):
         from deeplearning4j_tpu.zoo.transformer import (
             TextGenerationTransformer,
