@@ -61,6 +61,17 @@ from deeplearning4j_tpu.parallel.training_master import (  # noqa: E402
 
 UNEVEN_N, D, CLASSES = 67, 8, 4   # 67 % 4 != 0: the uneven-topology case
 
+# pod decode stage model: ONE definition shared with the host-side
+# parity test (test_pod4_decode_tokens_match_single_process) so the
+# worker and the checker provably build the same model. Modern decode
+# config on purpose: GQA + sliding window + rolling ring buffer +
+# RMS/SwiGLU must also hold as one SPMD program over hosts.
+DECODE_NET_KW = dict(
+    num_classes=13, input_shape=(8, 1), d_model=16, num_heads=2,
+    num_kv_heads=1, num_blocks=2, pos_encoding="rope", norm="rms",
+    ffn_activation="swiglu", window=4, rolling_cache=True)
+DECODE_PROMPT_SEED = 11
+
 
 def uneven_data():
     rng = np.random.default_rng(321)
@@ -342,11 +353,10 @@ def main():
     from deeplearning4j_tpu.utils.textgen import generate
     from deeplearning4j_tpu.zoo.transformer import TextGenerationTransformer
 
-    Vg, Tg = 13, 8
-    gen_net = TextGenerationTransformer(
-        num_classes=Vg, input_shape=(Tg, 1), d_model=16, num_heads=2,
-        num_blocks=2).init()
-    gprompt = np.random.default_rng(11).integers(0, Vg, (4, 3))
+    Vg = DECODE_NET_KW["num_classes"]
+    gen_net = TextGenerationTransformer(**DECODE_NET_KW).init()
+    gprompt = np.random.default_rng(DECODE_PROMPT_SEED).integers(
+        0, Vg, (4, 3))
     ref_tokens = generate(gen_net, gprompt, 4, greedy=True)  # local replica
     gen_net.rnn_clear_previous_state()
     gen_net._jit_cache.clear()

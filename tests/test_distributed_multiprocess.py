@@ -293,11 +293,12 @@ def test_pod4_decode_tokens_match_single_process(pod4_result):
         TextGenerationTransformer,
     )
 
+    from tests._mp_worker4 import DECODE_NET_KW, DECODE_PROMPT_SEED
+
     got = np.load(os.path.join(outdir, "decode4_tokens.npy"))
-    net = TextGenerationTransformer(
-        num_classes=13, input_shape=(8, 1), d_model=16, num_heads=2,
-        num_blocks=2).init()
-    prompt = np.random.default_rng(11).integers(0, 13, (4, 3))
+    net = TextGenerationTransformer(**DECODE_NET_KW).init()
+    prompt = np.random.default_rng(DECODE_PROMPT_SEED).integers(
+        0, DECODE_NET_KW["num_classes"], (4, 3))
     want = generate(net, prompt, 4, greedy=True)
     np.testing.assert_array_equal(got, want)
 

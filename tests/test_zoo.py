@@ -786,6 +786,48 @@ class TestBeamSearch:
         out = beam_search(net, np.zeros((2, 2), np.int64), 0, eos_id=1)
         assert out.shape == (2, 0)
 
+    def test_beam_on_computation_graph(self):
+        """beam_search drives ComputationGraph models too: carry
+        reordering goes through CG.rnn_reorder_state; width-1 equals
+        greedy generate on the same graph."""
+        from deeplearning4j_tpu.models import ComputationGraph
+        from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+        from deeplearning4j_tpu.nn.inputs import InputType
+        from deeplearning4j_tpu.nn.layers.attention import (
+            PositionEmbeddingLayer, TransformerEncoderBlock,
+        )
+        from deeplearning4j_tpu.nn.layers.feedforward import (
+            EmbeddingSequenceLayer,
+        )
+        from deeplearning4j_tpu.nn.layers.recurrent import RnnOutputLayer
+        from deeplearning4j_tpu.optim.updaters import Adam
+        from deeplearning4j_tpu.utils.textgen import beam_search, generate
+
+        V, T = 9, 10
+        conf = (NeuralNetConfiguration.builder()
+                .seed(0).updater(Adam(1e-3)).activation("identity")
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("emb", EmbeddingSequenceLayer(n_in=V, n_out=12),
+                           "in")
+                .add_layer("pos", PositionEmbeddingLayer(max_length=T),
+                           "emb")
+                .add_layer("blk", TransformerEncoderBlock(num_heads=2),
+                           "pos")
+                .add_layer("out", RnnOutputLayer(n_out=V,
+                                                 activation="softmax"),
+                           "blk")
+                .set_outputs("out")
+                .set_input_types(InputType.recurrent(1, T))
+                .build())
+        net = ComputationGraph(conf).init()
+        prompt = np.random.default_rng(6).integers(0, V, (2, 3))
+        g = generate(net, prompt, 4, greedy=True)
+        b1 = beam_search(net, prompt, 4, beam_width=1, length_penalty=0.0)
+        np.testing.assert_array_equal(g, b1)
+        b3 = beam_search(net, prompt, 4, beam_width=3, length_penalty=0.0)
+        assert b3.shape == (2, 4)
+
 
 class TestLlamaStyleBlock:
     """RMSNorm + SwiGLU options on TransformerEncoderBlock — with RoPE
