@@ -122,19 +122,24 @@ def _prefill(net, prompt_ids, encoding, vocab, chunk: Optional[int]):
 def generate(net, prompt_ids, n_tokens: int, *, temperature: float = 1.0,
              greedy: bool = False, top_k: Optional[int] = None,
              top_p: Optional[float] = None,
+             repetition_penalty: float = 1.0,
              prefill_chunk: Optional[int] = None,
              rng: Optional[np.random.Generator] = None) -> np.ndarray:
     """Sample `n_tokens` continuations of `prompt_ids` ([B, Tp] ints).
 
     The network's output layer must produce per-timestep class
     probabilities (softmax). Decoding controls compose in the standard
-    order: `temperature` rescales (p^(1/τ)), then `top_k` keeps the k
-    most probable tokens, then `top_p` keeps the smallest nucleus
-    reaching that cumulative mass; `greedy` takes the argmax instead of
-    sampling (ignoring the truncation knobs). `prefill_chunk` feeds the
-    prompt in chunks of that many tokens (bounds prefill memory; lets a
-    rolling-cache net consume prompts longer than its ring allows in
-    one step). Returns the sampled ids, [B, n_tokens]."""
+    order: `repetition_penalty` > 1 suppresses tokens already in the
+    prompt or generated so far (probability-space CTRL variant: seen
+    tokens' probabilities are raised to that power before
+    renormalization), then `temperature` rescales (p^(1/τ)), then
+    `top_k` keeps the k most probable tokens, then `top_p` keeps the
+    smallest nucleus reaching that cumulative mass; `greedy` takes the
+    argmax (after the repetition penalty; the truncation knobs are
+    moot). `prefill_chunk` feeds the prompt in chunks of that many
+    tokens (bounds prefill memory; lets a rolling-cache net consume
+    prompts longer than its ring allows in one step). Returns the
+    sampled ids, [B, n_tokens]."""
     prompt_ids = np.asarray(prompt_ids)
     if prompt_ids.ndim == 1:
         prompt_ids = prompt_ids[None, :]
@@ -142,17 +147,34 @@ def generate(net, prompt_ids, n_tokens: int, *, temperature: float = 1.0,
         raise ValueError(f"top_k must be >= 1, got {top_k}")
     if top_p is not None and not 0.0 < top_p <= 1.0:
         raise ValueError(f"top_p must be in (0, 1], got {top_p}")
+    if repetition_penalty < 1.0:
+        raise ValueError(
+            f"repetition_penalty must be >= 1, got {repetition_penalty}")
     B = prompt_ids.shape[0]
     first_layer, vocab = _resolve_net(net)
     encoding = _input_encoding(first_layer)
     if rng is None:
         rng = np.random.default_rng(0)
 
+    penalize = repetition_penalty != 1.0
+    if penalize:
+        seen = np.zeros((B, vocab), dtype=bool)
+        np.put_along_axis(seen, prompt_ids.astype(np.int64) % vocab, True,
+                          axis=-1)
     net.rnn_clear_previous_state()
     out = _prefill(net, prompt_ids, encoding, vocab, prefill_chunk)
     generated = np.empty((B, n_tokens), dtype=np.int64)
     for i in range(n_tokens):
         p = out[:, -1, :].astype(np.float64)
+        if penalize:
+            # floor AFTER the power too: a huge penalty on a small vocab
+            # can underflow every seen prob to exactly 0, and once all
+            # tokens are seen the renormalization would divide by zero
+            p = np.where(seen,
+                         np.maximum(np.power(np.maximum(p, 1e-30),
+                                             repetition_penalty), 1e-300),
+                         p)
+            p = p / p.sum(axis=-1, keepdims=True)
         if greedy:
             tok = p.argmax(axis=-1)
         else:
@@ -162,6 +184,8 @@ def generate(net, prompt_ids, n_tokens: int, *, temperature: float = 1.0,
             p = p / p.sum(axis=-1, keepdims=True)
             tok = np.array([rng.choice(vocab, p=p[b]) for b in range(B)])
         generated[:, i] = tok
+        if penalize:
+            seen[np.arange(B), tok] = True
         if i + 1 < n_tokens:
             out = np.asarray(net.rnn_time_step(
                 _encode(tok[:, None], encoding, vocab)))

@@ -227,6 +227,40 @@ class TestSamplingControls:
             generate(net, np.zeros((1, 2), np.int64), 2, top_k=0)
         with pytest.raises(ValueError, match="top_p"):
             generate(net, np.zeros((1, 2), np.int64), 2, top_p=0.0)
+        with pytest.raises(ValueError, match="repetition_penalty"):
+            generate(net, np.zeros((1, 2), np.int64), 2,
+                     repetition_penalty=0.5)
+
+    def test_repetition_penalty_breaks_greedy_loops(self):
+        """A greedy rollout that degenerates into a repeated token must
+        diversify under a strong repetition penalty; penalty=1 is a
+        no-op (token-identical to plain greedy)."""
+        from deeplearning4j_tpu.utils.textgen import generate
+        from deeplearning4j_tpu.zoo.transformer import (
+            TextGenerationTransformer,
+        )
+
+        V, T = 11, 12
+        net = TextGenerationTransformer(num_classes=V, input_shape=(T, 1),
+                                        d_model=16, num_heads=2,
+                                        num_blocks=1, pos_encoding="rope",
+                                        max_decode=32).init()
+        prompt = np.random.default_rng(2).integers(0, V, (1, 3))
+        plain = generate(net, prompt, 8, greedy=True)
+        noop = generate(net, prompt, 8, greedy=True,
+                        repetition_penalty=1.0)
+        np.testing.assert_array_equal(plain, noop)
+        strong = generate(net, prompt, 8, greedy=True,
+                          repetition_penalty=50.0)
+        # with a near-infinite penalty, greedy cannot emit any token
+        # twice until the vocabulary is exhausted
+        assert len(set(strong[0].tolist())) == 8, strong
+        # vocabulary exhaustion (n_tokens > V with a huge penalty) must
+        # not NaN out: probs are floored after the power as well
+        long = generate(net, prompt, V + 5, greedy=True,
+                        repetition_penalty=400.0)
+        assert long.shape == (1, V + 5)
+        assert (0 <= long).all() and (long < V).all()
 
 
 def test_generate_refuses_multi_io_graph():
