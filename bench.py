@@ -21,7 +21,12 @@ Env knobs: BENCH_MODEL=resnet50|vgg16|lstm|sentiment|inception|lenet|transformer
 each), BENCH_BATCH, BENCH_STEPS, BENCH_DTYPE, BENCH_ATTEMPT_TIMEOUT (s),
 BENCH_NO_FALLBACK=1, BENCH_S2D=1 (space-to-depth ResNet stem, own
 metric), BENCH_FUSED=1 (Pallas conv-epilogue fusion, own
-metric), BENCH_PROFILE=<dir> (jax.profiler trace of post-warmup steps).
+metric), BENCH_PROFILE=<dir> (jax.profiler trace of post-warmup steps),
+BENCH_STEPS_PER_DISPATCH (recorded in the JSON; sets K for
+`--host-overhead`). `python bench.py --host-overhead` (or
+BENCH_HOST_OVERHEAD=1) skips the ladder and measures per-step host
+overhead of the fit hot path with forced per-step sync vs deferred loss
+sync vs K-step fused dispatch (see _host_overhead_main).
 """
 
 from __future__ import annotations
@@ -92,6 +97,12 @@ def _compile(fn, donate, *args):
     return compiled, flops
 
 
+# Host-sync accounting for the emitted JSON: _timed_ips fetches ONE scalar
+# loss per timing leg (that is the sync), so host_sync_per_step = legs/steps
+# — the dispatch-depth evidence mirrored by tests/test_perf_guard.py.
+_SYNC_STATS = {"syncs": 0, "steps": 0}
+
+
 def _timed_ips(run, batch: int, steps: int):
     """Two-point timing that is robust to the tunneled TPU runtime, where
     block_until_ready returns early and every host fetch pays seconds of
@@ -103,6 +114,8 @@ def _timed_ips(run, batch: int, steps: int):
     steps into <dir> (the utils/profiling.py seam, for MFU analysis)."""
     loss = run(3)           # compile + warmup
     _ = float(loss)
+    _SYNC_STATS["syncs"] += 1
+    _SYNC_STATS["steps"] += 3
     prof_dir = os.environ.get("BENCH_PROFILE")
     if prof_dir:
         from deeplearning4j_tpu.utils.profiling import trace
@@ -120,6 +133,8 @@ def _timed_ips(run, batch: int, steps: int):
     def _leg(n):
         t0 = time.perf_counter()
         last_loss[0] = float(run(n))
+        _SYNC_STATS["syncs"] += 1
+        _SYNC_STATS["steps"] += n
         return time.perf_counter() - t0
 
     samples = {}
@@ -575,6 +590,13 @@ def _child_main():
         "device": getattr(dev, "device_kind", str(dev)),
         "platform": dev.platform,
         "final_loss": round(loss, 4),
+        # async-dispatch evidence: scalar fetches per executed step in the
+        # measured loop, and the dispatch fusion factor in effect
+        "host_sync_per_step": (
+            round(_SYNC_STATS["syncs"] / _SYNC_STATS["steps"], 6)
+            if _SYNC_STATS["steps"] else None),
+        "steps_per_dispatch": int(
+            os.environ.get("BENCH_STEPS_PER_DISPATCH", "1")),
     }))
 
 
@@ -668,7 +690,130 @@ def _load_last_tpu(metric):
     return _load_tpu_records().get(metric)
 
 
+def _host_overhead_main():
+    """`--host-overhead` mode: per-step wall time of the fit hot path in a
+    host-overhead-dominated regime (a tiny MLP, where device compute is
+    negligible and dispatch + scalar fetches are the cost). The legs drive
+    the network's REAL fit-path step methods on pre-built same-shape
+    batches, so ETL/iterator cost — which the prefetch iterators address
+    separately and which is identical across modes — stays out of the
+    comparison:
+
+      sync      — `float(net._fit_batch(ds))` every step: the pre-async
+                  behaviour, one forced host round-trip per step
+      deferred  — `net._fit_batch(ds)` only (loss stays on device), one
+                  block at the end: the default executor path
+      fused     — `net._fused_dispatch(...)` in K-step lax.scan chunks:
+                  the opt-in `steps_per_dispatch=K` path
+      floor     — ONE scan over all steps: a single host dispatch for the
+                  whole run, i.e. (approximately) pure device compute
+
+    Host overhead per step is (wall − floor); `host_overhead_reduction`
+    = (sync − floor) / (fused − floor) — how much of the per-step host
+    cost the pipelined path removes. Emits one JSON line like the
+    throughput modes so the win lands in the bench trajectory."""
+    import jax
+
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.inputs import InputType
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.optim.updaters import Sgd
+
+    batch = int(os.environ.get("BENCH_BATCH", "64"))
+    steps = int(os.environ.get("BENCH_STEPS", "256"))
+    k = int(os.environ.get("BENCH_STEPS_PER_DISPATCH", "8"))
+    steps -= steps % k          # keep every mode at the same step count
+    rng = np.random.default_rng(0)
+    dss = [DataSet(rng.standard_normal((batch, 16)).astype(np.float32),
+                   np.eye(4, dtype=np.float32)[rng.integers(0, 4, batch)])
+           for _ in range(steps)]
+
+    def build():
+        conf = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(0.01))
+                .list()
+                .layer(DenseLayer(n_out=32, activation="relu"))
+                .layer(OutputLayer(n_out=4, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(16)).build())
+        return MultiLayerNetwork(conf).init()
+
+    def measure(mode, kk=k):
+        net = build()
+        if mode in ("fused", "floor"):
+            net._fused_dispatch(dss[:kk])        # compile the scan
+        else:
+            net._fit_batch(dss[0])
+        jax.block_until_ready(net.params_tree)
+        best = float("inf")
+        for _ in range(3):                       # jitter only adds time
+            t0 = time.perf_counter()
+            if mode == "sync":
+                for ds in dss:
+                    float(net._fit_batch(ds))
+            elif mode == "deferred":
+                for ds in dss:
+                    net._fit_batch(ds)
+            else:
+                for i in range(0, steps, kk):
+                    net._fused_dispatch(dss[i:i + kk])
+            jax.block_until_ready(net.params_tree)
+            best = min(best, (time.perf_counter() - t0) / steps * 1e3)
+        return best
+
+    sync_ms = measure("sync")
+    deferred_ms = measure("deferred")
+    fused_ms = measure("fused")
+    floor_ms = measure("floor", steps)
+
+    def overhead(ms):
+        return max(ms - floor_ms, 0.0)
+
+    def reduction(ms):
+        denom = overhead(ms)
+        return round(overhead(sync_ms) / denom, 3) if denom > 0 else None
+
+    # tie the JSON to the real fit() loop: host syncs per step as the
+    # LossTracker counts them through a default (deferred) fit
+    net = build()
+    feats = np.concatenate([d.features for d in dss[:32]])
+    labs = np.concatenate([d.labels for d in dss[:32]])
+    net.fit(feats, labs, batch_size=batch, epochs=2)
+    tracked = net._loss_tracker
+    dev = jax.devices()[0]
+    print(json.dumps({
+        "metric": "host_overhead",
+        "unit": "ms/step",
+        "value": round(overhead(fused_ms), 4),
+        "batch": batch,
+        "steps": steps,
+        "steps_per_dispatch": k,
+        "sync_ms_per_step": round(sync_ms, 4),
+        "deferred_ms_per_step": round(deferred_ms, 4),
+        "fused_ms_per_step": round(fused_ms, 4),
+        "compute_floor_ms_per_step": round(floor_ms, 4),
+        "host_overhead_ms_per_step": {
+            "sync": round(overhead(sync_ms), 4),
+            "deferred": round(overhead(deferred_ms), 4),
+            "fused": round(overhead(fused_ms), 4),
+        },
+        "host_overhead_reduction": reduction(fused_ms),
+        "host_overhead_reduction_deferred_only": reduction(deferred_ms),
+        "host_sync_per_step": {
+            "sync": 1.0,
+            "deferred_fit": round(
+                tracked.host_syncs / max(1, tracked.updates), 6),
+        },
+        "device": getattr(dev, "device_kind", str(dev)),
+        "platform": dev.platform,
+    }))
+
+
 def main():
+    if "--host-overhead" in sys.argv or os.environ.get("BENCH_HOST_OVERHEAD"):
+        _host_overhead_main()
+        return
     if os.environ.get("BENCH_CHILD"):
         _child_main()
         return

@@ -151,13 +151,46 @@ class AsyncDataSetIterator(DataSetIterator):
     def __next__(self) -> DataSet:
         if self._queue is None:
             self.reset()
+        if self._error is not None:
+            # Fail fast: don't hand out already-buffered batches once the
+            # pump has died — the consumer would train on a silently
+            # truncated epoch before seeing the error.
+            err, self._error = self._error, None
+            self.close()
+            raise err
         item = self._queue.get()
         if item is self._SENTINEL:
             self._queue = None
             if self._error is not None:
-                raise self._error
+                err, self._error = self._error, None
+                raise err
             raise StopIteration
         return item
+
+    def close(self) -> None:
+        """Stop the pump and join the worker thread. Safe to call twice;
+        called automatically when used as a context manager."""
+        if self._stop is not None:
+            self._stop.set()
+        q, t = self._queue, self._thread
+        if q is not None:
+            # Drain so a pump blocked on a full queue observes the stop
+            # event and exits promptly.
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+        if t is not None and t.is_alive():
+            t.join(timeout=5.0)
+        self._queue = None
+        self._thread = None
+
+    def __enter__(self) -> "AsyncDataSetIterator":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
 
     @property
     def batch_size(self):
@@ -252,8 +285,123 @@ class BenchmarkDataSetIterator(DataSetIterator):
         return int(self._labels.shape[-1])
 
 
+class IterableDataSetIterator(DataSetIterator):
+    """Adapt any Python iterable of pre-built DataSet/MultiDataSet batches
+    (list, generator, custom loader) to the DataSetIterator protocol.
+
+    Re-iterables (lists, custom __iter__ objects) get a fresh ``iter()``
+    every reset, so multi-epoch ``fit(..., epochs=N)`` replays each epoch.
+    One-shot iterators/generators are replay-cached: batches seen in the
+    first pass are recorded and replayed on subsequent resets (the
+    generator itself can only be consumed once)."""
+
+    def __init__(self, source):
+        self._replay = isinstance(source, Iterator)
+        self._source = iter(source) if self._replay else source
+        self._cache: List = []
+        self._first_pass = True
+        self._inner: Optional[Iterator] = None
+
+    def reset(self):
+        if self._replay:
+            if self._first_pass:
+                self._inner = self._source
+            else:
+                self._inner = iter(self._cache)
+        else:
+            self._inner = iter(self._source)
+
+    def __next__(self):
+        if self._inner is None:
+            self.reset()
+        try:
+            item = next(self._inner)
+        except StopIteration:
+            if self._replay and self._first_pass:
+                self._first_pass = False
+            raise
+        if self._replay and self._first_pass:
+            self._cache.append(item)
+        return item
+
+
+class DevicePrefetchIterator(DataSetIterator):
+    """Overlap host→device transfer with compute: issue `jax.device_put`
+    for batch N+1 while batch N's step is still executing.
+
+    `device_put` merely ENQUEUES the transfer (JAX dispatch is async), so
+    no thread is needed — this iterator just runs ``depth`` batches ahead
+    of the consumer, double-buffered by default. Composes with
+    `AsyncDataSetIterator` underneath (thread hides host ETL, this hides
+    the H2D copy).
+
+    ``put_fn(array) -> jax.Array`` defaults to `jax.device_put`; the
+    data-parallel trainer passes a sharding-aware put so each batch lands
+    pre-sharded across the mesh. ``transform(ds) -> ds`` is a host-side
+    hook applied before the put (e.g. padding to device-count divisible).
+    """
+
+    def __init__(self, base: DataSetIterator, depth: int = 2,
+                 put_fn: Optional[Callable] = None,
+                 transform: Optional[Callable] = None):
+        self._base = base
+        self._depth = max(1, int(depth))
+        self._put_fn = put_fn
+        self._transform = transform
+        self._inner: Optional[Iterator] = None
+        self._buf: List = []
+        self._exhausted = False
+
+    def _put(self, ds):
+        import jax
+
+        put = self._put_fn or jax.device_put
+        if self._transform is not None:
+            ds = self._transform(ds)
+        if hasattr(ds, "features_masks"):   # MultiDataSet
+            cls = type(ds)
+            pl = lambda xs: None if xs is None else type(xs)(
+                None if x is None else put(x) for x in xs)
+            return cls(pl(ds.features), pl(ds.labels),
+                       pl(ds.features_masks), pl(ds.labels_masks))
+        p = lambda a: None if a is None else put(a)
+        return DataSet(p(ds.features), p(ds.labels),
+                       p(ds.features_mask), p(ds.labels_mask))
+
+    def _fill(self):
+        while not self._exhausted and len(self._buf) < self._depth:
+            try:
+                self._buf.append(self._put(next(self._inner)))
+            except StopIteration:
+                self._exhausted = True
+
+    def reset(self):
+        self._inner = iter(self._base)
+        self._buf = []
+        self._exhausted = False
+
+    def __next__(self):
+        if self._inner is None:
+            self.reset()
+        self._fill()
+        if not self._buf:
+            raise StopIteration
+        item = self._buf.pop(0)
+        self._fill()    # immediately enqueue the replacement transfer
+        return item
+
+    @property
+    def batch_size(self):
+        return self._base.batch_size
+
+    @property
+    def num_outcomes(self):
+        return self._base.num_outcomes
+
+
 def as_iterator(data, labels=None, batch_size: int = 32) -> DataSetIterator:
-    """Coerce arrays / DataSet / iterator into a DataSetIterator."""
+    """Coerce arrays / DataSet / iterables of DataSets / iterator into a
+    DataSetIterator."""
     if isinstance(data, DataSetIterator):
         return data
     if isinstance(data, DataSet):
@@ -261,7 +409,21 @@ def as_iterator(data, labels=None, batch_size: int = 32) -> DataSetIterator:
             data.features, data.labels, batch_size,
             data.features_mask, data.labels_mask,
         )
+    if labels is None and _is_dataset_iterable(data):
+        return IterableDataSetIterator(data)
     return ArrayDataSetIterator(data, labels, batch_size)
+
+
+def _is_dataset_iterable(data) -> bool:
+    """True for generators/iterators, and for non-array iterables whose
+    first element is a DataSet-like batch (has .features)."""
+    if isinstance(data, Iterator):
+        return True
+    if isinstance(data, np.ndarray) or hasattr(data, "shape"):
+        return False
+    if isinstance(data, (list, tuple)) and data:
+        return hasattr(data[0], "features")
+    return False
 
 
 class FileSplitDataSetIterator(DataSetIterator):

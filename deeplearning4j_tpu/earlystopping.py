@@ -220,8 +220,12 @@ class EarlyStoppingTrainer:
         self.iterator = train_iterator
 
     def _fit_batch(self, ds) -> float:
-        """One train step — the seam the parallel trainer overrides."""
-        return self.net._fit_batch(ds)
+        """One train step — the seam the parallel trainer overrides.
+        Early stopping inspects the score every step (iteration
+        termination conditions), so this is a per-step-visibility
+        workload: materialize the deferred device loss here, at the
+        consumption boundary."""
+        return float(self.net._fit_batch(ds))
 
     def fit(self) -> EarlyStoppingResult:
         cfg = self.config
@@ -303,6 +307,6 @@ class EarlyStoppingParallelTrainer(EarlyStoppingTrainer):
                                    prefetch_buffer=0)
 
     def _fit_batch(self, ds) -> float:
-        score = self._pw._step(self._pw._pad_to_divisible(ds))
+        score = float(self._pw._step(self._pw._pad_to_divisible(ds)))
         self.net.score_ = score
         return score

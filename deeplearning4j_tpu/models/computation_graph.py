@@ -21,7 +21,10 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
-from deeplearning4j_tpu.data.iterators import DataSetIterator, as_iterator
+from deeplearning4j_tpu.data.iterators import (
+    DataSetIterator, DevicePrefetchIterator, as_iterator,
+)
+from deeplearning4j_tpu.optim.executor import LossTracker, TrainingExecutor
 from deeplearning4j_tpu.nn.graph import (
     ComputationGraphConfiguration, GraphVertex, LayerVertex,
     resolve_output_type,
@@ -56,13 +59,23 @@ class ComputationGraph(SeqCtxJitCache, SeqCtxSolverCache):
         self.epoch = 0
         self.listeners: List[TrainingListener] = []
         self.last_batch_size: Optional[int] = None
-        self.score_: Optional[float] = None
+        self._loss_tracker = LossTracker()
         self._rng = jax.random.PRNGKey(conf.seed)
         self._rnn_carries: Dict[str, Any] = {}  # rnnTimeStep statefulness
         self._stateful: set = set()
         self._vertex_updaters: Dict[str, Updater] = {}
         self._jit_caches: Dict[Any, Dict[Any, Any]] = {}
         self._solvers: Dict[Any, Any] = {}      # full-batch solver cache
+
+    @property
+    def score_(self) -> Optional[float]:
+        """Most recent training loss as a float — reading this materializes
+        the deferred device loss (see MultiLayerNetwork.score_)."""
+        return self._loss_tracker.value
+
+    @score_.setter
+    def score_(self, value) -> None:
+        self._loss_tracker.set(value)
 
     # ------------------------------------------------------------- init
     def init(self) -> "ComputationGraph":
@@ -304,55 +317,119 @@ class ComputationGraph(SeqCtxJitCache, SeqCtxSolverCache):
         return feats, labs, fmasks, lmasks
 
     # ---------------------------------------------------------- fit API
-    def fit(self, data, labels=None, *, epochs: int = 1, batch_size: int = 32):
+    def fit(self, data, labels=None, *, epochs: int = 1, batch_size: int = 32,
+            steps_per_dispatch: int = 1, device_prefetch: bool = True,
+            sync_every: int = 0):
         """Reference: `ComputationGraph.fit(DataSetIterator):778` (also
-        accepts MultiDataSet / arrays / iterator)."""
+        accepts MultiDataSet / arrays / iterator / iterable of batches).
+        Pipelined per the async-dispatch contract — see
+        `MultiLayerNetwork.fit` for the knob semantics. Each epoch
+        re-iterates the source (`iter(...)` per epoch), so multi-epoch fit
+        over a DataSetIterator or an iterable of DataSets replays every
+        batch every epoch."""
         if self.params_tree is None:
             raise RuntimeError("Network not initialized — call init() first")
         if isinstance(data, MultiDataSet):
-            batches: Sequence = [data]
-            iterable = lambda: batches
+            iterable: Any = [data]
         else:
-            it = as_iterator(data, labels, batch_size)
-            iterable = lambda: it
-        for l in self.listeners:
-            l.on_fit_start(self)
-        for _ in range(epochs):
-            for l in self.listeners:
-                l.on_epoch_start(self, self.epoch)
-            for ds in iterable():
-                feats, labs, fmasks, lmasks = self._to_dicts(ds)
-                self.last_batch_size = next(iter(feats.values())).shape[0]
-                if self.conf.optimization_algo != \
-                        "stochastic_gradient_descent":
-                    from deeplearning4j_tpu.optim.solvers import (
-                        fit_with_solver,
-                    )
-
-                    loss = fit_with_solver(self, feats, labs, fmasks, lmasks)
-                elif (self.conf.tbptt_fwd_length > 0
-                        and all(v.ndim == 3 for v in feats.values())):
-                    loss = self._fit_tbptt(feats, labs, fmasks, lmasks)
-                else:
-                    key = (fmasks is not None, lmasks is not None)
-                    fn = self._get_train_step(key)
-                    self._rng, k = jax.random.split(self._rng)
-                    (self.params_tree, self.updater_state, self.state_tree,
-                     loss) = fn(self.params_tree, self.updater_state,
-                                self.state_tree,
-                                jnp.asarray(self.iteration, jnp.int32),
-                                feats, labs, fmasks, lmasks, k)
-                    loss = float(loss)
-                self.score_ = loss
-                self.iteration += 1
-                for l in self.listeners:
-                    l.iteration_done(self, self.iteration, self.epoch, self.score_)
-            for l in self.listeners:
-                l.on_epoch_end(self, self.epoch)
-            self.epoch += 1
-        for l in self.listeners:
-            l.on_fit_end(self)
+            iterable = as_iterator(data, labels, batch_size)
+        if device_prefetch:
+            iterable = DevicePrefetchIterator(
+                iterable, depth=max(2, int(steps_per_dispatch)))
+        self._loss_tracker.sync_every = int(sync_every)
+        TrainingExecutor(
+            self,
+            step=self._fit_batch,
+            fused_step=self._fused_dispatch,
+            can_fuse=self._can_fuse,
+            steps_per_dispatch=steps_per_dispatch,
+        ).run(iterable, epochs)
         return self
+
+    def _fit_batch(self, ds: Union[DataSet, MultiDataSet]):
+        """One training step; returns the loss as a DEVICE array on the
+        SGD path (deferred sync — see LossTracker)."""
+        feats, labs, fmasks, lmasks = self._to_dicts(ds)
+        self.last_batch_size = next(iter(feats.values())).shape[0]
+        if self.conf.optimization_algo != "stochastic_gradient_descent":
+            from deeplearning4j_tpu.optim.solvers import fit_with_solver
+
+            return fit_with_solver(self, feats, labs, fmasks, lmasks)
+        if (self.conf.tbptt_fwd_length > 0
+                and all(v.ndim == 3 for v in feats.values())):
+            return self._fit_tbptt(feats, labs, fmasks, lmasks)
+        key = (fmasks is not None, lmasks is not None)
+        fn = self._get_train_step(key)
+        self._rng, k = jax.random.split(self._rng)
+        (self.params_tree, self.updater_state, self.state_tree,
+         loss) = fn(self.params_tree, self.updater_state, self.state_tree,
+                    jnp.asarray(self.iteration, jnp.int32),
+                    feats, labs, fmasks, lmasks, k)
+        return loss
+
+    def _can_fuse(self, ds) -> bool:
+        if self.conf.optimization_algo != "stochastic_gradient_descent":
+            return False
+        if self.conf.tbptt_fwd_length > 0:
+            fs = ds.features if hasattr(ds, "features_masks") else [ds.features]
+            if all(f.ndim == 3 for f in fs):
+                return False
+        return True
+
+    def _get_fused_step(self, key, k: int):
+        cache_key = ("fused", key, k)
+        if cache_key in self._jit_cache:
+            return self._jit_cache[cache_key]
+        base = self._build_step(jit=False, tbptt=False)
+
+        def fused(params, opt_state, states, step0, rng, feats, labs, fms,
+                  lms):
+            # rng splits inside the scan carry — the same sequential
+            # `self._rng, k = split(self._rng)` chain as the K=1 path.
+            def body(carry, xs):
+                p, o, s, step, r = carry
+                f, l, fm, lm = xs
+                r, sub = jax.random.split(r)
+                new_p, new_o, persist, loss = base(
+                    p, o, s, step, f, l, fm, lm, sub, None)
+                return (new_p, new_o, persist, step + 1, r), loss
+
+            (params, opt_state, states, _, rng), losses = jax.lax.scan(
+                body, (params, opt_state, states, step0, rng),
+                (feats, labs, fms, lms))
+            return params, opt_state, states, rng, losses
+
+        fn = jax.jit(fused, donate_argnums=(0, 1, 2))
+        self._jit_cache[cache_key] = fn
+        return fn
+
+    def _fused_dispatch(self, batches: Sequence):
+        """K same-shape batches → one `lax.scan` dispatch → (K,) losses."""
+        # host=True keeps leaves as numpy when the batch is host-resident,
+        # so each tensor stacks on host and crosses to device ONCE; a
+        # prefetched (device-array) batch keeps the jnp path instead.
+        f0 = batches[0].features
+        host = isinstance(
+            f0[0] if hasattr(batches[0], "features_masks") else f0,
+            np.ndarray)
+        conv = [self._to_dicts(b, host=host) for b in batches]
+        self.last_batch_size = next(iter(conv[0][0].values())).shape[0]
+        stack = ((lambda vs: jnp.asarray(np.stack(vs))) if host
+                 else jnp.stack)
+
+        def stk(idx):
+            head = conv[0][idx]
+            if head is None:
+                return None
+            return {n: stack([c[idx][n] for c in conv]) for n in head}
+
+        key = (conv[0][2] is not None, conv[0][3] is not None)
+        fn = self._get_fused_step(key, len(batches))
+        (self.params_tree, self.updater_state, self.state_tree, self._rng,
+         losses) = fn(self.params_tree, self.updater_state, self.state_tree,
+                      np.int32(self.iteration), self._rng,
+                      stk(0), stk(1), stk(2), stk(3))
+        return losses
 
     def _fit_tbptt(self, feats, labs, fmasks, lmasks) -> float:
         """Truncated BPTT over every 3-D input/label dict entry; RNN vertex
@@ -391,8 +468,9 @@ class ComputationGraph(SeqCtxJitCache, SeqCtxSolverCache):
                 sl(feats, t_lo, hi), sl(labs, t_lo, hi),
                 sl(fmasks, t_lo, hi), sl(lmasks, t_lo, hi), k,
                 carries if carries else None)
-            losses.append(float(loss))
-        return float(np.mean(losses))
+            losses.append(loss)
+        # Mean on device — no per-chunk host syncs.
+        return jnp.stack(losses).mean()
 
     def _advance_carries(self, feats, fmasks, carries):
         """Gradient-free forward that only advances RNN vertex carries."""

@@ -26,8 +26,11 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.data.dataset import DataSet
-from deeplearning4j_tpu.data.iterators import DataSetIterator, as_iterator
+from deeplearning4j_tpu.data.iterators import (
+    DataSetIterator, DevicePrefetchIterator, as_iterator,
+)
 from deeplearning4j_tpu.nn.config import MultiLayerConfiguration
+from deeplearning4j_tpu.optim.executor import LossTracker, TrainingExecutor
 from deeplearning4j_tpu.nn.layers.base import Layer
 from deeplearning4j_tpu.nn.layers.recurrent import (
     BaseRecurrentLayer, Bidirectional, GravesBidirectionalLSTM, LastTimeStep,
@@ -141,13 +144,24 @@ class MultiLayerNetwork(SeqCtxJitCache, SeqCtxSolverCache):
         self.epoch = 0
         self.listeners: List[TrainingListener] = []
         self.last_batch_size: Optional[int] = None
-        self.score_: Optional[float] = None
+        self._loss_tracker = LossTracker()
         self._rng = jax.random.PRNGKey(conf.seed)
         self._stateful: set = set()           # layers with persistent state (BN)
         self._layer_updaters: Dict[str, Updater] = {}
         self._jit_caches: Dict[Any, Dict[Any, Any]] = {}
         self._rnn_carries: Dict[str, Any] = {}  # rnnTimeStep statefulness
         self._solvers: Dict[Any, Any] = {}      # full-batch solver cache
+
+    @property
+    def score_(self) -> Optional[float]:
+        """Most recent training loss as a float. Reading this MATERIALIZES
+        the deferred device loss (forces a host sync) — cheap after epoch
+        end, a pipeline stall if polled every step mid-fit."""
+        return self._loss_tracker.value
+
+    @score_.setter
+    def score_(self, value) -> None:
+        self._loss_tracker.set(value)
 
     # ------------------------------------------------------------- init
     def init(self) -> "MultiLayerNetwork":
@@ -327,36 +341,117 @@ class MultiLayerNetwork(SeqCtxJitCache, SeqCtxSolverCache):
         return jax.jit(step_fn, donate_argnums=(0, 1, 2))
 
     # ---------------------------------------------------------- fit API
-    def fit(self, data, labels=None, *, epochs: int = 1, batch_size: int = 32):
-        """Train. Accepts arrays, a DataSet, or a DataSetIterator.
-        Reference: `fit(DataSetIterator):1046` (+ tBPTT dispatch `:1102`)."""
+    def fit(self, data, labels=None, *, epochs: int = 1, batch_size: int = 32,
+            steps_per_dispatch: int = 1, device_prefetch: bool = True,
+            sync_every: int = 0):
+        """Train. Accepts arrays, a DataSet, a DataSetIterator, or any
+        iterable of DataSets. Reference: `fit(DataSetIterator):1046`
+        (+ tBPTT dispatch `:1102`), pipelined per the async-dispatch
+        contract (PERF_NOTES):
+
+        - the loss stays on device; ``score_`` materializes it lazily
+          (``sync_every=N`` forces a float every N steps for listeners)
+        - ``device_prefetch`` double-buffers the host→device transfer of
+          batch N+1 behind batch N's compute
+        - ``steps_per_dispatch=K`` (opt-in) fuses K same-shape batches
+          into one `lax.scan` dispatch; tBPTT batches and non-SGD solvers
+          fall back to per-step dispatch automatically
+        """
         self._check_init()
         it = as_iterator(data, labels, batch_size)
-        for l in self.listeners:
-            l.on_fit_start(self)
-        for ep in range(epochs):
-            for l in self.listeners:
-                l.on_epoch_start(self, self.epoch)
-            etl_start = time.perf_counter()
-            for ds in it:
-                etl_ms = (time.perf_counter() - etl_start) * 1e3
-                if self.conf.tbptt_fwd_length > 0 and ds.features.ndim == 3:
-                    score = self._fit_tbptt(ds)
-                else:
-                    score = self._fit_batch(ds)
-                self.score_ = score
-                self.iteration += 1
-                for l in self.listeners:
-                    if hasattr(l, "set_etl_time"):
-                        l.set_etl_time(etl_ms)
-                    l.iteration_done(self, self.iteration, self.epoch, score)
-                etl_start = time.perf_counter()
-            for l in self.listeners:
-                l.on_epoch_end(self, self.epoch)
-            self.epoch += 1
-        for l in self.listeners:
-            l.on_fit_end(self)
+        if device_prefetch:
+            it = DevicePrefetchIterator(
+                it, depth=max(2, int(steps_per_dispatch)),
+                transform=self._cast_batch)
+        self._loss_tracker.sync_every = int(sync_every)
+        TrainingExecutor(
+            self,
+            step=self._dispatch_batch,
+            fused_step=self._fused_dispatch,
+            can_fuse=self._can_fuse,
+            steps_per_dispatch=steps_per_dispatch,
+        ).run(it, epochs)
         return self
+
+    def _cast_batch(self, ds: DataSet) -> DataSet:
+        """Pre-cast features to the model dtype so the prefetch transfer
+        carries the bytes the step actually consumes (bf16 nets ship half
+        the data)."""
+        f = ds.features
+        if hasattr(f, "dtype") and f.dtype != self.dtype:
+            ds = DataSet(np.asarray(f, self.dtype), ds.labels,
+                         ds.features_mask, ds.labels_mask)
+        return ds
+
+    def _dispatch_batch(self, ds: DataSet):
+        if self.conf.tbptt_fwd_length > 0 and ds.features.ndim == 3:
+            return self._fit_tbptt(ds)
+        return self._fit_batch(ds)
+
+    def _can_fuse(self, ds: DataSet) -> bool:
+        """Fused dispatch needs the plain SGD step: tBPTT chunks and
+        full-batch solvers require per-step host control flow."""
+        return (self.conf.optimization_algo == "stochastic_gradient_descent"
+                and not (self.conf.tbptt_fwd_length > 0
+                         and ds.features.ndim == 3))
+
+    def _get_fused_step(self, key, k: int):
+        cache_key = ("fused", key, k)
+        if cache_key in self._jit_cache:
+            return self._jit_cache[cache_key]
+        base = self._build_step(key, jit=False)
+
+        def fused(params, opt_state, states, step0, rng, feats, labs, fms,
+                  lms):
+            # rng rides in the carry and splits INSIDE the scan — same
+            # `self._rng, k = split(self._rng)` chain as the K=1 path
+            # (bit-identical subkeys), but zero per-step host dispatches.
+            def body(carry, xs):
+                p, o, s, step, r = carry
+                f, l, fm, lm = xs
+                r, sub = jax.random.split(r)
+                new_p, new_o, persist, loss, _ = base(
+                    p, o, s, step, f, l, fm, lm, sub, None)
+                return (new_p, new_o, persist, step + 1, r), loss
+
+            (params, opt_state, states, _, rng), losses = jax.lax.scan(
+                body, (params, opt_state, states, step0, rng),
+                (feats, labs, fms, lms))
+            return params, opt_state, states, rng, losses
+
+        fn = jax.jit(fused, donate_argnums=(0, 1, 2))
+        self._jit_cache[cache_key] = fn
+        return fn
+
+    def _fused_dispatch(self, batches: List[DataSet]):
+        """Run K stacked same-shape batches as ONE `lax.scan` dispatch.
+        Returns the (K,) per-step losses as a device array."""
+        first = batches[0]
+        self._check_input(first.features)
+        self.last_batch_size = first.num_examples()
+        self._last_features = batches[-1].features
+        key = (first.features_mask is not None,
+               first.labels_mask is not None, False)
+        fn = self._get_fused_step(key, len(batches))
+
+        def stk(get, dtype=None):
+            vals = [get(b) for b in batches]
+            if vals[0] is None:
+                return None
+            if all(isinstance(v, np.ndarray) for v in vals):
+                # host-resident batches: one np.stack + ONE device transfer
+                # instead of K asarray dispatches + a device concat
+                return jnp.asarray(np.stack(vals), dtype)
+            return jnp.stack([jnp.asarray(v, dtype) for v in vals])
+
+        (self.params_tree, self.updater_state, self.state_tree, self._rng,
+         losses) = fn(self.params_tree, self.updater_state, self.state_tree,
+                      np.int32(self.iteration), self._rng,
+                      stk(lambda b: b.features, self.dtype),
+                      stk(lambda b: b.labels),
+                      stk(lambda b: b.features_mask),
+                      stk(lambda b: b.labels_mask))
+        return losses
 
     def _split_rng(self):
         self._rng, k = jax.random.split(self._rng)
@@ -410,7 +505,9 @@ class MultiLayerNetwork(SeqCtxJitCache, SeqCtxSolverCache):
                 None if ds.features_mask is None else jnp.asarray(ds.features_mask),
                 None if ds.labels_mask is None else jnp.asarray(ds.labels_mask),
                 self._split_rng(), None)
-        return float(loss)
+        # Deferred sync: the loss stays on device — LossTracker/score_
+        # materializes it only on demand (async-dispatch contract).
+        return loss
 
     def _fit_tbptt(self, ds: DataSet) -> float:
         """Truncated BPTT: slice time into fwd-length chunks, carry RNN
@@ -451,9 +548,10 @@ class MultiLayerNetwork(SeqCtxJitCache, SeqCtxSolverCache):
                 jnp.asarray(ds.features[:, t_lo:hi], self.dtype),
                 sl(ds.labels), sl(ds.features_mask), sl(ds.labels_mask),
                 self._split_rng(), carries if carries else None)
-            losses.append(float(loss))
+            losses.append(loss)
         self.last_batch_size = ds.num_examples()
-        return float(np.mean(losses))
+        # Mean on device — one divide instead of len(losses) host syncs.
+        return jnp.stack(losses).mean()
 
     def _advance_carries(self, feats, fmask, carries):
         """Gradient-free forward that only moves the RNN carries along —

@@ -16,10 +16,11 @@ from deeplearning4j_tpu.optim.schedules import (
 from deeplearning4j_tpu.optim.solvers import (
     Solver, backtrack_line_search, minimize_cg, minimize_gd, minimize_lbfgs,
 )
+from deeplearning4j_tpu.optim.executor import LossTracker, TrainingExecutor
 
 __all__ = [
     "Solver", "backtrack_line_search", "minimize_cg", "minimize_gd",
-    "minimize_lbfgs",
+    "minimize_lbfgs", "LossTracker", "TrainingExecutor",
     "Updater", "Sgd", "Adam", "AdaMax", "Nadam", "AMSGrad", "Nesterovs",
     "AdaGrad", "AdaDelta", "RmsProp", "NoOp",
     "Schedule", "FixedSchedule", "StepSchedule", "ExponentialSchedule",

@@ -1,0 +1,232 @@
+"""Async-dispatch training executor: the shared fit-loop engine.
+
+Reference parity: the reference's fit loops (`MultiLayerNetwork.fit:1046`,
+`ComputationGraph.fit:778`, `ParallelWrapper.fit:409`) each re-implement the
+same epoch/listener/score plumbing AND block the dispatch pipeline every
+step reading the scalar score off-device. Here that plumbing lives in ONE
+executor with TPU-native dispatch semantics (PyGraph, arXiv:2503.19779, is
+the GPU analogue — keep the accelerator queue full, stop paying host
+round-trips per step):
+
+- **Deferred loss sync** (`LossTracker`): the step functions return the
+  loss as a DEVICE array; the tracker only materializes a Python float on
+  demand (``score_`` access, a listener calling ``float(score)``, an
+  every-N ``sync_every`` cadence, or epoch end). The steady-state hot loop
+  performs ZERO mandatory host syncs — JAX's async dispatch keeps N steps
+  in flight while the host runs ahead enqueueing more.
+- **Fused multi-step execution** (`steps_per_dispatch=K`): K same-shape
+  batches are stacked and the donated train step runs under `lax.scan` in
+  a single dispatch — the TPU analogue of CUDA-graph capture. The executor
+  transparently falls back to per-step dispatch for batches that need
+  per-step visibility (tBPTT chunking, non-SGD solvers, shape changes,
+  resume/stop/checkpoint seams).
+- **Listener contract**: ``iteration_done`` receives the *device* loss;
+  listeners that read it (``float(score)``) pay the sync they ask for,
+  listeners that don't are free. Epoch end always materializes once so
+  ``score_`` is a float at every epoch boundary (≤1 sync/epoch).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, List, Optional
+
+__all__ = ["LossTracker", "TrainingExecutor", "SKIP", "STOP"]
+
+# before_batch sentinels: skip this batch (resume replay) / stop cleanly
+SKIP = object()
+STOP = object()
+
+
+def _is_device_array(x) -> bool:
+    import jax
+
+    return isinstance(x, jax.Array)
+
+
+class LossTracker:
+    """Deferred-sync score holder.
+
+    Stores the most recent loss as whatever the step returned (device
+    array or float) and converts to a Python float lazily, caching the
+    result. ``host_syncs`` counts actual device→host materializations —
+    the instrumentation seam the perf guard asserts on.
+
+    ``sync_every=N`` forces a materialization every N updates (the
+    listener-cadence knob); 0 (default) defers until ``value`` is read or
+    ``materialize()`` is called (the executor calls it once per epoch).
+    """
+
+    def __init__(self, sync_every: int = 0):
+        self.sync_every = int(sync_every)
+        self._raw: Any = None
+        self._cached: Optional[float] = None
+        self._since_sync = 0
+        self.host_syncs = 0     # device materializations (perf-guard seam)
+        self.updates = 0
+
+    def set(self, loss) -> None:
+        """Overwrite the tracked loss without counting an update (the
+        ``score_`` setter seam — solvers/earlystopping assign floats)."""
+        self._raw = loss
+        self._cached = None
+
+    def update(self, loss) -> None:
+        self.set(loss)
+        self.updates += 1
+        self._since_sync += 1
+        if self.sync_every and self._since_sync >= self.sync_every:
+            self.materialize()
+
+    @property
+    def value(self) -> Optional[float]:
+        """The tracked loss as a float — THIS is the sync point."""
+        if self._raw is None:
+            return None
+        if self._cached is None:
+            if _is_device_array(self._raw):
+                self.host_syncs += 1
+            self._cached = float(self._raw)
+            self._since_sync = 0
+        return self._cached
+
+    def peek(self):
+        """The loss without forcing a sync (device array if never read)."""
+        return self._raw if self._cached is None else self._cached
+
+    def materialize(self) -> Optional[float]:
+        return self.value
+
+
+def _arr_sig(a):
+    return None if a is None else (tuple(a.shape), str(getattr(a, "dtype", "")))
+
+
+def batch_signature(ds):
+    """Structural signature of a DataSet/MultiDataSet — two batches fuse
+    into one `lax.scan` dispatch only when their signatures match (same
+    shapes, dtypes, and mask presence ⇒ same compiled program)."""
+    if hasattr(ds, "features_masks"):   # MultiDataSet
+        return ("m",
+                tuple(_arr_sig(f) for f in ds.features),
+                tuple(_arr_sig(l) for l in ds.labels),
+                tuple(_arr_sig(x) for x in (ds.features_masks or ())),
+                tuple(_arr_sig(x) for x in (ds.labels_masks or ())))
+    return ("d", _arr_sig(ds.features), _arr_sig(ds.labels),
+            _arr_sig(ds.features_mask), _arr_sig(ds.labels_mask))
+
+
+class TrainingExecutor:
+    """The shared epoch/batch/listener loop with async-dispatch semantics.
+
+    The model (or parallel trainer) supplies the step callables; the
+    executor owns iteration bookkeeping, the fused-dispatch buffer, ETL
+    timing, listener fan-out, and the epoch-end materialization.
+
+    Hooks:
+      step(ds) -> loss                one training step (device loss)
+      fused_step(batches) -> (K,)    K stacked steps in one dispatch
+      can_fuse(ds) -> bool           batch eligible for fusion
+      before_batch(bi, ds) -> ds | SKIP | STOP
+      after_step(bi)                 post-iteration seam (checkpointing)
+      epoch_start() / epoch_end()    per-epoch trainer state
+    """
+
+    def __init__(self, net, *, step: Callable,
+                 fused_step: Optional[Callable] = None,
+                 can_fuse: Optional[Callable] = None,
+                 steps_per_dispatch: int = 1,
+                 before_batch: Optional[Callable] = None,
+                 after_step: Optional[Callable] = None,
+                 epoch_start: Optional[Callable] = None,
+                 epoch_end: Optional[Callable] = None):
+        self.net = net
+        self.step = step
+        self.fused_step = fused_step
+        self.can_fuse = can_fuse or (lambda ds: False)
+        self.k = max(1, int(steps_per_dispatch or 1))
+        self.before_batch = before_batch
+        self.after_step = after_step
+        self.epoch_start = epoch_start
+        self.epoch_end = epoch_end
+        self.stopped = False
+
+    # ------------------------------------------------------------- loop
+    def run(self, iterable, epochs: int, *, start_epoch: int = 0):
+        net = self.net
+        listeners = net.listeners
+        for l in listeners:
+            l.on_fit_start(net)
+        self.stopped = False
+        for _ in range(start_epoch, epochs):
+            if self.epoch_start is not None:
+                self.epoch_start()
+            for l in listeners:
+                l.on_epoch_start(net, net.epoch)
+            buf: List = []
+            etl_start = time.perf_counter()
+            for bi, ds in enumerate(iter(iterable)):
+                etl_ms = (time.perf_counter() - etl_start) * 1e3
+                if self.before_batch is not None:
+                    ds = self.before_batch(bi, ds)
+                    if ds is SKIP:
+                        etl_start = time.perf_counter()
+                        continue
+                    if ds is STOP:
+                        self.stopped = True
+                        break
+                fusible = (self.k > 1 and self.fused_step is not None
+                           and self.can_fuse(ds))
+                if fusible and buf and \
+                        batch_signature(buf[0][1]) != batch_signature(ds):
+                    self._drain(buf)
+                    buf = []
+                if fusible:
+                    buf.append((bi, ds, etl_ms))
+                    if len(buf) == self.k:
+                        self._run_fused(buf)
+                        buf = []
+                else:
+                    self._drain(buf)
+                    buf = []
+                    self._finish(bi, self.step(ds), etl_ms)
+                etl_start = time.perf_counter()
+            self._drain(buf)
+            if self.stopped:
+                break
+            for l in listeners:
+                l.on_epoch_end(net, net.epoch)
+            net.epoch += 1
+            if self.epoch_end is not None:
+                self.epoch_end()
+            # the ONE guaranteed materialization per epoch: score_ is a
+            # float at every epoch boundary without per-step syncs
+            net._loss_tracker.materialize()
+        for l in listeners:
+            l.on_fit_end(net)
+        return net
+
+    # ---------------------------------------------------------- helpers
+    def _drain(self, buf) -> None:
+        """Flush a partial fusion buffer through the per-step path (a
+        short tail would need its own K'-sized compile)."""
+        for bi, ds, etl_ms in buf:
+            self._finish(bi, self.step(ds), etl_ms)
+
+    def _run_fused(self, buf) -> None:
+        losses = self.fused_step([ds for _, ds, _ in buf])
+        for j, (bi, ds, etl_ms) in enumerate(buf):
+            # losses[j] stays on device — indexing does not sync
+            self._finish(bi, losses[j], etl_ms)
+
+    def _finish(self, bi, loss, etl_ms) -> None:
+        net = self.net
+        net._loss_tracker.update(loss)
+        net.iteration += 1
+        for l in net.listeners:
+            if hasattr(l, "set_etl_time"):
+                l.set_etl_time(etl_ms)
+            l.iteration_done(net, net.iteration, net.epoch,
+                             net._loss_tracker.peek())
+        if self.after_step is not None:
+            self.after_step(bi)

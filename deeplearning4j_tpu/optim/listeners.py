@@ -7,6 +7,15 @@ TimeIteration). Listeners run on the HOST after each step; because JAX
 dispatch is async, reading the score forces a device sync — listeners that
 only need it every N iterations therefore only sync every N iterations
 (the reference pays a similar cost reading scalars off-device).
+
+Async-dispatch contract (see PERF_NOTES): the `score` passed to
+``iteration_done`` is the RAW value off the step — in the deferred-sync
+fit path that is a jax device array, not a float. A listener that calls
+``float(score)`` (or reads ``model.score_``) pays exactly the host sync it
+asks for, stalling the dispatch pipeline for that step; listeners that
+don't touch the score (PerformanceListener, TimeIterationListener) cost
+nothing. Prefer a ``frequency``/``print_iterations`` cadence ≥10 in hot
+loops, or pass ``sync_every=N`` to ``fit()`` to batch materializations.
 """
 
 from __future__ import annotations

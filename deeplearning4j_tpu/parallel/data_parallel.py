@@ -24,7 +24,12 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
-from deeplearning4j_tpu.data.iterators import as_iterator
+from deeplearning4j_tpu.data.iterators import (
+    DevicePrefetchIterator, as_iterator,
+)
+from deeplearning4j_tpu.optim.executor import (
+    SKIP as _SKIP, STOP as _STOP, TrainingExecutor,
+)
 from deeplearning4j_tpu.parallel.distributed import (
     put_global, put_global_batch,
 )
@@ -172,7 +177,8 @@ class ParallelWrapper(SeqCtxJitCache):
     def fit(self, data, labels=None, *, epochs: int = 1,
             batch_size: int = 128, checkpointer=None,
             checkpoint_every: int = 1, resume: Optional[Dict] = None,
-            stop_fn=None):
+            stop_fn=None, steps_per_dispatch: int = 1,
+            device_prefetch: bool = True, sync_every: int = 0):
         """Reference: `ParallelWrapper.fit(DataSetIterator):409`. Partial
         final batches are padded by repetition to keep XLA shapes static.
 
@@ -190,55 +196,71 @@ class ParallelWrapper(SeqCtxJitCache):
         `epochs` counts TOTAL epochs over the whole (resumed) run so an
         interrupted fit(epochs=N) is finished by the same call. `stop_fn`
         (checked at step boundaries) ends training cleanly early —
-        the preemption seam used by ElasticTrainer."""
+        the preemption seam used by ElasticTrainer.
+
+        Async-dispatch knobs (see MultiLayerNetwork.fit / PERF_NOTES):
+        `device_prefetch` pre-shards batch N+1 across the mesh while batch
+        N computes (single-controller only — multi-controller feeding goes
+        through `put_global_batch`); `steps_per_dispatch=K` fuses K batches
+        into one `lax.scan` dispatch, forced back to 1 whenever a
+        checkpointer or stop_fn needs per-step visibility."""
         net = self.net
         if isinstance(data, MultiDataSet):
-            batches = [data]
-            iterable = lambda: batches
+            iterable: Any = [data]
         else:
-            it = as_iterator(data, labels, batch_size)
+            iterable = as_iterator(data, labels, batch_size)
             if self.prefetch:
-                it = it.async_(self.prefetch)
-            iterable = lambda: it
+                iterable = iterable.async_(self.prefetch)
+        if device_prefetch and self._nproc == 1:
+            # Pad on host, then land every leaf pre-sharded across the
+            # mesh one batch ahead of compute.
+            iterable = DevicePrefetchIterator(
+                iterable, depth=max(2, int(steps_per_dispatch)),
+                put_fn=lambda x: jax.device_put(
+                    x, self._batch_sharding_like(x)),
+                transform=self._pad_to_divisible)
+        if checkpointer is not None or stop_fn is not None:
+            # Both need exact per-step positions; a fused dispatch would
+            # make K steps indivisible.
+            steps_per_dispatch = 1
         start_epoch = net.epoch if resume is not None else 0
         skip = (resume or {}).get("batch_in_epoch", 0)
-        for l in net.listeners:
-            l.on_fit_start(net)
-        stopped = False
-        for _ in range(start_epoch, epochs):
+
+        def epoch_start():
             # per-epoch position: a stop before this epoch's first
             # non-skipped batch must checkpoint the RESUMED position
             # (skip batches are already trained), not the last epoch's tail
             self.last_batch_index = skip - 1
-            for l in net.listeners:
-                l.on_epoch_start(net, net.epoch)
-            for bi, ds in enumerate(iterable()):
-                if bi < skip:
-                    continue
-                if stop_fn is not None and stop_fn():
-                    stopped = True
-                    break
-                ds = self._pad_to_divisible(ds)
-                net.last_batch_size = ds.num_examples()
-                loss = self._step(ds)
-                self.last_batch_index = bi
-                net.score_ = loss
-                net.iteration += 1
-                for l in net.listeners:
-                    l.iteration_done(net, net.iteration, net.epoch, loss)
-                if checkpointer is not None and \
-                        net.iteration % checkpoint_every == 0:
-                    checkpointer.save(net, step=net.iteration,
-                                      position={"batch_in_epoch": bi + 1})
+
+        def before_batch(bi, ds):
+            nonlocal skip
+            if bi < skip:
+                return _SKIP
+            if stop_fn is not None and stop_fn():
+                return _STOP
+            ds = self._pad_to_divisible(ds)
+            net.last_batch_size = ds.num_examples()
+            return ds
+
+        def after_step(bi):
+            self.last_batch_index = bi
+            if checkpointer is not None and \
+                    net.iteration % checkpoint_every == 0:
+                checkpointer.save(net, step=net.iteration,
+                                  position={"batch_in_epoch": bi + 1})
+
+        def epoch_end():
+            nonlocal skip
             skip = 0
-            if stopped:
-                break
-            for l in net.listeners:
-                l.on_epoch_end(net, net.epoch)
-            net.epoch += 1
-        self.stopped_early = stopped   # authoritative for ElasticTrainer
-        for l in net.listeners:
-            l.on_fit_end(net)
+
+        net._loss_tracker.sync_every = int(sync_every)
+        execu = TrainingExecutor(
+            net, step=self._step, fused_step=self._fused_step,
+            can_fuse=self._can_fuse, steps_per_dispatch=steps_per_dispatch,
+            before_batch=before_batch, after_step=after_step,
+            epoch_start=epoch_start, epoch_end=epoch_end)
+        execu.run(iterable, epochs, start_epoch=start_epoch)
+        self.stopped_early = execu.stopped  # authoritative for ElasticTrainer
         if checkpointer is not None:
             checkpointer.wait()
         return net
@@ -252,7 +274,7 @@ class ParallelWrapper(SeqCtxJitCache):
             return {k: self._put_batch(v) for k, v in x.items()}
         return put_global_batch(x, self._batch_sharding_like(x))
 
-    def _step(self, ds) -> float:
+    def _step(self, ds):
         net = self.net
         net._rng, k = jax.random.split(net._rng)
         if self._nproc > 1:
@@ -298,4 +320,121 @@ class ParallelWrapper(SeqCtxJitCache):
             fn = self._get_step(key, args)
             (net.params_tree, net.updater_state, net.state_tree, loss, _
              ) = fn(*args)
-        return float(loss)
+        # Deferred sync: replicated device scalar; LossTracker materializes.
+        return loss
+
+    # --------------------------------------------------- fused dispatch
+    def _can_fuse(self, ds) -> bool:
+        """Multi-controller feeding goes through put_global_batch with
+        per-step host staging — fusion is single-controller only."""
+        return self._nproc == 1
+
+    def _put_stacked(self, x):
+        """Place a (K, batch, ...) stack with the scan axis replicated and
+        the batch axis sharded across the mesh."""
+        if x is None:
+            return None
+        if isinstance(x, dict):
+            return {k: self._put_stacked(v) for k, v in x.items()}
+        sh = NamedSharding(
+            self.mesh, P(None, self.batch_axis, *([None] * (x.ndim - 2))))
+        return jax.device_put(x, sh)
+
+    def _get_fused_step(self, key):
+        if key in self._jit_cache:
+            return self._jit_cache[key]
+        k = key[1]
+        base = self.net.make_step_fn()
+        # rng rides in the scan carry and splits in-graph — the identical
+        # sequential `net._rng, r = split(net._rng)` chain as the unfused
+        # step, with no per-step host dispatch.
+        if self._graph:
+            def fused(params, opt_state, states, step0, rng, feats, labs,
+                      fms, lms):
+                def body(carry, xs):
+                    p, o, s, step, r = carry
+                    f, l, fm, lm = xs
+                    r, sub = jax.random.split(r)
+                    new_p, new_o, persist, loss = base(
+                        p, o, s, step, f, l, fm, lm, sub)
+                    return (new_p, new_o, persist, step + 1, r), loss
+
+                (params, opt_state, states, _, rng), losses = jax.lax.scan(
+                    body, (params, opt_state, states, step0, rng),
+                    (feats, labs, fms, lms))
+                return params, opt_state, states, rng, losses
+        else:
+            def fused(params, opt_state, states, step0, rng, feats, labs,
+                      fms, lms):
+                def body(carry, xs):
+                    p, o, s, step, r = carry
+                    f, l, fm, lm = xs
+                    r, sub = jax.random.split(r)
+                    new_p, new_o, persist, loss, _ = base(
+                        p, o, s, step, f, l, fm, lm, sub, None)
+                    return (new_p, new_o, persist, step + 1, r), loss
+
+                (params, opt_state, states, _, rng), losses = jax.lax.scan(
+                    body, (params, opt_state, states, step0, rng),
+                    (feats, labs, fms, lms))
+                return params, opt_state, states, rng, losses
+
+        fn = jax.jit(fused, donate_argnums=(0, 1, 2))
+        self._jit_cache[key] = fn
+        return fn
+
+    def _fused_step(self, batches):
+        """K pre-sharded batches → one sharded `lax.scan` dispatch."""
+        net = self.net
+        first = batches[0]
+        step0 = np.int32(net.iteration)
+        if self._graph:
+            f0 = first.features
+            host = isinstance(
+                f0[0] if hasattr(first, "features_masks") else f0,
+                np.ndarray)
+            conv = [net._to_dicts(b, host=host) for b in batches]
+            stack = (np.stack if host else jnp.stack)
+
+            def stk(idx):
+                head = conv[0][idx]
+                if head is None:
+                    return None
+                # host batches stack as numpy, so _put_stacked's
+                # device_put is the single host→device hop per tensor
+                return self._put_stacked(
+                    {n: stack([c[idx][n] for c in conv]) for n in head})
+
+            key = ("gf", len(batches), tuple(sorted(conv[0][0])),
+                   tuple(sorted(conv[0][1])),
+                   conv[0][2] is not None, conv[0][3] is not None)
+            fn = self._get_fused_step(key)
+            (net.params_tree, net.updater_state, net.state_tree, net._rng,
+             losses) = fn(net.params_tree, net.updater_state, net.state_tree,
+                          step0, net._rng, stk(0), stk(1), stk(2), stk(3))
+        else:
+            def stk(get, dt=None):
+                vals = [get(b) for b in batches]
+                if vals[0] is None:
+                    return None
+                if all(isinstance(v, np.ndarray) for v in vals):
+                    arr = np.stack(vals)
+                    if dt is not None:
+                        arr = arr.astype(dt, copy=False)
+                else:
+                    arr = jnp.stack([jnp.asarray(v, dt) for v in vals])
+                return self._put_stacked(arr)
+
+            key = ("mf", len(batches), first.features.ndim,
+                   0 if first.labels is None else first.labels.ndim,
+                   first.features_mask is not None,
+                   first.labels_mask is not None)
+            fn = self._get_fused_step(key)
+            (net.params_tree, net.updater_state, net.state_tree, net._rng,
+             losses) = fn(net.params_tree, net.updater_state, net.state_tree,
+                          step0, net._rng,
+                          stk(lambda b: b.features, net.dtype),
+                          stk(lambda b: b.labels),
+                          stk(lambda b: b.features_mask),
+                          stk(lambda b: b.labels_mask))
+        return losses

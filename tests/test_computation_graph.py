@@ -140,6 +140,34 @@ class TestGraphFit:
         net.fit(x, y, epochs=5, batch_size=64)
         assert net.output(x).shape == (256, 2)
 
+    def test_multi_epoch_consumes_batches_every_epoch(self):
+        """Regression: fit(epochs>1) must re-iterate the data source each
+        epoch — the old `iterable = lambda: it` handed the same (possibly
+        exhausted) iterator back, silently training epoch 1 only."""
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.data.iterators import ArrayDataSetIterator
+
+        x, y = _toy(n=64)
+        net = ComputationGraph(_simple_graph()).init()
+        net.fit(ArrayDataSetIterator(x, y, 16), epochs=3)
+        assert net.iteration == 12      # 4 batches × 3 epochs
+        assert net.epoch == 3
+
+        # iterables of pre-built DataSets replay each epoch too
+        batches = [DataSet(x[:32], y[:32]), DataSet(x[32:], y[32:])]
+        net2 = ComputationGraph(_simple_graph()).init()
+        net2.fit(batches, epochs=2)
+        assert net2.iteration == 4
+
+        # one-shot generators are replay-cached across epochs
+        def gen():
+            yield DataSet(x[:32], y[:32])
+            yield DataSet(x[32:], y[32:])
+
+        net3 = ComputationGraph(_simple_graph()).init()
+        net3.fit(gen(), epochs=2)
+        assert net3.iteration == 4
+
 
 class TestGraphGradients:
     def test_gradient_check_skip_graph(self):

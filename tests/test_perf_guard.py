@@ -294,3 +294,114 @@ def test_timed_ips_jitter_spike_filtered(monkeypatch):
     monkeypatch.setattr(bench.time, "monotonic", lambda: 0.0)
     _, per_step, _ = bench._timed_ips(run, 32, 40)
     assert per_step == pytest.approx(0.0005, rel=1e-6)
+
+
+# --------------------------------------------------------- dispatch depth
+class TestDispatchDepthGuard:
+    """Async-dispatch contract: the default fit() hot loop must not sync
+    the host more than once per epoch. Patches the device→host
+    materialization seams (`ArrayImpl.__float__` / `block_until_ready`) so
+    any per-step `float(loss)` regression in multilayer.py /
+    computation_graph.py / data_parallel.py fails loudly here."""
+
+    def _counting_patches(self, monkeypatch, counts):
+        from jax._src import array as _jarray
+
+        orig_float = _jarray.ArrayImpl.__float__
+        orig_block = _jarray.ArrayImpl.block_until_ready
+
+        def counting_float(a):
+            counts["float"] += 1
+            return orig_float(a)
+
+        def counting_block(a):
+            counts["block"] += 1
+            return orig_block(a)
+
+        monkeypatch.setattr(_jarray.ArrayImpl, "__float__", counting_float)
+        monkeypatch.setattr(_jarray.ArrayImpl, "block_until_ready",
+                            counting_block)
+
+    def test_multilayer_fit_syncs_at_most_once_per_epoch(self, monkeypatch):
+        r = np.random.default_rng(1)
+        x = r.standard_normal((64, F)).astype(np.float32)
+        y = np.eye(C, dtype=np.float32)[r.integers(0, C, 64)]
+        conf = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(LR))
+                .list()
+                .layer(DenseLayer(n_out=32, activation="relu"))
+                .layer(OutputLayer(n_out=C, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(F)).build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(x, y, epochs=1, batch_size=16)      # compile outside guard
+
+        counts = {"float": 0, "block": 0}
+        self._counting_patches(monkeypatch, counts)
+        epochs = 3
+        net.fit(x, y, epochs=epochs, batch_size=16)
+        assert net._loss_tracker.updates >= 4 * epochs + 4
+        assert counts["float"] + counts["block"] <= epochs, counts
+
+    def test_computation_graph_fit_syncs_at_most_once_per_epoch(
+            self, monkeypatch):
+        from deeplearning4j_tpu.models import ComputationGraph
+
+        r = np.random.default_rng(2)
+        x = r.standard_normal((64, F)).astype(np.float32)
+        y = np.eye(C, dtype=np.float32)[r.integers(0, C, 64)]
+        conf = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(LR))
+                .graph_builder()
+                .add_inputs("in")
+                .add_layer("d", DenseLayer(n_in=F, n_out=32,
+                                           activation="relu"), "in")
+                .add_layer("out", OutputLayer(n_in=32, n_out=C,
+                                              activation="softmax",
+                                              loss="mcxent"), "d")
+                .set_outputs("out")
+                .build())
+        net = ComputationGraph(conf).init()
+        net.fit(x, y, epochs=1, batch_size=16)
+
+        counts = {"float": 0, "block": 0}
+        self._counting_patches(monkeypatch, counts)
+        epochs = 3
+        net.fit(x, y, epochs=epochs, batch_size=16)
+        assert counts["float"] + counts["block"] <= epochs, counts
+
+    def test_parallel_wrapper_fit_syncs_at_most_once_per_epoch(
+            self, monkeypatch):
+        from deeplearning4j_tpu.parallel.data_parallel import ParallelWrapper
+
+        r = np.random.default_rng(3)
+        x = r.standard_normal((64, F)).astype(np.float32)
+        y = np.eye(C, dtype=np.float32)[r.integers(0, C, 64)]
+        conf = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(LR))
+                .list()
+                .layer(DenseLayer(n_out=32, activation="relu"))
+                .layer(OutputLayer(n_out=C, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(F)).build())
+        net = MultiLayerNetwork(conf).init()
+        pw = ParallelWrapper(net)
+        pw.fit(x, y, epochs=1, batch_size=32)
+
+        counts = {"float": 0, "block": 0}
+        self._counting_patches(monkeypatch, counts)
+        epochs = 2
+        pw.fit(x, y, epochs=epochs, batch_size=32)
+        assert counts["float"] + counts["block"] <= epochs, counts
+
+    def test_score_access_is_the_sync_point(self, monkeypatch):
+        r = np.random.default_rng(4)
+        x = r.standard_normal((32, F)).astype(np.float32)
+        y = np.eye(C, dtype=np.float32)[r.integers(0, C, 32)]
+        conf = (NeuralNetConfiguration.builder().seed(0).updater(Sgd(LR))
+                .list()
+                .layer(OutputLayer(n_out=C, activation="softmax",
+                                   loss="mcxent"))
+                .set_input_type(InputType.feed_forward(F)).build())
+        net = MultiLayerNetwork(conf).init()
+        net.fit(x, y, epochs=1, batch_size=16)
+        before = net._loss_tracker.host_syncs
+        assert np.isfinite(net.score_)      # epoch-end already materialized
+        assert net._loss_tracker.host_syncs == before   # cache hit, no sync
