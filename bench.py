@@ -27,6 +27,12 @@ BENCH_STEPS_PER_DISPATCH (recorded in the JSON; sets K for
 BENCH_HOST_OVERHEAD=1) skips the ladder and measures per-step host
 overhead of the fit hot path with forced per-step sync vs deferred loss
 sync vs K-step fused dispatch (see _host_overhead_main).
+`python bench.py --serving` (or BENCH_SERVING=1) drives the REAL
+model-serving HTTP server with a closed-loop client pool, comparing the
+continuous-batching scheduler against the legacy collect-then-run loop
+(throughput + p50/p95/p99 + batch occupancy, reconciled against
+/metrics); writes BENCH_serving.json (see _serving_main; knobs:
+BENCH_SERVING_CLIENTS/SECS/ROWS/MAX_BATCH/TPU/OUT).
 """
 
 from __future__ import annotations
@@ -810,7 +816,149 @@ def _host_overhead_main():
     }))
 
 
+def _serving_main():
+    """`--serving` mode: a closed-loop HTTP client pool against the real
+    model-serving server, once per scheduling mode:
+
+      collect    — the legacy fixed collect-then-run loop
+                   (ParallelInference BATCHED, max_wait_ms collector)
+      continuous — the control plane's continuous-batching scheduler
+                   (requests join the next dispatch as soon as the
+                   device slot frees; no wait timer)
+
+    Closed loop means every client immediately re-issues after each
+    response, so both modes face the same offered load and the p50/95/99
+    comparison is at (approximately) equal throughput. Client-side
+    request counts are reconciled against the server's /metrics totals
+    — the observability acceptance check. Emits one JSON line AND
+    writes BENCH_serving.json (BENCH_SERVING_OUT overrides)."""
+    import jax
+
+    if not os.environ.get("BENCH_SERVING_TPU"):
+        jax.config.update("jax_platforms", "cpu")
+
+    import threading
+    import urllib.request
+
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.inputs import InputType
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.serving import InferenceServer
+
+    clients = int(os.environ.get("BENCH_SERVING_CLIENTS", "8"))
+    secs = float(os.environ.get("BENCH_SERVING_SECS", "6"))
+    rows = int(os.environ.get("BENCH_SERVING_ROWS", "1"))
+    max_batch = int(os.environ.get("BENCH_SERVING_MAX_BATCH", "32"))
+    buckets = [1, 4, 8, 16, 32]
+
+    conf = (NeuralNetConfiguration.builder().seed(0).list()
+            .layer(DenseLayer(n_out=64, activation="relu"))
+            .layer(OutputLayer(n_out=8, activation="softmax"))
+            .set_input_type(InputType.feed_forward(16)).build())
+    net = MultiLayerNetwork(conf).init()
+    rng = np.random.default_rng(0)
+    payload = json.dumps({
+        "ndarray": rng.standard_normal((rows, 16)).tolist()}).encode()
+
+    def post(port, path="/output", data=payload):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}{path}", data=data,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            return json.loads(r.read())
+
+    def pct(sorted_ms, q):
+        return round(sorted_ms[min(len(sorted_ms) - 1,
+                                   int(q * len(sorted_ms)))], 3)
+
+    modes = {}
+    for mode in ("collect", "continuous"):
+        srv = InferenceServer(net, port=0, scheduler=mode,
+                              max_batch_size=max_batch,
+                              batch_buckets=buckets, collect_wait_ms=5.0,
+                              queue_capacity=max(64, 8 * clients))
+        port = srv.start()
+        n_warm = 2 * len(buckets)
+        for _ in range(n_warm):            # compile every bucket path
+            post(port)
+        lat_ms = []
+        counts = [0] * clients
+        lock = threading.Lock()
+        t_end = time.monotonic() + secs
+
+        def client(i):
+            mine = []
+            while time.monotonic() < t_end:
+                t0 = time.perf_counter()
+                post(port)
+                mine.append((time.perf_counter() - t0) * 1e3)
+            with lock:
+                lat_ms.extend(mine)
+                counts[i] = len(mine)
+
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(clients)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        wall = time.monotonic() - t0
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+            metrics = json.loads(r.read())
+        srv.stop()
+        total = sum(counts)
+        lat_ms.sort()
+        served = metrics["requests"]["completed"]
+        modes[mode] = {
+            "requests": total,
+            "throughput_rps": round(total / wall, 2),
+            "p50_ms": pct(lat_ms, 0.50),
+            "p95_ms": pct(lat_ms, 0.95),
+            "p99_ms": pct(lat_ms, 0.99),
+            "mean_ms": round(sum(lat_ms) / len(lat_ms), 3),
+            "mean_batch_occupancy_rows":
+                metrics["batch"]["mean_occupancy_rows"],
+            "occupancy_histogram":
+                metrics["batch"]["occupancy_histogram"],
+            "metrics_completed": served,
+            "metrics_reconciled": served == total + n_warm,
+        }
+
+    import jax as _jax
+
+    dev = _jax.devices()[0]
+    p99_ratio = (modes["collect"]["p99_ms"]
+                 / modes["continuous"]["p99_ms"])
+    out = {
+        "metric": "serving_continuous_vs_collect_p99_speedup",
+        "value": round(p99_ratio, 3),
+        "unit": "x",
+        "vs_baseline": round(p99_ratio, 3),   # >1: continuous wins p99
+        "clients": clients,
+        "rows_per_request": rows,
+        "duration_s": secs,
+        "max_batch_size": max_batch,
+        "throughput_ratio": round(
+            modes["continuous"]["throughput_rps"]
+            / modes["collect"]["throughput_rps"], 3),
+        "modes": modes,
+        "device": getattr(dev, "device_kind", str(dev)),
+        "platform": dev.platform,
+    }
+    dest = os.environ.get("BENCH_SERVING_OUT") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_serving.json")
+    with open(dest, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+
+
 def main():
+    if "--serving" in sys.argv or os.environ.get("BENCH_SERVING"):
+        _serving_main()
+        return
     if "--host-overhead" in sys.argv or os.environ.get("BENCH_HOST_OVERHEAD"):
         _host_overhead_main()
         return

@@ -42,9 +42,15 @@ class ParallelInference(SeqCtxJitCache):
         self.mode = mode
         self.max_batch = max_batch_size
         self.max_wait = max_wait_ms / 1e3
-        self.buckets = sorted(batch_buckets or [1, 8, 32, max_batch_size])
+        self.buckets = sorted(set(batch_buckets or [1, 8, 32, max_batch_size]))
         self._queue: "queue.Queue" = queue.Queue()
         self._stop = threading.Event()
+        # Drain accounting: every future enqueued on the collector is
+        # counted until it completes (success OR failure) via its done
+        # callback — single ownership, so the put-after-shutdown race and
+        # the collector's exit drain can't double-count.
+        self._pending = 0
+        self._pending_cv = threading.Condition()
         self._worker: Optional[threading.Thread] = None
         if mode == InferenceMode.BATCHED:
             self._worker = threading.Thread(target=self._collector, daemon=True)
@@ -74,6 +80,9 @@ class ParallelInference(SeqCtxJitCache):
             current_sequence_mesh,
         )
 
+        with self._pending_cv:
+            self._pending += 1
+        fut.add_done_callback(self._dec_pending)
         self._queue.put((x, fut, contextvars.copy_context(),
                          current_sequence_mesh()))
         # Close the put-after-drain race: if shutdown landed between the
@@ -88,6 +97,33 @@ class ParallelInference(SeqCtxJitCache):
                 pass   # collector won the race and completed it
         return fut.result()
 
+    def run_batch(self, x) -> np.ndarray:
+        """Scheduler SPI: run one already-formed batch synchronously on
+        the device — bucketed pad + per-bucket jit cache + oversize
+        chunking — bypassing the collector queue. This is the data-plane
+        hook the serving control plane's continuous-batching scheduler
+        dispatches through."""
+        return self._run(np.asarray(x))
+
+    def warmup(self, feat_shape, dtype=np.float32) -> int:
+        """Compile (and execute once) the forward for every batch bucket.
+
+        Deploy-time warm: the serving registry calls this before flipping
+        traffic to a new model version so no live request ever pays the
+        trace+compile. Returns the number of buckets warmed."""
+        for b in self.buckets:
+            self._run(np.zeros((b, *tuple(feat_shape)), dtype))
+        return len(self.buckets)
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Scheduler SPI drain hook: block until every enqueued request
+        has completed (successfully or with an error). Returns False on
+        timeout. Does NOT stop the collector — callers that want to stop
+        serving use shutdown(), which fails leftovers explicitly."""
+        with self._pending_cv:
+            return self._pending_cv.wait_for(
+                lambda: self._pending == 0, timeout)
+
     def shutdown(self):
         self._stop.set()
         if self._worker is not None:
@@ -95,6 +131,10 @@ class ParallelInference(SeqCtxJitCache):
             self._worker.join(timeout=2)
 
     # --------------------------------------------------------- internal
+    def _dec_pending(self, _fut):
+        with self._pending_cv:
+            self._pending -= 1
+            self._pending_cv.notify_all()
     def _bucket(self, n: int) -> int:
         for b in self.buckets:
             if n <= b:
@@ -119,6 +159,14 @@ class ParallelInference(SeqCtxJitCache):
 
     def _run(self, x: np.ndarray) -> np.ndarray:
         n = x.shape[0]
+        cap = self.buckets[-1]
+        if n > cap:
+            # Oversized request: running it whole would key the jit cache
+            # on an unbucketed shape (one compile per distinct n) and can
+            # hand the sharded data axis an indivisible batch. Chunk to
+            # the largest bucket and reassemble in order.
+            return np.concatenate(
+                [self._run(x[i:i + cap]) for i in range(0, n, cap)], axis=0)
         b = self._bucket(n)
         # data-axis divisibility for sharding
         d = self.mesh.shape[AXIS_DATA]

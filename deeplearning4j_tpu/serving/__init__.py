@@ -1,12 +1,29 @@
-"""Serving: REST nearest-neighbor server + model inference endpoint.
+"""Serving: the model-serving control plane + REST endpoints.
 
 Reference parity: deeplearning4j-nearestneighbor-server
 (`NearestNeighborsServer.java:37`, `NearestNeighbor.java:19` — REST k-NN
-over a VPTree) plus an /output endpoint backed by ParallelInference
-(the reference serves models via ParallelInference embedded in user code).
+over a VPTree) plus the model server. The control plane
+(registry/scheduler/metrics) is the TPU-native extension: multi-model
+hosting with hot-swap, continuous batching, admission control, and a
+/metrics surface over the ParallelInference data plane.
 """
 
+from deeplearning4j_tpu.serving.http_base import HttpError, JsonHttpServer
+from deeplearning4j_tpu.serving.inference_server import (
+    InferenceServer, ModelServer,
+)
 from deeplearning4j_tpu.serving.knn_server import NearestNeighborsServer
-from deeplearning4j_tpu.serving.inference_server import InferenceServer
+from deeplearning4j_tpu.serving.metrics import ServingStats
+from deeplearning4j_tpu.serving.registry import ModelEntry, ModelRegistry
+from deeplearning4j_tpu.serving.scheduler import (
+    AdmissionPolicy, ContinuousBatchingScheduler, DeadlineExceededError,
+    RequestShedError, SchedulerClosedError,
+)
 
-__all__ = ["NearestNeighborsServer", "InferenceServer"]
+__all__ = [
+    "AdmissionPolicy", "ContinuousBatchingScheduler",
+    "DeadlineExceededError", "HttpError", "InferenceServer",
+    "JsonHttpServer", "ModelEntry", "ModelRegistry", "ModelServer",
+    "NearestNeighborsServer", "RequestShedError", "SchedulerClosedError",
+    "ServingStats",
+]

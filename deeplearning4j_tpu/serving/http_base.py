@@ -1,10 +1,18 @@
 """Shared stdlib JSON-over-HTTP plumbing for the serving endpoints.
 
 One implementation of the request/response mechanics (header parsing, JSON
-encode/decode, 404/400 mapping, threaded serve/shutdown) used by the
+encode/decode, status mapping, threaded serve/shutdown) used by the
 inference server, the k-NN server (reference:
 `NearestNeighborsServer.java:37`) and the Keras gateway — the role Play
 filled for the reference's REST modules.
+
+Status contract (clients must be able to tell their bug from ours):
+  400 — the request is at fault: malformed JSON, a non-object body, or a
+        missing field (`KeyError` from a handler)
+  4xx/5xx via `HttpError` — a handler's explicit verdict (the serving
+        control plane uses 503 for shed/draining and 504 for deadlines)
+  500 — any other handler exception: a server fault, never blamed on the
+        client
 """
 
 from __future__ import annotations
@@ -15,11 +23,20 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
 
 
+class HttpError(Exception):
+    """Raise from a handler to pick the response status explicitly."""
+
+    def __init__(self, status: int, message: str, **extra):
+        super().__init__(message)
+        self.status = int(status)
+        self.payload = {"error": message, **extra}
+
+
 class JsonHttpServer:
     """Subclass and override get_routes()/post_routes().
 
     GET handlers: () -> payload dict. POST handlers: (request dict) ->
-    payload dict. Exceptions map to {"error": str} with HTTP 400."""
+    payload dict. Errors map per the module-level status contract."""
 
     def __init__(self, *, port: int = 0, host: str = "127.0.0.1"):
         self.port = port
@@ -55,21 +72,32 @@ class JsonHttpServer:
                     return self._json(404, {"error": "not found"})
                 try:
                     self._json(200, fn())
+                except HttpError as e:
+                    self._json(e.status, e.payload)
                 except Exception as e:
-                    self._json(400, {"error": str(e)})
+                    self._json(500, {"error": str(e)})
 
             def do_POST(self):
                 fn = posts.get(self.path)
                 if fn is None:
                     return self._json(404, {"error": "not found"})
+                n = int(self.headers.get("Content-Length", 0))
+                raw = self.rfile.read(n) or b"{}"
                 try:
-                    n = int(self.headers.get("Content-Length", 0))
-                    req = json.loads(self.rfile.read(n) or b"{}")
+                    req = json.loads(raw)
+                except (json.JSONDecodeError, UnicodeDecodeError) as e:
+                    return self._json(400, {"error": f"malformed JSON: {e}"})
+                if not isinstance(req, dict):
+                    return self._json(
+                        400, {"error": "request body must be a JSON object"})
+                try:
                     self._json(200, fn(req))
+                except HttpError as e:
+                    self._json(e.status, e.payload)
                 except KeyError as e:
                     self._json(400, {"error": f"missing field/model: {e}"})
                 except Exception as e:
-                    self._json(400, {"error": str(e)})
+                    self._json(500, {"error": str(e)})
 
         self._httpd = ThreadingHTTPServer((self.host, self.port), Handler)
         self.port = self._httpd.server_port

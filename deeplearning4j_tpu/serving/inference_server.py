@@ -1,36 +1,181 @@
-"""REST model-inference server backed by ParallelInference.
+"""Model-serving control plane: REST front end over the ModelRegistry +
+continuous-batching scheduler.
 
-Reference precedent: the reference embeds `ParallelInference` in user code;
-this exposes it over HTTP (shared plumbing in serving/http_base.py) like
-the nearest-neighbor server exposes VPTree:
-  POST /output  {"ndarray": [[...], ...]}  → {"output": [[...], ...]}
-  GET  /healthz
+Grown from the original 37-line single-model wrapper into the serving
+subsystem the ROADMAP's "heavy traffic" north star needs: a multi-model
+registry with zero-downtime hot-swap, admission control with explicit
+backpressure semantics, and an observability surface.
+
+  POST /output   {"ndarray": [[...], ...], "model": "name"?,
+                  "deadline_ms": 250?}
+                 → {"output": [[...], ...], "model": ..., "version": ...}
+                 errors: 400 client fault, 503 shed/draining,
+                 504 deadline exceeded, 500 server fault
+  GET  /models   → per-model {version, served, inflight, deployments}
+  GET  /metrics  → ServingStats snapshot (queue depth, batch-occupancy
+                 histogram, p50/p95/p99 latency, shed count, per-model
+                 totals)
+  GET  /healthz  → {"status": "ok" | "degraded"} — degraded once the
+                 admission queue passes `degraded_fraction` of capacity
+
+Dispatch modes:
+  batched=True,  scheduler="continuous"  (default) — the
+      ContinuousBatchingScheduler: requests join the next device
+      dispatch as soon as a slot frees
+  batched=True,  scheduler="collect" — the legacy fixed
+      collect-then-run loop (ParallelInference BATCHED); kept as the
+      bench baseline (`bench.py --serving` compares the two)
+  batched=False — direct synchronous dispatch per HTTP thread
 """
 
 from __future__ import annotations
 
+import time
+from typing import Optional
+
 import numpy as np
 
-from deeplearning4j_tpu.parallel.inference import InferenceMode, ParallelInference
-from deeplearning4j_tpu.serving.http_base import JsonHttpServer
+from deeplearning4j_tpu.parallel.inference import InferenceMode
+from deeplearning4j_tpu.serving.http_base import HttpError, JsonHttpServer
+from deeplearning4j_tpu.serving.metrics import ServingStats
+from deeplearning4j_tpu.serving.registry import ModelRegistry
+from deeplearning4j_tpu.serving.scheduler import (
+    AdmissionPolicy, ContinuousBatchingScheduler, DeadlineExceededError,
+    RequestShedError, SchedulerClosedError,
+)
+
+DEFAULT_MODEL = "default"
 
 
 class InferenceServer(JsonHttpServer):
-    def __init__(self, net, *, port: int = 9001, batched: bool = True,
-                 max_batch_size: int = 64):
+    """One HTTP server, many models. `net` is a convenience: deployed as
+    ("default", version 1) without warmup (first request compiles, as
+    the original single-model server did); `deploy()` warms by default.
+    """
+
+    def __init__(self, net=None, *, port: int = 0, batched: bool = True,
+                 max_batch_size: int = 64,
+                 registry: Optional[ModelRegistry] = None,
+                 scheduler: str = "continuous",
+                 admission: str = AdmissionPolicy.BLOCK,
+                 queue_capacity: int = 256,
+                 default_deadline_ms: Optional[float] = None,
+                 batch_buckets=None, collect_wait_ms: float = 5.0,
+                 slots: int = 1, degraded_fraction: float = 0.8,
+                 mesh=None):
         super().__init__(port=port)
-        self.pi = ParallelInference(
-            net,
-            mode=InferenceMode.BATCHED if batched else InferenceMode.INPLACE,
-            max_batch_size=max_batch_size)
+        if scheduler not in ("continuous", "collect"):
+            raise ValueError("scheduler must be 'continuous' or 'collect'")
+        self.mode = ("continuous" if batched and scheduler == "continuous"
+                     else "collect" if batched else "direct")
+        self.stats = ServingStats()
+        self.degraded_fraction = degraded_fraction
+        if registry is None:
+            registry = ModelRegistry(
+                mesh=mesh, max_batch_size=max_batch_size,
+                batch_buckets=batch_buckets,
+                runner_mode=(InferenceMode.BATCHED
+                             if self.mode == "collect"
+                             else InferenceMode.INPLACE),
+                collect_wait_ms=collect_wait_ms)
+        self.registry = registry
+        self.scheduler = None
+        if self.mode == "continuous":
+            self.scheduler = ContinuousBatchingScheduler(
+                registry, self.stats, max_batch_size=max_batch_size,
+                queue_capacity=queue_capacity, policy=admission,
+                default_deadline_ms=default_deadline_ms, slots=slots)
+        if net is not None:
+            self.registry.deploy(DEFAULT_MODEL, 1, net, warm=False)
+
+    # ------------------------------------------------------ control API
+    def deploy(self, name: str, version, net, *, feat_shape=None,
+               warm: bool = True):
+        """Zero-downtime hot-swap: warm the new version's bucketed jit
+        caches, atomically flip traffic, drain + retire the old one."""
+        return self.registry.deploy(name, version, net,
+                                    feat_shape=feat_shape, warm=warm)
+
+    # --------------------------------------------------------- handlers
+    def _parse(self, req: dict):
+        x_raw = req["ndarray"]          # KeyError → 400
+        try:
+            x = np.asarray(x_raw, np.float32)
+        except Exception as e:
+            raise HttpError(400, f"bad ndarray payload: {e}")
+        if x.ndim < 2:
+            raise HttpError(400, "ndarray must be [batch, features...]")
+        model = req.get("model", DEFAULT_MODEL)
+        deadline_ms = req.get("deadline_ms")
+        if deadline_ms is not None:
+            try:
+                deadline_ms = float(deadline_ms)
+            except (TypeError, ValueError):
+                raise HttpError(400, "deadline_ms must be a number")
+        return x, model, deadline_ms
 
     def _output(self, req: dict):
-        x = np.asarray(req["ndarray"], np.float32)
-        return {"output": np.asarray(self.pi.output(x)).tolist()}
+        x, model, deadline_ms = self._parse(req)
+        if self.mode == "continuous":
+            try:
+                fut = self.scheduler.submit(model, x, deadline_ms)
+                y = fut.result()
+                version = getattr(fut, "version", None)
+            except RequestShedError as e:
+                raise HttpError(503, f"shed: {e}")
+            except DeadlineExceededError as e:
+                raise HttpError(504, f"deadline exceeded: {e}")
+            except SchedulerClosedError as e:
+                raise HttpError(503, f"draining: {e}")
+            except KeyError:
+                raise HttpError(400, f"unknown model: {model!r}")
+        else:
+            t0 = time.monotonic()
+            try:
+                entry = self.registry.acquire(model)
+            except KeyError:
+                raise HttpError(400, f"unknown model: {model!r}")
+            self.stats.admitted(model)
+            try:
+                y = entry.output(x)
+                version = entry.version
+            except BaseException:
+                self.stats.completed(model, 0.0, ok=False)
+                raise
+            finally:
+                self.registry.release(entry)
+            self.stats.completed(model, time.monotonic() - t0)
+        return {"output": np.asarray(y).tolist(), "model": model,
+                "version": version}
+
+    def _healthz(self):
+        depth = self.scheduler.queue_depth() if self.scheduler else 0
+        cap = self.scheduler.capacity if self.scheduler else None
+        degraded = (cap is not None
+                    and depth >= self.degraded_fraction * cap)
+        return {"status": "degraded" if degraded else "ok",
+                "mode": self.mode, "queue_depth": depth,
+                "queue_capacity": cap,
+                "models": self.registry.names()}
+
+    def _metrics(self):
+        depth = self.scheduler.queue_depth() if self.scheduler else 0
+        cap = self.scheduler.capacity if self.scheduler else None
+        return self.stats.snapshot(queue_depth=depth, queue_capacity=cap)
+
+    def get_routes(self):
+        return {"/healthz": self._healthz, "/metrics": self._metrics,
+                "/models": lambda: {"models": self.registry.summary()}}
 
     def post_routes(self):
         return {"/output": self._output}
 
     def stop(self):
         super().stop()
-        self.pi.shutdown()
+        if self.scheduler is not None:
+            self.scheduler.shutdown()
+        self.registry.close()
+
+
+# the control-plane-flavored name; same object
+ModelServer = InferenceServer

@@ -1,0 +1,147 @@
+"""ServingStats — lock-cheap observability aggregator for the serving
+control plane.
+
+Backs the server's `/metrics` endpoint. All hot-path hooks (`admitted`,
+`completed`, `batch_dispatched`, `shed`, `expired`) take one short
+`threading.Lock` acquisition around a handful of counter bumps and a
+bounded-deque append — no allocation proportional to traffic, no
+percentile math on the request path. Percentiles and the occupancy
+histogram are computed on demand in `snapshot()` (the /metrics reader
+pays, not the request).
+
+Reference precedent: the reference's `PerformanceListener` /
+`BenchmarkDataSetIterator` measurement seams, lifted from the training
+loop onto the serving path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, Optional
+
+# occupancy histogram bucket upper bounds (fraction of max_batch filled)
+OCCUPANCY_EDGES = (0.125, 0.25, 0.5, 0.75, 1.0)
+
+
+class _ModelStats:
+    __slots__ = ("admitted", "completed", "failed", "shed", "expired",
+                 "latencies")
+
+    def __init__(self, window: int):
+        self.admitted = 0
+        self.completed = 0
+        self.failed = 0
+        self.shed = 0
+        self.expired = 0
+        self.latencies: deque = deque(maxlen=window)
+
+
+class ServingStats:
+    """Per-model request counters + rolling latency window + global
+    batch-occupancy histogram."""
+
+    def __init__(self, *, latency_window: int = 4096):
+        self._lock = threading.Lock()
+        self._window = latency_window
+        self._models: Dict[str, _ModelStats] = {}
+        self._occupancy = [0] * (len(OCCUPANCY_EDGES) + 1)
+        self._batches = 0
+        self._batch_rows = 0
+        self._started = time.time()
+
+    def _m(self, model: str) -> _ModelStats:
+        s = self._models.get(model)
+        if s is None:
+            s = self._models[model] = _ModelStats(self._window)
+        return s
+
+    # ------------------------------------------------------- hot hooks
+    def admitted(self, model: str):
+        with self._lock:
+            self._m(model).admitted += 1
+
+    def shed(self, model: str):
+        with self._lock:
+            self._m(model).shed += 1
+
+    def expired(self, model: str):
+        with self._lock:
+            self._m(model).expired += 1
+
+    def completed(self, model: str, latency_s: float, ok: bool = True):
+        with self._lock:
+            s = self._m(model)
+            if ok:
+                s.completed += 1
+                s.latencies.append(latency_s)
+            else:
+                s.failed += 1
+
+    def batch_dispatched(self, rows: int, capacity: int):
+        """One device dispatch of `rows` rows against a `capacity`-row
+        budget; buckets the fill fraction into the occupancy histogram."""
+        frac = rows / capacity if capacity else 1.0
+        i = 0
+        while i < len(OCCUPANCY_EDGES) and frac > OCCUPANCY_EDGES[i]:
+            i += 1
+        with self._lock:
+            self._occupancy[i] += 1
+            self._batches += 1
+            self._batch_rows += rows
+
+    # ------------------------------------------------------- reporting
+    @staticmethod
+    def _percentiles(sorted_lat):
+        if not sorted_lat:
+            return {"p50_ms": None, "p95_ms": None, "p99_ms": None}
+        n = len(sorted_lat)
+
+        def pick(q):
+            return round(sorted_lat[min(n - 1, int(q * n))] * 1e3, 3)
+
+        return {"p50_ms": pick(0.50), "p95_ms": pick(0.95),
+                "p99_ms": pick(0.99)}
+
+    def snapshot(self, *, queue_depth: Optional[int] = None,
+                 queue_capacity: Optional[int] = None) -> dict:
+        """The /metrics payload. Queue gauges are passed in by the owner
+        (the scheduler holds them; this aggregator only holds counters)."""
+        with self._lock:
+            models = {
+                name: {
+                    "admitted": s.admitted,
+                    "completed": s.completed,
+                    "failed": s.failed,
+                    "shed": s.shed,
+                    "expired": s.expired,
+                    "latency": dict(window=len(s.latencies),
+                                    **self._percentiles(sorted(s.latencies))),
+                } for name, s in self._models.items()}
+            occupancy = list(self._occupancy)
+            batches, rows = self._batches, self._batch_rows
+            all_lat = sorted(
+                v for s in self._models.values() for v in s.latencies)
+        labels = ["<=12.5%", "<=25%", "<=50%", "<=75%", "<=100%", ">100%"]
+        out = {
+            "uptime_s": round(time.time() - self._started, 1),
+            "requests": {
+                k: sum(m[k] for m in models.values())
+                for k in ("admitted", "completed", "failed", "shed",
+                          "expired")},
+            "latency": dict(window=len(all_lat),
+                            **self._percentiles(all_lat)),
+            "batch": {
+                "dispatches": batches,
+                "rows": rows,
+                "mean_occupancy_rows": round(rows / batches, 3)
+                if batches else None,
+                "occupancy_histogram": dict(zip(labels, occupancy)),
+            },
+            "per_model": models,
+        }
+        if queue_depth is not None:
+            out["queue"] = {"depth": queue_depth,
+                            "capacity": queue_capacity}
+        return out

@@ -1,0 +1,182 @@
+"""ModelRegistry — multiple named+versioned models behind one server,
+with atomic zero-downtime hot-swap.
+
+The registry owns the data plane for each deployed model: a
+`ParallelInference` runner (bucketed pad + per-bucket jit cache + the
+oversize chunking fix in `parallel/inference.py`). The serving
+scheduler dispatches through `acquire()/release()`, which is also the
+hot-swap seam:
+
+  deploy(name, version, net)
+    1. builds the new entry's runner and WARMS its bucketed jit caches
+       (`ParallelInference.warmup`) while the old version keeps serving —
+       no live request ever pays the new version's compiles;
+    2. flips the active pointer under the registry lock — atomic with
+       `acquire`, so a request routes to exactly one version;
+    3. drains the old entry (waits for its in-flight batches to
+       complete) and shuts its runner down.
+
+Requests acquired on the old version finish on the old version;
+requests admitted after the flip run on the new one. Nothing is
+dropped, which is the zero-downtime contract the hot-swap test pins.
+
+Reference precedent: the reference serves models via ParallelInference
+embedded in user code; the registry is the missing control plane the
+DL4J model-server modules (NearestNeighborsServer, Keras gateway)
+imply.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional
+
+from deeplearning4j_tpu.parallel.inference import (
+    InferenceMode, ParallelInference,
+)
+
+
+class ModelEntry:
+    """One deployed (name, version): net + warmed runner + in-flight
+    accounting for drain-on-swap."""
+
+    def __init__(self, name: str, version, net,
+                 runner: ParallelInference):
+        self.name = name
+        self.version = version
+        self.net = net
+        self.runner = runner
+        self.deployed_at = time.time()
+        self.served = 0
+        self._inflight = 0
+        self._cv = threading.Condition()
+        self._retired = False
+
+    # ------------------------------------------------------ data plane
+    def run_batch(self, xs):
+        return self.runner.run_batch(xs)
+
+    def output(self, x):
+        """Collect-mode path: goes through the runner's own collector
+        queue when the runner is BATCHED, direct otherwise."""
+        return self.runner.output(x)
+
+    # ------------------------------------------------------- lifecycle
+    def _drain(self, timeout: Optional[float]) -> bool:
+        with self._cv:
+            return self._cv.wait_for(lambda: self._inflight == 0, timeout)
+
+    def describe(self) -> dict:
+        with self._cv:
+            return {"version": self.version,
+                    "deployed_at": round(self.deployed_at, 3),
+                    "served": self.served,
+                    "inflight": self._inflight,
+                    "retired": self._retired}
+
+
+class ModelRegistry:
+    """Named models, one active version each, atomic hot-swap."""
+
+    def __init__(self, *, mesh=None, max_batch_size: int = 64,
+                 batch_buckets: Optional[List[int]] = None,
+                 runner_mode: str = InferenceMode.INPLACE,
+                 collect_wait_ms: float = 5.0,
+                 drain_timeout_s: float = 30.0):
+        self.mesh = mesh
+        self.max_batch = max_batch_size
+        self.buckets = batch_buckets
+        self.runner_mode = runner_mode
+        self.collect_wait_ms = collect_wait_ms
+        self.drain_timeout = drain_timeout_s
+        self._lock = threading.Lock()
+        self._active: Dict[str, ModelEntry] = {}
+        self._history: Dict[str, List] = {}
+
+    # ---------------------------------------------------------- deploy
+    @staticmethod
+    def _infer_feat_shape(net):
+        """Best-effort single-input feature shape for warmup, from the
+        config's InputType (the repo's single source of shape truth)."""
+        try:
+            it = net.conf.input_type
+            shape = it.shape(1)[1:]
+            return shape if all(d for d in shape) else None
+        except Exception:
+            return None
+
+    def deploy(self, name: str, version, net, *, feat_shape=None,
+               warm: bool = True) -> ModelEntry:
+        """Deploy `net` as the active version of `name`; returns the new
+        entry after the old one (if any) is drained and retired."""
+        runner = ParallelInference(
+            net, mesh=self.mesh, mode=self.runner_mode,
+            max_batch_size=self.max_batch, batch_buckets=self.buckets,
+            max_wait_ms=self.collect_wait_ms)
+        entry = ModelEntry(name, version, net, runner)
+        if warm:
+            shape = feat_shape or self._infer_feat_shape(net)
+            if shape:
+                runner.warmup(shape)
+        with self._lock:
+            old = self._active.get(name)
+            self._active[name] = entry
+            self._history.setdefault(name, []).append(
+                {"version": version, "at": round(time.time(), 3)})
+        if old is not None:
+            self._retire(old)
+        return entry
+
+    def undeploy(self, name: str):
+        with self._lock:
+            old = self._active.pop(name)
+        self._retire(old)
+
+    def _retire(self, entry: ModelEntry):
+        entry._drain(self.drain_timeout)
+        with entry._cv:
+            entry._retired = True
+        entry.runner.shutdown()
+
+    # ------------------------------------------------- scheduler SPI
+    def acquire(self, name: str) -> ModelEntry:
+        """Pin the active entry for one dispatch. Atomic with deploy's
+        flip (same lock), so the old version's drain can never miss a
+        racing dispatch. KeyError for unknown models (HTTP 400)."""
+        with self._lock:
+            entry = self._active[name]
+            with entry._cv:
+                entry._inflight += 1
+                entry.served += 1
+        return entry
+
+    def release(self, entry: ModelEntry):
+        with entry._cv:
+            entry._inflight -= 1
+            entry._cv.notify_all()
+
+    # ------------------------------------------------------- inspection
+    def get(self, name: str) -> ModelEntry:
+        with self._lock:
+            return self._active[name]
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._active)
+
+    def summary(self) -> dict:
+        """/models payload."""
+        with self._lock:
+            entries = dict(self._active)
+            history = {n: list(h) for n, h in self._history.items()}
+        return {name: dict(entry.describe(),
+                           deployments=len(history.get(name, ())))
+                for name, entry in sorted(entries.items())}
+
+    def close(self):
+        with self._lock:
+            entries = list(self._active.values())
+            self._active.clear()
+        for e in entries:
+            self._retire(e)

@@ -1,0 +1,289 @@
+"""Continuous-batching scheduler + admission control for the serving
+control plane.
+
+Replaces the fixed collect-then-run loop (`ParallelInference._collector`,
+which waits up to `max_wait_ms` hoping to fill a batch) with the
+scheduling discipline real inference servers use: a request joins the
+very next device dispatch as soon as a slot frees. While a slot is busy
+the queue naturally accumulates arrivals, so batches grow under load and
+shrink to singletons when idle — occupancy tracks load with no tuned
+wait timer, which is exactly where the p99 win over collect-then-run
+comes from (measured in `bench.py --serving`).
+
+Admission control is a bounded queue with a configurable policy:
+
+  block    — the submitting thread waits (bounded by `block_timeout_s`)
+             for space; backpressure propagates to the HTTP client
+  shed     — a full queue rejects immediately (`RequestShedError`,
+             mapped to HTTP 503)
+  deadline — every request carries a deadline (per-request or
+             `default_deadline_ms`); admission waits only until the
+             deadline (`DeadlineExceededError`, HTTP 504)
+
+Deadlines propagate INTO the scheduler: a request that expires while
+queued is failed and never dispatched — the accelerator never burns a
+batch slot on work nobody is waiting for.
+
+Shutdown contract (extends `parallel/inference.py`'s drain guarantee):
+every submitted request either completes or fails with an explicit
+error; nothing hangs. Queued requests are failed with
+`SchedulerClosedError`; the batch in flight runs to completion.
+"""
+
+from __future__ import annotations
+
+import contextvars
+import threading
+import time
+from collections import deque
+from concurrent.futures import Future
+from typing import Dict, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.serving.metrics import ServingStats
+
+
+class AdmissionPolicy:
+    BLOCK = "block"
+    SHED = "shed"
+    DEADLINE = "deadline"
+
+    ALL = (BLOCK, SHED, DEADLINE)
+
+
+class RequestShedError(RuntimeError):
+    """Admission queue full under the shed policy (HTTP 503)."""
+
+
+class DeadlineExceededError(RuntimeError):
+    """Request deadline passed before completion (HTTP 504)."""
+
+
+class SchedulerClosedError(RuntimeError):
+    """Scheduler shut down before (or while) holding this request."""
+
+
+class _Request:
+    __slots__ = ("x", "fut", "model", "deadline", "t_enqueue", "ctx",
+                 "seq_key")
+
+    def __init__(self, x, fut, model, deadline, ctx, seq_key):
+        self.x = x
+        self.fut = fut
+        self.model = model
+        self.deadline = deadline
+        self.t_enqueue = time.monotonic()
+        self.ctx = ctx
+        self.seq_key = seq_key
+
+
+class ContinuousBatchingScheduler:
+    """Slot workers pulling per-model FIFO queues; one registry behind.
+
+    `registry` needs `acquire(name) -> entry` / `release(entry)` with
+    `entry.run_batch(xs)` (the ModelRegistry contract; unit tests pass
+    fakes). `slots` is the number of concurrent device dispatch lanes —
+    1 for a single mesh, >1 when the runner multiplexes devices.
+    """
+
+    def __init__(self, registry, stats: Optional[ServingStats] = None, *,
+                 max_batch_size: int = 64, queue_capacity: int = 256,
+                 policy: str = AdmissionPolicy.BLOCK,
+                 default_deadline_ms: Optional[float] = None,
+                 slots: int = 1, block_timeout_s: float = 30.0):
+        if policy not in AdmissionPolicy.ALL:
+            raise ValueError(
+                f"admission policy must be one of {AdmissionPolicy.ALL}, "
+                f"got {policy!r}")
+        if policy == AdmissionPolicy.DEADLINE and not default_deadline_ms:
+            raise ValueError(
+                "deadline admission policy requires default_deadline_ms")
+        self.registry = registry
+        self.stats = stats if stats is not None else ServingStats()
+        self.max_batch = max_batch_size
+        self.capacity = queue_capacity
+        self.policy = policy
+        self.default_deadline = (default_deadline_ms / 1e3
+                                 if default_deadline_ms else None)
+        self.block_timeout = block_timeout_s
+        self._cv = threading.Condition()
+        self._queues: Dict[str, deque] = {}
+        self._depth = 0
+        self._inflight = 0
+        self._closed = False
+        self._workers = [
+            threading.Thread(target=self._worker, daemon=True,
+                             name=f"serving-slot-{i}")
+            for i in range(max(1, slots))]
+        for w in self._workers:
+            w.start()
+
+    # ---------------------------------------------------------- public
+    def queue_depth(self) -> int:
+        with self._cv:
+            return self._depth
+
+    def submit(self, model: str, x,
+               deadline_ms: Optional[float] = None) -> Future:
+        """Admit one request; returns a Future resolving to the output
+        rows. Raises RequestShedError / DeadlineExceededError /
+        SchedulerClosedError per the admission contract."""
+        x = np.asarray(x)
+        now = time.monotonic()
+        dl_s = (deadline_ms / 1e3 if deadline_ms is not None
+                else self.default_deadline)
+        deadline = now + dl_s if dl_s is not None else None
+
+        from deeplearning4j_tpu.parallel.ring_attention import (
+            current_sequence_mesh,
+        )
+
+        with self._cv:
+            if self._closed:
+                raise SchedulerClosedError("scheduler is shut down")
+            if self._depth >= self.capacity:
+                if self.policy == AdmissionPolicy.SHED:
+                    self.stats.shed(model)
+                    raise RequestShedError(
+                        f"admission queue full "
+                        f"({self._depth}/{self.capacity})")
+                limit = now + self.block_timeout
+                if deadline is not None:
+                    limit = min(limit, deadline)
+                while self._depth >= self.capacity and not self._closed:
+                    remaining = limit - time.monotonic()
+                    if remaining <= 0:
+                        if (deadline is not None
+                                and time.monotonic() >= deadline):
+                            self.stats.expired(model)
+                            raise DeadlineExceededError(
+                                "deadline passed waiting for admission")
+                        self.stats.shed(model)
+                        raise RequestShedError(
+                            f"admission blocked > {self.block_timeout}s")
+                    self._cv.wait(remaining)
+                if self._closed:
+                    raise SchedulerClosedError("scheduler is shut down")
+            fut: Future = Future()
+            req = _Request(x, fut, model, deadline,
+                           contextvars.copy_context(),
+                           current_sequence_mesh())
+            self._queues.setdefault(model, deque()).append(req)
+            self._depth += 1
+            self.stats.admitted(model)
+            self._cv.notify_all()
+        return fut
+
+    def output(self, model: str, x,
+               deadline_ms: Optional[float] = None) -> np.ndarray:
+        """Blocking submit; the synchronous convenience the HTTP handler
+        uses."""
+        return self.submit(model, x, deadline_ms).result()
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Block until the queue is empty and no batch is in flight."""
+        with self._cv:
+            return self._cv.wait_for(
+                lambda: self._depth == 0 and self._inflight == 0, timeout)
+
+    def shutdown(self):
+        """Fail everything queued with SchedulerClosedError, let the
+        in-flight batch finish, stop the slot workers."""
+        with self._cv:
+            self._closed = True
+            leftovers = [r for q in self._queues.values() for r in q]
+            self._queues.clear()
+            self._depth = 0
+            self._cv.notify_all()
+        for r in leftovers:
+            if not r.fut.done():
+                r.fut.set_exception(SchedulerClosedError(
+                    "scheduler shut down before serving this request"))
+                self.stats.completed(r.model, 0.0, ok=False)
+        for w in self._workers:
+            w.join(timeout=10)
+
+    # ---------------------------------------------------------- worker
+    def _take_batch(self):
+        """Pop the next single-(model, seq-context) batch, FIFO-fair
+        across models by oldest head request. Called under self._cv."""
+        name = min((n for n, q in self._queues.items() if q),
+                   key=lambda n: self._queues[n][0].t_enqueue)
+        q = self._queues[name]
+        batch = [q.popleft()]
+        rows = batch[0].x.shape[0]
+        while (q and rows < self.max_batch
+               and q[0].seq_key == batch[0].seq_key):
+            nxt = q.popleft()
+            batch.append(nxt)
+            rows += nxt.x.shape[0]
+        self._depth -= len(batch)
+        return batch
+
+    def _worker(self):
+        while True:
+            with self._cv:
+                while not self._closed and self._depth == 0:
+                    self._cv.wait()
+                if self._closed:
+                    return
+                batch = self._take_batch()
+                self._inflight += 1
+                self._cv.notify_all()   # wake admission waiters
+            try:
+                self._dispatch(batch)
+            finally:
+                with self._cv:
+                    self._inflight -= 1
+                    self._cv.notify_all()
+
+    def _dispatch(self, batch):
+        now = time.monotonic()
+        live = []
+        for r in batch:
+            if r.deadline is not None and now >= r.deadline:
+                # expired while queued: never ship it to the device
+                self.stats.expired(r.model)
+                if not r.fut.done():
+                    r.fut.set_exception(DeadlineExceededError(
+                        f"deadline exceeded after "
+                        f"{now - r.t_enqueue:.3f}s in queue"))
+                continue
+            live.append(r)
+        if not live:
+            return
+        model = live[0].model
+        try:
+            entry = self.registry.acquire(model)
+        except BaseException as e:
+            for r in live:
+                if not r.fut.done():
+                    r.fut.set_exception(e)
+                self.stats.completed(r.model, 0.0, ok=False)
+            return
+        try:
+            xs = (live[0].x if len(live) == 1
+                  else np.concatenate([r.x for r in live], axis=0))
+            self.stats.batch_dispatched(xs.shape[0], self.max_batch)
+            ys = live[0].ctx.run(entry.run_batch, xs)
+            done = time.monotonic()
+            ver = getattr(entry, "version", None)
+            off = 0
+            for r in live:
+                n = r.x.shape[0]
+                if not r.fut.done():
+                    # stamp which deployed version served this request
+                    # BEFORE resolving, so result() readers see it —
+                    # the hot-swap zero-downtime evidence
+                    r.fut.version = ver
+                    r.fut.set_result(ys[off:off + n])
+                self.stats.completed(r.model, done - r.t_enqueue)
+                off += n
+        except BaseException as e:
+            for r in live:
+                if not r.fut.done():
+                    r.fut.set_exception(e)
+                self.stats.completed(r.model, 0.0, ok=False)
+        finally:
+            self.registry.release(entry)
