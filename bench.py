@@ -603,7 +603,19 @@ def _child_main():
             if _SYNC_STATS["steps"] else None),
         "steps_per_dispatch": int(
             os.environ.get("BENCH_STEPS_PER_DISPATCH", "1")),
+        "registry": _registry_snapshot(),
     }))
+
+
+def _registry_snapshot():
+    """The process-wide MetricsRegistry snapshot embedded in the BENCH
+    blob (compile counts, ETL/prefetch series, listener gauges) —
+    `python -m deeplearning4j_tpu.observe.dump BENCH_*.json` renders it."""
+    try:
+        from deeplearning4j_tpu.observe import get_registry
+        return get_registry().snapshot()
+    except Exception:
+        return None
 
 
 def _attempt_plans():
@@ -947,6 +959,7 @@ def _serving_main():
         "modes": modes,
         "device": getattr(dev, "device_kind", str(dev)),
         "platform": dev.platform,
+        "registry": _registry_snapshot(),
     }
     dest = os.environ.get("BENCH_SERVING_OUT") or os.path.join(
         os.path.dirname(os.path.abspath(__file__)), "BENCH_serving.json")

@@ -22,6 +22,7 @@ from typing import Callable, Iterator, List, Optional
 import numpy as np
 
 from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.observe import get_registry
 
 
 class DataSetIterator:
@@ -112,6 +113,11 @@ class AsyncDataSetIterator(DataSetIterator):
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
         self._stop: Optional[threading.Event] = None
+        reg = get_registry()
+        self._m_batches = reg.counter("etl_batches_total", stage="async")
+        self._m_hits = reg.counter("prefetch_hits_total", stage="async")
+        self._m_misses = reg.counter("prefetch_misses_total", stage="async")
+        self._m_depth = reg.gauge("prefetch_queue_depth", stage="async")
 
     def _pump(self, q: queue.Queue, stop: threading.Event):
         try:
@@ -158,6 +164,11 @@ class AsyncDataSetIterator(DataSetIterator):
             err, self._error = self._error, None
             self.close()
             raise err
+        # qsize() before the get: non-empty means the pump stayed ahead
+        # of the consumer (a prefetch hit); empty means this step waited
+        # on host ETL. Advisory but cheap — the ratio is the signal.
+        depth = self._queue.qsize()
+        self._m_depth.set(depth)
         item = self._queue.get()
         if item is self._SENTINEL:
             self._queue = None
@@ -165,6 +176,8 @@ class AsyncDataSetIterator(DataSetIterator):
                 err, self._error = self._error, None
                 raise err
             raise StopIteration
+        (self._m_hits if depth > 0 else self._m_misses).inc()
+        self._m_batches.inc()
         return item
 
     def close(self) -> None:
@@ -351,6 +364,10 @@ class DevicePrefetchIterator(DataSetIterator):
         self._inner: Optional[Iterator] = None
         self._buf: List = []
         self._exhausted = False
+        reg = get_registry()
+        self._m_hits = reg.counter("prefetch_hits_total", stage="device")
+        self._m_misses = reg.counter("prefetch_misses_total", stage="device")
+        self._m_batches = reg.counter("etl_batches_total", stage="device")
 
     def _put(self, ds):
         import jax
@@ -383,9 +400,15 @@ class DevicePrefetchIterator(DataSetIterator):
     def __next__(self):
         if self._inner is None:
             self.reset()
+        # a batch already buffered = its device_put was enqueued while the
+        # consumer computed (hit); an empty buffer = this step pays the
+        # host-side fetch+put latency in line (miss)
+        ready = bool(self._buf)
         self._fill()
         if not self._buf:
             raise StopIteration
+        (self._m_hits if ready else self._m_misses).inc()
+        self._m_batches.inc()
         item = self._buf.pop(0)
         self._fill()    # immediately enqueue the replacement transfer
         return item

@@ -1,0 +1,50 @@
+"""Unified observability core: one telemetry spine for training + serving.
+
+Before this package, observability was four disconnected islands
+(`optim/listeners.py` counters, `utils/profiling.py` traces, `ui/stats.py`
+reports, `serving/metrics.py`'s private aggregator) and the two costs that
+silently destroy TPU utilization — jit-cache recompiles from shape churn
+and accidental host syncs in the deferred-dispatch pipeline — were
+invisible at runtime. This package is the one instrumentation contract
+every layer shares:
+
+- `MetricsRegistry` (`registry.py`) — process-wide counters, gauges, and
+  histograms with bounded reservoirs and labeled series; thread-safe;
+  snapshot + Prometheus-text + JSONL exporters. The serving `/metrics`
+  endpoint and the training listeners are renderers over this registry.
+- `span()` (`trace.py`) — async-dispatch-safe host-side tracing spans.
+  Spans time HOST work only and never call `float()` /
+  `block_until_ready()` on device values, so enabling tracing cannot
+  stall the dispatch pipeline (pinned by the ≤1-sync-per-epoch test).
+- `RecompileWatchdog` (`watchdog.py`) — counts every jit-cache compile
+  across the per-model `_jit_cache` seams and warns once per model when
+  compiles cross a churn threshold (the classic silent 10x).
+- `HostSyncMonitor` (`syncmon.py`) — opt-in runtime generalization of the
+  test-only dispatch-depth guard: counts device→host materializations so
+  `PerformanceListener` can report syncs/step in production.
+- `python -m deeplearning4j_tpu.observe.dump` (`dump.py`) — pretty-print
+  a registry snapshot or tail a span JSONL.
+
+The package imports only the stdlib (no jax) so the dump tool and the
+registry work anywhere; jax seams are bound lazily at install time.
+"""
+
+from deeplearning4j_tpu.observe.registry import (
+    MetricsRegistry, get_registry, set_registry,
+)
+from deeplearning4j_tpu.observe.trace import (
+    SpanLog, emit_manual_span, install_span_log, read_spans, span,
+    tracing_enabled, uninstall_span_log,
+)
+from deeplearning4j_tpu.observe.watchdog import (
+    RecompileWatchdog, WatchedJitCache, get_watchdog, set_watchdog,
+)
+from deeplearning4j_tpu.observe.syncmon import HostSyncMonitor, current_monitor
+
+__all__ = [
+    "MetricsRegistry", "get_registry", "set_registry",
+    "SpanLog", "span", "install_span_log", "uninstall_span_log",
+    "tracing_enabled", "read_spans", "emit_manual_span",
+    "RecompileWatchdog", "WatchedJitCache", "get_watchdog", "set_watchdog",
+    "HostSyncMonitor", "current_monitor",
+]

@@ -1,0 +1,132 @@
+"""HostSyncMonitor — the dispatch-depth guard as a runtime metric.
+
+`tests/test_perf_guard.py::TestDispatchDepthGuard` proves the fit loop
+performs ≤1 host sync per epoch by patching the two device→host
+materialization seams (`ArrayImpl.__float__` and `block_until_ready`)
+and counting. That technique is too useful to leave test-only: a
+listener added in production (a `float(score)` every step) silently
+re-serializes the whole dispatch pipeline, and nothing today would say
+so. This monitor is the same patch as an OPT-IN runtime instrument:
+
+    with HostSyncMonitor() as mon:
+        net.fit(x, y, epochs=3)
+    print(mon.syncs)          # total materializations
+
+While installed, `PerformanceListener` reports syncs/step in its
+periodic line and the `train_host_syncs_per_step` registry gauge. Opt-in
+because the wrapper adds one Python call per materialization AND
+monkey-patches a jax internal — not something a library turns on behind
+your back. Install/uninstall are refcounted and idempotent; nesting
+monitors shares one patch.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+_lock = threading.Lock()
+_monitors: list = []          # install order; [-1] is `current_monitor()`
+_originals: Optional[tuple] = None
+
+
+def current_monitor() -> Optional["HostSyncMonitor"]:
+    """The innermost installed monitor, or None (the PerformanceListener
+    seam: report syncs/step only when someone asked to measure)."""
+    with _lock:
+        return _monitors[-1] if _monitors else None
+
+
+def _patch():
+    """Install the counting wrappers (called with _lock held, once)."""
+    global _originals
+    from jax._src import array as _jarray
+
+    orig_float = _jarray.ArrayImpl.__float__
+    orig_block = _jarray.ArrayImpl.block_until_ready
+
+    def counting_float(a):
+        for m in _monitors:
+            m._bump("float")
+        return orig_float(a)
+
+    def counting_block(a):
+        for m in _monitors:
+            m._bump("block")
+        return orig_block(a)
+
+    _jarray.ArrayImpl.__float__ = counting_float
+    _jarray.ArrayImpl.block_until_ready = counting_block
+    _originals = (_jarray.ArrayImpl, orig_float, orig_block)
+
+
+def _unpatch():
+    global _originals
+    cls, orig_float, orig_block = _originals
+    cls.__float__ = orig_float
+    cls.block_until_ready = orig_block
+    _originals = None
+
+
+class HostSyncMonitor:
+    """Counts device→host materializations while installed."""
+
+    def __init__(self, metrics=None):
+        self._metrics = metrics
+        self._count_lock = threading.Lock()
+        self.float_syncs = 0
+        self.block_syncs = 0
+        self._installed = False
+
+    @property
+    def syncs(self) -> int:
+        with self._count_lock:
+            return self.float_syncs + self.block_syncs
+
+    def _bump(self, kind: str) -> None:
+        with self._count_lock:
+            if kind == "float":
+                self.float_syncs += 1
+            else:
+                self.block_syncs += 1
+
+    def take(self) -> int:
+        """Syncs since the last take() — the per-report-window delta the
+        PerformanceListener divides by its batch count."""
+        with self._count_lock:
+            n = self.float_syncs + self.block_syncs
+            self.float_syncs = 0
+            self.block_syncs = 0
+        return n
+
+    # -------------------------------------------------------- lifecycle
+    def install(self) -> "HostSyncMonitor":
+        with _lock:
+            if self._installed:
+                return self
+            if not _monitors:
+                _patch()
+            _monitors.append(self)
+            self._installed = True
+        if self._metrics is None:
+            from deeplearning4j_tpu.observe.registry import get_registry
+            self._metrics = get_registry()
+        return self
+
+    def uninstall(self) -> None:
+        with _lock:
+            if not self._installed:
+                return
+            self._installed = False
+            try:
+                _monitors.remove(self)
+            except ValueError:
+                pass
+            if not _monitors and _originals is not None:
+                _unpatch()
+
+    def __enter__(self) -> "HostSyncMonitor":
+        return self.install()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.uninstall()

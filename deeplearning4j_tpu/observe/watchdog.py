@@ -1,0 +1,159 @@
+"""RecompileWatchdog — make jit-cache churn loud before it eats a run.
+
+Every model-level compiled program in this framework lives in a
+`_jit_cache` dict behind the `SeqCtxJitCache` mixin
+(`parallel/ring_attention.py`): `MultiLayerNetwork` / `ComputationGraph`
+train-step caches, `ParallelInference`'s per-bucket forwards,
+`ParallelWrapper`'s sharded steps. A compile happens exactly when a NEW
+key is inserted into one of those dicts — so `WatchedJitCache`
+(installed by the mixin) reports every first-time insertion here, and
+the watchdog:
+
+- counts compiles per owning object and per owner class (the class-level
+  count feeds the `jit_compiles` registry counter — bounded label
+  cardinality);
+- records each cache key's shape signature (the repr of the cache key,
+  which embeds batch/feature/timestep shapes for the shape-keyed
+  caches), so `snapshot()` shows exactly WHICH shapes churned;
+- warns ONCE per owner when its compile count crosses the churn
+  threshold — the signal that input shapes are unbucketed and every
+  batch is paying a trace+compile (the classic silent 10x).
+
+Counting costs one lock acquisition per COMPILE (not per step): compiles
+are rare by construction, so the watchdog is always on.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Dict, List, Optional
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+DEFAULT_THRESHOLD = int(os.environ.get("DL4J_TPU_RECOMPILE_THRESHOLD", "10"))
+_MAX_SIGNATURES = 64   # per-owner bound on recorded shape signatures
+
+
+class RecompileWatchdog:
+    """Counts jit compiles per owner; warn-once past `threshold`."""
+
+    def __init__(self, *, threshold: int = DEFAULT_THRESHOLD,
+                 metrics=None):
+        self.threshold = max(1, int(threshold))
+        self._metrics = metrics
+        self._lock = threading.Lock()
+        self._counts: Dict[str, int] = {}
+        self._signatures: Dict[str, List[str]] = {}
+        self._warned: set = set()
+
+    def _registry(self):
+        if self._metrics is None:
+            from deeplearning4j_tpu.observe.registry import get_registry
+            self._metrics = get_registry()
+        return self._metrics
+
+    def record_compile(self, owner_tag: str, owner_class: str,
+                       key) -> None:
+        """One first-time jit-cache insertion on `owner_tag` (a
+        per-instance id) of class `owner_class` under cache key `key`."""
+        warn_count = None
+        with self._lock:
+            n = self._counts.get(owner_tag, 0) + 1
+            self._counts[owner_tag] = n
+            sigs = self._signatures.setdefault(owner_tag, [])
+            if len(sigs) < _MAX_SIGNATURES:
+                sigs.append(repr(key))
+            if n >= self.threshold and owner_tag not in self._warned:
+                self._warned.add(owner_tag)
+                warn_count = n
+        self._registry().counter("jit_compiles", owner=owner_class).inc()
+        if warn_count is not None:
+            with self._lock:
+                recent = self._signatures.get(owner_tag, [])[-5:]
+            logger.warning(
+                "RecompileWatchdog: %s has compiled %d distinct jit "
+                "programs (threshold %d) — likely shape churn (dynamic "
+                "batch/sequence sizes defeating the jit cache). Recent "
+                "cache keys: %s. Bucket input shapes (pad to fixed "
+                "batch/length buckets, as ParallelInference does) or "
+                "raise DL4J_TPU_RECOMPILE_THRESHOLD if this workload "
+                "legitimately needs many programs.",
+                owner_tag, warn_count, self.threshold, recent)
+
+    # --------------------------------------------------------- reporting
+    def compiles(self, owner_tag: Optional[str] = None) -> int:
+        with self._lock:
+            if owner_tag is not None:
+                return self._counts.get(owner_tag, 0)
+            return sum(self._counts.values())
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "threshold": self.threshold,
+                "total_compiles": sum(self._counts.values()),
+                "per_owner": {
+                    tag: {"compiles": n,
+                          "signatures": list(self._signatures.get(tag, ())),
+                          "warned": tag in self._warned}
+                    for tag, n in self._counts.items()},
+            }
+
+    def reset(self) -> None:
+        with self._lock:
+            self._counts.clear()
+            self._signatures.clear()
+            self._warned.clear()
+
+
+class WatchedJitCache(dict):
+    """A jit-cache dict that reports first-time insertions (= compiles)
+    to the watchdog. Holds only the owner's tag strings, never the owner
+    itself — a cache must not keep its model alive."""
+
+    __slots__ = ("owner_tag", "owner_class")
+
+    def __init__(self, owner=None, *, owner_tag: Optional[str] = None,
+                 owner_class: Optional[str] = None):
+        super().__init__()
+        cls = owner_class or (type(owner).__name__ if owner is not None
+                              else "unknown")
+        self.owner_class = cls
+        self.owner_tag = owner_tag or (
+            f"{cls}@{id(owner):#x}" if owner is not None else cls)
+
+    def __setitem__(self, key, value):
+        if key not in self:
+            get_watchdog().record_compile(
+                self.owner_tag, self.owner_class, key)
+        super().__setitem__(key, value)
+
+    def setdefault(self, key, default=None):
+        if key not in self:
+            self[key] = default      # route through __setitem__
+            return default
+        return self[key]
+
+    def update(self, *args, **kw):
+        for k, v in dict(*args, **kw).items():
+            self[k] = v
+
+
+# ------------------------------------------------------------ process-wide
+_default_watchdog = RecompileWatchdog()
+_lock = threading.Lock()
+
+
+def get_watchdog() -> RecompileWatchdog:
+    return _default_watchdog
+
+
+def set_watchdog(watchdog: RecompileWatchdog) -> RecompileWatchdog:
+    """Swap the process-wide watchdog (tests pin thresholds this way);
+    returns the previous one."""
+    global _default_watchdog
+    with _lock:
+        prev, _default_watchdog = _default_watchdog, watchdog
+    return prev

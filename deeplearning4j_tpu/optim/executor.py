@@ -31,6 +31,8 @@ from __future__ import annotations
 import time
 from typing import Any, Callable, List, Optional
 
+from deeplearning4j_tpu.observe import get_registry, span
+
 __all__ = ["LossTracker", "TrainingExecutor", "SKIP", "STOP"]
 
 # before_batch sentinels: skip this batch (resume replay) / stop cleanly
@@ -150,60 +152,73 @@ class TrainingExecutor:
         self.epoch_start = epoch_start
         self.epoch_end = epoch_end
         self.stopped = False
+        reg = get_registry()
+        self._iter_counter = reg.counter("train_iterations")
+        self._etl_hist = reg.histogram("train_etl_ms")
 
     # ------------------------------------------------------------- loop
     def run(self, iterable, epochs: int, *, start_epoch: int = 0):
         net = self.net
         listeners = net.listeners
-        for l in listeners:
-            l.on_fit_start(net)
-        self.stopped = False
-        for _ in range(start_epoch, epochs):
-            if self.epoch_start is not None:
-                self.epoch_start()
+        # registry handles cached once per run; _finish only bumps them.
+        # Spans carry only host-side scalars — never the device loss.
+        reg = get_registry()
+        self._iter_counter = reg.counter("train_iterations")
+        self._etl_hist = reg.histogram("train_etl_ms")
+        with span("fit", epochs=epochs, start_epoch=start_epoch,
+                  steps_per_dispatch=self.k):
             for l in listeners:
-                l.on_epoch_start(net, net.epoch)
-            buf: List = []
-            etl_start = time.perf_counter()
-            for bi, ds in enumerate(iter(iterable)):
-                etl_ms = (time.perf_counter() - etl_start) * 1e3
-                if self.before_batch is not None:
-                    ds = self.before_batch(bi, ds)
-                    if ds is SKIP:
+                l.on_fit_start(net)
+            self.stopped = False
+            for _ in range(start_epoch, epochs):
+                with span("fit.epoch", epoch=net.epoch):
+                    if self.epoch_start is not None:
+                        self.epoch_start()
+                    for l in listeners:
+                        l.on_epoch_start(net, net.epoch)
+                    buf: List = []
+                    etl_start = time.perf_counter()
+                    for bi, ds in enumerate(iter(iterable)):
+                        etl_ms = (time.perf_counter() - etl_start) * 1e3
+                        if self.before_batch is not None:
+                            ds = self.before_batch(bi, ds)
+                            if ds is SKIP:
+                                etl_start = time.perf_counter()
+                                continue
+                            if ds is STOP:
+                                self.stopped = True
+                                break
+                        fusible = (self.k > 1 and self.fused_step is not None
+                                   and self.can_fuse(ds))
+                        if fusible and buf and \
+                                batch_signature(buf[0][1]) != \
+                                batch_signature(ds):
+                            self._drain(buf)
+                            buf = []
+                        if fusible:
+                            buf.append((bi, ds, etl_ms))
+                            if len(buf) == self.k:
+                                self._run_fused(buf)
+                                buf = []
+                        else:
+                            self._drain(buf)
+                            buf = []
+                            self._finish(bi, self.step(ds), etl_ms)
                         etl_start = time.perf_counter()
-                        continue
-                    if ds is STOP:
-                        self.stopped = True
+                    self._drain(buf)
+                    if self.stopped:
                         break
-                fusible = (self.k > 1 and self.fused_step is not None
-                           and self.can_fuse(ds))
-                if fusible and buf and \
-                        batch_signature(buf[0][1]) != batch_signature(ds):
-                    self._drain(buf)
-                    buf = []
-                if fusible:
-                    buf.append((bi, ds, etl_ms))
-                    if len(buf) == self.k:
-                        self._run_fused(buf)
-                        buf = []
-                else:
-                    self._drain(buf)
-                    buf = []
-                    self._finish(bi, self.step(ds), etl_ms)
-                etl_start = time.perf_counter()
-            self._drain(buf)
-            if self.stopped:
-                break
+                    for l in listeners:
+                        l.on_epoch_end(net, net.epoch)
+                    net.epoch += 1
+                    if self.epoch_end is not None:
+                        self.epoch_end()
+                    # the ONE guaranteed materialization per epoch: score_
+                    # is a float at every epoch boundary without per-step
+                    # syncs
+                    net._loss_tracker.materialize()
             for l in listeners:
-                l.on_epoch_end(net, net.epoch)
-            net.epoch += 1
-            if self.epoch_end is not None:
-                self.epoch_end()
-            # the ONE guaranteed materialization per epoch: score_ is a
-            # float at every epoch boundary without per-step syncs
-            net._loss_tracker.materialize()
-        for l in listeners:
-            l.on_fit_end(net)
+                l.on_fit_end(net)
         return net
 
     # ---------------------------------------------------------- helpers
@@ -223,6 +238,8 @@ class TrainingExecutor:
         net = self.net
         net._loss_tracker.update(loss)
         net.iteration += 1
+        self._iter_counter.inc()
+        self._etl_hist.observe(etl_ms)
         for l in net.listeners:
             if hasattr(l, "set_etl_time"):
                 l.set_etl_time(etl_ms)

@@ -88,11 +88,33 @@ class PerformanceListener(TrainingListener):
         self.last_etl_ms = 0.0
         # MFU reporting (TPU-native extension of the reference's counters):
         # flops_per_step from utils/profiling.step_flops(model, x, y);
-        # peak_flops defaults to the chip's spec-sheet bf16 peak.
+        # peak_flops defaults to the chip's spec-sheet bf16 peak — resolved
+        # ONCE here, not on the reporting path (the spec lookup + device
+        # count don't change mid-fit).
         self.flops_per_step = flops_per_step
+        if flops_per_step and peak_flops is None:
+            try:
+                import jax
+                from deeplearning4j_tpu.utils.profiling import peak_flops as \
+                    _peak
+                # step_flops is the GLOBAL step's HLO count, so the default
+                # peak must cover every participating chip
+                per_chip = _peak()
+                if per_chip:
+                    peak_flops = per_chip * jax.device_count()
+            except Exception:
+                peak_flops = None
         self.peak_flops = peak_flops
         self.last_mfu: Optional[float] = None
         self.last_step_ms: Optional[float] = None
+        self.last_syncs_per_step: Optional[float] = None
+        from deeplearning4j_tpu.observe import get_registry
+
+        reg = get_registry()
+        self._g_sps = reg.gauge("train_samples_per_sec")
+        self._g_step_ms = reg.gauge("train_step_ms")
+        self._g_mfu = reg.gauge("train_mfu")
+        self._g_syncs = reg.gauge("train_host_syncs_per_step")
 
     def set_etl_time(self, ms: float) -> None:
         """Reference: setLastEtlTime threading (`MultiLayerNetwork.java:1092`)."""
@@ -111,25 +133,28 @@ class PerformanceListener(TrainingListener):
             self.last_batches_per_sec = n_batches / dt
             self.last_samples_per_sec = n_batches * bs / dt
             self.last_step_ms = dt / n_batches * 1e3
+            self._g_sps.set(self.last_samples_per_sec)
+            self._g_step_ms.set(self.last_step_ms)
             msg = (f"iteration {iteration}: "
                    f"{self.last_samples_per_sec:.1f} samples/sec, "
                    f"{self.last_batches_per_sec:.2f} batches/sec, "
                    f"{self.last_step_ms:.1f} ms/step, "
                    f"ETL {self.last_etl_ms:.1f} ms")
-            if self.flops_per_step:
-                peak = self.peak_flops
-                if peak is None:
-                    # step_flops is the GLOBAL step's HLO count, so the
-                    # default peak must cover every participating chip
-                    import jax
-                    from deeplearning4j_tpu.utils.profiling import peak_flops
-                    per_chip = peak_flops()
-                    if per_chip:
-                        peak = self.peak_flops = per_chip * jax.device_count()
-                if peak:
-                    self.last_mfu = (self.flops_per_step
-                                     * self.last_batches_per_sec / peak)
-                    msg += f", MFU {self.last_mfu:.1%}"
+            if self.flops_per_step and self.peak_flops:
+                self.last_mfu = (self.flops_per_step
+                                 * self.last_batches_per_sec
+                                 / self.peak_flops)
+                self._g_mfu.set(self.last_mfu)
+                msg += f", MFU {self.last_mfu:.1%}"
+            from deeplearning4j_tpu.observe import current_monitor
+
+            mon = current_monitor()
+            if mon is not None:
+                # syncs since the last report window — the runtime version
+                # of the perf-guard's dispatch-depth assertion
+                self.last_syncs_per_step = mon.take() / n_batches
+                self._g_syncs.set(self.last_syncs_per_step)
+                msg += f", {self.last_syncs_per_step:.2f} syncs/step"
             self._report(msg)
             self._last_time = now
             self._last_iter = iteration
@@ -142,17 +167,37 @@ class TimeIterationListener(TrainingListener):
         self.total = total_iterations
         self.frequency = max(1, frequency)
         self._start = None
+        self._start_iter = 0
+
+    def on_fit_start(self, model):
+        # the clock starts at fit start, not at the end of the first step —
+        # the old lazy init swallowed the first iteration's report and
+        # based the rate on a denominator one step too large
+        self._start = time.perf_counter()
+        self._start_iter = getattr(model, "iteration", 0)
 
     def iteration_done(self, model, iteration, epoch, score):
         if self._start is None:
+            # attached mid-fit (or driven without on_fit_start): anchor the
+            # clock one step back so this report still has a rate
             self._start = time.perf_counter()
-            return
+            self._start_iter = iteration - 1
         if iteration % self.frequency == 0 and iteration > 0:
+            done = iteration - self._start_iter
+            if done <= 0:
+                return
             elapsed = time.perf_counter() - self._start
-            remaining = elapsed / iteration * max(self.total - iteration, 0)
-            logger.info(
-                f"iteration {iteration}/{self.total}, ETA {remaining:.0f}s"
-            )
+            rate = elapsed / done
+            if self.total and self.total > 0:
+                remaining = rate * max(self.total - iteration, 0)
+                logger.info(
+                    f"iteration {iteration}/{self.total}, "
+                    f"ETA {remaining:.0f}s")
+            else:
+                # total unknown/invalid: report progress without an ETA
+                # instead of a nonsense negative estimate
+                logger.info(
+                    f"iteration {iteration}, {rate * 1e3:.1f} ms/iter")
 
 
 class EvaluativeListener(TrainingListener):

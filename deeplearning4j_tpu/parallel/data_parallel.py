@@ -254,6 +254,11 @@ class ParallelWrapper(SeqCtxJitCache):
             skip = 0
 
         net._loss_tracker.sync_every = int(sync_every)
+        from deeplearning4j_tpu.observe import get_registry
+
+        reg = get_registry()
+        reg.gauge("train_replicas").set(self.mesh.devices.size)
+        reg.gauge("train_steps_per_dispatch").set(steps_per_dispatch)
         execu = TrainingExecutor(
             net, step=self._step, fused_step=self._fused_step,
             can_fuse=self._can_fuse, steps_per_dispatch=steps_per_dispatch,

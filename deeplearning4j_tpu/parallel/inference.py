@@ -51,6 +51,11 @@ class ParallelInference(SeqCtxJitCache):
         # the collector's exit drain can't double-count.
         self._pending = 0
         self._pending_cv = threading.Condition()
+        from deeplearning4j_tpu.observe import get_registry
+
+        reg = get_registry()
+        self._m_dispatches = reg.counter("inference_dispatches_total")
+        self._m_rows = reg.histogram("inference_batch_rows")
         self._worker: Optional[threading.Thread] = None
         if mode == InferenceMode.BATCHED:
             self._worker = threading.Thread(target=self._collector, daemon=True)
@@ -167,6 +172,9 @@ class ParallelInference(SeqCtxJitCache):
             # the largest bucket and reassemble in order.
             return np.concatenate(
                 [self._run(x[i:i + cap]) for i in range(0, n, cap)], axis=0)
+        # one device dispatch (chunked oversize requests count per chunk)
+        self._m_dispatches.inc()
+        self._m_rows.observe(n)
         b = self._bucket(n)
         # data-axis divisibility for sharding
         d = self.mesh.shape[AXIS_DATA]

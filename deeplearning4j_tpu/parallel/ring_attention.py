@@ -75,7 +75,15 @@ class SeqCtxJitCache:
     @property
     def _jit_cache(self):
         caches = self.__dict__.setdefault("_jit_caches", {})
-        return caches.setdefault(current_sequence_mesh(), {})
+        cache = caches.get(current_sequence_mesh())
+        if cache is None:
+            # every compiled-program cache in the framework flows through
+            # this property, so a counting dict here gives the
+            # RecompileWatchdog full coverage of (re)compiles
+            from deeplearning4j_tpu.observe.watchdog import WatchedJitCache
+            cache = caches[current_sequence_mesh()] = \
+                WatchedJitCache(owner=self)
+        return cache
 
 
 class SeqCtxSolverCache:

@@ -17,10 +17,12 @@ Status contract (clients must be able to tell their bug from ours):
 
 from __future__ import annotations
 
+import inspect
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
+from urllib.parse import parse_qs
 
 
 class HttpError(Exception):
@@ -32,11 +34,36 @@ class HttpError(Exception):
         self.payload = {"error": message, **extra}
 
 
+class TextResponse:
+    """Return from a GET handler to send a non-JSON body with an explicit
+    Content-Type (the Prometheus /metrics rendering uses
+    `text/plain; version=0.0.4`)."""
+
+    def __init__(self, body: str,
+                 content_type: str = "text/plain; charset=utf-8",
+                 status: int = 200):
+        self.body = body
+        self.content_type = content_type
+        self.status = int(status)
+
+
+def _wants_request(fn: Callable) -> bool:
+    """True when a GET handler declares a parameter — it then receives
+    {"query": ..., "headers": ...} for content negotiation; zero-arg
+    handlers keep the original contract."""
+    try:
+        return bool(inspect.signature(fn).parameters)
+    except (TypeError, ValueError):
+        return False
+
+
 class JsonHttpServer:
     """Subclass and override get_routes()/post_routes().
 
-    GET handlers: () -> payload dict. POST handlers: (request dict) ->
-    payload dict. Errors map per the module-level status contract."""
+    GET handlers: () -> payload dict, or (request) -> payload dict |
+    TextResponse when they declare a parameter (request carries parsed
+    query params + headers). POST handlers: (request dict) -> payload
+    dict. Errors map per the module-level status contract."""
 
     def __init__(self, *, port: int = 0, host: str = "127.0.0.1"):
         self.port = port
@@ -52,6 +79,7 @@ class JsonHttpServer:
 
     def start(self) -> int:
         gets = self.get_routes()
+        get_arity = {path: _wants_request(fn) for path, fn in gets.items()}
         posts = self.post_routes()
 
         class Handler(BaseHTTPRequestHandler):
@@ -66,12 +94,29 @@ class JsonHttpServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _text(self, resp: TextResponse):
+                body = resp.body.encode()
+                self.send_response(resp.status)
+                self.send_header("Content-Type", resp.content_type)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
-                fn = gets.get(self.path)
+                path, _, query = self.path.partition("?")
+                fn = gets.get(path)
                 if fn is None:
                     return self._json(404, {"error": "not found"})
                 try:
-                    self._json(200, fn())
+                    if get_arity[path]:
+                        out = fn({"query": parse_qs(query),
+                                  "headers": self.headers})
+                    else:
+                        out = fn()
+                    if isinstance(out, TextResponse):
+                        self._text(out)
+                    else:
+                        self._json(200, out)
                 except HttpError as e:
                     self._json(e.status, e.payload)
                 except Exception as e:

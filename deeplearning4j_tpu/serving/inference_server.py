@@ -14,7 +14,14 @@ backpressure semantics, and an observability surface.
   GET  /models   → per-model {version, served, inflight, deployments}
   GET  /metrics  → ServingStats snapshot (queue depth, batch-occupancy
                  histogram, p50/p95/p99 latency, shed count, per-model
-                 totals)
+                 totals). Content-negotiated: JSON by default;
+                 Prometheus text exposition (Content-Type
+                 `text/plain; version=0.0.4`) when the scraper sends
+                 `Accept: text/plain` / openmetrics or
+                 `?format=prometheus` — one renderer over the shared
+                 `observe.MetricsRegistry`, so passing
+                 `metrics=observe.get_registry()` publishes training
+                 metrics through the same scrape endpoint
   GET  /healthz  → {"status": "ok" | "degraded"} — degraded once the
                  admission queue passes `degraded_fraction` of capacity
 
@@ -35,8 +42,11 @@ from typing import Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.observe.registry import PROMETHEUS_CONTENT_TYPE
 from deeplearning4j_tpu.parallel.inference import InferenceMode
-from deeplearning4j_tpu.serving.http_base import HttpError, JsonHttpServer
+from deeplearning4j_tpu.serving.http_base import (
+    HttpError, JsonHttpServer, TextResponse,
+)
 from deeplearning4j_tpu.serving.metrics import ServingStats
 from deeplearning4j_tpu.serving.registry import ModelRegistry
 from deeplearning4j_tpu.serving.scheduler import (
@@ -62,13 +72,16 @@ class InferenceServer(JsonHttpServer):
                  default_deadline_ms: Optional[float] = None,
                  batch_buckets=None, collect_wait_ms: float = 5.0,
                  slots: int = 1, degraded_fraction: float = 0.8,
-                 mesh=None):
+                 mesh=None, metrics=None):
         super().__init__(port=port)
         if scheduler not in ("continuous", "collect"):
             raise ValueError("scheduler must be 'continuous' or 'collect'")
         self.mode = ("continuous" if batched and scheduler == "continuous"
                      else "collect" if batched else "direct")
-        self.stats = ServingStats()
+        # `metrics`: a shared observe.MetricsRegistry (e.g.
+        # observe.get_registry()) so /metrics publishes the whole
+        # process's telemetry; default is a private registry per server.
+        self.stats = ServingStats(registry=metrics)
         self.degraded_fraction = degraded_fraction
         if registry is None:
             registry = ModelRegistry(
@@ -158,10 +171,25 @@ class InferenceServer(JsonHttpServer):
                 "queue_capacity": cap,
                 "models": self.registry.names()}
 
-    def _metrics(self):
+    def _metrics(self, request=None):
         depth = self.scheduler.queue_depth() if self.scheduler else 0
         cap = self.scheduler.capacity if self.scheduler else None
+        if request is not None and self._wants_prometheus(request):
+            self.stats.set_queue_gauges(depth, cap)
+            return TextResponse(self.stats.registry.to_prometheus(),
+                                content_type=PROMETHEUS_CONTENT_TYPE)
         return self.stats.snapshot(queue_depth=depth, queue_capacity=cap)
+
+    @staticmethod
+    def _wants_prometheus(request) -> bool:
+        """Prometheus scrapers advertise text/plain (or openmetrics) in
+        Accept; plain JSON consumers (and the pre-existing tests) send no
+        Accept preference and keep the JSON snapshot."""
+        fmt = request.get("query", {}).get("format", [])
+        if fmt:
+            return fmt[0].lower() in ("prometheus", "text")
+        accept = (request.get("headers") or {}).get("Accept", "") or ""
+        return "text/plain" in accept or "openmetrics" in accept
 
     def get_routes(self):
         return {"/healthz": self._healthz, "/metrics": self._metrics,

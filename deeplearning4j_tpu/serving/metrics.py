@@ -1,83 +1,99 @@
-"""ServingStats — lock-cheap observability aggregator for the serving
-control plane.
+"""ServingStats — the serving-side renderer over the shared
+`observe.MetricsRegistry`.
 
-Backs the server's `/metrics` endpoint. All hot-path hooks (`admitted`,
-`completed`, `batch_dispatched`, `shed`, `expired`) take one short
-`threading.Lock` acquisition around a handful of counter bumps and a
-bounded-deque append — no allocation proportional to traffic, no
-percentile math on the request path. Percentiles and the occupancy
-histogram are computed on demand in `snapshot()` (the /metrics reader
-pays, not the request).
+Formerly a private aggregator with its own locks and deques; now every
+count lives in a `MetricsRegistry` (by default a private one per server
+for isolation, or pass the process-wide `observe.get_registry()` so the
+serving `/metrics` endpoint and the training listeners share ONE
+telemetry spine — the unified-observability contract). `snapshot()`
+keeps the exact JSON schema the control-plane tests pin; the Prometheus
+rendering of the same registry is served by the HTTP endpoint when the
+scraper asks for `text/plain` (exposition format 0.0.4).
 
-Reference precedent: the reference's `PerformanceListener` /
-`BenchmarkDataSetIterator` measurement seams, lifted from the training
-loop onto the serving path.
+Hot-path pricing is unchanged: each hook is a couple of short
+lock-guarded bumps on cached instrument handles — no allocation
+proportional to traffic, no percentile math on the request path
+(readers pay in `snapshot()`, as before).
 """
 
 from __future__ import annotations
 
 import threading
 import time
-from collections import deque
 from typing import Dict, Optional
+
+from deeplearning4j_tpu.observe.registry import MetricsRegistry
 
 # occupancy histogram bucket upper bounds (fraction of max_batch filled)
 OCCUPANCY_EDGES = (0.125, 0.25, 0.5, 0.75, 1.0)
+_OCC_LABELS = ("<=12.5%", "<=25%", "<=50%", "<=75%", "<=100%", ">100%")
+
+_OUTCOMES = ("admitted", "completed", "failed", "shed", "expired")
 
 
-class _ModelStats:
-    __slots__ = ("admitted", "completed", "failed", "shed", "expired",
-                 "latencies")
+class _ModelSeries:
+    """Cached instrument handles for one model's series."""
 
-    def __init__(self, window: int):
-        self.admitted = 0
-        self.completed = 0
-        self.failed = 0
-        self.shed = 0
-        self.expired = 0
-        self.latencies: deque = deque(maxlen=window)
+    __slots__ = ("outcomes", "latency")
+
+    def __init__(self, registry: MetricsRegistry, model: str, window: int):
+        self.outcomes = {
+            k: registry.counter("serving_requests_total",
+                                model=model, outcome=k)
+            for k in _OUTCOMES}
+        self.latency = registry.histogram(
+            "serving_latency_seconds", reservoir=window, model=model)
 
 
 class ServingStats:
     """Per-model request counters + rolling latency window + global
-    batch-occupancy histogram."""
+    batch-occupancy histogram, recorded into a MetricsRegistry."""
 
-    def __init__(self, *, latency_window: int = 4096):
-        self._lock = threading.Lock()
+    def __init__(self, *, latency_window: int = 4096,
+                 registry: Optional[MetricsRegistry] = None):
+        self.registry = registry if registry is not None else \
+            MetricsRegistry(reservoir=latency_window)
         self._window = latency_window
-        self._models: Dict[str, _ModelStats] = {}
-        self._occupancy = [0] * (len(OCCUPANCY_EDGES) + 1)
-        self._batches = 0
-        self._batch_rows = 0
+        self._lock = threading.Lock()
+        self._models: Dict[str, _ModelSeries] = {}
+        self._occupancy = [
+            self.registry.counter("serving_batch_occupancy_total",
+                                  bucket=lab) for lab in _OCC_LABELS]
+        self._dispatches = self.registry.counter(
+            "serving_batch_dispatches_total")
+        self._rows = self.registry.counter("serving_batch_rows_total")
+        self._q_depth = self.registry.gauge("serving_queue_depth")
+        self._q_cap = self.registry.gauge("serving_queue_capacity")
         self._started = time.time()
+        self.registry.gauge("serving_start_time_seconds").set(self._started)
 
-    def _m(self, model: str) -> _ModelStats:
+    def _m(self, model: str) -> _ModelSeries:
         s = self._models.get(model)
         if s is None:
-            s = self._models[model] = _ModelStats(self._window)
+            with self._lock:
+                s = self._models.get(model)
+                if s is None:
+                    s = self._models[model] = _ModelSeries(
+                        self.registry, model, self._window)
         return s
 
     # ------------------------------------------------------- hot hooks
     def admitted(self, model: str):
-        with self._lock:
-            self._m(model).admitted += 1
+        self._m(model).outcomes["admitted"].inc()
 
     def shed(self, model: str):
-        with self._lock:
-            self._m(model).shed += 1
+        self._m(model).outcomes["shed"].inc()
 
     def expired(self, model: str):
-        with self._lock:
-            self._m(model).expired += 1
+        self._m(model).outcomes["expired"].inc()
 
     def completed(self, model: str, latency_s: float, ok: bool = True):
-        with self._lock:
-            s = self._m(model)
-            if ok:
-                s.completed += 1
-                s.latencies.append(latency_s)
-            else:
-                s.failed += 1
+        s = self._m(model)
+        if ok:
+            s.outcomes["completed"].inc()
+            s.latency.observe(latency_s)
+        else:
+            s.outcomes["failed"].inc()
 
     def batch_dispatched(self, rows: int, capacity: int):
         """One device dispatch of `rows` rows against a `capacity`-row
@@ -86,10 +102,19 @@ class ServingStats:
         i = 0
         while i < len(OCCUPANCY_EDGES) and frac > OCCUPANCY_EDGES[i]:
             i += 1
-        with self._lock:
-            self._occupancy[i] += 1
-            self._batches += 1
-            self._batch_rows += rows
+        self._occupancy[i].inc()
+        self._dispatches.inc()
+        self._rows.inc(rows)
+
+    def set_queue_gauges(self, depth: Optional[int],
+                         capacity: Optional[int]) -> None:
+        """Push the scheduler-owned queue gauges into the registry so the
+        Prometheus rendering carries them (the JSON snapshot takes them
+        as arguments, as before)."""
+        if depth is not None:
+            self._q_depth.set(depth)
+        if capacity is not None:
+            self._q_cap.set(capacity)
 
     # ------------------------------------------------------- reporting
     @staticmethod
@@ -106,42 +131,43 @@ class ServingStats:
 
     def snapshot(self, *, queue_depth: Optional[int] = None,
                  queue_capacity: Optional[int] = None) -> dict:
-        """The /metrics payload. Queue gauges are passed in by the owner
-        (the scheduler holds them; this aggregator only holds counters)."""
+        """The JSON /metrics payload. Queue gauges are passed in by the
+        owner (the scheduler holds them; this renderer only holds
+        counters)."""
         with self._lock:
-            models = {
-                name: {
-                    "admitted": s.admitted,
-                    "completed": s.completed,
-                    "failed": s.failed,
-                    "shed": s.shed,
-                    "expired": s.expired,
-                    "latency": dict(window=len(s.latencies),
-                                    **self._percentiles(sorted(s.latencies))),
-                } for name, s in self._models.items()}
-            occupancy = list(self._occupancy)
-            batches, rows = self._batches, self._batch_rows
-            all_lat = sorted(
-                v for s in self._models.values() for v in s.latencies)
-        labels = ["<=12.5%", "<=25%", "<=50%", "<=75%", "<=100%", ">100%"]
+            model_series = dict(self._models)
+        models = {}
+        all_lat = []
+        for name, s in model_series.items():
+            lat = s.latency.values()
+            all_lat.extend(lat)
+            models[name] = {
+                **{k: int(c.value) for k, c in s.outcomes.items()},
+                "latency": dict(window=len(lat),
+                                **self._percentiles(sorted(lat))),
+            }
+        all_lat.sort()
+        dispatches = int(self._dispatches.value)
+        rows = int(self._rows.value)
         out = {
             "uptime_s": round(time.time() - self._started, 1),
             "requests": {
-                k: sum(m[k] for m in models.values())
-                for k in ("admitted", "completed", "failed", "shed",
-                          "expired")},
+                k: sum(m[k] for m in models.values()) for k in _OUTCOMES},
             "latency": dict(window=len(all_lat),
                             **self._percentiles(all_lat)),
             "batch": {
-                "dispatches": batches,
+                "dispatches": dispatches,
                 "rows": rows,
-                "mean_occupancy_rows": round(rows / batches, 3)
-                if batches else None,
-                "occupancy_histogram": dict(zip(labels, occupancy)),
+                "mean_occupancy_rows": round(rows / dispatches, 3)
+                if dispatches else None,
+                "occupancy_histogram": {
+                    lab: int(c.value)
+                    for lab, c in zip(_OCC_LABELS, self._occupancy)},
             },
             "per_model": models,
         }
         if queue_depth is not None:
             out["queue"] = {"depth": queue_depth,
                             "capacity": queue_capacity}
+            self.set_queue_gauges(queue_depth, queue_capacity)
         return out

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import contextlib
 import os
+import time
 from typing import Any, Optional
 
 import jax
@@ -105,15 +106,25 @@ class ProfilerListener:
             jax.profiler.start_trace(self.log_dir)
             self._active = True
             self._started_at = iteration
+            self._t0 = time.time()
             return
         if self._active and \
                 iteration >= self._started_at + self.num_iterations:
-            jax.profiler.stop_trace()
-            self._active = False
-            self.captured = True
+            self._close_trace(iteration)
 
     def on_fit_end(self, model):
         if self._active:   # fit ended mid-capture: close the trace cleanly
-            jax.profiler.stop_trace()
-            self._active = False
-            self.captured = True
+            self._close_trace(getattr(model, "iteration", None))
+
+    def _close_trace(self, end_iteration):
+        jax.profiler.stop_trace()
+        self._active = False
+        self.captured = True
+        # Mirror the capture window into the span log so the JSONL
+        # timeline can be correlated with the TensorBoard/Perfetto trace.
+        from deeplearning4j_tpu.observe import emit_manual_span
+
+        emit_manual_span("jax.profiler.trace", self._t0, time.time(),
+                         log_dir=self.log_dir,
+                         start_iteration=self._started_at,
+                         end_iteration=end_iteration)

@@ -1,0 +1,15 @@
+#!/usr/bin/env python3
+"""Thin launcher for `python -m deeplearning4j_tpu.observe.dump` —
+pretty-print a MetricsRegistry snapshot (or a BENCH blob embedding one)
+or tail a span JSONL, from the tools/ directory like the other
+debugging utilities here."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from deeplearning4j_tpu.observe.dump import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())
