@@ -1,0 +1,36 @@
+"""graft-lint — tracer-safety & recompile-hazard static analysis.
+
+The static counterpart of the runtime observability core: the
+RecompileWatchdog and HostSyncMonitor (observe/) catch jit-cache churn
+and host syncs *after* they ship; this package catches the patterns
+that cause them at review time, over plain ASTs. The analyzer modules
+are stdlib-only — linting never traces, compiles, or touches a device.
+
+    python -m deeplearning4j_tpu.analysis deeplearning4j_tpu tests \
+        --strict --baseline .graftlint-baseline.json
+
+Public API:
+
+    lint_paths(paths) / lint_file(path) / lint_source(src) -> [Finding]
+    RULES                         — rule registry (id -> Rule)
+    RUNTIME_RULE_HINTS            — runtime-event kind -> static rules
+                                    (the watchdog/monitor cross-check)
+    load_baseline/apply_baseline/write_baseline
+"""
+
+from deeplearning4j_tpu.analysis.baseline import (   # noqa: F401
+    apply_baseline, load_baseline, write_baseline,
+)
+from deeplearning4j_tpu.analysis.engine import (     # noqa: F401
+    DEFAULT_HOT_PREFIXES, Finding, is_hot, lint_file, lint_paths,
+    lint_source,
+)
+from deeplearning4j_tpu.analysis.rules import (      # noqa: F401
+    RULES, RUNTIME_RULE_HINTS, Rule, runtime_hint,
+)
+
+__all__ = [
+    "DEFAULT_HOT_PREFIXES", "Finding", "RULES", "RUNTIME_RULE_HINTS",
+    "Rule", "apply_baseline", "is_hot", "lint_file", "lint_paths",
+    "lint_source", "load_baseline", "runtime_hint", "write_baseline",
+]

@@ -1,0 +1,108 @@
+"""CLI for graft-lint.
+
+    python -m deeplearning4j_tpu.analysis [paths...]
+        [--format text|json|sarif] [--strict]
+        [--baseline FILE] [--write-baseline FILE]
+        [--select GL2,GL301] [--ignore GL4] [--list-rules]
+        [--hot-prefix PREFIX ...]
+
+Exit codes: 0 clean (after baseline/suppressions); 1 findings
+(errors only by default, any finding under --strict); 2 usage error.
+`tools/ci_check.sh` runs `--strict --baseline .graftlint-baseline.json`
+as the repo's lint-clean gate.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List, Optional
+
+from deeplearning4j_tpu.analysis.baseline import (
+    apply_baseline, load_baseline, write_baseline,
+)
+from deeplearning4j_tpu.analysis.engine import (
+    DEFAULT_HOT_PREFIXES, iter_python_files, lint_paths,
+)
+from deeplearning4j_tpu.analysis.report import RENDERERS
+from deeplearning4j_tpu.analysis.rules import ERROR, RULES
+
+
+def _split_rules(csv: Optional[str]) -> Optional[List[str]]:
+    if not csv:
+        return None
+    return [s.strip() for s in csv.split(",") if s.strip()]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m deeplearning4j_tpu.analysis",
+        description="graft-lint: tracer-safety & recompile-hazard "
+                    "static analysis")
+    ap.add_argument("paths", nargs="*", default=["deeplearning4j_tpu"],
+                    help="files or directories (default: "
+                         "deeplearning4j_tpu)")
+    ap.add_argument("--format", choices=sorted(RENDERERS),
+                    default="text")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 on ANY un-baselined finding "
+                         "(default: errors only)")
+    ap.add_argument("--baseline", metavar="FILE",
+                    help="subtract findings recorded in FILE")
+    ap.add_argument("--write-baseline", metavar="FILE",
+                    help="write the current findings to FILE and exit 0")
+    ap.add_argument("--select", metavar="RULES",
+                    help="comma-separated rule-id prefixes to keep "
+                         "(e.g. GL2,GL301)")
+    ap.add_argument("--ignore", metavar="RULES",
+                    help="comma-separated rule-id prefixes to drop")
+    ap.add_argument("--hot-prefix", action="append", default=None,
+                    metavar="PREFIX",
+                    help="override the hot-module path prefixes "
+                         "(repeatable)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rid in sorted(RULES):
+            r = RULES[rid]
+            print(f"{r.id} [{r.category}/{r.severity}] {r.name}: "
+                  f"{r.summary}")
+        return 0
+
+    hot = tuple(args.hot_prefix) if args.hot_prefix \
+        else DEFAULT_HOT_PREFIXES
+    paths = args.paths or ["deeplearning4j_tpu"]
+    files = iter_python_files(paths)
+    findings = lint_paths(paths, hot_prefixes=hot,
+                          select=_split_rules(args.select),
+                          ignore=_split_rules(args.ignore))
+
+    if args.write_baseline:
+        doc = write_baseline(findings, args.write_baseline)
+        print(f"graft-lint: wrote {len(doc['findings'])} baseline "
+              f"entr{'y' if len(doc['findings']) == 1 else 'ies'} "
+              f"({len(findings)} finding(s)) to {args.write_baseline}")
+        return 0
+
+    baselined = 0
+    if args.baseline:
+        try:
+            baseline = load_baseline(args.baseline)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"graft-lint: cannot load baseline "
+                  f"{args.baseline}: {e}", file=sys.stderr)
+            return 2
+        findings, baselined = apply_baseline(findings, baseline)
+
+    out = RENDERERS[args.format](findings, files=len(files),
+                                 baselined=baselined)
+    sys.stdout.write(out)
+
+    if args.strict:
+        return 1 if findings else 0
+    return 1 if any(f.severity == ERROR for f in findings) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

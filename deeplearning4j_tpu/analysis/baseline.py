@@ -1,0 +1,66 @@
+"""Baseline file support — checked-in intentional findings.
+
+A baseline entry is the finding's line-number-insensitive identity
+`(rule, path, snippet)` plus a count, so the baseline survives
+unrelated edits (a finding only 'moves' in the baseline when the
+offending line's *text* changes — at which point a human should
+re-triage it anyway). `--baseline FILE` subtracts baselined findings
+from the report; `--write-baseline FILE` regenerates the file from the
+current tree, sorted, for a reviewable diff.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from typing import Dict, List, Tuple
+
+from deeplearning4j_tpu.analysis.engine import Finding
+
+BASELINE_VERSION = 1
+
+
+def write_baseline(findings: List[Finding], path: str) -> dict:
+    counts: Counter = Counter(f.key() for f in findings)
+    entries = [
+        {"rule": rule, "path": fpath, "snippet": snippet, "count": n}
+        for (rule, fpath, snippet), n in sorted(counts.items())
+    ]
+    doc = {"version": BASELINE_VERSION, "tool": "graft-lint",
+           "findings": entries}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return doc
+
+
+def load_baseline(path: str) -> Dict[Tuple[str, str, str], int]:
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    if doc.get("version") != BASELINE_VERSION:
+        raise ValueError(
+            f"unsupported baseline version {doc.get('version')!r} "
+            f"in {path} (expected {BASELINE_VERSION})")
+    out: Dict[Tuple[str, str, str], int] = {}
+    for e in doc.get("findings", ()):
+        key = (e["rule"], e["path"], e.get("snippet", ""))
+        out[key] = out.get(key, 0) + int(e.get("count", 1))
+    return out
+
+
+def apply_baseline(findings: List[Finding],
+                   baseline: Dict[Tuple[str, str, str], int],
+                   ) -> Tuple[List[Finding], int]:
+    """Returns (new findings, number suppressed by the baseline). When a
+    key occurs more often than its baselined count, the excess is new."""
+    budget = dict(baseline)
+    new: List[Finding] = []
+    used = 0
+    for f in findings:
+        k = f.key()
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+            used += 1
+        else:
+            new.append(f)
+    return new, used

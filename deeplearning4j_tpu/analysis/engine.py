@@ -1,0 +1,1012 @@
+"""graft-lint engine — AST analysis for tracer-safety, recompile
+hazards, sync hygiene, and lock discipline.
+
+This is a *linter*, not a type system: it runs a small intraprocedural
+dataflow over each file and flags the patterns that have actually
+bitten this codebase (the runtime RecompileWatchdog / HostSyncMonitor
+catch the same failures after the fact; this pass catches them in
+review). Three pieces of state drive every rule:
+
+- **traced context** — a function is "traced" when jit/pmap/vmap/grad/
+  checkpoint wraps it (decorator or call form) or it is passed as a
+  body/cond to lax.scan / while_loop / fori_loop / cond / switch /
+  map, or it is nested inside a traced function. Inside a traced
+  function every parameter is a tracer; locals derived from tracers
+  are tracked by a forward pass (`.shape`/`.ndim`/`.dtype`/`.size` and
+  `len()` are static under trace and break the chain).
+- **devicey values (host context)** — names assigned from calls rooted
+  at a jax/jnp/lax import alias, from `*_jitted`-style callables, or
+  arithmetic/indexing over such names. Host-side sync rules (GL2xx)
+  only fire on devicey expressions, which keeps `int(os.environ[...])`
+  and `np.asarray(request_json)` quiet.
+- **lock ownership** — a class whose `__init__` creates a
+  `threading.Lock/RLock/Condition` attribute (or any `*_lock`/`*_cv`
+  attribute) declares its instance state lock-guarded; mutations of
+  `self.*` outside a `with <lock>:` block are flagged (GL301).
+
+Everything here is stdlib-only (ast + re), importable without jax —
+same constraint as `observe/registry.py`, for the same reason: CI and
+tooling must be able to run it anywhere.
+
+Suppressions (same-line, or a comment line directly above):
+
+    # graft: allow-sync(reason)      — suppresses sync-category rules
+    # graft: allow(GL301): reason    — suppresses one rule id
+
+A reason is mandatory; an empty reason leaves the finding live.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from deeplearning4j_tpu.analysis.rules import (
+    CAT_SYNC, RULES, Rule,
+)
+
+#: Module prefixes declared hot (PERF_NOTES: ≤1 host sync per epoch /
+#: no syncs on the serving dispatch path). Sync-hygiene rules (GL2xx)
+#: only fire under these.
+DEFAULT_HOT_PREFIXES: Tuple[str, ...] = (
+    "deeplearning4j_tpu/optim",
+    "deeplearning4j_tpu/serving",
+    "deeplearning4j_tpu/parallel",
+    "deeplearning4j_tpu/observe",
+)
+
+# wrapper terminal name -> positional slots holding traceable functions
+_TRACE_WRAPPERS: Dict[str, Tuple[int, ...]] = {
+    "jit": (0,), "pjit": (0,), "pmap": (0,), "vmap": (0,),
+    "grad": (0,), "value_and_grad": (0,), "checkpoint": (0,),
+    "remat": (0,), "custom_jvp": (0,), "custom_vjp": (0,),
+    "scan": (0,), "map": (0,),
+    "while_loop": (0, 1), "fori_loop": (2,),
+    "cond": (1, 2, 3), "switch": (1, 2, 3, 4, 5),
+}
+
+# the wrappers that own a *compile cache* keyed on function identity
+_JIT_FAMILY = ("jit", "pjit", "pmap")
+
+_STATIC_ATTRS = ("shape", "ndim", "dtype", "size", "aval", "sharding")
+_MATERIALIZE_METHODS = ("item", "tolist")
+_MUTATOR_METHODS = (
+    "append", "appendleft", "extend", "insert", "add", "discard",
+    "remove", "pop", "popleft", "popitem", "clear", "update",
+    "setdefault", "sort", "reverse",
+)
+_LOG_METHODS = ("debug", "info", "warning", "warn", "error", "exception",
+                "critical", "log")
+_LOCK_CLASSES = ("Lock", "RLock", "Condition", "Semaphore",
+                 "BoundedSemaphore")
+
+# word-ish boundaries: `_lock`/`lock`/`rlock`/`_cv`/`cond`/`mutex` are
+# lock-ish; `block`/`blocks`/`max_seconds` are not.
+_LOCKISH_RE = re.compile(
+    r"(^|_)r?lock|mutex|(^|_)cv($|_)|(^|_)cond(ition)?($|_)",
+    re.IGNORECASE)
+_JITNAME_RE = re.compile(r"(^|_)jit(ted)?($|_)")
+
+# jax-rooted calls whose result is a host int/bool/list, not a device
+# array — `if jax.process_count() > 1:` is not a sync.
+_HOST_RESULT_FUNCS = frozenset({
+    "process_count", "process_index", "device_count",
+    "local_device_count", "devices", "local_devices",
+    "default_backend", "issubdtype", "result_type", "can_cast",
+    "tree_structure", "tree_all",
+})
+# jax-rooted calls that return their inputs' leaves: device-valued iff
+# an argument is (tree_map over host numpy stays host).
+_TRANSPARENT_FUNCS = frozenset({
+    "tree_map", "tree_leaves", "tree_flatten", "tree_unflatten",
+    "tree_reduce", "tree_transpose",
+})
+
+_ALLOW_SYNC_RE = re.compile(
+    r"#\s*graft:\s*allow-sync\(\s*([^)]*?)\s*\)")
+_ALLOW_RULE_RE = re.compile(
+    r"#\s*graft:\s*allow\(\s*(GL\d{3})\s*(?:[,:)]\s*([^)]*?))?\s*\)"
+    r"(?::\s*(\S.*))?")
+_COMMENT_LINE_RE = re.compile(r"^\s*#")
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    snippet: str = ""
+
+    @property
+    def meta(self) -> Rule:
+        return RULES[self.rule]
+
+    @property
+    def severity(self) -> str:
+        return self.meta.severity
+
+    def key(self) -> Tuple[str, str, str]:
+        """Line-number-insensitive identity used by the baseline: the
+        finding survives unrelated edits above it."""
+        return (self.rule, self.path.replace(os.sep, "/"), self.snippet)
+
+    def to_dict(self) -> dict:
+        return {"rule": self.rule, "name": self.meta.name,
+                "category": self.meta.category,
+                "severity": self.severity, "path": self.path,
+                "line": self.line, "col": self.col,
+                "message": self.message, "snippet": self.snippet}
+
+
+def is_hot(path: str,
+           hot_prefixes: Sequence[str] = DEFAULT_HOT_PREFIXES) -> bool:
+    norm = path.replace(os.sep, "/")
+    return any(p in norm for p in hot_prefixes)
+
+
+# --------------------------------------------------------------- helpers
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    """'jax.lax.scan' for an Attribute/Name chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _terminal(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _root_name(node: ast.AST) -> Optional[str]:
+    while isinstance(node, (ast.Attribute, ast.Subscript)):
+        node = node.value
+    return node.id if isinstance(node, ast.Name) else None
+
+
+def _is_mutable_literal(node: ast.AST) -> bool:
+    if isinstance(node, (ast.List, ast.Dict, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name):
+        return node.func.id in ("list", "dict", "set")
+    return False
+
+
+class _Imports:
+    """Per-module import aliases: which local names are jax-ish module
+    roots, numpy roots, or bare from-jax function imports."""
+
+    def __init__(self, tree: ast.Module):
+        self.jax_roots: Set[str] = set()
+        self.np_roots: Set[str] = set()
+        self.from_jax: Set[str] = set()     # `from jax import jit` etc.
+        self.partial_names: Set[str] = {"partial"}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    name = a.asname or a.name.split(".")[0]
+                    if a.name == "jax" or a.name.startswith("jax."):
+                        self.jax_roots.add(a.asname if a.asname
+                                           else "jax")
+                    elif a.name == "numpy" or a.name.startswith("numpy."):
+                        self.np_roots.add(name)
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                for a in node.names:
+                    name = a.asname or a.name
+                    if mod == "jax" and a.name == "numpy":
+                        self.jax_roots.add(name)
+                    elif mod.startswith("jax"):
+                        if a.name in ("lax", "numpy"):
+                            self.jax_roots.add(name)
+                        else:
+                            self.from_jax.add(name)
+                    elif mod == "functools" and a.name == "partial":
+                        self.partial_names.add(name)
+                    elif mod == "numpy":
+                        self.np_roots.add(name)
+
+    # ------------------------------------------------------ provenance
+    def is_jax_call_root(self, func: ast.AST) -> bool:
+        """func resolves through a jax module alias (jnp.*, lax.*,
+        jax.*.*) — device-producing unless the terminal says otherwise."""
+        if isinstance(func, ast.Attribute):
+            return _root_name(func) in self.jax_roots
+        return False
+
+    def wrapper_slots(self, func: ast.AST) -> Optional[Tuple[int, ...]]:
+        """If `func` is a jax tracing wrapper, its function-arg slots."""
+        term = _terminal(func)
+        if term not in _TRACE_WRAPPERS:
+            return None
+        if isinstance(func, ast.Name) and term not in self.from_jax:
+            return None
+        if isinstance(func, ast.Attribute) \
+                and _root_name(func) not in self.jax_roots:
+            return None
+        return _TRACE_WRAPPERS[term]
+
+    def is_jit_family(self, func: ast.AST) -> bool:
+        term = _terminal(func)
+        if term not in _JIT_FAMILY:
+            return False
+        if isinstance(func, ast.Name):
+            return term in self.from_jax
+        return _root_name(func) in self.jax_roots
+
+    def is_np_call(self, func: ast.AST, names: Tuple[str, ...]) -> bool:
+        return (isinstance(func, ast.Attribute) and func.attr in names
+                and _root_name(func) in self.np_roots)
+
+
+def _collect_suppressions(lines: List[str]) -> Dict[int, Set[str]]:
+    """line number (1-based) -> {'cat:sync', 'GL301', ...}. A reason is
+    mandatory; `allow-sync()` with no reason does not suppress."""
+    allow: Dict[int, Set[str]] = {}
+    for i, line in enumerate(lines, start=1):
+        toks: Set[str] = set()
+        m = _ALLOW_SYNC_RE.search(line)
+        if m and m.group(1).strip():
+            toks.add("cat:" + CAT_SYNC)
+        m = _ALLOW_RULE_RE.search(line)
+        if m and ((m.group(2) or "").strip() or (m.group(3) or "").strip()):
+            toks.add(m.group(1))
+        if toks:
+            allow[i] = toks
+    return allow
+
+
+# ----------------------------------------------------------------- walker
+
+@dataclass
+class _Ctx:
+    traced: bool = False
+    tracked: Set[str] = field(default_factory=set)   # tracer-derived
+    dev: Set[str] = field(default_factory=set)       # host device values
+    fn_depth: int = 0
+    loop_depth: int = 0
+    lock_attrs: Optional[Set[str]] = None            # enclosing class's
+    self_name: str = "self"
+    lock_depth: int = 0
+    in_init: bool = False
+
+
+class _FileLinter:
+    def __init__(self, path: str, source: str, *, hot: bool):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.hot = hot
+        self.findings: List[Finding] = []
+        self.suppressed: List[Finding] = []
+        self.allow = _collect_suppressions(self.lines)
+
+    # ------------------------------------------------------------ entry
+    def run(self) -> List[Finding]:
+        try:
+            tree = ast.parse(self.source)
+        except SyntaxError as e:
+            self.findings.append(Finding(
+                "GL000", self.path, e.lineno or 1, e.offset or 0,
+                f"syntax error: {e.msg}", ""))
+            return self.findings
+        self.imports = _Imports(tree)
+        self.module_defs: Dict[str, ast.AST] = {}
+        self.traced_names: Set[str] = set()
+        self.traced_lambdas: Set[int] = set()
+        self._index(tree)
+        ctx = _Ctx()
+        for stmt in tree.body:
+            self._stmt(stmt, ctx)
+        self.findings.sort(key=lambda f: (f.line, f.col, f.rule))
+        return self.findings
+
+    def _index(self, tree: ast.Module) -> None:
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.module_defs.setdefault(node.name, node)
+            elif isinstance(node, ast.Call):
+                slots = self.imports.wrapper_slots(node.func)
+                if slots is None:
+                    continue
+                for i in slots:
+                    if i < len(node.args):
+                        arg = node.args[i]
+                        if isinstance(arg, ast.Name):
+                            self.traced_names.add(arg.id)
+                        elif isinstance(arg, ast.Lambda):
+                            self.traced_lambdas.add(id(arg))
+
+    # ------------------------------------------------------------- emit
+    def _emit(self, rule: str, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 1)
+        end = getattr(node, "end_lineno", line) or line
+        snippet = (self.lines[line - 1].strip()
+                   if 0 < line <= len(self.lines) else "")
+        f = Finding(rule, self.path, line, getattr(node, "col_offset", 0),
+                    message, snippet)
+        covered = set(range(line, end + 1))
+        # a suppression may sit anywhere in the contiguous pure-comment
+        # block directly above the flagged line (multi-line reasons)
+        ln = line - 1
+        while ln >= 1 and _COMMENT_LINE_RE.match(self.lines[ln - 1]):
+            covered.add(ln)
+            ln -= 1
+        cat_tok = "cat:" + RULES[rule].category
+        for ln in covered:
+            toks = self.allow.get(ln)
+            if toks and (rule in toks or cat_tok in toks):
+                self.suppressed.append(f)
+                return
+        self.findings.append(f)
+
+    # ------------------------------------------------- taint predicates
+    def _tainted(self, node: ast.AST, ctx: _Ctx) -> bool:
+        """Tracer-derived *value* (static shape/dtype access breaks the
+        chain) — drives the GL0xx rules inside traced functions."""
+        if isinstance(node, ast.Name):
+            return node.id in ctx.tracked
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return self._tainted(node.value, ctx)
+        if isinstance(node, ast.Subscript):
+            return self._tainted(node.value, ctx)
+        if isinstance(node, ast.Call):
+            func = node.func
+            if isinstance(func, ast.Name) and func.id == "len":
+                return False
+            if self.imports.is_jax_call_root(func):
+                return True
+            if isinstance(func, ast.Attribute) \
+                    and self._tainted(func.value, ctx):
+                return True
+            if isinstance(func, ast.Name) and func.id in ctx.tracked:
+                return True
+            return any(self._tainted(a, ctx) for a in node.args) or \
+                any(self._tainted(k.value, ctx) for k in node.keywords)
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False
+            return (self._tainted(node.left, ctx)
+                    or any(self._tainted(c, ctx) for c in node.comparators))
+        if isinstance(node, ast.BoolOp):
+            return any(self._tainted(v, ctx) for v in node.values)
+        if isinstance(node, ast.BinOp):
+            return (self._tainted(node.left, ctx)
+                    or self._tainted(node.right, ctx))
+        if isinstance(node, ast.UnaryOp):
+            return self._tainted(node.operand, ctx)
+        if isinstance(node, ast.IfExp):
+            return (self._tainted(node.body, ctx)
+                    or self._tainted(node.orelse, ctx))
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self._tainted(e, ctx) for e in node.elts)
+        if isinstance(node, ast.Starred):
+            return self._tainted(node.value, ctx)
+        return False
+
+    def _devicey(self, node: ast.AST, ctx: _Ctx) -> bool:
+        """Host-side 'this is (or contains) a live device array' — the
+        precondition for the sync rules. Deliberately conservative:
+        unknown function calls do NOT propagate, so ordinary host math
+        stays quiet."""
+        if isinstance(node, ast.Name):
+            return node.id in ctx.dev
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return self._devicey(node.value, ctx)
+        if isinstance(node, ast.Subscript):
+            return self._devicey(node.value, ctx)
+        if isinstance(node, ast.Call):
+            func = node.func
+            term = _terminal(func)
+            if term == "device_get":
+                return False                      # result lives on host
+            if term in _HOST_RESULT_FUNCS:
+                return False                      # host int/bool queries
+            if term in _TRANSPARENT_FUNCS:
+                # tree_map & friends return whatever their inputs hold
+                return any(self._devicey(a, ctx) for a in node.args) \
+                    or any(self._devicey(k.value, ctx)
+                           for k in node.keywords)
+            if self.imports.is_jax_call_root(func):
+                return True
+            if isinstance(func, ast.Name) and func.id in self.imports.from_jax:
+                return True
+            if term and _JITNAME_RE.search(term):
+                return True                       # self._jitted(...) etc.
+            if isinstance(func, ast.Attribute) \
+                    and func.attr not in _MATERIALIZE_METHODS \
+                    and self._devicey(func.value, ctx):
+                return True                       # x.sum(), x.astype(...)
+            return False
+        if isinstance(node, ast.Compare):
+            if all(isinstance(op, (ast.Is, ast.IsNot)) for op in node.ops):
+                return False
+            return (self._devicey(node.left, ctx)
+                    or any(self._devicey(c, ctx) for c in node.comparators))
+        if isinstance(node, ast.BoolOp):
+            return any(self._devicey(v, ctx) for v in node.values)
+        if isinstance(node, ast.BinOp):
+            return (self._devicey(node.left, ctx)
+                    or self._devicey(node.right, ctx))
+        if isinstance(node, ast.UnaryOp):
+            return self._devicey(node.operand, ctx)
+        if isinstance(node, ast.IfExp):
+            return (self._devicey(node.body, ctx)
+                    or self._devicey(node.orelse, ctx))
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self._devicey(e, ctx) for e in node.elts)
+        if isinstance(node, ast.Starred):
+            return self._devicey(node.value, ctx)
+        return False
+
+    def _dynamic_iter(self, it: ast.AST, ctx: _Ctx) -> bool:
+        """GL005 wants positive evidence of *array* iteration: a bare
+        tainted name is routinely a pytree dict (iterating its keys is
+        host-side and legal), so only range()/enumerate()/zip() of a
+        tracer and arithmetic/indexing-derived tracers count."""
+        if isinstance(it, ast.Call) and isinstance(it.func, ast.Name) \
+                and it.func.id in ("range", "enumerate", "zip"):
+            return any(self._tainted(a, ctx) for a in it.args)
+        if isinstance(it, (ast.BinOp, ast.UnaryOp, ast.Subscript)):
+            return self._tainted(it, ctx)
+        return False
+
+    def _update_bindings(self, targets: List[ast.AST], value_is: bool,
+                         ctx: _Ctx) -> None:
+        """Bind plain-name targets (incl. tuple unpacks) to the tracked/
+        devicey set. Attribute/subscript targets are NOT bound — taint
+        does not flow through `self.x = ...` (that would poison `self`)."""
+        names = ctx.tracked if ctx.traced else ctx.dev
+        stack = list(targets)
+        while stack:
+            t = stack.pop()
+            if isinstance(t, ast.Name):
+                (names.add if value_is else names.discard)(t.id)
+            elif isinstance(t, (ast.Tuple, ast.List)):
+                stack.extend(t.elts)
+            elif isinstance(t, ast.Starred):
+                stack.append(t.value)
+
+    # -------------------------------------------------------- statements
+    def _stmt(self, node: ast.AST, ctx: _Ctx) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            self._function(node, ctx)
+        elif isinstance(node, ast.ClassDef):
+            self._class(node, ctx)
+        elif isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            self._assign(node, ctx)
+        elif isinstance(node, (ast.If, ast.While)):
+            self._branch(node, ctx)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            self._for(node, ctx)
+        elif isinstance(node, ast.Assert):
+            if ctx.traced and self._tainted(node.test, ctx):
+                self._emit("GL004", node,
+                           "assert on a tracer-derived value inside a "
+                           "traced function")
+            self._expr(node.test, ctx)
+        elif isinstance(node, (ast.With, ast.AsyncWith)):
+            self._with(node, ctx)
+        elif isinstance(node, ast.Try):
+            self._try(node, ctx)
+        elif isinstance(node, ast.Delete):
+            self._check_lock_mutation_targets(node, node.targets, ctx)
+            for t in node.targets:
+                self._expr(t, ctx)
+        elif isinstance(node, (ast.Return, ast.Expr)):
+            if node.value is not None:
+                self._expr(node.value, ctx)
+        elif isinstance(node, ast.Raise):
+            if node.exc is not None:
+                self._expr(node.exc, ctx)
+        else:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.stmt):
+                    self._stmt(child, ctx)
+                elif isinstance(child, ast.expr):
+                    self._expr(child, ctx)
+
+    def _body(self, stmts: List[ast.stmt], ctx: _Ctx) -> None:
+        for s in stmts:
+            self._stmt(s, ctx)
+
+    def _assign(self, node: ast.AST, ctx: _Ctx) -> None:
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif isinstance(node, ast.AugAssign):
+            targets, value = [node.target], node.value
+        else:                                        # AnnAssign
+            targets, value = [node.target], node.value
+        self._check_lock_mutation_targets(node, targets, ctx)
+        if value is not None:
+            self._expr(value, ctx)
+            pred = self._tainted if ctx.traced else self._devicey
+            is_tracked = pred(value, ctx)
+            if isinstance(node, ast.AugAssign):
+                if is_tracked:
+                    self._update_bindings(targets, True, ctx)
+            else:
+                self._update_bindings(targets, is_tracked, ctx)
+
+    def _branch(self, node, ctx: _Ctx) -> None:
+        if ctx.traced and self._tainted(node.test, ctx):
+            kw = "while" if isinstance(node, ast.While) else "if"
+            self._emit("GL003", node.test,
+                       f"Python `{kw}` on a tracer-derived value inside "
+                       "a traced function — use lax.cond/lax.while_loop/"
+                       "jnp.where")
+        elif (not ctx.traced and self.hot
+                and self._devicey(node.test, ctx)):
+            kw = "while" if isinstance(node, ast.While) else "if"
+            self._emit("GL202", node.test,
+                       f"`{kw}` on a device value forces a blocking "
+                       "device→host sync (implicit __bool__)")
+        self._expr(node.test, ctx)
+        if isinstance(node, ast.While):
+            ctx.loop_depth += 1
+            self._body(node.body, ctx)
+            ctx.loop_depth -= 1
+        else:
+            self._body(node.body, ctx)
+        self._body(node.orelse, ctx)
+
+    def _for(self, node, ctx: _Ctx) -> None:
+        if ctx.traced and self._dynamic_iter(node.iter, ctx):
+            self._emit("GL005", node.iter,
+                       "Python for-loop over a tracer-derived value "
+                       "inside a traced function — use lax.scan/"
+                       "lax.fori_loop")
+        self._expr(node.iter, ctx)
+        pred = self._tainted if ctx.traced else self._devicey
+        self._update_bindings([node.target], pred(node.iter, ctx), ctx)
+        ctx.loop_depth += 1
+        self._body(node.body, ctx)
+        ctx.loop_depth -= 1
+        self._body(node.orelse, ctx)
+
+    def _with(self, node, ctx: _Ctx) -> None:
+        lockish = any(
+            _LOCKISH_RE.search(_terminal(item.context_expr) or "")
+            for item in node.items)
+        for item in node.items:
+            self._expr(item.context_expr, ctx)
+        if lockish:
+            ctx.lock_depth += 1
+        self._body(node.body, ctx)
+        if lockish:
+            ctx.lock_depth -= 1
+
+    def _try(self, node: ast.Try, ctx: _Ctx) -> None:
+        self._body(node.body, ctx)
+        for h in node.handlers:
+            if h.type is None:
+                self._emit("GL402", h,
+                           "bare `except:` catches KeyboardInterrupt/"
+                           "SystemExit and masks worker-thread errors")
+            elif (len(h.body) == 1 and isinstance(h.body[0], ast.Pass)):
+                self._emit("GL403", h,
+                           "exception silently swallowed "
+                           "(`except ...: pass`)")
+            if h.type is not None:
+                self._expr(h.type, ctx)
+            self._body(h.body, ctx)
+        self._body(node.orelse, ctx)
+        self._body(node.finalbody, ctx)
+
+    # --------------------------------------------------------- functions
+    def _is_traced_def(self, node, ctx: _Ctx) -> bool:
+        if ctx.traced:
+            return True
+        if node.name in self.traced_names:
+            return True
+        for dec in node.decorator_list:
+            if self._jitish_decorator(dec):
+                return True
+        return False
+
+    def _jitish_decorator(self, dec: ast.AST) -> Optional[ast.AST]:
+        """The jit-ish callable node for a decorator, or None. Handles
+        @jax.jit, @jit, @jax.jit(...), @partial(jax.jit, ...)."""
+        if self.imports.wrapper_slots(dec) is not None:
+            return dec
+        if isinstance(dec, ast.Call):
+            if self.imports.wrapper_slots(dec.func) is not None:
+                return dec.func
+            if (_terminal(dec.func) in self.imports.partial_names
+                    and dec.args
+                    and self.imports.wrapper_slots(dec.args[0]) is not None):
+                return dec.args[0]
+        return None
+
+    def _jit_family_decorator(self, dec: ast.AST) -> bool:
+        n = self._jitish_decorator(dec)
+        return n is not None and _terminal(n) in _JIT_FAMILY
+
+    def _static_param_names(self, call: ast.Call, fn) -> List[str]:
+        """Parameter names pinned static by static_argnums/argnames on a
+        jit call/decorator, resolved against `fn`'s signature."""
+        names: List[str] = []
+        params = [a.arg for a in
+                  getattr(fn.args, "posonlyargs", []) + fn.args.args]
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                for n in ast.walk(kw.value):
+                    if isinstance(n, ast.Constant) and isinstance(n.value,
+                                                                  str):
+                        names.append(n.value)
+            elif kw.arg == "static_argnums":
+                for n in ast.walk(kw.value):
+                    if isinstance(n, ast.Constant) and isinstance(n.value,
+                                                                  int):
+                        if 0 <= n.value < len(params):
+                            names.append(params[n.value])
+        return names
+
+    def _check_static_args(self, call: ast.Call, fn) -> None:
+        """GL101: static params whose defaults are unhashable."""
+        static = self._static_param_names(call, fn)
+        if not static:
+            return
+        args = getattr(fn.args, "posonlyargs", []) + fn.args.args
+        defaults = fn.args.defaults
+        offset = len(args) - len(defaults)
+        by_name = {args[offset + i].arg: d
+                   for i, d in enumerate(defaults)}
+        for a, d in zip(fn.args.kwonlyargs, fn.args.kw_defaults):
+            if d is not None:
+                by_name[a.arg] = d
+        for name in static:
+            d = by_name.get(name)
+            if d is not None and _is_mutable_literal(d):
+                self._emit("GL101", d,
+                           f"static argument {name!r} has a mutable "
+                           "(unhashable) default — jit cache keys hash "
+                           "static args")
+
+    def _function(self, node, ctx: _Ctx) -> None:
+        for d in node.decorator_list:
+            self._expr(d, ctx)
+            jf = self._jitish_decorator(d)
+            if jf is not None and _terminal(jf) in _JIT_FAMILY:
+                if ctx.loop_depth > 0:
+                    self._emit("GL103", node,
+                               f"jit-decorated function {node.name!r} "
+                               "defined inside a loop — a fresh compiled "
+                               "program per iteration")
+                elif ctx.fn_depth > 0 and not ctx.traced:
+                    self._emit("GL102", node,
+                               f"jit-decorated function {node.name!r} is "
+                               "a fresh closure per enclosing call — the "
+                               "jit cache keys on function identity, so "
+                               "every call recompiles; hoist it or cache "
+                               "the jitted callable")
+                if isinstance(d, ast.Call):
+                    self._check_static_args(d, node)
+        for default in list(node.args.defaults) + \
+                [d for d in node.args.kw_defaults if d is not None]:
+            if _is_mutable_literal(default):
+                self._emit("GL401", default,
+                           f"mutable default argument in {node.name!r} — "
+                           "shared across calls (and worker threads); "
+                           "default to None")
+            self._expr(default, ctx)
+
+        inner = _Ctx(
+            traced=self._is_traced_def(node, ctx),
+            fn_depth=ctx.fn_depth + 1,
+            lock_attrs=ctx.lock_attrs,
+            self_name=ctx.self_name,
+            lock_depth=0,
+            in_init=(node.name == "__init__" and ctx.fn_depth == 0
+                     and ctx.lock_attrs is not None),
+        )
+        if inner.traced:
+            skip = ("self", "cls")
+            for a in (getattr(node.args, "posonlyargs", [])
+                      + node.args.args + node.args.kwonlyargs):
+                if a.arg not in skip:
+                    inner.tracked.add(a.arg)
+            for a in (node.args.vararg, node.args.kwarg):
+                if a is not None:
+                    inner.tracked.add(a.arg)
+        self._body(node.body, inner)
+
+    def _class(self, node: ast.ClassDef, ctx: _Ctx) -> None:
+        for d in node.decorator_list:
+            self._expr(d, ctx)
+        lock_attrs, self_name = self._find_lock_attrs(node)
+        inner = _Ctx(fn_depth=0, lock_attrs=lock_attrs or None,
+                     self_name=self_name)
+        self._body(node.body, inner)
+
+    def _find_lock_attrs(self, node: ast.ClassDef):
+        lock_attrs: Set[str] = set()
+        self_name = "self"
+        for stmt in node.body:
+            if isinstance(stmt, ast.FunctionDef) and \
+                    stmt.name == "__init__":
+                if stmt.args.args:
+                    self_name = stmt.args.args[0].arg
+                for n in ast.walk(stmt):
+                    if not isinstance(n, ast.Assign):
+                        continue
+                    for t in n.targets:
+                        if (isinstance(t, ast.Attribute)
+                                and isinstance(t.value, ast.Name)
+                                and t.value.id == self_name):
+                            val = n.value
+                            if (isinstance(val, ast.Call)
+                                    and _terminal(val.func)
+                                    in _LOCK_CLASSES) \
+                                    or _LOCKISH_RE.search(t.attr):
+                                lock_attrs.add(t.attr)
+        return lock_attrs, self_name
+
+    def _check_lock_mutation_targets(self, stmt, targets, ctx: _Ctx):
+        if (not ctx.lock_attrs or ctx.lock_depth > 0 or ctx.in_init
+                or ctx.fn_depth == 0):
+            return
+        for t in targets:
+            attr = self._self_attr_of(t, ctx)
+            if attr and attr not in ctx.lock_attrs:
+                self._emit("GL301", stmt,
+                           f"mutation of `{ctx.self_name}.{attr}` outside "
+                           "`with <lock>:` in a lock-owning class — racy "
+                           "against locked readers (annotate with "
+                           "`# graft: allow(GL301): reason` if the "
+                           "caller holds the lock)")
+
+    def _self_attr_of(self, node: ast.AST, ctx: _Ctx) -> Optional[str]:
+        """'x' when node is self.x or self.x[...] (mutation targets)."""
+        if isinstance(node, ast.Subscript):
+            node = node.value
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == ctx.self_name):
+            return node.attr
+        return None
+
+    # ------------------------------------------------------- expressions
+    def _expr(self, node: ast.AST, ctx: _Ctx) -> None:
+        if isinstance(node, ast.Call):
+            self._call(node, ctx)
+            return
+        if isinstance(node, ast.Lambda):
+            inner = _Ctx(traced=ctx.traced or id(node) in
+                         self.traced_lambdas,
+                         fn_depth=ctx.fn_depth + 1)
+            if inner.traced:
+                for a in inner_args(node):
+                    inner.tracked.add(a)
+            for d in node.args.defaults:
+                if _is_mutable_literal(d):
+                    self._emit("GL401", d,
+                               "mutable default argument in lambda")
+            self._expr(node.body, inner)
+            return
+        if isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp,
+                             ast.GeneratorExp)):
+            for gen in node.generators:
+                if ctx.traced and self._dynamic_iter(gen.iter, ctx):
+                    self._emit("GL005", gen.iter,
+                               "comprehension over a tracer-derived "
+                               "value inside a traced function — use "
+                               "lax.scan/vmap")
+                self._expr(gen.iter, ctx)
+                pred = self._tainted if ctx.traced else self._devicey
+                self._update_bindings([gen.target],
+                                      pred(gen.iter, ctx), ctx)
+                for cond in gen.ifs:
+                    self._expr(cond, ctx)
+            if isinstance(node, ast.DictComp):
+                self._expr(node.key, ctx)
+                self._expr(node.value, ctx)
+            else:
+                self._expr(node.elt, ctx)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._expr(child, ctx)
+
+    def _call(self, node: ast.Call, ctx: _Ctx) -> None:
+        func = node.func
+        term = _terminal(func)
+
+        # GL102/GL103 — jit of a fresh function / jit in a loop
+        if self.imports.is_jit_family(func):
+            if ctx.loop_depth > 0:
+                self._emit("GL103", node,
+                           f"{term}() called inside a loop — a fresh "
+                           "compiled program per iteration")
+            # call-site static-arg check against a visible local def
+            if node.args and isinstance(node.args[0], ast.Name):
+                fn = self.module_defs.get(node.args[0].id)
+                if fn is not None:
+                    self._check_static_args(node, fn)
+        if (isinstance(func, ast.Call)
+                and self.imports.is_jit_family(func.func)
+                and ctx.loop_depth == 0 and ctx.fn_depth > 0):
+            # (in a loop, visiting the inner jit call emits GL103)
+            self._emit("GL102", func,
+                       "immediately-invoked jit "
+                       f"(`{_terminal(func.func)}(f)(...)`) builds a "
+                       "fresh traced callable per call — cache the "
+                       "jitted function instead")
+
+        # tracer-safety / sync rules
+        if isinstance(func, ast.Name) and func.id in ("bool", "int",
+                                                      "float") \
+                and node.args:
+            arg = node.args[0]
+            if ctx.traced and self._tainted(arg, ctx):
+                self._emit("GL001", node,
+                           f"{func.id}() on a tracer-derived value "
+                           "inside a traced function")
+            elif not ctx.traced and self.hot and self._devicey(arg, ctx):
+                self._emit("GL202", node,
+                           f"{func.id}() on a device value forces a "
+                           "blocking device→host sync")
+        elif isinstance(func, ast.Attribute) \
+                and func.attr in _MATERIALIZE_METHODS:
+            if ctx.traced and self._tainted(func.value, ctx):
+                self._emit("GL002", node,
+                           f".{func.attr}() on a tracer-derived value "
+                           "inside a traced function")
+            elif (not ctx.traced and self.hot
+                  and self._devicey(func.value, ctx)):
+                self._emit("GL201", node,
+                           f".{func.attr}() materializes a device value "
+                           "on host")
+        elif isinstance(func, ast.Attribute) \
+                and func.attr == "block_until_ready":
+            if ctx.traced and self._tainted(func.value, ctx):
+                self._emit("GL002", node,
+                           ".block_until_ready() inside a traced "
+                           "function")
+            elif not ctx.traced and self.hot:
+                self._emit("GL203", node,
+                           ".block_until_ready() blocks the host on "
+                           "device work")
+        elif self.imports.is_np_call(func, ("asarray", "array",
+                                            "ascontiguousarray")):
+            if node.args:
+                arg = node.args[0]
+                if ctx.traced and self._tainted(arg, ctx):
+                    self._emit("GL002", node,
+                               f"np.{func.attr}() on a tracer-derived "
+                               "value inside a traced function")
+                elif (not ctx.traced and self.hot
+                      and self._devicey(arg, ctx)):
+                    self._emit("GL201", node,
+                               f"np.{func.attr}() on a device value "
+                               "copies device→host")
+        elif term == "device_get":
+            args_ = list(node.args) + [k.value for k in node.keywords]
+            if ctx.traced and any(self._tainted(a, ctx) for a in args_):
+                self._emit("GL002", node,
+                           "jax.device_get() inside a traced function")
+            elif not ctx.traced and self.hot:
+                self._emit("GL201", node,
+                           "jax.device_get() copies device→host")
+
+        # GL204 — device arrays into logs / serialization (host, hot)
+        if not ctx.traced and self.hot:
+            is_log = ((isinstance(func, ast.Name) and func.id == "print")
+                      or (isinstance(func, ast.Attribute)
+                          and func.attr in _LOG_METHODS
+                          and "log" in (_root_name(func) or "").lower())
+                      or (isinstance(func, ast.Attribute)
+                          and func.attr in ("dumps", "dump")
+                          and _root_name(func) == "json"))
+            if is_log:
+                payload = list(node.args) + [k.value for k in
+                                             node.keywords]
+                if any(self._devicey(a, ctx) for a in payload):
+                    self._emit("GL204", node,
+                               "device value passed to logging/"
+                               "serialization — forces a sync and can "
+                               "pin device buffers; convert via "
+                               "float()/np.asarray() under an "
+                               "allow-sync, or log host scalars")
+
+        # GL301 — mutating method calls on self attrs
+        if (isinstance(func, ast.Attribute)
+                and func.attr in _MUTATOR_METHODS):
+            self._check_lock_mutation_targets(node, [func.value], ctx)
+
+        if isinstance(func, (ast.Call, ast.Lambda)):
+            self._expr(func, ctx)
+        elif isinstance(func, ast.Attribute):
+            self._expr(func.value, ctx)
+        for a in node.args:
+            self._expr(a, ctx)
+        for k in node.keywords:
+            self._expr(k.value, ctx)
+
+
+def inner_args(node: ast.Lambda) -> List[str]:
+    args = node.args
+    out = [a.arg for a in getattr(args, "posonlyargs", []) + args.args
+           + args.kwonlyargs]
+    for a in (args.vararg, args.kwarg):
+        if a is not None:
+            out.append(a.arg)
+    return out
+
+
+# ------------------------------------------------------------- public API
+
+def lint_source(source: str, path: str = "<string>", *,
+                hot: Optional[bool] = None,
+                hot_prefixes: Sequence[str] = DEFAULT_HOT_PREFIXES,
+                ) -> List[Finding]:
+    """Lint one source string; `hot` overrides path-based hot detection."""
+    if hot is None:
+        hot = is_hot(path, hot_prefixes)
+    return _FileLinter(path, source, hot=hot).run()
+
+
+def lint_file(path: str, *,
+              hot_prefixes: Sequence[str] = DEFAULT_HOT_PREFIXES,
+              ) -> List[Finding]:
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        src = f.read()
+    rel = os.path.relpath(path).replace(os.sep, "/")
+    if rel.startswith(".."):
+        rel = path.replace(os.sep, "/")
+    return lint_source(src, rel, hot=is_hot(rel, hot_prefixes))
+
+
+def iter_python_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                out.append(p)
+        else:
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if not d.startswith(".")
+                                 and d != "__pycache__")
+                for f in sorted(files):
+                    if f.endswith(".py"):
+                        out.append(os.path.join(root, f))
+    return out
+
+
+def lint_paths(paths: Sequence[str], *,
+               hot_prefixes: Sequence[str] = DEFAULT_HOT_PREFIXES,
+               select: Optional[Sequence[str]] = None,
+               ignore: Optional[Sequence[str]] = None,
+               ) -> List[Finding]:
+    """Lint files/trees; optional rule-id prefix filters ('GL2' selects
+    the whole sync category)."""
+    findings: List[Finding] = []
+    for f in iter_python_files(paths):
+        findings.extend(lint_file(f, hot_prefixes=hot_prefixes))
+    if select:
+        findings = [f for f in findings
+                    if any(f.rule.startswith(s) for s in select)]
+    if ignore:
+        findings = [f for f in findings
+                    if not any(f.rule.startswith(s) for s in ignore)]
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings

@@ -1,0 +1,102 @@
+"""graft-lint output renderers: text (human), JSON (tools/
+lint_report.py), SARIF 2.1.0 (code-scanning UIs).
+
+The JSON schema is contractual — `tools/lint_report.py` and the tests
+round-trip it:
+
+    {"tool": "graft-lint", "version": ..., "summary": {"files": N,
+     "findings": N, "errors": N, "warnings": N, "baselined": N,
+     "by_rule": {"GL202": N, ...}},
+     "findings": [Finding.to_dict(), ...]}
+"""
+
+from __future__ import annotations
+
+import json
+from collections import Counter
+from typing import List
+
+from deeplearning4j_tpu.analysis.engine import Finding
+from deeplearning4j_tpu.analysis.rules import ERROR, RULES
+
+TOOL_NAME = "graft-lint"
+TOOL_VERSION = "1.0.0"
+TOOL_URI = ("https://github.com/deeplearning4j/deeplearning4j"
+            "#graft-lint")
+
+
+def summarize(findings: List[Finding], *, files: int = 0,
+              baselined: int = 0) -> dict:
+    by_rule = Counter(f.rule for f in findings)
+    errors = sum(1 for f in findings if f.severity == ERROR)
+    return {"files": files, "findings": len(findings),
+            "errors": errors, "warnings": len(findings) - errors,
+            "baselined": baselined,
+            "by_rule": dict(sorted(by_rule.items()))}
+
+
+def render_text(findings: List[Finding], *, files: int = 0,
+                baselined: int = 0) -> str:
+    lines = []
+    for f in findings:
+        meta = f.meta
+        lines.append(f"{f.path}:{f.line}:{f.col + 1} "
+                     f"{f.rule}[{meta.severity}] {meta.name}: "
+                     f"{f.message}")
+        if f.snippet:
+            lines.append(f"    | {f.snippet}")
+    s = summarize(findings, files=files, baselined=baselined)
+    lines.append(
+        f"graft-lint: {s['findings']} finding(s) "
+        f"({s['errors']} error(s), {s['warnings']} warning(s)) "
+        f"in {files} file(s); {baselined} baselined")
+    return "\n".join(lines) + "\n"
+
+
+def render_json(findings: List[Finding], *, files: int = 0,
+                baselined: int = 0) -> str:
+    doc = {"tool": TOOL_NAME, "version": TOOL_VERSION,
+           "summary": summarize(findings, files=files,
+                                baselined=baselined),
+           "findings": [f.to_dict() for f in findings]}
+    return json.dumps(doc, indent=1, sort_keys=True) + "\n"
+
+
+def render_sarif(findings: List[Finding], *, files: int = 0,
+                 baselined: int = 0) -> str:
+    rules_used = sorted({f.rule for f in findings} | set())
+    sarif_rules = [
+        {"id": rid, "name": RULES[rid].name,
+         "shortDescription": {"text": RULES[rid].summary},
+         "defaultConfiguration": {
+             "level": RULES[rid].severity}}
+        for rid in (rules_used or sorted(RULES))]
+    results = [
+        {"ruleId": f.rule,
+         "level": f.severity,
+         "message": {"text": f.message},
+         "locations": [{
+             "physicalLocation": {
+                 "artifactLocation": {"uri": f.path},
+                 "region": {"startLine": f.line,
+                            "startColumn": f.col + 1,
+                            "snippet": {"text": f.snippet}},
+             }}]}
+        for f in findings]
+    doc = {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": TOOL_NAME, "version": TOOL_VERSION,
+                "informationUri": TOOL_URI,
+                "rules": sarif_rules}},
+            "results": results,
+        }],
+    }
+    return json.dumps(doc, indent=1, sort_keys=True) + "\n"
+
+
+RENDERERS = {"text": render_text, "json": render_json,
+             "sarif": render_sarif}

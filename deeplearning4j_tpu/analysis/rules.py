@@ -1,0 +1,130 @@
+"""graft-lint rule registry.
+
+Every rule the engine can emit, with the metadata the reporters and the
+runtime cross-check need: stable id, category, severity, one-line
+summary. The ids are contractual — they appear in suppression comments
+(`# graft: allow(GL202): reason`), in `.graftlint-baseline.json`, in
+SARIF output, and in the hints the runtime RecompileWatchdog /
+HostSyncMonitor attach to their events — so ids are append-only; never
+renumber.
+
+Categories map onto the failure modes this codebase actually has
+(PERF_NOTES contracts):
+
+  tracer    — concretizing a tracer inside a traced function
+              (TracerBoolConversionError / silent constant-folding)
+  recompile — patterns that defeat the jit cache (the
+              RecompileWatchdog's static counterpart)
+  sync      — un-suppressed device→host syncs in modules declared hot
+              (the HostSyncMonitor's static counterpart); suppressible
+              with `# graft: allow-sync(reason)`
+  lock      — mutation of lock-guarded shared state outside its lock
+  hygiene   — general patterns that mask errors in worker threads
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Tuple
+
+ERROR = "error"
+WARNING = "warning"
+
+CAT_TRACER = "tracer"
+CAT_RECOMPILE = "recompile"
+CAT_SYNC = "sync"
+CAT_LOCK = "lock"
+CAT_HYGIENE = "hygiene"
+
+
+@dataclass(frozen=True)
+class Rule:
+    id: str
+    name: str
+    category: str
+    severity: str
+    summary: str
+
+
+_ALL = (
+    Rule("GL000", "parse-failure", CAT_HYGIENE, ERROR,
+         "file does not parse — nothing else can be checked"),
+    # ------------------------------------------------------ tracer-safety
+    Rule("GL001", "tracer-implicit-cast", CAT_TRACER, ERROR,
+         "bool()/int()/float() on a tracer-derived value inside a traced "
+         "function — raises TracerBoolConversionError or bakes a "
+         "trace-time constant into the program"),
+    Rule("GL002", "tracer-concretize", CAT_TRACER, ERROR,
+         ".item()/.tolist()/np.asarray()/jax.device_get()/"
+         ".block_until_ready() on a tracer-derived value inside a traced "
+         "function — tracers have no buffer to materialize"),
+    Rule("GL003", "tracer-python-branch", CAT_TRACER, ERROR,
+         "Python if/while on a tracer-derived value inside a traced "
+         "function — use lax.cond/lax.while_loop/jnp.where"),
+    Rule("GL004", "tracer-assert", CAT_TRACER, ERROR,
+         "assert on a tracer-derived value inside a traced function — "
+         "use checkify or move the check to host code"),
+    Rule("GL005", "tracer-python-loop", CAT_TRACER, ERROR,
+         "Python for-loop over a tracer-derived value (or range() of "
+         "one) inside a traced function — use lax.scan/lax.fori_loop"),
+    # --------------------------------------------------- recompile hazards
+    Rule("GL101", "unhashable-static-arg", CAT_RECOMPILE, ERROR,
+         "jit static argument whose parameter default is a mutable "
+         "(unhashable) container — every call either crashes on hashing "
+         "or defeats the jit cache key"),
+    Rule("GL102", "jit-of-fresh-function", CAT_RECOMPILE, ERROR,
+         "jit/pmap applied to a function object created per call "
+         "(immediately-invoked jit, or a jit-decorated def nested in a "
+         "function) — the cache keys on function identity, so every "
+         "call recompiles"),
+    Rule("GL103", "jit-in-loop", CAT_RECOMPILE, ERROR,
+         "jit/pmap wrapping (or decorating) a function inside a loop "
+         "body — a fresh compiled program per iteration"),
+    # -------------------------------------------------------- sync hygiene
+    Rule("GL201", "hot-sync-materialize", CAT_SYNC, ERROR,
+         "device→host materialization (.item()/.tolist()/np.asarray()/"
+         "jax.device_get()) on a device value in a hot module without "
+         "`# graft: allow-sync(reason)`"),
+    Rule("GL202", "hot-implicit-sync", CAT_SYNC, ERROR,
+         "implicit device→host sync (bool()/int()/float() or Python "
+         "truthiness on a device value) in a hot module without "
+         "`# graft: allow-sync(reason)`"),
+    Rule("GL203", "hot-block-until-ready", CAT_SYNC, ERROR,
+         ".block_until_ready() in a hot module without "
+         "`# graft: allow-sync(reason)` — serializes the dispatch "
+         "pipeline"),
+    Rule("GL204", "device-array-leak", CAT_SYNC, WARNING,
+         "device value passed to logging/print/json serialization in a "
+         "hot module — forces a sync and can pin device buffers in "
+         "log records"),
+    # ----------------------------------------------------- lock discipline
+    Rule("GL301", "unlocked-shared-mutation", CAT_LOCK, ERROR,
+         "mutation of an attribute of a lock-owning object outside a "
+         "`with <lock>:` block — racy against the locked readers"),
+    # ---------------------------------------------------- general hygiene
+    Rule("GL401", "mutable-default-arg", CAT_HYGIENE, WARNING,
+         "mutable default argument (list/dict/set) — shared across "
+         "calls and across AsyncDataSetIterator-style worker threads"),
+    Rule("GL402", "bare-except", CAT_HYGIENE, WARNING,
+         "bare `except:` — catches KeyboardInterrupt/SystemExit and "
+         "masks worker-thread errors; catch Exception (or narrower)"),
+    Rule("GL403", "silent-exception-swallow", CAT_HYGIENE, WARNING,
+         "`except ...: pass` — the error disappears; log it, re-raise, "
+         "or narrow the handler"),
+)
+
+RULES: Dict[str, Rule] = {r.id: r for r in _ALL}
+
+#: Runtime cross-check: when a *runtime* monitor fires, these are the
+#: static rules that should have caught the pattern before it shipped.
+#: observe/watchdog.py and observe/syncmon.py tag their events with
+#: these ids so a production alert points straight back at graft-lint.
+RUNTIME_RULE_HINTS: Dict[str, Tuple[str, ...]] = {
+    "recompile": ("GL101", "GL102", "GL103"),
+    "host_sync": ("GL001", "GL002", "GL201", "GL202", "GL203"),
+}
+
+
+def runtime_hint(event_kind: str) -> str:
+    """Human-facing 'GL101/GL102/GL103' tag for a runtime event kind."""
+    return "/".join(RUNTIME_RULE_HINTS.get(event_kind, ()))

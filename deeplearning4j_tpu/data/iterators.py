@@ -192,7 +192,7 @@ class AsyncDataSetIterator(DataSetIterator):
             try:
                 while True:
                     q.get_nowait()
-            except queue.Empty:
+            except queue.Empty:  # graft: allow(GL403): drain-until-empty
                 pass
         if t is not None and t.is_alive():
             t.join(timeout=5.0)

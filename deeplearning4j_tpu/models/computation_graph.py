@@ -596,6 +596,8 @@ class ComputationGraph(SeqCtxJitCache, SeqCtxSolverCache):
                 return x
 
             @jax.jit
+            # graft: allow(GL103): one program per pretrained layer by
+            # design — layerwise pretraining compiles each layer once
             def pre_step(params, lp, opt_state, step, feats, rng):
                 x = featurize(params, self.state_tree, feats)
 

@@ -771,6 +771,8 @@ class MultiLayerNetwork(SeqCtxJitCache, SeqCtxSolverCache):
                 return x
 
             @jax.jit
+            # graft: allow(GL103): one program per pretrained layer by
+            # design — layerwise pretraining compiles each layer once
             def pre_step(lp, opt_state, step, feats, rng):
                 x = featurize(feats)
 

@@ -388,5 +388,5 @@ class Workspace:
     def __del__(self):
         try:
             self.close(force=True)  # GC decided: nothing can reach the views
-        except Exception:
+        except Exception:  # graft: allow(GL403): __del__ must never raise
             pass

@@ -62,6 +62,8 @@ class Glove(Word2Vec):
         x_max, alpha, lr = self.x_max, self.alpha, self.lr
 
         @jax.jit
+        # graft: allow(GL102): compiled once per fit(); closes over
+        # per-fit hyperparameters and lives for the whole epoch loop
         def step(params, hist, ii, jj, x):
             def loss_fn(p):
                 dot = jnp.einsum("bd,bd->b", p["w"][ii], p["wc"][jj])

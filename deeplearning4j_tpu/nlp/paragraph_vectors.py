@@ -20,6 +20,38 @@ from deeplearning4j_tpu.nlp.vocab import build_vocab, unigram_table
 from deeplearning4j_tpu.nlp.word2vec import Word2Vec, _as_token_lists
 
 
+@jax.jit
+def _infer_step_dm(dv, syn0, syn1, targets, negs, lr_):
+    """One inferVector gradient step, DM flavour (doc + word context).
+    Module-level so repeated infer_vector() calls share one jit cache
+    entry per (len(idx), negative) shape instead of re-tracing."""
+    def loss_fn(v):
+        h = 0.5 * (v[None, :] + syn0[targets])
+        pos = jnp.einsum("bd,bd->b", h, syn1[targets])
+        neg = jnp.einsum("bd,bkd->bk", h, syn1[negs])
+        # SUM: per-pair SGD semantics (see word2vec.py)
+        return (jnp.sum(jax.nn.softplus(-pos))
+                + jnp.sum(jax.nn.softplus(neg)))
+
+    return dv - lr_ * jax.grad(loss_fn)(dv)
+
+
+@jax.jit
+def _infer_step_dbow(dv, syn0, syn1, targets, negs, lr_):
+    """DBOW flavour: the doc vector alone predicts each target (syn0 is
+    unused and DCE'd; the signature matches _infer_step_dm so callers
+    dispatch on self.dm only)."""
+    def loss_fn(v):
+        h = jnp.broadcast_to(v, (targets.shape[0], v.shape[0]))
+        pos = jnp.einsum("bd,bd->b", h, syn1[targets])
+        neg = jnp.einsum("bd,bkd->bk", h, syn1[negs])
+        # SUM: per-pair SGD semantics (see word2vec.py)
+        return (jnp.sum(jax.nn.softplus(-pos))
+                + jnp.sum(jax.nn.softplus(neg)))
+
+    return dv - lr_ * jax.grad(loss_fn)(dv)
+
+
 class ParagraphVectors(Word2Vec):
     def __init__(self, *, dm: bool = True, **kw):
         kw.setdefault("min_count", 1)
@@ -109,6 +141,8 @@ class ParagraphVectors(Word2Vec):
         dm = self.dm
 
         @jax.jit
+        # graft: allow(GL102): factory runs once per fit(); the trainer
+        # caches the returned jitted step for the whole epoch loop
         def step(params, doc_ids, centers, contexts, negatives, lr):
             def loss_fn(p):
                 dv = p["docs"][doc_ids]            # [B,D]
@@ -158,24 +192,11 @@ class ParagraphVectors(Word2Vec):
         syn1 = jnp.asarray(self._syn1)
         probs = unigram_table(self.vocab)
         targets = jnp.asarray(idx)
-        dm = self.dm
-
-        @jax.jit
-        def istep(dv, negs, lr_):
-            def loss_fn(v):
-                h = (0.5 * (v[None, :] + syn0[targets]) if dm
-                     else jnp.broadcast_to(v, (len(idx), v.shape[0])))
-                pos = jnp.einsum("bd,bd->b", h, syn1[targets])
-                neg = jnp.einsum("bd,bkd->bk", h, syn1[negs])
-                # SUM: per-pair SGD semantics (see word2vec.py)
-                return (jnp.sum(jax.nn.softplus(-pos))
-                        + jnp.sum(jax.nn.softplus(neg)))
-
-            return dv - lr_ * jax.grad(loss_fn)(dv)
+        step_fn = _infer_step_dm if self.dm else _infer_step_dbow
 
         for s in range(steps):
             negs = rng.choice(len(probs), size=(len(idx), self.negative),
                               p=probs)
-            dv = istep(dv, jnp.asarray(negs),
-                       jnp.asarray(lr * (1 - s / steps), jnp.float32))
+            dv = step_fn(dv, syn0, syn1, targets, jnp.asarray(negs),
+                         jnp.asarray(lr * (1 - s / steps), jnp.float32))
         return np.asarray(dv)

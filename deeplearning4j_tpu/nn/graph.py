@@ -43,7 +43,7 @@ def resolve_output_type(name, vertex, in_types, n_inputs, known):
     elif not n_inputs:
         try:
             known[name] = vertex.output_type(*in_types)
-        except Exception:
+        except Exception:  # graft: allow(GL403): vertex stays untyped
             pass  # untyped zero-input vertex
 
 

@@ -30,6 +30,16 @@ _monitors: list = []          # install order; [-1] is `current_monitor()`
 _originals: Optional[tuple] = None
 
 
+def _static_rules() -> str:
+    """graft-lint rules whose violations produce the syncs this monitor
+    counts (the runtime → static cross-check; see analysis/rules.py)."""
+    try:
+        from deeplearning4j_tpu.analysis.rules import runtime_hint
+        return runtime_hint("host_sync")
+    except Exception:
+        return ""
+
+
 def current_monitor() -> Optional["HostSyncMonitor"]:
     """The innermost installed monitor, or None (the PerformanceListener
     seam: report syncs/step only when someone asked to measure)."""
@@ -99,6 +109,18 @@ class HostSyncMonitor:
             self.block_syncs = 0
         return n
 
+    def snapshot(self) -> dict:
+        """Counters plus the graft-lint rules that flag host-sync
+        patterns at review time — when this monitor reports unexpected
+        syncs, `static_rules` names what to lint for."""
+        with self._count_lock:
+            return {
+                "float_syncs": self.float_syncs,
+                "block_syncs": self.block_syncs,
+                "total": self.float_syncs + self.block_syncs,
+                "static_rules": _static_rules(),
+            }
+
     # -------------------------------------------------------- lifecycle
     def install(self) -> "HostSyncMonitor":
         with _lock:
@@ -108,9 +130,11 @@ class HostSyncMonitor:
                 _patch()
             _monitors.append(self)
             self._installed = True
-        if self._metrics is None:
-            from deeplearning4j_tpu.observe.registry import get_registry
-            self._metrics = get_registry()
+            if self._metrics is None:
+                from deeplearning4j_tpu.observe.registry import (
+                    get_registry,
+                )
+                self._metrics = get_registry()
         return self
 
     def uninstall(self) -> None:
@@ -118,10 +142,8 @@ class HostSyncMonitor:
             if not self._installed:
                 return
             self._installed = False
-            try:
+            if self in _monitors:
                 _monitors.remove(self)
-            except ValueError:
-                pass
             if not _monitors and _originals is not None:
                 _unpatch()
 

@@ -36,6 +36,17 @@ DEFAULT_THRESHOLD = int(os.environ.get("DL4J_TPU_RECOMPILE_THRESHOLD", "10"))
 _MAX_SIGNATURES = 64   # per-owner bound on recorded shape signatures
 
 
+def _static_rules() -> str:
+    """The graft-lint rules that flag recompile-churn patterns at review
+    time — every watchdog warning names its static counterpart so the
+    fix loop is 'run the linter', not 'read the runtime trace'."""
+    try:
+        from deeplearning4j_tpu.analysis.rules import runtime_hint
+        return runtime_hint("recompile")
+    except Exception:
+        return ""
+
+
 class RecompileWatchdog:
     """Counts jit compiles per owner; warn-once past `threshold`."""
 
@@ -49,10 +60,13 @@ class RecompileWatchdog:
         self._warned: set = set()
 
     def _registry(self):
-        if self._metrics is None:
-            from deeplearning4j_tpu.observe.registry import get_registry
-            self._metrics = get_registry()
-        return self._metrics
+        with self._lock:
+            if self._metrics is None:
+                from deeplearning4j_tpu.observe.registry import (
+                    get_registry,
+                )
+                self._metrics = get_registry()
+            return self._metrics
 
     def record_compile(self, owner_tag: str, owner_class: str,
                        key) -> None:
@@ -79,8 +93,11 @@ class RecompileWatchdog:
                 "cache keys: %s. Bucket input shapes (pad to fixed "
                 "batch/length buckets, as ParallelInference does) or "
                 "raise DL4J_TPU_RECOMPILE_THRESHOLD if this workload "
-                "legitimately needs many programs.",
-                owner_tag, warn_count, self.threshold, recent)
+                "legitimately needs many programs. graft-lint rules %s "
+                "flag the source patterns (python -m "
+                "deeplearning4j_tpu.analysis).",
+                owner_tag, warn_count, self.threshold, recent,
+                _static_rules() or "n/a")
 
     # --------------------------------------------------------- reporting
     def compiles(self, owner_tag: Optional[str] = None) -> int:
@@ -93,6 +110,7 @@ class RecompileWatchdog:
         with self._lock:
             return {
                 "threshold": self.threshold,
+                "static_rules": _static_rules(),
                 "total_compiles": sum(self._counts.values()),
                 "per_owner": {
                     tag: {"compiles": n,

@@ -262,6 +262,7 @@ class Solver:
                     for n in states
                 }
             model.state_tree = states
+        # graft: allow-sync(final loss readback, once per fit)
         model.score_ = float(res.loss)
         return res.history
 

@@ -113,6 +113,8 @@ class AsyncTrainer:
         states = net.state_tree
 
         @jax.jit
+        # graft: allow(GL102): one closure per fit(), warmed once below;
+        # all worker threads share the same jitted callable
         def grad_fn(params, feats, labs):
             def loss_fn(p):
                 loss, _ = net._loss(p, states, feats, labs, None, None,

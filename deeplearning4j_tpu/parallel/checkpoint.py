@@ -141,13 +141,14 @@ class ShardedCheckpointer:
         if rng is not None:
             try:
                 rng = jax.random.key_data(rng)  # typed PRNG keys
-            except Exception:
+            except Exception:  # graft: allow(GL403): legacy raw key stays
                 pass                            # legacy uint32 key arrays
         meta = {
             "step": int(step),
             "iteration": int(net.iteration),
             "epoch": int(net.epoch),
             "position": position or {},
+            # graft: allow-sync(checkpoint metadata serializes the rng key)
             "rng": None if rng is None else np.asarray(rng).tolist(),
             "process_index": jax.process_index(),
             "process_count": jax.process_count(),

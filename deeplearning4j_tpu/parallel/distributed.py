@@ -96,6 +96,7 @@ def put_global(x, sharding):
     except Exception:
         typed_key = False
     if typed_key:  # typed PRNG keys: round-trip through raw key data
+        # graft: allow-sync(global key assembly requires host key data)
         data = np.asarray(jax.random.key_data(x))
         _check_replicated_consistency(data)
         raw = jax.make_array_from_callback(

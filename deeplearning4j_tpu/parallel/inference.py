@@ -98,7 +98,7 @@ class ParallelInference(SeqCtxJitCache):
             try:
                 fut.set_exception(RuntimeError(
                     "ParallelInference is shut down"))
-            except Exception:
+            except Exception:  # graft: allow(GL403): benign lost race
                 pass   # collector won the race and completed it
         return fut.result()
 
@@ -159,6 +159,8 @@ class ParallelInference(SeqCtxJitCache):
                                           train=False, rng=None)
                 return y
 
+            # graft: allow(GL301): benign double-compile race — the dict
+            # write is atomic under the GIL and both values are equivalent
             self._jit_cache[key] = jax.jit(fwd, in_shardings=(None, None, sharding))
         return self._jit_cache[key]
 

@@ -495,6 +495,8 @@ class PipelinedNetwork:
         def step(params_all, opt_state, it, x, lab_mb):
             pro_p, trunk_p, epi_p = (params_all["pro"], params_all["trunk"],
                                      params_all["epi"])
+            # graft: allow(GL003): pytree emptiness test — `pro_p` is a
+            # params dict, so truthiness is static under trace
             if pro_p:
                 pro_out, pro_vjp = jax.vjp(
                     lambda p: prologue_fn(p, x), pro_p)
@@ -504,6 +506,7 @@ class PipelinedNetwork:
             loss, trunk_g, epi_g, dx_mb = pipe(trunk_p, epi_p, pro_mb,
                                                lab_mb)
             grads = {"trunk": trunk_g, "epi": epi_g}
+            # graft: allow(GL003): pytree emptiness test (static)
             if pro_p:
                 (grads["pro"],) = pro_vjp(merge_microbatches(dx_mb))
             else:
@@ -582,9 +585,12 @@ class PipelinedNetwork:
         evaluate()/save_model see the trained weights)."""
         net, K = self.net, self._k
         for l in self._pro_layers:
+            # graft: allow-sync(host writeback, off the step path)
             net.params_tree[l.name] = jax.device_get(self.pro_params[l.name])
         for l in self._epi_layers:
+            # graft: allow-sync(host writeback, off the step path)
             net.params_tree[l.name] = jax.device_get(self.epi_params[l.name])
+        # graft: allow-sync(host writeback, off the step path)
         stage_trees = unstack_stage_params(jax.device_get(self.trunk_params))
         for i, tree in enumerate(stage_trees):
             for j in range(K):

@@ -218,6 +218,7 @@ class ContinuousBatchingScheduler:
             nxt = q.popleft()
             batch.append(nxt)
             rows += nxt.x.shape[0]
+        # graft: allow(GL301): caller holds self._cv (documented contract)
         self._depth -= len(batch)
         return batch
 

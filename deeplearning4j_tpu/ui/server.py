@@ -301,8 +301,8 @@ class _Handler(BaseHTTPRequestHandler):
             if part.startswith("since="):
                 try:
                     since = float(part[6:])
-                except ValueError:
-                    pass
+                except ValueError:  # graft: allow(GL403): malformed
+                    pass            # since= falls back to full history
         # one collection path for first and incremental polls, so the
         # session scope never shifts between them (the latest session,
         # via _updates) — a per-timestamp storage index can slot in here

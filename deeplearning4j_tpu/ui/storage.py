@@ -138,16 +138,20 @@ class StatsStorage(StatsStorageRouter):
     # ---------------------------------------------------------- listeners
     def register_stats_storage_listener(
             self, fn: Callable[[StatsStorageEvent], None]) -> None:
-        self._listeners.append(fn)
+        with self._lock:
+            self._listeners.append(fn)
 
     def deregister_stats_storage_listener(self, fn) -> None:
-        if fn in self._listeners:
-            self._listeners.remove(fn)
+        with self._lock:
+            if fn in self._listeners:
+                self._listeners.remove(fn)
 
     def _emit(self, event_type: str, r: Persistable) -> None:
         ev = StatsStorageEvent(event_type, r.session_id, r.type_id,
                                r.worker_id, r.timestamp)
-        for fn in list(self._listeners):
+        with self._lock:
+            listeners = list(self._listeners)
+        for fn in listeners:
             fn(ev)
 
     # -------------------------------------------------------- persistence

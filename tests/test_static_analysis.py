@@ -1,0 +1,658 @@
+"""graft-lint (deeplearning4j_tpu.analysis) — rule fixtures, suppression
+and baseline semantics, renderer round-trips, CLI exit codes, and the
+meta-test that the shipped tree lints clean under the CI gate.
+
+Every rule in the registry has at least one positive fixture (the rule
+fires) and one negative fixture (a near-miss the rule must stay quiet
+on) in FIXTURES below — a new rule without fixtures fails
+test_every_rule_has_fixtures.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from deeplearning4j_tpu.analysis import (
+    DEFAULT_HOT_PREFIXES, RULES, RUNTIME_RULE_HINTS, apply_baseline,
+    is_hot, lint_paths, lint_source, load_baseline, runtime_hint,
+    write_baseline,
+)
+from deeplearning4j_tpu.analysis.__main__ import main as lint_main
+from deeplearning4j_tpu.analysis.report import (
+    render_json, render_sarif, render_text, summarize,
+)
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def rules_of(src, *, hot=False, path="pkg/mod.py"):
+    return [f.rule for f in lint_source(textwrap.dedent(src),
+                                        path, hot=hot)]
+
+
+# --------------------------------------------------------------- fixtures
+# rule id -> list of (source, hot, fires?) cases; the first True case is
+# the positive fixture, the first False case the negative.
+
+FIXTURES = {
+    "GL000": [
+        ("def broken(:\n    pass\n", False, True),
+        ("x = 1\n", False, False),
+    ],
+    "GL001": [
+        ("""
+         import jax
+         @jax.jit
+         def f(x):
+             return float(x)
+         """, False, True),
+        ("""
+         import jax
+         @jax.jit
+         def f(x):
+             return float(x.shape[0])   # static under trace
+         """, False, False),
+    ],
+    "GL002": [
+        ("""
+         import jax
+         @jax.jit
+         def f(x):
+             return x.item()
+         """, False, True),
+        ("""
+         def host(x):
+             return x.item()            # not traced, not hot
+         """, False, False),
+    ],
+    "GL003": [
+        ("""
+         import jax
+         @jax.jit
+         def f(x):
+             if x > 0:
+                 return x
+             return -x
+         """, False, True),
+        ("""
+         import jax
+         @jax.jit
+         def f(x):
+             if x is None:              # identity test is host-static
+                 return 0
+             return x
+         """, False, False),
+    ],
+    "GL004": [
+        ("""
+         import jax
+         @jax.jit
+         def f(x):
+             assert x > 0
+             return x
+         """, False, True),
+        ("""
+         import jax
+         @jax.jit
+         def f(x):
+             assert x.ndim == 2         # shape metadata is static
+             return x
+         """, False, False),
+    ],
+    "GL005": [
+        ("""
+         import jax
+         @jax.jit
+         def f(x, n):
+             acc = x
+             for i in range(n):
+                 acc = acc + i
+             return acc
+         """, False, True),
+        ("""
+         import jax
+         @jax.jit
+         def f(x):
+             acc = x
+             for i in range(3):         # static trip count unrolls fine
+                 acc = acc + i
+             return acc
+         """, False, False),
+    ],
+    "GL101": [
+        ("""
+         import jax
+         from functools import partial
+         @partial(jax.jit, static_argnames=("cfg",))
+         def f(x, cfg=[]):
+             return x
+         """, False, True),
+        ("""
+         import jax
+         from functools import partial
+         @partial(jax.jit, static_argnames=("cfg",))
+         def f(x, cfg=()):
+             return x
+         """, False, False),
+    ],
+    "GL102": [
+        ("""
+         import jax
+         def run(x):
+             return jax.jit(lambda y: y + 1)(x)
+         """, False, True),
+        ("""
+         import jax
+         class Model:
+             def run(self, x):
+                 if self._jitted is None:
+                     self._jitted = jax.jit(self._step)  # cached once
+                 return self._jitted(x)
+         """, False, False),
+    ],
+    "GL103": [
+        ("""
+         import jax
+         def train(batches):
+             for b in batches:
+                 step = jax.jit(lambda y: y * 2)
+                 step(b)
+         """, False, True),
+        ("""
+         import jax
+         step = jax.jit(lambda y: y * 2)    # module level: compiled once
+         """, False, False),
+    ],
+    "GL201": [
+        ("""
+         import numpy as np
+         import jax.numpy as jnp
+         def report(x):
+             y = jnp.sum(x)
+             return np.asarray(y)
+         """, True, True),
+        ("""
+         import numpy as np
+         def report(request_json):
+             return np.asarray(request_json["rows"])   # host data
+         """, True, False),
+    ],
+    "GL202": [
+        ("""
+         import jax.numpy as jnp
+         def score(x):
+             return float(jnp.sum(x))
+         """, True, True),
+        ("""
+         import os
+         def workers():
+             return int(os.environ["N_WORKERS"])       # host int
+         """, True, False),
+    ],
+    "GL203": [
+        ("""
+         def wait(x):
+             x.block_until_ready()
+         """, True, True),
+        ("""
+         def wait(x):
+             x.block_until_ready()      # cold module: fine
+         """, False, False),
+    ],
+    "GL204": [
+        ("""
+         import jax.numpy as jnp
+         def log_loss(logger, x):
+             loss = jnp.mean(x)
+             logger.info("loss %s", loss)
+         """, True, True),
+        ("""
+         def log_n(logger, n):
+             logger.info("n %d", n)     # host scalar payload
+         """, True, False),
+    ],
+    "GL301": [
+        ("""
+         import threading
+         class Store:
+             def __init__(self):
+                 self._lock = threading.Lock()
+                 self.items = []
+             def add(self, x):
+                 self.items.append(x)
+         """, False, True),
+        ("""
+         import threading
+         class Store:
+             def __init__(self):
+                 self._lock = threading.Lock()
+                 self.items = []
+             def add(self, x):
+                 with self._lock:
+                     self.items.append(x)
+         """, False, False),
+    ],
+    "GL401": [
+        ("def f(x, acc=[]):\n    return acc\n", False, True),
+        ("def f(x, acc=None):\n    return acc\n", False, False),
+    ],
+    "GL402": [
+        ("""
+         def f():
+             try:
+                 return 1
+             except:
+                 return 0
+         """, False, True),
+        ("""
+         def f():
+             try:
+                 return 1
+             except Exception:
+                 return 0
+         """, False, False),
+    ],
+    "GL403": [
+        ("""
+         def f():
+             try:
+                 return 1
+             except ValueError:
+                 pass
+         """, False, True),
+        ("""
+         import logging
+         def f():
+             try:
+                 return 1
+             except ValueError:
+                 logging.exception("f failed")
+         """, False, False),
+    ],
+}
+
+
+def test_every_rule_has_fixtures():
+    assert len(RULES) >= 12
+    missing = set(RULES) - set(FIXTURES)
+    assert not missing, f"rules without fixtures: {sorted(missing)}"
+    for rid, cases in FIXTURES.items():
+        outcomes = {fires for _, _, fires in cases}
+        assert outcomes == {True, False}, \
+            f"{rid} needs both a positive and a negative fixture"
+
+
+@pytest.mark.parametrize(
+    "rid,src,hot,fires",
+    [(rid, src, hot, fires)
+     for rid, cases in sorted(FIXTURES.items())
+     for src, hot, fires in cases],
+    ids=lambda v: v if isinstance(v, str) and v.startswith("GL") else None)
+def test_rule_fixture(rid, src, hot, fires):
+    got = rules_of(src, hot=hot)
+    if fires:
+        assert rid in got, f"{rid} should fire; got {got}"
+    else:
+        assert rid not in got, f"{rid} must stay quiet; got {got}"
+
+
+# ----------------------------------------------------- traced-context IQ
+
+def test_wrapper_call_slots_mark_traced():
+    # function passed to lax.while_loop is traced even without @jit
+    src = """
+    import jax
+    from jax import lax
+    def cond(state):
+        if state[0] > 0:            # tracer branch inside traced body
+            return True
+        return False
+    def run(x):
+        return lax.while_loop(cond, lambda s: s, (x,))
+    """
+    assert "GL003" in rules_of(src)
+
+
+def test_host_result_jax_calls_are_not_devicey():
+    src = """
+    import jax
+    def split(x, sharding):
+        if jax.process_count() == 1:    # host int — not a sync
+            return jax.device_put(x, sharding)
+        return x
+    """
+    assert "GL202" not in rules_of(src, hot=True)
+
+
+def test_tree_map_is_transparent_to_devicey_taint():
+    src = """
+    import jax
+    import numpy as np
+    def mean_of_host(gathered):
+        m = jax.tree_util.tree_map(lambda g: g.mean(axis=0), gathered)
+        return float(m["s"])            # host numpy stays host
+    """
+    assert "GL202" not in rules_of(src, hot=True)
+
+
+# ------------------------------------------------------------ suppression
+
+HOT_SYNC_SRC = """
+import jax.numpy as jnp
+def score(x):
+    y = jnp.sum(x)
+    return float(y){comment}
+"""
+
+
+def test_allow_sync_with_reason_suppresses():
+    src = HOT_SYNC_SRC.format(
+        comment="  # graft: allow-sync(once per epoch)")
+    assert rules_of(src, hot=True) == []
+
+
+def test_allow_sync_without_reason_does_not_suppress():
+    src = HOT_SYNC_SRC.format(comment="  # graft: allow-sync()")
+    assert "GL202" in rules_of(src, hot=True)
+
+
+def test_allow_sync_comment_line_above():
+    src = """
+    import jax.numpy as jnp
+    def score(x):
+        y = jnp.sum(x)
+        # graft: allow-sync(final readback)
+        return float(y)
+    """
+    assert rules_of(src, hot=True) == []
+
+
+def test_allow_sync_does_not_cover_tracer_rules():
+    src = """
+    import jax
+    @jax.jit
+    def f(x):
+        # graft: allow-sync(not a sync rule)
+        if x > 0:
+            return x
+        return -x
+    """
+    assert "GL003" in rules_of(src)
+
+
+def test_allow_rule_same_line():
+    src = """
+    def f():
+        try:
+            return 1
+        except ValueError:  # graft: allow(GL403): drain-until-empty
+            pass
+    """
+    assert rules_of(src) == []
+
+
+def test_allow_rule_comment_block_above():
+    # the directive may sit anywhere in the contiguous comment block
+    # directly above the flagged line (multi-line reasons)
+    src = """
+    import jax
+    def train(batches):
+        for b in batches:
+            @jax.jit
+            # graft: allow(GL103): one program per layer by
+            # design -- layerwise pretraining compiles each once
+            def step(y):
+                return y * 2
+            step(b)
+    """
+    assert "GL103" not in rules_of(src)
+
+
+def test_allow_wrong_rule_id_does_not_suppress():
+    src = """
+    def f():
+        try:
+            return 1
+        except ValueError:  # graft: allow(GL402): wrong id
+            pass
+    """
+    assert "GL403" in rules_of(src)
+
+
+# --------------------------------------------------------------- baseline
+
+def _two_findings_src(pad=0):
+    return ("\n" * pad) + textwrap.dedent("""
+    def f():
+        try:
+            return 1
+        except ValueError:
+            pass
+
+    def g():
+        try:
+            return 2
+        except KeyError:
+            pass
+    """)
+
+
+def test_baseline_roundtrip_and_budget(tmp_path):
+    findings = lint_source(_two_findings_src(), "a.py")
+    assert len(findings) == 2
+    bl_path = str(tmp_path / "bl.json")
+    doc = write_baseline(findings, bl_path)
+    assert doc["version"] == 1
+    loaded = load_baseline(bl_path)
+    new, used = apply_baseline(findings, loaded)
+    assert new == [] and used == 2
+    # a third identical finding exceeds the per-key budget
+    tripled = findings + [findings[0]]
+    new, used = apply_baseline(tripled, loaded)
+    assert used == 2 and len(new) == 1
+
+
+def test_baseline_is_line_number_insensitive(tmp_path):
+    bl_path = str(tmp_path / "bl.json")
+    write_baseline(lint_source(_two_findings_src(), "a.py"), bl_path)
+    shifted = lint_source(_two_findings_src(pad=7), "a.py")
+    new, used = apply_baseline(shifted, load_baseline(bl_path))
+    assert new == [] and used == 2
+
+
+def test_baseline_version_check(tmp_path):
+    p = tmp_path / "bl.json"
+    p.write_text(json.dumps({"version": 99, "findings": []}))
+    with pytest.raises(ValueError):
+        load_baseline(str(p))
+
+
+# -------------------------------------------------------------- renderers
+
+def _sample_findings():
+    return lint_source(_two_findings_src(), "pkg/sample.py")
+
+
+def test_json_roundtrip():
+    findings = _sample_findings()
+    doc = json.loads(render_json(findings, files=1, baselined=3))
+    assert doc["tool"] == "graft-lint"
+    s = doc["summary"]
+    assert s["findings"] == len(findings) == 2
+    assert s["files"] == 1 and s["baselined"] == 3
+    assert s["by_rule"] == {"GL403": 2}
+    for f, d in zip(findings, doc["findings"]):
+        assert d["rule"] == f.rule and d["line"] == f.line
+        assert d["path"] == "pkg/sample.py"
+
+
+def test_sarif_shape():
+    findings = _sample_findings()
+    doc = json.loads(render_sarif(findings, files=1))
+    assert doc["version"] == "2.1.0"
+    run = doc["runs"][0]
+    assert run["tool"]["driver"]["name"] == "graft-lint"
+    assert len(run["results"]) == len(findings)
+    declared = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    for res in run["results"]:
+        assert res["ruleId"] in declared
+        loc = res["locations"][0]["physicalLocation"]
+        assert loc["artifactLocation"]["uri"] == "pkg/sample.py"
+        assert loc["region"]["startLine"] >= 1
+
+
+def test_text_render_mentions_location_and_summary():
+    out = render_text(_sample_findings(), files=1)
+    assert "pkg/sample.py:" in out and "GL403" in out
+    assert "2 finding(s)" in out
+
+
+def test_summarize_counts_severities():
+    s = summarize(_sample_findings())
+    assert s["errors"] == 0 and s["warnings"] == 2
+
+
+# -------------------------------------------------------------------- CLI
+
+def _write(tmp_path, name, src):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src))
+    return str(p)
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    clean = _write(tmp_path, "clean.py", "x = 1\n")
+    err = _write(tmp_path, "err.py", """
+        import jax
+        @jax.jit
+        def f(x):
+            return float(x)
+        """)
+    warn = _write(tmp_path, "warn.py", """
+        def f(x, acc=[]):
+            return acc
+        """)
+    assert lint_main([clean]) == 0
+    assert lint_main([err]) == 1
+    assert lint_main([warn]) == 0          # warnings pass by default
+    assert lint_main([warn, "--strict"]) == 1
+    assert lint_main([clean, "--baseline", str(tmp_path / "nope.json")]) == 2
+    capsys.readouterr()
+
+
+def test_cli_baseline_gate(tmp_path, capsys):
+    err = _write(tmp_path, "err.py", """
+        import jax
+        @jax.jit
+        def f(x):
+            return float(x)
+        """)
+    bl = str(tmp_path / "bl.json")
+    assert lint_main([err, "--write-baseline", bl]) == 0
+    assert lint_main([err, "--strict", "--baseline", bl]) == 0
+    out = capsys.readouterr().out
+    assert "1 baselined" in out
+
+
+def test_cli_select_ignore_and_formats(tmp_path, capsys):
+    mixed = _write(tmp_path, "mixed.py", """
+        import jax
+        @jax.jit
+        def f(x, acc=[]):
+            return float(x)
+        """)
+    assert lint_main([mixed, "--select", "GL4", "--strict"]) == 1
+    capsys.readouterr()
+    assert lint_main([mixed, "--ignore", "GL0,GL4"]) == 0
+    capsys.readouterr()
+    assert lint_main([mixed, "--format", "json"]) == 1
+    doc = json.loads(capsys.readouterr().out)
+    assert {f["rule"] for f in doc["findings"]} == {"GL001", "GL401"}
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rid in RULES:
+        assert rid in out
+
+
+def test_hot_prefix_override(tmp_path, capsys):
+    hot_src = """
+        import jax.numpy as jnp
+        def score(x):
+            return float(jnp.sum(x))
+        """
+    cold = _write(tmp_path, "cold.py", hot_src)
+    assert lint_main([cold]) == 0
+    assert lint_main([cold, "--hot-prefix", str(tmp_path)]) == 1
+    capsys.readouterr()
+
+
+def test_is_hot_prefixes():
+    assert is_hot("deeplearning4j_tpu/optim/solvers.py",
+                  DEFAULT_HOT_PREFIXES)
+    assert not is_hot("deeplearning4j_tpu/nlp/glove.py",
+                      DEFAULT_HOT_PREFIXES)
+
+
+# ------------------------------------------------- runtime cross-check
+
+def test_runtime_hint_strings():
+    assert runtime_hint("recompile") == "GL101/GL102/GL103"
+    assert runtime_hint("host_sync") == "GL001/GL002/GL201/GL202/GL203"
+    assert runtime_hint("unknown") == ""
+    for kind, rids in RUNTIME_RULE_HINTS.items():
+        for rid in rids:
+            assert rid in RULES, (kind, rid)
+
+
+def test_watchdog_snapshot_carries_static_rules():
+    from deeplearning4j_tpu.observe.watchdog import RecompileWatchdog
+    wd = RecompileWatchdog(threshold=2)
+    wd.record_compile("tag", "Cls", (1, 2))
+    assert wd.snapshot()["static_rules"] == runtime_hint("recompile")
+
+
+def test_syncmon_snapshot_carries_static_rules():
+    from deeplearning4j_tpu.observe.syncmon import HostSyncMonitor
+    snap = HostSyncMonitor().snapshot()
+    assert snap["static_rules"] == runtime_hint("host_sync")
+    assert snap["total"] == 0
+
+
+def test_watchdog_warning_names_lint_rules(caplog):
+    import logging
+    from deeplearning4j_tpu.observe.watchdog import RecompileWatchdog
+    wd = RecompileWatchdog(threshold=2)
+    with caplog.at_level(logging.WARNING, logger="deeplearning4j_tpu"):
+        wd.record_compile("tag", "Cls", (1,))
+        wd.record_compile("tag", "Cls", (2,))
+    assert any("GL101/GL102/GL103" in r.getMessage()
+               for r in caplog.records)
+
+
+# ------------------------------------------------------------- meta-test
+
+def test_repo_lints_clean_under_ci_gate():
+    """The shipped tree passes the exact gate tools/ci_check.sh runs."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "deeplearning4j_tpu.analysis",
+         "deeplearning4j_tpu", "tests", "--strict",
+         "--baseline", ".graftlint-baseline.json"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, \
+        f"graft-lint gate failed:\n{proc.stdout}\n{proc.stderr}"
+
+
+def test_lint_paths_filters_and_sorts(tmp_path):
+    _write(tmp_path, "b.py", "def f(x, acc=[]):\n    return acc\n")
+    _write(tmp_path, "a.py", "def g(x, acc={}):\n    return acc\n")
+    found = lint_paths([str(tmp_path)])
+    assert [f.rule for f in found] == ["GL401", "GL401"]
+    assert found[0].path <= found[1].path
+    assert lint_paths([str(tmp_path)], ignore=["GL4"]) == []
+    assert len(lint_paths([str(tmp_path)], select=["GL401"])) == 2

@@ -1,0 +1,21 @@
+#!/usr/bin/env bash
+# Repo CI gate: static analysis first (cheap, jax-free), then the
+# tier-1 test suite. Mirrors ROADMAP.md's tier-1 command.
+#
+#   tools/ci_check.sh            # full gate
+#   tools/ci_check.sh --lint     # lint gate only (seconds)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+echo "== graft-lint (--strict, baselined) =="
+python -m deeplearning4j_tpu.analysis deeplearning4j_tpu tests \
+    --strict --baseline .graftlint-baseline.json
+
+if [[ "${1:-}" == "--lint" ]]; then
+    exit 0
+fi
+
+echo "== tier-1 tests =="
+env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q \
+    -m 'not slow' --continue-on-collection-errors \
+    -p no:cacheprovider -p no:xdist -p no:randomly

@@ -1,0 +1,75 @@
+#!/usr/bin/env python3
+"""graft-lint digest — run the analyzer over the repo (or given paths)
+and print a by-category / by-rule / worst-files table, from the tools/
+directory like the other debugging utilities here.
+
+    tools/lint_report.py                     # whole repo, with baseline
+    tools/lint_report.py deeplearning4j_tpu/serving --no-baseline
+    tools/lint_report.py --json              # machine-readable digest
+"""
+
+import argparse
+import json
+import os
+import sys
+from collections import Counter
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from deeplearning4j_tpu.analysis import (            # noqa: E402
+    RULES, apply_baseline, lint_paths, load_baseline,
+)
+
+DEFAULT_BASELINE = ".graftlint-baseline.json"
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("paths", nargs="*",
+                    default=["deeplearning4j_tpu", "tests"])
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report raw findings, including baselined ones")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--json", action="store_true")
+    ap.add_argument("--top", type=int, default=10,
+                    help="worst-files rows to show (default 10)")
+    args = ap.parse_args(argv)
+
+    findings = lint_paths(args.paths)
+    baselined = 0
+    if not args.no_baseline and os.path.exists(args.baseline):
+        findings, baselined = apply_baseline(
+            findings, load_baseline(args.baseline))
+
+    by_rule = Counter(f.rule for f in findings)
+    by_cat = Counter(RULES[f.rule].category for f in findings)
+    by_file = Counter(f.path for f in findings)
+
+    if args.json:
+        json.dump({"tool": "graft-lint", "baselined": baselined,
+                   "findings": len(findings),
+                   "by_category": dict(sorted(by_cat.items())),
+                   "by_rule": dict(sorted(by_rule.items())),
+                   "by_file": dict(by_file.most_common())},
+                  sys.stdout, indent=1, sort_keys=True)
+        print()
+        return 0
+
+    print(f"graft-lint digest: {len(findings)} finding(s), "
+          f"{baselined} baselined")
+    if by_cat:
+        print("\n  by category:")
+        for cat, n in by_cat.most_common():
+            print(f"    {cat:<10} {n}")
+        print("\n  by rule:")
+        for rid, n in sorted(by_rule.items()):
+            r = RULES[rid]
+            print(f"    {rid} {r.name:<26} {n:>4}  [{r.severity}]")
+        print(f"\n  worst files (top {args.top}):")
+        for path, n in by_file.most_common(args.top):
+            print(f"    {n:>4}  {path}")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
