@@ -40,6 +40,16 @@ from deeplearning4j_tpu.observe.watchdog import (
     RecompileWatchdog, WatchedJitCache, get_watchdog, set_watchdog,
 )
 from deeplearning4j_tpu.observe.syncmon import HostSyncMonitor, current_monitor
+from deeplearning4j_tpu.observe.flight import (
+    FlightRecorder, get_flight, set_flight,
+)
+from deeplearning4j_tpu.observe.devicemon import (
+    DeviceMonitor, device_memory_summary, get_device_monitor,
+    maybe_start_monitor, set_device_monitor,
+)
+from deeplearning4j_tpu.observe.attribution import (
+    StepAttribution, attribution_enabled,
+)
 
 __all__ = [
     "MetricsRegistry", "get_registry", "set_registry",
@@ -47,4 +57,8 @@ __all__ = [
     "tracing_enabled", "read_spans", "emit_manual_span",
     "RecompileWatchdog", "WatchedJitCache", "get_watchdog", "set_watchdog",
     "HostSyncMonitor", "current_monitor",
+    "FlightRecorder", "get_flight", "set_flight",
+    "DeviceMonitor", "device_memory_summary", "get_device_monitor",
+    "maybe_start_monitor", "set_device_monitor",
+    "StepAttribution", "attribution_enabled",
 ]

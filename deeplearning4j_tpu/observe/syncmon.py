@@ -135,6 +135,7 @@ class HostSyncMonitor:
                     get_registry,
                 )
                 self._metrics = get_registry()
+        self._flight_mark("syncmon_installed")
         return self
 
     def uninstall(self) -> None:
@@ -146,6 +147,20 @@ class HostSyncMonitor:
                 _monitors.remove(self)
             if not _monitors and _originals is not None:
                 _unpatch()
+        self._flight_mark("syncmon_uninstalled")
+
+    def _flight_mark(self, kind: str) -> None:
+        """Lifecycle breadcrumb in the crash ring: a dump that shows
+        sync counts should also show when counting was on."""
+        try:
+            from deeplearning4j_tpu.observe.flight import get_flight
+            with self._count_lock:
+                total = self.float_syncs + self.block_syncs
+            get_flight().record(kind, total_syncs=total)
+        # graft: allow(GL403): lifecycle breadcrumb must never break
+        # monitor install/uninstall
+        except Exception:
+            pass
 
     def __enter__(self) -> "HostSyncMonitor":
         return self.install()

@@ -34,6 +34,17 @@ _tls = threading.local()
 _active_log: Optional["SpanLog"] = None
 _install_lock = threading.Lock()
 
+# FlightRecorder ring (observe/flight.py) — when installed, every span
+# event is ALSO appended to the crash ring, even with no SpanLog active.
+# Set via _set_flight_sink (flight.py wires it) so this module never
+# imports flight (no cycle).
+_flight_sink = None
+
+
+def _set_flight_sink(sink) -> None:
+    global _flight_sink
+    _flight_sink = sink
+
 _PLAIN = (str, int, float, bool, type(None))
 
 
@@ -106,9 +117,11 @@ def _stack() -> List[int]:
 def span(name: str, /, **attrs) -> Iterator[Optional[dict]]:
     """Time a host-side region. Yields the (mutable) attrs dict when
     tracing is enabled so callers can add results discovered inside the
-    span (host values only), or None when disabled."""
+    span (host values only), or None when disabled (a flight ring alone
+    keeps the None yield — the no-SpanLog contract is pinned)."""
     log = _active_log
-    if log is None:
+    fr = _flight_sink
+    if log is None and fr is None:
         yield None
         return
     sid = next(_ids)
@@ -118,15 +131,19 @@ def span(name: str, /, **attrs) -> Iterator[Optional[dict]]:
     ts = time.time()
     t0 = time.perf_counter()
     try:
-        yield attrs
+        yield attrs if log is not None else None
     finally:
         dur = (time.perf_counter() - t0) * 1e3
         st.pop()
-        log.emit({"name": name, "ts": round(ts, 6),
-                  "dur_ms": round(dur, 4), "span_id": sid,
-                  "parent_id": parent,
-                  "thread": threading.current_thread().name,
-                  "attrs": _sanitize(attrs)})
+        event = {"name": name, "ts": round(ts, 6),
+                 "dur_ms": round(dur, 4), "span_id": sid,
+                 "parent_id": parent,
+                 "thread": threading.current_thread().name,
+                 "attrs": _sanitize(attrs)}
+        if log is not None:
+            log.emit(event)
+        if fr is not None:
+            fr.record_event("span", event)
 
 
 def emit_manual_span(name: str, t_start: float, t_end: float, /,
@@ -135,15 +152,20 @@ def emit_manual_span(name: str, t_start: float, t_end: float, /,
     seconds, e.g. a jax.profiler capture window bracketed by listener
     callbacks)."""
     log = _active_log
-    if log is None:
+    fr = _flight_sink
+    if log is None and fr is None:
         return
     st = _stack()
-    log.emit({"name": name, "ts": round(t_start, 6),
-              "dur_ms": round((t_end - t_start) * 1e3, 4),
-              "span_id": next(_ids),
-              "parent_id": st[-1] if st else None,
-              "thread": threading.current_thread().name,
-              "attrs": _sanitize(attrs)})
+    event = {"name": name, "ts": round(t_start, 6),
+             "dur_ms": round((t_end - t_start) * 1e3, 4),
+             "span_id": next(_ids),
+             "parent_id": st[-1] if st else None,
+             "thread": threading.current_thread().name,
+             "attrs": _sanitize(attrs)}
+    if log is not None:
+        log.emit(event)
+    if fr is not None:
+        fr.record_event("span", event)
 
 
 def read_spans(path: str) -> List[dict]:

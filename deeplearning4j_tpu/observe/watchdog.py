@@ -36,6 +36,18 @@ DEFAULT_THRESHOLD = int(os.environ.get("DL4J_TPU_RECOMPILE_THRESHOLD", "10"))
 _MAX_SIGNATURES = 64   # per-owner bound on recorded shape signatures
 
 
+def _flight():
+    """The crash ring, or None — watchdog events are breadcrumbs, never
+    load-bearing, so any flight failure is swallowed here."""
+    try:
+        from deeplearning4j_tpu.observe.flight import get_flight
+        return get_flight()
+    # graft: allow(GL403): breadcrumbs are optional by design — compile
+    # accounting must survive a broken flight recorder
+    except Exception:
+        return None
+
+
 def _static_rules() -> str:
     """The graft-lint rules that flag recompile-churn patterns at review
     time — every watchdog warning names its static counterpart so the
@@ -57,6 +69,7 @@ class RecompileWatchdog:
         self._lock = threading.Lock()
         self._counts: Dict[str, int] = {}
         self._signatures: Dict[str, List[str]] = {}
+        self._costs: Dict[str, Dict[str, dict]] = {}
         self._warned: set = set()
 
     def _registry(self):
@@ -83,6 +96,11 @@ class RecompileWatchdog:
                 self._warned.add(owner_tag)
                 warn_count = n
         self._registry().counter("jit_compiles", owner=owner_class).inc()
+        fr = _flight()
+        if fr is not None:
+            # compiles are rare by construction — a ring breadcrumb each
+            fr.record("jit_compile", owner=owner_class, tag=owner_tag,
+                      key=repr(key)[:160])
         if warn_count is not None:
             with self._lock:
                 recent = self._signatures.get(owner_tag, [])[-5:]
@@ -98,6 +116,37 @@ class RecompileWatchdog:
                 "deeplearning4j_tpu.analysis).",
                 owner_tag, warn_count, self.threshold, recent,
                 _static_rules() or "n/a")
+            if fr is not None:
+                # threshold trip = the black-box moment: dump the ring so
+                # the churned signatures survive the run
+                fr.record("recompile_threshold_trip", owner=owner_class,
+                          tag=owner_tag, compiles=warn_count,
+                          threshold=self.threshold)
+                fr.dump("recompile_threshold")
+
+    def record_cost(self, owner_tag: str, owner_class: str, key,
+                    cost: dict) -> None:
+        """Attach an XLA cost report (flops / bytes_accessed /
+        peak_memory_bytes, absent keys omitted) to a compile — fed by
+        the `_CostProbe` the WatchedJitCache installs, or by
+        `utils.profiling.step_cost` on the AOT path."""
+        entry = {k: v for k, v in cost.items() if v is not None}
+        with self._lock:
+            costs = self._costs.setdefault(owner_tag, {})
+            sig = repr(key)
+            if len(costs) < _MAX_SIGNATURES or sig in costs:
+                costs[sig] = entry
+        reg = self._registry()
+        if entry.get("flops"):
+            reg.counter("jit_compile_flops_total",
+                        owner=owner_class).inc(entry["flops"])
+        if entry.get("bytes_accessed"):
+            reg.counter("jit_compile_bytes_total",
+                        owner=owner_class).inc(entry["bytes_accessed"])
+        fr = _flight()
+        if fr is not None:
+            fr.record("compile_cost", owner=owner_class, tag=owner_tag,
+                      key=repr(key)[:160], **entry)
 
     # --------------------------------------------------------- reporting
     def compiles(self, owner_tag: Optional[str] = None) -> int:
@@ -115,6 +164,7 @@ class RecompileWatchdog:
                 "per_owner": {
                     tag: {"compiles": n,
                           "signatures": list(self._signatures.get(tag, ())),
+                          "costs": dict(self._costs.get(tag, {})),
                           "warned": tag in self._warned}
                     for tag, n in self._counts.items()},
             }
@@ -123,13 +173,115 @@ class RecompileWatchdog:
         with self._lock:
             self._counts.clear()
             self._signatures.clear()
+            self._costs.clear()
             self._warned.clear()
+
+
+def _cost_probe_enabled() -> bool:
+    return os.environ.get("DL4J_TPU_COMPILE_COST", "1") != "0"
+
+
+_cost_failure_logged = False
+
+
+def note_cost_analysis_failure(detail: str) -> None:
+    """Cost analysis breaking must be visible, not silent (before this,
+    `step_flops` swallowed every exception and MFU just disappeared):
+    DEBUG-log the first failure, count every one — and never raise on a
+    training path."""
+    global _cost_failure_logged
+    try:
+        from deeplearning4j_tpu.observe.registry import get_registry
+        get_registry().counter("profiling_cost_analysis_failures").inc()
+    # graft: allow(GL403): the counter is the reporting channel — if the
+    # registry itself is broken, the DEBUG log below still fires
+    except Exception:
+        pass
+    if not _cost_failure_logged:
+        _cost_failure_logged = True
+        logger.debug(
+            "compile cost analysis unavailable (%s); further failures "
+            "are counted in profiling_cost_analysis_failures", detail)
+
+
+def _arg_specs(args, kw):
+    """ShapeDtypeStructs for the array arguments of a jit call (non-array
+    leaves pass through untouched, so static args keep their values)."""
+    try:
+        import jax
+
+        def spec(x):
+            if hasattr(x, "shape") and hasattr(x, "dtype"):
+                return jax.ShapeDtypeStruct(x.shape, x.dtype)
+            return x
+
+        return jax.tree_util.tree_map(spec, (args, kw))
+    except Exception:
+        note_cost_analysis_failure("argument spec capture failed")
+        return None
+
+
+def _record_lowered_cost(fn, specs, owner_tag, owner_class, key) -> None:
+    try:
+        spec_args, spec_kw = specs
+        cost = fn.lower(*spec_args, **spec_kw).cost_analysis()
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        cost = cost or {}
+        get_watchdog().record_cost(owner_tag, owner_class, key, {
+            "flops": float(cost.get("flops") or 0.0),
+            "bytes_accessed": float(cost.get("bytes accessed") or 0.0),
+        })
+    except Exception as e:
+        note_cost_analysis_failure(
+            f"lowering cost analysis failed: {type(e).__name__}")
+
+
+class _CostProbe:
+    """Transparent wrapper around a cached jit callable that, on its
+    FIRST invocation, AOT-lowers the same function against the call's
+    shape specs and records the XLA cost report with the watchdog — so
+    every first-time compile the watchdog counts also carries what it
+    costs.
+
+    Why at call time, not insert time: insertion sees only the callable;
+    lowering needs the concrete argument avals. Why specs are captured
+    BEFORE the call runs: donated input buffers are deleted by the call
+    itself. `Lowered.cost_analysis()` traces but does not compile, so
+    the one-time probe costs one extra trace, never a second XLA
+    compile — and nothing it touches can force a device sync."""
+
+    __slots__ = ("fn", "_owner_tag", "_owner_class", "_key", "_done",
+                 "_lock")
+
+    def __init__(self, fn, owner_tag, owner_class, key):
+        self.fn = fn
+        self._owner_tag = owner_tag
+        self._owner_class = owner_class
+        self._key = key
+        self._done = False
+        self._lock = threading.Lock()
+
+    def __call__(self, *args, **kw):
+        with self._lock:
+            probe, self._done = (not self._done), True
+        specs = _arg_specs(args, kw) if probe else None
+        out = self.fn(*args, **kw)
+        if specs is not None:
+            _record_lowered_cost(self.fn, specs, self._owner_tag,
+                                 self._owner_class, self._key)
+        return out
+
+    def __getattr__(self, name):
+        return getattr(self.fn, name)
 
 
 class WatchedJitCache(dict):
     """A jit-cache dict that reports first-time insertions (= compiles)
-    to the watchdog. Holds only the owner's tag strings, never the owner
-    itself — a cache must not keep its model alive."""
+    to the watchdog, wrapping jit callables in a one-shot `_CostProbe`
+    so the compile's XLA cost is recorded too. Holds only the owner's
+    tag strings, never the owner itself — a cache must not keep its
+    model alive."""
 
     __slots__ = ("owner_tag", "owner_class")
 
@@ -146,13 +298,17 @@ class WatchedJitCache(dict):
         if key not in self:
             get_watchdog().record_compile(
                 self.owner_tag, self.owner_class, key)
+            if (_cost_probe_enabled() and callable(value)
+                    and hasattr(value, "lower")
+                    and not isinstance(value, _CostProbe)):
+                value = _CostProbe(value, self.owner_tag,
+                                   self.owner_class, key)
         super().__setitem__(key, value)
 
     def setdefault(self, key, default=None):
         if key not in self:
             self[key] = default      # route through __setitem__
-            return default
-        return self[key]
+        return self[key]             # the stored (possibly probed) value
 
     def update(self, *args, **kw):
         for k, v in dict(*args, **kw).items():

@@ -32,6 +32,11 @@ import time
 from typing import Any, Callable, List, Optional
 
 from deeplearning4j_tpu.observe import get_registry, span
+from deeplearning4j_tpu.observe.attribution import (
+    StepAttribution, attribution_enabled,
+)
+from deeplearning4j_tpu.observe.devicemon import maybe_start_monitor
+from deeplearning4j_tpu.observe.flight import get_flight
 
 __all__ = ["LossTracker", "TrainingExecutor", "SKIP", "STOP"]
 
@@ -66,6 +71,10 @@ class LossTracker:
         self._since_sync = 0
         self.host_syncs = 0     # device materializations (perf-guard seam)
         self.updates = 0
+        # attribution seam: fn(block_ms) invoked after a device loss
+        # materializes, with how long the float() blocked — THE measured
+        # device boundary StepAttribution infers device time from
+        self.on_block: Optional[Callable[[float], None]] = None
 
     def set(self, loss) -> None:
         """Overwrite the tracked loss without counting an update (the
@@ -86,9 +95,18 @@ class LossTracker:
         if self._raw is None:
             return None
         if self._cached is None:
-            if _is_device_array(self._raw):
+            blocked = _is_device_array(self._raw)
+            if blocked:
                 self.host_syncs += 1
+            t0 = time.perf_counter()
             self._cached = float(self._raw)
+            if blocked and self.on_block is not None:
+                try:
+                    self.on_block((time.perf_counter() - t0) * 1e3)
+                # graft: allow(GL403): attribution must never break the
+                # fit loop; the loss value below is the payload
+                except Exception:
+                    pass
             self._since_sync = 0
         return self._cached
 
@@ -152,6 +170,7 @@ class TrainingExecutor:
         self.epoch_start = epoch_start
         self.epoch_end = epoch_end
         self.stopped = False
+        self._attr: Optional[StepAttribution] = None
         reg = get_registry()
         self._iter_counter = reg.counter("train_iterations")
         self._etl_hist = reg.histogram("train_etl_ms")
@@ -165,60 +184,88 @@ class TrainingExecutor:
         reg = get_registry()
         self._iter_counter = reg.counter("train_iterations")
         self._etl_hist = reg.histogram("train_etl_ms")
-        with span("fit", epochs=epochs, start_epoch=start_epoch,
-                  steps_per_dispatch=self.k):
-            for l in listeners:
-                l.on_fit_start(net)
-            self.stopped = False
-            for _ in range(start_epoch, epochs):
-                with span("fit.epoch", epoch=net.epoch):
-                    if self.epoch_start is not None:
-                        self.epoch_start()
-                    for l in listeners:
-                        l.on_epoch_start(net, net.epoch)
-                    buf: List = []
-                    etl_start = time.perf_counter()
-                    for bi, ds in enumerate(iter(iterable)):
-                        etl_ms = (time.perf_counter() - etl_start) * 1e3
-                        if self.before_batch is not None:
-                            ds = self.before_batch(bi, ds)
-                            if ds is SKIP:
-                                etl_start = time.perf_counter()
-                                continue
-                            if ds is STOP:
-                                self.stopped = True
-                                break
-                        fusible = (self.k > 1 and self.fused_step is not None
-                                   and self.can_fuse(ds))
-                        if fusible and buf and \
-                                batch_signature(buf[0][1]) != \
-                                batch_signature(ds):
-                            self._drain(buf)
-                            buf = []
-                        if fusible:
-                            buf.append((bi, ds, etl_ms))
-                            if len(buf) == self.k:
-                                self._run_fused(buf)
-                                buf = []
-                        else:
-                            self._drain(buf)
-                            buf = []
-                            self._finish(bi, self.step(ds), etl_ms)
+        # black box + device telemetry: wire the span ring before the
+        # first fit span so a crash dump carries this run from the start
+        flight = get_flight()
+        maybe_start_monitor()
+        tracker = getattr(net, "_loss_tracker", None)
+        attr = None
+        if attribution_enabled() and tracker is not None:
+            attr = StepAttribution(reg)
+            # PerformanceListener reads the measured device step time
+            # (MFU denominator) from here
+            net._attribution = attr
+            tracker.on_block = attr.on_device_block
+        self._attr = attr
+        try:
+            with span("fit", epochs=epochs, start_epoch=start_epoch,
+                      steps_per_dispatch=self.k):
+                for l in listeners:
+                    l.on_fit_start(net)
+                self.stopped = False
+                for _ in range(start_epoch, epochs):
+                    with span("fit.epoch", epoch=net.epoch):
+                        if self.epoch_start is not None:
+                            self.epoch_start()
+                        for l in listeners:
+                            l.on_epoch_start(net, net.epoch)
+                        buf: List = []
                         etl_start = time.perf_counter()
-                    self._drain(buf)
-                    if self.stopped:
-                        break
-                    for l in listeners:
-                        l.on_epoch_end(net, net.epoch)
-                    net.epoch += 1
-                    if self.epoch_end is not None:
-                        self.epoch_end()
-                    # the ONE guaranteed materialization per epoch: score_
-                    # is a float at every epoch boundary without per-step
-                    # syncs
-                    net._loss_tracker.materialize()
-            for l in listeners:
-                l.on_fit_end(net)
+                        for bi, ds in enumerate(iter(iterable)):
+                            etl_ms = (time.perf_counter() - etl_start) * 1e3
+                            if self.before_batch is not None:
+                                ds = self.before_batch(bi, ds)
+                                if ds is SKIP:
+                                    etl_start = time.perf_counter()
+                                    continue
+                                if ds is STOP:
+                                    self.stopped = True
+                                    break
+                            fusible = (self.k > 1
+                                       and self.fused_step is not None
+                                       and self.can_fuse(ds))
+                            if fusible and buf and \
+                                    batch_signature(buf[0][1]) != \
+                                    batch_signature(ds):
+                                self._drain(buf)
+                                buf = []
+                            if fusible:
+                                buf.append((bi, ds, etl_ms))
+                                if len(buf) == self.k:
+                                    self._run_fused(buf)
+                                    buf = []
+                            else:
+                                self._drain(buf)
+                                buf = []
+                                t_d = time.perf_counter()
+                                loss = self.step(ds)
+                                dispatch_ms = (time.perf_counter()
+                                               - t_d) * 1e3
+                                self._finish(bi, loss, etl_ms, dispatch_ms)
+                            etl_start = time.perf_counter()
+                        self._drain(buf)
+                        if self.stopped:
+                            break
+                        for l in listeners:
+                            l.on_epoch_end(net, net.epoch)
+                        net.epoch += 1
+                        if self.epoch_end is not None:
+                            self.epoch_end()
+                        # the ONE guaranteed materialization per epoch:
+                        # score_ is a float at every epoch boundary
+                        # without per-step syncs — and the block boundary
+                        # attribution infers device time from
+                        net._loss_tracker.materialize()
+                for l in listeners:
+                    l.on_fit_end(net)
+        except BaseException as e:
+            # the crash the flight recorder exists for: dump the ring
+            # (recent spans, compiles, device memory) next to the error
+            flight.dump("training_exception", exc=e)
+            raise
+        finally:
+            if tracker is not None:
+                tracker.on_block = None
         return net
 
     # ---------------------------------------------------------- helpers
@@ -226,20 +273,27 @@ class TrainingExecutor:
         """Flush a partial fusion buffer through the per-step path (a
         short tail would need its own K'-sized compile)."""
         for bi, ds, etl_ms in buf:
-            self._finish(bi, self.step(ds), etl_ms)
+            t_d = time.perf_counter()
+            loss = self.step(ds)
+            dispatch_ms = (time.perf_counter() - t_d) * 1e3
+            self._finish(bi, loss, etl_ms, dispatch_ms)
 
     def _run_fused(self, buf) -> None:
+        t_d = time.perf_counter()
         losses = self.fused_step([ds for _, ds, _ in buf])
+        # one dispatch for K steps: attribute its enqueue cost evenly
+        dispatch_ms = (time.perf_counter() - t_d) * 1e3 / len(buf)
         for j, (bi, ds, etl_ms) in enumerate(buf):
             # losses[j] stays on device — indexing does not sync
-            self._finish(bi, losses[j], etl_ms)
+            self._finish(bi, losses[j], etl_ms, dispatch_ms)
 
-    def _finish(self, bi, loss, etl_ms) -> None:
+    def _finish(self, bi, loss, etl_ms, dispatch_ms: float = 0.0) -> None:
         net = self.net
         net._loss_tracker.update(loss)
         net.iteration += 1
         self._iter_counter.inc()
         self._etl_hist.observe(etl_ms)
+        t_h = time.perf_counter()
         for l in net.listeners:
             if hasattr(l, "set_etl_time"):
                 l.set_etl_time(etl_ms)
@@ -247,3 +301,7 @@ class TrainingExecutor:
                              net._loss_tracker.peek())
         if self.after_step is not None:
             self.after_step(bi)
+        attr = self._attr
+        if attr is not None:
+            host_ms = (time.perf_counter() - t_h) * 1e3
+            attr.record_iteration(etl_ms, dispatch_ms, host_ms)

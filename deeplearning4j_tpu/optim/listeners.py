@@ -98,15 +98,21 @@ class PerformanceListener(TrainingListener):
                 from deeplearning4j_tpu.utils.profiling import peak_flops as \
                     _peak
                 # step_flops is the GLOBAL step's HLO count, so the default
-                # peak must cover every participating chip
+                # peak must cover every participating chip. An unknown
+                # device kind leaves peak_flops None — peak_flops() warns
+                # once naming the kind, and the MFU gauge is OMITTED
+                # below instead of publishing NaN.
                 per_chip = _peak()
                 if per_chip:
                     peak_flops = per_chip * jax.device_count()
             except Exception:
                 peak_flops = None
+        if peak_flops is not None and not peak_flops > 0:
+            peak_flops = None      # NaN/0/negative: same no-gauge path
         self.peak_flops = peak_flops
         self.last_mfu: Optional[float] = None
         self.last_step_ms: Optional[float] = None
+        self.last_device_step_ms: Optional[float] = None
         self.last_syncs_per_step: Optional[float] = None
         from deeplearning4j_tpu.observe import get_registry
 
@@ -135,17 +141,30 @@ class PerformanceListener(TrainingListener):
             self.last_step_ms = dt / n_batches * 1e3
             self._g_sps.set(self.last_samples_per_sec)
             self._g_step_ms.set(self.last_step_ms)
+            # measured device step time from the attribution window (the
+            # executor parks its StepAttribution on the model) — absent
+            # until a window has closed or when attribution is off
+            attr = getattr(model, "_attribution", None)
+            dev_ms = (attr.last_device_step_ms()
+                      if attr is not None else None)
+            self.last_device_step_ms = dev_ms
             msg = (f"iteration {iteration}: "
                    f"{self.last_samples_per_sec:.1f} samples/sec, "
                    f"{self.last_batches_per_sec:.2f} batches/sec, "
                    f"{self.last_step_ms:.1f} ms/step, "
                    f"ETL {self.last_etl_ms:.1f} ms")
+            if dev_ms:
+                msg += f", device {dev_ms:.2f} ms/step"
             if self.flops_per_step and self.peak_flops:
-                self.last_mfu = (self.flops_per_step
-                                 * self.last_batches_per_sec
+                # MFU over MEASURED device time when attribution has it
+                # (wall time charges the device for host stalls); wall
+                # step time is the fallback denominator
+                step_s = dev_ms / 1e3 if dev_ms else dt / n_batches
+                self.last_mfu = (self.flops_per_step / step_s
                                  / self.peak_flops)
                 self._g_mfu.set(self.last_mfu)
-                msg += f", MFU {self.last_mfu:.1%}"
+                msg += (f", MFU {self.last_mfu:.1%}"
+                        + (" (device)" if dev_ms else ""))
             from deeplearning4j_tpu.observe import current_monitor
 
             mon = current_monitor()

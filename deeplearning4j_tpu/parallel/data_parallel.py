@@ -254,11 +254,17 @@ class ParallelWrapper(SeqCtxJitCache):
             skip = 0
 
         net._loss_tracker.sync_every = int(sync_every)
-        from deeplearning4j_tpu.observe import get_registry
+        from deeplearning4j_tpu.observe import get_flight, get_registry
 
         reg = get_registry()
         reg.gauge("train_replicas").set(self.mesh.devices.size)
         reg.gauge("train_steps_per_dispatch").set(steps_per_dispatch)
+        # multi-replica fits are where HBM headroom actually bites
+        # (replicated params + updater state per device): breadcrumb the
+        # topology so a flight dump names the mesh it died on
+        get_flight().record("parallel_fit", replicas=int(self.mesh.devices.size),
+                            steps_per_dispatch=int(steps_per_dispatch),
+                            processes=int(self._nproc))
         execu = TrainingExecutor(
             net, step=self._step, fused_step=self._fused_step,
             can_fuse=self._can_fuse, steps_per_dispatch=steps_per_dispatch,

@@ -24,6 +24,11 @@ backpressure semantics, and an observability surface.
                  metrics through the same scrape endpoint
   GET  /healthz  → {"status": "ok" | "degraded"} — degraded once the
                  admission queue passes `degraded_fraction` of capacity
+  GET  /devices  → live per-device telemetry (one DeviceMonitor sample:
+                 memory_stats bytes in-use/peak/limit where the backend
+                 reports them, live-array counts everywhere)
+  GET  /flight   → the FlightRecorder ring: recent spans/compiles/
+                 device samples plus paths of any crash dumps written
 
 Dispatch modes:
   batched=True,  scheduler="continuous"  (default) — the
@@ -191,9 +196,22 @@ class InferenceServer(JsonHttpServer):
         accept = (request.get("headers") or {}).get("Accept", "") or ""
         return "text/plain" in accept or "openmetrics" in accept
 
+    def _devices(self):
+        from deeplearning4j_tpu.observe.devicemon import get_device_monitor
+
+        mon = get_device_monitor()
+        return {"devices": mon.sample_once(), "polls": mon.polls,
+                "monitor_running": mon.running}
+
+    def _flight(self):
+        from deeplearning4j_tpu.observe.flight import get_flight
+
+        return get_flight().snapshot()
+
     def get_routes(self):
         return {"/healthz": self._healthz, "/metrics": self._metrics,
-                "/models": lambda: {"models": self.registry.summary()}}
+                "/models": lambda: {"models": self.registry.summary()},
+                "/devices": self._devices, "/flight": self._flight}
 
     def post_routes(self):
         return {"/output": self._output}

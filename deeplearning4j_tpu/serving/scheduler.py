@@ -223,21 +223,34 @@ class ContinuousBatchingScheduler:
         return batch
 
     def _worker(self):
-        while True:
-            with self._cv:
-                while not self._closed and self._depth == 0:
-                    self._cv.wait()
-                if self._closed:
-                    return
-                batch = self._take_batch()
-                self._inflight += 1
-                self._cv.notify_all()   # wake admission waiters
-            try:
-                self._dispatch(batch)
-            finally:
+        try:
+            while True:
                 with self._cv:
-                    self._inflight -= 1
-                    self._cv.notify_all()
+                    while not self._closed and self._depth == 0:
+                        self._cv.wait()
+                    if self._closed:
+                        return
+                    batch = self._take_batch()
+                    self._inflight += 1
+                    self._cv.notify_all()   # wake admission waiters
+                try:
+                    self._dispatch(batch)
+                finally:
+                    with self._cv:
+                        self._inflight -= 1
+                        self._cv.notify_all()
+        except BaseException as e:
+            # a dead worker thread is a silent serving outage (daemon
+            # threads die without a traceback anyone keeps): leave the
+            # black box before propagating
+            try:
+                from deeplearning4j_tpu.observe.flight import get_flight
+                get_flight().dump("scheduler_worker_crash", exc=e)
+            # graft: allow(GL403): the dump is best-effort forensics;
+            # the original worker crash must propagate unmasked
+            except Exception:
+                pass
+            raise
 
     def _dispatch(self, batch):
         now = time.monotonic()
@@ -286,5 +299,16 @@ class ContinuousBatchingScheduler:
                 if not r.fut.done():
                     r.fut.set_exception(e)
                 self.stats.completed(r.model, 0.0, ok=False)
+            # per-batch faults surface through futures and stats; a ring
+            # breadcrumb keeps them visible in a later crash dump too
+            try:
+                from deeplearning4j_tpu.observe.flight import get_flight
+                get_flight().record("serving_dispatch_error", model=model,
+                                    error=type(e).__name__,
+                                    requests=len(live))
+            # graft: allow(GL403): ring breadcrumb is best-effort; the
+            # fault already reached every future and the stats above
+            except Exception:
+                pass
         finally:
             self.registry.release(entry)

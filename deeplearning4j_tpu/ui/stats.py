@@ -159,6 +159,16 @@ class StatsListener(TrainingListener):
         # memory (reference: system/JVM memory in the init+update reports)
         report["memory_rss_mb"] = (
             resource.getrusage(resource.RUSAGE_SELF).ru_maxrss / 1024.0)
+        # device-truth counterpart: per-device HBM in-use/peak/limit plus
+        # live-array counts (None entries where the backend reports
+        # nothing, e.g. CPU) — the DL4J UI showed JVM+offheap, ours
+        # shows host RSS + device memory side by side
+        from deeplearning4j_tpu.observe.devicemon import (
+            device_memory_summary,
+        )
+        dm = device_memory_summary()
+        if dm is not None:
+            report["device_memory"] = dm
 
         self.router.put_update(Persistable(
             self.session_id, TYPE_ID, self.worker_id, now, report))

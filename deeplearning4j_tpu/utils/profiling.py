@@ -11,6 +11,8 @@ model's compiled train step so listeners can report MFU.
 from __future__ import annotations
 
 import contextlib
+import dataclasses
+import logging
 import os
 import time
 from typing import Any, Optional
@@ -18,6 +20,10 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from deeplearning4j_tpu.observe.watchdog import note_cost_analysis_failure
+
+logger = logging.getLogger("deeplearning4j_tpu")
 
 # Peak dense bf16 matmul throughput per chip, FLOP/s (public spec sheets).
 PEAK_FLOPS_BY_KIND = (
@@ -33,34 +39,98 @@ PEAK_FLOPS_BY_KIND = (
 )
 
 
+_warned_kinds: set = set()
+
+
 def peak_flops(device_kind: Optional[str] = None) -> Optional[float]:
-    """Per-chip peak bf16 FLOP/s for a device kind (default: device 0)."""
+    """Per-chip peak bf16 FLOP/s for a device kind (default: device 0).
+
+    Unknown kinds return None AND warn once naming the kind — callers
+    (PerformanceListener) must then OMIT the MFU gauge rather than
+    publish NaN, and the warning is the only trace of why."""
     if device_kind is None:
         device_kind = jax.devices()[0].device_kind
     kind = device_kind.lower()
     for key, peak in PEAK_FLOPS_BY_KIND:
         if key in kind:
             return peak
+    if kind not in _warned_kinds:
+        _warned_kinds.add(kind)
+        logger.warning(
+            "peak_flops: unrecognized device kind %r — no spec-sheet "
+            "peak known, so MFU will not be reported. Add the kind to "
+            "PEAK_FLOPS_BY_KIND or pass peak_flops= explicitly.",
+            device_kind)
     return None
+
+
+@dataclasses.dataclass(frozen=True)
+class CostReport:
+    """XLA cost analysis of one compiled program: compute (flops),
+    memory traffic (bytes_accessed) from `cost_analysis()`, and the
+    buffer-level footprint from `compiled.memory_analysis()` —
+    `peak_memory_bytes` approximates live HBM while the program runs
+    (arguments + outputs + XLA temp scratch)."""
+
+    flops: Optional[float] = None
+    bytes_accessed: Optional[float] = None
+    peak_memory_bytes: Optional[float] = None
+    argument_bytes: Optional[float] = None
+    output_bytes: Optional[float] = None
+    temp_bytes: Optional[float] = None
+
+    def as_dict(self) -> dict:
+        return {k: v for k, v in dataclasses.asdict(self).items()
+                if v is not None}
+
+
+def _normalize_cost(cost) -> dict:
+    if isinstance(cost, (list, tuple)):
+        cost = cost[0] if cost else {}
+    return cost or {}
+
+
+def step_cost(model, features, labels) -> Optional[CostReport]:
+    """Full CostReport for the model's train step: AOT-lower + compile
+    the same pure step fn the fit loop jits, then read XLA's cost and
+    memory analyses. Failures return None — DEBUG-logged once and
+    counted in `profiling_cost_analysis_failures`, never raised."""
+    try:
+        fn = model.make_step_fn()
+        feats = jnp.asarray(features, model.dtype)
+        labs = jnp.asarray(labels)
+        compiled = jax.jit(fn).lower(
+            model.params_tree, model.updater_state, model.state_tree,
+            jnp.asarray(0, jnp.int32), feats, labs, None, None,
+            jax.random.PRNGKey(0), None).compile()
+        cost = _normalize_cost(compiled.cost_analysis())
+        arg = out = temp = peak = None
+        try:
+            mem = compiled.memory_analysis()
+        except Exception:
+            mem = None
+        if mem is not None:
+            arg = getattr(mem, "argument_size_in_bytes", None)
+            out = getattr(mem, "output_size_in_bytes", None)
+            temp = getattr(mem, "temp_size_in_bytes", None)
+            if temp is not None:
+                peak = float((arg or 0) + (out or 0) + temp)
+        return CostReport(
+            flops=float(cost.get("flops") or 0.0) or None,
+            bytes_accessed=float(cost.get("bytes accessed") or 0.0) or None,
+            peak_memory_bytes=peak,
+            argument_bytes=arg, output_bytes=out, temp_bytes=temp)
+    except Exception as e:
+        note_cost_analysis_failure(
+            f"step_cost AOT analysis failed: {type(e).__name__}")
+        return None
 
 
 def step_flops(model, features, labels) -> Optional[float]:
     """Exact HLO flop count of the model's train step (AOT cost analysis
     of the same pure step fn the fit loop jits)."""
-    fn = model.make_step_fn()
-    feats = jnp.asarray(features, model.dtype)
-    labs = jnp.asarray(labels)
-    try:
-        compiled = jax.jit(fn).lower(
-            model.params_tree, model.updater_state, model.state_tree,
-            jnp.asarray(0, jnp.int32), feats, labs, None, None,
-            jax.random.PRNGKey(0), None).compile()
-        cost = compiled.cost_analysis()
-        if isinstance(cost, (list, tuple)):
-            cost = cost[0] if cost else {}
-        return float(cost.get("flops", 0.0)) or None
-    except Exception:
-        return None
+    report = step_cost(model, features, labels)
+    return report.flops if report is not None else None
 
 
 @contextlib.contextmanager
@@ -90,7 +160,9 @@ class ProfilerListener:
 
     # TrainingListener protocol (duck-typed; no import cycle with optim)
     def on_fit_start(self, model):
-        pass
+        # re-arm: a listener reused across fit() calls captures one
+        # trace window per fit, not one per listener lifetime
+        self.captured = False
 
     def on_epoch_start(self, model, epoch):
         pass

@@ -3,6 +3,7 @@ profiler trace capture (SURVEY §5 tracing gap).
 """
 
 import glob
+import logging
 import os
 
 import numpy as np
@@ -11,10 +12,22 @@ import pytest
 from deeplearning4j_tpu.models import MultiLayerNetwork
 from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
 from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.observe import MetricsRegistry, set_registry
 from deeplearning4j_tpu.optim.listeners import PerformanceListener
 from deeplearning4j_tpu.utils.profiling import (
-    ProfilerListener, peak_flops, step_flops, trace,
+    CostReport, ProfilerListener, peak_flops, step_cost, step_flops,
+    trace,
 )
+
+
+@pytest.fixture
+def fresh_registry():
+    reg = MetricsRegistry()
+    prev = set_registry(reg)
+    try:
+        yield reg
+    finally:
+        set_registry(prev)
 
 
 def _net():
@@ -51,6 +64,45 @@ class TestStepFlops:
         assert peak_flops("TPU v4") == 275e12
         assert peak_flops("weird accelerator") is None
 
+    def test_peak_flops_unknown_kind_warns_once_naming_it(self, caplog):
+        kind = "Imaginary Accelerator Mk1"
+        with caplog.at_level(logging.WARNING, "deeplearning4j_tpu"):
+            assert peak_flops(kind) is None
+            assert peak_flops(kind) is None      # second lookup: silent
+        warns = [r for r in caplog.records
+                 if "peak_flops" in r.getMessage()]
+        assert len(warns) == 1
+        assert kind in warns[0].getMessage()
+
+
+class TestCostReport:
+    def test_step_cost_carries_flops_and_memory(self):
+        net = _net()
+        x, y = _data(32)
+        rep = step_cost(net, x, y)
+        assert rep is not None
+        assert rep.flops and rep.flops > 0
+        # memory_analysis() works on CPU: peak = args + outputs + temps
+        assert rep.peak_memory_bytes and rep.peak_memory_bytes > 0
+        assert rep.argument_bytes and rep.argument_bytes > 0
+        d = rep.as_dict()
+        assert d["flops"] == rep.flops
+        assert None not in d.values()           # as_dict drops absents
+        assert CostReport().as_dict() == {}
+
+    def test_analysis_failure_is_counted_not_swallowed(
+            self, fresh_registry):
+        class Broken:
+            def make_step_fn(self):
+                raise RuntimeError("no step fn for you")
+
+        x, y = _data(8)
+        assert step_cost(Broken(), x, y) is None
+        assert step_flops(Broken(), x, y) is None
+        series = fresh_registry.snapshot()["series"]
+        failures = series["profiling_cost_analysis_failures"][0]["value"]
+        assert failures >= 2
+
 
 class TestPerformanceListenerMfu:
     def test_mfu_reported(self):
@@ -65,6 +117,29 @@ class TestPerformanceListenerMfu:
         assert pl.last_mfu is not None and pl.last_mfu > 0
         assert pl.last_step_ms is not None
         assert any("MFU" in m and "ms/step" in m for m in msgs)
+
+    def test_unknown_peak_omits_mfu_instead_of_nan(self, fresh_registry):
+        # flops known but the device kind has no spec-sheet peak (CPU
+        # here): the resolver leaves peak_flops None and the listener
+        # must skip MFU entirely — no NaN in the gauge, none in the log
+        net = _net()
+        x, y = _data(128)
+        msgs = []
+        pl = PerformanceListener(frequency=2, report=msgs.append,
+                                 flops_per_step=1e6)
+        assert pl.peak_flops is None
+        net.listeners.append(pl)
+        net.fit(x, y, epochs=2, batch_size=32)
+        assert pl.last_mfu is None
+        assert not any("MFU" in m for m in msgs)
+        series = fresh_registry.snapshot()["series"]
+        mfu = series.get("train_mfu", [{"value": 0.0}])[0]["value"]
+        assert mfu == 0.0               # never set, never NaN
+
+    def test_explicit_nan_or_zero_peak_is_dropped(self, fresh_registry):
+        for bad in (float("nan"), 0.0, -1.0):
+            pl = PerformanceListener(flops_per_step=1e6, peak_flops=bad)
+            assert pl.peak_flops is None
 
 
 class TestProfilerTrace:
@@ -89,3 +164,18 @@ class TestProfilerTrace:
         assert pl.captured and not pl._active
         files = glob.glob(os.path.join(d, "**", "*"), recursive=True)
         assert any(os.path.isfile(f) for f in files)
+
+    def test_profiler_listener_rearms_across_fits(self, tmp_path):
+        # `captured` used to latch forever: a listener reused across
+        # fit() calls silently captured nothing on the second fit
+        net = _net()
+        x, y = _data(128)
+        pl = ProfilerListener(str(tmp_path / "rearm"),
+                              start_iteration=2, num_iterations=2)
+        net.listeners.append(pl)
+        net.fit(x, y, epochs=1, batch_size=32)
+        assert pl.captured
+        pl.on_fit_start(net)
+        assert not pl.captured          # the re-arm seam itself
+        net.fit(x, y, epochs=1, batch_size=32)
+        assert pl.captured and not pl._active

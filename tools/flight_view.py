@@ -1,0 +1,131 @@
+#!/usr/bin/env python
+"""Render a FlightRecorder crash dump (observe/flight.py) for humans.
+
+    python tools/flight_view.py <dump.json>      # render one dump
+    python tools/flight_view.py                  # newest flight_*.json
+                                                 # in $DL4J_TPU_FLIGHT_DIR
+                                                 # (default: tempdir)
+    python tools/flight_view.py <dump> --events 50 --kind span
+
+Shows: the dump reason + triggering exception, the event ring as a
+timeline (relative timestamps), crash-time device-memory samples,
+watchdog compile counts/costs, and sync-monitor counters. Stdlib only —
+usable on a machine that has just the artifact.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import tempfile
+
+
+def _latest_dump() -> str:
+    d = os.environ.get("DL4J_TPU_FLIGHT_DIR") or tempfile.gettempdir()
+    paths = glob.glob(os.path.join(d, "flight_*.json"))
+    if not paths:
+        sys.exit(f"no flight_*.json dumps found in {d}")
+    return max(paths, key=os.path.getmtime)
+
+
+def _fmt_event(ev: dict, t0: float) -> str:
+    rel = ev.get("ts", t0) - t0
+    kind = ev.get("kind", "?")
+    data = ev.get("data", {})
+    if kind == "span":
+        detail = (f"{data.get('name')} {data.get('dur_ms', '?')}ms"
+                  f" attrs={data.get('attrs', {})}")
+    else:
+        detail = " ".join(f"{k}={v}" for k, v in data.items()
+                          if k not in ("devices",))
+    return f"  {rel:+10.3f}s  #{ev.get('seq', '?'):<5} {kind:<24} {detail}"
+
+
+def _render_devices(devices) -> None:
+    if not devices:
+        print("  (no device sample in dump)")
+        return
+    for s in devices:
+        line = f"  {s.get('device', '?'):<10} {s.get('kind', '?'):<14}"
+        line += f" live_arrays={s.get('live_arrays', '?')}"
+        if s.get("memory_stats", "absent") is None:
+            line += "  (backend reports no memory stats)"
+        else:
+            for key in ("bytes_in_use", "peak_bytes_in_use",
+                        "bytes_limit"):
+                if key in s:
+                    line += f" {key}={s[key] / 2**20:.1f}MiB"
+            if "used_fraction" in s:
+                line += f" used={s['used_fraction']:.1%}"
+        print(line)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("dump", nargs="?", help="flight dump JSON "
+                    "(default: newest in the flight dir)")
+    ap.add_argument("--events", type=int, default=30,
+                    help="show the last N ring events (default 30)")
+    ap.add_argument("--kind", help="only events of this kind "
+                    "(e.g. span, jit_compile, device_memory)")
+    args = ap.parse_args(argv)
+
+    path = args.dump or _latest_dump()
+    with open(path) as f:
+        doc = json.load(f)
+
+    t0 = doc.get("ts", 0.0)
+    print(f"flight dump: {path}")
+    print(f"reason: {doc.get('reason')}   pid: {doc.get('pid')}   "
+          f"ts: {t0}")
+
+    exc = doc.get("exception")
+    if exc:
+        print(f"\nexception: {exc.get('type')}: {exc.get('message')}")
+        tb = (exc.get("traceback") or "").rstrip()
+        if tb:
+            print("  " + "\n  ".join(tb.splitlines()[-12:]))
+
+    events = doc.get("events") or []
+    if args.kind:
+        events = [e for e in events if e.get("kind") == args.kind]
+    shown = events[-args.events:]
+    print(f"\nevents ({len(shown)} of {len(events)} in ring, "
+          f"times relative to dump):")
+    for ev in shown:
+        print(_fmt_event(ev, t0))
+
+    print("\ndevices (crash-time sample):")
+    _render_devices(doc.get("devices"))
+
+    wd = doc.get("watchdog") or {}
+    per_owner = wd.get("per_owner") or {}
+    if per_owner:
+        print(f"\nwatchdog: {wd.get('total_compiles')} compiles, "
+              f"threshold {wd.get('threshold')}")
+        for tag, o in per_owner.items():
+            mark = "  [WARNED]" if o.get("warned") else ""
+            print(f"  {tag}: {o.get('compiles')} compiles{mark}")
+            for sig, cost in list((o.get("costs") or {}).items())[:4]:
+                parts = ", ".join(f"{k}={v:.3g}" for k, v in cost.items())
+                print(f"      {sig[:60]}: {parts}")
+
+    sm = doc.get("syncmon")
+    if sm:
+        print(f"\nsyncmon: {sm.get('total')} syncs "
+              f"(float={sm.get('float_syncs')}, "
+              f"block={sm.get('block_syncs')})")
+
+    dumps = doc.get("registry", {})
+    if dumps:
+        n = len(dumps.get("series", {}))
+        print(f"\nregistry snapshot: {n} series (render with "
+              f"python -m deeplearning4j_tpu.observe.dump)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
