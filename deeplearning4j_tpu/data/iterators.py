@@ -364,6 +364,7 @@ class DevicePrefetchIterator(DataSetIterator):
         self._inner: Optional[Iterator] = None
         self._buf: List = []
         self._exhausted = False
+        self._pending: Optional[BaseException] = None
         reg = get_registry()
         self._m_hits = reg.counter("prefetch_hits_total", stage="device")
         self._m_misses = reg.counter("prefetch_misses_total", stage="device")
@@ -386,16 +387,24 @@ class DevicePrefetchIterator(DataSetIterator):
                        p(ds.features_mask), p(ds.labels_mask))
 
     def _fill(self):
-        while not self._exhausted and len(self._buf) < self._depth:
+        while (not self._exhausted and self._pending is None
+               and len(self._buf) < self._depth):
             try:
                 self._buf.append(self._put(next(self._inner)))
             except StopIteration:
                 self._exhausted = True
+            except BaseException as e:
+                # a failed AHEAD fetch must not poison the batch already
+                # in hand: hold the error until the consumer actually
+                # reaches the failed position (exact-resume cursors and
+                # checkpoints then reflect every batch that trained)
+                self._pending = e
 
     def reset(self):
         self._inner = iter(self._base)
         self._buf = []
         self._exhausted = False
+        self._pending = None
 
     def __next__(self):
         if self._inner is None:
@@ -406,6 +415,9 @@ class DevicePrefetchIterator(DataSetIterator):
         ready = bool(self._buf)
         self._fill()
         if not self._buf:
+            if self._pending is not None:
+                e, self._pending = self._pending, None
+                raise e
             raise StopIteration
         (self._m_hits if ready else self._m_misses).inc()
         self._m_batches.inc()
@@ -444,9 +456,12 @@ def _is_dataset_iterable(data) -> bool:
         return True
     if isinstance(data, np.ndarray) or hasattr(data, "shape"):
         return False
-    if isinstance(data, (list, tuple)) and data:
-        return hasattr(data[0], "features")
-    return False
+    if isinstance(data, (list, tuple)):
+        return bool(data) and hasattr(data[0], "features")
+    # custom iterable wrappers (loaders, the chaos injectors in
+    # parallel/chaos.py) satisfy "any iterable of DataSets" too — anything
+    # non-array that can produce an iterator is a batch source
+    return hasattr(data, "__iter__")
 
 
 class FileSplitDataSetIterator(DataSetIterator):

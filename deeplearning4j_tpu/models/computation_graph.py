@@ -25,6 +25,7 @@ from deeplearning4j_tpu.data.iterators import (
     DataSetIterator, DevicePrefetchIterator, as_iterator,
 )
 from deeplearning4j_tpu.optim.executor import LossTracker, TrainingExecutor
+from deeplearning4j_tpu.optim.recovery import build_plan, run_with_recovery
 from deeplearning4j_tpu.nn.graph import (
     ComputationGraphConfiguration, GraphVertex, LayerVertex,
     resolve_output_type,
@@ -319,16 +320,22 @@ class ComputationGraph(SeqCtxJitCache, SeqCtxSolverCache):
     # ---------------------------------------------------------- fit API
     def fit(self, data, labels=None, *, epochs: int = 1, batch_size: int = 32,
             steps_per_dispatch: int = 1, device_prefetch: bool = True,
-            sync_every: int = 0):
+            sync_every: int = 0, checkpointer=None, checkpoint_every: int = 1,
+            resume=None, stop_fn=None, preemption=None):
         """Reference: `ComputationGraph.fit(DataSetIterator):778` (also
         accepts MultiDataSet / arrays / iterator / iterable of batches).
         Pipelined per the async-dispatch contract — see
-        `MultiLayerNetwork.fit` for the knob semantics. Each epoch
-        re-iterates the source (`iter(...)` per epoch), so multi-epoch fit
-        over a DataSetIterator or an iterable of DataSets replays every
-        batch every epoch."""
+        `MultiLayerNetwork.fit` for the knob semantics, including the
+        recovery knobs (``checkpointer``/``checkpoint_every``/``resume``/
+        ``stop_fn``/``preemption`` — `optim/recovery.RecoveryPlan`). Each
+        epoch re-iterates the source (`iter(...)` per epoch), so
+        multi-epoch fit over a DataSetIterator or an iterable of DataSets
+        replays every batch every epoch."""
         if self.params_tree is None:
             raise RuntimeError("Network not initialized — call init() first")
+        plan = build_plan(self, checkpointer=checkpointer,
+                          checkpoint_every=checkpoint_every, resume=resume,
+                          stop_fn=stop_fn, preemption=preemption)
         if isinstance(data, MultiDataSet):
             iterable: Any = [data]
         else:
@@ -337,13 +344,19 @@ class ComputationGraph(SeqCtxJitCache, SeqCtxSolverCache):
             iterable = DevicePrefetchIterator(
                 iterable, depth=max(2, int(steps_per_dispatch)))
         self._loss_tracker.sync_every = int(sync_every)
-        TrainingExecutor(
+        execu = TrainingExecutor(
             self,
             step=self._fit_batch,
             fused_step=self._fused_dispatch,
             can_fuse=self._can_fuse,
             steps_per_dispatch=steps_per_dispatch,
-        ).run(iterable, epochs)
+            before_batch=plan.before_batch if plan else None,
+            after_dispatch=plan.after_dispatch if plan else None,
+            epoch_start=plan.epoch_start if plan else None,
+            epoch_end=plan.epoch_end if plan else None,
+        )
+        run_with_recovery(execu, plan, iterable, epochs)
+        self.stopped_early = execu.stopped
         return self
 
     def _fit_batch(self, ds: Union[DataSet, MultiDataSet]):

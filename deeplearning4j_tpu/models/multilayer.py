@@ -31,6 +31,7 @@ from deeplearning4j_tpu.data.iterators import (
 )
 from deeplearning4j_tpu.nn.config import MultiLayerConfiguration
 from deeplearning4j_tpu.optim.executor import LossTracker, TrainingExecutor
+from deeplearning4j_tpu.optim.recovery import build_plan, run_with_recovery
 from deeplearning4j_tpu.nn.layers.base import Layer
 from deeplearning4j_tpu.nn.layers.recurrent import (
     BaseRecurrentLayer, Bidirectional, GravesBidirectionalLSTM, LastTimeStep,
@@ -343,7 +344,8 @@ class MultiLayerNetwork(SeqCtxJitCache, SeqCtxSolverCache):
     # ---------------------------------------------------------- fit API
     def fit(self, data, labels=None, *, epochs: int = 1, batch_size: int = 32,
             steps_per_dispatch: int = 1, device_prefetch: bool = True,
-            sync_every: int = 0):
+            sync_every: int = 0, checkpointer=None, checkpoint_every: int = 1,
+            resume=None, stop_fn=None, preemption=None):
         """Train. Accepts arrays, a DataSet, a DataSetIterator, or any
         iterable of DataSets. Reference: `fit(DataSetIterator):1046`
         (+ tBPTT dispatch `:1102`), pipelined per the async-dispatch
@@ -356,21 +358,38 @@ class MultiLayerNetwork(SeqCtxJitCache, SeqCtxSolverCache):
         - ``steps_per_dispatch=K`` (opt-in) fuses K same-shape batches
           into one `lax.scan` dispatch; tBPTT batches and non-SGD solvers
           fall back to per-step dispatch automatically
+
+        Recovery knobs (see `optim/recovery.RecoveryPlan`): pass a
+        ``checkpointer`` (`ShardedCheckpointer`) for continuous async
+        checkpoints every ``checkpoint_every`` iterations; ``resume``
+        (`"auto"` or a position dict) for exact mid-epoch resume;
+        ``stop_fn`` / ``preemption=True`` to stop cleanly at a batch
+        boundary with a final exact-position snapshot. None of these add
+        a per-step host sync.
         """
         self._check_init()
+        plan = build_plan(self, checkpointer=checkpointer,
+                          checkpoint_every=checkpoint_every, resume=resume,
+                          stop_fn=stop_fn, preemption=preemption)
         it = as_iterator(data, labels, batch_size)
         if device_prefetch:
             it = DevicePrefetchIterator(
                 it, depth=max(2, int(steps_per_dispatch)),
                 transform=self._cast_batch)
         self._loss_tracker.sync_every = int(sync_every)
-        TrainingExecutor(
+        execu = TrainingExecutor(
             self,
             step=self._dispatch_batch,
             fused_step=self._fused_dispatch,
             can_fuse=self._can_fuse,
             steps_per_dispatch=steps_per_dispatch,
-        ).run(it, epochs)
+            before_batch=plan.before_batch if plan else None,
+            after_dispatch=plan.after_dispatch if plan else None,
+            epoch_start=plan.epoch_start if plan else None,
+            epoch_end=plan.epoch_end if plan else None,
+        )
+        run_with_recovery(execu, plan, it, epochs)
+        self.stopped_early = execu.stopped
         return self
 
     def _cast_batch(self, ds: DataSet) -> DataSet:

@@ -41,7 +41,7 @@ from deeplearning4j_tpu.observe.watchdog import (
 )
 from deeplearning4j_tpu.observe.syncmon import HostSyncMonitor, current_monitor
 from deeplearning4j_tpu.observe.flight import (
-    FlightRecorder, get_flight, set_flight,
+    FlightRecorder, get_flight, latest_dump, read_dump, set_flight,
 )
 from deeplearning4j_tpu.observe.devicemon import (
     DeviceMonitor, device_memory_summary, get_device_monitor,
@@ -57,7 +57,7 @@ __all__ = [
     "tracing_enabled", "read_spans", "emit_manual_span",
     "RecompileWatchdog", "WatchedJitCache", "get_watchdog", "set_watchdog",
     "HostSyncMonitor", "current_monitor",
-    "FlightRecorder", "get_flight", "set_flight",
+    "FlightRecorder", "get_flight", "set_flight", "latest_dump", "read_dump",
     "DeviceMonitor", "device_memory_summary", "get_device_monitor",
     "maybe_start_monitor", "set_device_monitor",
     "StepAttribution", "attribution_enabled",

@@ -217,6 +217,32 @@ def read_dump(path: str) -> dict:
         return json.load(f)
 
 
+def latest_dump(dump_dir: Optional[str] = None) -> Optional[str]:
+    """Path of the newest flight dump on disk (any process), or None.
+
+    The recovery breadcrumb: a restart is a NEW process, so the crashed
+    run's `FlightRecorder.dumps` list is gone — but its artifact is
+    still in the dump directory. `RecoveryPlan` records this path on
+    resume so the restarted run carries its predecessor's black box."""
+    d = dump_dir or get_flight().dump_dir
+    best, best_mtime = None, -1.0
+    try:
+        names = os.listdir(d)
+    except OSError:
+        return None
+    for n in names:
+        if not (n.startswith("flight_") and n.endswith(".json")):
+            continue
+        p = os.path.join(d, n)
+        try:
+            m = os.path.getmtime(p)
+        except OSError:
+            continue       # raced with cleanup — not a candidate
+        if m > best_mtime:
+            best, best_mtime = p, m
+    return best
+
+
 # ------------------------------------------------------------ process-wide
 _flight: Optional[FlightRecorder] = None
 _install_lock = threading.Lock()

@@ -149,6 +149,14 @@ class RecompileWatchdog:
                       key=repr(key)[:160], **entry)
 
     # --------------------------------------------------------- reporting
+    def warned(self, owner_tag: str) -> bool:
+        """Has this owner tripped the churn threshold? The deploy-gate
+        seam: `ModelRegistry.deploy` checks the fresh runner's tag after
+        warmup and rolls back instead of flipping a version that would
+        recompile per-request."""
+        with self._lock:
+            return owner_tag in self._warned
+
     def compiles(self, owner_tag: Optional[str] = None) -> int:
         with self._lock:
             if owner_tag is not None:

@@ -148,7 +148,13 @@ class TrainingExecutor:
       fused_step(batches) -> (K,)    K stacked steps in one dispatch
       can_fuse(ds) -> bool           batch eligible for fusion
       before_batch(bi, ds) -> ds | SKIP | STOP
-      after_step(bi)                 post-iteration seam (checkpointing)
+      after_step(bi)                 post-iteration seam (per _finish)
+      after_dispatch(bi)             post-DISPATCH seam: fires once per
+                                     device dispatch (per step unfused,
+                                     per K-step scan window fused), at a
+                                     point where params/updater/rng are a
+                                     consistent snapshot — the
+                                     checkpointing seam (RecoveryPlan)
       epoch_start() / epoch_end()    per-epoch trainer state
     """
 
@@ -158,6 +164,7 @@ class TrainingExecutor:
                  steps_per_dispatch: int = 1,
                  before_batch: Optional[Callable] = None,
                  after_step: Optional[Callable] = None,
+                 after_dispatch: Optional[Callable] = None,
                  epoch_start: Optional[Callable] = None,
                  epoch_end: Optional[Callable] = None):
         self.net = net
@@ -167,6 +174,7 @@ class TrainingExecutor:
         self.k = max(1, int(steps_per_dispatch or 1))
         self.before_batch = before_batch
         self.after_step = after_step
+        self.after_dispatch = after_dispatch
         self.epoch_start = epoch_start
         self.epoch_end = epoch_end
         self.stopped = False
@@ -242,6 +250,8 @@ class TrainingExecutor:
                                 dispatch_ms = (time.perf_counter()
                                                - t_d) * 1e3
                                 self._finish(bi, loss, etl_ms, dispatch_ms)
+                                if self.after_dispatch is not None:
+                                    self.after_dispatch(bi)
                             etl_start = time.perf_counter()
                         self._drain(buf)
                         if self.stopped:
@@ -277,6 +287,8 @@ class TrainingExecutor:
             loss = self.step(ds)
             dispatch_ms = (time.perf_counter() - t_d) * 1e3
             self._finish(bi, loss, etl_ms, dispatch_ms)
+            if self.after_dispatch is not None:
+                self.after_dispatch(bi)
 
     def _run_fused(self, buf) -> None:
         t_d = time.perf_counter()
@@ -286,6 +298,10 @@ class TrainingExecutor:
         for j, (bi, ds, etl_ms) in enumerate(buf):
             # losses[j] stays on device — indexing does not sync
             self._finish(bi, losses[j], etl_ms, dispatch_ms)
+        if self.after_dispatch is not None:
+            # once per scan window: params now reflect all K steps, so a
+            # checkpoint here is a consistent (step, rng, cursor) snapshot
+            self.after_dispatch(buf[-1][0])
 
     def _finish(self, bi, loss, etl_ms, dispatch_ms: float = 0.0) -> None:
         net = self.net

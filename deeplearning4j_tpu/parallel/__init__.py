@@ -40,9 +40,15 @@ from deeplearning4j_tpu.parallel.estimator import NetworkEstimator
 from deeplearning4j_tpu.parallel.checkpoint import ShardedCheckpointer
 from deeplearning4j_tpu.parallel.elastic import ElasticTrainer, PreemptionHandler
 from deeplearning4j_tpu.parallel.async_ps import AsyncParameterServer, AsyncTrainer
+from deeplearning4j_tpu.parallel.chaos import (
+    CheckpointIOFault, FailingIterator, InjectedFault, SigtermAtStep,
+    StallingIterator,
+)
 
 __all__ = [
     "ShardedCheckpointer", "ElasticTrainer", "PreemptionHandler",
+    "CheckpointIOFault", "FailingIterator", "InjectedFault", "SigtermAtStep",
+    "StallingIterator",
     "AsyncParameterServer", "AsyncTrainer",
     "MeshSpec", "make_mesh", "device_count", "local_device_count",
     "ParallelWrapper", "ParallelInference",

@@ -1,0 +1,149 @@
+"""Fault-injection harness: deterministic chaos for the recovery path.
+
+ISSUE 6: "recovery is CI-testable rather than aspirational." Every
+failure mode the preemption-proofing claims to survive gets an
+injectable, CPU-deterministic trigger here, so tests/test_chaos_recovery
+can kill runs at exact step/file boundaries and assert bit-identical
+resume instead of hoping:
+
+- `SigtermAtStep` — a TrainingListener that delivers a real SIGTERM (or
+  degrades to `PreemptionHandler.request_stop()` off the main thread) at
+  iteration N. CPython runs signal handlers between bytecodes on the
+  main thread, so the flag is set before the next batch-boundary check —
+  the stop lands at a deterministic batch.
+- `CheckpointIOFault` — a `ShardedCheckpointer.fault_hook` that raises
+  after a chosen number of file writes ("kill the writer after the first
+  shard file"), proving the COMMIT protocol: a half-written step is
+  invisible and resume picks the previous committed step.
+- `FailingIterator` / `StallingIterator` — data-pipeline crash/stall at
+  batch K (crash exercises flight-dump → restart → breadcrumb; stall
+  exercises that slow input doesn't trip anything).
+- scheduler-worker crashes are injected at the serving layer itself:
+  `ContinuousBatchingScheduler.inject_worker_fault()` (the dispatch seam
+  lives there), asserted through `ServingStats.worker_restarted`.
+
+Everything here is test/ops tooling: no jax imports, no syncs, safe to
+ship in production images (inert unless wired in).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import time
+from typing import Any, Callable, Iterable, Iterator, Optional
+
+from deeplearning4j_tpu.optim.listeners import TrainingListener
+
+__all__ = [
+    "SigtermAtStep", "CheckpointIOFault", "FailingIterator",
+    "StallingIterator", "InjectedFault",
+]
+
+
+class InjectedFault(OSError):
+    """The exception every injector raises by default — recognizable in
+    logs/flight dumps as chaos, never a real IO failure."""
+
+
+class SigtermAtStep(TrainingListener):
+    """Deliver SIGTERM to this process when iteration N completes.
+
+    With a `handler` (a PreemptionHandler) the trigger calls
+    `request_stop()` instead of `os.kill` — the off-main-thread path
+    where signal delivery isn't available (threaded test runners).
+    `fired` records delivery so tests can assert the fault actually ran.
+    """
+
+    def __init__(self, at_iteration: int,
+                 handler: Optional[Any] = None):
+        self.at_iteration = int(at_iteration)
+        self.handler = handler
+        self.fired = False
+
+    def iteration_done(self, model, iteration, epoch, score):
+        if self.fired or iteration < self.at_iteration:
+            return
+        self.fired = True
+        if self.handler is not None:
+            self.handler.request_stop()
+        else:
+            os.kill(os.getpid(), signal.SIGTERM)
+
+
+class CheckpointIOFault:
+    """`ShardedCheckpointer.fault_hook` raising at an exact file boundary.
+
+    `fail_after=N` lets N matching writes succeed and kills the N+1-th;
+    `kind` filters which boundary counts ("shard" | "manifest" |
+    "commit" | None for all). `times` bounds how many checkpoints die
+    (default 1: the writer fails once, later saves succeed — the
+    recover-after-fault scenario). Counters are writer-thread-touched
+    only, so no lock is needed beyond the GIL.
+    """
+
+    def __init__(self, *, fail_after: int = 1, kind: Optional[str] = "shard",
+                 times: int = 1,
+                 exc_factory: Callable[[], BaseException] = None):
+        self.fail_after = int(fail_after)
+        self.kind = kind
+        self.times = int(times)
+        self.exc_factory = exc_factory or (
+            lambda: InjectedFault("injected checkpoint IO fault"))
+        self.touched = 0
+        self.raised = 0
+
+    def __call__(self, kind: str, path: str) -> None:
+        if self.kind is not None and kind != self.kind:
+            return
+        if self.raised >= self.times:
+            return
+        self.touched += 1
+        if self.touched > self.fail_after:
+            self.raised += 1
+            self.touched = 0      # re-arm for the next checkpoint attempt
+            raise self.exc_factory()
+
+
+class FailingIterator:
+    """Iterable that raises at batch `fail_at` — the input-pipeline crash
+    (a training exception, NOT a clean stop: the executor flight-dumps
+    and re-raises, and the next run resumes from the last checkpoint).
+    `times` bounds how many epochs/passes fail (default 1)."""
+
+    def __init__(self, inner: Iterable, *, fail_at: int, times: int = 1,
+                 exc_factory: Callable[[], BaseException] = None):
+        self.inner = inner
+        self.fail_at = int(fail_at)
+        self.times = int(times)
+        self.exc_factory = exc_factory or (
+            lambda: InjectedFault("injected iterator failure"))
+        self.raised = 0
+
+    def __iter__(self) -> Iterator:
+        for i, item in enumerate(iter(self.inner)):
+            if i == self.fail_at and self.raised < self.times:
+                self.raised += 1
+                raise self.exc_factory()
+            yield item
+
+
+class StallingIterator:
+    """Iterable that sleeps `stall_s` before yielding batch `stall_at` —
+    a slow input pipeline. Recovery must treat this as ordinary ETL time
+    (no watchdog trip, no spurious stop), which the chaos suite pins."""
+
+    def __init__(self, inner: Iterable, *, stall_at: int,
+                 stall_s: float = 0.25, times: int = 1):
+        self.inner = inner
+        self.stall_at = int(stall_at)
+        self.stall_s = float(stall_s)
+        self.times = int(times)
+        self.stalled = 0
+
+    def __iter__(self) -> Iterator:
+        for i, item in enumerate(iter(self.inner)):
+            if i == self.stall_at and self.stalled < self.times:
+                self.stalled += 1
+                time.sleep(self.stall_s)
+            yield item

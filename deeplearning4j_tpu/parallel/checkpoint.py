@@ -20,7 +20,13 @@ Design (TPU-native, multi-host-shaped):
   file writes happen on a background thread — the step loop never blocks
   on disk.
 - A checkpoint directory is only valid once `COMMIT` exists (written
-  last), so a kill mid-write never yields a half checkpoint.
+  last), so a kill mid-write never yields a half checkpoint. The
+  `fault_hook` seam lets `parallel/chaos.py` kill the writer at an exact
+  file boundary, which is how the COMMIT protocol is CI-tested.
+- Restore re-assembles each leaf's GLOBAL array from whatever shards the
+  committed manifests cover and re-shards it onto the restoring mesh —
+  so a snapshot taken on N devices restores onto M devices (elastic
+  shrink/grow) without a host-side gather at save time.
 """
 
 from __future__ import annotations
@@ -126,7 +132,15 @@ class ShardedCheckpointer:
         self.async_save = async_save
         self._q: "queue.Queue" = queue.Queue()
         self._worker: Optional[threading.Thread] = None
+        # writer-thread error latch + restore pins share one lock: both
+        # are cross-thread (writer appends/rotates, main thread drains/reads)
+        self._state_lock = threading.Lock()
         self._errors: List[BaseException] = []
+        self._pinned: set = set()
+        # chaos seam: fn(kind, path) called before every file write
+        # ("shard" | "manifest" | "commit"); raising simulates the writer
+        # dying mid-checkpoint at a deterministic file boundary
+        self.fault_hook: Optional[Any] = None
 
     # ------------------------------------------------------------- save
     def save(self, net, *, step: int, position: Optional[Dict] = None):
@@ -177,6 +191,8 @@ class ShardedCheckpointer:
 
     def _ensure_worker(self):
         if self._worker is None or not self._worker.is_alive():
+            # graft: allow(GL301): only save()'s caller thread spawns the
+            # writer; the worker itself never touches self._worker
             self._worker = threading.Thread(
                 target=self._drain, daemon=True, name="ckpt-writer")
             self._worker.start()
@@ -189,9 +205,15 @@ class ShardedCheckpointer:
             try:
                 self._write(job)
             except BaseException as e:  # surfaced by wait()
-                self._errors.append(e)
+                with self._state_lock:
+                    self._errors.append(e)
             finally:
                 self._q.task_done()
+
+    def _touch(self, kind: str, path: str) -> None:
+        hook = self.fault_hook
+        if hook is not None:
+            hook(kind, path)
 
     def _write(self, job):
         payload, meta, leaf_meta = job
@@ -208,29 +230,45 @@ class ShardedCheckpointer:
                 for bounds, data in shards:
                     fn = f"s{fid:06d}.npy"
                     fid += 1
-                    np.save(os.path.join(pdir, fn), data)
+                    path = os.path.join(pdir, fn)
+                    self._touch("shard", path)
+                    np.save(path, data)
                     entries.append({"index": bounds, "file": fn})
                 manifest["leaves"][f"{name}:{key}"] = {
                     "shards": entries, **leaf_meta[name][key]}
-        with open(os.path.join(pdir, _MANIFEST), "w") as f:
+        mpath = os.path.join(pdir, _MANIFEST)
+        self._touch("manifest", mpath)
+        with open(mpath, "w") as f:
             json.dump(manifest, f)
-        with open(os.path.join(pdir, _COMMIT), "w") as f:
+        cpath = os.path.join(pdir, _COMMIT)
+        self._touch("commit", cpath)
+        with open(cpath, "w") as f:
             f.write("ok")
         self._rotate()
 
     def _rotate(self):
-        steps = self.steps()
-        for s in steps[:-self.max_to_keep]:
+        with self._state_lock:
+            pinned = set(self._pinned)
+        for s in self.steps()[:-self.max_to_keep]:
+            if s in pinned:
+                # a restore is (or was just about to start) reading this
+                # step — deleting it under the reader loses the recovery
+                continue
             shutil.rmtree(
                 os.path.join(self.directory, f"step-{s:010d}"),
                 ignore_errors=True)
 
     def wait(self):
-        """Block until queued writes land; re-raise writer errors."""
+        """Block until queued writes land; re-raise writer errors.
+
+        The error latch is drained on raise: one failed write surfaces
+        exactly once, instead of poisoning every later wait()."""
         if self._worker is not None and self._worker.is_alive():
             self._q.join()
-        if self._errors:
-            raise self._errors[0]
+        with self._state_lock:
+            errors, self._errors = self._errors, []
+        if errors:
+            raise errors[0]
         return self
 
     # ---------------------------------------------------------- restore
@@ -240,9 +278,16 @@ class ShardedCheckpointer:
             if not n.startswith("step-"):
                 continue
             d = os.path.join(self.directory, n)
-            committed = any(
-                os.path.exists(os.path.join(d, p, _COMMIT))
-                for p in os.listdir(d))
+            try:
+                committed = any(
+                    os.path.exists(os.path.join(d, p, _COMMIT))
+                    for p in os.listdir(d))
+            except OSError:
+                # the writer thread's _rotate() can delete a step-* dir
+                # between our listdir of the parent and of the step (or
+                # a stray non-directory entry matched the prefix) —
+                # a vanished step is simply not a candidate
+                continue
             if committed:
                 out.append(int(n[len("step-"):]))
         return sorted(out)
@@ -252,10 +297,25 @@ class ShardedCheckpointer:
         return s[-1] if s else None
 
     def _read_step(self, step: int):
+        # pin the step for the duration of the read so the writer
+        # thread's rotation can never delete it out from under us
+        with self._state_lock:
+            self._pinned.add(step)
+        try:
+            return self._read_step_pinned(step)
+        finally:
+            with self._state_lock:
+                self._pinned.discard(step)
+
+    def _read_step_pinned(self, step: int):
         d = os.path.join(self.directory, f"step-{step:010d}")
         flats: Dict[str, Dict[str, np.ndarray]] = {}
         meta = None
-        for pname in sorted(os.listdir(d)):
+        try:
+            pnames = sorted(os.listdir(d))
+        except OSError:
+            pnames = []    # rotated away before the pin landed
+        for pname in pnames:
             pdir = os.path.join(d, pname)
             mf = os.path.join(pdir, _MANIFEST)
             if not os.path.exists(mf) or \

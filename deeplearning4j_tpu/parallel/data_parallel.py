@@ -27,9 +27,8 @@ from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
 from deeplearning4j_tpu.data.iterators import (
     DevicePrefetchIterator, as_iterator,
 )
-from deeplearning4j_tpu.optim.executor import (
-    SKIP as _SKIP, STOP as _STOP, TrainingExecutor,
-)
+from deeplearning4j_tpu.optim.executor import TrainingExecutor
+from deeplearning4j_tpu.optim.recovery import RecoveryPlan, run_with_recovery
 from deeplearning4j_tpu.parallel.distributed import (
     put_global, put_global_batch,
 )
@@ -176,8 +175,8 @@ class ParallelWrapper(SeqCtxJitCache):
 
     def fit(self, data, labels=None, *, epochs: int = 1,
             batch_size: int = 128, checkpointer=None,
-            checkpoint_every: int = 1, resume: Optional[Dict] = None,
-            stop_fn=None, steps_per_dispatch: int = 1,
+            checkpoint_every: int = 1, resume=None,
+            stop_fn=None, preemption=None, steps_per_dispatch: int = 1,
             device_prefetch: bool = True, sync_every: int = 0):
         """Reference: `ParallelWrapper.fit(DataSetIterator):409`. Partial
         final batches are padded by repetition to keep XLA shapes static.
@@ -189,22 +188,42 @@ class ParallelWrapper(SeqCtxJitCache):
         DistributedTrainingMaster.execute_training instead, which shards
         and divides for you.
 
-        `checkpointer` (a ShardedCheckpointer) saves sharded snapshots every
-        `checkpoint_every` iterations, async. `resume` takes the position
-        dict returned by `ShardedCheckpointer.restore_into_wrapper` —
-        training continues mid-epoch from the exact batch/rng/step, and
-        `epochs` counts TOTAL epochs over the whole (resumed) run so an
-        interrupted fit(epochs=N) is finished by the same call. `stop_fn`
-        (checked at step boundaries) ends training cleanly early —
-        the preemption seam used by ElasticTrainer.
+        Recovery (shared `optim/recovery.RecoveryPlan` — same semantics as
+        `MultiLayerNetwork.fit`): `checkpointer` (a ShardedCheckpointer)
+        saves sharded snapshots every `checkpoint_every` iterations, async.
+        `resume` takes the position dict returned by
+        `ShardedCheckpointer.restore_into_wrapper`, or `"auto"` to restore
+        the newest committed step with this wrapper's shardings — training
+        continues mid-epoch from the exact batch/rng/step, and `epochs`
+        counts TOTAL epochs over the whole (resumed) run so an interrupted
+        fit(epochs=N) is finished by the same call. `stop_fn` /
+        `preemption=True` end training cleanly at a batch boundary — the
+        preemption seam used by ElasticTrainer.
 
         Async-dispatch knobs (see MultiLayerNetwork.fit / PERF_NOTES):
         `device_prefetch` pre-shards batch N+1 across the mesh while batch
         N computes (single-controller only — multi-controller feeding goes
         through `put_global_batch`); `steps_per_dispatch=K` fuses K batches
-        into one `lax.scan` dispatch, forced back to 1 whenever a
-        checkpointer or stop_fn needs per-step visibility."""
+        into one `lax.scan` dispatch. Fusion now COMPOSES with recovery:
+        checkpoints land at scan-window boundaries (where params are
+        consistent) and a resume replays into a partial window per-step."""
         net = self.net
+
+        def prepare(ds):
+            ds = self._pad_to_divisible(ds)
+            net.last_batch_size = ds.num_examples()
+            return ds
+
+        # PW always runs under a plan: padding needs before_batch anyway,
+        # and last_batch_index must track even checkpointer-less fits
+        # (ElasticTrainer reads it after a stop)
+        plan = RecoveryPlan(
+            net, checkpointer=checkpointer, checkpoint_every=checkpoint_every,
+            resume=resume, stop_fn=stop_fn, preemption=preemption,
+            prepare=prepare,
+            restore_fn=(lambda: checkpointer.restore_into_wrapper(self))
+            if checkpointer is not None else None)
+
         if isinstance(data, MultiDataSet):
             iterable: Any = [data]
         else:
@@ -219,39 +238,14 @@ class ParallelWrapper(SeqCtxJitCache):
                 put_fn=lambda x: jax.device_put(
                     x, self._batch_sharding_like(x)),
                 transform=self._pad_to_divisible)
-        if checkpointer is not None or stop_fn is not None:
-            # Both need exact per-step positions; a fused dispatch would
-            # make K steps indivisible.
-            steps_per_dispatch = 1
-        start_epoch = net.epoch if resume is not None else 0
-        skip = (resume or {}).get("batch_in_epoch", 0)
 
         def epoch_start():
-            # per-epoch position: a stop before this epoch's first
-            # non-skipped batch must checkpoint the RESUMED position
-            # (skip batches are already trained), not the last epoch's tail
-            self.last_batch_index = skip - 1
+            plan.epoch_start()
+            self.last_batch_index = plan.last_batch_index
 
-        def before_batch(bi, ds):
-            nonlocal skip
-            if bi < skip:
-                return _SKIP
-            if stop_fn is not None and stop_fn():
-                return _STOP
-            ds = self._pad_to_divisible(ds)
-            net.last_batch_size = ds.num_examples()
-            return ds
-
-        def after_step(bi):
-            self.last_batch_index = bi
-            if checkpointer is not None and \
-                    net.iteration % checkpoint_every == 0:
-                checkpointer.save(net, step=net.iteration,
-                                  position={"batch_in_epoch": bi + 1})
-
-        def epoch_end():
-            nonlocal skip
-            skip = 0
+        def after_dispatch(bi):
+            plan.after_dispatch(bi)
+            self.last_batch_index = plan.last_batch_index
 
         net._loss_tracker.sync_every = int(sync_every)
         from deeplearning4j_tpu.observe import get_flight, get_registry
@@ -268,12 +262,11 @@ class ParallelWrapper(SeqCtxJitCache):
         execu = TrainingExecutor(
             net, step=self._step, fused_step=self._fused_step,
             can_fuse=self._can_fuse, steps_per_dispatch=steps_per_dispatch,
-            before_batch=before_batch, after_step=after_step,
-            epoch_start=epoch_start, epoch_end=epoch_end)
-        execu.run(iterable, epochs, start_epoch=start_epoch)
+            before_batch=plan.before_batch, after_dispatch=after_dispatch,
+            epoch_start=epoch_start, epoch_end=plan.epoch_end)
+        run_with_recovery(execu, plan, iterable, epochs)
+        self.last_batch_index = plan.last_batch_index
         self.stopped_early = execu.stopped  # authoritative for ElasticTrainer
-        if checkpointer is not None:
-            checkpointer.wait()
         return net
 
     def _put_batch(self, x):

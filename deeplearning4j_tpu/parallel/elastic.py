@@ -22,11 +22,16 @@ Pieces:
 
 from __future__ import annotations
 
+import logging
 import signal
 from typing import Any, Callable, Dict, Optional, Sequence
 
 from deeplearning4j_tpu.parallel.checkpoint import ShardedCheckpointer
 from deeplearning4j_tpu.parallel.data_parallel import ParallelWrapper
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+_warned_off_main_thread = False
 
 
 class PreemptionHandler:
@@ -38,10 +43,27 @@ class PreemptionHandler:
         self._preempted = False
         self._previous: Dict[int, Any] = {}
         self.signals = tuple(signals)
+        # True when install() could not register handlers (non-main
+        # thread); preemption then only arrives via request_stop()/stop_fn
+        self.degraded = False
 
     def install(self) -> "PreemptionHandler":
+        global _warned_off_main_thread
         for s in self.signals:
-            self._previous[s] = signal.signal(s, self._on_signal)
+            try:
+                self._previous[s] = signal.signal(s, self._on_signal)
+            except ValueError:
+                # signal.signal is main-thread-only; under threaded test
+                # runners / servers the fit must still run — degrade to
+                # the stop_fn/request_stop path instead of crashing
+                self.degraded = True
+                if not _warned_off_main_thread:
+                    _warned_off_main_thread = True
+                    logger.warning(
+                        "PreemptionHandler.install(): not on the main "
+                        "thread, signal handlers unavailable — relying on "
+                        "stop_fn/request_stop() for preemption (warning "
+                        "once per process)")
         return self
 
     def uninstall(self) -> None:
@@ -50,6 +72,11 @@ class PreemptionHandler:
         self._previous.clear()
 
     def _on_signal(self, signum, frame):
+        self._preempted = True
+
+    def request_stop(self) -> None:
+        """Programmatic preemption — the delivery path that still works
+        when install() degraded off the main thread."""
         self._preempted = True
 
     @property
@@ -100,6 +127,10 @@ class ElasticTrainer:
         if self.checkpointer.latest_step() is not None:
             resume = self.checkpointer.restore_into_wrapper(self.wrapper)
         with self.handler:
+            # the wrapper's RecoveryPlan owns the rest: periodic async
+            # saves, the final exact-position snapshot on stop, and the
+            # writer flush (finalize) — this driver just supplies the
+            # handler-aware stop predicate and reports the outcome
             self.wrapper.fit(
                 data, labels, epochs=epochs, batch_size=batch_size,
                 checkpointer=self.checkpointer,
@@ -108,13 +139,5 @@ class ElasticTrainer:
             # the wrapper's record is authoritative — a transient stop_fn
             # that flipped back must still report the truncated run
             preempted = self.wrapper.stopped_early
-            if preempted:
-                # final snapshot at the exact stop point (the periodic
-                # cadence may not have covered the last steps)
-                self.checkpointer.save(
-                    net, step=net.iteration,
-                    position={"batch_in_epoch":
-                              self.wrapper.last_batch_index + 1})
-                self.checkpointer.wait()
         return {"completed": not preempted, "preempted": preempted,
                 "iteration": net.iteration}

@@ -14,16 +14,18 @@ from deeplearning4j_tpu.serving.inference_server import (
 )
 from deeplearning4j_tpu.serving.knn_server import NearestNeighborsServer
 from deeplearning4j_tpu.serving.metrics import ServingStats
-from deeplearning4j_tpu.serving.registry import ModelEntry, ModelRegistry
+from deeplearning4j_tpu.serving.registry import (
+    DeployRolledBackError, ModelEntry, ModelRegistry,
+)
 from deeplearning4j_tpu.serving.scheduler import (
     AdmissionPolicy, ContinuousBatchingScheduler, DeadlineExceededError,
-    RequestShedError, SchedulerClosedError,
+    RequestShedError, SchedulerClosedError, WorkerCrashError,
 )
 
 __all__ = [
     "AdmissionPolicy", "ContinuousBatchingScheduler",
-    "DeadlineExceededError", "HttpError", "InferenceServer",
-    "JsonHttpServer", "ModelEntry", "ModelRegistry", "ModelServer",
-    "NearestNeighborsServer", "RequestShedError", "SchedulerClosedError",
-    "ServingStats",
+    "DeadlineExceededError", "DeployRolledBackError", "HttpError",
+    "InferenceServer", "JsonHttpServer", "ModelEntry", "ModelRegistry",
+    "ModelServer", "NearestNeighborsServer", "RequestShedError",
+    "SchedulerClosedError", "ServingStats", "WorkerCrashError",
 ]

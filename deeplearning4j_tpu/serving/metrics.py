@@ -64,6 +64,8 @@ class ServingStats:
         self._rows = self.registry.counter("serving_batch_rows_total")
         self._q_depth = self.registry.gauge("serving_queue_depth")
         self._q_cap = self.registry.gauge("serving_queue_capacity")
+        self._worker_restarts = self.registry.counter(
+            "serving_worker_restarts_total")
         self._started = time.time()
         self.registry.gauge("serving_start_time_seconds").set(self._started)
 
@@ -105,6 +107,12 @@ class ServingStats:
         self._occupancy[i].inc()
         self._dispatches.inc()
         self._rows.inc(rows)
+
+    def worker_restarted(self):
+        """One supervised slot-worker restart after a crash — nonzero
+        here means the scheduler survived something that used to be a
+        silent outage (a dead daemon thread)."""
+        self._worker_restarts.inc()
 
     def set_queue_gauges(self, depth: Optional[int],
                          capacity: Optional[int]) -> None:
@@ -165,6 +173,7 @@ class ServingStats:
                     for lab, c in zip(_OCC_LABELS, self._occupancy)},
             },
             "per_model": models,
+            "workers": {"restarts": int(self._worker_restarts.value)},
         }
         if queue_depth is not None:
             out["queue"] = {"depth": queue_depth,

@@ -28,6 +28,7 @@ imply.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Dict, List, Optional
@@ -35,6 +36,14 @@ from typing import Dict, List, Optional
 from deeplearning4j_tpu.parallel.inference import (
     InferenceMode, ParallelInference,
 )
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+
+class DeployRolledBackError(RuntimeError):
+    """`deploy()` refused to flip: warmup crashed or tripped the
+    recompile watchdog, and the previously active version (when one
+    exists) was left serving. The failed runner is already shut down."""
 
 
 class ModelEntry:
@@ -109,7 +118,15 @@ class ModelRegistry:
     def deploy(self, name: str, version, net, *, feat_shape=None,
                warm: bool = True) -> ModelEntry:
         """Deploy `net` as the active version of `name`; returns the new
-        entry after the old one (if any) is drained and retired."""
+        entry after the old one (if any) is drained and retired.
+
+        Failover (ISSUE 6): warmup is the canary. If it raises, or it
+        trips the RecompileWatchdog on the new runner's jit cache (the
+        version would recompile under live traffic — the silent-10x
+        outage), the flip never happens: the previous version keeps
+        serving untouched and `DeployRolledBackError` is raised. A
+        watchdog trip on a FIRST deploy (nothing to roll back to)
+        proceeds with a warning — degraded beats dark."""
         runner = ParallelInference(
             net, mesh=self.mesh, mode=self.runner_mode,
             max_batch_size=self.max_batch, batch_buckets=self.buckets,
@@ -118,7 +135,24 @@ class ModelRegistry:
         if warm:
             shape = feat_shape or self._infer_feat_shape(net)
             if shape:
-                runner.warmup(shape)
+                failure: Optional[BaseException] = None
+                try:
+                    runner.warmup(shape)
+                except BaseException as e:
+                    failure = e
+                tripped = failure is None and self._warmup_tripped(runner)
+                with self._lock:
+                    has_previous = name in self._active
+                if failure is not None or (tripped and has_previous):
+                    self._reject_deploy(name, version, runner,
+                                        cause=failure, tripped=tripped,
+                                        has_previous=has_previous)
+                elif tripped:
+                    logger.warning(
+                        "deploy(%s@%r): warmup tripped the recompile "
+                        "watchdog but no previous version exists — "
+                        "deploying anyway (degraded beats dark)",
+                        name, version)
         with self._lock:
             old = self._active.get(name)
             self._active[name] = entry
@@ -127,6 +161,46 @@ class ModelRegistry:
         if old is not None:
             self._retire(old)
         return entry
+
+    @staticmethod
+    def _warmup_tripped(runner: ParallelInference) -> bool:
+        """Did warming THIS runner's jit cache cross the watchdog's churn
+        threshold? The tag is per-instance, so a trip here is the new
+        version's own compile churn, never residue from an old one."""
+        from deeplearning4j_tpu.observe.watchdog import get_watchdog
+        return get_watchdog().warned(runner._jit_cache.owner_tag)
+
+    def _reject_deploy(self, name, version, runner, *, cause, tripped,
+                       has_previous):
+        """Tear down the failed candidate and raise; the active pointer
+        was never touched, so the old version (if any) keeps serving."""
+        try:
+            runner.shutdown()
+        # graft: allow(GL403): best-effort teardown of a runner that
+        # already failed — the rollback error below is the payload
+        except Exception:
+            pass
+        reason = ("warmup raised" if cause is not None
+                  else "warmup tripped the recompile watchdog")
+        try:
+            from deeplearning4j_tpu.observe import get_flight, get_registry
+            get_registry().counter("serving_deploy_rollbacks_total",
+                                   model=name).inc()
+            get_flight().record(
+                "deploy_rollback", model=name, version=version,
+                reason=reason, watchdog_tripped=bool(tripped),
+                previous_kept=bool(has_previous),
+                error=None if cause is None else type(cause).__name__)
+        # graft: allow(GL403): telemetry must not mask the rollback error
+        except Exception:
+            pass
+        logger.warning(
+            "deploy(%s@%r) rolled back: %s%s", name, version, reason,
+            " — previous version keeps serving" if has_previous
+            else " — model has no active version")
+        raise DeployRolledBackError(
+            f"deploy {name}@{version!r} rolled back: {reason}"
+        ) from cause
 
     def undeploy(self, name: str):
         with self._lock:

@@ -64,6 +64,23 @@ class SchedulerClosedError(RuntimeError):
     """Scheduler shut down before (or while) holding this request."""
 
 
+class WorkerCrashError(RuntimeError):
+    """A slot worker crashed more than `max_worker_restarts` times in a
+    row while holding this batch; the batch was failed rather than
+    retried forever. The slot itself stays alive for new work."""
+
+
+class _WorkerCrashed(BaseException):
+    """Internal: carries the in-flight batch out of a crashed worker
+    iteration to the supervisor (BaseException so nothing downstream
+    accidentally swallows it)."""
+
+    def __init__(self, batch, cause: BaseException):
+        super().__init__(str(cause))
+        self.batch = batch
+        self.cause = cause
+
+
 class _Request:
     __slots__ = ("x", "fut", "model", "deadline", "t_enqueue", "ctx",
                  "seq_key")
@@ -91,7 +108,9 @@ class ContinuousBatchingScheduler:
                  max_batch_size: int = 64, queue_capacity: int = 256,
                  policy: str = AdmissionPolicy.BLOCK,
                  default_deadline_ms: Optional[float] = None,
-                 slots: int = 1, block_timeout_s: float = 30.0):
+                 slots: int = 1, block_timeout_s: float = 30.0,
+                 max_worker_restarts: int = 3,
+                 worker_restart_backoff_s: float = 0.05):
         if policy not in AdmissionPolicy.ALL:
             raise ValueError(
                 f"admission policy must be one of {AdmissionPolicy.ALL}, "
@@ -107,11 +126,20 @@ class ContinuousBatchingScheduler:
         self.default_deadline = (default_deadline_ms / 1e3
                                  if default_deadline_ms else None)
         self.block_timeout = block_timeout_s
+        # worker supervision: a crashed slot restarts with doubling
+        # backoff; after max_worker_restarts consecutive crashes the held
+        # batch is failed (WorkerCrashError) instead of retried forever
+        self.max_worker_restarts = max(0, int(max_worker_restarts))
+        self.worker_restart_backoff = float(worker_restart_backoff_s)
         self._cv = threading.Condition()
         self._queues: Dict[str, deque] = {}
         self._depth = 0
         self._inflight = 0
         self._closed = False
+        # chaos seam (inject_worker_fault): raise in the next N worker
+        # iterations right after a batch is taken — guarded by self._cv
+        self._fault_budget = 0
+        self._fault_exc = None
         self._workers = [
             threading.Thread(target=self._worker, daemon=True,
                              name=f"serving-slot-{i}")
@@ -222,9 +250,84 @@ class ContinuousBatchingScheduler:
         self._depth -= len(batch)
         return batch
 
+    def inject_worker_fault(self, *, times: int = 1,
+                            exc_factory=None) -> None:
+        """Chaos seam: make the next `times` worker iterations crash
+        right after taking a batch — the thread-death scenario the
+        supervisor exists for, injectable deterministically on CPU
+        (tests/test_serving_failover)."""
+        from deeplearning4j_tpu.parallel.chaos import InjectedFault
+        with self._cv:
+            self._fault_budget = int(times)
+            self._fault_exc = exc_factory or (
+                lambda: InjectedFault("injected worker crash"))
+
     def _worker(self):
-        try:
-            while True:
+        """Supervisor: before ISSUE 6 a crash here killed the daemon
+        thread silently and the slot went dark — every later request
+        hung until its deadline. Now the slot survives: the held batch
+        is requeued at the FRONT (order preserved), the crash is
+        flight-dumped and counted (`serving_worker_restarts_total`), and
+        the loop restarts after a doubling backoff. A crash LOOP is
+        bounded: after `max_worker_restarts` consecutive crashes the
+        held batch fails with WorkerCrashError and the slot moves on."""
+        streak = [0]               # consecutive crashes; dispatch resets
+        backoff = self.worker_restart_backoff
+        while True:
+            try:
+                self._worker_loop(streak)
+                return             # clean shutdown
+            except _WorkerCrashed as wc:
+                batch, cause = wc.batch, wc.cause
+            streak[0] += 1
+            self.stats.worker_restarted()
+            # a dead worker thread is a silent serving outage (daemon
+            # threads die without a traceback anyone keeps): black box
+            # first, then recover
+            try:
+                from deeplearning4j_tpu.observe.flight import get_flight
+                get_flight().dump("scheduler_worker_crash", exc=cause)
+            # graft: allow(GL403): the dump is best-effort forensics;
+            # the restart below is the payload
+            except Exception:
+                pass
+            if streak[0] > self.max_worker_restarts:
+                for r in batch:
+                    if not r.fut.done():
+                        r.fut.set_exception(WorkerCrashError(
+                            f"worker crashed {streak[0]} consecutive "
+                            f"times holding this batch: {cause!r}"))
+                    self.stats.completed(r.model, 0.0, ok=False)
+                streak[0] = 0
+                backoff = self.worker_restart_backoff
+                continue
+            if batch:
+                self._requeue(batch)
+            time.sleep(backoff)
+            backoff = min(backoff * 2.0, 1.0)
+
+    def _requeue(self, batch) -> None:
+        """Put a crashed worker's batch back at the head of its queue
+        (oldest request first, so FIFO order survives the restart)."""
+        with self._cv:
+            if self._closed:
+                closed = list(batch)
+            else:
+                closed = []
+                q = self._queues.setdefault(batch[0].model, deque())
+                for r in reversed(batch):
+                    q.appendleft(r)
+                self._depth += len(batch)
+            self._cv.notify_all()
+        for r in closed:        # raced shutdown: fail, don't strand
+            if not r.fut.done():
+                r.fut.set_exception(SchedulerClosedError(
+                    "scheduler shut down while recovering this request"))
+            self.stats.completed(r.model, 0.0, ok=False)
+
+    def _worker_loop(self, streak):
+        while True:
+            try:
                 with self._cv:
                     while not self._closed and self._depth == 0:
                         self._cv.wait()
@@ -232,25 +335,29 @@ class ContinuousBatchingScheduler:
                         return
                     batch = self._take_batch()
                     self._inflight += 1
+                    if self._fault_budget > 0:
+                        self._fault_budget -= 1
+                        fault = self._fault_exc()
+                    else:
+                        fault = None
                     self._cv.notify_all()   # wake admission waiters
-                try:
-                    self._dispatch(batch)
-                finally:
-                    with self._cv:
-                        self._inflight -= 1
-                        self._cv.notify_all()
-        except BaseException as e:
-            # a dead worker thread is a silent serving outage (daemon
-            # threads die without a traceback anyone keeps): leave the
-            # black box before propagating
+            except BaseException as e:
+                # a crash in the take phase holds no batch yet; it still
+                # must reach the supervisor, not kill the thread
+                raise _WorkerCrashed([], e) from e
             try:
-                from deeplearning4j_tpu.observe.flight import get_flight
-                get_flight().dump("scheduler_worker_crash", exc=e)
-            # graft: allow(GL403): the dump is best-effort forensics;
-            # the original worker crash must propagate unmasked
-            except Exception:
-                pass
-            raise
+                if fault is not None:
+                    raise fault
+                self._dispatch(batch)
+                streak[0] = 0          # healthy dispatch ends the streak
+            except _WorkerCrashed:
+                raise
+            except BaseException as e:
+                raise _WorkerCrashed(batch, e) from e
+            finally:
+                with self._cv:
+                    self._inflight -= 1
+                    self._cv.notify_all()
 
     def _dispatch(self, batch):
         now = time.monotonic()

@@ -29,6 +29,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "slow: long-running scale tests (always on in CI; "
         "deselect locally with -m 'not slow')")
+    config.addinivalue_line(
+        "markers", "chaos: fault-injection / recovery tests "
+        "(tools/ci_check.sh --chaos runs exactly these)")
 
 
 @pytest.fixture(scope="session")

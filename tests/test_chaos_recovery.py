@@ -1,0 +1,438 @@
+"""Preemption-proof training under injected faults (ISSUE 6 tentpole).
+
+Acceptance: a SIGTERM (or injected fault) at an arbitrary step mid-epoch,
+followed by re-running the same fit(), produces a loss curve bit-identical
+to an uninterrupted run — for MultiLayerNetwork, ComputationGraph, AND
+ParallelWrapper — while `LossTracker.host_syncs` confirms the ≤1
+sync/epoch contract survived the checkpoint cadence.
+
+Every fault here comes from `parallel/chaos.py` (deterministic on CPU):
+SIGTERM-at-step-N, checkpoint-writer IO errors at exact file boundaries
+(the COMMIT protocol), iterator crashes/stalls, plus elastic shrink and
+off-main-thread preemption degrade.
+"""
+
+import os
+import threading
+
+import numpy as np
+import pytest
+from jax.sharding import Mesh
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.models import ComputationGraph, MultiLayerNetwork
+from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+from deeplearning4j_tpu.observe.flight import (
+    FlightRecorder, get_flight, set_flight,
+)
+from deeplearning4j_tpu.optim.updaters import Adam
+from deeplearning4j_tpu.parallel import (
+    CheckpointIOFault, FailingIterator, InjectedFault, ParallelWrapper,
+    ShardedCheckpointer, SigtermAtStep, StallingIterator,
+)
+from deeplearning4j_tpu.parallel.elastic import PreemptionHandler
+from deeplearning4j_tpu.parallel.mesh import AXIS_DATA
+
+pytestmark = pytest.mark.chaos
+
+
+def _net(seed=7):
+    return MultiLayerNetwork(
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .updater(Adam(1e-2))
+        .list(
+            DenseLayer(n_in=12, n_out=16, activation="relu"),
+            OutputLayer(n_in=16, n_out=4, activation="softmax",
+                        loss="mcxent"),
+        )
+        .build()
+    ).init()
+
+
+def _graph(seed=7):
+    return ComputationGraph(
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .updater(Adam(1e-2))
+        .graph_builder()
+        .add_inputs("in")
+        .add_layer("dense", DenseLayer(n_out=16, activation="relu"), "in")
+        .add_layer("out", OutputLayer(n_out=4, activation="softmax",
+                                      loss="mcxent"), "dense")
+        .set_outputs("out")
+        .set_input_types(InputType.feed_forward(12))
+        .build()
+    ).init()
+
+
+def _data(n=256, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, 12)).astype(np.float32)
+    yi = rng.integers(0, 4, n)
+    x[np.arange(n), yi % 12] += 2.0
+    return x, np.eye(4, dtype=np.float32)[yi]
+
+
+def _batches(x, y, bs=64):
+    return [DataSet(x[i:i + bs], y[i:i + bs]) for i in range(0, len(x), bs)]
+
+
+class _Rec:
+    """Loss-curve listener (losses stay deferred — no host sync)."""
+
+    def __init__(self):
+        self.losses = []
+
+    def iteration_done(self, net, it, ep, loss):
+        self.losses.append(loss)
+
+    def __getattr__(self, name):
+        if name.startswith("on_"):
+            return lambda *a, **k: None
+        raise AttributeError(name)
+
+
+def _curve(losses):
+    return [float(v) for v in losses]
+
+
+# ---------------------------------------------------------------- tentpole
+@pytest.mark.slow
+class TestResumeBitIdentical:
+    """Kill mid-epoch, re-run the same fit() → identical loss curve."""
+
+    def test_mln_real_sigterm_mid_epoch(self, tmp_path):
+        x, y = _data()
+
+        ref_net, ref = _net(), _Rec()
+        ref_net.listeners.append(ref)
+        ref_net.fit(x, y, epochs=2, batch_size=64)       # 4 batches/epoch
+        assert ref_net._loss_tracker.host_syncs <= 2     # ≤1 sync/epoch
+
+        # interrupted: a REAL SIGTERM lands after iteration 3 completes;
+        # preemption=True installs the plan-owned handler for the fit
+        net_b, rec_b = _net(), _Rec()
+        sig = SigtermAtStep(3)
+        net_b.listeners += [rec_b, sig]
+        ck = ShardedCheckpointer(str(tmp_path / "ck"))
+        net_b.fit(x, y, epochs=2, batch_size=64,
+                  checkpointer=ck, preemption=True)
+        assert sig.fired and net_b.stopped_early
+        assert len(rec_b.losses) == 3
+        assert net_b._loss_tracker.host_syncs <= 2
+        assert ck.latest_step() == 3
+
+        # same fit() again, resume="auto": picks up at (step 3, batch 3)
+        net_c, rec_c = _net(seed=99), _Rec()   # init gets overwritten
+        net_c.listeners.append(rec_c)
+        ck2 = ShardedCheckpointer(str(tmp_path / "ck"))
+        net_c.fit(x, y, epochs=2, batch_size=64,
+                  checkpointer=ck2, resume="auto")
+        assert len(rec_c.losses) == 5
+        assert net_c._loss_tracker.host_syncs <= 2
+        np.testing.assert_allclose(
+            _curve(rec_b.losses) + _curve(rec_c.losses),
+            _curve(ref.losses), rtol=1e-6, atol=1e-7)
+
+    def test_cg_stop_fn_mid_epoch(self, tmp_path):
+        x, y = _data()
+
+        ref_net, ref = _graph(), _Rec()
+        ref_net.listeners.append(ref)
+        ref_net.fit(x, y, epochs=2, batch_size=64)
+        assert ref_net._loss_tracker.host_syncs <= 2
+
+        net_b, rec_b = _graph(), _Rec()
+        net_b.listeners.append(rec_b)
+        ck = ShardedCheckpointer(str(tmp_path / "ck"))
+        net_b.fit(x, y, epochs=2, batch_size=64, checkpointer=ck,
+                  stop_fn=lambda: len(rec_b.losses) >= 3)
+        assert net_b.stopped_early and len(rec_b.losses) == 3
+        assert net_b._loss_tracker.host_syncs <= 2
+
+        net_c, rec_c = _graph(seed=99), _Rec()
+        net_c.listeners.append(rec_c)
+        ck2 = ShardedCheckpointer(str(tmp_path / "ck"))
+        net_c.fit(x, y, epochs=2, batch_size=64,
+                  checkpointer=ck2, resume="auto")
+        assert len(rec_c.losses) == 5
+        assert net_c._loss_tracker.host_syncs <= 2
+        np.testing.assert_allclose(
+            _curve(rec_b.losses) + _curve(rec_c.losses),
+            _curve(ref.losses), rtol=1e-6, atol=1e-7)
+
+    def test_parallel_wrapper_fused_partial_window_resume(
+            self, tmp_path, devices8):
+        """steps_per_dispatch=4 with a stop landing MID-window: the
+        executor drains the partial window per-step, the checkpoint
+        records the exact cursor, and the resumed run (which replays the
+        window tail per-step too) continues the rng chain bit-identically."""
+        mesh = Mesh(np.array(devices8), (AXIS_DATA,))
+        x, y = _data(n=512)                              # 8 batches/epoch
+
+        net_a, rec_a = _net(), _Rec()
+        net_a.listeners.append(rec_a)
+        wa = ParallelWrapper(net_a, mesh=mesh)
+        wa.fit(x, y, epochs=2, batch_size=64, steps_per_dispatch=4)
+        assert len(rec_a.losses) == 16
+        assert net_a._loss_tracker.host_syncs <= 2
+
+        # stop at the 7th batch boundary → batches 4,5 are a buffered
+        # partial window at stop time
+        net_b, rec_b = _net(), _Rec()
+        net_b.listeners.append(rec_b)
+        wb = ParallelWrapper(net_b, mesh=mesh)
+        ck = ShardedCheckpointer(str(tmp_path / "ck"))
+        calls = [0]
+
+        def stop_fn():
+            calls[0] += 1
+            return calls[0] > 6
+
+        wb.fit(x, y, epochs=2, batch_size=64, steps_per_dispatch=4,
+               checkpointer=ck, stop_fn=stop_fn)
+        assert wb.stopped_early and len(rec_b.losses) == 6
+        assert net_b._loss_tracker.host_syncs <= 2
+        ck.wait()
+        assert ck.latest_step() == 6
+
+        net_c, rec_c = _net(seed=99), _Rec()
+        net_c.listeners.append(rec_c)
+        wc = ParallelWrapper(net_c, mesh=mesh)
+        ck2 = ShardedCheckpointer(str(tmp_path / "ck"))
+        wc.fit(x, y, epochs=2, batch_size=64, steps_per_dispatch=4,
+               checkpointer=ck2, resume="auto")
+        assert len(rec_c.losses) == 10
+        assert net_c._loss_tracker.host_syncs <= 2
+        np.testing.assert_allclose(
+            _curve(rec_b.losses) + _curve(rec_c.losses),
+            _curve(rec_a.losses), rtol=1e-6, atol=1e-7)
+
+
+# ---------------------------------------------------------- COMMIT protocol
+class TestCommitProtocol:
+    def test_half_written_step_invisible_and_latch_drains(self, tmp_path):
+        """Writer dies after the FIRST shard file: the step never gets a
+        COMMIT so it is invisible to steps(); wait() surfaces the error
+        exactly once (the latch drains)."""
+        net = _net()
+        ck = ShardedCheckpointer(str(tmp_path / "ck"), async_save=True)
+        ck.fault_hook = fault = CheckpointIOFault(fail_after=1,
+                                                  kind="shard", times=1)
+        ck.save(net, step=1)            # dies after one shard file
+        ck.save(net, step=2)            # fault budget spent → commits
+        with pytest.raises(InjectedFault):
+            ck.wait()
+        ck.wait()                       # latch drained: second wait clean
+        assert fault.raised == 1
+        assert ck.steps() == [2]        # half-written step 1 is invisible
+        half = tmp_path / "ck" / "step-0000000001" / "process-0"
+        assert half.is_dir() and not (half / "COMMIT").exists()
+        # and the half-written step is not restorable
+        with pytest.raises(FileNotFoundError):
+            ck._read_step(1)
+
+    @pytest.mark.slow
+    def test_resume_picks_previous_committed_step(self, tmp_path):
+        """Kill the writer mid-write of the LAST checkpoint: resume lands
+        on the previous committed step and retrains the lost batch to a
+        bit-identical curve."""
+        x, y = _data()
+
+        ref_net, ref = _net(), _Rec()
+        ref_net.listeners.append(ref)
+        ref_net.fit(x, y, epochs=1, batch_size=64)       # 4 losses
+
+        net_b, rec_b = _net(), _Rec()
+        net_b.listeners.append(rec_b)
+        ck = ShardedCheckpointer(str(tmp_path / "ck"))
+        inner = CheckpointIOFault(fail_after=1, kind="shard", times=1)
+
+        def hook(kind, path):            # kill only step 4's write
+            if f"step-{4:010d}" in path:
+                inner(kind, path)
+
+        ck.fault_hook = hook
+        # training itself succeeds; finalize's wait() surfaces the
+        # writer death (a silently failed checkpoint is a lost run)
+        with pytest.raises(InjectedFault):
+            net_b.fit(x, y, epochs=1, batch_size=64, checkpointer=ck)
+        assert len(rec_b.losses) == 4
+        assert ck.steps() == [1, 2, 3]   # step 4 never committed
+        assert ck.latest_step() == 3
+
+        net_c, rec_c = _net(seed=99), _Rec()
+        net_c.listeners.append(rec_c)
+        ck2 = ShardedCheckpointer(str(tmp_path / "ck"))
+        net_c.fit(x, y, epochs=1, batch_size=64,
+                  checkpointer=ck2, resume="auto")
+        assert len(rec_c.losses) == 1    # retrains exactly the lost batch
+        np.testing.assert_allclose(
+            _curve(rec_b.losses[:3]) + _curve(rec_c.losses),
+            _curve(ref.losses), rtol=1e-6, atol=1e-7)
+
+
+# ------------------------------------------------- input-pipeline faults
+@pytest.mark.slow
+class TestDataPipelineFaults:
+    def test_iterator_crash_dumps_flight_and_resume_breadcrumbs(
+            self, tmp_path):
+        """A data-pipeline crash flight-dumps the black box; the resumed
+        run records a `resume` event pointing at the prior dump."""
+        prev = set_flight(FlightRecorder(dump_dir=str(tmp_path)))
+        try:
+            x, y = _data()
+            batches = _batches(x, y)
+
+            ref_net, ref = _net(), _Rec()
+            ref_net.listeners.append(ref)
+            ref_net.fit(batches, epochs=1)
+
+            net_b, rec_b = _net(), _Rec()
+            net_b.listeners.append(rec_b)
+            ck = ShardedCheckpointer(str(tmp_path / "ck"))
+            with pytest.raises(InjectedFault):
+                net_b.fit(FailingIterator(batches, fail_at=2),
+                          epochs=1, checkpointer=ck)
+            assert len(rec_b.losses) == 2
+            dumps = [n for n in os.listdir(tmp_path)
+                     if n.startswith("flight_") and n.endswith(".json")]
+            assert len(dumps) == 1 and "training_exception" in dumps[0]
+
+            net_c, rec_c = _net(seed=99), _Rec()
+            net_c.listeners.append(rec_c)
+            ck2 = ShardedCheckpointer(str(tmp_path / "ck"))
+            net_c.fit(batches, epochs=1, checkpointer=ck2, resume="auto")
+            assert len(rec_c.losses) == 2
+            np.testing.assert_allclose(
+                _curve(rec_b.losses) + _curve(rec_c.losses),
+                _curve(ref.losses), rtol=1e-6, atol=1e-7)
+            # the restart carries its predecessor's black box
+            resumes = [e for e in get_flight().events()
+                       if e["kind"] == "resume"]
+            assert resumes and resumes[-1]["data"]["prior_dump"] == \
+                os.path.join(str(tmp_path), dumps[0])
+        finally:
+            set_flight(prev)
+
+    def test_stalling_iterator_is_ordinary_etl_time(self, tmp_path):
+        """A slow pipeline must not trip any recovery machinery."""
+        x, y = _data()
+        batches = _batches(x, y)
+
+        ref_net, ref = _net(), _Rec()
+        ref_net.listeners.append(ref)
+        ref_net.fit(batches, epochs=1)
+
+        net, rec = _net(), _Rec()
+        net.listeners.append(rec)
+        stalling = StallingIterator(batches, stall_at=1, stall_s=0.2)
+        net.fit(stalling, epochs=1,
+                checkpointer=ShardedCheckpointer(str(tmp_path / "ck")))
+        assert stalling.stalled == 1 and not net.stopped_early
+        np.testing.assert_allclose(_curve(rec.losses), _curve(ref.losses),
+                                   rtol=1e-6, atol=1e-7)
+
+
+# ----------------------------------------------------------- elastic shrink
+@pytest.mark.slow
+class TestElasticShrink:
+    def test_restore_8_device_snapshot_onto_4_devices(
+            self, tmp_path, devices8):
+        """A snapshot taken on 8 devices restores onto a 4-device mesh
+        (global arrays re-assembled from shards, re-sharded onto the
+        smaller mesh) and training continues."""
+        from jax.sharding import PartitionSpec as P
+
+        from deeplearning4j_tpu.parallel.sharding import ShardingRules
+
+        rules = ShardingRules(rules=[("*dense*", "W", P(None, AXIS_DATA)),
+                                     ("*dense*", "b", P(AXIS_DATA))])
+        x, y = _data()
+
+        mesh8 = Mesh(np.array(devices8), (AXIS_DATA,))
+        net_a = _net()
+        wa = ParallelWrapper(net_a, mesh=mesh8, param_rules=rules)
+        ck = ShardedCheckpointer(str(tmp_path / "ck"))
+        wa.fit(x, y, epochs=1, batch_size=64, checkpointer=ck)
+        ck.wait()
+
+        mesh4 = Mesh(np.array(devices8[:4]), (AXIS_DATA,))
+        net_c = _net(seed=99)
+        wc = ParallelWrapper(net_c, mesh=mesh4, param_rules=rules)
+        pos = ck.restore_into_wrapper(wc)
+        assert pos["batch_in_epoch"] == 4
+        assert net_c.iteration == net_a.iteration
+        for lname, sub in net_a.params_tree.items():
+            for k, v in sub.items():
+                np.testing.assert_array_equal(
+                    np.asarray(v), np.asarray(net_c.params_tree[lname][k]))
+        # the restored FSDP leaf lives on the SMALLER mesh now
+        leaf = net_c.params_tree["layer0_denselayer"]["W"]
+        idxs = {tuple((sl.start, sl.stop) for sl in s.index)
+                for s in leaf.addressable_shards}
+        assert len(idxs) == 4
+        rec = _Rec()
+        net_c.listeners.append(rec)
+        wc.fit(x, y, epochs=2, batch_size=64, resume=pos)
+        assert len(rec.losses) == 4      # epoch 0 replayed, epoch 1 trained
+        assert all(np.isfinite(v) for v in _curve(rec.losses))
+
+
+# ------------------------------------------------ preemption degrade path
+class TestPreemptionDegrade:
+    def test_install_off_main_thread_degrades_gracefully(self):
+        res = {}
+
+        def worker():
+            h = PreemptionHandler()
+            try:
+                h.install()              # signal.signal → ValueError here
+                res["degraded"] = h.degraded
+                h.request_stop()         # programmatic path still works
+                res["preempted"] = h.preempted
+            finally:
+                h.uninstall()
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join(10)
+        assert res == {"degraded": True, "preempted": True}
+
+    @pytest.mark.slow
+    def test_fit_with_degraded_handler_stops_via_request_stop(self):
+        """The whole fit runs on a worker thread (threaded serving/test
+        runners): install() degrades instead of crashing the fit, and
+        SigtermAtStep's request_stop() delivery still preempts."""
+        x, y = _data()
+        res = {}
+
+        def worker():
+            handler = PreemptionHandler().install()
+            net, rec = _net(), _Rec()
+            sig = SigtermAtStep(2, handler=handler)
+            net.listeners += [rec, sig]
+            net.fit(x, y, epochs=2, batch_size=64, preemption=handler)
+            res.update(degraded=handler.degraded, fired=sig.fired,
+                       stopped=net.stopped_early, losses=len(rec.losses))
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join(120)
+        assert not t.is_alive()
+        assert res == {"degraded": True, "fired": True,
+                       "stopped": True, "losses": 2}
+
+
+class TestResumeValidation:
+    def test_resume_auto_without_checkpointer_raises(self):
+        """`resume="auto"` with no checkpointer can never restore
+        anything — silently training from scratch would masquerade as a
+        resume, so it must fail loudly at the fit() call."""
+        x, y = _data(n=64)
+        net = _net()
+        with pytest.raises(ValueError, match="nothing to restore"):
+            net.fit(x, y, epochs=1, batch_size=64, resume="auto")

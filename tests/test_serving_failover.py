@@ -1,0 +1,204 @@
+"""Serving failover (ISSUE 6): deploy rollback on a bad new version, and
+supervised scheduler workers that survive crashes.
+
+Acceptance: a deploy whose warmup trips the recompile watchdog (or
+raises) leaves the previous version serving; a crashed batching worker is
+restarted with bounded backoff and the event is visible in /metrics.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.observe.flight import FlightRecorder, set_flight
+from deeplearning4j_tpu.observe.watchdog import (
+    RecompileWatchdog, set_watchdog,
+)
+from deeplearning4j_tpu.parallel.chaos import InjectedFault
+from deeplearning4j_tpu.serving import (
+    ContinuousBatchingScheduler, DeployRolledBackError, ModelRegistry,
+    WorkerCrashError,
+)
+
+pytestmark = pytest.mark.chaos
+
+
+def _make_net(seed):
+    from deeplearning4j_tpu import InputType
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+    return MultiLayerNetwork(
+        (NeuralNetConfiguration.builder()
+         .seed(seed).list(DenseLayer(n_out=8, activation="relu"),
+                          OutputLayer(n_out=2, activation="softmax"))
+         .set_input_type(InputType.feed_forward(4))
+         .build())).init()
+
+
+# ----------------------------------------------------------- fakes (fast)
+class FakeEntry:
+    def __init__(self, version=1):
+        self.version = version
+        self.batches = []
+
+    def run_batch(self, xs):
+        self.batches.append(int(np.asarray(xs).shape[0]))
+        return np.asarray(xs) * 2.0
+
+
+class FakeRegistry:
+    def __init__(self, entry):
+        self.entry = entry
+
+    def acquire(self, name):
+        return self.entry
+
+    def release(self, entry):
+        pass
+
+    def close(self):
+        pass
+
+
+# ------------------------------------------------------- deploy rollback
+@pytest.mark.slow
+class TestDeployRollback:
+    def test_watchdog_trip_rolls_back_to_serving_version(self, tmp_path):
+        """Warmup is the canary: v2's bucketed warmup (3 compiles) trips
+        a threshold-1 watchdog → the flip never happens, v1 keeps
+        serving, and the rollback is counted + flight-recorded."""
+        prev_wd = set_watchdog(RecompileWatchdog(threshold=1))
+        prev_fl = set_flight(FlightRecorder(dump_dir=str(tmp_path)))
+        reg = ModelRegistry(max_batch_size=8, batch_buckets=[1, 4, 8])
+        try:
+            net1, net2 = _make_net(0), _make_net(1)
+            # FIRST deploy also trips (3 compiles ≥ 1) but there is
+            # nothing to roll back to → degraded beats dark
+            reg.deploy("m", 1, net1, feat_shape=(4,))
+            assert reg.get("m").version == 1
+
+            with pytest.raises(DeployRolledBackError, match="watchdog"):
+                reg.deploy("m", 2, net2, feat_shape=(4,))
+
+            entry = reg.get("m")
+            assert entry.version == 1 and not entry._retired
+            out = entry.run_batch(np.ones((2, 4), np.float32))
+            assert np.asarray(out).shape == (2, 2)   # v1 still serves
+
+            from deeplearning4j_tpu.observe import get_flight, get_registry
+            n = get_registry().counter("serving_deploy_rollbacks_total",
+                                       model="m").value
+            assert n >= 1
+            kinds = [e["kind"] for e in get_flight().events()]
+            assert "deploy_rollback" in kinds
+        finally:
+            reg.close()
+            set_watchdog(prev_wd)
+            set_flight(prev_fl)
+
+    def test_warmup_exception_rolls_back_even_first_deploy(self):
+        reg = ModelRegistry(max_batch_size=8, batch_buckets=[1, 4, 8])
+        try:
+            net1, net2 = _make_net(0), _make_net(1)
+            # first deploy with a broken feat shape: nothing to keep, but
+            # a crashing version must never go live either
+            with pytest.raises(DeployRolledBackError, match="raised"):
+                reg.deploy("m", 1, net1, feat_shape=(999,))
+            assert reg.names() == []
+
+            reg.deploy("m", 1, net1, warm=False)
+            with pytest.raises(DeployRolledBackError, match="raised"):
+                reg.deploy("m", 2, net2, feat_shape=(999,))
+            assert reg.get("m").version == 1
+        finally:
+            reg.close()
+
+
+# -------------------------------------------------- worker supervision
+class TestWorkerSupervision:
+    def test_crashed_worker_restarts_and_request_completes(self, tmp_path):
+        """A worker crash mid-hold: the batch is requeued at the queue
+        head, the restarted slot serves it, and the restart is counted
+        in /metrics + flight-dumped."""
+        prev_fl = set_flight(FlightRecorder(dump_dir=str(tmp_path)))
+        entry = FakeEntry()
+        sched = ContinuousBatchingScheduler(
+            FakeRegistry(entry), max_batch_size=8, queue_capacity=16,
+            worker_restart_backoff_s=0.01)
+        try:
+            sched.inject_worker_fault(times=1)
+            fut = sched.submit("m", np.ones((2, 2)))
+            got = np.asarray(fut.result(10))       # survived the crash
+            np.testing.assert_allclose(got, np.ones((2, 2)) * 2.0)
+            snap = sched.stats.snapshot()
+            assert snap["workers"]["restarts"] == 1
+            assert snap["requests"]["completed"] == 1
+            assert int(sched.stats.registry.counter(
+                "serving_worker_restarts_total").value) == 1
+            from deeplearning4j_tpu.observe import get_flight
+            assert any("scheduler_worker_crash" in p
+                       for p in get_flight().dumps)
+        finally:
+            sched.shutdown()
+            set_flight(prev_fl)
+
+    def test_crash_loop_bounded_slot_stays_alive(self, tmp_path):
+        """max_worker_restarts consecutive crashes → the held batch fails
+        with WorkerCrashError instead of retrying forever, and the SLOT
+        keeps serving new work afterwards."""
+        prev_fl = set_flight(FlightRecorder(dump_dir=str(tmp_path),
+                                            enabled=False))
+        entry = FakeEntry()
+        sched = ContinuousBatchingScheduler(
+            FakeRegistry(entry), max_batch_size=8, queue_capacity=16,
+            max_worker_restarts=2, worker_restart_backoff_s=0.01)
+        try:
+            sched.inject_worker_fault(
+                times=3, exc_factory=lambda: InjectedFault("persistent"))
+            doomed = sched.submit("m", np.ones((1, 2)))
+            with pytest.raises(WorkerCrashError):
+                doomed.result(10)
+            assert sched.stats.snapshot()["workers"]["restarts"] == 3
+            # the slot is alive: the very next request is served
+            ok = sched.submit("m", np.ones((1, 2)))
+            np.testing.assert_allclose(np.asarray(ok.result(10)),
+                                       np.ones((1, 2)) * 2.0)
+            snap = sched.stats.snapshot()
+            assert snap["requests"]["failed"] == 1
+            assert snap["requests"]["completed"] == 1
+        finally:
+            sched.shutdown()
+            set_flight(prev_fl)
+
+    def test_requeue_preserves_fifo_order(self, tmp_path):
+        """Requests queued behind the crashed batch still complete, in
+        order, after the restart."""
+        prev_fl = set_flight(FlightRecorder(dump_dir=str(tmp_path),
+                                            enabled=False))
+        order = []
+        lock = threading.Lock()
+
+        class OrderedEntry(FakeEntry):
+            def run_batch(self, xs):
+                with lock:
+                    order.append(int(np.asarray(xs)[0, 0]))
+                return super().run_batch(xs)
+
+        entry = OrderedEntry()
+        sched = ContinuousBatchingScheduler(
+            FakeRegistry(entry), max_batch_size=1, queue_capacity=16,
+            worker_restart_backoff_s=0.01)
+        try:
+            sched.inject_worker_fault(times=1)
+            futs = [sched.submit("m", np.full((1, 2), float(i)))
+                    for i in range(4)]
+            for f in futs:
+                f.result(10)
+            assert order == [0, 1, 2, 3]
+            assert sched.stats.snapshot()["workers"]["restarts"] == 1
+        finally:
+            sched.shutdown()
+            set_flight(prev_fl)

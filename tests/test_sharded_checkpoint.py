@@ -195,3 +195,53 @@ class TestShardedCheckpointer:
         ck = ShardedCheckpointer(str(tmp_path / "empty"))
         with pytest.raises(FileNotFoundError):
             ck.restore_into(_net())
+
+    def test_wait_error_latch_drains(self, tmp_path):
+        """One failed write surfaces exactly once; it must not poison
+        every later wait() (ISSUE 6 satellite)."""
+        from deeplearning4j_tpu.parallel.chaos import (
+            CheckpointIOFault, InjectedFault,
+        )
+
+        net = _net()
+        ck = ShardedCheckpointer(str(tmp_path / "ck"), async_save=True)
+        ck.fault_hook = CheckpointIOFault(fail_after=0, kind="manifest",
+                                          times=1)
+        ck.save(net, step=1)
+        with pytest.raises(InjectedFault):
+            ck.wait()
+        ck.wait()                       # latch drained — no stale error
+        ck.save(net, step=2)            # writer thread is still healthy
+        ck.wait()
+        assert ck.latest_step() == 2
+
+    def test_rotation_never_deletes_pinned_step(self, tmp_path):
+        """A step being read by a racing restore is pinned; rotation must
+        skip it instead of deleting it under the reader."""
+        net = _net()
+        ck = ShardedCheckpointer(str(tmp_path / "ck"), max_to_keep=1,
+                                 async_save=False)
+        ck.save(net, step=1)
+        with ck._state_lock:            # a restore holds step 1 open
+            ck._pinned.add(1)
+        ck.save(net, step=2)
+        ck.save(net, step=3)
+        assert 1 in ck.steps()          # survived two rotations
+        assert 3 in ck.steps()
+        ck._read_step(1)                # still fully readable
+        with ck._state_lock:            # reader done → next rotate culls
+            ck._pinned.discard(1)
+        ck.save(net, step=4)
+        assert ck.steps() == [4]
+
+    def test_steps_tolerates_stray_and_uncommitted_entries(self, tmp_path):
+        """`steps()` runs concurrently with the writer's rotation: stray
+        files matching the step prefix and uncommitted/vanishing dirs are
+        simply not candidates — never an exception."""
+        net = _net()
+        ck = ShardedCheckpointer(str(tmp_path / "ck"), async_save=False)
+        ck.save(net, step=1)
+        (tmp_path / "ck" / "step-stray").write_text("not a dir")
+        os.makedirs(tmp_path / "ck" / "step-0000000099")  # no COMMIT
+        assert ck.steps() == [1]
+        assert ck.latest_step() == 1

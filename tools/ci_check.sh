@@ -4,6 +4,7 @@
 #
 #   tools/ci_check.sh            # full gate
 #   tools/ci_check.sh --lint     # lint gate only (seconds)
+#   tools/ci_check.sh --chaos    # fault-injection / failover suite only
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -12,6 +13,14 @@ python -m deeplearning4j_tpu.analysis deeplearning4j_tpu tests \
     --strict --baseline .graftlint-baseline.json
 
 if [[ "${1:-}" == "--lint" ]]; then
+    exit 0
+fi
+
+if [[ "${1:-}" == "--chaos" ]]; then
+    echo "== chaos / failover suite (-m chaos, includes slow) =="
+    env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python -m pytest tests/ -q \
+        -m chaos --continue-on-collection-errors \
+        -p no:cacheprovider -p no:xdist -p no:randomly
     exit 0
 fi
 
