@@ -33,6 +33,12 @@ continuous-batching scheduler against the legacy collect-then-run loop
 (throughput + p50/p95/p99 + batch occupancy, reconciled against
 /metrics); writes BENCH_serving.json (see _serving_main; knobs:
 BENCH_SERVING_CLIENTS/SECS/ROWS/MAX_BATCH/TPU/OUT).
+`python bench.py --serving-decode` (or BENCH_SERVING_DECODE=1) runs the
+closed-loop prompt→stream decode workload against POST /generate:
+tokens/sec + p99 TTFT/ITL reconciled against the /metrics decode
+section, zero-recompiles-after-warmup asserted; writes
+BENCH_serving_decode.json (see _serving_decode_main; knobs:
+BENCH_DECODE_CLIENTS/ROUNDS/MAX_TOKENS/PROMPT/PREFILL_CHUNK/OUT).
 """
 
 from __future__ import annotations
@@ -1023,7 +1029,179 @@ def _serving_main():
     print(json.dumps(out))
 
 
+def _serving_decode_main():
+    """`--serving-decode` mode: closed-loop prompt→stream workload
+    against POST /generate — N concurrent clients, each opening a
+    session, reading its SSE token stream to completion, and
+    immediately opening the next (closed loop). Reports device-truth
+    decode serving numbers:
+
+      tokens/sec        aggregate streamed tokens over wall time
+      TTFT p50/p99      request-start → first token (client-side)
+      ITL p50/p99       gap between consecutive streamed tokens
+
+    and reconciles them against the server's /metrics decode section
+    (tokens_streamed, session outcomes, shared-dispatch counters) plus
+    the recompile watchdog: after the manager's warmup, session churn
+    must cause ZERO compiles (the fixed-shape decode contract). Emits
+    one JSON line AND writes BENCH_serving_decode.json
+    (BENCH_DECODE_OUT overrides)."""
+    import jax
+
+    if not os.environ.get("BENCH_SERVING_TPU"):
+        jax.config.update("jax_platforms", "cpu")
+
+    import threading
+    import urllib.request
+
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.inputs import InputType
+    from deeplearning4j_tpu.nn.layers.attention import (
+        PositionEmbeddingLayer, TransformerEncoderBlock,
+    )
+    from deeplearning4j_tpu.nn.layers.feedforward import (
+        EmbeddingSequenceLayer,
+    )
+    from deeplearning4j_tpu.nn.layers.recurrent import RnnOutputLayer
+    from deeplearning4j_tpu.observe.watchdog import get_watchdog
+    from deeplearning4j_tpu.optim.updaters import Adam
+    from deeplearning4j_tpu.serving import InferenceServer
+
+    clients = int(os.environ.get("BENCH_DECODE_CLIENTS", "4"))
+    rounds = int(os.environ.get("BENCH_DECODE_ROUNDS", "3"))
+    max_tokens = int(os.environ.get("BENCH_DECODE_MAX_TOKENS", "32"))
+    prompt_len = int(os.environ.get("BENCH_DECODE_PROMPT", "12"))
+    chunk = int(os.environ.get("BENCH_DECODE_PREFILL_CHUNK", "8"))
+    V = 32
+
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-3))
+            .activation("identity")
+            .list(EmbeddingSequenceLayer(n_in=V, n_out=32),
+                  PositionEmbeddingLayer(max_length=256),
+                  TransformerEncoderBlock(num_heads=4, causal=True,
+                                          window=32, rolling_cache=True,
+                                          max_cache=64),
+                  RnnOutputLayer(n_out=V, activation="softmax"))
+            .set_input_type(InputType.recurrent(1, chunk)).build())
+    net = MultiLayerNetwork(conf).init()
+
+    srv = InferenceServer(net, port=0, decode_slots=clients,
+                          decode_prefill_chunk=chunk,
+                          max_batch_size=max(8, clients),
+                          queue_capacity=max(64, 8 * clients))
+    port = srv.start()
+    base = f"http://127.0.0.1:{port}"
+    compiles_after_warmup = get_watchdog().compiles()
+
+    rng = np.random.default_rng(0)
+    lock = threading.Lock()
+    ttfts, itls, tok_total, done_sessions = [], [], [0], [0]
+    errors = []
+
+    def one_generation(seed):
+        body = json.dumps({
+            "prompt_ids": rng.integers(0, V, prompt_len).tolist(),
+            "max_tokens": max_tokens, "seed": int(seed),
+            "temperature": 0.9}).encode()
+        req = urllib.request.Request(
+            base + "/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        t0 = time.perf_counter()
+        first, prev, n = None, None, 0
+        with urllib.request.urlopen(req, timeout=120) as r:
+            for line in r:
+                line = line.decode().strip()
+                if not line.startswith("data: "):
+                    continue
+                ev = json.loads(line[6:])
+                if "token" in ev:
+                    now = time.perf_counter()
+                    if first is None:
+                        first = (now - t0) * 1e3
+                    else:
+                        with lock:
+                            itls.append((now - prev) * 1e3)
+                    prev = now
+                    n += 1
+                elif "error" in ev:
+                    raise RuntimeError(ev["error"])
+        if n != max_tokens or first is None:
+            raise RuntimeError(f"short stream: {n}/{max_tokens}")
+        with lock:
+            ttfts.append(first)
+            tok_total[0] += n
+            done_sessions[0] += 1
+
+    def client(i):
+        try:
+            for rd in range(rounds):
+                one_generation(i * 1000 + rd)
+        except BaseException as e:     # surfaced in the artifact
+            with lock:
+                errors.append(f"{type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=client, args=(i,))
+               for i in range(clients)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.monotonic() - t0
+    compile_delta = get_watchdog().compiles() - compiles_after_warmup
+
+    with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+        metrics = json.loads(r.read())
+    srv.stop()
+    decode = metrics["decode"]["default"]
+
+    def pct(vals, q):
+        vals = sorted(vals)
+        return (None if not vals else
+                round(vals[min(len(vals) - 1, int(q * len(vals)))], 3))
+
+    toks = tok_total[0]
+    out = {
+        "metric": "serving_decode_tokens_per_s",
+        "value": round(toks / wall, 2),
+        "unit": "tokens/s",
+        "clients": clients,
+        "rounds": rounds,
+        "prompt_len": prompt_len,
+        "max_tokens": max_tokens,
+        "prefill_chunk": chunk,
+        "duration_s": round(wall, 3),
+        "sessions_completed": done_sessions[0],
+        "ttft_ms": {"p50": pct(ttfts, 0.50), "p99": pct(ttfts, 0.99)},
+        "itl_ms": {"p50": pct(itls, 0.50), "p99": pct(itls, 0.99)},
+        "compile_delta_after_warmup": compile_delta,
+        "zero_recompiles": compile_delta == 0,
+        "server_decode": decode,
+        "metrics_reconciled": (
+            decode["tokens_streamed"] == toks
+            and decode["sessions"]["completed"] == done_sessions[0]),
+        "shared_dispatches": decode["dispatches"]["shared"],
+        "interleaved": decode["dispatches"]["shared"] > 0,
+        "errors": errors,
+        "registry": _registry_snapshot(),
+    }
+    dev = jax.devices()[0]
+    out["device"] = getattr(dev, "device_kind", str(dev))
+    out["platform"] = dev.platform
+    dest = os.environ.get("BENCH_DECODE_OUT") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "BENCH_serving_decode.json")
+    with open(dest, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+
+
 def main():
+    if "--serving-decode" in sys.argv or os.environ.get(
+            "BENCH_SERVING_DECODE"):
+        _serving_decode_main()
+        return
     if "--serving" in sys.argv or os.environ.get("BENCH_SERVING"):
         _serving_main()
         return

@@ -37,6 +37,7 @@ from deeplearning4j_tpu.models.multilayer import (
 )
 from deeplearning4j_tpu.optim.listeners import TrainingListener
 from deeplearning4j_tpu.optim.updaters import NoOp, Updater, resolve_updater
+from deeplearning4j_tpu.models.decode_state import DecodeState
 from deeplearning4j_tpu.parallel.ring_attention import (
     SeqCtxJitCache, SeqCtxSolverCache,
 )
@@ -62,7 +63,9 @@ class ComputationGraph(SeqCtxJitCache, SeqCtxSolverCache):
         self.last_batch_size: Optional[int] = None
         self._loss_tracker = LossTracker()
         self._rng = jax.random.PRNGKey(conf.seed)
-        self._rnn_carries: Dict[str, Any] = {}  # rnnTimeStep statefulness
+        # rnnTimeStep statefulness, lock-guarded (ISSUE 7: the bare-attr
+        # version was an unlocked shared-state mutation)
+        self._decode_state = DecodeState()
         self._stateful: set = set()
         self._vertex_updaters: Dict[str, Updater] = {}
         self._jit_caches: Dict[Any, Dict[Any, Any]] = {}
@@ -503,11 +506,23 @@ class ComputationGraph(SeqCtxJitCache, SeqCtxSolverCache):
             carries if carries else None)
 
     # ----------------------------------------------------- rnn stepping
+    @property
+    def _rnn_carries(self):
+        """Read view of the ambient stepping carries (mutations live in
+        the lock-guarded `DecodeState`)."""
+        return self._decode_state.carries
+
+    @property
+    def _decode_pos(self):
+        return self._decode_state.pos
+
     def rnn_time_step(self, *xs):
         """Stateful single-step inference; RNN vertex carries persist across
         calls. Reference: `ComputationGraph.rnnTimeStep`. Attention
         vertices step the same way via their decode carries (KV cache),
-        mirroring `MultiLayerNetwork.rnn_time_step`."""
+        mirroring `MultiLayerNetwork.rnn_time_step`. The read-step-write
+        runs under the decode-state lock so concurrent callers serialize
+        instead of corrupting each other's carries."""
         inputs = {}
         for n, x in zip(self.conf.network_inputs, xs):
             x = jnp.asarray(x, self.dtype)
@@ -515,57 +530,61 @@ class ComputationGraph(SeqCtxJitCache, SeqCtxSolverCache):
                 x = x[:, None, :]
             inputs[n] = x
         decode_names = self._decode_vertex_names
-        t_step = None
-        if decode_names:
-            # Host-side decode-length guard (under jit the layers' eager
-            # overflow checks cannot fire — see MultiLayerNetwork). Only
-            # meaningful when every input steps by the same length; a
-            # multi-length graph (e.g. full encoder context + one decoder
-            # token per call) has no single counter, so the in-kernel NaN
-            # poison is the remaining overflow signal there.
-            lens = {v.shape[1] for v in inputs.values() if v.ndim >= 3}
-            if len(lens) == 1:
-                t_step = lens.pop()
-                _check_decode_budget(
-                    self,
-                    (self.conf.vertices[n].layer for n in decode_names),
-                    t_step)
-        if not self._rnn_carries and decode_names:
-            batch = next(iter(inputs.values())).shape[0]
-            # validate ALL before seeding ANY: a mid-loop raise would
-            # leave partial carries behind and disarm this guard forever
-            for n in decode_names:
-                if not getattr(self.conf.vertices[n].layer, "causal", True):
-                    raise ValueError(
-                        f"rnn_time_step requires causal attention; vertex "
-                        f"{n!r} is non-causal (stepped decoding cannot "
-                        f"reproduce a bidirectional forward)")
-            for n in decode_names:
-                self._rnn_carries[n] = (
-                    self.conf.vertices[n].layer.decode_carry(
-                        batch, self.dtype))
-        stateful = set(self._rnn_vertex_names) | set(decode_names)
-        carries = self._rnn_carries or None
-        # One jitted program per (step shapes, carry presence) — see
-        # MultiLayerNetwork.rnn_time_step for why eager per-op dispatch
-        # is unacceptable in a per-token decode loop on TPU.
-        key = ("rnn_step",
-               tuple(sorted((n, v.shape) for n, v in inputs.items())),
-               carries is not None)
-        if key not in self._jit_cache:
-            def step_fn(params, states, inputs_, carries_):
-                values, _, new_states = self._forward(
-                    params, states, inputs_, train=False, rng=None,
-                    carries=carries_)
-                return ({o: values[o] for o in self.conf.network_outputs},
-                        {n: new_states[n] for n in stateful})
+        st = self._decode_state
+        with st.lock():
+            t_step = None
+            if decode_names:
+                # Host-side decode-length guard (under jit the layers'
+                # eager overflow checks cannot fire — see
+                # MultiLayerNetwork). Only meaningful when every input
+                # steps by the same length; a multi-length graph (e.g.
+                # full encoder context + one decoder token per call) has
+                # no single counter, so the in-kernel NaN poison is the
+                # remaining overflow signal there.
+                lens = {v.shape[1] for v in inputs.values() if v.ndim >= 3}
+                if len(lens) == 1:
+                    t_step = lens.pop()
+                    _check_decode_budget(
+                        self,
+                        (self.conf.vertices[n].layer for n in decode_names),
+                        t_step)
+            if not st.carries and decode_names:
+                batch = next(iter(inputs.values())).shape[0]
+                # validate ALL before seeding ANY: a mid-loop raise would
+                # leave partial carries behind and disarm this guard
+                for n in decode_names:
+                    if not getattr(self.conf.vertices[n].layer,
+                                   "causal", True):
+                        raise ValueError(
+                            f"rnn_time_step requires causal attention; "
+                            f"vertex {n!r} is non-causal (stepped "
+                            f"decoding cannot reproduce a bidirectional "
+                            f"forward)")
+                st.seed({n: self.conf.vertices[n].layer.decode_carry(
+                    batch, self.dtype) for n in decode_names})
+            stateful = set(self._rnn_vertex_names) | set(decode_names)
+            carries = st.carries or None
+            # One jitted program per (step shapes, carry presence) — see
+            # MultiLayerNetwork.rnn_time_step for why eager per-op
+            # dispatch is unacceptable in a per-token decode loop on TPU.
+            key = ("rnn_step",
+                   tuple(sorted((n, v.shape) for n, v in inputs.items())),
+                   carries is not None)
+            if key not in self._jit_cache:
+                def step_fn(params, states, inputs_, carries_):
+                    values, _, new_states = self._forward(
+                        params, states, inputs_, train=False, rng=None,
+                        carries=carries_)
+                    return ({o: values[o]
+                             for o in self.conf.network_outputs},
+                            {n: new_states[n] for n in stateful})
 
-            self._jit_cache[key] = jax.jit(step_fn)
-        values, self._rnn_carries = self._jit_cache[key](
-            self.params_tree, self.state_tree, inputs, carries)
-        if t_step is not None:
+                self._jit_cache[key] = jax.jit(step_fn)
+            values, new_carries = self._jit_cache[key](
+                self.params_tree, self.state_tree, inputs, carries)
             # advance only after a successful step
-            self._decode_pos = getattr(self, "_decode_pos", 0) + t_step
+            st.update(new_carries,
+                      advance=t_step if t_step is not None else 0)
         outs = [values[o] for o in self.conf.network_outputs]
         return outs[0] if len(outs) == 1 else outs
 
@@ -574,14 +593,12 @@ class ComputationGraph(SeqCtxJitCache, SeqCtxSolverCache):
         `MultiLayerNetwork.rnn_reorder_state` — the beam-search carry
         contract is identical for graph vertices)."""
         ix = jnp.asarray(np.asarray(idx))
-        self._rnn_carries = jax.tree_util.tree_map(
-            lambda a: a[ix] if getattr(a, "ndim", 0) >= 1 else a,
-            self._rnn_carries)
+        self._decode_state.reorder(lambda carries: jax.tree_util.tree_map(
+            lambda a: a[ix] if getattr(a, "ndim", 0) >= 1 else a, carries))
 
     def rnn_clear_previous_state(self):
         """Reference: `ComputationGraph.rnnClearPreviousState`."""
-        self._rnn_carries = {}
-        self._decode_pos = 0
+        self._decode_state.clear()
 
     # -------------------------------------------------------- pretrain
     def pretrain(self, data, *, epochs: int = 1, batch_size: int = 32):

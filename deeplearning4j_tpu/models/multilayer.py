@@ -29,6 +29,7 @@ from deeplearning4j_tpu.data.dataset import DataSet
 from deeplearning4j_tpu.data.iterators import (
     DataSetIterator, DevicePrefetchIterator, as_iterator,
 )
+from deeplearning4j_tpu.models.decode_state import DecodeState
 from deeplearning4j_tpu.nn.config import MultiLayerConfiguration
 from deeplearning4j_tpu.optim.executor import LossTracker, TrainingExecutor
 from deeplearning4j_tpu.optim.recovery import build_plan, run_with_recovery
@@ -150,7 +151,9 @@ class MultiLayerNetwork(SeqCtxJitCache, SeqCtxSolverCache):
         self._stateful: set = set()           # layers with persistent state (BN)
         self._layer_updaters: Dict[str, Updater] = {}
         self._jit_caches: Dict[Any, Dict[Any, Any]] = {}
-        self._rnn_carries: Dict[str, Any] = {}  # rnnTimeStep statefulness
+        # rnnTimeStep statefulness, lock-guarded (ISSUE 7: the bare-attr
+        # version was an unlocked shared-state mutation)
+        self._decode_state = DecodeState()
         self._solvers: Dict[Any, Any] = {}      # full-batch solver cache
 
     @property
@@ -697,59 +700,78 @@ class MultiLayerNetwork(SeqCtxJitCache, SeqCtxSolverCache):
             ROCMultiClass(), iterator, self.output)
 
     # ----------------------------------------------------- rnn stepping
+    @property
+    def _rnn_carries(self):
+        """Read view of the ambient stepping carries (the mutable path
+        lives inside `DecodeState`, lock-guarded)."""
+        return self._decode_state.carries
+
+    @property
+    def _decode_pos(self):
+        return self._decode_state.pos
+
+    def _validate_causal_decode(self, layers, what="rnn_time_step"):
+        """Validate ALL before seeding ANY carries: a mid-loop raise
+        would leave partial carries behind and disarm the guard."""
+        for l in layers:
+            if not getattr(l, "causal", True):
+                raise ValueError(
+                    f"{what} requires causal attention; layer "
+                    f"{l.name!r} is non-causal (stepped decoding cannot "
+                    f"see future tokens, so it cannot reproduce a "
+                    f"bidirectional forward)")
+
     def rnn_time_step(self, x):
         """Stateful single-step inference; carries persist across calls.
         Reference: `rnnTimeStep` + `rnnClearPreviousState`. Attention
         stacks step the same way: layers exposing `decode_carry` (KV
         cache, position offset) are seeded on the first call, so a
         transformer generates token-by-token without re-running the
-        prefix."""
+        prefix. The whole read-step-write runs under the decode-state
+        lock, so concurrent callers serialize instead of corrupting each
+        other's carries (serving threads its carries through
+        `session_step` arguments instead and never touches this state)."""
         x = jnp.asarray(x, self.dtype)
         if x.ndim == 2:
             x = x[:, None, :]
-        if not self._rnn_carries and self._decode_layer_names:
-            decode = [l for l in self.layers if hasattr(l, "decode_carry")]
-            # validate ALL before seeding ANY: a mid-loop raise would
-            # leave partial carries behind and disarm this guard forever
-            for l in decode:
-                if not getattr(l, "causal", True):
-                    raise ValueError(
-                        f"rnn_time_step requires causal attention; "
-                        f"layer {l.name!r} is non-causal (stepped "
-                        f"decoding cannot see future tokens, so it "
-                        f"cannot reproduce a bidirectional forward)")
-            for l in decode:
-                self._rnn_carries[l.name] = l.decode_carry(
-                    x.shape[0], self.dtype)
         stateful = set(self._rnn_layer_names) | set(self._decode_layer_names)
-        if self._decode_layer_names:
-            _check_decode_budget(
-                self, (l for l in self.layers if hasattr(l, "decode_carry")),
-                x.shape[1])
-        carries = self._rnn_carries or None
-        # One jitted program per (step shape, carry presence): token-by-
-        # token decoding is a fixed-shape loop, so eager per-op dispatch
-        # (a device round-trip per op per token) would dominate on TPU.
-        key = ("rnn_step", x.shape, carries is not None)
-        if key not in self._jit_cache:
-            def step_fn(params, states, feats, carries_):
-                out, _, new_states, _ = self._forward(
-                    params, states, feats, train=False, rng=None,
-                    carries=carries_)
-                return out, {n: new_states[n] for n in stateful}
+        st = self._decode_state
+        with st.lock():
+            if not st.carries and self._decode_layer_names:
+                decode = [l for l in self.layers
+                          if hasattr(l, "decode_carry")]
+                self._validate_causal_decode(decode)
+                st.seed({l.name: l.decode_carry(x.shape[0], self.dtype)
+                         for l in decode})
+            if self._decode_layer_names:
+                _check_decode_budget(
+                    self,
+                    (l for l in self.layers if hasattr(l, "decode_carry")),
+                    x.shape[1])
+            carries = st.carries or None
+            # One jitted program per (step shape, carry presence): token-
+            # by-token decoding is a fixed-shape loop, so eager per-op
+            # dispatch (a device round-trip per op per token) would
+            # dominate on TPU.
+            key = ("rnn_step", x.shape, carries is not None)
+            if key not in self._jit_cache:
+                def step_fn(params, states, feats, carries_):
+                    out, _, new_states, _ = self._forward(
+                        params, states, feats, train=False, rng=None,
+                        carries=carries_)
+                    return out, {n: new_states[n] for n in stateful}
 
-            self._jit_cache[key] = jax.jit(step_fn)
-        out, self._rnn_carries = self._jit_cache[key](
-            self.params_tree, self.state_tree, x, carries)
-        if self._decode_layer_names:
+                self._jit_cache[key] = jax.jit(step_fn)
+            out, new_carries = self._jit_cache[key](
+                self.params_tree, self.state_tree, x, carries)
             # advance only after a successful step (a raise above or a
             # trace failure must not burn decode budget)
-            self._decode_pos = getattr(self, "_decode_pos", 0) + x.shape[1]
+            st.update(new_carries,
+                      advance=x.shape[1] if self._decode_layer_names else 0)
         return out
 
     def rnn_clear_previous_state(self):
-        self._rnn_carries = {}
-        self._decode_pos = 0
+        self._decode_state.clear()
 
     def rnn_reorder_state(self, idx) -> None:
         """Reorder (or expand) the stateful-decoding carries along the
@@ -758,12 +780,85 @@ class MultiLayerNetwork(SeqCtxJitCache, SeqCtxSolverCache):
         carry leaf is batch-leading by the decode-carry contract
         (`decode_carry`/`initial_carry`); scalar leaves (decode
         positions) are shared across the batch and pass through."""
-        import jax.numpy as jnp
-
         ix = jnp.asarray(np.asarray(idx))
-        self._rnn_carries = jax.tree_util.tree_map(
-            lambda a: a[ix] if getattr(a, "ndim", 0) >= 1 else a,
-            self._rnn_carries)
+        self._decode_state.reorder(lambda carries: jax.tree_util.tree_map(
+            lambda a: a[ix] if getattr(a, "ndim", 0) >= 1 else a, carries))
+
+    # ------------------------------------------- slot-indexed sessions
+    def decode_limit(self) -> Optional[int]:
+        """Smallest non-rolling cache/position bound across decode
+        layers (None = unbounded, e.g. a pure rolling-cache stack) — the
+        serving session manager's host-side budget ceiling."""
+        return _decode_limit(
+            l for l in self.layers if hasattr(l, "decode_carry"))
+
+    def session_carries(self, slots: int):
+        """Batched slot-indexed decode carries for `slots` independent
+        sessions: attention layers get PER-SLOT position vectors
+        (`decode_carry(per_slot=True)`), recurrent layers their h/c
+        carries (mask-gated per step, so padded chunks hold them on pad
+        tokens). This is the KVSlotPool's backing tree — pure data, no
+        model-global state."""
+        self._check_init()
+        decode = [l for l in self.layers if hasattr(l, "decode_carry")]
+        rnn = [l for l in self.layers if _is_recurrent(l)]
+        if not decode and not rnn:
+            raise ValueError(
+                "session_carries needs at least one stateful decode "
+                "layer (attention decode_carry or recurrent carry)")
+        for l in rnn:
+            if isinstance(l, (Bidirectional, GravesBidirectionalLSTM,
+                              LastTimeStep)):
+                raise ValueError(
+                    f"session decoding is causal left-to-right; layer "
+                    f"{l.name!r} ({type(l).__name__}) cannot stream")
+        self._validate_causal_decode(decode, what="session decoding")
+        carries = {l.name: l.decode_carry(slots, self.dtype, per_slot=True)
+                   for l in decode}
+        for l in rnn:
+            carries[l.name] = l.initial_carry(slots, self.dtype)
+        return carries
+
+    def session_step(self, x, carries, *, active=None, valid=None):
+        """One slot-indexed decode step: carries and per-slot positions
+        are ARGUMENTS threaded through the jitted program, not model
+        state — any mix of sessions can ride one dispatch.
+
+        `x` is [S, T, F] (S = slot count; T = the chunk bucket), `valid`
+        an optional [S, T] prefix mask (1.0 = real token) letting short
+        chunks and idle lanes share the padded bucket shape, `active` an
+        optional [S] bool vector — inactive lanes' carries pass through
+        unchanged (their lanes compute, their writes are masked, their
+        outputs are garbage to be ignored). Returns (out, new_carries).
+
+        One compiled program per (x.shape, active?, valid?) — the
+        fixed-shape decode contract the recompile watchdog polices."""
+        self._check_init()
+        x = jnp.asarray(x, self.dtype)
+        if x.ndim == 2:
+            x = x[:, None, :]
+        stateful = set(self._rnn_layer_names) | set(self._decode_layer_names)
+        key = ("session_step", x.shape,
+               active is not None, valid is not None)
+        if key not in self._jit_cache:
+            def step_fn(params, states, feats, carries_, active_, valid_):
+                out, _, new_states, _ = self._forward(
+                    params, states, feats, train=False, rng=None,
+                    fmask=valid_, carries=carries_)
+                new = {n: new_states[n] for n in stateful}
+                if active_ is not None:
+                    def lane(old, nw):
+                        a = active_.reshape(
+                            (-1,) + (1,) * (getattr(nw, "ndim", 1) - 1))
+                        return jnp.where(a, nw, old)
+                    new = jax.tree_util.tree_map(lane, carries_, new)
+                return out, new
+
+            self._jit_cache[key] = jax.jit(step_fn)
+        return self._jit_cache[key](
+            self.params_tree, self.state_tree, x, carries,
+            None if active is None else jnp.asarray(active, bool),
+            None if valid is None else jnp.asarray(valid, self.dtype))
 
     # -------------------------------------------------------- pretrain
     def pretrain(self, data, *, epochs: int = 1, batch_size: int = 32):

@@ -26,15 +26,21 @@ def rope_rotate(x, positions, base: float = 10000.0):
     pairs by position-dependent angles. Attention scores between rotated
     q/k depend only on RELATIVE distance, so there is no learned
     position table and no absolute-length cap (modern extension; the
-    RNN-era reference has no positional encodings at all)."""
+    RNN-era reference has no positional encodings at all).
+
+    `positions` is [T] (one stream, or all rows at the same offset) or
+    [B, T] (per-row offsets — the slot-indexed decode path, where each
+    session in the batch sits at its own absolute position)."""
     dh = x.shape[-1]
     if dh % 2:
         raise ValueError(f"RoPE needs an even head dim, got {dh}")
     half = dh // 2
     freqs = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
-    ang = positions.astype(jnp.float32)[:, None] * freqs[None, :]  # [T, half]
-    c = jnp.cos(ang)[None, :, None, :].astype(x.dtype)
-    s = jnp.sin(ang)[None, :, None, :].astype(x.dtype)
+    ang = positions.astype(jnp.float32)[..., None] * freqs
+    if ang.ndim == 2:                  # [T, half] -> [1, T, half]
+        ang = ang[None]
+    c = jnp.cos(ang)[:, :, None, :].astype(x.dtype)
+    s = jnp.sin(ang)[:, :, None, :].astype(x.dtype)
     x1, x2 = x[..., :half], x[..., half:]
     return jnp.concatenate([x1 * c - x2 * s, x1 * s + x2 * c], axis=-1)
 
@@ -121,26 +127,46 @@ class MultiHeadAttention(Layer):
             "b": jnp.zeros((d,), dtype),
         }, {}
 
-    def decode_carry(self, batch: int, dtype=jnp.float32):
+    def decode_carry(self, batch: int, dtype=jnp.float32, *,
+                     per_slot: bool = False):
         """Preallocated KV cache for incremental decoding (the transformer
         analogue of the reference's rnnTimeStep statefulness,
         `MultiLayerNetwork.java:rnnTimeStep`): fixed [B, max_cache, Hkv,
         Dh] buffers + a write position, so every step reuses one compiled
         program instead of growing shapes. Under GQA the cache holds only
         the Hkv KV heads — the group factor comes straight off decode's
-        per-token HBM traffic."""
+        per-token HBM traffic.
+
+        `per_slot=True` makes the write position a [batch] vector — each
+        batch row is an independent decode SLOT at its own position
+        (serving sessions: rows advance at different rates, inactive
+        lanes stand still). Requires causal attention."""
         Dh = self.n_out // self.num_heads
         L = self.max_cache
         Hkv = self._kv_heads
+        if per_slot and not self.causal:
+            raise ValueError(
+                "per-slot decode carries need causal=True (each lane's "
+                "visible prefix is its own position)")
         return {
             "cache_k": jnp.zeros((batch, L, Hkv, Dh), dtype),
             "cache_v": jnp.zeros((batch, L, Hkv, Dh), dtype),
-            "pos": jnp.zeros((), jnp.int32),
+            "pos": jnp.zeros((batch,) if per_slot else (), jnp.int32),
         }
 
-    def _decode(self, params, x, state):
+    def _decode(self, params, x, state, mask=None):
         """One decode step: append this block's K/V at `pos`, attend the
-        incoming queries over the visible cache prefix."""
+        incoming queries over the visible cache prefix.
+
+        Two position layouts share this method (and one compiled program
+        each): a SCALAR `pos` carry steps every batch row in lockstep
+        (the classic `rnn_time_step` path — `mask` is ignored, as
+        before), while a VECTOR `pos` carry ([B]) steps slot-indexed
+        session lanes independently. In vector mode `mask` is a [B, T]
+        prefix-validity mask: padded tokens are dropped from the cache
+        write (scatter index pushed out of range, `mode="drop"`) and do
+        not advance the row's position, so a prefill chunk and a
+        single-token step can share one padded bucket shape."""
         B, T, _ = x.shape
         H = self.num_heads
         Hkv = self._kv_heads
@@ -157,7 +183,10 @@ class MultiHeadAttention(Layer):
         elif T > L:
             raise ValueError(f"decode step of {T} tokens > max_cache {L}")
         pos = state["pos"]
-        if (not self.rolling_cache
+        per_slot = getattr(pos, "ndim", 0) == 1
+        if per_slot and not self.causal:
+            raise ValueError("per-slot decode needs causal=True")
+        if (not self.rolling_cache and not per_slot
                 and not isinstance(pos, jax.core.Tracer)
                 and int(pos) + T > L):
             raise ValueError(
@@ -170,18 +199,54 @@ class MultiHeadAttention(Layer):
         q = split(params["Wq"], H)
         k = split(params["Wk"], Hkv)
         v = split(params["Wv"], Hkv)
-        if self.rope:
-            # rotate with ABSOLUTE positions continuing from the carry;
-            # the cache stores rotated keys (standard RoPE decoding)
-            positions = pos + jnp.arange(T)
-            q = rope_rotate(q, positions)
-            k = rope_rotate(k, positions)
-        if self.rolling_cache:
+        if per_slot:
+            valid = None if mask is None else (mask > 0)       # [B, T]
+            n_new = (jnp.full(pos.shape, T, pos.dtype) if valid is None
+                     else valid.sum(axis=1).astype(pos.dtype))  # [B]
+            q_ids = pos[:, None] + jnp.arange(T)               # [B, T]
+            if self.rope:
+                q = rope_rotate(q, q_ids)
+                k = rope_rotate(k, q_ids)
+            rows = jnp.arange(B)[:, None]
+            tgt = q_ids % L if self.rolling_cache else q_ids
+            if valid is not None:
+                # padded tokens scatter out of range -> dropped, so a
+                # short chunk in a wide bucket never dirties the cache
+                tgt = jnp.where(valid, tgt, L)
+            cdt = state["cache_k"].dtype
+            ck = state["cache_k"].at[rows, tgt].set(
+                k.astype(cdt), mode="drop")
+            cv = state["cache_v"].at[rows, tgt].set(
+                v.astype(cdt), mode="drop")
+            if self.rolling_cache:
+                # per-row held-position arithmetic (see scalar branch)
+                end = pos + n_new - 1                          # [B]
+                j = jnp.arange(L)[None, :]
+                held = end[:, None] - ((end[:, None] - j) % L)  # [B, L]
+                held = held[:, None, :]                     # [B, 1, L]
+                qe = q_ids[:, :, None]                      # [B, T, 1]
+                vis = ((held >= 0) & (held <= qe)
+                       & (held > qe - self.window))         # [B, T, L]
+            else:
+                # per-row overflow poison (tracer-safe, like scalar)
+                q = jnp.where((pos + n_new <= L)[:, None, None, None],
+                              q, jnp.nan)
+                k_ids = jnp.arange(L)[None, None, :]
+                qe = q_ids[:, :, None]
+                vis = k_ids <= qe
+                if self.window is not None:
+                    vis = vis & (k_ids > qe - self.window)
+            pos_new = pos + n_new
+        elif self.rolling_cache:
             # Mistral-style ring buffer: slot = global position mod L.
             # The write is a scatter (it may wrap the boundary); each
             # slot's CURRENT occupant is recovered arithmetically from
             # the newest written global position, so visibility needs no
             # stored metadata.
+            if self.rope:
+                positions = pos + jnp.arange(T)
+                q = rope_rotate(q, positions)
+                k = rope_rotate(k, positions)
             slots = (pos + jnp.arange(T)) % L
             ck = state["cache_k"].at[:, slots].set(
                 k.astype(state["cache_k"].dtype))
@@ -194,11 +259,18 @@ class MultiHeadAttention(Layer):
             vis = ((held[None, :] >= 0)     # slot ever written
                    & (held[None, :] <= q_ids)          # causal
                    & (held[None, :] > q_ids - self.window))
+            pos_new = pos + T
         else:
             # Tracer-safe overflow poison: under jit the eager check
             # above cannot fire, and dynamic_update_slice would silently
             # clamp the write into the last rows — poison the output
             # with NaN instead so overflow is loud, not wrong.
+            if self.rope:
+                # rotate with ABSOLUTE positions continuing from the
+                # carry; the cache stores rotated keys (standard RoPE)
+                positions = pos + jnp.arange(T)
+                q = rope_rotate(q, positions)
+                k = rope_rotate(k, positions)
             q = jnp.where(pos + T <= L, q, jnp.nan)
             z = jnp.zeros((), pos.dtype)   # index dtypes must match `pos`
             ck = jax.lax.dynamic_update_slice(
@@ -219,6 +291,9 @@ class MultiHeadAttention(Layer):
                 vis = vis & (k_ids > q_ids - self.window)
                 if not self.causal:
                     vis = vis & (k_ids < q_ids + self.window)
+            pos_new = pos + T
+        # [T, L] (lockstep) or [B, T, L] (per-slot) -> broadcastable
+        vb = vis if vis.ndim == 3 else vis[None]
         if Hkv != H:
             # GQA: group the query heads against the Hkv-wide cache in
             # the einsum itself — the cache is never broadcast to H
@@ -227,21 +302,21 @@ class MultiHeadAttention(Layer):
             G = H // Hkv
             qg = q.reshape(B, T, Hkv, G, Dh)
             s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, ck) / jnp.sqrt(Dh)
-            s = jnp.where(vis[None, None, None], s, -1e30)
+            s = jnp.where(vb[:, None, None], s, -1e30)
             o = jnp.einsum("bhgqk,bkhd->bqhgd",
                            jax.nn.softmax(s, axis=-1), cv)
             o = o.reshape(B, T, H, Dh)
         else:
             s = jnp.einsum("bqhd,bkhd->bhqk", q, ck) / jnp.sqrt(Dh)
-            s = jnp.where(vis[None, None], s, -1e30)
+            s = jnp.where(vb[:, None], s, -1e30)
             o = jnp.einsum("bhqk,bkhd->bqhd",
                            jax.nn.softmax(s, axis=-1), cv)
         y = o.reshape(B, T, self.n_out) @ params["Wo"] + params["b"]
-        return self._act(y), {"cache_k": ck, "cache_v": cv, "pos": pos + T}
+        return self._act(y), {"cache_k": ck, "cache_v": cv, "pos": pos_new}
 
     def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
         if state is not None and "cache_k" in state:
-            return self._decode(params, x, state)
+            return self._decode(params, x, state, mask=mask)
         B, T, _ = x.shape
         H = self.num_heads
         Hkv = self._kv_heads
@@ -380,8 +455,9 @@ class PositionEmbeddingLayer(Layer):
         return {"P": 0.02 * jax.random.normal(
             key, (self.max_length, d), dtype)}, {}
 
-    def decode_carry(self, batch: int, dtype=jnp.float32):
-        return {"pos": jnp.zeros((), jnp.int32)}
+    def decode_carry(self, batch: int, dtype=jnp.float32, *,
+                     per_slot: bool = False):
+        return {"pos": jnp.zeros((batch,) if per_slot else (), jnp.int32)}
 
     def apply(self, params, x, *, state=None, train=False, rng=None,
               mask=None):
@@ -392,6 +468,22 @@ class PositionEmbeddingLayer(Layer):
         if state is not None and "pos" in state:
             # decode stepping: positions continue from the carry offset
             pos = state["pos"]
+            if getattr(pos, "ndim", 0) == 1:
+                # per-slot vector positions (session decode): each row
+                # gathers its own offsets; `mask` marks the valid prefix
+                # of a padded chunk, which alone advances the position
+                valid = None if mask is None else (mask > 0)
+                n_new = (jnp.full(pos.shape, t, pos.dtype)
+                         if valid is None
+                         else valid.sum(axis=1).astype(pos.dtype))
+                positions = pos[:, None] + jnp.arange(t)       # [B, t]
+                p = jnp.take(params["P"],
+                             jnp.minimum(positions, self.max_length - 1),
+                             axis=0)                           # [B, t, d]
+                # tracer-safe per-row overflow poison
+                p = jnp.where((pos + n_new <= self.max_length)
+                              [:, None, None], p, jnp.nan)
+                return x + p, {"pos": pos + n_new}
             if (not isinstance(pos, jax.core.Tracer)
                     and int(pos) + t > self.max_length):
                 raise ValueError(
@@ -519,9 +611,11 @@ class TransformerEncoderBlock(Layer):
         return (x - mu) * jax.lax.rsqrt(var + 1e-5) * g \
             + params[f"{prefix}_b"]
 
-    def decode_carry(self, batch: int, dtype=jnp.float32):
+    def decode_carry(self, batch: int, dtype=jnp.float32, *,
+                     per_slot: bool = False):
         attn, _ = self._sub()
-        return {"attn": attn.decode_carry(batch, dtype)}
+        return {"attn": attn.decode_carry(batch, dtype,
+                                          per_slot=per_slot)}
 
     def apply(self, params, x, *, state=None, train=False, rng=None,
               mask=None):

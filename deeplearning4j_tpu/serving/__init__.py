@@ -8,11 +8,16 @@ hosting with hot-swap, continuous batching, admission control, and a
 /metrics surface over the ParallelInference data plane.
 """
 
-from deeplearning4j_tpu.serving.http_base import HttpError, JsonHttpServer
+from deeplearning4j_tpu.serving.http_base import (
+    HttpError, JsonHttpServer, StreamResponse,
+)
 from deeplearning4j_tpu.serving.inference_server import (
     InferenceServer, ModelServer,
 )
 from deeplearning4j_tpu.serving.knn_server import NearestNeighborsServer
+from deeplearning4j_tpu.serving.kv_pool import (
+    IncompatibleSessionSwapError, KVSlotPool, SlotPoolExhaustedError,
+)
 from deeplearning4j_tpu.serving.metrics import ServingStats
 from deeplearning4j_tpu.serving.registry import (
     DeployRolledBackError, ModelEntry, ModelRegistry,
@@ -21,11 +26,16 @@ from deeplearning4j_tpu.serving.scheduler import (
     AdmissionPolicy, ContinuousBatchingScheduler, DeadlineExceededError,
     RequestShedError, SchedulerClosedError, WorkerCrashError,
 )
+from deeplearning4j_tpu.serving.sessions import (
+    DecodeSession, DecodeSessionManager,
+)
 
 __all__ = [
-    "AdmissionPolicy", "ContinuousBatchingScheduler",
-    "DeadlineExceededError", "DeployRolledBackError", "HttpError",
-    "InferenceServer", "JsonHttpServer", "ModelEntry", "ModelRegistry",
-    "ModelServer", "NearestNeighborsServer", "RequestShedError",
-    "SchedulerClosedError", "ServingStats", "WorkerCrashError",
+    "AdmissionPolicy", "ContinuousBatchingScheduler", "DecodeSession",
+    "DecodeSessionManager", "DeadlineExceededError",
+    "DeployRolledBackError", "HttpError", "IncompatibleSessionSwapError",
+    "InferenceServer", "JsonHttpServer", "KVSlotPool", "ModelEntry",
+    "ModelRegistry", "ModelServer", "NearestNeighborsServer",
+    "RequestShedError", "SchedulerClosedError", "ServingStats",
+    "SlotPoolExhaustedError", "StreamResponse", "WorkerCrashError",
 ]

@@ -47,6 +47,23 @@ class TextResponse:
         self.status = int(status)
 
 
+class StreamResponse:
+    """Return from a POST handler to stream incrementally instead of
+    sending one JSON body: each event from the iterable is written as an
+    SSE frame (`data: <json>\\n\\n`) and flushed immediately. The server
+    speaks HTTP/1.0, so connection-close delimits the stream — no
+    chunked encoding needed. If the client disconnects mid-stream the
+    event iterable is `close()`d (a generator sees GeneratorExit there,
+    which the /generate handler turns into a session cancel)."""
+
+    def __init__(self, events,
+                 content_type: str = "text/event-stream",
+                 status: int = 200):
+        self.events = events
+        self.content_type = content_type
+        self.status = int(status)
+
+
 def _wants_request(fn: Callable) -> bool:
     """True when a GET handler declares a parameter — it then receives
     {"query": ..., "headers": ...} for content negotiation; zero-arg
@@ -122,6 +139,35 @@ class JsonHttpServer:
                 except Exception as e:
                     self._json(500, {"error": str(e)})
 
+            def _stream(self, resp: StreamResponse):
+                self.send_response(resp.status)
+                self.send_header("Content-Type", resp.content_type)
+                self.send_header("Cache-Control", "no-cache")
+                self.end_headers()
+                try:
+                    for ev in resp.events:
+                        data = (ev if isinstance(ev, str)
+                                else json.dumps(ev))
+                        self.wfile.write(f"data: {data}\n\n".encode())
+                        self.wfile.flush()
+                # graft: allow(GL403): client hung up mid-stream — the
+                # finally block cancels the producer; nothing to report
+                except (BrokenPipeError, ConnectionResetError):
+                    pass
+                except Exception as e:
+                    try:        # headers are gone; best-effort in-band
+                        self.wfile.write(
+                            f"data: {json.dumps({'error': str(e)})}"
+                            f"\n\n".encode())
+                    # graft: allow(GL403): the socket is already dead;
+                    # the in-band error frame was best-effort
+                    except OSError:
+                        pass
+                finally:
+                    close = getattr(resp.events, "close", None)
+                    if close is not None:
+                        close()
+
             def do_POST(self):
                 fn = posts.get(self.path)
                 if fn is None:
@@ -136,7 +182,10 @@ class JsonHttpServer:
                     return self._json(
                         400, {"error": "request body must be a JSON object"})
                 try:
-                    self._json(200, fn(req))
+                    out = fn(req)
+                    if isinstance(out, StreamResponse):
+                        return self._stream(out)
+                    self._json(200, out)
                 except HttpError as e:
                     self._json(e.status, e.payload)
                 except KeyError as e:

@@ -11,6 +11,17 @@ backpressure semantics, and an observability surface.
                  → {"output": [[...], ...], "model": ..., "version": ...}
                  errors: 400 client fault, 503 shed/draining,
                  504 deadline exceeded, 500 server fault
+  POST /generate {"prompt_ids": [...], "model"?, "max_tokens"?,
+                  "temperature"/"top_k"/"top_p"/"greedy"?, "seed"?,
+                  "deadline_ms"?, "eos_id"?, "stream"? (default true)}
+                 → SSE token stream (one `data:` frame per token, then
+                 a terminal done/error frame), or one JSON body with
+                 "stream": false. Needs decode sessions enabled
+                 (`decode_slots=N` or enable_decode_sessions()); slot
+                 exhaustion → 503. Client disconnect cancels.
+  POST /generate/cancel {"session": id, "model"?} → {"cancelled": bool}
+  GET  /sessions → per-model decode snapshot (slots, session outcomes,
+                 streamed tokens, TTFT/ITL, shared-dispatch counters)
   GET  /models   → per-model {version, served, inflight, deployments}
   GET  /metrics  → ServingStats snapshot (queue depth, batch-occupancy
                  histogram, p50/p95/p99 latency, shed count, per-model
@@ -50,8 +61,9 @@ import numpy as np
 from deeplearning4j_tpu.observe.registry import PROMETHEUS_CONTENT_TYPE
 from deeplearning4j_tpu.parallel.inference import InferenceMode
 from deeplearning4j_tpu.serving.http_base import (
-    HttpError, JsonHttpServer, TextResponse,
+    HttpError, JsonHttpServer, StreamResponse, TextResponse,
 )
+from deeplearning4j_tpu.serving.kv_pool import SlotPoolExhaustedError
 from deeplearning4j_tpu.serving.metrics import ServingStats
 from deeplearning4j_tpu.serving.registry import ModelRegistry
 from deeplearning4j_tpu.serving.scheduler import (
@@ -77,7 +89,8 @@ class InferenceServer(JsonHttpServer):
                  default_deadline_ms: Optional[float] = None,
                  batch_buckets=None, collect_wait_ms: float = 5.0,
                  slots: int = 1, degraded_fraction: float = 0.8,
-                 mesh=None, metrics=None):
+                 mesh=None, metrics=None, decode_slots: int = 0,
+                 decode_prefill_chunk: int = 8):
         super().__init__(port=port)
         if scheduler not in ("continuous", "collect"):
             raise ValueError("scheduler must be 'continuous' or 'collect'")
@@ -103,8 +116,15 @@ class InferenceServer(JsonHttpServer):
                 registry, self.stats, max_batch_size=max_batch_size,
                 queue_capacity=queue_capacity, policy=admission,
                 default_deadline_ms=default_deadline_ms, slots=slots)
+        self._decode = {}
         if net is not None:
             self.registry.deploy(DEFAULT_MODEL, 1, net, warm=False)
+            # decode_slots > 0 turns on stateful decode serving for the
+            # convenience model: POST /generate with streaming
+            if decode_slots:
+                self.enable_decode_sessions(
+                    slots=decode_slots,
+                    prefill_chunk=decode_prefill_chunk)
 
     # ------------------------------------------------------ control API
     def deploy(self, name: str, version, net, *, feat_shape=None,
@@ -113,6 +133,29 @@ class InferenceServer(JsonHttpServer):
         caches, atomically flip traffic, drain + retire the old one."""
         return self.registry.deploy(name, version, net,
                                     feat_shape=feat_shape, warm=warm)
+
+    def enable_decode_sessions(self, model: str = DEFAULT_MODEL, *,
+                               slots: int = 4, prefill_chunk: int = 8,
+                               warm: bool = True):
+        """Attach a DecodeSessionManager to `model`: POST /generate
+        streams tokens from per-request sessions over a shared KV slot
+        pool, stepped through the continuous-batching scheduler."""
+        if self.mode != "continuous":
+            raise ValueError(
+                "decode sessions need the continuous scheduler "
+                f"(server mode is {self.mode!r})")
+        if model in self._decode:
+            raise ValueError(f"decode sessions already enabled "
+                             f"for {model!r}")
+        from deeplearning4j_tpu.serving.sessions import (
+            DecodeSessionManager,
+        )
+        mgr = DecodeSessionManager(
+            self.registry, self.scheduler, model, slots=slots,
+            prefill_chunk=prefill_chunk, metrics=self.stats.registry,
+            warm=warm)
+        self._decode[model] = mgr
+        return mgr
 
     # --------------------------------------------------------- handlers
     def _parse(self, req: dict):
@@ -166,6 +209,69 @@ class InferenceServer(JsonHttpServer):
         return {"output": np.asarray(y).tolist(), "model": model,
                 "version": version}
 
+    def _generate(self, req: dict):
+        """Stateful decode: open a session, stream its tokens. With
+        "stream": true (default) the response is SSE — one `data:` frame
+        per token, then a terminal done/error frame; client disconnect
+        cancels the session. With "stream": false the handler blocks and
+        returns the full generation as one JSON body."""
+        model = req.get("model", DEFAULT_MODEL)
+        mgr = self._decode.get(model)
+        if mgr is None:
+            raise HttpError(
+                400, f"decode sessions are not enabled for {model!r}")
+        prompt = req["prompt_ids"]              # KeyError → 400
+        kw = {}
+        for field, cast in (("max_tokens", int), ("temperature", float),
+                            ("top_k", int), ("top_p", float),
+                            ("greedy", bool), ("seed", int),
+                            ("deadline_ms", float), ("eos_id", int)):
+            if req.get(field) is not None:
+                try:
+                    kw[field] = cast(req[field])
+                except (TypeError, ValueError):
+                    raise HttpError(400, f"bad {field}: {req[field]!r}")
+        try:
+            sess = mgr.open_session(prompt, **kw)
+        except SlotPoolExhaustedError as e:
+            raise HttpError(503, f"no free decode slot: {e}")
+        except SchedulerClosedError as e:
+            raise HttpError(503, f"draining: {e}")
+        except (TypeError, ValueError) as e:
+            raise HttpError(400, str(e))
+        if req.get("stream", True):
+            def events():
+                try:
+                    yield {"session": sess.id, "model": model}
+                    for ev in sess.stream():
+                        yield ev
+                finally:
+                    # client disconnect lands here as GeneratorExit
+                    if not sess.done.is_set():
+                        sess.cancel()
+            return StreamResponse(events())
+        try:
+            tokens = sess.result()
+        except DeadlineExceededError as e:
+            raise HttpError(504, f"deadline exceeded: {e}")
+        except (RequestShedError, SchedulerClosedError) as e:
+            raise HttpError(503, str(e))
+        return {"session": sess.id, "model": model, "tokens": tokens,
+                "outcome": sess.outcome, "ttft_ms": sess.ttft_ms}
+
+    def _generate_cancel(self, req: dict):
+        model = req.get("model", DEFAULT_MODEL)
+        mgr = self._decode.get(model)
+        if mgr is None:
+            raise HttpError(
+                400, f"decode sessions are not enabled for {model!r}")
+        sid = req["session"]                    # KeyError → 400
+        return {"session": sid, "cancelled": mgr.cancel(sid)}
+
+    def _sessions(self):
+        return {"decode": {m: mgr.snapshot()
+                           for m, mgr in self._decode.items()}}
+
     def _healthz(self):
         depth = self.scheduler.queue_depth() if self.scheduler else 0
         cap = self.scheduler.capacity if self.scheduler else None
@@ -183,7 +289,11 @@ class InferenceServer(JsonHttpServer):
             self.stats.set_queue_gauges(depth, cap)
             return TextResponse(self.stats.registry.to_prometheus(),
                                 content_type=PROMETHEUS_CONTENT_TYPE)
-        return self.stats.snapshot(queue_depth=depth, queue_capacity=cap)
+        snap = self.stats.snapshot(queue_depth=depth, queue_capacity=cap)
+        if self._decode:        # additive: only when sessions exist
+            snap["decode"] = {m: mgr.snapshot()
+                              for m, mgr in self._decode.items()}
+        return snap
 
     @staticmethod
     def _wants_prometheus(request) -> bool:
@@ -211,13 +321,20 @@ class InferenceServer(JsonHttpServer):
     def get_routes(self):
         return {"/healthz": self._healthz, "/metrics": self._metrics,
                 "/models": lambda: {"models": self.registry.summary()},
-                "/devices": self._devices, "/flight": self._flight}
+                "/devices": self._devices, "/flight": self._flight,
+                "/sessions": self._sessions}
 
     def post_routes(self):
-        return {"/output": self._output}
+        return {"/output": self._output, "/generate": self._generate,
+                "/generate/cancel": self._generate_cancel}
 
     def stop(self):
         super().stop()
+        # abort live decode sessions first — their callback chains keep
+        # resubmitting into the scheduler; closing them makes the
+        # scheduler/registry shutdown below drain instead of time out
+        for mgr in self._decode.values():
+            mgr.shutdown()
         if self.scheduler is not None:
             self.scheduler.shutdown()
         self.registry.close()

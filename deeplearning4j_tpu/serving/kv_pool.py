@@ -1,0 +1,167 @@
+"""KVSlotPool — a paged allocator over batched, slot-indexed decode
+carries.
+
+The pool owns ONE device-resident carry tree built by
+`net.session_carries(slots)`: every attention layer's KV cache is
+[slots, L, Hkv, Dh] with a per-slot position vector, every recurrent
+layer's h/c is [slots, n]. A slot (one batch row across the whole tree)
+is the unit of admission for decode sessions: `alloc()` hands a free row
+to a new session, `free()` zeroes it and returns it. Nothing here ever
+retraces — allocation is host bookkeeping, and the reset is a single
+jitted program whose slot index is a traced scalar, so session churn
+costs zero compiles (the fixed-shape decode contract the recompile
+watchdog polices).
+
+Against cross-session leakage the pool is belt-and-braces: the rolling
+ring's held-position arithmetic already makes a fresh slot's stale rows
+invisible (a reset position of 0 puts every old slot entry on a previous
+lap, `held < 0`), AND `free()` zeroes the slot's rows anyway so a bug in
+either layer cannot expose the previous session's keys/values. The
+wraparound-reuse test pins both.
+
+Occupancy rides the shared metrics spine: `serving_kv_slots` /
+`serving_kv_slots_in_use` gauges plus alloc/reset counters.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class SlotPoolExhaustedError(RuntimeError):
+    """No free KV slot (HTTP 503 — admission is slots, not queue depth)."""
+
+
+class IncompatibleSessionSwapError(RuntimeError):
+    """A deploy candidate's session-carry tree (shapes/dtypes/structure)
+    does not match the live pool — live sessions cannot migrate onto it,
+    so the deploy must roll back rather than drop them."""
+
+
+class KVSlotPool:
+    """Slot-indexed decode carries + free-list allocation + jitted
+    per-slot reset."""
+
+    def __init__(self, net, slots: int, *, model: str = "default",
+                 metrics=None):
+        if slots < 1:
+            raise ValueError(f"slots must be >= 1, got {slots}")
+        self.net = net
+        self.slots = int(slots)
+        self.model = model
+        self._cv = threading.Condition()
+        self.carries = net.session_carries(self.slots)
+        self._free = list(range(self.slots - 1, -1, -1))
+        self._active = [False] * self.slots
+
+        def _reset(carries, slot):
+            def z(a):
+                # graft: allow(GL003): ndim/shape are static array
+                # metadata, constant per trace — not traced values
+                if getattr(a, "ndim", 0) >= 1 and a.shape[0] == slots:
+                    return a.at[slot].set(jnp.zeros_like(a[slot]))
+                return a
+            return jax.tree_util.tree_map(z, carries)
+
+        # slot is a traced scalar: one compile covers every reset ever
+        self._reset_jit = jax.jit(_reset)
+
+        if metrics is None:
+            from deeplearning4j_tpu.observe import get_registry
+            metrics = get_registry()
+        self._g_total = metrics.gauge("serving_kv_slots", model=model)
+        self._g_used = metrics.gauge("serving_kv_slots_in_use", model=model)
+        self._c_allocs = metrics.counter("serving_kv_slot_allocs_total",
+                                         model=model)
+        self._c_resets = metrics.counter("serving_kv_slot_resets_total",
+                                         model=model)
+        self._g_total.set(self.slots)
+        self._g_used.set(0)
+
+    def lock(self):
+        """The pool lock, for the step critical section: the dispatch
+        path holds it across read-carries -> session_step -> writeback so
+        concurrent decode dispatches serialize on the one carry tree."""
+        return self._cv
+
+    # ------------------------------------------------------- allocation
+    def alloc(self, timeout_s: float = 0.0) -> int:
+        """Claim a free slot; raises SlotPoolExhaustedError when none
+        frees within `timeout_s` (0 = fail fast; admission pressure maps
+        to HTTP 503, not an unbounded queue)."""
+        with self._cv:
+            if not self._free and timeout_s > 0:
+                self._cv.wait_for(lambda: bool(self._free), timeout_s)
+            if not self._free:
+                raise SlotPoolExhaustedError(
+                    f"all {self.slots} KV slots in use")
+            slot = self._free.pop()
+            self._active[slot] = True
+            self._c_allocs.inc()
+            self._g_used.set(self.slots - len(self._free))
+            return slot
+
+    def free(self, slot: int) -> None:
+        """Zero the slot's carry rows and return it to the free list.
+        Idempotent (a session abort racing a shutdown frees once)."""
+        with self._cv:
+            if not self._active[slot]:
+                return
+            self.carries = self._reset_jit(self.carries, slot)
+            self._c_resets.inc()
+            self._active[slot] = False
+            self._free.append(slot)
+            self._g_used.set(self.slots - len(self._free))
+            self._cv.notify_all()
+
+    def reset(self, slot: int) -> None:
+        """Zero a slot's rows without releasing it (session restart)."""
+        with self._cv:
+            self.carries = self._reset_jit(self.carries, slot)
+            self._c_resets.inc()
+
+    # ------------------------------------------------------- step seam
+    def swap_carries(self, new_carries) -> None:
+        """Install the post-step carry tree. Callers hold `lock()` across
+        the read-step-swap sequence; Condition's lock is not reentrant,
+        so this method must NOT re-acquire it."""
+        # graft: allow(GL301): writers hold self._cv by contract (the
+        # dispatch critical section documented on lock()); re-acquiring
+        # a non-reentrant Condition here would self-deadlock
+        self.carries = new_carries
+
+    # -------------------------------------------------------- hot swap
+    def rebind(self, net) -> None:
+        """Point the pool at a hot-swapped net, keeping the live carries
+        (sessions survive the flip). The candidate must produce an
+        identical carry tree — checked abstractly (eval_shape: no device
+        allocation); mismatch raises IncompatibleSessionSwapError."""
+        want = jax.eval_shape(lambda: net.session_carries(self.slots))
+        have = jax.eval_shape(lambda: self.carries)
+        ws, hs = jax.tree_util.tree_structure(want), \
+            jax.tree_util.tree_structure(have)
+        wl = jax.tree_util.tree_leaves(want)
+        hl = jax.tree_util.tree_leaves(have)
+        if ws != hs or [(l.shape, l.dtype) for l in wl] != \
+                [(l.shape, l.dtype) for l in hl]:
+            raise IncompatibleSessionSwapError(
+                f"session carries of the deploy candidate do not match "
+                f"the live pool (live {hs}, candidate {ws}); live "
+                f"sessions cannot migrate")
+        with self._cv:
+            self.net = net
+
+    # ------------------------------------------------------ inspection
+    def in_use(self) -> int:
+        with self._cv:
+            return self.slots - len(self._free)
+
+    def describe(self) -> dict:
+        with self._cv:
+            return {"total": self.slots,
+                    "in_use": self.slots - len(self._free),
+                    "model": self.model}

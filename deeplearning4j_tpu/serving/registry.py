@@ -102,6 +102,7 @@ class ModelRegistry:
         self._lock = threading.Lock()
         self._active: Dict[str, ModelEntry] = {}
         self._history: Dict[str, List] = {}
+        self._deploy_hooks: Dict[str, List] = {}
 
     # ---------------------------------------------------------- deploy
     @staticmethod
@@ -127,6 +128,12 @@ class ModelRegistry:
         serving untouched and `DeployRolledBackError` is raised. A
         watchdog trip on a FIRST deploy (nothing to roll back to)
         proceeds with a warning — degraded beats dark."""
+        with self._lock:
+            cur = self._active.get(name)
+        if cur is not None and getattr(cur, "_external", False):
+            raise ValueError(
+                f"{name!r} is an externally-managed entry "
+                f"(register_entry); deploy() cannot replace it")
         runner = ParallelInference(
             net, mesh=self.mesh, mode=self.runner_mode,
             max_batch_size=self.max_batch, batch_buckets=self.buckets,
@@ -153,14 +160,84 @@ class ModelRegistry:
                         "watchdog but no previous version exists — "
                         "deploying anyway (degraded beats dark)",
                         name, version)
+            # warm-phase deploy hooks join the canary: a decode-session
+            # manager pre-compiles the candidate's session-step buckets
+            # here (so live sessions never pay a post-flip compile) and
+            # RAISES if live sessions could not migrate onto it — which
+            # rides the same rollback path, previous version untouched.
+            for hook in self._hooks_for(name):
+                try:
+                    hook("warm", name, version, net)
+                except BaseException as e:
+                    with self._lock:
+                        has_previous = name in self._active
+                    self._reject_deploy(name, version, runner,
+                                        cause=e, tripped=False,
+                                        has_previous=has_previous)
         with self._lock:
             old = self._active.get(name)
             self._active[name] = entry
             self._history.setdefault(name, []).append(
                 {"version": version, "at": round(time.time(), 3)})
+        # flipped-phase hooks run after the pointer swap but before the
+        # old entry drains, so live decode sessions rebind to the new
+        # net while the old version is still able to finish its last
+        # in-flight batches. A hook failure here must not wedge the
+        # deploy — the flip already happened; log and keep going.
+        for hook in self._hooks_for(name):
+            try:
+                hook("flipped", name, version, net)
+            # graft: allow(GL403): post-flip migration is best-effort —
+            # the deploy is already live; failure is logged + recorded
+            except Exception as e:
+                logger.warning(
+                    "deploy(%s@%r): post-flip hook failed: %s",
+                    name, version, e)
+                try:
+                    from deeplearning4j_tpu.observe import get_flight
+                    get_flight().record(
+                        "deploy_hook_failed", model=name,
+                        version=version, error=type(e).__name__)
+                # graft: allow(GL403): telemetry stays best-effort
+                except Exception:
+                    pass
         if old is not None:
             self._retire(old)
         return entry
+
+    # ---------------------------------------------- entries and hooks
+    def register_entry(self, name: str, entry: ModelEntry) -> ModelEntry:
+        """Register an externally-managed entry (e.g. a decode-session
+        endpoint whose `runner` is a session manager, not a
+        ParallelInference). It participates in acquire/release/summary/
+        close exactly like a deployed model, but `deploy()` under the
+        same name is refused — its lifecycle belongs to its owner."""
+        with self._lock:
+            if name in self._active:
+                raise ValueError(f"entry {name!r} already registered")
+            entry._external = True
+            self._active[name] = entry
+            self._history.setdefault(name, []).append(
+                {"version": entry.version, "at": round(time.time(), 3)})
+        return entry
+
+    def add_deploy_hook(self, name: str, hook) -> None:
+        """Subscribe `hook(phase, name, version, net)` to deploys of
+        `name`. phase is "warm" (inside the canary, pre-flip; raising
+        rolls the deploy back) or "flipped" (after the atomic pointer
+        swap; failures are logged, never propagated)."""
+        with self._lock:
+            self._deploy_hooks.setdefault(name, []).append(hook)
+
+    def remove_deploy_hook(self, name: str, hook) -> None:
+        with self._lock:
+            hooks = self._deploy_hooks.get(name, [])
+            if hook in hooks:
+                hooks.remove(hook)
+
+    def _hooks_for(self, name: str) -> List:
+        with self._lock:
+            return list(self._deploy_hooks.get(name, []))
 
     @staticmethod
     def _warmup_tripped(runner: ParallelInference) -> bool:

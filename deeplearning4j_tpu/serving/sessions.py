@@ -1,0 +1,542 @@
+"""Stateful decode serving: per-request sessions over a shared KV slot
+pool, stepped through the continuous-batching scheduler.
+
+The old decode path (`utils/textgen.generate`) drives `rnn_time_step`,
+which mutates MODEL-GLOBAL carries — one autoregressive stream per net,
+and a server would have to dedicate a model replica per conversation.
+This module turns decode into data: each session owns a SLOT in a
+`KVSlotPool` (one batch row of a [slots, ...] carry tree), and every
+step — prefill chunk or single-token decode — is submitted to the
+`ContinuousBatchingScheduler` as an ordinary one-row request against a
+dedicated `<model>@decode` endpoint. The scheduler coalesces whatever
+rows are queued, the endpoint's `run_batch` scatters them into the
+fixed [slots, bucket] step shape, runs ONE jitted `session_step`
+(inactive lanes masked, RNN carries held, attention writes dropped),
+and each session samples its next token in the future's done-callback
+and immediately submits the next row. Sessions at different phases —
+one mid-prefill, another deep into decode — share the same dispatch
+and the same compiled program.
+
+Shapes are the contract: every dispatch runs at bucket 1 (pure decode)
+or bucket `prefill_chunk` (any prefill present), both warmed at
+construction, so session churn causes ZERO recompiles — the watchdog
+stays quiet (see PERF_NOTES). TTFT/ITL histograms, token counters and
+shared-dispatch counters ride the server's metrics registry so the
+closed-loop bench can reconcile its client-side numbers.
+
+Hot-swap: the manager subscribes to registry deploy hooks for its base
+model. In the "warm" phase it verifies the candidate can host the live
+carry tree and pre-compiles its session-step buckets (raising rides
+the normal rollback — sessions keep serving the old version); in the
+"flipped" phase it rebinds the pool, migrating every live session onto
+the new weights mid-stream instead of dropping them.
+"""
+
+from __future__ import annotations
+
+import itertools
+import logging
+import queue
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.serving.kv_pool import (
+    IncompatibleSessionSwapError, KVSlotPool, SlotPoolExhaustedError,
+)
+from deeplearning4j_tpu.serving.registry import ModelEntry
+from deeplearning4j_tpu.serving.scheduler import (
+    DeadlineExceededError, RequestShedError, SchedulerClosedError,
+)
+from deeplearning4j_tpu.utils.sampling import SamplingParams, sample_next
+from deeplearning4j_tpu.utils.textgen import (
+    _encode, _input_encoding, _resolve_net,
+)
+
+logger = logging.getLogger("deeplearning4j_tpu")
+
+_OUTCOMES = ("completed", "cancelled", "expired", "failed")
+
+
+class DecodeSession:
+    """One streaming generation: a slot, a cursor into the prompt, the
+    sampling state, and a queue of token events the client drains."""
+
+    def __init__(self, sid: str, slot: int, prompt: np.ndarray, *,
+                 max_tokens: int, params: SamplingParams,
+                 seed: Optional[int], deadline_ms: Optional[float],
+                 eos_id: Optional[int]):
+        self.id = sid
+        self.slot = slot
+        self.prompt = prompt
+        self.max_tokens = int(max_tokens)
+        self.params = params
+        self.rng = np.random.default_rng(seed)
+        self.eos_id = eos_id
+        self.opened_at = time.monotonic()
+        self.deadline = (None if deadline_ms is None
+                         else self.opened_at + deadline_ms / 1000.0)
+        self.generated: List[int] = []
+        self.outcome: Optional[str] = None
+        self.error: Optional[BaseException] = None
+        self.ttft_ms: Optional[float] = None
+        self.done = threading.Event()
+        self.cancelled = False
+        self._events: "queue.Queue[dict]" = queue.Queue()
+        self._off = 0              # prompt tokens already submitted
+        self._last_tok_at: Optional[float] = None
+        self._finished = False     # guarded by the manager lock
+
+    # -------------------------------------------------------- client API
+    def stream(self, timeout: Optional[float] = None):
+        """Yield token events as they arrive: `{"token", "index"}` per
+        token, then exactly one terminal event (`{"done": ...}` or
+        `{"error": ...}`). Raises queue.Empty if `timeout` seconds pass
+        without an event (a stalled-stream guard for clients)."""
+        while True:
+            ev = self._events.get(timeout=timeout)
+            yield ev
+            if "done" in ev or "error" in ev:
+                return
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Block until the session finishes; returns the generated token
+        ids, or raises the session's error (deadline, shed, crash)."""
+        if not self.done.wait(timeout):
+            raise TimeoutError(f"session {self.id} still running")
+        if self.error is not None:
+            raise self.error
+        return list(self.generated)
+
+    def cancel(self) -> None:
+        """Request cancellation; honored at the next step boundary (there
+        is always at most one step in flight per session)."""
+        self.cancelled = True
+
+    def remaining_ms(self) -> Optional[float]:
+        if self.deadline is None:
+            return None
+        return (self.deadline - time.monotonic()) * 1000.0
+
+    def describe(self) -> dict:
+        return {"id": self.id, "slot": self.slot,
+                "prompt_len": int(self.prompt.size),
+                "generated": len(self.generated),
+                "max_tokens": self.max_tokens,
+                "ttft_ms": self.ttft_ms,
+                "outcome": self.outcome}
+
+
+class DecodeSessionManager:
+    """Owns the slot pool, the `<model>@decode` endpoint, and the
+    callback chain that steps every live session."""
+
+    def __init__(self, registry, scheduler, model: str = "default", *,
+                 slots: int = 4, prefill_chunk: int = 8,
+                 metrics=None, warm: bool = True):
+        if prefill_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1")
+        base = registry.get(model)      # KeyError if not deployed
+        if not hasattr(base.net, "session_carries"):
+            raise TypeError(
+                f"decode sessions need a net with session_carries() "
+                f"(MultiLayerNetwork); got {type(base.net).__name__}")
+        self.registry = registry
+        self.scheduler = scheduler
+        self.model = model
+        self.decode_name = f"{model}@decode"
+        self.prefill_chunk = int(prefill_chunk)
+        self.buckets = sorted({1, self.prefill_chunk})
+        self._lock = threading.Lock()
+        self._net = base.net
+        self._sessions: Dict[str, DecodeSession] = {}
+        self._sid = itertools.count(1)
+        self._closed = False
+
+        first, vocab = _resolve_net(base.net)
+        self.vocab = int(vocab)
+        self._encoding = _input_encoding(first)
+        self._limit = base.net.decode_limit()
+
+        if metrics is None:
+            from deeplearning4j_tpu.observe import get_registry
+            metrics = get_registry()
+        self.metrics = metrics
+        self.pool = KVSlotPool(base.net, slots, model=model,
+                               metrics=metrics)
+        self._g_active = metrics.gauge("serving_sessions_active",
+                                       model=model)
+        self._c_opened = metrics.counter("serving_sessions_total",
+                                         model=model, outcome="opened")
+        self._c_out = {o: metrics.counter("serving_sessions_total",
+                                          model=model, outcome=o)
+                       for o in _OUTCOMES}
+        self._c_tokens = metrics.counter("serving_decode_tokens_total",
+                                         model=model)
+        self._h_ttft = metrics.histogram("serving_ttft_ms", model=model)
+        self._h_itl = metrics.histogram("serving_itl_ms", model=model)
+        self._c_disp = metrics.counter("serving_decode_dispatches_total",
+                                       model=model)
+        self._c_rows = metrics.counter(
+            "serving_decode_dispatch_rows_total", model=model)
+        self._c_shared = metrics.counter(
+            "serving_decode_shared_dispatches_total", model=model)
+
+        # the decode endpoint: an ordinary registry entry whose "runner"
+        # is this manager — scheduler dispatch, drain-on-retire and
+        # registry.close() all work unchanged
+        self.entry = registry.register_entry(
+            self.decode_name,
+            ModelEntry(self.decode_name, getattr(base, "version", None),
+                       base.net, runner=self))
+        registry.add_deploy_hook(model, self._deploy_hook)
+        if warm:
+            self.warmup()
+
+    # ------------------------------------------------------------ warmup
+    def _feat_dim(self) -> int:
+        return 1 if self._encoding == "ids" else self.vocab
+
+    def _compile_buckets(self, net) -> None:
+        """Run one all-lanes-inactive step per bucket so every dispatch
+        shape this manager will ever use is compiled before traffic (the
+        zero-recompiles-after-warmup contract the bench asserts)."""
+        carries = net.session_carries(self.pool.slots)
+        S, F = self.pool.slots, self._feat_dim()
+        act = np.zeros((S,), bool)
+        for b in self.buckets:
+            x = np.zeros((S, b, F), np.float32)
+            val = np.zeros((S, b), np.float32)
+            out, _ = net.session_step(x, carries, active=act, valid=val)
+            # materialize: compile time must land in warmup, not on the
+            # first live dispatch
+            # graft: allow-sync(warmup barrier — pre-traffic by design)
+            np.asarray(out)
+
+    def warmup(self) -> None:
+        self._compile_buckets(self.pool.net)
+
+    # ---------------------------------------------------------- sessions
+    def open_session(self, prompt_ids, *, max_tokens: int = 16,
+                     temperature: float = 1.0,
+                     top_k: Optional[int] = None,
+                     top_p: Optional[float] = None,
+                     greedy: bool = False, seed: Optional[int] = None,
+                     deadline_ms: Optional[float] = None,
+                     eos_id: Optional[int] = None,
+                     alloc_timeout_s: float = 0.0) -> DecodeSession:
+        """Admit one generation: claim a slot (SlotPoolExhaustedError →
+        503 upstream), validate the token budget against the net's
+        decode limit, and kick off the prefill→decode callback chain.
+        Returns immediately; consume via `stream()`/`result()`."""
+        prompt = np.asarray(prompt_ids, dtype=np.int64).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("prompt_ids must contain at least one token")
+        if prompt.min() < 0 or prompt.max() >= self.vocab:
+            raise ValueError(
+                f"prompt token ids must be in [0, {self.vocab})")
+        if max_tokens < 1:
+            raise ValueError("max_tokens must be >= 1")
+        params = SamplingParams(temperature=temperature, top_k=top_k,
+                                top_p=top_p, greedy=greedy)
+        if self._limit is not None and \
+                int(prompt.size) + int(max_tokens) > self._limit:
+            raise ValueError(
+                f"prompt ({prompt.size}) + max_tokens ({max_tokens}) "
+                f"exceeds the decode budget of {self._limit} for this "
+                f"net (non-rolling cache)")
+        with self._lock:
+            if self._closed:
+                raise SchedulerClosedError("session manager is shut down")
+        slot = self.pool.alloc(alloc_timeout_s)
+        sess = DecodeSession(
+            f"s{next(self._sid):06d}", slot, prompt,
+            max_tokens=max_tokens, params=params, seed=seed,
+            deadline_ms=deadline_ms, eos_id=eos_id)
+        with self._lock:
+            self._sessions[sess.id] = sess
+        self._c_opened.inc()
+        self._g_active.set(len(self._sessions))
+        try:
+            from deeplearning4j_tpu.observe import get_flight
+            get_flight().record("session_open", model=self.model,
+                                session=sess.id, slot=slot,
+                                prompt_len=int(prompt.size),
+                                max_tokens=int(max_tokens))
+        # graft: allow(GL403): breadcrumbs are best-effort
+        except Exception:
+            pass
+        self._submit_next(sess)
+        return sess
+
+    def get_session(self, sid: str) -> Optional[DecodeSession]:
+        with self._lock:
+            return self._sessions.get(sid)
+
+    def cancel(self, sid: str) -> bool:
+        sess = self.get_session(sid)
+        if sess is None:
+            return False
+        sess.cancel()
+        return True
+
+    # --------------------------------------------------- stepping chain
+    def _next_row(self, sess: DecodeSession) -> np.ndarray:
+        """The session's next request row, fixed width [1, 2 + chunk]:
+        [slot, n_valid, tok_0..]. Prefill rows carry up to `chunk`
+        prompt tokens; decode rows carry the last sampled token."""
+        row = np.zeros((1, 2 + self.prefill_chunk), np.float32)
+        row[0, 0] = sess.slot
+        if sess._off < sess.prompt.size:
+            toks = sess.prompt[sess._off:sess._off + self.prefill_chunk]
+            sess._off += toks.size
+        else:
+            toks = np.asarray([sess.generated[-1]], np.int64)
+        row[0, 1] = toks.size
+        row[0, 2:2 + toks.size] = toks
+        return row
+
+    def _submit_next(self, sess: DecodeSession) -> None:
+        with self._lock:
+            if sess._finished:
+                return      # aborted (shutdown/cancel) — stop the chain
+        rem = sess.remaining_ms()
+        if rem is not None and rem <= 0:
+            self._finish(sess, error=DeadlineExceededError(
+                f"session {sess.id} deadline passed"))
+            return
+        row = self._next_row(sess)
+        try:
+            fut = self.scheduler.submit(self.decode_name, row,
+                                        deadline_ms=rem)
+        except BaseException as e:
+            self._finish(sess, error=e)
+            return
+        fut.add_done_callback(lambda f: self._on_step(sess, f))
+
+    def _on_step(self, sess: DecodeSession, fut) -> None:
+        """Future callback (runs on the scheduler worker): consume this
+        step's logits, maybe sample, maybe finish, else chain the next
+        row. Every path must end in _finish or _submit_next — an escaped
+        exception here would orphan the session's slot."""
+        with self._lock:
+            if sess._finished:
+                return      # session was aborted while this step flew
+        try:
+            y = fut.result()
+        except BaseException as e:
+            self._finish(sess, error=e)
+            return
+        try:
+            if sess.cancelled:
+                self._finish(sess, outcome="cancelled")
+                return
+            if sess._off < sess.prompt.size:
+                # mid-prefill: the logits are positional garbage until
+                # the last prompt token lands; keep feeding chunks
+                self._submit_next(sess)
+                return
+            p = np.asarray(y, np.float64)[0]
+            tok = int(sample_next(p[None], sess.params, sess.rng)[0])
+            now = time.monotonic()
+            if sess.ttft_ms is None:
+                sess.ttft_ms = (now - sess.opened_at) * 1000.0
+                self._h_ttft.observe(sess.ttft_ms)
+            else:
+                self._h_itl.observe((now - sess._last_tok_at) * 1000.0)
+            sess._last_tok_at = now
+            sess.generated.append(tok)
+            self._c_tokens.inc()
+            sess._events.put({"token": tok,
+                              "index": len(sess.generated) - 1})
+            if (sess.eos_id is not None and tok == sess.eos_id) or \
+                    len(sess.generated) >= sess.max_tokens:
+                self._finish(sess, outcome="completed")
+            else:
+                self._submit_next(sess)
+        except BaseException as e:
+            self._finish(sess, error=e)
+
+    def _finish(self, sess: DecodeSession, *, outcome: Optional[str] = None,
+                error: Optional[BaseException] = None) -> None:
+        with self._lock:
+            if sess._finished:
+                return
+            sess._finished = True
+            self._sessions.pop(sess.id, None)
+            n_active = len(self._sessions)
+        if error is not None:
+            outcome = ("expired" if isinstance(error, DeadlineExceededError)
+                       else "failed")
+        sess.outcome = outcome
+        sess.error = error
+        self.pool.free(sess.slot)
+        self._c_out[outcome].inc()
+        self._g_active.set(n_active)
+        try:
+            from deeplearning4j_tpu.observe import get_flight
+            get_flight().record(
+                "session_close", model=self.model, session=sess.id,
+                outcome=outcome, tokens=len(sess.generated),
+                error=None if error is None else type(error).__name__)
+        # graft: allow(GL403): breadcrumbs are best-effort
+        except Exception:
+            pass
+        if error is not None:
+            sess._events.put({"error": str(error), "outcome": outcome})
+        else:
+            sess._events.put({"done": True, "outcome": outcome,
+                              "tokens": len(sess.generated)})
+        sess.done.set()
+
+    # ------------------------------------------------- scheduler runner
+    def run_batch(self, xs) -> np.ndarray:
+        """The decode endpoint's data plane. `xs` is a stack of session
+        rows ([k, 2+chunk], possibly from k different sessions — this
+        coalescing IS continuous batching). Scatter into the [slots,
+        bucket] step shape, run the one shared jitted step under the
+        pool lock, gather each row's last-valid-position logits."""
+        xs = np.asarray(xs)
+        if xs.ndim != 2 or xs.shape[1] != 2 + self.prefill_chunk:
+            raise ValueError(
+                f"decode rows must be [k, {2 + self.prefill_chunk}], "
+                f"got {xs.shape}")
+        k = xs.shape[0]
+        slots_idx = xs[:, 0].astype(np.int64)
+        nvalid = xs[:, 1].astype(np.int64)
+        need = int(nvalid.max())
+        bucket = min(b for b in self.buckets if b >= need)
+        S = self.pool.slots
+        tok = np.zeros((S, bucket), np.int64)
+        val = np.zeros((S, bucket), np.float32)
+        act = np.zeros((S,), bool)
+        for i in range(k):
+            s, n = int(slots_idx[i]), int(nvalid[i])
+            tok[s, :n] = xs[i, 2:2 + n].astype(np.int64)
+            val[s, :n] = 1.0
+            act[s] = True
+        x = _encode(tok, self._encoding, self.vocab)
+        with self.pool.lock():
+            # drop rows whose slot was freed while the row was queued
+            # (session aborted mid-flight): stepping a freed slot would
+            # dirty carries the pool just reset for the next tenant.
+            # Reading _active is safe here — we hold the pool lock.
+            for i in range(k):
+                if not self.pool._active[int(slots_idx[i])]:
+                    act[int(slots_idx[i])] = False
+            net = self.pool.net
+            out, new_carries = net.session_step(
+                x, self.pool.carries, active=act, valid=val)
+            self.pool.swap_carries(new_carries)
+        # device->host sync AFTER releasing the pool lock: the next
+        # dispatch can enqueue its step while we read this one back
+        # graft: allow-sync(decode endpoint result readback — the one
+        # intended host sync per dispatch)
+        out = np.asarray(out)
+        ys = out[slots_idx, np.maximum(nvalid - 1, 0), :]
+        self._c_disp.inc()
+        self._c_rows.inc(k)
+        if k >= 2:
+            self._c_shared.inc()
+        return ys
+
+    # --------------------------------------------------------- hot-swap
+    def _deploy_hook(self, phase: str, name: str, version, net) -> None:
+        if phase == "warm":
+            # canary: live sessions must be hostable on the candidate
+            # (raises IncompatibleSessionSwapError → deploy rolls back,
+            # sessions keep serving the current version), and its step
+            # buckets compile NOW so the flip costs zero recompiles
+            want = self._check_swap_compat(net)
+            del want
+            self._compile_buckets(net)
+            return
+        if phase == "flipped":
+            self.pool.rebind(net)
+            with self._lock:
+                self._net = net
+                n = len(self._sessions)
+            self.entry.net = net
+            self.entry.version = version
+            try:
+                from deeplearning4j_tpu.observe import get_flight
+                get_flight().record("decode_sessions_migrated",
+                                    model=name, version=version,
+                                    live_sessions=n)
+            # graft: allow(GL403): breadcrumbs are best-effort
+            except Exception:
+                pass
+            logger.info("decode sessions migrated to %s@%r (%d live)",
+                        name, version, n)
+
+    def _check_swap_compat(self, net):
+        import jax
+        want = jax.eval_shape(
+            lambda: net.session_carries(self.pool.slots))
+        have = jax.eval_shape(lambda: self.pool.carries)
+        if jax.tree_util.tree_structure(want) != \
+                jax.tree_util.tree_structure(have) or \
+                [(l.shape, str(l.dtype))
+                 for l in jax.tree_util.tree_leaves(want)] != \
+                [(l.shape, str(l.dtype))
+                 for l in jax.tree_util.tree_leaves(have)]:
+            raise IncompatibleSessionSwapError(
+                f"deploy candidate for {self.model!r} cannot host the "
+                f"live session carries; rolling back")
+        return want
+
+    # -------------------------------------------------------- lifecycle
+    def snapshot(self) -> dict:
+        with self._lock:
+            active = len(self._sessions)
+        disp = int(self._c_disp.value)
+        return {
+            "model": self.model,
+            "endpoint": self.decode_name,
+            "sessions": {
+                "active": active,
+                "opened": int(self._c_opened.value),
+                **{o: int(self._c_out[o].value) for o in _OUTCOMES},
+            },
+            "slots": self.pool.describe(),
+            "tokens_streamed": int(self._c_tokens.value),
+            "ttft_ms": self._h_ttft.percentiles(),
+            "itl_ms": self._h_itl.percentiles(),
+            "dispatches": {"total": disp,
+                           "rows": int(self._c_rows.value),
+                           "shared": int(self._c_shared.value)},
+            "buckets": list(self.buckets),
+        }
+
+    def drain(self, timeout: Optional[float] = None) -> bool:
+        """Wait for every live session to finish (no new admissions are
+        blocked — callers close admission first if they need that)."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            with self._lock:
+                live = list(self._sessions.values())
+            if not live:
+                return True
+            if deadline is not None and time.monotonic() >= deadline:
+                return False
+            live[0].done.wait(0.05)
+
+    def shutdown(self) -> None:
+        """Abort every live session (clients get a terminal error event)
+        and detach from the registry. Called by registry.close() through
+        the entry's runner seam, or directly."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            live = list(self._sessions.values())
+        for sess in live:
+            self._finish(sess, error=SchedulerClosedError(
+                "decode session manager shut down"))
+        try:
+            self.registry.remove_deploy_hook(self.model, self._deploy_hook)
+        # graft: allow(GL403): registry may already be closing
+        except Exception:
+            pass

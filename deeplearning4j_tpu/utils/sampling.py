@@ -1,0 +1,89 @@
+"""Shared token-sampling kernel for every decode path.
+
+`generate()`/`beam_search()` (utils/textgen.py) and the served decode
+sessions (serving/sessions.py) draw next tokens from per-row probability
+vectors with the same knobs — temperature, top-k, nucleus top-p, greedy.
+This module is the single tested implementation: truncation semantics
+(stable-order top-k so k=1 coincides with argmax; the nucleus keeps the
+token that crosses the threshold) live here and nowhere else.
+
+Everything is host-side numpy on [B, V] probability matrices — sampling
+happens after the device step's output has been fetched, so there is no
+tracer anywhere near this code.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import numpy as np
+
+
+def truncate_probs(p: np.ndarray, top_k: Optional[int],
+                   top_p: Optional[float]) -> np.ndarray:
+    """Nucleus/top-k truncation of a [B, V] probability matrix: zero out
+    everything outside the k most probable tokens and/or the smallest
+    prefix whose mass reaches top_p (the token crossing the threshold is
+    kept, per the nucleus-sampling convention)."""
+    if top_k is not None and top_k < p.shape[-1]:
+        # exactly k survivors even under ties; stable order on -p makes
+        # k=1 coincide with argmax (first occurrence wins)
+        order = np.argsort(-p, axis=-1, kind="stable")[:, :top_k]
+        keep = np.zeros_like(p, dtype=bool)
+        np.put_along_axis(keep, order, True, axis=-1)
+        p = np.where(keep, p, 0.0)
+    if top_p is not None and top_p < 1.0:
+        order = np.argsort(-p, axis=-1)
+        sorted_p = np.take_along_axis(p, order, axis=-1)
+        csum = np.cumsum(sorted_p, axis=-1)
+        # keep tokens strictly before the threshold crossing, plus the
+        # crossing token itself (never empty)
+        keep_sorted = (csum - sorted_p) < top_p * csum[:, -1:]
+        keep = np.zeros_like(p, dtype=bool)
+        np.put_along_axis(keep, order, keep_sorted, axis=-1)
+        p = np.where(keep, p, 0.0)
+    return p
+
+
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-request decoding knobs, validated once at construction time
+    (a served request's bad top_p should 400 at admission, not crash a
+    shared dispatch mid-stream)."""
+
+    temperature: float = 1.0
+    top_k: Optional[int] = None
+    top_p: Optional[float] = None
+    greedy: bool = False
+
+    def __post_init__(self):
+        if self.top_k is not None and self.top_k < 1:
+            raise ValueError(f"top_k must be >= 1, got {self.top_k}")
+        if self.top_p is not None and not 0.0 < self.top_p <= 1.0:
+            raise ValueError(f"top_p must be in (0, 1], got {self.top_p}")
+        if self.temperature <= 0.0:
+            raise ValueError(
+                f"temperature must be > 0, got {self.temperature}")
+
+
+def sample_next(p: np.ndarray, params: SamplingParams,
+                rng: np.random.Generator) -> np.ndarray:
+    """Draw one token per row from a [B, V] probability matrix.
+
+    Knobs compose in the canonical order `generate()` documents:
+    temperature rescales (p^(1/τ), skipped at exactly 1.0 so the default
+    path is bit-identical to no-op), then top-k, then top-p, then a
+    renormalized categorical draw per row. `greedy` takes the stable
+    argmax and ignores the truncation knobs."""
+    p = np.asarray(p, dtype=np.float64)
+    if p.ndim == 1:
+        p = p[None, :]
+    if params.greedy:
+        return p.argmax(axis=-1)
+    if params.temperature != 1.0:
+        p = np.power(np.maximum(p, 1e-30), 1.0 / params.temperature)
+    p = truncate_probs(p, params.top_k, params.top_p)
+    p = p / p.sum(axis=-1, keepdims=True)
+    vocab = p.shape[-1]
+    return np.array([rng.choice(vocab, p=p[b]) for b in range(p.shape[0])])

@@ -17,6 +17,10 @@ from typing import Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.utils.sampling import (
+    SamplingParams, sample_next, truncate_probs,
+)
+
 
 def _resolve_net(net):
     """(first_layer, vocab) for a MultiLayerNetwork or a single-input /
@@ -76,30 +80,9 @@ def _encode(ids: np.ndarray, encoding: str, vocab: int) -> np.ndarray:
     return np.eye(vocab, dtype=np.float32)[ids]
 
 
-def _truncate(p: np.ndarray, top_k: Optional[int],
-              top_p: Optional[float]) -> np.ndarray:
-    """Nucleus/top-k truncation of a [B, V] probability matrix: zero out
-    everything outside the k most probable tokens and/or the smallest
-    prefix whose mass reaches top_p (the token crossing the threshold is
-    kept, per the nucleus-sampling convention)."""
-    if top_k is not None and top_k < p.shape[-1]:
-        # exactly k survivors even under ties; stable order on -p makes
-        # k=1 coincide with argmax (first occurrence wins)
-        order = np.argsort(-p, axis=-1, kind="stable")[:, :top_k]
-        keep = np.zeros_like(p, dtype=bool)
-        np.put_along_axis(keep, order, True, axis=-1)
-        p = np.where(keep, p, 0.0)
-    if top_p is not None and top_p < 1.0:
-        order = np.argsort(-p, axis=-1)
-        sorted_p = np.take_along_axis(p, order, axis=-1)
-        csum = np.cumsum(sorted_p, axis=-1)
-        # keep tokens strictly before the threshold crossing, plus the
-        # crossing token itself (never empty)
-        keep_sorted = (csum - sorted_p) < top_p * csum[:, -1:]
-        keep = np.zeros_like(p, dtype=bool)
-        np.put_along_axis(keep, order, keep_sorted, axis=-1)
-        p = np.where(keep, p, 0.0)
-    return p
+# Truncation moved to utils/sampling.py so served decode shares the one
+# tested implementation; the old private name stays importable.
+_truncate = truncate_probs
 
 
 def _prefill(net, prompt_ids, encoding, vocab, chunk: Optional[int]):
@@ -155,6 +138,8 @@ def generate(net, prompt_ids, n_tokens: int, *, temperature: float = 1.0,
     encoding = _input_encoding(first_layer)
     if rng is None:
         rng = np.random.default_rng(0)
+    params = SamplingParams(temperature=temperature, top_k=top_k,
+                            top_p=top_p, greedy=greedy)
 
     penalize = repetition_penalty != 1.0
     if penalize:
@@ -175,14 +160,7 @@ def generate(net, prompt_ids, n_tokens: int, *, temperature: float = 1.0,
                                              repetition_penalty), 1e-300),
                          p)
             p = p / p.sum(axis=-1, keepdims=True)
-        if greedy:
-            tok = p.argmax(axis=-1)
-        else:
-            if temperature != 1.0:
-                p = np.power(np.maximum(p, 1e-30), 1.0 / temperature)
-            p = _truncate(p, top_k, top_p)
-            p = p / p.sum(axis=-1, keepdims=True)
-            tok = np.array([rng.choice(vocab, p=p[b]) for b in range(B)])
+        tok = sample_next(p, params, rng)
         generated[:, i] = tok
         if penalize:
             seen[np.arange(B), tok] = True

@@ -1,0 +1,546 @@
+"""Stateful decode serving: KV slot pool, decode sessions under
+continuous batching, streaming, and hot-swap migration.
+
+What these pin:
+  * the sampling helper (utils/sampling.py) is the one shared
+    truncation/sampling implementation for generate() and served decode
+  * `session_step` (per-slot positions, masked lanes) reproduces the
+    sequential `rnn_time_step` decode exactly, per slot
+  * a freed slot NEVER leaks the previous session's keys/values — both
+    defenses independently: the pool's reset zeroes the rows, and the
+    rolling ring's visibility arithmetic masks stale rows even when
+    they are poisoned (reset-masking at the decode_carry level)
+  * concurrent sessions coalesce into shared scheduler dispatches with
+    ZERO recompiles after warmup (the fixed-shape decode contract)
+  * deadlines expire sessions, cancel frees slots, exhaustion is an
+    admission error, and hot-swap migrates live sessions (rollback on
+    an incompatible candidate keeps them serving the old version)
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.nn.layers.attention import (
+    PositionEmbeddingLayer, TransformerEncoderBlock,
+)
+from deeplearning4j_tpu.nn.layers.feedforward import EmbeddingSequenceLayer
+from deeplearning4j_tpu.nn.layers.recurrent import RnnOutputLayer
+from deeplearning4j_tpu.observe.watchdog import get_watchdog
+from deeplearning4j_tpu.optim.updaters import Adam
+
+V, T = 13, 6
+
+
+def _make_net(seed=0, emb=12, max_len=64, window=8, max_cache=16):
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-3))
+            .activation("identity")
+            .list(EmbeddingSequenceLayer(n_in=V, n_out=emb),
+                  PositionEmbeddingLayer(max_length=max_len),
+                  TransformerEncoderBlock(num_heads=2, causal=True,
+                                          window=window,
+                                          rolling_cache=True,
+                                          max_cache=max_cache),
+                  RnnOutputLayer(n_out=V, activation="softmax"))
+            .set_input_type(InputType.recurrent(1, T)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+@pytest.fixture(scope="module")
+def net():
+    return _make_net()
+
+
+def _control_plane(net, slots=2, chunk=4):
+    from deeplearning4j_tpu.serving import (
+        ContinuousBatchingScheduler, ModelRegistry, ServingStats,
+    )
+    from deeplearning4j_tpu.serving.sessions import DecodeSessionManager
+
+    registry = ModelRegistry()
+    registry.deploy("default", 1, net, warm=False)
+    stats = ServingStats()
+    sched = ContinuousBatchingScheduler(registry, stats, max_batch_size=8)
+    mgr = DecodeSessionManager(registry, sched, "default", slots=slots,
+                               prefill_chunk=chunk,
+                               metrics=stats.registry)
+    return registry, sched, mgr
+
+
+# ------------------------------------------------------------ sampling
+class TestSamplingHelper:
+    def test_truncate_is_shared_with_textgen(self):
+        from deeplearning4j_tpu.utils import textgen
+        from deeplearning4j_tpu.utils.sampling import truncate_probs
+        assert textgen._truncate is truncate_probs
+
+    def test_top_k_top_p(self):
+        from deeplearning4j_tpu.utils.sampling import truncate_probs
+        p = np.array([[0.4, 0.3, 0.2, 0.1]])
+        k2 = truncate_probs(p, 2, None)
+        assert (k2 > 0).sum() == 2 and k2[0, 0] > 0 and k2[0, 1] > 0
+        nuc = truncate_probs(p, None, 0.6)
+        assert (nuc > 0).sum() == 2       # 0.4+0.3 covers 0.6
+
+    def test_params_validate(self):
+        from deeplearning4j_tpu.utils.sampling import SamplingParams
+        with pytest.raises(ValueError):
+            SamplingParams(top_k=0)
+        with pytest.raises(ValueError):
+            SamplingParams(top_p=1.5)
+        with pytest.raises(ValueError):
+            SamplingParams(temperature=0.0)
+
+    def test_greedy_and_temperature(self):
+        from deeplearning4j_tpu.utils.sampling import (
+            SamplingParams, sample_next,
+        )
+        p = np.array([[0.1, 0.7, 0.2]])
+        rng = np.random.default_rng(0)
+        tok = sample_next(p, SamplingParams(greedy=True), rng)
+        assert tok[0] == 1
+        # low temperature sharpens toward the mode
+        cold = [int(sample_next(p, SamplingParams(temperature=0.05),
+                                np.random.default_rng(i))[0])
+                for i in range(20)]
+        assert cold.count(1) >= 18
+
+
+# ------------------------------------------------- session-step parity
+class TestSessionStepParity:
+    def test_session_step_matches_sequential_decode(self, net):
+        """Two slots stepped through the batched per-slot seam must
+        reproduce two independent sequential rnn_time_step streams."""
+        rng = np.random.default_rng(3)
+        prompts = [rng.integers(0, V, 9), rng.integers(0, V, 9)]
+
+        seq = []
+        for pr in prompts:
+            net.rnn_clear_previous_state()
+            outs = [np.asarray(net.rnn_time_step(
+                pr[None, i:i + 1, None].astype(np.float32)))[0, 0]
+                for i in range(len(pr))]
+            seq.append(np.stack(outs))
+        net.rnn_clear_previous_state()
+
+        carries = net.session_carries(2)
+        got = [[], []]
+        for i in range(9):
+            x = np.stack([prompts[0][i:i + 1], prompts[1][i:i + 1]]
+                         )[..., None].astype(np.float32)
+            act = np.array([True, True])
+            val = np.ones((2, 1), np.float32)
+            out, carries = net.session_step(x, carries, active=act,
+                                            valid=val)
+            out = np.asarray(out)
+            got[0].append(out[0, 0])
+            got[1].append(out[1, 0])
+        for s in range(2):
+            np.testing.assert_allclose(np.stack(got[s]), seq[s],
+                                       rtol=2e-4, atol=2e-5)
+
+    def test_inactive_lane_holds_carries(self, net):
+        carries = net.session_carries(2)
+        x = np.ones((2, 1, 1), np.float32)
+        val = np.ones((2, 1), np.float32)
+        _, c1 = net.session_step(x, carries,
+                                 active=np.array([True, False]),
+                                 valid=val)
+        import jax
+        for a, b in zip(jax.tree_util.tree_leaves(carries),
+                        jax.tree_util.tree_leaves(c1)):
+            a, b = np.asarray(a), np.asarray(b)
+            if a.shape[0] == 2:
+                np.testing.assert_array_equal(a[1], b[1])   # held
+        # active lane advanced its position
+        pos = [np.asarray(l) for l in jax.tree_util.tree_leaves(c1)
+               if np.asarray(l).shape == (2,)]
+        assert any(p[0] == 1 and p[1] == 0 for p in pos)
+
+
+# ------------------------------------------------------------ the pool
+class TestKVSlotPool:
+    def test_alloc_free_exhaustion_gauges(self, net):
+        from deeplearning4j_tpu.observe.registry import MetricsRegistry
+        from deeplearning4j_tpu.serving.kv_pool import (
+            KVSlotPool, SlotPoolExhaustedError,
+        )
+        reg = MetricsRegistry()
+        pool = KVSlotPool(net, 2, metrics=reg)
+        a, b = pool.alloc(), pool.alloc()
+        assert {a, b} == {0, 1}
+        assert pool.in_use() == 2
+        assert reg.gauge("serving_kv_slots_in_use",
+                         model="default").value == 2
+        with pytest.raises(SlotPoolExhaustedError):
+            pool.alloc()
+        pool.free(a)
+        pool.free(a)                      # idempotent
+        assert pool.in_use() == 1
+        assert reg.gauge("serving_kv_slots_in_use",
+                         model="default").value == 1
+        assert pool.alloc() == a
+
+    def test_alloc_timeout_unblocks_on_free(self, net):
+        from deeplearning4j_tpu.serving.kv_pool import KVSlotPool
+        pool = KVSlotPool(net, 1)
+        s = pool.alloc()
+        threading.Timer(0.05, pool.free, args=(s,)).start()
+        assert pool.alloc(timeout_s=2.0) == s
+
+    def test_freed_slot_never_leaks_previous_session(self, net):
+        """The wraparound-reuse satellite, both defenses separately.
+
+        (1) free() zeroes the slot's rows — checked directly.
+        (2) even WITHOUT the zeroing, a fresh slot at position 0 cannot
+            see stale ring rows: we poison the freed slot's caches with
+            huge finite garbage and the re-run still matches a clean
+            pool bit-for-bit — the held-position arithmetic gives the
+            stale rows exactly zero attention weight. (NaN poison would
+            be over-adversarial: a 0-weight NaN value still pollutes
+            `0 * NaN`; stale data from a real session is finite.)"""
+        import jax
+        from deeplearning4j_tpu.serving.kv_pool import KVSlotPool
+
+        def run(pool, slot, toks):
+            outs = []
+            for t in toks:
+                x = np.full((pool.slots, 1, 1), 0, np.float32)
+                x[slot, 0, 0] = t
+                act = np.zeros((pool.slots,), bool)
+                act[slot] = True
+                val = np.zeros((pool.slots, 1), np.float32)
+                val[slot] = 1.0
+                out, new = pool.net.session_step(
+                    x, pool.carries, active=act, valid=val)
+                with pool.lock():
+                    pool.swap_carries(new)
+                outs.append(np.asarray(out)[slot, 0])
+            return np.stack(outs)
+
+        rng = np.random.default_rng(7)
+        # long enough to wrap the ring (max_cache 16) several times
+        session_a = rng.integers(0, V, 40)
+        session_b = rng.integers(0, V, 12)
+
+        pool = KVSlotPool(net, 2)
+        slot = pool.alloc()
+        run(pool, slot, session_a)
+        pool.free(slot)
+
+        # defense 1: rows are actually zeroed
+        for leaf in jax.tree_util.tree_leaves(pool.carries):
+            leaf = np.asarray(leaf)
+            if leaf.ndim >= 1 and leaf.shape[0] == 2:
+                assert not np.any(leaf[slot]), "freed slot not reset"
+
+        # defense 2: poison the freed slot's KV rows, then reuse it —
+        # the ring's visibility mask alone must hide the garbage
+        def poison(c):
+            def p(a):
+                if getattr(a, "ndim", 0) >= 3 and a.shape[0] == 2:
+                    a = np.asarray(a).copy()
+                    a[slot] = 7777.0
+                    return a
+                return a
+            return jax.tree_util.tree_map(p, c)
+        with pool.lock():
+            pool.swap_carries(poison(pool.carries))
+
+        assert pool.alloc() == slot       # same slot, new tenant
+        got = run(pool, slot, session_b)
+        assert np.isfinite(got).all(), "stale poisoned KV leaked in"
+        assert np.abs(got).max() <= 1.0   # softmax outputs, no garbage
+
+        clean = KVSlotPool(net, 2)
+        s2 = clean.alloc()
+        want = run(clean, s2, session_b)
+        np.testing.assert_array_equal(got, want)
+
+    def test_rebind_rejects_incompatible(self, net):
+        from deeplearning4j_tpu.serving.kv_pool import (
+            IncompatibleSessionSwapError, KVSlotPool,
+        )
+        pool = KVSlotPool(net, 2)
+        pool.rebind(_make_net(seed=5))            # same shapes: fine
+        with pytest.raises(IncompatibleSessionSwapError):
+            pool.rebind(_make_net(seed=5, emb=16))
+
+
+# --------------------------------------------- sessions + batching
+class TestDecodeSessions:
+    def test_concurrent_sessions_share_dispatches_zero_recompiles(self,
+                                                                  net):
+        registry, sched, mgr = _control_plane(net)
+        try:
+            c0 = get_watchdog().compiles()
+            s1 = mgr.open_session([1, 2, 3, 4, 5], max_tokens=8, seed=1)
+            s2 = mgr.open_session([6, 7], max_tokens=8, seed=2)
+            t1, t2 = s1.result(timeout=60), s2.result(timeout=60)
+            assert len(t1) == len(t2) == 8
+            assert get_watchdog().compiles() == c0, \
+                "decode sessions caused a recompile after warmup"
+            snap = mgr.snapshot()
+            assert snap["sessions"]["completed"] == 2
+            assert snap["tokens_streamed"] == 16
+            assert snap["dispatches"]["shared"] >= 1, \
+                "sessions never coalesced into one dispatch"
+            assert snap["slots"]["in_use"] == 0
+        finally:
+            sched.shutdown()
+            registry.close()
+
+    def test_stream_events_and_outcomes(self, net):
+        registry, sched, mgr = _control_plane(net)
+        try:
+            s = mgr.open_session([1, 2], max_tokens=3, seed=0)
+            evs = list(s.stream(timeout=60))
+            toks = [e["token"] for e in evs if "token" in e]
+            assert toks == s.result(timeout=5)
+            assert evs[-1] == {"done": True, "outcome": "completed",
+                               "tokens": 3}
+            assert s.ttft_ms is not None and s.ttft_ms >= 0
+        finally:
+            sched.shutdown()
+            registry.close()
+
+    def test_eos_stops_early(self, net):
+        registry, sched, mgr = _control_plane(net)
+        try:
+            # greedy: first token is deterministic; use it as eos
+            probe = mgr.open_session([3, 1], max_tokens=1, greedy=True)
+            eos = probe.result(timeout=60)[0]
+            s = mgr.open_session([3, 1], max_tokens=50, greedy=True,
+                                 eos_id=int(eos))
+            toks = s.result(timeout=60)
+            assert toks[-1] == eos and len(toks) < 50
+        finally:
+            sched.shutdown()
+            registry.close()
+
+    def test_deadline_expires_and_frees_slot(self, net):
+        from deeplearning4j_tpu.serving import DeadlineExceededError
+        registry, sched, mgr = _control_plane(net)
+        try:
+            s = mgr.open_session([1, 2, 3], max_tokens=50,
+                                 deadline_ms=1)
+            with pytest.raises(DeadlineExceededError):
+                s.result(timeout=60)
+            assert s.outcome == "expired"
+            assert mgr.pool.in_use() == 0
+        finally:
+            sched.shutdown()
+            registry.close()
+
+    def test_cancel_frees_slot(self, net):
+        registry, sched, mgr = _control_plane(net)
+        try:
+            s = mgr.open_session([1, 2], max_tokens=50)
+            s.cancel()
+            # cancel is not an error: result() returns what was
+            # generated before the cancel landed
+            partial = s.result(timeout=60)
+            assert s.outcome == "cancelled"
+            assert len(partial) < 50
+            assert mgr.pool.in_use() == 0
+        finally:
+            sched.shutdown()
+            registry.close()
+
+    def test_budget_and_exhaustion(self, net):
+        from deeplearning4j_tpu.serving import SlotPoolExhaustedError
+        registry, sched, mgr = _control_plane(net)
+        try:
+            with pytest.raises(ValueError):    # 64-position embedding
+                mgr.open_session([1, 2], max_tokens=500)
+            held = [mgr.open_session([1], max_tokens=60, seed=i)
+                    for i in range(2)]
+            with pytest.raises(SlotPoolExhaustedError):
+                mgr.open_session([1], max_tokens=5)
+            for h in held:
+                h.cancel()
+        finally:
+            sched.shutdown()
+            registry.close()
+
+    def test_shutdown_aborts_sessions_with_terminal_event(self, net):
+        registry, sched, mgr = _control_plane(net)
+        s = mgr.open_session([1, 2], max_tokens=50)
+        mgr.shutdown()
+        assert s.done.wait(10)
+        assert s.outcome == "failed"
+        evs = list(s.stream(timeout=5))
+        assert "error" in evs[-1]
+        sched.shutdown()
+        registry.close()
+
+
+# ------------------------------------------------------ DecodeState
+class TestDecodeState:
+    def test_hammer_is_race_free(self):
+        from deeplearning4j_tpu.models.decode_state import DecodeState
+        st = DecodeState()
+        errors = []
+
+        def work(i):
+            try:
+                for _ in range(300):
+                    with st.lock():
+                        before = st.pos
+                        st.seed({"k": i})
+                        st.update({"k": i, "v": i}, advance=1)
+                        assert st.pos == before + 1
+                        assert st.carries["k"] == i
+            except BaseException as e:      # pragma: no cover
+                errors.append(e)
+
+        ts = [threading.Thread(target=work, args=(i,)) for i in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert not errors
+        assert st.pos == 4 * 300
+        st.clear()
+        assert st.pos == 0 and st.carries == {}
+
+    def test_models_use_decode_state(self, net):
+        from deeplearning4j_tpu.models.decode_state import DecodeState
+        assert isinstance(net._decode_state, DecodeState)
+        net.rnn_clear_previous_state()
+        net.rnn_time_step(np.ones((1, 2, 1), np.float32))
+        assert net._decode_pos == 2
+        net.rnn_clear_previous_state()
+        assert net._decode_pos == 0
+
+
+# ------------------------------------------------------- HTTP + swap
+@pytest.mark.slow
+class TestServingDecodeHttp:
+    def test_generate_streams_and_reconciles_metrics(self):
+        import json
+        import urllib.request
+        from deeplearning4j_tpu.serving import InferenceServer
+
+        srv = InferenceServer(_make_net(), decode_slots=2,
+                              decode_prefill_chunk=4)
+        port = srv.start()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            outs = [[], []]
+
+            def go(i):
+                body = json.dumps({"prompt_ids": [1, 2, 3 + i],
+                                   "max_tokens": 6, "seed": i}).encode()
+                req = urllib.request.Request(base + "/generate",
+                                             data=body)
+                with urllib.request.urlopen(req) as r:
+                    assert r.headers["Content-Type"].startswith(
+                        "text/event-stream")
+                    for line in r:
+                        line = line.decode().strip()
+                        if line.startswith("data: "):
+                            outs[i].append(json.loads(line[6:]))
+
+            ts = [threading.Thread(target=go, args=(i,))
+                  for i in range(2)]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            for evs in outs:
+                assert "session" in evs[0]
+                assert len([e for e in evs if "token" in e]) == 6
+                assert evs[-1]["outcome"] == "completed"
+
+            with urllib.request.urlopen(base + "/metrics") as r:
+                snap = json.load(r)
+            d = snap["decode"]["default"]
+            assert d["tokens_streamed"] == 12
+            assert d["sessions"]["completed"] == 2
+            assert d["dispatches"]["shared"] >= 1
+
+            # non-streamed JSON body
+            body = json.dumps({"prompt_ids": [5], "max_tokens": 3,
+                               "stream": False, "greedy": True}).encode()
+            with urllib.request.urlopen(urllib.request.Request(
+                    base + "/generate", data=body)) as r:
+                res = json.load(r)
+            assert len(res["tokens"]) == 3
+            assert res["outcome"] == "completed"
+
+            with urllib.request.urlopen(base + "/sessions") as r:
+                assert json.load(r)["decode"]["default"][
+                    "sessions"]["active"] == 0
+        finally:
+            srv.stop()
+
+    def test_generate_exhaustion_503_and_cancel_endpoint(self):
+        import json
+        import urllib.error
+        import urllib.request
+        from deeplearning4j_tpu.serving import InferenceServer
+
+        srv = InferenceServer(_make_net(), decode_slots=1,
+                              decode_prefill_chunk=4)
+        port = srv.start()
+        base = f"http://127.0.0.1:{port}"
+        try:
+            mgr = srv._decode["default"]
+            held = mgr.open_session([1], max_tokens=60, seed=4)
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(urllib.request.Request(
+                    base + "/generate",
+                    data=json.dumps({"prompt_ids": [1]}).encode()))
+            assert ei.value.code == 503
+            body = json.dumps({"session": held.id}).encode()
+            with urllib.request.urlopen(urllib.request.Request(
+                    base + "/generate/cancel", data=body)) as r:
+                assert json.load(r)["cancelled"] is True
+            assert held.done.wait(30)
+            assert mgr.pool.in_use() == 0
+        finally:
+            srv.stop()
+
+
+@pytest.mark.slow
+class TestHotSwapWithSessions:
+    def test_flip_migrates_and_rollback_keeps_sessions(self):
+        from deeplearning4j_tpu.serving import (
+            DeployRolledBackError, InferenceServer,
+        )
+        srv = InferenceServer(_make_net(seed=0), decode_slots=2,
+                              decode_prefill_chunk=4)
+        srv.start()
+        try:
+            mgr = srv._decode["default"]
+            s = mgr.open_session([1, 2, 3], max_tokens=40, seed=1)
+            srv.deploy("default", 2, _make_net(seed=7),
+                       feat_shape=(T, 1))
+            assert len(s.result(timeout=120)) == 40
+            assert s.outcome == "completed"
+            assert mgr.entry.version == 2
+
+            # post-flip sessions must pay zero compiles (the warm-phase
+            # hook compiled the new net's buckets inside the canary)
+            c0 = get_watchdog().compiles()
+            s2 = mgr.open_session([4], max_tokens=4, seed=2)
+            s2.result(timeout=60)
+            assert get_watchdog().compiles() == c0
+
+            # incompatible candidate: deploy rolls back, live session
+            # keeps decoding on the surviving version
+            s3 = mgr.open_session([1, 2], max_tokens=30, seed=3)
+            with pytest.raises(DeployRolledBackError):
+                srv.deploy("default", 3, _make_net(seed=9, emb=16),
+                           feat_shape=(T, 1))
+            assert srv.registry.get("default").version == 2
+            assert len(s3.result(timeout=120)) == 30
+            assert s3.outcome == "completed"
+        finally:
+            srv.stop()
