@@ -1197,7 +1197,159 @@ def _serving_decode_main():
     print(json.dumps(out))
 
 
+def _kernels_main():
+    """`bench.py --kernels`: banded-attention / decode / fused-update
+    microbench → BENCH_kernels.json.
+
+    Per shape bucket it records BOTH wall-clock ms (kernel vs its dense
+    XLA contender — meaningful on TPU; on CPU the banded side runs
+    interpret-mode and the ms column documents only that it ran) and the
+    XLA compile-cost flops/bytes of each side. The compile costs are the
+    platform-independent evidence the acceptance contract keys on: the
+    dense contender's flops grow ~T² across buckets while the banded
+    program's grow ~T·w. Dispatch policies are consulted per bucket so
+    the kernel_dispatch_total counters land in the embedded registry
+    snapshot. Knobs: BENCH_KERNELS_SHAPES="256x32,512x64",
+    BENCH_KERNELS_REPS, BENCH_KERNELS_OUT.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from deeplearning4j_tpu.ops.banded_attention import (
+        banded_attention, banded_decode_attention, banded_reference,
+        decode_reference,
+    )
+    from deeplearning4j_tpu.ops.fused_update import (
+        adam_update, nesterov_update,
+    )
+    from deeplearning4j_tpu.ops.kernel_defaults import (
+        banded_policy, decode_attention_policy, fused_update_policy,
+    )
+
+    on_tpu = jax.default_backend() == "tpu"
+    interp = not on_tpu
+    reps = int(os.environ.get("BENCH_KERNELS_REPS", "5"))
+    shapes = [tuple(int(v) for v in s.split("x"))
+              for s in os.environ.get("BENCH_KERNELS_SHAPES",
+                                      "256x32,512x64").split(",")]
+
+    def _cost(fn, *args):
+        try:
+            c = jax.jit(fn).lower(*args).cost_analysis()
+            if isinstance(c, (list, tuple)):
+                c = c[0] if c else {}
+            c = c or {}
+            return {"flops": float(c.get("flops") or 0.0),
+                    "bytes_accessed": float(c.get("bytes accessed")
+                                            or 0.0)}
+        except Exception as e:
+            return {"error": f"{type(e).__name__}: {e}"}
+
+    def _ms(fn, *args):
+        f = jax.jit(fn)
+        jax.block_until_ready(f(*args))   # compile + warmup
+        best = None
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(f(*args))
+            dt = (time.perf_counter() - t0) * 1e3
+            best = dt if best is None else min(best, dt)
+        return round(best, 3)
+
+    b, h, hkv, dh = 2, 4, 2, 64
+    buckets = []
+    for t, w in shapes:
+        key = jax.random.PRNGKey(t)
+        kq, kk, kv = jax.random.split(key, 3)
+        q = jax.random.normal(kq, (b, t, h, dh), jnp.float32)
+        k = jax.random.normal(kk, (b, t, hkv, dh), jnp.float32)
+        v = jax.random.normal(kv, (b, t, hkv, dh), jnp.float32)
+        pol = banded_policy(t, h, hkv)          # records dispatch
+        dense = lambda q, k, v: banded_reference(q, k, v, w, True,
+                                                 dh ** -0.5)
+        banded = lambda q, k, v: banded_attention(
+            q, k, v, w, True, None, 256, 256, interp)
+        buckets.append({
+            "kind": "banded_attention", "t": t, "window": w,
+            "heads": h, "kv_heads": hkv, "head_dim": dh,
+            "policy": pol.kind,
+            "dense": {"ms": _ms(dense, q, k, v),
+                      **_cost(dense, q, k, v)},
+            "banded": {"ms": _ms(banded, q, k, v),
+                       **_cost(banded, q, k, v)},
+        })
+
+    # single-query decode over the KV-cache layout [B, L, Hkv, Dh]
+    for cache_len in (512,):
+        key = jax.random.PRNGKey(cache_len)
+        kq, kk, kv = jax.random.split(key, 3)
+        q1 = jax.random.normal(kq, (b, h, dh), jnp.float32)
+        ck = jax.random.normal(kk, (b, cache_len, hkv, dh), jnp.float32)
+        cv = jax.random.normal(kv, (b, cache_len, hkv, dh), jnp.float32)
+        qpos = jnp.full((b,), cache_len - 1, jnp.int32)
+        dpol = decode_attention_policy(cache_len, h, hkv)
+        ddense = lambda q1, ck, cv: decode_reference(
+            q1, ck, cv, qpos, qpos, None, False, dh ** -0.5)
+        dband = lambda q1, ck, cv: banded_decode_attention(
+            q1, ck, cv, qpos, qpos, window=None, rolling=False,
+            block_l=512, interpret=interp)
+        buckets.append({
+            "kind": "decode_attention", "cache_len": cache_len,
+            "heads": h, "kv_heads": hkv, "head_dim": dh,
+            "policy": dpol.kind,
+            "dense": {"ms": _ms(ddense, q1, ck, cv),
+                      **_cost(ddense, q1, ck, cv)},
+            "banded": {"ms": _ms(dband, q1, ck, cv),
+                       **_cost(dband, q1, ck, cv)},
+        })
+
+    # fused optimizer update, one ~1M-element leaf
+    n = 1 << 20
+    key = jax.random.PRNGKey(7)
+    kp, kg = jax.random.split(key)
+    p = jax.random.normal(kp, (n,), jnp.float32)
+    g = jax.random.normal(kg, (n,), jnp.float32) * 1e-2
+    m = jnp.zeros((n,), jnp.float32)
+    vv = jnp.zeros((n,), jnp.float32)
+    lrbc = jnp.float32(1e-3)
+
+    def adam_xla(p, g, m, vv):
+        m2 = 0.9 * m + 0.1 * g
+        v2 = 0.999 * vv + 0.001 * g * g
+        return p - lrbc * m2 / (jnp.sqrt(v2) + 1e-8), m2, v2
+
+    adam_fused = lambda p, g, m, vv: adam_update(
+        p, g, m, vv, lrbc, interpret=interp)
+    upol = fused_update_policy("adam")
+    buckets.append({
+        "kind": "fused_update", "opt": "adam", "n": n, "policy": upol,
+        "xla": {"ms": _ms(adam_xla, p, g, m, vv),
+                **_cost(adam_xla, p, g, m, vv)},
+        "fused": {"ms": _ms(adam_fused, p, g, m, vv),
+                  **_cost(adam_fused, p, g, m, vv)},
+    })
+
+    dev = jax.devices()[0]
+    out = {
+        "metric": "kernel_microbench",
+        "buckets": buckets,
+        "reps": reps,
+        "interpret_mode": interp,
+        "device": getattr(dev, "device_kind", str(dev)),
+        "platform": dev.platform,
+        "registry": _registry_snapshot(),
+    }
+    dest = os.environ.get("BENCH_KERNELS_OUT") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_kernels.json")
+    with open(dest, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+
+
 def main():
+    if "--kernels" in sys.argv or os.environ.get("BENCH_KERNELS"):
+        _kernels_main()
+        return
     if "--serving-decode" in sys.argv or os.environ.get(
             "BENCH_SERVING_DECODE"):
         _serving_decode_main()

@@ -265,13 +265,10 @@ class ComputationGraph(SeqCtxJitCache, SeqCtxSolverCache):
             grads = _normalize_grads(grads, mode, thr)
             new_params, new_opt = {}, {}
             for name, u in updaters.items():
-                upd, st = u.apply(grads[name], opt_state[name], params[name], step)
-                # Preserve dtypes (bf16 training + donation): see
+                # Whole-update seam (fused-kernel capable): see
                 # MultiLayerNetwork._build_step.
-                new_params[name] = _tmap(
-                    lambda a, b: a - b.astype(a.dtype), params[name], upd)
-                new_opt[name] = _tmap(
-                    lambda n, o: n.astype(o.dtype), st, opt_state[name])
+                new_params[name], new_opt[name] = u.update_with_params(
+                    grads[name], opt_state[name], params[name], step)
             persist = {
                 n: (new_states[n] if n in stateful else states.get(n, {}))
                 for n in states
@@ -635,10 +632,8 @@ class ComputationGraph(SeqCtxJitCache, SeqCtxSolverCache):
                     return layer.reconstruction_score(p, x, rng=rng)
 
                 loss, grads = jax.value_and_grad(loss_fn)(lp)
-                upd, new_opt = updater.apply(grads, opt_state, lp, step)
-                new_lp = _tmap(lambda a, b: a - b.astype(a.dtype), lp, upd)
-                new_opt = _tmap(lambda n, o: n.astype(o.dtype), new_opt,
-                                opt_state)
+                new_lp, new_opt = updater.update_with_params(
+                    grads, opt_state, lp, step)
                 return new_lp, new_opt, loss
 
             step = 0

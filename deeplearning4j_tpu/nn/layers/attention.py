@@ -70,8 +70,10 @@ class MultiHeadAttention(Layer):
     window: Optional[int] = None      # sliding-window (local) attention:
     # each position sees at most `window` keys back (causal) or within
     # |i-j| < window (bidirectional) — Mistral-style locality; O(T*w)
-    # useful score mass. Windowed layers use the dense band-masked path
-    # (the flash kernel and the ring are full-context codepaths).
+    # useful score mass. Windowed layers route through the banded Pallas
+    # kernel when `kernel_defaults.banded_policy` approves (O(T*w) by
+    # grid construction), else the dense band-masked path; the flash
+    # kernel and the ring remain full-context codepaths.
     rolling_cache: bool = False       # causal+window decode streams in a
     # FIXED max_cache-slot ring buffer (Mistral's rolling KV cache):
     # slot = position % max_cache, so generation length is unbounded in
@@ -294,7 +296,36 @@ class MultiHeadAttention(Layer):
             pos_new = pos + T
         # [T, L] (lockstep) or [B, T, L] (per-slot) -> broadcastable
         vb = vis if vis.ndim == 3 else vis[None]
-        if Hkv != H:
+        dpol = None
+        if T == 1:
+            from deeplearning4j_tpu.ops.kernel_defaults import (
+                decode_attention_policy,
+            )
+
+            dpol = decode_attention_policy(L, H, Hkv)
+        if dpol is not None and dpol.kind == "banded":
+            # Single-token step: the banded decode kernel reads the cache
+            # in its stored [*, L, Hkv, Dh] layout (same arithmetic as
+            # `vis` above, held-index ring included) without broadcasting
+            # KV to H heads or materializing [B, H, 1, L] scores in HBM.
+            from deeplearning4j_tpu.ops.banded_attention import (
+                banded_decode_attention,
+            )
+
+            if per_slot:
+                dec_pos = pos
+                dec_end = (pos + n_new - 1 if self.rolling_cache
+                           else pos)
+            else:
+                dec_pos = jnp.broadcast_to(pos, (B,))
+                dec_end = dec_pos
+            o = banded_decode_attention(
+                q[:, 0], ck, cv, dec_pos.astype(jnp.int32),
+                dec_end.astype(jnp.int32), window=self.window,
+                rolling=self.rolling_cache, block_l=dpol.block_l,
+                interpret=jax.default_backend() != "tpu")
+            o = o[:, None]
+        elif Hkv != H:
             # GQA: group the query heads against the Hkv-wide cache in
             # the einsum itself — the cache is never broadcast to H
             # heads, so the per-token HBM sweep (decode's binding
@@ -332,12 +363,17 @@ class MultiHeadAttention(Layer):
             positions = jnp.arange(T)
             q = rope_rotate(q, positions)
             k = rope_rotate(k, positions)
-        if Hkv != H:
-            # GQA full-sequence path: broadcast KV heads to the query
-            # heads for the attention core (training materializes full
-            # activations anyway; the cache savings are the decode win)
-            k = jnp.repeat(k, H // Hkv, axis=2)
-            v = jnp.repeat(v, H // Hkv, axis=2)
+
+        def broadcast_kv(k, v):
+            # GQA fallback for the H-wide attention cores (ring, flash,
+            # dense): broadcast KV heads up to the query heads. The
+            # banded kernel never needs this — it consumes the native
+            # Hkv layout, which is where its decode-path HBM win lives.
+            if Hkv != H:
+                k = jnp.repeat(k, H // Hkv, axis=2)
+                v = jnp.repeat(v, H // Hkv, axis=2)
+            return k, v
+
         from deeplearning4j_tpu.parallel.ring_attention import (
             current_sequence_mesh,
         )
@@ -370,17 +406,41 @@ class MultiHeadAttention(Layer):
                 ring_self_attention,
             )
 
+            k, v = broadcast_kv(k, v)
             o = ring_self_attention(q, k, v, seq_ctx.mesh,
                                     axis=seq_ctx.axis, causal=self.causal)
-        elif mask is not None or drop or self.window is not None:
-            # Padding mask, attention-weight dropout, and the sliding
-            # window all need the dense path (dropout perturbs the
-            # post-softmax weights, which never materialize inside the
-            # flash kernel; the band mask is a score-level bias).
+        elif self.window is not None and mask is None and not drop:
+            # Sliding window (no mask/dropout): the banded kernel serves
+            # this O(T·w) by grid construction, GQA-native. Banded-vs-
+            # dense is the measured policy's call (kernel_defaults.
+            # banded_policy; env hatch DL4J_TPU_ATTN=banded|dense).
+            from deeplearning4j_tpu.ops.kernel_defaults import (
+                banded_policy,
+            )
+
+            pol = banded_policy(T, H, Hkv, train=train)
+            if pol.kind == "banded":
+                from deeplearning4j_tpu.ops.banded_attention import (
+                    banded_attention,
+                )
+
+                o = banded_attention(
+                    q, k, v, self.window, self.causal, None, pol.block_q,
+                    pol.block_k, jax.default_backend() != "tpu")
+            else:
+                k, v = broadcast_kv(k, v)
+                o = self._masked_attention(q, k, v, None, self.causal,
+                                           window=self.window)
+        elif mask is not None or drop:
+            # Padding mask and attention-weight dropout need the dense
+            # path (dropout perturbs the post-softmax weights, which
+            # never materialize inside the fused kernels).
+            k, v = broadcast_kv(k, v)
             o = self._masked_attention(q, k, v, mask, self.causal,
                                        dropout=drop, rng=rng,
                                        window=self.window)
         else:
+            k, v = broadcast_kv(k, v)
             # Flash-vs-dense, tile config, and backward selection all come
             # from the measured-winner policy (ops/kernel_defaults.py) —
             # the kernel must have a recorded hardware row beating XLA

@@ -14,5 +14,27 @@ dispatch, `ConvolutionLayer.java:67-77`).
 
 from deeplearning4j_tpu.ops.lstm import fused_lstm, fused_lstm_available
 from deeplearning4j_tpu.ops.attention import flash_attention
+from deeplearning4j_tpu.ops.banded_attention import (
+    banded_attention,
+    banded_decode_attention,
+    banded_eligible,
+    decode_eligible,
+)
+from deeplearning4j_tpu.ops.fused_update import (
+    adam_update,
+    fused_update_available,
+    nesterov_update,
+)
 
-__all__ = ["fused_lstm", "fused_lstm_available", "flash_attention"]
+__all__ = [
+    "fused_lstm",
+    "fused_lstm_available",
+    "flash_attention",
+    "banded_attention",
+    "banded_decode_attention",
+    "banded_eligible",
+    "decode_eligible",
+    "adam_update",
+    "fused_update_available",
+    "nesterov_update",
+]

@@ -1,0 +1,449 @@
+"""Banded flash attention (Pallas TPU): sliding-window + GQA + ring decode.
+
+The flash kernel in `ops/attention.py` is a full-context kernel: its grid
+sweeps every K block for every Q block, so a sliding-window layer gains
+nothing from it and `nn/layers/attention.py` historically forced window/
+GQA/ring shapes onto the O(T²) dense band-masked path — the exact shapes
+the decode serving stack runs on. This module closes that gap with one
+kernel family:
+
+* `banded_attention` — full-sequence forward whose GRID is banded: for
+  each Q block only the `nkb` K blocks that can intersect the band are
+  visited (`nkb` is a constant in T, derived from window/block sizes), so
+  compile-time FLOPs scale with T·w, not T². GQA is native: the K/V tiles
+  stay Hkv-wide while the query tile carries the whole `G = H/Hkv` group
+  (`[1, G, Bq, Dh]` folded to `(G·Bq, Dh)` rows against one `[Bk, Dh]`
+  KV tile), so KV HBM traffic really is Hkv/H of MHA — the cache is never
+  broadcast to H heads the way the layer's dense GQA path must.
+* `banded_decode_attention` — the single-query serving variant. It reads
+  the `KVSlotPool` carry layout `[S, L, Hkv, Dh]` directly and evaluates
+  the rolling-ring held-index arithmetic (`held = end - ((end - j) % L)`,
+  see `nn/layers/attention.py` scalar-ring branch) inside the kernel from
+  scalar-prefetched per-slot positions, so one compiled program serves
+  every session position — the zero-recompile decode contract holds.
+
+Both kernels run under `interpret=True` on CPU (the parity suite in
+tests/test_banded_attention.py pins them against the layer's dense
+band-masked oracle). Backward: banded training shapes recompute through
+the dense band-masked reference (`banded_reference`) — the O(T²) scores
+exist transiently on the backward only; a blockwise Pallas backward is
+future work that `tools/roofline_report.py` exists to prioritize.
+
+Dispatch is NOT decided here: `kernel_defaults.banded_policy` owns the
+banded-vs-dense verdict under the measured-winner discipline (env hatch
+`DL4J_TPU_ATTN=banded` forces it; new MEASURED rows come from
+`tools/kernel_bench.py --banded` on hardware).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from deeplearning4j_tpu.ops.attention import _CompilerParams
+
+_NEG_INF = -1e30
+
+
+# --------------------------------------------------------------- reference
+def banded_reference(q, k, v, window: int, causal: bool, scale: float):
+    """Dense band-masked oracle over native GQA layouts: q [B, T, H, Dh],
+    k/v [B, T, Hkv, Dh]. Numerically the layer's `_masked_attention` band
+    path (score-level -1e30 bias, f32 softmax); also the recompute
+    backward for `banded_attention`."""
+    b, t, h, dh = q.shape
+    hkv = k.shape[2]
+    g = h // hkv
+    qg = q.reshape(b, t, hkv, g, dh)
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, k) * scale
+    qi = jnp.arange(t)[:, None]
+    ki = jnp.arange(t)[None, :]
+    if causal:
+        vis = (ki <= qi) & (ki > qi - window)
+    else:
+        vis = jnp.abs(qi - ki) < window
+    s = jnp.where(vis[None, None, None], s, _NEG_INF)
+    o = jnp.einsum("bhgqk,bkhd->bqhgd", jax.nn.softmax(s, axis=-1), v)
+    return o.reshape(b, t, h, dh)
+
+
+def _fit_block(block: int, t: int, *, interpret: bool) -> int:
+    """Largest block <= requested that divides t. On TPU blocks walk down
+    in 128-lane steps (Mosaic tiling); in interpret mode any divisor is
+    legal, which is what lets the parity suite cover odd T/w shapes."""
+    block = max(1, min(block, t))
+    if interpret:
+        while t % block:
+            block -= 1
+        return block
+    while block > 128 and t % block:
+        block -= 128
+    if t % block:
+        raise ValueError(f"seq len {t} not divisible by any block <= "
+                         f"{block} (need a multiple of 128)")
+    return block
+
+
+def _band_geometry(t: int, window: int, causal: bool, block_q: int,
+                   block_k: int):
+    """Static band geometry: `nkb`, the number of K blocks any single Q
+    block can intersect, is a function of window/block sizes ONLY — this
+    is the T·w contract, enforced by making the grid's K extent `nkb`
+    instead of `T // block_k`."""
+    nk = t // block_k
+    span = block_q + window - 1 + (0 if causal else window - 1)
+    nkb = min(nk, (span + block_k - 1) // block_k + 1)
+    return nk, nkb
+
+
+def _kb_first(i, *, nk: int, nkb: int, block_q: int, block_k: int,
+              window: int, causal: bool):
+    """First K block visited for Q block `i` (shared by the BlockSpec
+    index_map and the in-kernel mask arithmetic, so they can never
+    disagree). The last needed block is `ub` = the block holding the
+    band's rightmost visible key for the block's last row; the window of
+    `nkb` blocks ending there always covers the leftmost too (nkb bounds
+    the intersection count by construction)."""
+    hi = (i + 1) * block_q - 1 + (0 if causal else window - 1)
+    ub = jnp.minimum(hi // block_k, nk - 1)
+    return jnp.clip(ub - (nkb - 1), 0, nk - nkb)
+
+
+def _banded_kernel(q_ref, k_ref, v_ref, o_ref, acc_scr, m_scr, l_scr, *,
+                   nk: int, window: int, causal: bool, scale: float):
+    """Grid = (batch·Hkv, Q blocks, band K blocks). Per Q block only the
+    `nkb` K blocks the band can touch are visited; the online-softmax
+    state rides VMEM scratch across that innermost sweep exactly as in
+    `ops/attention._flash_kernel`. The query tile is the whole GQA group
+    ([G, Bq, Dh] folded to G·Bq rows) against one Hkv-wide KV tile."""
+    i = pl.program_id(1)
+    j = pl.program_id(2)
+    nkb = pl.num_programs(2)
+    q = q_ref[0]                                   # [G, Bq, Dh]
+    g, bq, d = q.shape
+    block_k = k_ref.shape[1]
+    kb = _kb_first(i, nk=nk, nkb=nkb, block_q=bq, block_k=block_k,
+                   window=window, causal=causal) + j
+
+    @pl.when(j == 0)
+    def _():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    # A clamped band (first/last rows of the sequence) can hand this step
+    # a K block fully outside the visible interval — skip its FLOPs.
+    lo = i * bq - window + 1
+    hi = (i + 1) * bq - 1 + (0 if causal else window - 1)
+    relevant = (kb * block_k <= hi) & (kb * block_k + block_k - 1 >= lo)
+
+    @pl.when(relevant)
+    def _():
+        k = k_ref[0]                               # [Bk, Dh]
+        v = v_ref[0]
+        prec = (jax.lax.Precision.HIGHEST if q.dtype == jnp.float32
+                else jax.lax.Precision.DEFAULT)
+        qf = q.reshape(g * bq, d)
+        s = jnp.dot(qf, k.T, preferred_element_type=jnp.float32,
+                    precision=prec) * scale        # [G·Bq, Bk]
+        rows = jax.lax.broadcasted_iota(jnp.int32, (g * bq, block_k), 0)
+        q_ids = i * bq + rows % bq                 # row r of group g -> q
+        k_ids = (kb * block_k
+                 + jax.lax.broadcasted_iota(jnp.int32, (g * bq, block_k), 1))
+        if causal:
+            vis = (k_ids <= q_ids) & (k_ids > q_ids - window)
+        else:
+            vis = (k_ids < q_ids + window) & (k_ids > q_ids - window)
+        s = jnp.where(vis, s, _NEG_INF)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        # Explicit zeroing, not just the -1e30 bias: a row whose visible
+        # band hasn't started yet has m_new == -1e30, where exp(s - m)
+        # would be exp(0) = 1 for every masked entry — fake weight the
+        # full-context kernel never sees (its first block is never fully
+        # dead for a live row; a banded grid's can be).
+        p = jnp.where(vis, jnp.exp(s - m_new), 0.0)
+        m_scr[:] = m_new
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[:] = acc_scr[:] * alpha + jnp.dot(
+            p.astype(v.dtype), v, preferred_element_type=jnp.float32,
+            precision=prec)
+
+    @pl.when(j == nkb - 1)
+    def _():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).reshape(g, bq, d).astype(o_ref.dtype)
+
+
+def _run_banded(q, k, v, *, window: int, causal: bool, scale: float,
+                block_q: int, block_k: int, interpret: bool):
+    b, t, h, dh = q.shape
+    hkv = k.shape[2]
+    g = h // hkv
+    block_q = _fit_block(block_q, t, interpret=interpret)
+    block_k = _fit_block(block_k, t, interpret=interpret)
+    nk, nkb = _band_geometry(t, window, causal, block_q, block_k)
+    # [B, T, H, Dh] -> [B·Hkv, G, T, Dh]; heads group as h = hkv·G + g,
+    # matching the layer's `q.reshape(B, T, Hkv, G, Dh)` GQA grouping.
+    q5 = q.transpose(0, 2, 1, 3).reshape(b, hkv, g, t, dh) \
+        .reshape(b * hkv, g, t, dh)
+    k3 = k.transpose(0, 2, 1, 3).reshape(b * hkv, t, dh)
+    v3 = v.transpose(0, 2, 1, 3).reshape(b * hkv, t, dh)
+    kmap = functools.partial(_kb_first, nk=nk, nkb=nkb, block_q=block_q,
+                             block_k=block_k, window=window, causal=causal)
+    o = pl.pallas_call(
+        functools.partial(_banded_kernel, nk=nk, window=window,
+                          causal=causal, scale=scale),
+        grid=(b * hkv, t // block_q, nkb),
+        in_specs=[
+            pl.BlockSpec((1, g, block_q, dh), lambda bb, i, j: (bb, 0, i, 0)),
+            pl.BlockSpec((1, block_k, dh),
+                         lambda bb, i, j: (bb, kmap(i) + j, 0)),
+            pl.BlockSpec((1, block_k, dh),
+                         lambda bb, i, j: (bb, kmap(i) + j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, g, block_q, dh),
+                               lambda bb, i, j: (bb, 0, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * hkv, g, t, dh), q.dtype),
+        scratch_shapes=[
+            pltpu.VMEM((g * block_q, dh), jnp.float32),
+            pltpu.VMEM((g * block_q, 1), jnp.float32),
+            pltpu.VMEM((g * block_q, 1), jnp.float32),
+        ],
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(q5, k3, v3)
+    return o.reshape(b, hkv, g, t, dh).transpose(0, 3, 1, 2, 4) \
+        .reshape(b, t, h, dh)
+
+
+def banded_eligible(t: int, h: int, hkv: int, *, min_t: int = 256,
+                    any_backend: bool = False) -> bool:
+    """SHAPE eligibility for the full-sequence banded kernel: TPU backend,
+    128-lane-tileable T, and a clean GQA grouping. `min_t` is the perf
+    floor (below it the band is most of the matrix and dense wins on
+    launch overhead); the measured verdict lives in
+    `kernel_defaults.banded_policy`. `any_backend=True` waives the TPU
+    requirement (env-forced routing runs interpret-mode off-TPU — a
+    production force must not silently un-force itself)."""
+    return ((any_backend or jax.default_backend() == "tpu")
+            and t % 128 == 0
+            and t >= min_t and hkv >= 1 and h % hkv == 0)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def banded_attention(q, k, v, window: int, causal: bool = True,
+                     scale: Optional[float] = None, block_q: int = 256,
+                     block_k: int = 256, interpret: bool = False):
+    """Banded (sliding-window) self-attention, GQA-native.
+
+    q: [B, T, H, Dh]; k/v: [B, T, Hkv, Dh] with Hkv dividing H (Hkv == H
+    is plain MHA). Causal visibility is `q - window < k <= q`;
+    bidirectional is `|q - k| < window` — exactly the layer's dense band
+    semantics. Forward is O(T·w) compute/HBM by grid construction;
+    backward recomputes through the dense band-masked reference (scores
+    exist transiently on the backward only)."""
+    s = scale if scale is not None else q.shape[-1] ** -0.5
+    return _run_banded(q, k, v, window=window, causal=causal, scale=s,
+                       block_q=block_q, block_k=block_k,
+                       interpret=interpret)
+
+
+def _banded_fwd(q, k, v, window, causal, scale, block_q, block_k,
+                interpret):
+    out = banded_attention(q, k, v, window, causal, scale, block_q,
+                           block_k, interpret)
+    return out, (q, k, v)
+
+
+def _banded_bwd(window, causal, scale, block_q, block_k, interpret, res,
+                do):
+    q, k, v = res
+    s = scale if scale is not None else q.shape[-1] ** -0.5
+    _, vjp = jax.vjp(
+        lambda qq, kk, vv: banded_reference(qq, kk, vv, window, causal, s),
+        q, k, v)
+    return vjp(do)
+
+
+banded_attention.defvjp(_banded_fwd, _banded_bwd)
+
+
+# ------------------------------------------------------- decode (serving)
+def decode_reference(q, cache_k, cache_v, qpos, end, window: Optional[int],
+                     rolling: bool, scale: float):
+    """Dense oracle for the single-query decode kernel, mirroring the
+    layer's per-slot `_decode` visibility arithmetic over the pool layout
+    (q [S, H, Dh], caches [S, L, Hkv, Dh], qpos/end [S] int32). Rows with
+    an empty visible set are garbage-by-contract on BOTH paths (softmax
+    of a constant here, zeros in the kernel) — inactive lanes, never
+    read back."""
+    s_, h, dh = q.shape
+    l = cache_k.shape[1]
+    hkv = cache_k.shape[2]
+    g = h // hkv
+    j = jnp.arange(l)[None, :]                               # [1, L]
+    qp = qpos[:, None]
+    if rolling:
+        held = end[:, None] - ((end[:, None] - j) % l)       # [S, L]
+        vis = (held >= 0) & (held <= qp) & (held > qp - window)
+    else:
+        vis = j <= qp
+        if window is not None:
+            vis = vis & (j > qp - window)
+    qg = q.reshape(s_, hkv, g, dh)
+    sc = jnp.einsum("shgd,slhd->shgl", qg, cache_k) * scale
+    sc = jnp.where(vis[:, None, None], sc, _NEG_INF)
+    o = jnp.einsum("shgl,slhd->shgd", jax.nn.softmax(sc, axis=-1), cache_v)
+    return o.reshape(s_, h, dh)
+
+
+def _decode_kernel(qpos_ref, end_ref, q_ref, k_ref, v_ref, o_ref, acc_scr,
+                   m_scr, l_scr, *, cache_len: int, window: Optional[int],
+                   rolling: bool, hkv: int, scale: float):
+    """Grid = (slots, L blocks): one slot's [L, Hkv, Dh] cache rows sweep
+    through VMEM while the single-token query group stays resident. The
+    per-slot positions arrive scalar-prefetched (SMEM) so visibility is
+    computed from traced scalars — one compiled program for every session
+    position, which is what keeps the decode zero-recompile contract."""
+    si = pl.program_id(0)
+    lb = pl.program_id(1)
+    nlb = pl.num_programs(1)
+    q = q_ref[0]                                   # [H, Dh]
+    h, d = q.shape
+    block_l = k_ref.shape[1]
+    g = h // hkv
+    pos = qpos_ref[si]
+    end = end_ref[si]
+
+    @pl.when(lb == 0)
+    def _():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    if rolling:
+        # Every ring slot can hold a live position (the ring IS the band
+        # wrapped onto L slots) — no block is statically or dynamically
+        # dead, so there is nothing to skip.
+        relevant = lb >= 0
+    else:
+        # Linear cache: only blocks intersecting [pos-w+1, pos] live.
+        relevant = lb * block_l <= pos
+        if window is not None:
+            relevant &= lb * block_l + block_l - 1 > pos - window
+
+    @pl.when(relevant)
+    def _():
+        kc = k_ref[0]                              # [Bl, Hkv, Dh]
+        vc = v_ref[0]
+        prec = (jax.lax.Precision.HIGHEST if q.dtype == jnp.float32
+                else jax.lax.Precision.DEFAULT)
+        # GQA: each Hkv tile scores its G-row query group; Hkv is a
+        # static python loop (tiny: 1-16), so the kernel stays one fused
+        # program with no H-wide KV broadcast.
+        s = jnp.concatenate([
+            jnp.dot(q[hk * g:(hk + 1) * g], kc[:, hk, :].T,
+                    preferred_element_type=jnp.float32,
+                    precision=prec)
+            for hk in range(hkv)], axis=0) * scale  # [H, Bl]
+        j = (lb * block_l
+             + jax.lax.broadcasted_iota(jnp.int32, (h, block_l), 1))
+        if rolling:
+            held = end - ((end - j) % cache_len)
+            vis = (held >= 0) & (held <= pos) & (held > pos - window)
+        else:
+            vis = j <= pos
+            if window is not None:
+                vis = vis & (j > pos - window)
+        s = jnp.where(vis, s, _NEG_INF)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.where(vis, jnp.exp(s - m_new), 0.0)   # dead-block guard
+        m_scr[:] = m_new
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jnp.concatenate([
+            jnp.dot(p[hk * g:(hk + 1) * g].astype(vc.dtype), vc[:, hk, :],
+                    preferred_element_type=jnp.float32, precision=prec)
+            for hk in range(hkv)], axis=0)            # [H, Dh]
+        acc_scr[:] = acc_scr[:] * alpha + pv
+
+    @pl.when(lb == nlb - 1)
+    def _():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+def decode_eligible(cache_len: int, h: int, hkv: int) -> bool:
+    """Shape eligibility for the decode kernel on hardware: TPU backend,
+    lane-tileable ring length, clean GQA grouping."""
+    return (jax.default_backend() == "tpu" and cache_len % 128 == 0
+            and hkv >= 1 and h % hkv == 0)
+
+
+def banded_decode_attention(q, cache_k, cache_v, qpos, end,
+                            window: Optional[int] = None,
+                            rolling: bool = False,
+                            scale: Optional[float] = None,
+                            block_l: int = 512,
+                            interpret: bool = False):
+    """Single-query attention over the KVSlotPool layout.
+
+    q: [S, H, Dh] (this step's query token per slot, post-RoPE);
+    cache_k/cache_v: [S, L, Hkv, Dh] (post-write: this step's K/V already
+    scattered in); qpos: [S] int32 global position of each slot's query;
+    end: [S] int32 newest written global position per slot (rolling ring
+    only; ignored otherwise — pass qpos). Returns [S, H, Dh].
+
+    Visibility matches the layer's per-slot `_decode`: rolling recovers
+    each ring slot's current occupant arithmetically
+    (`held = end - ((end - j) % L)`, visible iff `0 <= held <= qpos` and
+    `held > qpos - window`); linear caches see `j <= qpos` minus anything
+    beyond the window. Inference-only (no vjp): the decode path never
+    differentiates."""
+    s_, h, dh = q.shape
+    cache_len = cache_k.shape[1]
+    hkv = cache_k.shape[2]
+    if h % hkv:
+        raise ValueError(f"H {h} not divisible by Hkv {hkv}")
+    if rolling and window is None:
+        raise ValueError("rolling decode requires a window")
+    sc = scale if scale is not None else dh ** -0.5
+    block_l = _fit_block(block_l, cache_len, interpret=interpret)
+    qpos = qpos.astype(jnp.int32)
+    end = end.astype(jnp.int32)
+    return pl.pallas_call(
+        functools.partial(_decode_kernel, cache_len=cache_len,
+                          window=window, rolling=rolling, hkv=hkv,
+                          scale=sc),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(s_, cache_len // block_l),
+            in_specs=[
+                pl.BlockSpec((1, h, dh), lambda si, lb, *refs: (si, 0, 0)),
+                pl.BlockSpec((1, block_l, hkv, dh),
+                             lambda si, lb, *refs: (si, lb, 0, 0)),
+                pl.BlockSpec((1, block_l, hkv, dh),
+                             lambda si, lb, *refs: (si, lb, 0, 0)),
+            ],
+            out_specs=pl.BlockSpec((1, h, dh),
+                                   lambda si, lb, *refs: (si, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((h, dh), jnp.float32),
+                pltpu.VMEM((h, 1), jnp.float32),
+                pltpu.VMEM((h, 1), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((s_, h, dh), q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(qpos, end, q, cache_k, cache_v)

@@ -19,10 +19,12 @@ CudnnConvolutionHelper.java:54`), applied to Pallas-vs-XLA.
 Policy, in order:
   1. Env escape hatches always win (ops run in production; a lowering
      bug or perf regression must be routable around without a release):
-       DL4J_TPU_ATTN           = auto|flash|dense
+       DL4J_TPU_ATTN           = auto|flash|banded|dense
        DL4J_TPU_ATTN_BACKWARD  = auto|pallas|dense
        DL4J_TPU_ATTN_BLOCK     = "512" or "512x256"   (block_q x block_k)
        DL4J_TPU_DENSE_MAX_T    = int (memory-necessity threshold)
+       DL4J_TPU_DECODE_ATTN    = auto|banded|dense   (serving decode step)
+       DL4J_TPU_FUSED_UPDATE   = auto|fused|xla      (optimizer update)
   2. Shape eligibility: flash needs the TPU backend and 128-lane-tileable
      sequence lengths; otherwise dense.
   3. Memory necessity: when Tq*Tk >= DENSE_MAX_T^2 (default 8192^2) the
@@ -91,6 +93,26 @@ class AttentionPolicy(NamedTuple):
     block_k: int
     backward: str        # "pallas" | "dense"
     reason: str          # why this choice (for logs/tests)
+
+
+class BandedPolicy(NamedTuple):
+    kind: str            # "banded" | "dense"
+    block_q: int
+    block_k: int
+    reason: str
+
+
+def record_dispatch(op: str, impl: str) -> None:
+    """Count a dispatch-policy verdict on the shared metrics spine:
+    `kernel_dispatch_total{op, impl}`. Policies are consulted at TRACE
+    time, so each increment means "one compiled program serves `op` via
+    `impl`" — a decode stack showing anything but one banded row per
+    bucket, or a counter that keeps growing across steps (shape churn
+    re-tracing), is diagnostic, not cosmetic. Visible in `/metrics` and
+    in bench snapshots."""
+    from deeplearning4j_tpu.observe import get_registry
+
+    get_registry().counter("kernel_dispatch_total", op=op, impl=impl).inc()
 
 
 def _env(name: str, default: str = "auto") -> str:
@@ -186,10 +208,12 @@ def attention_policy(tq: int, tk: Optional[int] = None,
     def flash(bq, bk, reason):
         if blocks is not None:
             bq, bk = blocks
+        record_dispatch("attention", "flash")
         return AttentionPolicy("flash", bq, bk,
                                attention_backward(tq, tk), reason)
 
     def dense(reason):
+        record_dispatch("attention", "dense")
         return AttentionPolicy("dense", 0, 0, "dense", reason)
 
     if forced == "dense":
@@ -239,6 +263,160 @@ def _best_measured_flash(mode: str, t: int) -> Optional[dict]:
     return row if (row.get("block_q") and row["winner"] == "flash") else None
 
 
+def _best_measured_banded(mode: str, t: int) -> Optional[dict]:
+    """Winning banded row's tile config (same rule as
+    `_best_measured_flash`: a losing row's blocks are the measured-worst
+    configuration and must not be inherited)."""
+    table = MEASURED.get("banded", {}).get(mode, {})
+    mt = _nearest_measured(table, t)
+    if mt is None:
+        return None
+    row = table[mt]
+    return row if (row.get("block_q") and row["winner"] == "banded") \
+        else None
+
+
+def banded_policy(t: int, h: int, hkv: int,
+                  train: bool = False) -> BandedPolicy:
+    """Banded-vs-dense for one windowed/GQA attention call (the shapes
+    `attention_policy` never serves: its flash kernel is full-context).
+
+    Same lattice as `attention_policy`: env force, then shape capability,
+    then memory necessity, then the measured verdict, with dense the
+    no-data default. Memory necessity applies to the FORWARD-only mode —
+    the banded backward recomputes through the dense band-masked
+    reference, so routing banded cannot relieve a training-shape [T, T]
+    hazard and must not claim to."""
+    forced = _env("DL4J_TPU_ATTN")
+    blocks = _blocks_from_env()
+    from deeplearning4j_tpu.ops.banded_attention import banded_eligible
+
+    can = banded_eligible(t, h, hkv, min_t=128)
+
+    def banded(bq, bk, reason):
+        if blocks is not None:
+            bq, bk = blocks
+        record_dispatch("banded_attention", "banded")
+        return BandedPolicy("banded", bq, bk, reason)
+
+    def dense(reason):
+        record_dispatch("banded_attention", "dense")
+        return BandedPolicy("dense", 0, 0, reason)
+
+    if forced == "dense":
+        return dense("forced by DL4J_TPU_ATTN=dense")
+    if forced == "flash":
+        return dense("DL4J_TPU_ATTN=flash: the full-context flash kernel "
+                     "cannot band; windowed shapes stay dense")
+    if forced == "banded":
+        # Backend is waived: the force must hold off-TPU too (the layer
+        # runs the kernel in interpret mode there), or a CPU smoke of a
+        # production config would silently exercise a different path.
+        if not banded_eligible(t, h, hkv, min_t=128, any_backend=True):
+            return dense("DL4J_TPU_ATTN=banded but shape ineligible "
+                         f"(tiling, t={t} h={h} hkv={hkv})")
+        return banded(256, 256, "forced by DL4J_TPU_ATTN=banded")
+    if not can:
+        return dense(f"shape ineligible (t={t}, h={h}, hkv={hkv})")
+    if not train and _mem_hazard(t, t):
+        row = _best_measured_banded("fwd", t)
+        bq, bk = (row["block_q"], row["block_k"]) if row else (256, 256)
+        return banded(bq, bk,
+                      f"memory necessity: T^2 >= {dense_max_t()}^2")
+    mode = "train" if train else "fwd"
+    table = MEASURED.get("banded", {}).get(mode, {})
+    mt = _nearest_measured(table, t)
+    if mt is not None and table[mt]["winner"] == "banded":
+        row = table[mt]
+        return banded(row["block_q"], row["block_k"],
+                      f"measured win at T={mt} "
+                      f"({row['banded_ms']} vs {row['dense_ms']} ms)")
+    if mt is not None:
+        row = table[mt]
+        return dense(f"measured loss at T={mt} "
+                     f"({row.get('banded_ms')} vs {row['dense_ms']} ms)")
+    return dense("no measured rows; conservative default")
+
+
+class DecodePolicy(NamedTuple):
+    kind: str            # "banded" | "dense"
+    block_l: int
+    reason: str
+
+
+def decode_attention_policy(cache_len: int, h: int, hkv: int,
+                            record: bool = True) -> DecodePolicy:
+    """Single-query decode-step attention: the Pallas kernel that reads
+    the KVSlotPool layout directly vs the layer's dense einsum. Env hatch
+    DL4J_TPU_DECODE_ATTN=auto|banded|dense; measured rows live under
+    MEASURED["decode"] keyed by cache length. `record=False` is for
+    observers (serving snapshots) that ask what WOULD dispatch —
+    kernel_dispatch_total must count only real dispatch sites."""
+    forced = _env("DL4J_TPU_DECODE_ATTN")
+    from deeplearning4j_tpu.ops.banded_attention import decode_eligible
+
+    can = decode_eligible(cache_len, h, hkv)
+
+    def banded(bl, reason):
+        if record:
+            record_dispatch("decode_attention", "banded")
+        return DecodePolicy("banded", bl, reason)
+
+    def dense(reason):
+        if record:
+            record_dispatch("decode_attention", "dense")
+        return DecodePolicy("dense", 0, reason)
+
+    if forced == "dense":
+        return dense("forced by DL4J_TPU_DECODE_ATTN=dense")
+    if forced == "banded":
+        # An explicit force runs even off-TPU (interpret mode): that is
+        # the CPU parity/integration seam, and production force-routing
+        # must not silently un-force itself.
+        return banded(512, "forced by DL4J_TPU_DECODE_ATTN=banded")
+    if not can:
+        return dense(f"shape ineligible (L={cache_len}, h={h}, "
+                     f"hkv={hkv})")
+    table = MEASURED.get("decode", {})
+    mt = _nearest_measured(table, cache_len)
+    if mt is not None and table[mt]["winner"] == "banded":
+        row = table[mt]
+        return banded(row.get("block_l", 512),
+                      f"measured win at L={mt} "
+                      f"({row['banded_ms']} vs {row['dense_ms']} ms)")
+    if mt is not None:
+        row = table[mt]
+        return dense(f"measured loss at L={mt} "
+                     f"({row.get('banded_ms')} vs {row['dense_ms']} ms)")
+    return dense("no measured rows; conservative default")
+
+
+def fused_update_policy(kind: str) -> str:
+    """"fused" (one-pass Pallas read-modify-write) or "xla" for the
+    optimizer update of `kind` ("adam" | "nesterov"). Env hatch
+    DL4J_TPU_FUSED_UPDATE forces either way (force runs off-TPU via
+    interpret mode — the CPU integration seam); otherwise a winning
+    MEASURED["fused_update"][kind] row is required, with the XLA path
+    the conservative no-data default."""
+    forced = _env("DL4J_TPU_FUSED_UPDATE")
+    op = f"{kind}_update"
+    if forced == "xla":
+        record_dispatch(op, "xla")
+        return "xla"
+    if forced == "fused":
+        record_dispatch(op, "fused")
+        return "fused"
+    from deeplearning4j_tpu.ops.fused_update import fused_update_available
+
+    row = MEASURED.get("fused_update", {}).get(kind)
+    if (fused_update_available() and row is not None
+            and row["winner"] == "fused"):
+        record_dispatch(op, "fused")
+        return "fused"
+    record_dispatch(op, "xla")
+    return "xla"
+
+
 def lstm_policy(train: bool = True) -> str:
     """"fused" (Pallas) or "scan" (lax.scan baseline) for the LSTM core.
 
@@ -250,10 +428,13 @@ def lstm_policy(train: bool = True) -> str:
     """
     forced = _env("DL4J_TPU_LSTM")
     if forced in ("fused", "scan"):
+        record_dispatch("lstm", forced)
         return forced
     table = MEASURED.get("lstm", {})
     mode = "train" if train else "fwd"
     row = table.get(mode) or table.get("fwd" if train else "train")
+    verdict = "fused"   # no data at all: structural argument above
     if row is not None:
-        return "fused" if row["winner"] == "fused" else "scan"
-    return "fused"   # no data at all: structural argument above
+        verdict = "fused" if row["winner"] == "fused" else "scan"
+    record_dispatch("lstm", verdict)
+    return verdict

@@ -508,7 +508,44 @@ class DecodeSessionManager:
                            "rows": int(self._c_rows.value),
                            "shared": int(self._c_shared.value)},
             "buckets": list(self.buckets),
+            "kernel_policy": self._kernel_policy(),
         }
+
+    def _kernel_policy(self) -> list:
+        """Which decode-attention kernel each cached-attention layer
+        shape would dispatch to (kernel_defaults.decode_attention_policy
+        — same call the layer makes per step), so snapshots show WHERE
+        single-token steps run without reverse-engineering env + measured
+        tables. Best-effort: policy evaluation must never take down
+        /metrics."""
+        try:
+            from deeplearning4j_tpu.ops.kernel_defaults import (
+                decode_attention_policy,
+            )
+
+            with self._lock:
+                net = self._net
+            seen, out = set(), []
+            for layer in getattr(net, "layers", ()):
+                heads = getattr(layer, "num_heads", None)
+                if heads is None or not hasattr(layer, "decode_carry"):
+                    continue
+                # TransformerEncoderBlock carries num_kv_heads directly;
+                # MultiHeadAttention resolves it via the _kv_heads prop
+                hkv = getattr(layer, "_kv_heads", None) or getattr(
+                    layer, "num_kv_heads", None) or heads
+                key = (layer.max_cache, heads, hkv)
+                if key in seen:
+                    continue
+                seen.add(key)
+                pol = decode_attention_policy(*key, record=False)
+                out.append({"layer": layer.name, "cache_len": key[0],
+                            "heads": key[1], "kv_heads": key[2],
+                            "kind": pol.kind, "reason": pol.reason})
+            return out
+        # graft: allow(GL403): snapshot decoration is best-effort
+        except Exception:
+            return []
 
     def drain(self, timeout: Optional[float] = None) -> bool:
         """Wait for every live session to finish (no new admissions are

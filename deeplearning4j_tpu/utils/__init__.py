@@ -6,13 +6,13 @@ from deeplearning4j_tpu.utils.timesource import (
     NTPTimeSource, SystemClockTimeSource, TimeSource, TimeSourceProvider,
 )
 from deeplearning4j_tpu.utils.profiling import (
-    ProfilerListener, peak_flops, step_flops, trace,
+    ProfilerListener, peak_flops, peak_hbm_bytes, step_flops, trace,
 )
 
 __all__ = [
     "register_serde", "to_json", "from_json", "config_to_dict", "config_from_dict",
     "flatten_params", "unflatten_params", "param_count", "tree_norm",
     "TimeSource", "SystemClockTimeSource", "NTPTimeSource",
-    "TimeSourceProvider", "ProfilerListener", "peak_flops", "step_flops",
-    "trace",
+    "TimeSourceProvider", "ProfilerListener", "peak_flops",
+    "peak_hbm_bytes", "step_flops", "trace",
 ]

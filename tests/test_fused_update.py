@@ -1,0 +1,150 @@
+"""Parity suite for the one-pass fused optimizer update
+(ops/fused_update.py) and its seam into optim/updaters.py.
+
+The fused kernels must reproduce the unfused updater math bit-for-bit
+in float64-free f32 terms across the leaf shapes real nets produce:
+scalars, odd sizes that don't tile, and low-precision dtypes. The
+updater seam is then checked end-to-end: forcing the env hatch routes
+the REAL Adam/Nesterovs updaters through the kernel (interpret mode on
+CPU) and the trajectory matches the default XLA path.
+"""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.fused_update import (
+    adam_update,
+    nesterov_update,
+)
+
+TOL = dict(rtol=2e-6, atol=2e-6)
+
+
+def _leaves(seed=0):
+    # scalar, odd (doesn't divide block_rows), tile-ish, matrix
+    shapes = [(), (7,), (513,), (16, 24)]
+    ks = jax.random.split(jax.random.PRNGKey(seed), len(shapes))
+    return [jax.random.normal(k, s, jnp.float32)
+            for k, s in zip(ks, shapes)]
+
+
+def _adam_ref(p, g, m, v, lrbc, b1, b2, eps):
+    m2 = b1 * m + (1.0 - b1) * g
+    v2 = b2 * v + (1.0 - b2) * g * g
+    return p - lrbc * m2 / (jnp.sqrt(v2) + eps), m2, v2
+
+
+def _nesterov_ref(p, g, vel, lr, mu):
+    v2 = mu * vel - lr * g
+    return p + mu * v2 - lr * g, v2
+
+
+class TestKernelParity:
+    @pytest.mark.parametrize("i", range(4))
+    def test_adam_leaf_shapes(self, i):
+        p = _leaves(1)[i]
+        g, m = _leaves(2)[i], _leaves(3)[i] * 0.1
+        v = jnp.abs(_leaves(4)[i]) * 0.01
+        lrbc = 3e-3
+        got = adam_update(p, g, m, v, lrbc, block_rows=8,
+                          interpret=True)
+        want = _adam_ref(p, g, m, v, lrbc, 0.9, 0.999, 1e-8)
+        for a, b in zip(got, want):
+            assert a.shape == p.shape and a.dtype == p.dtype
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       **TOL)
+
+    @pytest.mark.parametrize("i", range(4))
+    def test_nesterov_leaf_shapes(self, i):
+        p, g = _leaves(5)[i], _leaves(6)[i]
+        vel = _leaves(7)[i] * 0.1
+        got = nesterov_update(p, g, vel, 0.05, block_rows=8,
+                              interpret=True)
+        want = _nesterov_ref(p, g, vel, 0.05, 0.9)
+        for a, b in zip(got, want):
+            assert a.shape == p.shape and a.dtype == p.dtype
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       **TOL)
+
+    def test_bf16_params_stay_bf16(self):
+        # mixed-precision nets carry bf16 leaves; the kernel must not
+        # silently promote them (that would double optimizer-state HBM)
+        p = jnp.ones((64,), jnp.bfloat16) * 0.5
+        g = jnp.ones((64,), jnp.bfloat16) * 0.25
+        m = jnp.zeros((64,), jnp.bfloat16)
+        v = jnp.zeros((64,), jnp.bfloat16)
+        p2, m2, v2 = adam_update(p, g, m, v, 1e-2, interpret=True)
+        assert p2.dtype == jnp.bfloat16
+        assert m2.dtype == jnp.bfloat16 and v2.dtype == jnp.bfloat16
+        ref = _adam_ref(p.astype(jnp.float32), g.astype(jnp.float32),
+                        m.astype(jnp.float32), v.astype(jnp.float32),
+                        1e-2, 0.9, 0.999, 1e-8)
+        np.testing.assert_allclose(
+            np.asarray(p2, np.float32), np.asarray(ref[0], np.float32),
+            rtol=2e-2, atol=2e-2)
+
+    def test_non_default_hyperparams(self):
+        p, g = _leaves(8)[2], _leaves(9)[2]
+        m, v = _leaves(10)[2] * 0.1, jnp.abs(_leaves(11)[2]) * 0.01
+        got = adam_update(p, g, m, v, 1e-2, beta1=0.5, beta2=0.9,
+                          eps=1e-4, block_rows=64, interpret=True)
+        want = _adam_ref(p, g, m, v, 1e-2, 0.5, 0.9, 1e-4)
+        for a, b in zip(got, want):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       **TOL)
+
+
+class TestUpdaterSeam:
+    """End-to-end through optim/updaters.py: the env hatch flips the
+    real updaters onto the kernel and the parameter trajectory matches
+    the default path."""
+
+    def _params(self):
+        return {"w": jax.random.normal(jax.random.PRNGKey(0), (13, 5)),
+                "b": jnp.zeros((5,)),
+                "s": jnp.asarray(0.3)}
+
+    def _grads(self, step):
+        ks = jax.random.split(jax.random.PRNGKey(100 + step), 3)
+        return {"w": jax.random.normal(ks[0], (13, 5)) * 0.1,
+                "b": jax.random.normal(ks[1], (5,)) * 0.1,
+                "s": jax.random.normal(ks[2], ()) * 0.1}
+
+    def _run(self, updater, steps=4):
+        params = self._params()
+        state = updater.init(params)
+        for i in range(steps):
+            params, state = updater.update_with_params(
+                self._grads(i), state, params, i)
+        return params
+
+    @pytest.mark.parametrize("name", ["adam", "nesterov"])
+    def test_forced_fused_matches_xla(self, monkeypatch, name):
+        from deeplearning4j_tpu.optim.updaters import Adam, Nesterovs
+        mk = ((lambda: Adam(3e-3)) if name == "adam"
+              else (lambda: Nesterovs(0.05, momentum=0.9)))
+        monkeypatch.setenv("DL4J_TPU_FUSED_UPDATE", "xla")
+        base = self._run(mk())
+        monkeypatch.setenv("DL4J_TPU_FUSED_UPDATE", "fused")
+        fused = self._run(mk())
+        for key in base:
+            np.testing.assert_allclose(
+                np.asarray(fused[key]), np.asarray(base[key]),
+                rtol=1e-5, atol=1e-5, err_msg=f"{name} leaf {key}")
+
+    def test_default_cpu_policy_is_xla(self, monkeypatch):
+        monkeypatch.delenv("DL4J_TPU_FUSED_UPDATE", raising=False)
+        from deeplearning4j_tpu.ops.kernel_defaults import (
+            fused_update_policy,
+        )
+        assert fused_update_policy("adam") == "xla"
+
+    def test_forced_policy_is_fused(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_FUSED_UPDATE", "fused")
+        from deeplearning4j_tpu.ops.kernel_defaults import (
+            fused_update_policy,
+        )
+        assert fused_update_policy("adam") == "fused"
+        assert fused_update_policy("nesterov") == "fused"

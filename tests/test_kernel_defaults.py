@@ -162,6 +162,117 @@ def test_flash_attention_backward_resolution_matches_policy():
     assert _resolve_backward("pallas", 1024, 1024) == "pallas"
 
 
+def _banded_shapes(monkeypatch, value=True):
+    """Simulate TPU eligibility for the banded kernel gates (the
+    policies import these at call time, so patching the op module's
+    attributes reaches them)."""
+    # NB: ops/__init__ re-exports a function named banded_attention
+    # that shadows the module attribute — go through sys.modules
+    import importlib
+    ba = importlib.import_module(
+        "deeplearning4j_tpu.ops.banded_attention")
+    monkeypatch.setattr(
+        ba, "banded_eligible",
+        lambda t, h, hkv, min_t=256, any_backend=False: value)
+    monkeypatch.setattr(ba, "decode_eligible",
+                        lambda cache_len, h, hkv: value)
+
+
+def test_banded_policy_env_hatches(monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_ATTN", "dense")
+    assert kd.banded_policy(256, 4, 2).kind == "dense"
+    # flash cannot band: a flash force on a windowed shape stays dense
+    monkeypatch.setenv("DL4J_TPU_ATTN", "flash")
+    assert kd.banded_policy(256, 4, 2).kind == "dense"
+    monkeypatch.setenv("DL4J_TPU_ATTN", "banded")
+    pol = kd.banded_policy(256, 4, 2)
+    assert pol.kind == "banded"
+    assert (pol.block_q, pol.block_k) == (256, 256)
+    # block override flows through the force, like flash's
+    monkeypatch.setenv("DL4J_TPU_ATTN_BLOCK", "128x64")
+    pol = kd.banded_policy(256, 4, 2)
+    assert (pol.block_q, pol.block_k) == (128, 64)
+    monkeypatch.delenv("DL4J_TPU_ATTN_BLOCK")
+    # ...but tiling ineligibility still wins over the force (backend
+    # does NOT: a force runs interpret-mode off-TPU by design)
+    assert kd.banded_policy(100, 4, 2).kind == "dense"
+    assert kd.banded_policy(256, 4, 3).kind == "dense"   # h % hkv != 0
+
+
+def test_banded_policy_conservative_without_rows(monkeypatch):
+    """Dispatch discipline: even on eligible shapes, banded is not the
+    default until a winning MEASURED['banded'] row exists. When a real
+    banded bench lands, update this pin together with the table."""
+    if kd.MEASURED.get("banded"):
+        pytest.skip("banded rows measured; pin no longer applies")
+    _banded_shapes(monkeypatch)
+    for train in (False, True):
+        pol = kd.banded_policy(1024, 8, 2, train=train)
+        assert pol.kind == "dense", pol
+        assert "no measured rows" in pol.reason
+
+
+def test_banded_policy_agrees_with_measured_winners(monkeypatch):
+    _banded_shapes(monkeypatch)
+    for mode, by_t in kd.MEASURED.get("banded", {}).items():
+        train = mode == "train"
+        for t, row in by_t.items():
+            if not train and kd._mem_hazard(t, t):
+                continue   # memory necessity overrides the verdict
+            pol = kd.banded_policy(t, 8, 2, train=train)
+            assert pol.kind == row["winner"], (
+                f"banded {mode}@T={t}: policy picks {pol.kind} but "
+                f"measured winner is {row['winner']}")
+            if pol.kind == "banded":
+                assert (pol.block_q, pol.block_k) == (
+                    row["block_q"], row["block_k"])
+
+
+def test_decode_policy_env_and_default(monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_DECODE_ATTN", "dense")
+    assert kd.decode_attention_policy(512, 8, 2).kind == "dense"
+    monkeypatch.setenv("DL4J_TPU_DECODE_ATTN", "banded")
+    pol = kd.decode_attention_policy(512, 8, 2)
+    assert pol.kind == "banded" and pol.block_l == 512
+    monkeypatch.delenv("DL4J_TPU_DECODE_ATTN")
+    # eligible shape, no measured rows -> conservative dense
+    if kd.MEASURED.get("decode"):
+        pytest.skip("decode rows measured; pin no longer applies")
+    _banded_shapes(monkeypatch)
+    pol = kd.decode_attention_policy(512, 8, 2)
+    assert pol.kind == "dense"
+    assert "no measured rows" in pol.reason
+
+
+def test_decode_policy_record_flag_gates_counter(monkeypatch):
+    """Observers (serving snapshots) ask what WOULD dispatch with
+    record=False; kernel_dispatch_total must count only real dispatch
+    sites, or snapshot polling would inflate the metric."""
+    from deeplearning4j_tpu.observe import get_registry
+    monkeypatch.setenv("DL4J_TPU_DECODE_ATTN", "dense")
+    c = get_registry().counter("kernel_dispatch_total",
+                               op="decode_attention", impl="dense")
+    v0 = c.value
+    kd.decode_attention_policy(512, 8, 2, record=False)
+    assert c.value == v0
+    kd.decode_attention_policy(512, 8, 2)
+    assert c.value == v0 + 1
+
+
+def test_fused_update_policy(monkeypatch):
+    monkeypatch.setenv("DL4J_TPU_FUSED_UPDATE", "fused")
+    assert kd.fused_update_policy("adam") == "fused"
+    monkeypatch.setenv("DL4J_TPU_FUSED_UPDATE", "xla")
+    assert kd.fused_update_policy("adam") == "xla"
+    monkeypatch.delenv("DL4J_TPU_FUSED_UPDATE")
+    for kind in ("adam", "nesterov"):
+        row = kd.MEASURED.get("fused_update", {}).get(kind)
+        if row is None:
+            # no data: XLA is the conservative default (off-TPU the
+            # availability gate forces it regardless)
+            assert kd.fused_update_policy(kind) == "xla"
+
+
 def test_current_data_yields_dense_defaults(monkeypatch):
     """Regression pin for the r4 ADVICE finding: with the rows recorded
     today (flash loses everywhere measured), training and inference

@@ -4,6 +4,8 @@
 #
 #   tools/ci_check.sh            # full gate
 #   tools/ci_check.sh --lint     # lint gate only (seconds)
+#   tools/ci_check.sh --perf     # perf gate only (recompiles + syncs/step
+#                                #   vs .graftperf-baseline.json)
 #   tools/ci_check.sh --chaos    # fault-injection / failover suite only
 set -euo pipefail
 cd "$(dirname "$0")/.."
@@ -13,6 +15,12 @@ python -m deeplearning4j_tpu.analysis deeplearning4j_tpu tests \
     --strict --baseline .graftlint-baseline.json
 
 if [[ "${1:-}" == "--lint" ]]; then
+    exit 0
+fi
+
+if [[ "${1:-}" == "--perf" ]]; then
+    echo "== perf gate (recompiles + host syncs vs baseline) =="
+    env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python tools/perf_gate.py
     exit 0
 fi
 

@@ -7,6 +7,12 @@ this framework hand-writes kernels instead of trusting the compiler
   - ops/attention.flash_attention  vs  dense XLA attention
       forward (inference) and forward+backward (training), causal,
       T in {1024, 2048, 4096}
+  - ops/banded_attention.banded_attention  vs  the dense band-masked
+      reference: windowed GQA, T in {1024, 2048, 4096}, w = T/8
+  - ops/banded_attention.banded_decode_attention  vs  the dense masked
+      einsum: single-query decode over [S, L, Hkv, Dh], L in {1024, 4096}
+  - ops/fused_update.{adam,nesterov}_update  vs  the XLA updater math:
+      one-pass read-modify-write, 16M-element leaves (HBM-bound)
   - ops/lstm.fused_lstm            vs  the lax.scan fallback
       forward and forward+backward
 
@@ -138,6 +144,163 @@ def bench_attention(t, train, flash, causal=True, block_q=512, block_k=512,
     return r
 
 
+# ------------------------------------------------------- banded attention
+def bench_banded(t, window, train, banded, block_q=256, block_k=256):
+    """Windowed/GQA attention: the banded Pallas kernel vs the dense
+    band-masked reference (the layer's fallback path)."""
+    from deeplearning4j_tpu.ops.banded_attention import (
+        banded_attention, banded_reference,
+    )
+    b, h, hkv, d = 4, 8, 2, 64
+    key = jax.random.PRNGKey(2)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (b, t, h, d), jnp.bfloat16)
+    k = jax.random.normal(kk, (b, t, hkv, d), jnp.bfloat16)
+    v = jax.random.normal(kv, (b, t, hkv, d), jnp.bfloat16)
+
+    if banded:
+        attn = lambda q, k, v: banded_attention(q, k, v, window, True,
+                                                None, block_q, block_k)
+    else:
+        attn = lambda q, k, v: banded_reference(q, k, v, window, True,
+                                                d ** -0.5)
+
+    if train:
+        def loss(q, k, v):
+            o = attn(q, k, v)
+            return (o.astype(jnp.float32) ** 2).mean()
+        g = jax.grad(loss, argnums=(0, 1, 2))
+
+        def body(i, c):
+            q, k, v = c
+            dq, dk, dv = g(q, k, v)
+            s = 1e-3
+            return (q - s * dq, k - s * dk, v - s * dv)
+        run = _loop(body, (q, k, v))
+    else:
+        def body(i, c):
+            q, k, v = c
+            return (attn(q, k, v), k, v)
+        run = _loop(body, (q, k, v))
+
+    per_iter = _timed_per_iter(run)
+    # Useful FLOPs: the O(T*w) band only — both contenders get the same
+    # numerator, so the dense side's T^2 wasted lanes show as low TFLOP/s.
+    fwd_flops = 4 * b * h * t * window * d
+    flops = fwd_flops * (3.5 if train else 1.0)
+    blk = f"_bq{block_q}_bk{block_k}" if banded else ""
+    r = {
+        "name": f"battn_t{t}_w{window}_{'train' if train else 'fwd'}_"
+                f"{'banded' if banded else 'dense'}{blk}",
+        "per_iter_ms": round(per_iter * 1e3, 3),
+        "tflops_per_s": round(flops / per_iter / 1e12, 2),
+        "shape": f"b{b} t{t} w{window} h{h} hkv{hkv} d{d} causal bf16",
+        "window": window,
+    }
+    if banded:
+        r.update(block_q=block_q, block_k=block_k)
+    return r
+
+
+# --------------------------------------------------- single-query decode
+def bench_decode(cache_len, banded, block_l=512):
+    """One decode step over the KV-pool layout [S, L, Hkv, Dh]: the
+    scalar-prefetch Pallas kernel vs the dense masked einsum."""
+    from deeplearning4j_tpu.ops.banded_attention import (
+        banded_decode_attention, decode_reference,
+    )
+    s, h, hkv, d = 32, 8, 2, 64
+    key = jax.random.PRNGKey(3)
+    kq, kk, kv = jax.random.split(key, 3)
+    q = jax.random.normal(kq, (s, h, d), jnp.bfloat16)
+    ck = jax.random.normal(kk, (s, cache_len, hkv, d), jnp.bfloat16)
+    cv = jax.random.normal(kv, (s, cache_len, hkv, d), jnp.bfloat16)
+    qpos = jnp.full((s,), cache_len - 1, jnp.int32)
+
+    if banded:
+        f = lambda q, ck, cv: banded_decode_attention(
+            q, ck, cv, qpos, qpos, window=None, rolling=False,
+            block_l=block_l)
+    else:
+        f = lambda q, ck, cv: decode_reference(q, ck, cv, qpos, qpos,
+                                               None, False, d ** -0.5)
+
+    def body(i, c):
+        q, ck, cv = c
+        o = f(q, ck, cv)
+        return (q + 1e-9 * o.astype(q.dtype), ck, cv)
+    run = _loop(body, (q, ck, cv))
+
+    per_iter = _timed_per_iter(run)
+    # decode is bandwidth-bound: report GB/s of cache traffic instead of
+    # TFLOP/s (the per-token HBM sweep is the resource being bought)
+    cache_bytes = 2 * s * cache_len * hkv * d * 2   # k+v, bf16
+    blk = f"_bl{block_l}" if banded else ""
+    r = {
+        "name": f"dattn_l{cache_len}_{'banded' if banded else 'dense'}"
+                f"{blk}",
+        "per_iter_ms": round(per_iter * 1e3, 3),
+        "cache_gb_per_s": round(cache_bytes / per_iter / 1e9, 2),
+        "shape": f"s{s} l{cache_len} h{h} hkv{hkv} d{d} bf16",
+    }
+    if banded:
+        r["block_l"] = block_l
+    return r
+
+
+# ---------------------------------------------------- fused optimizer step
+def bench_fused_update(opt, fused):
+    """One optimizer leaf update: the one-pass Pallas read-modify-write
+    vs the XLA expression the updaters build (same math, separate HBM
+    sweeps)."""
+    from deeplearning4j_tpu.ops.fused_update import (
+        adam_update, nesterov_update,
+    )
+    n = 1 << 24   # 16M f32 elements/tensor: decisively HBM-bound
+    key = jax.random.PRNGKey(4)
+    kp, kg = jax.random.split(key)
+    p = jax.random.normal(kp, (n,), jnp.float32)
+    g = jax.random.normal(kg, (n,), jnp.float32) * 1e-2
+    m = jnp.zeros((n,), jnp.float32)
+    v = jnp.zeros((n,), jnp.float32)
+    c = jnp.float32(1e-3)
+
+    if opt == "adam":
+        if fused:
+            def body(i, carry):
+                p, m, v = carry
+                return adam_update(p, g, m, v, c)
+        else:
+            def body(i, carry):
+                p, m, v = carry
+                m2 = 0.9 * m + 0.1 * g
+                v2 = 0.999 * v + 0.001 * g * g
+                return (p - c * m2 / (jnp.sqrt(v2) + 1e-8), m2, v2)
+        run = _loop(body, (p, m, v))
+        ntensors = 5   # read p,m,v + write m',v' dominate (g shared)
+    else:
+        if fused:
+            def body(i, carry):
+                p, v = carry
+                return nesterov_update(p, g, v, c)
+        else:
+            def body(i, carry):
+                p, v = carry
+                v2 = 0.9 * v - c * g
+                return (p + 0.9 * v2 - c * g, v2)
+        run = _loop(body, (p, v))
+        ntensors = 4
+
+    per_iter = _timed_per_iter(run)
+    bytes_moved = ntensors * n * 4
+    return {
+        "name": f"upd_{opt}_{'fused' if fused else 'xla'}",
+        "per_iter_ms": round(per_iter * 1e3, 3),
+        "gb_per_s": round(bytes_moved / per_iter / 1e9, 2),
+        "shape": f"n{n} f32",
+    }
+
+
 # ------------------------------------------------------------------ lstm
 def bench_lstm(train, fused):
     from deeplearning4j_tpu.ops.lstm import _cell, fused_lstm
@@ -218,6 +381,20 @@ def main():
             bench_attention, 4096, False, True, True, bq, bk)))
         jobs.append(("sweeptrain", functools.partial(
             bench_attention, 4096, True, True, True, bq, bk)))
+    for t in (1024, 2048, 4096):
+        w = max(128, t // 8)
+        for train in (False, True):
+            for banded in (False, True):
+                jobs.append(("banded", functools.partial(
+                    bench_banded, t, w, train, banded)))
+    for cache_len in (1024, 4096):
+        for banded in (False, True):
+            jobs.append(("decode", functools.partial(
+                bench_decode, cache_len, banded)))
+    for opt in ("adam", "nesterov"):
+        for fused in (False, True):
+            jobs.append(("upd", functools.partial(
+                bench_fused_update, opt, fused)))
     for train in (False, True):
         for fused in (False, True):
             jobs.append(("lstm", functools.partial(bench_lstm, train,

@@ -1,0 +1,226 @@
+#!/usr/bin/env python3
+"""Slim perf gate: recompile counts and host syncs/step, diffed against
+a checked-in baseline (.graftperf-baseline.json).
+
+The expensive perf regressions in this codebase are rarely "the kernel
+got 3% slower" — they are structural: a shape leaks into a jit cache
+key and the step recompiles per batch, or a listener calls float() on a
+device value and re-serializes the dispatch pipeline. Both are exactly
+countable on CPU in seconds, deterministically (no timers, no noise),
+so they can gate CI where wall-clock benchmarks cannot.
+
+The gate runs a small fixed workload (fit an MLP; fit a windowed-
+attention transformer; run bucketed inference twice) under a fresh
+RecompileWatchdog + HostSyncMonitor and measures:
+
+  - jit compiles per owner CLASS (instance tags carry run-local ids);
+  - host syncs per steady-state train step (second epoch, cache warm).
+
+`--check` (the ci_check.sh --perf entry) recomputes and fails loudly if
+any owner compiles more than baseline + its budget, a NEW owner class
+appears (a new jit cache nobody baselined), or syncs/step exceeds
+baseline + budget. `--update` rewrites the baseline after a reviewed
+change. Budgets live IN the baseline file so a diff shows both the
+numbers and the allowed slack.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+BASELINE_PATH = os.path.join(os.path.dirname(__file__), "..",
+                             ".graftperf-baseline.json")
+WORKLOAD_VERSION = 1
+
+# Default slack written into a fresh baseline: zero extra compiles (a
+# new program IS the regression being hunted) and half a sync of noise
+# headroom per step (threading in test rigs can land one stray
+# block_until_ready).
+DEFAULT_BUDGETS = {"extra_compiles_per_owner": 0,
+                   "extra_syncs_per_step": 0.5}
+
+
+def run_workload() -> dict:
+    """The deterministic CPU workload; returns the measured profile."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.inputs import InputType
+    from deeplearning4j_tpu.nn.layers.attention import (
+        TransformerEncoderBlock,
+    )
+    from deeplearning4j_tpu.nn.layers.feedforward import (
+        DenseLayer, EmbeddingSequenceLayer, OutputLayer,
+    )
+    from deeplearning4j_tpu.nn.layers.recurrent import RnnOutputLayer
+    from deeplearning4j_tpu.observe.syncmon import HostSyncMonitor
+    from deeplearning4j_tpu.observe.watchdog import (
+        RecompileWatchdog, get_watchdog, set_watchdog,
+    )
+    from deeplearning4j_tpu.optim.updaters import Adam, Sgd
+
+    prev = set_watchdog(RecompileWatchdog(threshold=10_000))
+    try:
+        rng = np.random.default_rng(0)
+
+        # --- MLP fit: the plain train-step cache -----------------------
+        conf = (NeuralNetConfiguration.builder().seed(0)
+                .updater(Sgd(0.1)).activation("relu")
+                .list(DenseLayer(n_in=16, n_out=16),
+                      OutputLayer(n_in=16, n_out=4, activation="softmax",
+                                  loss="mcxent"))
+                .build())
+        net = MultiLayerNetwork(conf).init()
+        x = rng.standard_normal((32, 16)).astype("float32")
+        y = np.eye(4, dtype="float32")[rng.integers(0, 4, 32)]
+        net.fit(x, y, batch_size=8, epochs=1)        # compile epoch
+        mon = HostSyncMonitor().install()
+        try:
+            net.fit(x, y, batch_size=8, epochs=2)    # steady state
+        finally:
+            mon.uninstall()
+        steps = 2 * (32 // 8)
+        syncs_per_step = mon.syncs / steps
+
+        # --- windowed-attention transformer fit: the dispatch-policy
+        # seam (attention/banded policies run at trace time) ------------
+        T, V = 32, 16
+        conf = (NeuralNetConfiguration.builder().seed(0)
+                .updater(Adam(1e-3)).activation("identity")
+                .list(EmbeddingSequenceLayer(n_in=V, n_out=16),
+                      TransformerEncoderBlock(num_heads=4, causal=True,
+                                              window=8),
+                      RnnOutputLayer(n_out=V, activation="softmax"))
+                .set_input_type(InputType.recurrent(1, T)).build())
+        anet = MultiLayerNetwork(conf).init()
+        ids = rng.integers(0, V, (8, T, 1)).astype("float32")
+        labs = np.eye(V, dtype="float32")[rng.integers(0, V, (8, T))]
+        anet.fit(ids, labs, batch_size=4, epochs=2)
+
+        # --- bucketed inference: same shape twice = one compile --------
+        for _ in range(2):
+            net.output(x[:8])
+
+        snap = get_watchdog().snapshot()
+    finally:
+        set_watchdog(prev)
+
+    compiles = {}
+    for tag, owner in snap["per_owner"].items():
+        cls = tag.split("@", 1)[0]
+        compiles[cls] = compiles.get(cls, 0) + owner["compiles"]
+    return {
+        "workload_version": WORKLOAD_VERSION,
+        "compiles_per_owner": dict(sorted(compiles.items())),
+        "total_compiles": snap["total_compiles"],
+        "syncs_per_step": round(syncs_per_step, 3),
+    }
+
+
+def compare(baseline: dict, measured: dict) -> list:
+    """Pure diff: list of breach strings (empty = gate passes).
+
+    Rules: workload versions must match (else the numbers are not
+    comparable and the baseline needs --update); each owner class may
+    compile at most baseline + extra_compiles_per_owner; owner classes
+    absent from the baseline are breaches (a NEW jit cache must be
+    baselined on purpose); syncs/step may exceed baseline by at most
+    extra_syncs_per_step. Owners that disappear or improve only report
+    informationally via diff(), never fail."""
+    budgets = {**DEFAULT_BUDGETS, **baseline.get("budgets", {})}
+    breaches = []
+    if baseline.get("workload_version") != measured["workload_version"]:
+        return [f"workload version changed "
+                f"({baseline.get('workload_version')} -> "
+                f"{measured['workload_version']}): baseline is stale, "
+                f"re-run with --update"]
+    base_c = baseline.get("compiles_per_owner", {})
+    extra = budgets["extra_compiles_per_owner"]
+    for cls, n in sorted(measured["compiles_per_owner"].items()):
+        if cls not in base_c:
+            breaches.append(
+                f"new jit-cache owner {cls!r} compiled {n} program(s) "
+                f"— not in baseline; baseline it with --update if "
+                f"intended")
+        elif n > base_c[cls] + extra:
+            breaches.append(
+                f"{cls}: {n} compiles vs baseline {base_c[cls]} "
+                f"(budget +{extra}) — likely a shape or static-arg "
+                f"leak into the jit cache key")
+    limit = baseline.get("syncs_per_step", 0.0) + \
+        budgets["extra_syncs_per_step"]
+    if measured["syncs_per_step"] > limit:
+        breaches.append(
+            f"syncs/step {measured['syncs_per_step']} vs baseline "
+            f"{baseline.get('syncs_per_step')} (budget "
+            f"+{budgets['extra_syncs_per_step']}) — a device->host "
+            f"materialization crept into the step loop")
+    return breaches
+
+
+def diff(baseline: dict, measured: dict) -> list:
+    """Informational deltas (improvements and disappearances too)."""
+    out = []
+    base_c = baseline.get("compiles_per_owner", {})
+    meas_c = measured["compiles_per_owner"]
+    for cls in sorted(set(base_c) | set(meas_c)):
+        b, m = base_c.get(cls), meas_c.get(cls)
+        if b != m:
+            out.append(f"  {cls}: {b} -> {m}")
+    b, m = baseline.get("syncs_per_step"), measured["syncs_per_step"]
+    if b != m:
+        out.append(f"  syncs_per_step: {b} -> {m}")
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--update", action="store_true",
+                    help="rewrite the baseline from this run")
+    ap.add_argument("--baseline", default=BASELINE_PATH)
+    ap.add_argument("--json", action="store_true",
+                    help="print the measured profile as JSON")
+    args = ap.parse_args(argv)
+
+    measured = run_workload()
+    if args.json:
+        print(json.dumps(measured, indent=1))
+    if args.update:
+        blob = dict(measured, budgets=dict(DEFAULT_BUDGETS))
+        with open(args.baseline, "w") as f:
+            json.dump(blob, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"perf baseline written: {os.path.relpath(args.baseline)}")
+        return 0
+
+    try:
+        with open(args.baseline) as f:
+            baseline = json.load(f)
+    except FileNotFoundError:
+        print(f"no baseline at {args.baseline}; run with --update first",
+              file=sys.stderr)
+        return 2
+
+    breaches = compare(baseline, measured)
+    deltas = diff(baseline, measured)
+    if deltas:
+        print("perf profile deltas vs baseline:")
+        for line in deltas:
+            print(line)
+    if breaches:
+        print("PERF GATE FAILED:", file=sys.stderr)
+        for b in breaches:
+            print(f"  - {b}", file=sys.stderr)
+        return 1
+    print(f"perf gate OK: {measured['total_compiles']} compiles, "
+          f"{measured['syncs_per_step']} syncs/step (within budgets)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -12,10 +12,16 @@ without a recorded measurement backing it.
 
 Row-name grammar (kernel_bench.py):
     attn_t{T}_{fwd|train}_{flash|dense}[_bq{B}_bk{B}][_bwddense]
+    battn_t{T}_w{W}_{fwd|train}_{banded|dense}[_bq{B}_bk{B}]
+    dattn_l{L}_{banded|dense}[_bl{B}]
+    upd_{adam|nesterov}_{fused|xla}
     lstm_{fwd|train}_{fused|scan}
 Legacy flash rows without a block suffix or explicit fields were measured
 at the then-default 128x128 tiles with the pre-Pallas (dense-recompute)
-backward; they are read as such.
+backward; they are read as such. The banded / decode / fused_update
+sections are emitted only when their rows exist — build_table over a
+results file with none of them reproduces the pre-banded table exactly,
+which is what keeps the suite guard green until real measurements land.
 """
 import json
 import os
@@ -34,17 +40,59 @@ END = "# --- END GENERATED ---"
 _ATTN = re.compile(
     r"^attn_t(?P<t>\d+)_(?P<mode>fwd|train)_(?P<kind>flash|dense)"
     r"(?:_bq(?P<bq>\d+)_bk(?P<bk>\d+))?(?P<bwd>_bwddense)?$")
+_BATTN = re.compile(
+    r"^battn_t(?P<t>\d+)_w(?P<w>\d+)_(?P<mode>fwd|train)"
+    r"_(?P<kind>banded|dense)(?:_bq(?P<bq>\d+)_bk(?P<bk>\d+))?$")
+_DATTN = re.compile(
+    r"^dattn_l(?P<l>\d+)_(?P<kind>banded|dense)(?:_bl(?P<bl>\d+))?$")
+_UPD = re.compile(r"^upd_(?P<opt>adam|nesterov)_(?P<kind>fused|xla)$")
 _LSTM = re.compile(r"^lstm_(?P<mode>fwd|train)_(?P<kind>fused|scan)$")
 
 
 def build_table(rows: dict) -> dict:
     attn = {}   # mode -> T -> {dense_ms, flash candidates}
+    banded = {}  # mode -> T -> {dense_ms, banded candidates}
+    decode = {}  # L -> {dense_ms, banded candidates}
+    upd = {}    # opt -> {fused_ms, xla_ms}
     lstm = {}   # mode -> {fused_ms, scan_ms}
     devices = set()
     for name, row in rows.items():
         if "error" in row or "per_iter_ms" not in row:
             continue
         devices.add(row.get("device", "?"))
+        m = _BATTN.match(name)
+        if m:
+            t = int(m.group("t"))
+            slot = banded.setdefault(m.group("mode"), {}).setdefault(
+                t, {"dense_ms": None, "window": int(m.group("w")),
+                    "banded": []})
+            if m.group("kind") == "dense":
+                slot["dense_ms"] = row["per_iter_ms"]
+            else:
+                slot["banded"].append(
+                    {"ms": row["per_iter_ms"],
+                     "block_q": row.get("block_q") or (
+                         int(m.group("bq")) if m.group("bq") else 256),
+                     "block_k": row.get("block_k") or (
+                         int(m.group("bk")) if m.group("bk") else 256)})
+            continue
+        m = _DATTN.match(name)
+        if m:
+            cl = int(m.group("l"))
+            slot = decode.setdefault(cl, {"dense_ms": None, "banded": []})
+            if m.group("kind") == "dense":
+                slot["dense_ms"] = row["per_iter_ms"]
+            else:
+                slot["banded"].append(
+                    {"ms": row["per_iter_ms"],
+                     "block_l": row.get("block_l") or (
+                         int(m.group("bl")) if m.group("bl") else 512)})
+            continue
+        m = _UPD.match(name)
+        if m:
+            upd.setdefault(m.group("opt"), {})[
+                m.group("kind") + "_ms"] = row["per_iter_ms"]
+            continue
         m = _ATTN.match(name)
         if m:
             t = int(m.group("t"))
@@ -92,8 +140,53 @@ def build_table(rows: dict) -> dict:
                 "winner": ("fused" if d["fused_ms"] < d["scan_ms"]
                            else "scan"),
             }
-    return {"attention": out_attn, "lstm": out_lstm,
-            "devices": sorted(devices)}
+    table = {"attention": out_attn, "lstm": out_lstm,
+             "devices": sorted(devices)}
+    # New sections appear only once rows exist: an all-legacy results
+    # file must reproduce the pre-banded table byte-for-byte (the suite
+    # guard compares the embedded MEASURED against this function).
+    out_banded = {}
+    for mode, by_t in banded.items():
+        for t, slot in sorted(by_t.items()):
+            if slot["dense_ms"] is None or not slot["banded"]:
+                continue
+            best = min(slot["banded"], key=lambda f: f["ms"])
+            out_banded.setdefault(mode, {})[t] = {
+                "dense_ms": slot["dense_ms"],
+                "banded_ms": best["ms"],
+                "block_q": best["block_q"],
+                "block_k": best["block_k"],
+                "window": slot["window"],
+                "winner": ("banded" if best["ms"] < slot["dense_ms"]
+                           else "dense"),
+            }
+    if out_banded:
+        table["banded"] = out_banded
+    out_decode = {}
+    for cl, slot in sorted(decode.items()):
+        if slot["dense_ms"] is None or not slot["banded"]:
+            continue
+        best = min(slot["banded"], key=lambda f: f["ms"])
+        out_decode[cl] = {
+            "dense_ms": slot["dense_ms"],
+            "banded_ms": best["ms"],
+            "block_l": best["block_l"],
+            "winner": ("banded" if best["ms"] < slot["dense_ms"]
+                       else "dense"),
+        }
+    if out_decode:
+        table["decode"] = out_decode
+    out_upd = {}
+    for opt, d in sorted(upd.items()):
+        if "fused_ms" in d and "xla_ms" in d:
+            out_upd[opt] = {
+                "fused_ms": d["fused_ms"], "xla_ms": d["xla_ms"],
+                "winner": ("fused" if d["fused_ms"] < d["xla_ms"]
+                           else "xla"),
+            }
+    if out_upd:
+        table["fused_update"] = out_upd
+    return table
 
 
 def main():
