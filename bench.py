@@ -39,6 +39,11 @@ tokens/sec + p99 TTFT/ITL reconciled against the /metrics decode
 section, zero-recompiles-after-warmup asserted; writes
 BENCH_serving_decode.json (see _serving_decode_main; knobs:
 BENCH_DECODE_CLIENTS/ROUNDS/MAX_TOKENS/PROMPT/PREFILL_CHUNK/OUT).
+`python bench.py --sharding` (or BENCH_SHARDING=1) profiles the GSPMD
+sharding spine on a forced-8-device CPU mesh: per-device param +
+optimizer-moment bytes replicated vs sharded, syncs/step, post-warmup
+recompiles; writes BENCH_sharding.json (see _sharding_main; knobs:
+BENCH_SHARDING_OUT/HIDDEN).
 """
 
 from __future__ import annotations
@@ -1346,7 +1351,134 @@ def _kernels_main():
     print(json.dumps(out))
 
 
+def _sharding_main():
+    """`bench.py --sharding`: the GSPMD spine's memory + dispatch profile
+    on a forced-8-device CPU mesh → BENCH_sharding.json.
+
+    Two legs of the SAME ParallelWrapper fit, differing only in
+    `shard_opt_state` (the spine's escape hatch): the replicated leg
+    holds full Adam moments on every device, the sharded leg splits
+    them across the replica axis (arXiv:2004.13336). Per-device bytes
+    come from addressable-shard metadata via
+    observe.devicemon.tree_device_bytes (the CPU runtime reports no
+    memory_stats), and the blob embeds the devicemon sample list +
+    registry snapshot like every other mode. Also records steady-state
+    syncs/step and post-warmup recompiles for the sharded leg — the
+    numbers the perf gate budgets. Knobs: BENCH_SHARDING_OUT,
+    BENCH_SHARDING_HIDDEN (default 256).
+    """
+    force = "--xla_force_host_platform_device_count=8"
+    if "jax" in sys.modules:
+        # too late to fake host devices in this process — re-exec with
+        # the flag in place and let the child write the blob
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "") + " " + force).strip()
+        env["JAX_PLATFORMS"] = "cpu"
+        env["BENCH_SHARDING"] = "1"
+        sys.exit(subprocess.run(
+            [sys.executable, os.path.abspath(__file__)], env=env,
+        ).returncode)
+    if force not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " " + force).strip()
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers.feedforward import (
+        DenseLayer, OutputLayer,
+    )
+    from deeplearning4j_tpu.observe.devicemon import tree_device_bytes
+    from deeplearning4j_tpu.observe.syncmon import HostSyncMonitor
+    from deeplearning4j_tpu.observe.watchdog import (
+        RecompileWatchdog, get_watchdog, set_watchdog,
+    )
+    from deeplearning4j_tpu.optim.updaters import Adam
+    from deeplearning4j_tpu.parallel import ParallelWrapper
+
+    hidden = int(os.environ.get("BENCH_SHARDING_HIDDEN", "256"))
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((128, 64)).astype(np.float32)
+    y = np.eye(8, dtype=np.float32)[rng.integers(0, 8, 128)]
+
+    def build():
+        conf = (NeuralNetConfiguration.builder().seed(0)
+                .updater(Adam(1e-3)).activation("relu")
+                .list(DenseLayer(n_in=64, n_out=hidden),
+                      DenseLayer(n_in=hidden, n_out=hidden),
+                      OutputLayer(n_in=hidden, n_out=8,
+                                  activation="softmax", loss="mcxent"))
+                .build())
+        return MultiLayerNetwork(conf).init()
+
+    def leg(shard_opt_state):
+        prev = set_watchdog(RecompileWatchdog(threshold=10_000))
+        try:
+            net = build()
+            wrap = ParallelWrapper(net, shard_opt_state=shard_opt_state)
+            wrap.fit(x, y, batch_size=32, epochs=1)      # compile epoch
+            warm0 = get_watchdog().snapshot()["total_compiles"]
+            mon = HostSyncMonitor().install()
+            try:
+                wrap.fit(x, y, batch_size=32, epochs=2)  # steady state
+            finally:
+                mon.uninstall()
+            warm_recompiles = (get_watchdog().snapshot()["total_compiles"]
+                               - warm0)
+        finally:
+            set_watchdog(prev)
+        steps = 2 * (128 // 32)
+        params_dev = tree_device_bytes(net.params_tree)
+        opt_dev = tree_device_bytes(net.updater_state)
+
+        def mean(d):
+            return int(sum(d.values()) / max(len(d), 1))
+
+        return {
+            "shard_opt_state": shard_opt_state,
+            "per_device_param_bytes": mean(params_dev),
+            "per_device_opt_state_bytes": mean(opt_dev),
+            "per_device_opt_state_bytes_by_device": dict(
+                sorted(opt_dev.items())),
+            "syncs_per_step": round(mon.syncs / steps, 3),
+            "warm_recompiles": int(warm_recompiles),
+            "final_score": float(net.score_),
+        }, wrap
+
+    replicated, _ = leg(False)
+    sharded, wrap = leg(True)
+    total_opt = sum(int(leaf.nbytes) for leaf in
+                    jax.tree_util.tree_leaves(wrap.net.updater_state))
+    factor = (replicated["per_device_opt_state_bytes"]
+              / max(sharded["per_device_opt_state_bytes"], 1))
+    out = {
+        "metric": "sharding_spine",
+        "devices": jax.device_count(),
+        "mesh_axes": {str(a): int(wrap.mesh.shape[a])
+                      for a in wrap.mesh.axis_names},
+        "opt_state_bytes_total": int(total_opt),
+        "opt_state_shard_factor": round(factor, 2),
+        "losses_match": abs(replicated["final_score"]
+                            - sharded["final_score"]) < 1e-4,
+        "replicated": replicated,
+        "sharded": sharded,
+        "device_memory": _devices_summary(),
+        "observability": _registry_snapshot(),
+    }
+    dest = os.environ.get("BENCH_SHARDING_OUT") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "BENCH_sharding.json")
+    with open(dest, "w") as f:
+        json.dump(out, f, indent=1)
+    print(json.dumps(out))
+
+
 def main():
+    if "--sharding" in sys.argv or os.environ.get("BENCH_SHARDING"):
+        _sharding_main()
+        return
     if "--kernels" in sys.argv or os.environ.get("BENCH_KERNELS"):
         _kernels_main()
         return

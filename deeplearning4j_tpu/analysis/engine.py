@@ -927,6 +927,31 @@ class _FileLinter:
                                "float()/np.asarray() under an "
                                "allow-sync, or log host scalars")
 
+        # GL501 — mesh/device-topology construction outside the spine.
+        # parallel/mesh.py is the one module allowed to touch these; it
+        # is what the rule funnels everyone else toward.
+        if not self.path.replace(os.sep, "/").endswith("parallel/mesh.py"):
+            if term == "Mesh" and (
+                    (isinstance(func, ast.Name)
+                     and term in self.imports.from_jax)
+                    or (isinstance(func, ast.Attribute)
+                        and _root_name(func) in self.imports.jax_roots)):
+                self._emit("GL501", node,
+                           "jax.sharding.Mesh constructed outside "
+                           "parallel/mesh.py — placement decided "
+                           "off-spine; use parallel.mesh.make_mesh() or "
+                           "MeshContext")
+            elif term in ("devices", "local_devices") and (
+                    (isinstance(func, ast.Name)
+                     and term in self.imports.from_jax)
+                    or (isinstance(func, ast.Attribute)
+                        and _root_name(func) in self.imports.jax_roots)):
+                self._emit("GL501", node,
+                           f"jax.{term}() read outside parallel/mesh.py "
+                           "— device topology belongs to the spine; use "
+                           "parallel.mesh.device_count() or the active "
+                           "MeshContext")
+
         # GL301 — mutating method calls on self attrs
         if (isinstance(func, ast.Attribute)
                 and func.attr in _MUTATOR_METHODS):

@@ -35,6 +35,7 @@ CAT_RECOMPILE = "recompile"
 CAT_SYNC = "sync"
 CAT_LOCK = "lock"
 CAT_HYGIENE = "hygiene"
+CAT_SHARDING = "sharding"
 
 
 @dataclass(frozen=True)
@@ -111,6 +112,13 @@ _ALL = (
     Rule("GL403", "silent-exception-swallow", CAT_HYGIENE, WARNING,
          "`except ...: pass` — the error disappears; log it, re-raise, "
          "or narrow the handler"),
+    # ------------------------------------------------- sharding discipline
+    Rule("GL501", "mesh-outside-spine", CAT_SHARDING, WARNING,
+         "direct jax.sharding.Mesh(...) / jax.devices() construction "
+         "outside parallel/mesh.py — placement decided off-spine drifts "
+         "from the MeshContext the executor threads through training; "
+         "build meshes via parallel.mesh.make_mesh()/MeshContext and read "
+         "device topology via parallel.mesh.device_count()"),
 )
 
 RULES: Dict[str, Rule] = {r.id: r for r in _ALL}

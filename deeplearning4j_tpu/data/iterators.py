@@ -348,10 +348,13 @@ class DevicePrefetchIterator(DataSetIterator):
     `AsyncDataSetIterator` underneath (thread hides host ETL, this hides
     the H2D copy).
 
-    ``put_fn(array) -> jax.Array`` defaults to `jax.device_put`; the
-    data-parallel trainer passes a sharding-aware put so each batch lands
-    pre-sharded across the mesh. ``transform(ds) -> ds`` is a host-side
-    hook applied before the put (e.g. padding to device-count divisible).
+    ``put_fn(array) -> jax.Array`` defaults to the active sharding
+    spine's batch placement (`parallel.mesh.current_mesh_context()`) when
+    one is installed — each batch lands pre-sharded over the batch axis
+    in ONE device_put — and to plain `jax.device_put` (single device)
+    otherwise. The data-parallel trainer passes its spine's put
+    explicitly. ``transform(ds) -> ds`` is a host-side hook applied
+    before the put (e.g. padding to device-count divisible).
     """
 
     def __init__(self, base: DataSetIterator, depth: int = 2,
@@ -373,7 +376,15 @@ class DevicePrefetchIterator(DataSetIterator):
     def _put(self, ds):
         import jax
 
-        put = self._put_fn or jax.device_put
+        put = self._put_fn
+        if put is None:
+            # resolved per batch: the spine is active only for the
+            # duration of the fit driving this iterator
+            from deeplearning4j_tpu.parallel.mesh import (
+                current_mesh_context,
+            )
+            ctx = current_mesh_context()
+            put = ctx.put_batch if ctx is not None else jax.device_put
         if self._transform is not None:
             ds = self._transform(ds)
         if hasattr(ds, "features_masks"):   # MultiDataSet

@@ -90,7 +90,9 @@ class DeviceMonitor:
             from deeplearning4j_tpu.observe.registry import get_registry
             reg = get_registry()
         if devices is None:
-            devices = jax.devices()
+            # telemetry observes every addressable device regardless of
+            # which spine (if any) is active — not a placement decision
+            devices = jax.devices()  # graft: allow(GL501): observer enumerates all devices, no placement
         live = self._live_array_counts()
         samples = []
         for d in devices:
@@ -252,6 +254,28 @@ def set_device_monitor(mon: DeviceMonitor) -> Optional[DeviceMonitor]:
     with _install_lock:
         prev, _monitor = _monitor, mon
     return prev
+
+
+def tree_device_bytes(tree) -> Dict[str, int]:
+    """Per-device resident bytes for a pytree of jax.Arrays, summed from
+    addressable shards. Works where memory_stats() reports nothing (the
+    CPU runtime) and attributes bytes to the devices a sharded array
+    actually occupies — a replicated leaf counts its full nbytes on every
+    device, a sharded leaf only its shard. Pure host-side metadata
+    (shape/sharding), never values, so sampling cannot sync."""
+    per: Dict[str, int] = {}
+    for leaf in _tree_leaves(tree):
+        for sh in getattr(leaf, "addressable_shards", ()) or ():
+            label = _label(sh.device)
+            data = sh.data
+            if data is not None:
+                per[label] = per.get(label, 0) + int(data.nbytes)
+    return per
+
+
+def _tree_leaves(tree):
+    import jax   # lazy: the observe package stays jax-free to import
+    return jax.tree_util.tree_leaves(tree)
 
 
 def device_memory_summary() -> Optional[List[dict]]:

@@ -156,6 +156,11 @@ class TrainingExecutor:
                                      consistent snapshot — the
                                      checkpointing seam (RecoveryPlan)
       epoch_start() / epoch_end()    per-epoch trainer state
+
+    `mesh_ctx` (a `parallel.mesh.MeshContext`) scopes the sharding spine
+    over the whole loop: step-fn tracing, batch placement (the prefetch
+    iterator's default put), and trace-time kernel policies all see ONE
+    mesh while the executor runs.
     """
 
     def __init__(self, net, *, step: Callable,
@@ -166,8 +171,10 @@ class TrainingExecutor:
                  after_step: Optional[Callable] = None,
                  after_dispatch: Optional[Callable] = None,
                  epoch_start: Optional[Callable] = None,
-                 epoch_end: Optional[Callable] = None):
+                 epoch_end: Optional[Callable] = None,
+                 mesh_ctx=None):
         self.net = net
+        self.mesh_ctx = mesh_ctx
         self.step = step
         self.fused_step = fused_step
         self.can_fuse = can_fuse or (lambda ds: False)
@@ -185,6 +192,15 @@ class TrainingExecutor:
 
     # ------------------------------------------------------------- loop
     def run(self, iterable, epochs: int, *, start_epoch: int = 0):
+        if self.mesh_ctx is not None:
+            # lazy import: parallel.mesh pulls no optim modules, but the
+            # parallel package __init__ imports this one
+            from deeplearning4j_tpu.parallel.mesh import use_mesh_context
+            with use_mesh_context(self.mesh_ctx):
+                return self._run(iterable, epochs, start_epoch=start_epoch)
+        return self._run(iterable, epochs, start_epoch=start_epoch)
+
+    def _run(self, iterable, epochs: int, *, start_epoch: int = 0):
         net = self.net
         listeners = net.listeners
         # registry handles cached once per run; _finish only bumps them.

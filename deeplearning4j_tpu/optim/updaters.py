@@ -32,7 +32,16 @@ def _lr(self, step):
 
 
 class Updater:
-    """Base updater. Subclasses are frozen dataclasses (JSON-serializable)."""
+    """Base updater. Subclasses are frozen dataclasses (JSON-serializable).
+
+    `sharded_state` names the state keys that are param-shaped moments —
+    the leaves the sharding spine (`parallel.mesh.MeshContext`) may
+    partition across the replica axis (cross-replica weight-update
+    sharding, arXiv:2004.13336). Scalar or irregular state must stay off
+    this list; stateless updaters leave it empty.
+    """
+
+    sharded_state = ()   # state keys holding param-shaped moments
 
     def init(self, params) -> Any:
         return ()
@@ -65,6 +74,20 @@ def _fused_interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _moments_replica_sharded() -> bool:
+    """Trace-time check: is an active sharding spine partitioning the
+    optimizer moments across the replica axis? The fused-update Pallas
+    kernels are slot-local (one contiguous buffer per leaf) — running
+    them over replica-sharded moments would force XLA to all-gather the
+    very state the spine just split, so the fused path defers to the
+    XLA update whenever the spine owns moment placement."""
+    from deeplearning4j_tpu.parallel.mesh import current_mesh_context
+
+    ctx = current_mesh_context()
+    return (ctx is not None and ctx.shard_opt_state
+            and ctx.data_size > 1)
+
+
 @register_serde
 @dataclasses.dataclass(frozen=True)
 class NoOp(Updater):
@@ -95,6 +118,7 @@ class Nesterovs(Updater):
     """
     learning_rate: Any = 0.1
     momentum: float = 0.9
+    sharded_state = ("v",)
 
     def init(self, params):
         return {"v": _tmap(jnp.zeros_like, params)}
@@ -111,7 +135,8 @@ class Nesterovs(Updater):
             fused_update_policy,
         )
 
-        if fused_update_policy("nesterov") != "fused":
+        if fused_update_policy("nesterov") != "fused" \
+                or _moments_replica_sharded():
             return super().update_with_params(grads, state, params, step)
         from deeplearning4j_tpu.ops.fused_update import nesterov_update
 
@@ -135,6 +160,7 @@ class Adam(Updater):
     beta1: float = 0.9
     beta2: float = 0.999
     epsilon: float = 1e-8
+    sharded_state = ("m", "v")
 
     def init(self, params):
         z = _tmap(jnp.zeros_like, params)
@@ -155,7 +181,8 @@ class Adam(Updater):
             fused_update_policy,
         )
 
-        if fused_update_policy("adam") != "fused":
+        if fused_update_policy("adam") != "fused" \
+                or _moments_replica_sharded():
             return super().update_with_params(grads, state, params, step)
         from deeplearning4j_tpu.ops.fused_update import adam_update
 
@@ -185,6 +212,7 @@ class AdaMax(Updater):
     beta1: float = 0.9
     beta2: float = 0.999
     epsilon: float = 1e-8
+    sharded_state = ("m", "u")
 
     def init(self, params):
         return {"m": _tmap(jnp.zeros_like, params), "u": _tmap(jnp.zeros_like, params)}
@@ -208,6 +236,7 @@ class Nadam(Updater):
     beta1: float = 0.9
     beta2: float = 0.999
     epsilon: float = 1e-8
+    sharded_state = ("m", "v")
 
     def init(self, params):
         return {"m": _tmap(jnp.zeros_like, params), "v": _tmap(jnp.zeros_like, params)}
@@ -237,6 +266,7 @@ class AMSGrad(Updater):
     beta1: float = 0.9
     beta2: float = 0.999
     epsilon: float = 1e-8
+    sharded_state = ("m", "v", "vhat")
 
     def init(self, params):
         z = _tmap(jnp.zeros_like, params)
@@ -258,6 +288,7 @@ class AdaGrad(Updater):
     """Reference: AdaGradUpdater."""
     learning_rate: Any = 1e-1
     epsilon: float = 1e-6
+    sharded_state = ("h",)
 
     def init(self, params):
         return {"h": _tmap(jnp.zeros_like, params)}
@@ -275,6 +306,7 @@ class AdaDelta(Updater):
     """Reference: AdaDeltaUpdater (rho/epsilon; no explicit LR)."""
     rho: float = 0.95
     epsilon: float = 1e-6
+    sharded_state = ("Eg", "Ex")
 
     def init(self, params):
         return {"Eg": _tmap(jnp.zeros_like, params), "Ex": _tmap(jnp.zeros_like, params)}
@@ -297,6 +329,7 @@ class RmsProp(Updater):
     learning_rate: Any = 1e-1
     rms_decay: float = 0.95
     epsilon: float = 1e-8
+    sharded_state = ("g2",)
 
     def init(self, params):
         return {"g2": _tmap(jnp.zeros_like, params)}
@@ -307,6 +340,16 @@ class RmsProp(Updater):
         g2 = _tmap(lambda a, g: d * a + (1 - d) * g * g, state["g2"], grads)
         updates = _tmap(lambda g, a: lr * g / (jnp.sqrt(a) + self.epsilon), grads, g2)
         return updates, {"g2": g2}
+
+
+#: Every param-shaped moment key any built-in updater declares — the
+#: sharding spine's default answer to "which updater-state leaves may be
+#: partitioned across the replica axis" when it cannot see the per-layer
+#: updater instances (e.g. re-sharding a checkpoint tree).
+MOMENT_STATE_KEYS = frozenset(
+    k for cls in (Nesterovs, Adam, AdaMax, Nadam, AMSGrad, AdaGrad,
+                  AdaDelta, RmsProp)
+    for k in cls.sharded_state)
 
 
 def resolve_updater(u) -> Updater:

@@ -14,12 +14,13 @@ context, multi-host DCN initialization.
 """
 
 from deeplearning4j_tpu.parallel.mesh import (
-    MeshSpec, make_mesh, device_count, local_device_count,
+    MeshContext, MeshSpec, current_mesh_context, device_count,
+    local_device_count, make_mesh, set_mesh_context, use_mesh_context,
 )
 from deeplearning4j_tpu.parallel.data_parallel import ParallelWrapper
 from deeplearning4j_tpu.parallel.sharding import (
     ShardingRules, shard_params, replicate, batch_sharding,
-    tensor_parallel_rules,
+    fsdp_rules, tensor_parallel_rules,
 )
 from deeplearning4j_tpu.parallel.inference import ParallelInference
 from deeplearning4j_tpu.parallel.distributed import initialize_distributed
@@ -50,10 +51,12 @@ __all__ = [
     "CheckpointIOFault", "FailingIterator", "InjectedFault", "SigtermAtStep",
     "StallingIterator",
     "AsyncParameterServer", "AsyncTrainer",
-    "MeshSpec", "make_mesh", "device_count", "local_device_count",
+    "MeshContext", "MeshSpec", "current_mesh_context", "set_mesh_context",
+    "use_mesh_context",
+    "make_mesh", "device_count", "local_device_count",
     "ParallelWrapper", "ParallelInference",
     "ShardingRules", "shard_params", "replicate", "batch_sharding",
-    "tensor_parallel_rules", "initialize_distributed",
+    "fsdp_rules", "tensor_parallel_rules", "initialize_distributed",
     "PipelineParallel", "PipelinedNetwork", "make_pipeline_fn",
     "make_pipeline_1f1b_fn", "partition_for_pipeline", "stack_stage_params",
     "split_microbatches",

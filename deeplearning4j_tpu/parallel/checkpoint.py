@@ -386,8 +386,16 @@ class ShardedCheckpointer:
     def restore_into_wrapper(self, wrapper, *,
                              step: Optional[int] = None) -> Dict:
         """Restore into a ParallelWrapper's model with ITS shardings —
-        FSDP-sharded leaves go straight back onto the mesh."""
-        return self.restore_into(
-            wrapper.net, step=step,
-            shardings={"params": wrapper._params_sh,
-                       "updater": wrapper._opt_sh})
+        FSDP-sharded params AND replica-sharded optimizer moments land
+        straight back on the mesh. The wrapper's spine may sit on a
+        DIFFERENT device count than the snapshot's (elastic shrink/grow):
+        `_read_step` re-assembles each global array from the saved unique
+        shards, then the device_put here re-partitions it under the
+        restoring spine's specs."""
+        shardings = {"params": wrapper._params_sh,
+                     "updater": wrapper._opt_sh}
+        if wrapper.net.state_tree:
+            shardings["state"] = wrapper.spine.state_shardings(
+                wrapper.net.state_tree)
+        return self.restore_into(wrapper.net, step=step,
+                                 shardings=shardings)

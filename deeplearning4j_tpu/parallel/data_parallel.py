@@ -32,7 +32,9 @@ from deeplearning4j_tpu.optim.recovery import RecoveryPlan, run_with_recovery
 from deeplearning4j_tpu.parallel.distributed import (
     put_global, put_global_batch,
 )
-from deeplearning4j_tpu.parallel.mesh import AXIS_DATA, make_mesh
+from deeplearning4j_tpu.parallel.mesh import (
+    AXIS_DATA, MeshContext, make_mesh,
+)
 from deeplearning4j_tpu.parallel.ring_attention import SeqCtxJitCache
 from deeplearning4j_tpu.parallel.sharding import ShardingRules
 
@@ -51,12 +53,22 @@ class ParallelWrapper(SeqCtxJitCache):
     `workers` is implied by the mesh's data-axis size. Gradient averaging is
     exact and per-step (allreduce), i.e. averagingFrequency=1 semantics.
     `param_rules` opts into FSDP/ZeRO-style parameter+optimizer sharding
-    (reference precedent: none — extension)."""
+    (reference precedent: none — extension).
+
+    Placement comes from ONE `parallel.mesh.MeshContext` (the sharding
+    spine): pass a prebuilt `spine`, or let the wrapper assemble one from
+    `mesh`/`param_rules`/`batch_axis`. By contract the spine shards the
+    optimizer moments across the replica axis even when params replicate
+    (weight-update sharding, ~data_size× less optimizer HBM per device);
+    `shard_opt_state=False` is the escape hatch back to replicated
+    moments (see PERF_NOTES — replicating them is a regression)."""
 
     def __init__(self, net, *, mesh: Optional[Mesh] = None,
                  param_rules: Optional[ShardingRules] = None,
                  prefetch_buffer: int = 2,
-                 batch_axis: str = AXIS_DATA):
+                 batch_axis: str = AXIS_DATA,
+                 spine: Optional[MeshContext] = None,
+                 shard_opt_state: bool = True):
         if net.params_tree is None:
             raise RuntimeError("Model must be init()ed before wrapping")
         if getattr(net.conf, "optimization_algo",
@@ -67,26 +79,30 @@ class ParallelWrapper(SeqCtxJitCache):
                 f"optimization_algo={net.conf.optimization_algo!r} is a "
                 "full-batch single-device solver — fit the model directly")
         self.net = net
-        self.mesh = mesh if mesh is not None else make_mesh()
-        self.batch_axis = batch_axis
-        self.param_rules = param_rules
+        if spine is None:
+            spine = MeshContext(
+                mesh if mesh is not None else make_mesh(),
+                param_rules, batch_axis=batch_axis,
+                shard_opt_state=shard_opt_state)
+        self.spine = spine
+        self.mesh = spine.mesh
+        self.batch_axis = spine.batch_axis
+        self.param_rules = spine.rules
         self.prefetch = prefetch_buffer
         self._graph = _is_graph(net)
         self.last_batch_index = -1   # in-epoch position (elastic resume)
         self.stopped_early = False   # did the last fit() stop via stop_fn?
 
-        if batch_axis not in self.mesh.axis_names:
-            raise ValueError(
-                f"Mesh {self.mesh.axis_names} has no {batch_axis!r} axis")
-        self.data_size = self.mesh.shape[batch_axis]
+        self.data_size = spine.data_size
         # Multi-controller: each process feeds a host-LOCAL slice of every
         # batch; padding must make the local slice divide the local devices.
         self._nproc = jax.process_count()
         self._local_divisor = max(1, self.data_size // self._nproc)
 
-        self._rep = NamedSharding(self.mesh, P())
-        self._params_sh = self._param_tree_sharding(net.params_tree)
-        self._opt_sh = self._param_tree_sharding(net.updater_state)
+        self._rep = spine.replicated
+        self._params_sh = spine.param_shardings(net.params_tree)
+        self._opt_sh = spine.opt_shardings(
+            net.updater_state, self._moment_keys())
         net.params_tree = jax.tree_util.tree_map(
             put_global, net.params_tree, self._params_sh)
         net.updater_state = jax.tree_util.tree_map(
@@ -96,36 +112,23 @@ class ParallelWrapper(SeqCtxJitCache):
                 lambda x: put_global(x, self._rep), net.state_tree)
 
     # ------------------------------------------------------- shardings
+    def _moment_keys(self):
+        """State keys the spine may replica-shard: what this net's actual
+        updaters declare, or every built-in moment key as the fallback."""
+        ups = getattr(self.net, "_layer_updaters", None)
+        if not ups:
+            return None
+        return frozenset(k for u in ups.values()
+                         for k in getattr(u, "sharded_state", ()))
+
     def _param_tree_sharding(self, tree):
-        """NamedSharding tree matching `tree`'s structure. Param-name rules
-        apply at the LEAF key (so updater state like {'m': {'W': ...}} shards
-        like its underlying param 'W')."""
-        if self.param_rules is None:
-            return jax.tree_util.tree_map(lambda _: self._rep, tree)
-
-        def build(layer_name, sub):
-            if isinstance(sub, dict):
-                return {k: build(layer_name, v) if isinstance(v, dict)
-                        else self._leaf_sharding(layer_name, k, v)
-                        for k, v in sub.items()}
-            return jax.tree_util.tree_map(lambda _: self._rep, sub)
-
-        return {ln: build(ln, sub) for ln, sub in tree.items()}
-
-    def _leaf_sharding(self, layer_name, param_name, leaf):
-        spec = self.param_rules.spec_for(layer_name, param_name)
-        nd = getattr(leaf, "ndim", None)
-        if nd is not None and len(spec) > nd:
-            spec = P()
-        return NamedSharding(self.mesh, spec)
+        """NamedSharding tree matching `tree`'s structure (spine rules at
+        the leaf key). Kept as the wrapper-level seam; placement itself
+        lives in `MeshContext`."""
+        return self.spine.param_shardings(tree)
 
     def _batch_sharding_like(self, x):
-        if x is None:
-            return None
-        if isinstance(x, dict):
-            return {k: self._batch_sharding_like(v) for k, v in x.items()}
-        return NamedSharding(
-            self.mesh, P(self.batch_axis, *([None] * (x.ndim - 1))))
+        return self.spine.batch_sharding_like(x)
 
     # ------------------------------------------------------- step build
     def _get_step(self, key, example_args):
@@ -141,6 +144,8 @@ class ParallelWrapper(SeqCtxJitCache):
                      self._batch_sharding_like(fms),
                      self._batch_sharding_like(lms),
                      self._rep)
+            # (params, opt, states, loss)
+            out_sh = (self._params_sh, self._opt_sh, self._rep, self._rep)
         else:
             # (params, opt, states, step, feats, labels, fm, lm, rng, carries)
             _, _, _, _, feats, labs, fm, lm, _, _ = example_args
@@ -150,7 +155,15 @@ class ParallelWrapper(SeqCtxJitCache):
                      self._batch_sharding_like(fm),
                      self._batch_sharding_like(lm),
                      self._rep, None)
-        fn = jax.jit(base, in_shardings=in_sh, donate_argnums=(0, 1, 2))
+            # (params, opt, persist, loss, carries)
+            out_sh = (self._params_sh, self._opt_sh, self._rep, self._rep,
+                      None)
+        # out_shardings pin the donated params/opt buffers to their input
+        # placement — the moments stay replica-sharded through the update
+        # instead of silently re-replicating (the regression the perf
+        # gate's opt_state_shard_factor budget exists to catch).
+        fn = jax.jit(base, in_shardings=in_sh, out_shardings=out_sh,
+                     donate_argnums=(0, 1, 2))
         self._jit_cache[key] = fn
         return fn
 
@@ -232,11 +245,11 @@ class ParallelWrapper(SeqCtxJitCache):
                 iterable = iterable.async_(self.prefetch)
         if device_prefetch and self._nproc == 1:
             # Pad on host, then land every leaf pre-sharded across the
-            # mesh one batch ahead of compute.
+            # mesh one batch ahead of compute — the spine's batch
+            # placement in ONE device_put per leaf.
             iterable = DevicePrefetchIterator(
                 iterable, depth=max(2, int(steps_per_dispatch)),
-                put_fn=lambda x: jax.device_put(
-                    x, self._batch_sharding_like(x)),
+                put_fn=self.spine.put_batch,
                 transform=self._pad_to_divisible)
 
         def epoch_start():
@@ -258,12 +271,17 @@ class ParallelWrapper(SeqCtxJitCache):
         # topology so a flight dump names the mesh it died on
         get_flight().record("parallel_fit", replicas=int(self.mesh.devices.size),
                             steps_per_dispatch=int(steps_per_dispatch),
-                            processes=int(self._nproc))
+                            processes=int(self._nproc),
+                            mesh_axes={str(a): int(self.mesh.shape[a])
+                                       for a in self.mesh.axis_names},
+                            opt_state_sharded=bool(
+                                self.spine.shard_opt_state))
         execu = TrainingExecutor(
             net, step=self._step, fused_step=self._fused_step,
             can_fuse=self._can_fuse, steps_per_dispatch=steps_per_dispatch,
             before_batch=plan.before_batch, after_dispatch=after_dispatch,
-            epoch_start=epoch_start, epoch_end=plan.epoch_end)
+            epoch_start=epoch_start, epoch_end=plan.epoch_end,
+            mesh_ctx=self.spine)
         run_with_recovery(execu, plan, iterable, epochs)
         self.last_batch_index = plan.last_batch_index
         self.stopped_early = execu.stopped  # authoritative for ElasticTrainer
@@ -333,6 +351,15 @@ class ParallelWrapper(SeqCtxJitCache):
         per-step host staging — fusion is single-controller only."""
         return self._nproc == 1
 
+    def _stacked_sharding_like(self, x):
+        """(K, batch, ...) stack: scan axis replicated, batch sharded."""
+        if x is None:
+            return None
+        if isinstance(x, dict):
+            return {k: self._stacked_sharding_like(v) for k, v in x.items()}
+        return NamedSharding(
+            self.mesh, P(None, self.batch_axis, *([None] * (x.ndim - 2))))
+
     def _put_stacked(self, x):
         """Place a (K, batch, ...) stack with the scan axis replicated and
         the batch axis sharded across the mesh."""
@@ -340,11 +367,9 @@ class ParallelWrapper(SeqCtxJitCache):
             return None
         if isinstance(x, dict):
             return {k: self._put_stacked(v) for k, v in x.items()}
-        sh = NamedSharding(
-            self.mesh, P(None, self.batch_axis, *([None] * (x.ndim - 2))))
-        return jax.device_put(x, sh)
+        return jax.device_put(x, self._stacked_sharding_like(x))
 
-    def _get_fused_step(self, key):
+    def _get_fused_step(self, key, example_args):
         if key in self._jit_cache:
             return self._jit_cache[key]
         k = key[1]
@@ -383,7 +408,24 @@ class ParallelWrapper(SeqCtxJitCache):
                     (feats, labs, fms, lms))
                 return params, opt_state, states, rng, losses
 
-        fn = jax.jit(fused, donate_argnums=(0, 1, 2))
+        # Both ends of the K-step scan are pinned: the partitioner must
+        # carry the replica-sharded moments through the whole window and
+        # hand them back in place — without the explicit in_shardings it
+        # re-replicates the carry and the donated moment buffers become
+        # unusable (a reshard + 2x moment HBM per dispatch window).
+        # (params, opt, states, step0, rng, feats, labs, fms, lms)
+        _, _, _, _, _, feats, labs, fms, lms = example_args
+        in_sh = (self._params_sh, self._opt_sh, self._rep, self._rep,
+                 self._rep,
+                 self._stacked_sharding_like(feats),
+                 self._stacked_sharding_like(labs),
+                 self._stacked_sharding_like(fms),
+                 self._stacked_sharding_like(lms))
+        # (params, opt, states, rng, losses)
+        out_sh = (self._params_sh, self._opt_sh, self._rep, self._rep,
+                  self._rep)
+        fn = jax.jit(fused, in_shardings=in_sh, out_shardings=out_sh,
+                     donate_argnums=(0, 1, 2))
         self._jit_cache[key] = fn
         return fn
 
@@ -412,10 +454,11 @@ class ParallelWrapper(SeqCtxJitCache):
             key = ("gf", len(batches), tuple(sorted(conv[0][0])),
                    tuple(sorted(conv[0][1])),
                    conv[0][2] is not None, conv[0][3] is not None)
-            fn = self._get_fused_step(key)
+            args = (net.params_tree, net.updater_state, net.state_tree,
+                    step0, net._rng, stk(0), stk(1), stk(2), stk(3))
+            fn = self._get_fused_step(key, args)
             (net.params_tree, net.updater_state, net.state_tree, net._rng,
-             losses) = fn(net.params_tree, net.updater_state, net.state_tree,
-                          step0, net._rng, stk(0), stk(1), stk(2), stk(3))
+             losses) = fn(*args)
         else:
             def stk(get, dt=None):
                 vals = [get(b) for b in batches]
@@ -433,12 +476,13 @@ class ParallelWrapper(SeqCtxJitCache):
                    0 if first.labels is None else first.labels.ndim,
                    first.features_mask is not None,
                    first.labels_mask is not None)
-            fn = self._get_fused_step(key)
+            args = (net.params_tree, net.updater_state, net.state_tree,
+                    step0, net._rng,
+                    stk(lambda b: b.features, net.dtype),
+                    stk(lambda b: b.labels),
+                    stk(lambda b: b.features_mask),
+                    stk(lambda b: b.labels_mask))
+            fn = self._get_fused_step(key, args)
             (net.params_tree, net.updater_state, net.state_tree, net._rng,
-             losses) = fn(net.params_tree, net.updater_state, net.state_tree,
-                          step0, net._rng,
-                          stk(lambda b: b.features, net.dtype),
-                          stk(lambda b: b.labels),
-                          stk(lambda b: b.features_mask),
-                          stk(lambda b: b.labels_mask))
+             losses) = fn(*args)
         return losses

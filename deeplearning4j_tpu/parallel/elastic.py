@@ -106,9 +106,15 @@ class ElasticTrainer:
                  param_rules=None, checkpoint_every: int = 10,
                  max_to_keep: int = 3,
                  preemption_signals: Sequence[int] = (signal.SIGTERM,),
-                 stop_fn: Optional[Callable[[], bool]] = None):
+                 stop_fn: Optional[Callable[[], bool]] = None,
+                 spine=None, shard_opt_state: bool = True):
+        # the spine survives preemption cycles: a restart on a SMALLER
+        # mesh builds a fresh context here and restore_into_wrapper
+        # re-partitions params AND replica-sharded moments onto it
         self.wrapper = ParallelWrapper(net, mesh=mesh,
-                                       param_rules=param_rules)
+                                       param_rules=param_rules,
+                                       spine=spine,
+                                       shard_opt_state=shard_opt_state)
         self.checkpointer = ShardedCheckpointer(
             checkpoint_dir, max_to_keep=max_to_keep)
         self.checkpoint_every = checkpoint_every

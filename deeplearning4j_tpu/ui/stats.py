@@ -192,7 +192,8 @@ class StatsListener(TrainingListener):
             "software": {"jax_version": jax.__version__,
                          "backend": backend},
             "hardware": {"num_devices": jax.device_count(),
-                         "device_kind": jax.devices()[0].device_kind},
+                         # hardware metadata for the dashboard, not placement
+                         "device_kind": jax.devices()[0].device_kind},  # graft: allow(GL501): UI reads device kind for display only
             "timestamp": time.time(),
         }
         self.router.put_static_info(Persistable(

@@ -65,7 +65,8 @@ def peak_flops(device_kind: Optional[str] = None) -> Optional[float]:
     (PerformanceListener) must then OMIT the MFU gauge rather than
     publish NaN, and the warning is the only trace of why."""
     if device_kind is None:
-        device_kind = jax.devices()[0].device_kind
+        # spec-sheet lookup keys off the chip model, not placement
+        device_kind = jax.devices()[0].device_kind  # graft: allow(GL501): roofline reads device kind only
     kind = device_kind.lower()
     for key, peak in PEAK_FLOPS_BY_KIND:
         if key in kind:
@@ -85,7 +86,8 @@ def peak_hbm_bytes(device_kind: Optional[str] = None) -> Optional[float]:
     device 0). Same contract as `peak_flops`: unknown kinds return None
     and warn once — callers must omit, never fabricate, a roofline."""
     if device_kind is None:
-        device_kind = jax.devices()[0].device_kind
+        # spec-sheet lookup keys off the chip model, not placement
+        device_kind = jax.devices()[0].device_kind  # graft: allow(GL501): roofline reads device kind only
     kind = device_kind.lower()
     for key, peak in PEAK_HBM_BYTES_BY_KIND:
         if key in kind:

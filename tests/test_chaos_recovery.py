@@ -381,6 +381,64 @@ class TestElasticShrink:
         assert len(rec.losses) == 4      # epoch 0 replayed, epoch 1 trained
         assert all(np.isfinite(v) for v in _curve(rec.losses))
 
+    def test_sharded_moments_survive_8_to_4_shrink(
+            self, tmp_path, devices8):
+        """ISSUE 9 satellite: replica-sharded Adam moments snapshot on an
+        8-device FSDP mesh, re-assemble, and re-partition onto a 4-device
+        spine — then training continues. Moment bytes must land sharded
+        on the SMALLER mesh too, not silently re-replicated."""
+        from jax.sharding import PartitionSpec as P
+
+        from deeplearning4j_tpu.optim.updaters import MOMENT_STATE_KEYS
+        from deeplearning4j_tpu.parallel.sharding import ShardingRules
+
+        def moment_leaves(net):
+            for lname, state in net.updater_state.items():
+                for skey, sub in state.items():
+                    if skey in MOMENT_STATE_KEYS:
+                        for pname, leaf in sub.items():
+                            yield lname, skey, pname, leaf
+
+        rules = ShardingRules(rules=[("*dense*", "W", P(None, AXIS_DATA)),
+                                     ("*dense*", "b", P(AXIS_DATA))])
+        x, y = _data()
+
+        mesh8 = Mesh(np.array(devices8), (AXIS_DATA,))
+        net_a = _net()
+        wa = ParallelWrapper(net_a, mesh=mesh8, param_rules=rules)
+        ck = ShardedCheckpointer(str(tmp_path / "ck"))
+        wa.fit(x, y, epochs=1, batch_size=64, checkpointer=ck)
+        ck.wait()
+        # the source run really exercised the contract: at least the
+        # unruled OutputLayer W-moments are sharded on the replica axis
+        src_sharded = {(ln, sk, pn)
+                       for ln, sk, pn, leaf in moment_leaves(net_a)
+                       if any(a is not None for a in leaf.sharding.spec)}
+        assert src_sharded
+
+        mesh4 = Mesh(np.array(devices8[:4]), (AXIS_DATA,))
+        net_c = _net(seed=99)
+        wc = ParallelWrapper(net_c, mesh=mesh4, param_rules=rules)
+        pos = ck.restore_into_wrapper(wc)
+        assert net_c.iteration == net_a.iteration
+        for ln, sk, pn, leaf in moment_leaves(net_a):
+            restored = net_c.updater_state[ln][sk][pn]
+            np.testing.assert_array_equal(np.asarray(leaf),
+                                          np.asarray(restored))
+        # every source-sharded moment is sharded on the 4-device spine
+        # as well: 4 distinct shard slices, not 4 full copies
+        for ln, sk, pn in src_sharded:
+            leaf = net_c.updater_state[ln][sk][pn]
+            assert any(a is not None for a in leaf.sharding.spec), \
+                f"{ln}/{sk}/{pn} re-replicated after shrink"
+            idxs = {tuple((sl.start, sl.stop) for sl in s.index)
+                    for s in leaf.addressable_shards}
+            assert len(idxs) == 4
+        rec = _Rec()
+        net_c.listeners.append(rec)
+        wc.fit(x, y, epochs=2, batch_size=64, resume=pos)
+        assert all(np.isfinite(v) for v in _curve(rec.losses))
+
 
 # ------------------------------------------------ preemption degrade path
 class TestPreemptionDegrade:

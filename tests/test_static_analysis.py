@@ -275,6 +275,19 @@ FIXTURES = {
                  logging.exception("f failed")
          """, False, False),
     ],
+    "GL501": [
+        ("""
+         import jax
+         from jax.sharding import Mesh
+         def build():
+             return Mesh(jax.devices(), ("data",))
+         """, False, True),
+        ("""
+         from deeplearning4j_tpu.parallel.mesh import make_mesh
+         def build():
+             return make_mesh()
+         """, False, False),
+    ],
 }
 
 
@@ -412,6 +425,50 @@ def test_allow_rule_comment_block_above():
             step(b)
     """
     assert "GL103" not in rules_of(src)
+
+
+class TestMeshOutsideSpine:
+    """GL501 — placement construction must flow through parallel/mesh.py."""
+
+    def test_jax_attribute_forms_fire(self):
+        src = """
+        import jax
+        import jax.sharding as jsh
+        def build():
+            m = jax.sharding.Mesh(jax.devices(), ("data",))
+            n = jsh.Mesh(jax.local_devices(), ("data",))
+            return m, n
+        """
+        assert rules_of(src).count("GL501") == 4
+
+    def test_spine_module_itself_is_exempt(self):
+        src = """
+        import jax
+        from jax.sharding import Mesh
+        def make_mesh():
+            return Mesh(jax.devices(), ("data",))
+        """
+        for path in ("deeplearning4j_tpu/parallel/mesh.py",
+                     "parallel/mesh.py"):
+            assert rules_of(src, path=path) == []
+
+    def test_non_jax_mesh_or_devices_stay_quiet(self):
+        src = """
+        from mylib import Mesh
+        class Topo:
+            pass
+        def build(t: Topo):
+            return Mesh(t.devices(), ("data",))
+        """
+        assert "GL501" not in rules_of(src)
+
+    def test_allow_with_reason_suppresses(self):
+        src = """
+        import jax
+        def kinds():
+            return jax.devices()[0].device_kind  # graft: allow(GL501): display only
+        """
+        assert rules_of(src) == []
 
 
 def test_allow_wrong_rule_id_does_not_suppress():

@@ -33,19 +33,33 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 BASELINE_PATH = os.path.join(os.path.dirname(__file__), "..",
                              ".graftperf-baseline.json")
-WORKLOAD_VERSION = 1
+WORKLOAD_VERSION = 2
 
 # Default slack written into a fresh baseline: zero extra compiles (a
 # new program IS the regression being hunted) and half a sync of noise
 # headroom per step (threading in test rigs can land one stray
-# block_until_ready).
+# block_until_ready). The sharded leg additionally holds an absolute
+# floor on the optimizer-state sharding factor: moments are sharded
+# across the replica axis BY CONTRACT (PERF_NOTES) — a drop back toward
+# 1.0 means someone replicated them again.
 DEFAULT_BUDGETS = {"extra_compiles_per_owner": 0,
-                   "extra_syncs_per_step": 0.5}
+                   "extra_syncs_per_step": 0.5,
+                   "extra_sharded_syncs_per_step": 0.5,
+                   "min_opt_state_shard_factor": 4.0}
 
 
 def run_workload() -> dict:
     """The deterministic CPU workload; returns the measured profile."""
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    # the sharded leg needs a multi-device mesh; on a fresh process the
+    # CPU runtime can fake one, but only if the flag lands before jax
+    # initializes (an in-process caller with jax already up runs the
+    # single-device legs and reports the sharded leg as skipped)
+    _force = "--xla_force_host_platform_device_count=8"
+    if "jax" not in sys.modules and \
+            _force not in os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "") + " " + _force).strip()
     import numpy as np
 
     from deeplearning4j_tpu.models import MultiLayerNetwork
@@ -106,6 +120,48 @@ def run_workload() -> dict:
         for _ in range(2):
             net.output(x[:8])
 
+        # --- sharded fit: the GSPMD spine (data-sharded batch, replica-
+        # sharded Adam moments). Placement regressions show up here as
+        # extra syncs (collective fell back to host), extra
+        # ParallelWrapper compiles (sharding leaked into the cache key),
+        # or a collapsed opt-state shard factor (moments re-replicated).
+        import jax
+        sharded = None
+        if jax.device_count() >= 8:
+            from deeplearning4j_tpu.observe.devicemon import (
+                tree_device_bytes,
+            )
+            from deeplearning4j_tpu.parallel import ParallelWrapper
+
+            conf = (NeuralNetConfiguration.builder().seed(0)
+                    .updater(Adam(1e-3)).activation("relu")
+                    .list(DenseLayer(n_in=16, n_out=32),
+                          OutputLayer(n_in=32, n_out=4,
+                                      activation="softmax",
+                                      loss="mcxent"))
+                    .build())
+            snet = MultiLayerNetwork(conf).init()
+            wrap = ParallelWrapper(snet)
+            sx = rng.standard_normal((64, 16)).astype("float32")
+            sy = np.eye(4, dtype="float32")[rng.integers(0, 4, 64)]
+            wrap.fit(sx, sy, batch_size=16, epochs=1)   # compile epoch
+            mon = HostSyncMonitor().install()
+            try:
+                wrap.fit(sx, sy, batch_size=16, epochs=2)
+            finally:
+                mon.uninstall()
+            ssteps = 2 * (64 // 16)
+            full = sum(int(leaf.nbytes) for leaf in
+                       jax.tree_util.tree_leaves(snet.updater_state))
+            per_dev = tree_device_bytes(snet.updater_state)
+            mean_dev = sum(per_dev.values()) / max(len(per_dev), 1)
+            sharded = {
+                "devices": jax.device_count(),
+                "syncs_per_step": round(mon.syncs / ssteps, 3),
+                "opt_state_shard_factor": round(full / mean_dev, 2)
+                if mean_dev else 1.0,
+            }
+
         snap = get_watchdog().snapshot()
     finally:
         set_watchdog(prev)
@@ -119,6 +175,7 @@ def run_workload() -> dict:
         "compiles_per_owner": dict(sorted(compiles.items())),
         "total_compiles": snap["total_compiles"],
         "syncs_per_step": round(syncs_per_step, 3),
+        "sharded": sharded,
     }
 
 
@@ -160,6 +217,31 @@ def compare(baseline: dict, measured: dict) -> list:
             f"{baseline.get('syncs_per_step')} (budget "
             f"+{budgets['extra_syncs_per_step']}) — a device->host "
             f"materialization crept into the step loop")
+    # sharded-spine leg: only gated once a baseline recorded it
+    base_sh = baseline.get("sharded")
+    if base_sh:
+        meas_sh = measured.get("sharded")
+        if not meas_sh:
+            breaches.append(
+                "sharded leg did not run (needs a fresh process with "
+                ">=8 forced host devices) but the baseline gates it")
+        else:
+            s_limit = base_sh.get("syncs_per_step", 0.0) + \
+                budgets["extra_sharded_syncs_per_step"]
+            if meas_sh["syncs_per_step"] > s_limit:
+                breaches.append(
+                    f"sharded syncs/step {meas_sh['syncs_per_step']} vs "
+                    f"baseline {base_sh.get('syncs_per_step')} (budget "
+                    f"+{budgets['extra_sharded_syncs_per_step']}) — a "
+                    f"collective or placement fell back to host")
+            floor = budgets["min_opt_state_shard_factor"]
+            if meas_sh["opt_state_shard_factor"] < floor:
+                breaches.append(
+                    f"opt_state_shard_factor "
+                    f"{meas_sh['opt_state_shard_factor']} < floor "
+                    f"{floor} — optimizer moments are sharded across "
+                    f"the replica axis by contract (PERF_NOTES); "
+                    f"replicating them is a regression")
     return breaches
 
 
@@ -175,6 +257,11 @@ def diff(baseline: dict, measured: dict) -> list:
     b, m = baseline.get("syncs_per_step"), measured["syncs_per_step"]
     if b != m:
         out.append(f"  syncs_per_step: {b} -> {m}")
+    for key in ("syncs_per_step", "opt_state_shard_factor"):
+        b = (baseline.get("sharded") or {}).get(key)
+        m = (measured.get("sharded") or {}).get(key)
+        if b != m:
+            out.append(f"  sharded.{key}: {b} -> {m}")
     return out
 
 
