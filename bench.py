@@ -1048,9 +1048,15 @@ def _serving_decode_main():
     and reconciles them against the server's /metrics decode section
     (tokens_streamed, session outcomes, shared-dispatch counters) plus
     the recompile watchdog: after the manager's warmup, session churn
-    must cause ZERO compiles (the fixed-shape decode contract). Emits
-    one JSON line AND writes BENCH_serving_decode.json
-    (BENCH_DECODE_OUT overrides)."""
+    must cause ZERO compiles (the fixed-shape decode contract).
+
+    The workload runs TWICE — once with request tracing off (the
+    zero-allocation baseline) and once with DL4J_TPU_TRACE_SAMPLE=1
+    (every request traced) — so the artifact carries the measured
+    sampled-on overhead (`tracing.trace_overhead_pct`, contract <2%)
+    plus one exemplar trace tree (`trace`, renderable with
+    tools/trace_view.py). Emits one JSON line AND writes
+    BENCH_serving_decode.json (BENCH_DECODE_OUT overrides)."""
     import jax
 
     if not os.environ.get("BENCH_SERVING_TPU"):
@@ -1103,6 +1109,7 @@ def _serving_decode_main():
     lock = threading.Lock()
     ttfts, itls, tok_total, done_sessions = [], [], [0], [0]
     errors = []
+    trace_ids = []
 
     def one_generation(seed):
         body = json.dumps({
@@ -1120,6 +1127,10 @@ def _serving_decode_main():
                 if not line.startswith("data: "):
                     continue
                 ev = json.loads(line[6:])
+                tid = ev.get("trace_id")
+                if tid:
+                    with lock:
+                        trace_ids.append(tid)
                 if "token" in ev:
                     now = time.perf_counter()
                     if first is None:
@@ -1146,18 +1157,40 @@ def _serving_decode_main():
             with lock:
                 errors.append(f"{type(e).__name__}: {e}")
 
-    threads = [threading.Thread(target=client, args=(i,))
-               for i in range(clients)]
-    t0 = time.monotonic()
-    for t in threads:
-        t.start()
-    for t in threads:
-        t.join()
-    wall = time.monotonic() - t0
+    def run_pass():
+        threads = [threading.Thread(target=client, args=(i,))
+                   for i in range(clients)]
+        t_p = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return time.monotonic() - t_p
+
+    # pass 1: sampling off — the zero-allocation fast path
+    prev_sample = os.environ.pop("DL4J_TPU_TRACE_SAMPLE", None)
+    wall_off = run_pass()
+    toks_off = tok_total[0]
+    # pass 2: every request traced — measures the sampled-on tax
+    os.environ["DL4J_TPU_TRACE_SAMPLE"] = "1"
+    try:
+        wall_on = run_pass()
+    finally:
+        if prev_sample is None:
+            os.environ.pop("DL4J_TPU_TRACE_SAMPLE", None)
+        else:
+            os.environ["DL4J_TPU_TRACE_SAMPLE"] = prev_sample
+    toks_on = tok_total[0] - toks_off
+    wall = wall_off + wall_on
     compile_delta = get_watchdog().compiles() - compiles_after_warmup
 
     with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
         metrics = json.loads(r.read())
+    trace_block = None
+    if trace_ids:
+        with urllib.request.urlopen(
+                base + "/trace/" + trace_ids[-1], timeout=10) as r:
+            trace_block = json.loads(r.read())
     srv.stop()
     decode = metrics["decode"]["default"]
 
@@ -1189,6 +1222,19 @@ def _serving_decode_main():
         "shared_dispatches": decode["dispatches"]["shared"],
         "interleaved": decode["dispatches"]["shared"] > 0,
         "errors": errors,
+        "tracing": {
+            "pass_off": {"tokens": toks_off,
+                         "duration_s": round(wall_off, 3),
+                         "tokens_per_s": round(toks_off / wall_off, 2)},
+            "pass_on": {"tokens": toks_on,
+                        "duration_s": round(wall_on, 3),
+                        "tokens_per_s": round(toks_on / wall_on, 2)},
+            "trace_overhead_pct": round(
+                (1 - (toks_on / wall_on) / (toks_off / wall_off)) * 100,
+                2) if toks_off and toks_on else None,
+            "traces_sampled": len(trace_ids),
+        },
+        "trace": trace_block,
         "registry": _registry_snapshot(),
     }
     dev = jax.devices()[0]

@@ -80,6 +80,10 @@ _MUTATOR_METHODS = (
 )
 _LOG_METHODS = ("debug", "info", "warning", "warn", "error", "exception",
                 "critical", "log")
+# span-emitting callables (observe.trace / observe.reqtrace) whose kwargs
+# are span attributes — GL601 requires those to be host scalars
+_SPAN_EMITTERS = ("span", "emit_manual_span", "record_span",
+                  "error_trace", "finish_root", "end_dispatch")
 _LOCK_CLASSES = ("Lock", "RLock", "Condition", "Semaphore",
                  "BoundedSemaphore")
 
@@ -951,6 +955,32 @@ class _FileLinter:
                            "— device topology belongs to the spine; use "
                            "parallel.mesh.device_count() or the active "
                            "MeshContext")
+
+        # GL601 — tracer/device values as span or exemplar attributes.
+        # The span machinery (observe.trace / observe.reqtrace) promises
+        # zero syncs: attrs are host scalars, stringified without
+        # touching device buffers. A device value handed to a span
+        # emitter (or an exemplar=) defeats that contract at the call
+        # site — inside a trace it concretizes the tracer outright.
+        if term in _SPAN_EMITTERS or term == "observe":
+            attr_vals = [k.value for k in node.keywords
+                         if k.arg is not None
+                         and (term != "observe" or k.arg == "exemplar")]
+            for v in attr_vals:
+                if ctx.traced and self._tainted(v, ctx):
+                    self._emit("GL601", node,
+                               f"tracer-derived value as a {term}() "
+                               "attribute inside a traced function — "
+                               "span attrs must be host scalars")
+                    break
+                if not ctx.traced and self.hot \
+                        and self._devicey(v, ctx):
+                    self._emit("GL601", node,
+                               f"device value as a {term}() attribute "
+                               "forces a device→host sync on the "
+                               "telemetry path — pass a host scalar "
+                               "(the sync-free span contract)")
+                    break
 
         # GL301 — mutating method calls on self attrs
         if (isinstance(func, ast.Attribute)

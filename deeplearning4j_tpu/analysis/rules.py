@@ -36,6 +36,7 @@ CAT_SYNC = "sync"
 CAT_LOCK = "lock"
 CAT_HYGIENE = "hygiene"
 CAT_SHARDING = "sharding"
+CAT_OBSERVE = "observe"
 
 
 @dataclass(frozen=True)
@@ -119,6 +120,13 @@ _ALL = (
          "from the MeshContext the executor threads through training; "
          "build meshes via parallel.mesh.make_mesh()/MeshContext and read "
          "device topology via parallel.mesh.device_count()"),
+    # ------------------------------------------------ observability safety
+    Rule("GL601", "span-attr-device-taint", CAT_OBSERVE, WARNING,
+         "tracer- or device-derived value passed as a span/exemplar "
+         "attribute (span(...)/record_span(...)/observe(exemplar=...)) — "
+         "inside a traced function it concretizes the tracer; in a hot "
+         "module it forces a device→host sync on the telemetry path, "
+         "breaking the sync-free span contract; pass host scalars only"),
 )
 
 RULES: Dict[str, Rule] = {r.id: r for r in _ALL}
@@ -130,6 +138,7 @@ RULES: Dict[str, Rule] = {r.id: r for r in _ALL}
 RUNTIME_RULE_HINTS: Dict[str, Tuple[str, ...]] = {
     "recompile": ("GL101", "GL102", "GL103"),
     "host_sync": ("GL001", "GL002", "GL201", "GL202", "GL203"),
+    "span_taint": ("GL601",),
 }
 
 

@@ -24,6 +24,10 @@ every layer shares:
   `PerformanceListener` can report syncs/step in production.
 - `python -m deeplearning4j_tpu.observe.dump` (`dump.py`) — pretty-print
   a registry snapshot or tail a span JSONL.
+- `reqtrace.py` — request-scoped causal trace trees (TraceContext at the
+  HTTP edge, fan-in dispatch spans, per-step session spans, training
+  dispatch windows) with head-based sampling and a bounded TraceStore;
+  served by `GET /trace/{id}` and embedded in flight dumps.
 
 The package imports only the stdlib (no jax) so the dump tool and the
 registry work anywhere; jax seams are bound lazily at install time.
@@ -50,6 +54,11 @@ from deeplearning4j_tpu.observe.devicemon import (
 from deeplearning4j_tpu.observe.attribution import (
     StepAttribution, attribution_enabled,
 )
+from deeplearning4j_tpu.observe.reqtrace import (
+    TraceContext, TraceStore, active_dispatch, begin_dispatch,
+    current_trace, end_dispatch, error_extra, error_trace, finish_root,
+    get_trace_store, new_trace, record_span, set_trace_store,
+)
 
 __all__ = [
     "MetricsRegistry", "get_registry", "set_registry",
@@ -61,4 +70,7 @@ __all__ = [
     "DeviceMonitor", "device_memory_summary", "get_device_monitor",
     "maybe_start_monitor", "set_device_monitor",
     "StepAttribution", "attribution_enabled",
+    "TraceContext", "TraceStore", "get_trace_store", "set_trace_store",
+    "new_trace", "finish_root", "record_span", "error_trace", "error_extra",
+    "current_trace", "begin_dispatch", "active_dispatch", "end_dispatch",
 ]

@@ -30,6 +30,9 @@ Env knobs:
   DL4J_TPU_FLIGHT=0           disable entirely (record/dump no-ops)
   DL4J_TPU_FLIGHT_CAP=256     ring capacity (events)
   DL4J_TPU_FLIGHT_DIR=<dir>   dump directory (default: tempdir)
+  DL4J_TPU_FLIGHT_KEEP=20     retained dumps in the dir (newest N kept;
+                              always-on crash dumps can't fill the disk)
+  DL4J_TPU_FLIGHT_TRACES=8    sampled request traces embedded per dump
 
 Stdlib-only at import time (the observe package contract); jax-touching
 enrichment (device sample) is imported lazily inside `dump()` and is
@@ -52,6 +55,8 @@ from typing import Any, Dict, List, Optional
 logger = logging.getLogger("deeplearning4j_tpu")
 
 DEFAULT_CAPACITY = 256
+DEFAULT_KEEP = 20        # retained dumps per directory (newest kept)
+DEFAULT_TRACES = 8       # request-trace trees embedded in each dump
 _PLAIN = (str, int, float, bool, type(None))
 _MAX_DEPTH = 4          # payload sanitizer bounds: a flight event must
 _MAX_ITEMS = 32         # stay cheap to record and safe to json.dumps
@@ -158,7 +163,8 @@ class FlightRecorder:
             for key, fn in (("registry", self._registry_snapshot),
                             ("watchdog", self._watchdog_snapshot),
                             ("syncmon", self._syncmon_snapshot),
-                            ("devices", self._device_sample)):
+                            ("devices", self._device_sample),
+                            ("traces", self._traces_snapshot)):
                 try:
                     doc[key] = fn()
                 except Exception:
@@ -177,6 +183,7 @@ class FlightRecorder:
             os.replace(tmp, path)     # atomic: a reader never sees half
             with self._lock:
                 self.dumps.append(path)
+            self._prune_dumps()
             self.record("flight_dump", reason=reason, path=path)
             logger.info("FlightRecorder: wrote %d events to %s "
                         "(reason: %s)", len(doc["events"]), path, reason)
@@ -185,7 +192,51 @@ class FlightRecorder:
             logger.debug("FlightRecorder: dump failed", exc_info=True)
             return None
 
+    def _prune_dumps(self) -> None:
+        """Dump-dir hygiene: keep the newest DL4J_TPU_FLIGHT_KEEP
+        `flight_*.json` artifacts (any process), delete the rest. Runs
+        after every successful dump; best-effort like dump() itself."""
+        try:
+            keep = int(os.environ.get("DL4J_TPU_FLIGHT_KEEP",
+                                      str(DEFAULT_KEEP)))
+        except ValueError:
+            keep = DEFAULT_KEEP
+        if keep <= 0:
+            return
+        try:
+            names = os.listdir(self.dump_dir)
+        except OSError:
+            return
+        cands = []
+        for n in names:
+            if not (n.startswith("flight_") and n.endswith(".json")):
+                continue
+            p = os.path.join(self.dump_dir, n)
+            try:
+                cands.append((os.path.getmtime(p), n, p))
+            except OSError:
+                continue   # raced with another pruner
+        # name is the tiebreak for same-second dumps: the seq counter in
+        # the filename sorts newer dumps later
+        cands.sort(reverse=True)
+        for _, _, p in cands[keep:]:
+            try:
+                os.remove(p)
+            except OSError:
+                continue   # raced with another pruner
+
     # dump enrichment — each is best-effort and individually guarded
+    @staticmethod
+    def _traces_snapshot():
+        from deeplearning4j_tpu.observe.reqtrace import get_trace_store
+        try:
+            k = int(os.environ.get("DL4J_TPU_FLIGHT_TRACES",
+                                   str(DEFAULT_TRACES)))
+        except ValueError:
+            k = DEFAULT_TRACES
+        trees = get_trace_store().last_trees(k)
+        return trees or None
+
     @staticmethod
     def _registry_snapshot():
         from deeplearning4j_tpu.observe.registry import get_registry

@@ -128,7 +128,7 @@ class Histogram:
 
     kind = "histogram"
     __slots__ = ("name", "labels", "_lock", "_reservoir", "count", "sum",
-                 "_min", "_max")
+                 "_min", "_max", "_exemplars")
 
     def __init__(self, name: str, labels, reservoir: int = 4096):
         self.name = name
@@ -139,8 +139,12 @@ class Histogram:
         self.sum = 0.0
         self._min: Optional[float] = None
         self._max: Optional[float] = None
+        # OpenMetrics-style exemplars: recent observations that carry a
+        # trace id, so a tail percentile can be joined back to the exact
+        # request tree in the trace store (GET /trace/{id}).
+        self._exemplars: deque = deque(maxlen=8)
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float, *, exemplar: Optional[str] = None) -> None:
         v = float(v)
         with self._lock:
             self.count += 1
@@ -150,6 +154,20 @@ class Histogram:
                 self._min = v
             if self._max is None or v > self._max:
                 self._max = v
+            if exemplar is not None:
+                self._exemplars.append({"value": v,
+                                        "trace_id": str(exemplar),
+                                        "ts": round(time.time(), 3)})
+
+    def exemplars(self) -> List[dict]:
+        with self._lock:
+            return [dict(e) for e in self._exemplars]
+
+    def tail_exemplar(self) -> Optional[dict]:
+        """The exemplar with the largest value in the window — the one
+        the p99 quantile line links to."""
+        exs = self.exemplars()
+        return max(exs, key=lambda e: e["value"]) if exs else None
 
     def values(self) -> List[float]:
         """Copy of the current reservoir (reader-side percentile math)."""
@@ -171,6 +189,9 @@ class Histogram:
         out = {"count": count, "sum": total, "min": lo, "max": hi,
                "window": window}
         out.update(self.percentiles())
+        exs = self.exemplars()
+        if exs:
+            out["exemplars"] = exs
         return out
 
 
@@ -239,7 +260,10 @@ class MetricsRegistry:
         """Prometheus text exposition (format version 0.0.4).
 
         Counters/gauges render natively; histograms render as summaries
-        (quantiles from the bounded reservoir + exact _count/_sum)."""
+        (quantiles from the bounded reservoir + exact _count/_sum).
+        Histograms that carry exemplars append OpenMetrics-style
+        `# {trace_id="..."} value ts` suffixes: the tail (max-value)
+        exemplar on the 0.99 quantile line, the latest on _count."""
         by_name: Dict[str, list] = {}
         for inst in self.series():
             by_name.setdefault(inst.name, []).append(inst)
@@ -253,17 +277,29 @@ class MetricsRegistry:
             for inst in insts:
                 lab = inst.labels
                 if inst.kind == "histogram":
+                    tail = inst.tail_exemplar()
                     for q in (0.5, 0.95, 0.99):
                         p = inst.percentiles((q,))[f"p{int(q * 100)}"]
                         if p is None:
                             continue
                         qlab = lab + (("quantile", str(q)),)
-                        lines.append(
-                            f"{pname}{_prom_labels(qlab)} {_prom_value(p)}")
+                        line = f"{pname}{_prom_labels(qlab)} {_prom_value(p)}"
+                        if q == 0.99 and tail is not None:
+                            line += (f' # {{trace_id="{tail["trace_id"]}"}}'
+                                     f' {_prom_value(tail["value"])}'
+                                     f' {tail["ts"]}')
+                        lines.append(line)
                     lines.append(f"{pname}_sum{_prom_labels(lab)} "
                                  f"{_prom_value(inst.sum)}")
-                    lines.append(f"{pname}_count{_prom_labels(lab)} "
-                                 f"{_prom_value(inst.count)}")
+                    count_line = (f"{pname}_count{_prom_labels(lab)} "
+                                  f"{_prom_value(inst.count)}")
+                    exs = inst.exemplars()
+                    if exs:
+                        last = exs[-1]
+                        count_line += (
+                            f' # {{trace_id="{last["trace_id"]}"}}'
+                            f' {_prom_value(last["value"])} {last["ts"]}')
+                    lines.append(count_line)
                 else:
                     lines.append(
                         f"{pname}{_prom_labels(lab)} "

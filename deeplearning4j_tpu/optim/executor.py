@@ -31,7 +31,7 @@ from __future__ import annotations
 import time
 from typing import Any, Callable, List, Optional
 
-from deeplearning4j_tpu.observe import get_registry, span
+from deeplearning4j_tpu.observe import get_registry, reqtrace, span
 from deeplearning4j_tpu.observe.attribution import (
     StepAttribution, attribution_enabled,
 )
@@ -186,6 +186,9 @@ class TrainingExecutor:
         self.epoch_end = epoch_end
         self.stopped = False
         self._attr: Optional[StepAttribution] = None
+        # per-epoch request trace (reqtrace) — None when sampling is off,
+        # so the hot loop pays one attribute read per dispatch window
+        self._rt = None
         reg = get_registry()
         self._iter_counter = reg.counter("train_iterations")
         self._etl_hist = reg.histogram("train_etl_ms")
@@ -228,6 +231,10 @@ class TrainingExecutor:
                     l.on_fit_start(net)
                 self.stopped = False
                 for _ in range(start_epoch, epochs):
+                    ep = net.epoch
+                    # one sampled trace per epoch: dispatch windows hang
+                    # off this root (trace ids key on (epoch, window))
+                    self._rt = reqtrace.new_trace("train.epoch")
                     with span("fit.epoch", epoch=net.epoch):
                         if self.epoch_start is not None:
                             self.epoch_start()
@@ -265,12 +272,14 @@ class TrainingExecutor:
                                 loss = self.step(ds)
                                 dispatch_ms = (time.perf_counter()
                                                - t_d) * 1e3
+                                self._trace_window(bi, bi, dispatch_ms)
                                 self._finish(bi, loss, etl_ms, dispatch_ms)
                                 if self.after_dispatch is not None:
                                     self.after_dispatch(bi)
                             etl_start = time.perf_counter()
                         self._drain(buf)
                         if self.stopped:
+                            self._finish_epoch_trace(ep, stopped=True)
                             break
                         for l in listeners:
                             l.on_epoch_end(net, net.epoch)
@@ -282,9 +291,13 @@ class TrainingExecutor:
                         # without per-step syncs — and the block boundary
                         # attribution infers device time from
                         net._loss_tracker.materialize()
+                    self._finish_epoch_trace(ep)
                 for l in listeners:
                     l.on_fit_end(net)
         except BaseException as e:
+            # close the epoch trace first so the flight dump's trace
+            # block carries the crashed epoch's dispatch windows
+            self._finish_epoch_trace(net.epoch, error=type(e).__name__)
             # the crash the flight recorder exists for: dump the ring
             # (recent spans, compiles, device memory) next to the error
             flight.dump("training_exception", exc=e)
@@ -295,6 +308,27 @@ class TrainingExecutor:
         return net
 
     # ---------------------------------------------------------- helpers
+    def _finish_epoch_trace(self, epoch: int, **attrs) -> None:
+        """Close the per-epoch trace root (None-safe; resets _rt)."""
+        rt, self._rt = self._rt, None
+        reqtrace.finish_root(rt, epoch=epoch, iteration=self.net.iteration,
+                             steps_per_dispatch=self.k, **attrs)
+
+    def _trace_window(self, bi_lo: int, bi_hi: int, dur_ms: float,
+                      fused: bool = False) -> None:
+        """Record one train.dispatch span keyed (epoch, step-window).
+
+        dur_ms is the host ENQUEUE time for the window — never a device
+        wait, so the span machinery stays sync-free."""
+        rt = self._rt
+        if rt is None:
+            return
+        ep = self.net.epoch
+        reqtrace.record_span(
+            rt.trace_id, "train.dispatch", parent_id=rt.span_id,
+            dur_ms=dur_ms, epoch=ep, window=f"{ep}:{bi_lo}-{bi_hi}",
+            steps=bi_hi - bi_lo + 1, fused=fused)
+
     def _drain(self, buf) -> None:
         """Flush a partial fusion buffer through the per-step path (a
         short tail would need its own K'-sized compile)."""
@@ -302,6 +336,7 @@ class TrainingExecutor:
             t_d = time.perf_counter()
             loss = self.step(ds)
             dispatch_ms = (time.perf_counter() - t_d) * 1e3
+            self._trace_window(bi, bi, dispatch_ms)
             self._finish(bi, loss, etl_ms, dispatch_ms)
             if self.after_dispatch is not None:
                 self.after_dispatch(bi)
@@ -311,6 +346,8 @@ class TrainingExecutor:
         losses = self.fused_step([ds for _, ds, _ in buf])
         # one dispatch for K steps: attribute its enqueue cost evenly
         dispatch_ms = (time.perf_counter() - t_d) * 1e3 / len(buf)
+        self._trace_window(buf[0][0], buf[-1][0],
+                           dispatch_ms * len(buf), fused=True)
         for j, (bi, ds, etl_ms) in enumerate(buf):
             # losses[j] stays on device — indexing does not sync
             self._finish(bi, losses[j], etl_ms, dispatch_ms)

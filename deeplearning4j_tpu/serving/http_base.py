@@ -91,12 +91,20 @@ class JsonHttpServer:
     def get_routes(self) -> Dict[str, Callable[[], dict]]:
         return {"/healthz": lambda: {"status": "ok"}}
 
+    def get_prefix_routes(self) -> Dict[str, Callable[[str, dict], dict]]:
+        """Path-parameter GET routes, consulted after an exact-route
+        miss: `{"/trace/": fn}` serves `/trace/<id>` with
+        fn(suffix, request). Longest prefix wins."""
+        return {}
+
     def post_routes(self) -> Dict[str, Callable[[dict], dict]]:
         return {}
 
     def start(self) -> int:
         gets = self.get_routes()
         get_arity = {path: _wants_request(fn) for path, fn in gets.items()}
+        prefixes = sorted(self.get_prefix_routes().items(),
+                          key=lambda kv: -len(kv[0]))
         posts = self.post_routes()
 
         class Handler(BaseHTTPRequestHandler):
@@ -122,10 +130,20 @@ class JsonHttpServer:
             def do_GET(self):
                 path, _, query = self.path.partition("?")
                 fn = gets.get(path)
+                suffix = prefix_fn = None
                 if fn is None:
-                    return self._json(404, {"error": "not found"})
+                    for pre, pfn in prefixes:
+                        if path.startswith(pre):
+                            prefix_fn, suffix = pfn, path[len(pre):]
+                            break
+                    if prefix_fn is None:
+                        return self._json(404, {"error": "not found"})
                 try:
-                    if get_arity[path]:
+                    if prefix_fn is not None:
+                        out = prefix_fn(suffix,
+                                        {"query": parse_qs(query),
+                                         "headers": self.headers})
+                    elif get_arity[path]:
                         out = fn({"query": parse_qs(query),
                                   "headers": self.headers})
                     else:

@@ -40,6 +40,12 @@ backpressure semantics, and an observability surface.
                  reports them, live-array counts everywhere)
   GET  /flight   → the FlightRecorder ring: recent spans/compiles/
                  device samples plus paths of any crash dumps written
+  GET  /trace/{id} → reconstructed span tree for one sampled request
+                 (HTTP root → queue.wait → shared dispatch →
+                 session.step leaves); `GET /trace/` lists stored ids.
+                 Sampling: DL4J_TPU_TRACE_SAMPLE rate at the edge;
+                 shed/expired/worker-crash requests always trace, and
+                 error payloads carry their `trace_id`.
 
 Dispatch modes:
   batched=True,  scheduler="continuous"  (default) — the
@@ -58,6 +64,7 @@ from typing import Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.observe import reqtrace
 from deeplearning4j_tpu.observe.registry import PROMETHEUS_CONTENT_TYPE
 from deeplearning4j_tpu.parallel.inference import InferenceMode
 from deeplearning4j_tpu.serving.http_base import (
@@ -175,39 +182,65 @@ class InferenceServer(JsonHttpServer):
                 raise HttpError(400, "deadline_ms must be a number")
         return x, model, deadline_ms
 
+    @staticmethod
+    def _trace_extra(rt) -> dict:
+        return {"trace_id": rt.trace_id} if rt is not None else {}
+
     def _output(self, req: dict):
         x, model, deadline_ms = self._parse(req)
+        # the trace is born at the HTTP edge: rt is None on the
+        # sampled-off fast path and every seam below only pays an
+        # `is None` check
+        rt = reqtrace.new_trace("http.output")
+        try:
+            y, version = self._output_dispatch(model, x, deadline_ms, rt)
+        except HttpError as e:
+            reqtrace.finish_root(rt, route="/output", model=model,
+                                 status=e.status)
+            if rt is not None:
+                e.payload.setdefault("trace_id", rt.trace_id)
+            raise
+        out = {"output": np.asarray(y).tolist(), "model": model,
+               "version": version}
+        if rt is not None:
+            reqtrace.finish_root(rt, route="/output", model=model,
+                                 status=200, rows=int(x.shape[0]))
+            out["trace_id"] = rt.trace_id
+        return out
+
+    def _output_dispatch(self, model, x, deadline_ms, rt):
         if self.mode == "continuous":
             try:
-                fut = self.scheduler.submit(model, x, deadline_ms)
+                fut = self.scheduler.submit(model, x, deadline_ms,
+                                            trace=rt)
                 y = fut.result()
-                version = getattr(fut, "version", None)
+                return y, getattr(fut, "version", None)
             except RequestShedError as e:
-                raise HttpError(503, f"shed: {e}")
+                raise HttpError(503, f"shed: {e}",
+                                **reqtrace.error_extra(e))
             except DeadlineExceededError as e:
-                raise HttpError(504, f"deadline exceeded: {e}")
+                raise HttpError(504, f"deadline exceeded: {e}",
+                                **reqtrace.error_extra(e))
             except SchedulerClosedError as e:
                 raise HttpError(503, f"draining: {e}")
             except KeyError:
                 raise HttpError(400, f"unknown model: {model!r}")
-        else:
-            t0 = time.monotonic()
-            try:
-                entry = self.registry.acquire(model)
-            except KeyError:
-                raise HttpError(400, f"unknown model: {model!r}")
-            self.stats.admitted(model)
-            try:
-                y = entry.output(x)
-                version = entry.version
-            except BaseException:
-                self.stats.completed(model, 0.0, ok=False)
-                raise
-            finally:
-                self.registry.release(entry)
-            self.stats.completed(model, time.monotonic() - t0)
-        return {"output": np.asarray(y).tolist(), "model": model,
-                "version": version}
+        t0 = time.monotonic()
+        try:
+            entry = self.registry.acquire(model)
+        except KeyError:
+            raise HttpError(400, f"unknown model: {model!r}")
+        self.stats.admitted(model)
+        try:
+            y = entry.output(x)
+            version = entry.version
+        except BaseException:
+            self.stats.completed(model, 0.0, ok=False)
+            raise
+        finally:
+            self.registry.release(entry)
+        self.stats.completed(model, time.monotonic() - t0)
+        return y, version
 
     def _generate(self, req: dict):
         """Stateful decode: open a session, stream its tokens. With
@@ -231,33 +264,60 @@ class InferenceServer(JsonHttpServer):
                     kw[field] = cast(req[field])
                 except (TypeError, ValueError):
                     raise HttpError(400, f"bad {field}: {req[field]!r}")
+        rt = reqtrace.new_trace("http.generate")
         try:
-            sess = mgr.open_session(prompt, **kw)
+            sess = mgr.open_session(prompt, trace=rt, **kw)
         except SlotPoolExhaustedError as e:
-            raise HttpError(503, f"no free decode slot: {e}")
+            reqtrace.finish_root(rt, route="/generate", status=503)
+            raise HttpError(503, f"no free decode slot: {e}",
+                            **self._trace_extra(rt))
         except SchedulerClosedError as e:
-            raise HttpError(503, f"draining: {e}")
+            reqtrace.finish_root(rt, route="/generate", status=503)
+            raise HttpError(503, f"draining: {e}", **self._trace_extra(rt))
         except (TypeError, ValueError) as e:
-            raise HttpError(400, str(e))
+            reqtrace.finish_root(rt, route="/generate", status=400)
+            raise HttpError(400, str(e), **self._trace_extra(rt))
         if req.get("stream", True):
             def events():
                 try:
-                    yield {"session": sess.id, "model": model}
+                    first = {"session": sess.id, "model": model}
+                    if rt is not None:
+                        first["trace_id"] = rt.trace_id
+                    yield first
                     for ev in sess.stream():
                         yield ev
                 finally:
                     # client disconnect lands here as GeneratorExit
                     if not sess.done.is_set():
                         sess.cancel()
+                    reqtrace.finish_root(
+                        rt, route="/generate", model=model,
+                        session=sess.id, tokens=len(sess.generated),
+                        outcome=sess.outcome)
             return StreamResponse(events())
         try:
             tokens = sess.result()
         except DeadlineExceededError as e:
-            raise HttpError(504, f"deadline exceeded: {e}")
+            reqtrace.finish_root(rt, route="/generate", model=model,
+                                 session=sess.id, status=504)
+            raise HttpError(504, f"deadline exceeded: {e}",
+                            **(reqtrace.error_extra(e)
+                               or self._trace_extra(rt)))
         except (RequestShedError, SchedulerClosedError) as e:
-            raise HttpError(503, str(e))
-        return {"session": sess.id, "model": model, "tokens": tokens,
-                "outcome": sess.outcome, "ttft_ms": sess.ttft_ms}
+            reqtrace.finish_root(rt, route="/generate", model=model,
+                                 session=sess.id, status=503)
+            raise HttpError(503, str(e),
+                            **(reqtrace.error_extra(e)
+                               or self._trace_extra(rt)))
+        out = {"session": sess.id, "model": model, "tokens": tokens,
+               "outcome": sess.outcome, "ttft_ms": sess.ttft_ms}
+        if rt is not None:
+            reqtrace.finish_root(rt, route="/generate", model=model,
+                                 session=sess.id, status=200,
+                                 tokens=len(tokens),
+                                 outcome=sess.outcome)
+            out["trace_id"] = rt.trace_id
+        return out
 
     def _generate_cancel(self, req: dict):
         model = req.get("model", DEFAULT_MODEL)
@@ -318,11 +378,29 @@ class InferenceServer(JsonHttpServer):
 
         return get_flight().snapshot()
 
+    def _trace_list(self):
+        store = reqtrace.get_trace_store()
+        ids = store.ids()
+        return {"traces": ids[-50:], "count": len(ids),
+                "sample_rate": reqtrace.sample_rate()}
+
+    def _trace(self, suffix: str, request=None):
+        tid = suffix.strip("/")
+        if not tid:
+            return self._trace_list()
+        tree = reqtrace.get_trace_store().tree(tid)
+        if tree is None:
+            raise HttpError(404, f"unknown trace: {tid!r}")
+        return tree
+
     def get_routes(self):
         return {"/healthz": self._healthz, "/metrics": self._metrics,
                 "/models": lambda: {"models": self.registry.summary()},
                 "/devices": self._devices, "/flight": self._flight,
-                "/sessions": self._sessions}
+                "/sessions": self._sessions, "/trace": self._trace_list}
+
+    def get_prefix_routes(self):
+        return {"/trace/": self._trace}
 
     def post_routes(self):
         return {"/output": self._output, "/generate": self._generate,

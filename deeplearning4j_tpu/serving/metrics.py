@@ -89,11 +89,14 @@ class ServingStats:
     def expired(self, model: str):
         self._m(model).outcomes["expired"].inc()
 
-    def completed(self, model: str, latency_s: float, ok: bool = True):
+    def completed(self, model: str, latency_s: float, ok: bool = True,
+                  trace_id: Optional[str] = None):
         s = self._m(model)
         if ok:
             s.outcomes["completed"].inc()
-            s.latency.observe(latency_s)
+            # sampled requests stamp an exemplar so a tail latency in
+            # /metrics links back to its trace tree (GET /trace/{id})
+            s.latency.observe(latency_s, exemplar=trace_id)
         else:
             s.outcomes["failed"].inc()
 

@@ -41,6 +41,7 @@ from typing import Dict, Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.observe import reqtrace
 from deeplearning4j_tpu.serving.metrics import ServingStats
 
 
@@ -83,9 +84,9 @@ class _WorkerCrashed(BaseException):
 
 class _Request:
     __slots__ = ("x", "fut", "model", "deadline", "t_enqueue", "ctx",
-                 "seq_key")
+                 "seq_key", "trace", "t_wall")
 
-    def __init__(self, x, fut, model, deadline, ctx, seq_key):
+    def __init__(self, x, fut, model, deadline, ctx, seq_key, trace=None):
         self.x = x
         self.fut = fut
         self.model = model
@@ -93,6 +94,10 @@ class _Request:
         self.t_enqueue = time.monotonic()
         self.ctx = ctx
         self.seq_key = seq_key
+        # request-trace seam: None on the sampled-off fast path (no span
+        # objects allocated); t_wall anchors the queue.wait span
+        self.trace = trace
+        self.t_wall = time.time() if trace is not None else 0.0
 
 
 class ContinuousBatchingScheduler:
@@ -153,15 +158,25 @@ class ContinuousBatchingScheduler:
             return self._depth
 
     def submit(self, model: str, x,
-               deadline_ms: Optional[float] = None) -> Future:
+               deadline_ms: Optional[float] = None, *,
+               trace=None) -> Future:
         """Admit one request; returns a Future resolving to the output
         rows. Raises RequestShedError / DeadlineExceededError /
-        SchedulerClosedError per the admission contract."""
+        SchedulerClosedError per the admission contract.
+
+        `trace` carries the request's TraceContext across the admission
+        seam (decode sessions resubmit from scheduler worker threads, so
+        the contextvar carrier alone is not enough); when omitted, the
+        edge's `reqtrace.current_trace()` is picked up. Shed / expired
+        requests are force-traced regardless of the sampling rate and
+        the trace id is stamped on the raised exception."""
         x = np.asarray(x)
         now = time.monotonic()
         dl_s = (deadline_ms / 1e3 if deadline_ms is not None
                 else self.default_deadline)
         deadline = now + dl_s if dl_s is not None else None
+        if trace is None:
+            trace = reqtrace.current_trace()
 
         from deeplearning4j_tpu.parallel.ring_attention import (
             current_sequence_mesh,
@@ -173,9 +188,13 @@ class ContinuousBatchingScheduler:
             if self._depth >= self.capacity:
                 if self.policy == AdmissionPolicy.SHED:
                     self.stats.shed(model)
-                    raise RequestShedError(
+                    err = RequestShedError(
                         f"admission queue full "
                         f"({self._depth}/{self.capacity})")
+                    err.trace_id = reqtrace.error_trace(
+                        "request.shed", ctx=trace, model=model,
+                        queue_depth=self._depth, capacity=self.capacity)
+                    raise err
                 limit = now + self.block_timeout
                 if deadline is not None:
                     limit = min(limit, deadline)
@@ -185,18 +204,27 @@ class ContinuousBatchingScheduler:
                         if (deadline is not None
                                 and time.monotonic() >= deadline):
                             self.stats.expired(model)
-                            raise DeadlineExceededError(
+                            err = DeadlineExceededError(
                                 "deadline passed waiting for admission")
+                            err.trace_id = reqtrace.error_trace(
+                                "request.expired", ctx=trace, model=model,
+                                where="admission")
+                            raise err
                         self.stats.shed(model)
-                        raise RequestShedError(
+                        err = RequestShedError(
                             f"admission blocked > {self.block_timeout}s")
+                        err.trace_id = reqtrace.error_trace(
+                            "request.shed", ctx=trace, model=model,
+                            queue_depth=self._depth,
+                            blocked_s=round(self.block_timeout, 3))
+                        raise err
                     self._cv.wait(remaining)
                 if self._closed:
                     raise SchedulerClosedError("scheduler is shut down")
             fut: Future = Future()
             req = _Request(x, fut, model, deadline,
                            contextvars.copy_context(),
-                           current_sequence_mesh())
+                           current_sequence_mesh(), trace)
             self._queues.setdefault(model, deque()).append(req)
             self._depth += 1
             self.stats.admitted(model)
@@ -293,10 +321,15 @@ class ContinuousBatchingScheduler:
                 pass
             if streak[0] > self.max_worker_restarts:
                 for r in batch:
+                    exc = WorkerCrashError(
+                        f"worker crashed {streak[0]} consecutive "
+                        f"times holding this batch: {cause!r}")
+                    exc.trace_id = reqtrace.error_trace(
+                        "request.worker_crash", ctx=r.trace,
+                        model=r.model, crashes=streak[0],
+                        cause=type(cause).__name__)
                     if not r.fut.done():
-                        r.fut.set_exception(WorkerCrashError(
-                            f"worker crashed {streak[0]} consecutive "
-                            f"times holding this batch: {cause!r}"))
+                        r.fut.set_exception(exc)
                     self.stats.completed(r.model, 0.0, ok=False)
                 streak[0] = 0
                 backoff = self.worker_restart_backoff
@@ -366,10 +399,14 @@ class ContinuousBatchingScheduler:
             if r.deadline is not None and now >= r.deadline:
                 # expired while queued: never ship it to the device
                 self.stats.expired(r.model)
+                exc = DeadlineExceededError(
+                    f"deadline exceeded after "
+                    f"{now - r.t_enqueue:.3f}s in queue")
+                exc.trace_id = reqtrace.error_trace(
+                    "request.expired", ctx=r.trace, model=r.model,
+                    where="queue", queue_s=round(now - r.t_enqueue, 3))
                 if not r.fut.done():
-                    r.fut.set_exception(DeadlineExceededError(
-                        f"deadline exceeded after "
-                        f"{now - r.t_enqueue:.3f}s in queue"))
+                    r.fut.set_exception(exc)
                 continue
             live.append(r)
         if not live:
@@ -383,13 +420,30 @@ class ContinuousBatchingScheduler:
                     r.fut.set_exception(e)
                 self.stats.completed(r.model, 0.0, ok=False)
             return
+        dt = None
         try:
             xs = (live[0].x if len(live) == 1
                   else np.concatenate([r.x for r in live], axis=0))
             self.stats.batch_dispatched(xs.shape[0], self.max_batch)
+            traced = [r for r in live if r.trace is not None]
+            if traced:
+                # fan-in seam: close each trace's admission wait, then
+                # open ONE dispatch window joining all co-batched traces
+                # (begin_dispatch pins it to this worker thread so
+                # run_batch can parent per-row session-step spans on it)
+                t_w = time.time()
+                for r in traced:
+                    reqtrace.record_span(
+                        r.trace.trace_id, "queue.wait",
+                        parent_id=r.trace.span_id, ts=r.t_wall,
+                        dur_ms=(t_w - r.t_wall) * 1e3, model=model)
+                dt = reqtrace.begin_dispatch([r.trace for r in traced])
             ys = live[0].ctx.run(entry.run_batch, xs)
             done = time.monotonic()
             ver = getattr(entry, "version", None)
+            reqtrace.end_dispatch(dt, model=model, rows=int(xs.shape[0]),
+                                  requests=len(live), version=ver)
+            dt = None
             off = 0
             for r in live:
                 n = r.x.shape[0]
@@ -399,9 +453,13 @@ class ContinuousBatchingScheduler:
                     # the hot-swap zero-downtime evidence
                     r.fut.version = ver
                     r.fut.set_result(ys[off:off + n])
-                self.stats.completed(r.model, done - r.t_enqueue)
+                self.stats.completed(
+                    r.model, done - r.t_enqueue,
+                    trace_id=r.trace.trace_id if r.trace else None)
                 off += n
         except BaseException as e:
+            reqtrace.end_dispatch(dt, model=model, requests=len(live),
+                                  error=type(e).__name__)
             for r in live:
                 if not r.fut.done():
                     r.fut.set_exception(e)

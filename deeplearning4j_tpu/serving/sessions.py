@@ -43,6 +43,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.observe import reqtrace
 from deeplearning4j_tpu.serving.kv_pool import (
     IncompatibleSessionSwapError, KVSlotPool, SlotPoolExhaustedError,
 )
@@ -67,10 +68,13 @@ class DecodeSession:
     def __init__(self, sid: str, slot: int, prompt: np.ndarray, *,
                  max_tokens: int, params: SamplingParams,
                  seed: Optional[int], deadline_ms: Optional[float],
-                 eos_id: Optional[int]):
+                 eos_id: Optional[int], trace=None):
         self.id = sid
         self.slot = slot
         self.prompt = prompt
+        # sampled requests carry their TraceContext through every
+        # resubmitted step; None on the sampled-off fast path
+        self.trace = trace
         self.max_tokens = int(max_tokens)
         self.params = params
         self.rng = np.random.default_rng(seed)
@@ -126,7 +130,9 @@ class DecodeSession:
                 "generated": len(self.generated),
                 "max_tokens": self.max_tokens,
                 "ttft_ms": self.ttft_ms,
-                "outcome": self.outcome}
+                "outcome": self.outcome,
+                "trace_id": (self.trace.trace_id
+                             if self.trace is not None else None)}
 
 
 class DecodeSessionManager:
@@ -192,6 +198,10 @@ class DecodeSessionManager:
             ModelEntry(self.decode_name, getattr(base, "version", None),
                        base.net, runner=self))
         registry.add_deploy_hook(model, self._deploy_hook)
+        # kernel-policy verdict cached once (and refreshed on hot-swap):
+        # session-step spans stamp it per ITL step, and re-deriving it
+        # per dispatch would price policy evaluation into the hot path
+        self._policy_kind = self._policy_brief()
         if warm:
             self.warmup()
 
@@ -226,7 +236,8 @@ class DecodeSessionManager:
                      greedy: bool = False, seed: Optional[int] = None,
                      deadline_ms: Optional[float] = None,
                      eos_id: Optional[int] = None,
-                     alloc_timeout_s: float = 0.0) -> DecodeSession:
+                     alloc_timeout_s: float = 0.0,
+                     trace=None) -> DecodeSession:
         """Admit one generation: claim a slot (SlotPoolExhaustedError →
         503 upstream), validate the token budget against the net's
         decode limit, and kick off the prefill→decode callback chain.
@@ -254,7 +265,7 @@ class DecodeSessionManager:
         sess = DecodeSession(
             f"s{next(self._sid):06d}", slot, prompt,
             max_tokens=max_tokens, params=params, seed=seed,
-            deadline_ms=deadline_ms, eos_id=eos_id)
+            deadline_ms=deadline_ms, eos_id=eos_id, trace=trace)
         with self._lock:
             self._sessions[sess.id] = sess
         self._c_opened.inc()
@@ -309,8 +320,11 @@ class DecodeSessionManager:
             return
         row = self._next_row(sess)
         try:
+            # explicit trace: resubmits run on scheduler worker threads,
+            # where the edge's contextvar carrier is not in scope
             fut = self.scheduler.submit(self.decode_name, row,
-                                        deadline_ms=rem)
+                                        deadline_ms=rem,
+                                        trace=sess.trace)
         except BaseException as e:
             self._finish(sess, error=e)
             return
@@ -341,11 +355,13 @@ class DecodeSessionManager:
             p = np.asarray(y, np.float64)[0]
             tok = int(sample_next(p[None], sess.params, sess.rng)[0])
             now = time.monotonic()
+            tid = sess.trace.trace_id if sess.trace is not None else None
             if sess.ttft_ms is None:
                 sess.ttft_ms = (now - sess.opened_at) * 1000.0
-                self._h_ttft.observe(sess.ttft_ms)
+                self._h_ttft.observe(sess.ttft_ms, exemplar=tid)
             else:
-                self._h_itl.observe((now - sess._last_tok_at) * 1000.0)
+                self._h_itl.observe((now - sess._last_tok_at) * 1000.0,
+                                    exemplar=tid)
             sess._last_tok_at = now
             sess.generated.append(tok)
             self._c_tokens.inc()
@@ -372,6 +388,13 @@ class DecodeSessionManager:
                        else "failed")
         sess.outcome = outcome
         sess.error = error
+        if sess.trace is not None:
+            reqtrace.record_span(
+                sess.trace.trace_id, "session.close",
+                parent_id=sess.trace.span_id, session=sess.id,
+                slot=sess.slot, outcome=outcome,
+                tokens=len(sess.generated),
+                error=None if error is None else type(error).__name__)
         self.pool.free(sess.slot)
         self._c_out[outcome].inc()
         self._g_active.set(n_active)
@@ -404,6 +427,11 @@ class DecodeSessionManager:
                 f"decode rows must be [k, {2 + self.prefill_chunk}], "
                 f"got {xs.shape}")
         k = xs.shape[0]
+        # fan-in handoff: the scheduler worker opened a dispatch window
+        # iff at least one co-batched row belongs to a sampled trace —
+        # None here keeps the sampled-off path allocation-free
+        dtrace = reqtrace.active_dispatch()
+        t0 = time.perf_counter() if dtrace is not None else 0.0
         slots_idx = xs[:, 0].astype(np.int64)
         nvalid = xs[:, 1].astype(np.int64)
         need = int(nvalid.max())
@@ -440,7 +468,36 @@ class DecodeSessionManager:
         self._c_rows.inc(k)
         if k >= 2:
             self._c_shared.inc()
+        if dtrace is not None:
+            self._trace_steps(dtrace, slots_idx, bucket, k,
+                              (time.perf_counter() - t0) * 1e3)
         return ys
+
+    def _trace_steps(self, dtrace, slots_idx, bucket: int, k: int,
+                     dur_ms: float) -> None:
+        """One `session.step` span per sampled row of this dispatch —
+        the ITL-step leaf of the fan-in tree, parented on that trace's
+        dispatch span and stamped with the slot id and the cached
+        kernel-policy verdict. Host scalars only (the span contract)."""
+        with self._lock:
+            by_slot = {s.slot: s for s in self._sessions.values()
+                       if s.trace is not None}
+        for i in range(slots_idx.shape[0]):
+            sess = by_slot.get(int(slots_idx[i]))
+            if sess is None:
+                continue
+            sid = dtrace.span_ids.get(sess.trace.trace_id)
+            if sid is None:
+                continue        # co-batched with a different endpoint
+            # one step is in flight per session, so `generated` still
+            # reflects the state the row was built from: prefill chunks
+            # all precede the first sampled token
+            reqtrace.record_span(
+                sess.trace.trace_id, "session.step", parent_id=sid,
+                dur_ms=dur_ms, session=sess.id, slot=sess.slot,
+                phase="prefill" if not sess.generated else "decode",
+                step=len(sess.generated), bucket=bucket, rows=k,
+                kernel=self._policy_kind)
 
     # --------------------------------------------------------- hot-swap
     def _deploy_hook(self, phase: str, name: str, version, net) -> None:
@@ -460,6 +517,9 @@ class DecodeSessionManager:
                 n = len(self._sessions)
             self.entry.net = net
             self.entry.version = version
+            kind = self._policy_brief()     # takes _lock; compute first
+            with self._lock:
+                self._policy_kind = kind
             try:
                 from deeplearning4j_tpu.observe import get_flight
                 get_flight().record("decode_sessions_migrated",
@@ -510,6 +570,13 @@ class DecodeSessionManager:
             "buckets": list(self.buckets),
             "kernel_policy": self._kernel_policy(),
         }
+
+    def _policy_brief(self) -> str:
+        """Compact kernel-policy verdict for span attributes: the sorted
+        set of dispatch kinds across cached-attention layers."""
+        kinds = sorted({p.get("kind") for p in self._kernel_policy()
+                        if p.get("kind")})
+        return ",".join(kinds) if kinds else "n/a"
 
     def _kernel_policy(self) -> list:
         """Which decode-attention kernel each cached-attention layer

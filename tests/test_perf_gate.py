@@ -85,6 +85,28 @@ class TestCompare:
         assert any("Gone" in line for line in d)
         assert any("syncs_per_step" in line for line in d)
 
+    def test_traced_leg_gated_when_baselined(self):
+        base = _baseline(traced={"syncs_per_step": 0.25,
+                                 "extra_syncs_per_step": 0.0})
+        meas = _measured(traced={"syncs_per_step": 0.5,
+                                 "extra_syncs_per_step": 0.25})
+        breaches = perf_gate.compare(base, meas)
+        assert len(breaches) == 1
+        assert "traced" in breaches[0] and "sync-free" in breaches[0]
+        # exactly zero extra syncs passes (the contract)
+        ok = _measured(traced={"syncs_per_step": 0.25,
+                               "extra_syncs_per_step": 0.0})
+        assert perf_gate.compare(base, ok) == []
+        # the leg is not gated until a baseline records it
+        assert perf_gate.compare(_baseline(), meas) == []
+
+    def test_checked_in_baseline_gates_traced_leg(self):
+        import json
+        with open(perf_gate.BASELINE_PATH) as fh:
+            base = json.load(fh)
+        assert base["traced"]["extra_syncs_per_step"] == 0.0
+        assert base["budgets"]["extra_traced_syncs_per_step"] == 0.0
+
     def test_checked_in_baseline_is_current_version(self):
         import json
         with open(perf_gate.BASELINE_PATH) as fh:

@@ -288,6 +288,29 @@ FIXTURES = {
              return make_mesh()
          """, False, False),
     ],
+    "GL601": [
+        ("""
+         import jax.numpy as jnp
+         from deeplearning4j_tpu.observe import span
+         def step(x):
+             y = jnp.dot(x, x)
+             with span("train.step", loss=y):
+                 return y
+         """, True, True),
+        ("""
+         import jax.numpy as jnp
+         def record(hist, x):
+             y = jnp.dot(x, x)
+             hist.observe(0.5, exemplar=y)
+         """, True, True),
+        ("""
+         import jax.numpy as jnp
+         def step(hist, x, tid):
+             y = jnp.dot(x, x)
+             hist.observe(y.shape[0], exemplar=tid)
+             return y
+         """, True, False),
+    ],
 }
 
 

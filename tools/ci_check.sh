@@ -7,6 +7,9 @@
 #   tools/ci_check.sh --perf     # perf gate only (recompiles + syncs/step
 #                                #   vs .graftperf-baseline.json)
 #   tools/ci_check.sh --chaos    # fault-injection / failover suite only
+#   tools/ci_check.sh --trace    # request-tracing smoke: one sampled
+#                                #   /generate must reconstruct an
+#                                #   HTTP→dispatch→session trace tree
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -21,6 +24,12 @@ fi
 if [[ "${1:-}" == "--perf" ]]; then
     echo "== perf gate (recompiles + host syncs vs baseline) =="
     env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python tools/perf_gate.py
+    exit 0
+fi
+
+if [[ "${1:-}" == "--trace" ]]; then
+    echo "== request-tracing smoke (/generate → /trace/{id}) =="
+    env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python tools/trace_smoke.py
     exit 0
 fi
 

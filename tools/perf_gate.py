@@ -33,7 +33,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 BASELINE_PATH = os.path.join(os.path.dirname(__file__), "..",
                              ".graftperf-baseline.json")
-WORKLOAD_VERSION = 2
+WORKLOAD_VERSION = 3
 
 # Default slack written into a fresh baseline: zero extra compiles (a
 # new program IS the regression being hunted) and half a sync of noise
@@ -45,7 +45,11 @@ WORKLOAD_VERSION = 2
 DEFAULT_BUDGETS = {"extra_compiles_per_owner": 0,
                    "extra_syncs_per_step": 0.5,
                    "extra_sharded_syncs_per_step": 0.5,
-                   "min_opt_state_shard_factor": 4.0}
+                   "min_opt_state_shard_factor": 4.0,
+                   # request tracing is sync-free BY CONTRACT
+                   # (PERF_NOTES): a traced fit may add exactly zero
+                   # host syncs over the untraced one
+                   "extra_traced_syncs_per_step": 0.0}
 
 
 def run_workload() -> dict:
@@ -100,6 +104,33 @@ def run_workload() -> dict:
             mon.uninstall()
         steps = 2 * (32 // 8)
         syncs_per_step = mon.syncs / steps
+
+        # --- traced leg: the SAME steady-state fit with every epoch
+        # sampled (reqtrace). The span machinery records host scalars
+        # only, so tracing must add ZERO syncs and zero compiles (span
+        # attrs never reach a jit cache key) — gated below via
+        # extra_traced_syncs_per_step and the shared compile budget.
+        from deeplearning4j_tpu.observe import reqtrace
+        prev_store = reqtrace.get_trace_store()
+        prev_env = os.environ.get(reqtrace.ENV_SAMPLE)
+        reqtrace.set_trace_store(reqtrace.TraceStore())
+        os.environ[reqtrace.ENV_SAMPLE] = "1"
+        mon = HostSyncMonitor().install()
+        try:
+            net.fit(x, y, batch_size=8, epochs=2)
+        finally:
+            mon.uninstall()
+            if prev_env is None:
+                os.environ.pop(reqtrace.ENV_SAMPLE, None)
+            else:
+                os.environ[reqtrace.ENV_SAMPLE] = prev_env
+            reqtrace.set_trace_store(prev_store)
+        traced_syncs = mon.syncs / steps
+        traced = {
+            "syncs_per_step": round(traced_syncs, 3),
+            "extra_syncs_per_step": round(traced_syncs - syncs_per_step,
+                                          3),
+        }
 
         # --- windowed-attention transformer fit: the dispatch-policy
         # seam (attention/banded policies run at trace time) ------------
@@ -175,6 +206,7 @@ def run_workload() -> dict:
         "compiles_per_owner": dict(sorted(compiles.items())),
         "total_compiles": snap["total_compiles"],
         "syncs_per_step": round(syncs_per_step, 3),
+        "traced": traced,
         "sharded": sharded,
     }
 
@@ -217,6 +249,17 @@ def compare(baseline: dict, measured: dict) -> list:
             f"{baseline.get('syncs_per_step')} (budget "
             f"+{budgets['extra_syncs_per_step']}) — a device->host "
             f"materialization crept into the step loop")
+    # traced leg: only gated once a baseline recorded it
+    if baseline.get("traced"):
+        meas_tr = measured.get("traced") or {}
+        t_budget = budgets["extra_traced_syncs_per_step"]
+        if meas_tr.get("extra_syncs_per_step", 0.0) > t_budget:
+            breaches.append(
+                f"traced fit added "
+                f"{meas_tr.get('extra_syncs_per_step')} syncs/step over "
+                f"the untraced run (budget +{t_budget}) — a span or "
+                f"exemplar attribute is materializing a device value; "
+                f"tracing must stay sync-free (GL601)")
     # sharded-spine leg: only gated once a baseline recorded it
     base_sh = baseline.get("sharded")
     if base_sh:
@@ -262,6 +305,11 @@ def diff(baseline: dict, measured: dict) -> list:
         m = (measured.get("sharded") or {}).get(key)
         if b != m:
             out.append(f"  sharded.{key}: {b} -> {m}")
+    for key in ("syncs_per_step", "extra_syncs_per_step"):
+        b = (baseline.get("traced") or {}).get(key)
+        m = (measured.get("traced") or {}).get(key)
+        if b != m:
+            out.append(f"  traced.{key}: {b} -> {m}")
     return out
 
 

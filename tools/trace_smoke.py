@@ -1,0 +1,111 @@
+#!/usr/bin/env python
+"""CI smoke for end-to-end request tracing (`tools/ci_check.sh --trace`).
+
+Boots a real InferenceServer (CPU), streams one SAMPLED /generate
+request, then asserts the reconstruction contract on GET /trace/{id}:
+the tree must reach depth ≥3 — HTTP root → shared dispatch →
+session.step — with the step spans carrying slot + kernel-policy
+attributes. Exits nonzero (with the offending JSON) on any miss, so the
+gate catches a broken seam, not just a broken import.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import urllib.request
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["DL4J_TPU_TRACE_SAMPLE"] = "1"   # sample every request
+
+    import numpy as np
+
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.inputs import InputType
+    from deeplearning4j_tpu.nn.layers.attention import (
+        PositionEmbeddingLayer, TransformerEncoderBlock,
+    )
+    from deeplearning4j_tpu.nn.layers.feedforward import (
+        EmbeddingSequenceLayer,
+    )
+    from deeplearning4j_tpu.nn.layers.recurrent import RnnOutputLayer
+    from deeplearning4j_tpu.optim.updaters import Adam
+    from deeplearning4j_tpu.serving import InferenceServer
+
+    V, chunk = 16, 4
+    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-3))
+            .activation("identity")
+            .list(EmbeddingSequenceLayer(n_in=V, n_out=8),
+                  PositionEmbeddingLayer(max_length=64),
+                  TransformerEncoderBlock(num_heads=2, causal=True,
+                                          window=8, rolling_cache=True,
+                                          max_cache=16),
+                  RnnOutputLayer(n_out=V, activation="softmax"))
+            .set_input_type(InputType.recurrent(1, chunk)).build())
+    net = MultiLayerNetwork(conf).init()
+    srv = InferenceServer(net, port=0, decode_slots=2,
+                          decode_prefill_chunk=chunk)
+    port = srv.start()
+    base = f"http://127.0.0.1:{port}"
+    try:
+        prompt = np.random.default_rng(0).integers(0, V, 6).tolist()
+        body = json.dumps({"prompt_ids": prompt, "max_tokens": 4,
+                           "seed": 1}).encode()
+        req = urllib.request.Request(
+            base + "/generate", data=body,
+            headers={"Content-Type": "application/json"})
+        trace_id, tokens = None, 0
+        with urllib.request.urlopen(req, timeout=60) as r:
+            for line in r:
+                line = line.decode().strip()
+                if not line.startswith("data: "):
+                    continue
+                ev = json.loads(line[6:])
+                trace_id = ev.get("trace_id") or trace_id
+                tokens += 1 if "token" in ev else 0
+        if not trace_id:
+            sys.exit("FAIL: sampled /generate stream carried no trace_id")
+        if not tokens:
+            sys.exit("FAIL: /generate streamed no tokens")
+
+        with urllib.request.urlopen(base + f"/trace/{trace_id}",
+                                    timeout=10) as r:
+            tree = json.loads(r.read())
+
+        def names_at(nodes, depth=0):
+            for n in nodes:
+                yield depth, n["name"], n.get("attrs") or {}
+                yield from names_at(n.get("children") or [], depth + 1)
+
+        spans = list(names_at(tree.get("tree") or []))
+        problems = []
+        if tree.get("depth", 0) < 3:
+            problems.append(f"depth {tree.get('depth')} < 3")
+        if not any(d == 0 and name.startswith("http.")
+                   for d, name, _ in spans):
+            problems.append("no HTTP root span")
+        if not any(name == "dispatch" for _, name, _ in spans):
+            problems.append("no shared dispatch span")
+        steps = [a for _, name, a in spans if name == "session.step"]
+        if not steps:
+            problems.append("no session.step spans")
+        elif not all("slot" in a and "kernel" in a for a in steps):
+            problems.append("session.step spans missing slot/kernel attrs")
+        if problems:
+            print(json.dumps(tree, indent=1)[:4000])
+            sys.exit("FAIL: " + "; ".join(problems))
+        print(f"trace smoke OK: {trace_id} — {tree['spans']} spans, "
+              f"depth {tree['depth']}, {len(steps)} session steps")
+        return 0
+    finally:
+        srv.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
