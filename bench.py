@@ -718,6 +718,45 @@ def _record_last_tpu(result):
         pass
 
 
+_HISTORY_FILE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             "BENCH_history.jsonl")
+
+
+def _append_history(mode, summary):
+    """One compact timestamped row per bench invocation, appended to
+    BENCH_history.jsonl (every mode, every run — unlike the per-mode
+    BENCH_*.json blobs, which only keep the latest). tools/dash.py
+    --bench renders the trajectory from these rows."""
+    row = {"ts": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+           "mode": mode}
+    for k in ("metric", "value", "unit", "vs_baseline", "mfu", "batch",
+              "config", "platform", "device", "devices",
+              "opt_state_shard_factor", "throughput_ratio"):
+        v = summary.get(k)
+        if v is not None and not isinstance(v, (dict, list)):
+            row[k] = v
+    for k, sub in (("ttft_p99_ms", ("ttft_ms", "p99")),
+                   ("itl_p99_ms", ("itl_ms", "p99")),
+                   ("continuous_p99_ms", ("modes", "continuous",
+                                          "p99_ms")),
+                   ("continuous_rps", ("modes", "continuous",
+                                       "throughput_rps"))):
+        v = summary
+        for part in sub:
+            v = v.get(part) if isinstance(v, dict) else None
+        if v is not None:
+            row[k] = v
+    if summary.get("error"):
+        row["error"] = True
+    try:
+        with open(_HISTORY_FILE, "a") as f:
+            f.write(json.dumps(row) + "\n")
+    # graft: allow(GL403): history is advisory; never fail the bench
+    # over an unwritable artifact dir
+    except OSError:
+        pass
+
+
 def _load_tpu_records():
     try:
         with open(_LAST_TPU_FILE) as f:
@@ -858,7 +897,7 @@ def _host_overhead_main():
     devicemon_sample_ms = (time.perf_counter() - t0) * 1e3
 
     dev = jax.devices()[0]
-    print(json.dumps({
+    out = {
         "metric": "host_overhead",
         "unit": "ms/step",
         "value": round(overhead(fused_ms), 4),
@@ -891,7 +930,9 @@ def _host_overhead_main():
         "device": getattr(dev, "device_kind", str(dev)),
         "platform": dev.platform,
         "registry": _registry_snapshot(),
-    }))
+    }
+    _append_history("host-overhead", out)
+    print(json.dumps(out))
 
 
 def _serving_main():
@@ -1031,6 +1072,7 @@ def _serving_main():
         os.path.dirname(os.path.abspath(__file__)), "BENCH_serving.json")
     with open(dest, "w") as f:
         json.dump(out, f, indent=1)
+    _append_history("serving", out)
     print(json.dumps(out))
 
 
@@ -1245,6 +1287,7 @@ def _serving_decode_main():
         "BENCH_serving_decode.json")
     with open(dest, "w") as f:
         json.dump(out, f, indent=1)
+    _append_history("serving-decode", out)
     print(json.dumps(out))
 
 
@@ -1394,6 +1437,7 @@ def _kernels_main():
         os.path.dirname(os.path.abspath(__file__)), "BENCH_kernels.json")
     with open(dest, "w") as f:
         json.dump(out, f, indent=1)
+    _append_history("kernels", out)
     print(json.dumps(out))
 
 
@@ -1518,6 +1562,7 @@ def _sharding_main():
         os.path.dirname(os.path.abspath(__file__)), "BENCH_sharding.json")
     with open(dest, "w") as f:
         json.dump(out, f, indent=1)
+    _append_history("sharding", out)
     print(json.dumps(out))
 
 
@@ -1613,6 +1658,7 @@ def _run_ladder():
                 last = _load_last_tpu(_metric_name(model))
                 if last:
                     result["last_verified_tpu"] = last
+            _append_history("ladder", result)
             print(json.dumps(result))
             return
         tail = (proc.stderr or proc.stdout or "").strip().splitlines()
@@ -1644,6 +1690,7 @@ def _run_ladder():
     last = _load_last_tpu(metric)
     if last:
         out["last_verified_tpu"] = last
+    _append_history("ladder", out)
     print(json.dumps(out))
 
 

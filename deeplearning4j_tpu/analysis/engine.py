@@ -84,6 +84,13 @@ _LOG_METHODS = ("debug", "info", "warning", "warn", "error", "exception",
 # are span attributes — GL601 requires those to be host scalars
 _SPAN_EMITTERS = ("span", "emit_manual_span", "record_span",
                   "error_trace", "finish_root", "end_dispatch")
+
+# full-registry/series reader methods (GL602): each walks every series
+# and sorts histogram reservoirs — periodic-reader pricing only
+_SNAPSHOT_READS = ("snapshot", "to_prometheus", "to_jsonl")
+# receiver name tokens that mark a registry/series-store-ish object
+_REGISTRYISH_TOKENS = frozenset(
+    ("registry", "reg", "metrics", "series", "stats", "store"))
 _LOCK_CLASSES = ("Lock", "RLock", "Condition", "Semaphore",
                  "BoundedSemaphore")
 
@@ -298,6 +305,9 @@ class _FileLinter:
         self.findings: List[Finding] = []
         self.suppressed: List[Finding] = []
         self.allow = _collect_suppressions(self.lines)
+        # names bound from get_registry()/get_series_store() — GL602
+        # receiver tracking (file-wide, deliberately rough)
+        self.registry_names: Set[str] = set()
 
     # ------------------------------------------------------------ entry
     def run(self) -> List[Finding]:
@@ -403,6 +413,20 @@ class _FileLinter:
         if isinstance(node, ast.Starred):
             return self._tainted(node.value, ctx)
         return False
+
+    def _registryish(self, node: ast.AST) -> bool:
+        """Is this receiver a MetricsRegistry / SeriesStore / stats
+        aggregator? Matches direct get_registry()/get_series_store()
+        call receivers, names bound from them, and receiver names built
+        from registry-ish tokens (self.stats.registry, series_store…)."""
+        if isinstance(node, ast.Call):
+            return _terminal(node.func) in ("get_registry",
+                                            "get_series_store")
+        if isinstance(node, ast.Name) and node.id in self.registry_names:
+            return True
+        term = _terminal(node) or ""
+        toks = re.split(r"[_\W]+", term.lower())
+        return any(t in _REGISTRYISH_TOKENS for t in toks)
 
     def _devicey(self, node: ast.AST, ctx: _Ctx) -> bool:
         """Host-side 'this is (or contains) a live device array' — the
@@ -540,6 +564,12 @@ class _FileLinter:
         else:                                        # AnnAssign
             targets, value = [node.target], node.value
         self._check_lock_mutation_targets(node, targets, ctx)
+        if (isinstance(value, ast.Call)
+                and _terminal(value.func) in ("get_registry",
+                                              "get_series_store")):
+            for t in targets:
+                if isinstance(t, ast.Name):
+                    self.registry_names.add(t.id)
         if value is not None:
             self._expr(value, ctx)
             pred = self._tainted if ctx.traced else self._devicey
@@ -981,6 +1011,23 @@ class _FileLinter:
                                "telemetry path — pass a host scalar "
                                "(the sync-free span contract)")
                     break
+
+        # GL602 — full registry/series snapshot on the hot path. The
+        # exporters walk EVERY series and sort histogram reservoirs;
+        # they are priced for periodic readers (the series sampler, a
+        # /metrics scrape), not for a step/request loop — and inside a
+        # traced function the read happens at trace time, silently.
+        if (term in _SNAPSHOT_READS
+                and isinstance(func, ast.Attribute)
+                and self._registryish(func.value)
+                and (ctx.traced or (self.hot and ctx.loop_depth > 0))):
+            where = ("a traced function" if ctx.traced
+                     else "a hot-module loop")
+            self._emit("GL602", node,
+                       f"registry/series {term}() inside {where} — "
+                       "O(all metrics) reader work on the hot path; "
+                       "hoist the read out (the series sampler thread "
+                       "is the periodic reader)")
 
         # GL301 — mutating method calls on self attrs
         if (isinstance(func, ast.Attribute)

@@ -127,6 +127,13 @@ _ALL = (
          "inside a traced function it concretizes the tracer; in a hot "
          "module it forces a device→host sync on the telemetry path, "
          "breaking the sync-free span contract; pass host scalars only"),
+    Rule("GL602", "snapshot-in-hot-loop", CAT_OBSERVE, WARNING,
+         "full MetricsRegistry/series snapshot (snapshot()/"
+         "to_prometheus()/to_jsonl()) inside a traced function or a "
+         "hot-module loop — rendering every series sorts histogram "
+         "reservoirs and is O(all metrics) reader work on the step/"
+         "request path; readers pay, so hoist the read off the hot loop "
+         "(the series sampler thread is the periodic reader)"),
 )
 
 RULES: Dict[str, Rule] = {r.id: r for r in _ALL}
@@ -139,6 +146,7 @@ RUNTIME_RULE_HINTS: Dict[str, Tuple[str, ...]] = {
     "recompile": ("GL101", "GL102", "GL103"),
     "host_sync": ("GL001", "GL002", "GL201", "GL202", "GL203"),
     "span_taint": ("GL601",),
+    "hot_snapshot": ("GL602",),
 }
 
 

@@ -28,6 +28,16 @@ every layer shares:
   HTTP edge, fan-in dispatch spans, per-step session spans, training
   dispatch windows) with head-based sampling and a bounded TraceStore;
   served by `GET /trace/{id}` and embedded in flight dumps.
+- `series.py` — bounded time-series history over the registry: a
+  fixed-capacity ring per metric key fed by a background sampler thread
+  (`DL4J_TPU_SERIES_INTERVAL`), with sliding-window rates for counters
+  and windowed p50/95/99 for histograms. Host-side only: zero device
+  syncs, zero compiles, zero allocation per sample (perf-gate pinned).
+- `slo.py` — declarative objectives over those series with multi-window
+  burn-rate alerting (fast 5m + slow 1h); firing SLOs dump the flight
+  ring (`slo_breach`), mint a forced trace exemplar, degrade /healthz
+  and publish `slo_burn_rate`/`slo_breaches_total`; plus the runtime
+  AnomalyWatch (recompile-storm + sync-regression detectors).
 
 The package imports only the stdlib (no jax) so the dump tool and the
 registry work anywhere; jax seams are bound lazily at install time.
@@ -59,6 +69,12 @@ from deeplearning4j_tpu.observe.reqtrace import (
     current_trace, end_dispatch, error_extra, error_trace, finish_root,
     get_trace_store, new_trace, record_span, set_trace_store,
 )
+from deeplearning4j_tpu.observe.series import (
+    SeriesRing, SeriesSampler, SeriesStore, series_key,
+)
+from deeplearning4j_tpu.observe.slo import (
+    SLO, AnomalyWatch, SLOEngine, default_slos,
+)
 
 __all__ = [
     "MetricsRegistry", "get_registry", "set_registry",
@@ -73,4 +89,6 @@ __all__ = [
     "TraceContext", "TraceStore", "get_trace_store", "set_trace_store",
     "new_trace", "finish_root", "record_span", "error_trace", "error_extra",
     "current_trace", "begin_dispatch", "active_dispatch", "end_dispatch",
+    "SeriesRing", "SeriesSampler", "SeriesStore", "series_key",
+    "SLO", "AnomalyWatch", "SLOEngine", "default_slos",
 ]

@@ -192,6 +192,7 @@ class TrainingExecutor:
         reg = get_registry()
         self._iter_counter = reg.counter("train_iterations")
         self._etl_hist = reg.histogram("train_etl_ms")
+        self._dispatch_hist = reg.histogram("train_dispatch_ms")
 
     # ------------------------------------------------------------- loop
     def run(self, iterable, epochs: int, *, start_epoch: int = 0):
@@ -211,6 +212,7 @@ class TrainingExecutor:
         reg = get_registry()
         self._iter_counter = reg.counter("train_iterations")
         self._etl_hist = reg.histogram("train_etl_ms")
+        self._dispatch_hist = reg.histogram("train_dispatch_ms")
         # black box + device telemetry: wire the span ring before the
         # first fit span so a crash dump carries this run from the start
         flight = get_flight()
@@ -362,6 +364,9 @@ class TrainingExecutor:
         net.iteration += 1
         self._iter_counter.inc()
         self._etl_hist.observe(etl_ms)
+        # host-side dispatch wall time per step: the training-side
+        # series the sampler turns into train_dispatch_ms:p99
+        self._dispatch_hist.observe(dispatch_ms)
         t_h = time.perf_counter()
         for l in net.listeners:
             if hasattr(l, "set_etl_time"):

@@ -33,8 +33,20 @@ backpressure semantics, and an observability surface.
                  `observe.MetricsRegistry`, so passing
                  `metrics=observe.get_registry()` publishes training
                  metrics through the same scrape endpoint
-  GET  /healthz  → {"status": "ok" | "degraded"} — degraded once the
-                 admission queue passes `degraded_fraction` of capacity
+  GET  /healthz  → {"status": "ok" | "degraded", "reasons": [...]} —
+                 degraded when the admission queue passes
+                 `degraded_fraction` of capacity, the recompile
+                 watchdog tripped on one of this server's jit owners,
+                 a slot worker is crash-looping, or an SLO is firing
+                 (reason list names each cause)
+  GET  /series   → sampled telemetry time-series windows (needs
+                 `slo=True` / enable_slo(); `?window=60&prefix=serving_`
+                 filters). One point per registry series per sampler
+                 tick; histograms appear as `:count`/`:p50/:p95/:p99`
+  GET  /slo      → the SLO engine's last evaluation: per-objective
+                 burn rates (fast/slow windows), firing state, breach
+                 counts + forced-trace ids, anomaly-watch warnings;
+                 `?refresh=1` forces a tick first
   GET  /devices  → live per-device telemetry (one DeviceMonitor sample:
                  memory_stats bytes in-use/peak/limit where the backend
                  reports them, live-array counts everywhere)
@@ -97,7 +109,9 @@ class InferenceServer(JsonHttpServer):
                  batch_buckets=None, collect_wait_ms: float = 5.0,
                  slots: int = 1, degraded_fraction: float = 0.8,
                  mesh=None, metrics=None, decode_slots: int = 0,
-                 decode_prefill_chunk: int = 8):
+                 decode_prefill_chunk: int = 8, slo: bool = False,
+                 slo_objectives=None,
+                 series_interval: Optional[float] = None):
         super().__init__(port=port)
         if scheduler not in ("continuous", "collect"):
             raise ValueError("scheduler must be 'continuous' or 'collect'")
@@ -124,6 +138,13 @@ class InferenceServer(JsonHttpServer):
                 queue_capacity=queue_capacity, policy=admission,
                 default_deadline_ms=default_deadline_ms, slots=slots)
         self._decode = {}
+        self._series_store = None
+        self._sampler = None
+        self._slo = None
+        self._anomaly = None
+        if slo:
+            self.enable_slo(slos=slo_objectives,
+                            interval=series_interval)
         if net is not None:
             self.registry.deploy(DEFAULT_MODEL, 1, net, warm=False)
             # decode_slots > 0 turns on stateful decode serving for the
@@ -163,6 +184,44 @@ class InferenceServer(JsonHttpServer):
             warm=warm)
         self._decode[model] = mgr
         return mgr
+
+    def enable_slo(self, *, slos=None, interval: Optional[float] = None,
+                   anomaly: bool = True):
+        """Turn on the telemetry time-series sampler + SLO engine for
+        this server: a background thread samples `self.stats.registry`
+        every `interval` (default DL4J_TPU_SERIES_INTERVAL) seconds into
+        a bounded SeriesStore, and the SLOEngine + AnomalyWatch evaluate
+        on each tick — all host-side, off the request path. Surfaces:
+        GET /series, GET /slo, and the degraded /healthz verdict."""
+        if self._sampler is not None:
+            return self._slo
+        from deeplearning4j_tpu.observe.series import (
+            SeriesSampler, SeriesStore,
+        )
+        from deeplearning4j_tpu.observe.slo import (
+            AnomalyWatch, SLOEngine,
+        )
+        self._series_store = SeriesStore()
+        self._sampler = SeriesSampler(self._series_store,
+                                      registry=self.stats.registry,
+                                      interval=interval)
+        # queue gauges only move when /metrics renders; push them every
+        # tick so the series (and the SLOs over them) stay live
+        self._sampler.add_callback(self._push_queue_gauges)
+        self._slo = SLOEngine(self._series_store,
+                              registry=self.stats.registry, slos=slos)
+        self._sampler.add_callback(self._slo.evaluate)
+        if anomaly:
+            self._anomaly = AnomalyWatch(self._series_store,
+                                         registry=self.stats.registry)
+            self._sampler.add_callback(self._anomaly.check)
+        self._sampler.start()
+        return self._slo
+
+    def _push_queue_gauges(self, now=None):
+        depth = self.scheduler.queue_depth() if self.scheduler else None
+        cap = self.scheduler.capacity if self.scheduler else None
+        self.stats.set_queue_gauges(depth, cap)
 
     # --------------------------------------------------------- handlers
     def _parse(self, req: dict):
@@ -332,15 +391,98 @@ class InferenceServer(JsonHttpServer):
         return {"decode": {m: mgr.snapshot()
                            for m, mgr in self._decode.items()}}
 
+    def _owned_watchdog_tags(self):
+        """Owner tags of jit caches THIS server's models/sessions own —
+        healthz folds watchdog trips for these only, so another
+        component's churn in the same process can't degrade us."""
+        tags = set()
+        get = getattr(self.registry, "get", None)
+        for name in (self.registry.names() if get else ()):
+            try:
+                entry = get(name)
+            except KeyError:
+                continue
+            tag = getattr(getattr(getattr(entry, "runner", None),
+                                  "_jit_cache", None), "owner_tag", None)
+            if tag:
+                tags.add(tag)
+        for mgr in self._decode.values():
+            tag = getattr(getattr(mgr, "_jit_cache", None),
+                          "owner_tag", None)
+            if tag:
+                tags.add(tag)
+        return tags
+
     def _healthz(self):
+        """Degraded verdict with the reason list in the body. Degraded
+        when: the admission queue passes `degraded_fraction` of
+        capacity, OR the recompile watchdog tripped on one of this
+        server's jit owners, OR a slot worker is crash-looping right
+        now, OR any SLO is firing."""
         depth = self.scheduler.queue_depth() if self.scheduler else 0
         cap = self.scheduler.capacity if self.scheduler else None
-        degraded = (cap is not None
-                    and depth >= self.degraded_fraction * cap)
-        return {"status": "degraded" if degraded else "ok",
-                "mode": self.mode, "queue_depth": depth,
-                "queue_capacity": cap,
-                "models": self.registry.names()}
+        reasons = []
+        if cap is not None and depth >= self.degraded_fraction * cap:
+            reasons.append(f"admission queue saturated ({depth}/{cap})")
+        from deeplearning4j_tpu.observe.watchdog import get_watchdog
+        owned = self._owned_watchdog_tags()
+        snap = get_watchdog().snapshot()["per_owner"] if owned else {}
+        tripped = sorted(t for t, o in snap.items()
+                         if o["warned"] and t in owned)
+        if tripped:
+            reasons.append(
+                "recompile watchdog tripped: " + ", ".join(tripped))
+        streak = (self.scheduler.restart_streak()
+                  if self.scheduler else 0)
+        if streak:
+            reasons.append(
+                f"slot worker crash-looping (streak {streak})")
+        firing = self._slo.firing() if self._slo is not None else []
+        for name in firing:
+            reasons.append(f"slo firing: {name}")
+        out = {"status": "degraded" if reasons else "ok",
+               "reasons": reasons, "mode": self.mode,
+               "queue_depth": depth, "queue_capacity": cap,
+               "models": self.registry.names()}
+        if self._slo is not None:
+            out["slo_firing"] = firing
+            if firing:
+                out["slo_breaches"] = self._slo.breaches()
+        return out
+
+    def _series(self, request=None):
+        """GET /series — the sampled time-series windows. Query params:
+        `window` (seconds of history) and `prefix` (key filter)."""
+        if self._series_store is None:
+            return {"enabled": False, "series": {}}
+        q = (request or {}).get("query", {})
+
+        def _f(name):
+            try:
+                return float(q[name][0]) if q.get(name) else None
+            except (TypeError, ValueError):
+                raise HttpError(400, f"bad {name!r} query param")
+        out = self._series_store.snapshot(
+            window_s=_f("window"),
+            prefix=(q.get("prefix") or [None])[0])
+        out["enabled"] = True
+        out["interval_s"] = self._sampler.interval
+        out["ticks"] = self._sampler.ticks
+        return out
+
+    def _slo_route(self, request=None):
+        """GET /slo — the engine's last evaluation (add `?refresh=1` to
+        force one now, e.g. with a long sampler interval)."""
+        if self._slo is None:
+            return {"enabled": False, "slos": [], "firing": []}
+        q = (request or {}).get("query", {})
+        if q.get("refresh"):
+            self._sampler.sample_once()
+        out = dict(self._slo.snapshot())
+        out["enabled"] = True
+        if self._anomaly is not None:
+            out["anomalies"] = list(self._anomaly.warnings)
+        return out
 
     def _metrics(self, request=None):
         depth = self.scheduler.queue_depth() if self.scheduler else 0
@@ -397,7 +539,8 @@ class InferenceServer(JsonHttpServer):
         return {"/healthz": self._healthz, "/metrics": self._metrics,
                 "/models": lambda: {"models": self.registry.summary()},
                 "/devices": self._devices, "/flight": self._flight,
-                "/sessions": self._sessions, "/trace": self._trace_list}
+                "/sessions": self._sessions, "/trace": self._trace_list,
+                "/series": self._series, "/slo": self._slo_route}
 
     def get_prefix_routes(self):
         return {"/trace/": self._trace}
@@ -408,6 +551,10 @@ class InferenceServer(JsonHttpServer):
 
     def stop(self):
         super().stop()
+        # the sampler thread reads stats/scheduler state; stop it before
+        # tearing those down (idempotent join)
+        if self._sampler is not None:
+            self._sampler.stop()
         # abort live decode sessions first — their callback chains keep
         # resubmitting into the scheduler; closing them makes the
         # scheduler/registry shutdown below drain instead of time out

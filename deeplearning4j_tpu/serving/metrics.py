@@ -34,7 +34,7 @@ _OUTCOMES = ("admitted", "completed", "failed", "shed", "expired")
 class _ModelSeries:
     """Cached instrument handles for one model's series."""
 
-    __slots__ = ("outcomes", "latency")
+    __slots__ = ("outcomes", "latency", "queue_wait")
 
     def __init__(self, registry: MetricsRegistry, model: str, window: int):
         self.outcomes = {
@@ -43,6 +43,8 @@ class _ModelSeries:
             for k in _OUTCOMES}
         self.latency = registry.histogram(
             "serving_latency_seconds", reservoir=window, model=model)
+        self.queue_wait = registry.histogram(
+            "serving_queue_wait_ms", reservoir=window, model=model)
 
 
 class ServingStats:
@@ -66,6 +68,11 @@ class ServingStats:
         self._q_cap = self.registry.gauge("serving_queue_capacity")
         self._worker_restarts = self.registry.counter(
             "serving_worker_restarts_total")
+        # worst CURRENT consecutive-crash streak across slot workers —
+        # nonzero means a slot is crash-looping right now (the restarts
+        # counter above only says it happened at some point)
+        self._worker_streak = self.registry.gauge(
+            "serving_worker_restart_streak")
         self._started = time.time()
         self.registry.gauge("serving_start_time_seconds").set(self._started)
 
@@ -111,11 +118,21 @@ class ServingStats:
         self._dispatches.inc()
         self._rows.inc(rows)
 
+    def queue_waited(self, model: str, wait_ms: float):
+        """Admission-to-dispatch queue wait for one request — the
+        series the queue-wait SLO watches."""
+        self._m(model).queue_wait.observe(wait_ms)
+
     def worker_restarted(self):
         """One supervised slot-worker restart after a crash — nonzero
         here means the scheduler survived something that used to be a
         silent outage (a dead daemon thread)."""
         self._worker_restarts.inc()
+
+    def worker_streak(self, streak: int):
+        """Worst current consecutive-crash streak (0 = all slots
+        healthy); feeds the restart-streak SLO and /healthz."""
+        self._worker_streak.set(streak)
 
     def set_queue_gauges(self, depth: Optional[int],
                          capacity: Optional[int]) -> None:

@@ -141,6 +141,9 @@ class ContinuousBatchingScheduler:
         self._depth = 0
         self._inflight = 0
         self._closed = False
+        # per-worker CURRENT crash streaks (worker thread name → count);
+        # restart_streak() reads the worst one for /healthz and the SLO
+        self._streaks: Dict[str, int] = {}
         # chaos seam (inject_worker_fault): raise in the next N worker
         # iterations right after a batch is taken — guarded by self._cv
         self._fault_budget = 0
@@ -156,6 +159,19 @@ class ContinuousBatchingScheduler:
     def queue_depth(self) -> int:
         with self._cv:
             return self._depth
+
+    def restart_streak(self) -> int:
+        """Worst current consecutive-crash streak across slot workers
+        (0 = healthy). Nonzero means a slot is crash-looping RIGHT NOW —
+        a healthy dispatch resets its worker's streak."""
+        with self._cv:
+            return max(self._streaks.values(), default=0)
+
+    def _note_streak(self, n: int) -> None:
+        with self._cv:
+            self._streaks[threading.current_thread().name] = n
+            worst = max(self._streaks.values())
+        self.stats.worker_streak(worst)
 
     def submit(self, model: str, x,
                deadline_ms: Optional[float] = None, *,
@@ -308,6 +324,7 @@ class ContinuousBatchingScheduler:
             except _WorkerCrashed as wc:
                 batch, cause = wc.batch, wc.cause
             streak[0] += 1
+            self._note_streak(streak[0])
             self.stats.worker_restarted()
             # a dead worker thread is a silent serving outage (daemon
             # threads die without a traceback anyone keeps): black box
@@ -332,6 +349,7 @@ class ContinuousBatchingScheduler:
                         r.fut.set_exception(exc)
                     self.stats.completed(r.model, 0.0, ok=False)
                 streak[0] = 0
+                self._note_streak(0)
                 backoff = self.worker_restart_backoff
                 continue
             if batch:
@@ -382,7 +400,9 @@ class ContinuousBatchingScheduler:
                 if fault is not None:
                     raise fault
                 self._dispatch(batch)
-                streak[0] = 0          # healthy dispatch ends the streak
+                if streak[0]:          # healthy dispatch ends the streak
+                    streak[0] = 0
+                    self._note_streak(0)
             except _WorkerCrashed:
                 raise
             except BaseException as e:
@@ -412,6 +432,10 @@ class ContinuousBatchingScheduler:
         if not live:
             return
         model = live[0].model
+        for r in live:
+            # queue wait = admission → dispatch; one histogram observe
+            # per request (same cost class as completed() below)
+            self.stats.queue_waited(r.model, (now - r.t_enqueue) * 1e3)
         try:
             entry = self.registry.acquire(model)
         except BaseException as e:

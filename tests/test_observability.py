@@ -146,6 +146,11 @@ PROM_METRIC_LINE = re.compile(
     r"(,[a-zA-Z_][a-zA-Z0-9_]*=\"[^\"]*\")*\})?" # more labels
     r" (-?\d+(\.\d+)?([eE][+-]?\d+)?|NaN|[+-]Inf)$")
 
+PROM_EXEMPLAR_SUFFIX = re.compile(
+    r"^\{trace_id=\"[^\"]*\"\} "                 # exemplar labelset
+    r"-?\d+(\.\d+)?([eE][+-]?\d+)? "             # exemplar value
+    r"\d+(\.\d+)?$")                             # exemplar timestamp
+
 
 def _assert_prometheus_grammar(text):
     assert text.endswith("\n")
@@ -154,6 +159,11 @@ def _assert_prometheus_grammar(text):
             parts = line.split()
             assert parts[3] in ("counter", "gauge", "summary"), line
             continue
+        # OpenMetrics exemplar suffix: `<sample> # {labels} value ts`
+        line, sep, exemplar = line.partition(" # ")
+        if sep:
+            assert PROM_EXEMPLAR_SUFFIX.match(exemplar), \
+                f"bad exemplar suffix: {exemplar!r}"
         assert PROM_METRIC_LINE.match(line), f"bad exposition line: {line!r}"
 
 
@@ -181,6 +191,69 @@ class TestPrometheusExposition:
         _assert_prometheus_grammar(text)
         assert "quantile" not in text
         assert "empty_count 0" in text
+
+    def test_exemplar_suffixes_only_where_observed(self):
+        # the p99 line carries the max-value exemplar, _count the
+        # latest; a histogram without exemplars renders plain lines,
+        # and a never-observed one renders no quantile to hang an
+        # exemplar on at all
+        reg = MetricsRegistry()
+        h = reg.histogram("lat", model="m")
+        h.observe(0.2, exemplar="tr-small")
+        h.observe(0.9, exemplar="tr-big")
+        h.observe(0.1)
+        reg.histogram("plain").observe(1.0)
+        reg.histogram("bare")                     # never observed
+        text = reg.to_prometheus()
+        _assert_prometheus_grammar(text)
+        p99 = [l for l in text.splitlines()
+               if l.startswith('lat{model="m",quantile="0.99"}')][0]
+        assert 'trace_id="tr-big"' in p99          # max value wins p99
+        count = [l for l in text.splitlines()
+                 if l.startswith('lat_count')][0]
+        assert 'trace_id="tr-big"' in count        # latest with exemplar
+        for line in text.splitlines():
+            if line.startswith(("plain", "bare")):
+                assert "trace_id" not in line
+        assert "bare_count 0" in text
+        assert 'bare{quantile' not in text
+
+    def test_concurrent_observe_during_expose(self):
+        # exposition walks live instruments while writers observe; the
+        # reservoir copy under the instrument lock must keep every
+        # render self-consistent and exception-free
+        reg = MetricsRegistry()
+        h = reg.histogram("lat")
+        c = reg.counter("hits")
+        stop = threading.Event()
+        errors = []
+
+        def writer():
+            i = 0
+            try:
+                while not stop.is_set():
+                    h.observe(i % 100 / 10.0, exemplar=f"t{i}")
+                    c.inc()
+                    i += 1
+            except Exception as e:       # pragma: no cover - failure path
+                errors.append(e)
+
+        threads = [threading.Thread(target=writer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        try:
+            for _ in range(50):
+                text = reg.to_prometheus()
+                _assert_prometheus_grammar(text)
+        finally:
+            stop.set()
+            for t in threads:
+                t.join(timeout=10)
+        assert not errors
+        final = reg.to_prometheus()
+        _assert_prometheus_grammar(final)
+        assert f"hits {int(c.value)}" in final
+        assert h.count == int(c.value)
 
 
 # ----------------------------------------------------------------- spans

@@ -1,0 +1,541 @@
+"""Telemetry series store + SLO engine tests.
+
+Unit coverage for the bounded ring (wraparound/eviction/windowing), the
+store's derived views (label matching, counter deltas/rates), the
+sampler (histogram expansion, lifecycle idempotence, callback
+isolation), the burn-rate engine (multi-window semantics, firing
+transitions into flight/trace/registry), the anomaly watch detectors —
+plus one end-to-end HTTP pin of the deterministic breach scenario: a
+slowed handler must flip /slo to firing within two evaluation ticks,
+degrade /healthz naming the objective, and leave a tagged flight dump.
+"""
+
+import glob
+import json
+import os
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.observe import reqtrace
+from deeplearning4j_tpu.observe.flight import (
+    FlightRecorder, get_flight, set_flight,
+)
+from deeplearning4j_tpu.observe.registry import MetricsRegistry
+from deeplearning4j_tpu.observe.series import (
+    SeriesRing, SeriesSampler, SeriesStore, series_key,
+)
+from deeplearning4j_tpu.observe.slo import (
+    SLO, AnomalyWatch, SLOEngine, default_slos,
+)
+
+T0 = 1_000_000.0
+
+
+# ------------------------------------------------------------ the ring
+class TestSeriesRing:
+    def test_wraparound_evicts_oldest(self):
+        r = SeriesRing("m", {}, "gauge", capacity=4)
+        for i in range(7):
+            r.append(T0 + i, float(i))
+        assert len(r) == 4
+        assert r.points() == [(T0 + 3, 3.0), (T0 + 4, 4.0),
+                              (T0 + 5, 5.0), (T0 + 6, 6.0)]
+        assert r.last() == (T0 + 6, 6.0)
+
+    def test_exact_capacity_boundary(self):
+        r = SeriesRing("m", {}, "gauge", capacity=3)
+        for i in range(3):
+            r.append(T0 + i, float(i))
+        assert [v for _, v in r.points()] == [0.0, 1.0, 2.0]
+        r.append(T0 + 3, 3.0)          # first eviction
+        assert [v for _, v in r.points()] == [1.0, 2.0, 3.0]
+
+    def test_window_filters_by_cutoff(self):
+        r = SeriesRing("m", {}, "gauge", capacity=16)
+        for i in range(10):
+            r.append(T0 + i, float(i))
+        pts = r.window(3.0, now=T0 + 9)
+        assert [v for _, v in pts] == [6.0, 7.0, 8.0, 9.0]
+        assert r.window(100.0, now=T0 + 9) == r.points()
+
+    def test_empty_ring(self):
+        r = SeriesRing("m", {}, "gauge", capacity=4)
+        assert len(r) == 0 and r.points() == [] and r.last() is None
+        assert r.window(10.0) == []
+
+    def test_series_key_sorts_labels(self):
+        assert series_key("m", {}) == "m"
+        assert series_key("m", {"b": "2", "a": "1"}) == "m{a=1,b=2}"
+
+
+# ----------------------------------------------------------- the store
+class TestSeriesStore:
+    def test_match_is_label_superset(self):
+        s = SeriesStore(capacity=8)
+        s.record("req", {"model": "a", "outcome": "ok"}, T0, 1.0)
+        s.record("req", {"model": "b", "outcome": "ok"}, T0, 2.0)
+        s.record("req", {"model": "a", "outcome": "shed"}, T0, 3.0)
+        s.record("other", {"model": "a"}, T0, 4.0)
+        assert len(s.match("req")) == 3
+        assert len(s.match("req", outcome="ok")) == 2
+        assert len(s.match("req", model="a", outcome="shed")) == 1
+        assert s.match("req", outcome="nope") == []
+
+    def test_delta_clamps_counter_reset(self):
+        s = SeriesStore(capacity=8)
+        ring = s.ring("c", {}, kind="counter")
+        ring.append(T0, 10.0)
+        ring.append(T0 + 1, 3.0)       # counter reset: never negative
+        assert s.delta("c", 100.0, now=T0 + 1) == 0.0
+        ring2 = s.ring("c", {"m": "x"}, kind="counter")
+        ring2.append(T0, 0.0)
+        ring2.append(T0 + 1, 5.0)
+        assert s.delta("c", 100.0, now=T0 + 1) == 5.0
+
+    def test_rate_per_second(self):
+        s = SeriesStore(capacity=8)
+        ring = s.ring("c", {}, kind="counter")
+        ring.append(T0, 0.0)
+        ring.append(T0 + 10, 20.0)
+        assert s.rate("c", 100.0, now=T0 + 10) == pytest.approx(2.0)
+        assert s.rate("missing", 100.0, now=T0 + 10) == 0.0
+
+    def test_snapshot_prefix_and_window(self):
+        s = SeriesStore(capacity=8)
+        s.record("aa", {}, time.time() - 100, 1.0)
+        s.record("aa", {}, time.time(), 2.0)
+        s.record("bb", {}, time.time(), 3.0)
+        snap = s.snapshot(prefix="aa")
+        assert list(snap["series"]) == ["aa"]
+        assert len(snap["series"]["aa"]["points"]) == 2
+        snap = s.snapshot(window_s=10.0, prefix="aa")
+        assert len(snap["series"]["aa"]["points"]) == 1
+
+
+# --------------------------------------------------------- the sampler
+class TestSeriesSampler:
+    def test_sample_once_expands_histograms(self):
+        reg = MetricsRegistry()
+        reg.counter("hits", model="a").inc(3)
+        reg.gauge("depth").set(7.0)
+        h = reg.histogram("lat_ms", model="a")
+        for v in (1.0, 2.0, 100.0):
+            h.observe(v)
+        reg.histogram("never_ms")      # registered, never observed
+        store = SeriesStore(capacity=8)
+        s = SeriesSampler(store, registry=reg, interval=99.0)
+        wrote = s.sample_once(now=T0)
+        keys = store.keys()
+        assert "hits{model=a}" in keys
+        assert "depth" in keys
+        assert "lat_ms:count{model=a}" in keys
+        assert "lat_ms:p50{model=a}" in keys
+        assert "lat_ms:p99{model=a}" in keys
+        # never-observed histogram: a count point, no quantile points
+        assert "never_ms:count" in keys
+        assert not [k for k in keys if k.startswith("never_ms:p")]
+        assert s.ticks == 1 and wrote == len(keys)
+        assert store.get("lat_ms:count{model=a}").kind == "counter"
+        assert store.get("lat_ms:p99{model=a}").kind == "quantile"
+
+    def test_start_stop_idempotent(self):
+        store = SeriesStore(capacity=8)
+        s = SeriesSampler(store, registry=MetricsRegistry(),
+                          interval=0.01)
+        assert not s.running
+        s.start()
+        t1 = s._thread
+        s.start()                      # second start: same thread
+        assert s._thread is t1 and s.running
+        deadline = time.time() + 5
+        while s.ticks == 0 and time.time() < deadline:
+            time.sleep(0.01)
+        assert s.ticks > 0
+        s.stop()
+        s.stop()                       # second stop: no-op
+        assert not s.running
+
+    def test_broken_callback_does_not_kill_tick(self):
+        store = SeriesStore(capacity=8)
+        reg = MetricsRegistry()
+        reg.gauge("g").set(1.0)
+        s = SeriesSampler(store, registry=reg, interval=99.0)
+        seen = []
+        s.add_callback(lambda now: (_ for _ in ()).throw(RuntimeError()))
+        s.add_callback(seen.append)
+        s.sample_once(now=T0)
+        s.sample_once(now=T0 + 1)
+        assert s.ticks == 2 and seen == [T0, T0 + 1]
+        assert store.get("g").last() == (T0 + 1, 1.0)
+
+
+# -------------------------------------------------------- burn semantics
+def _engine(slo, **kw):
+    store = SeriesStore(capacity=256)
+    reg = MetricsRegistry()
+    fr = FlightRecorder(capacity=64, dump_dir=kw.pop("dump_dir", None),
+                        enabled=True)
+    eng = SLOEngine(store, registry=reg, slos=[slo], flight=fr)
+    return store, reg, fr, eng
+
+
+class TestSLOEngine:
+    def test_sustained_breach_fires_within_two_ticks(self, tmp_path):
+        slo = SLO("lat", series="lat:p99", threshold=0.1,
+                  fast_s=30.0, slow_s=60.0)
+        store, reg, fr, eng = _engine(slo, dump_dir=str(tmp_path))
+        prev_store = reqtrace.set_trace_store(reqtrace.TraceStore())
+        try:
+            # every sample violating: windows clamp to what exists, so
+            # a fresh process alerts on the first evaluated tick
+            store.record("lat:p99", {}, T0, 0.5, kind="quantile")
+            out = eng.evaluate(now=T0)
+            assert out["firing"] == ["lat"]
+            store.record("lat:p99", {}, T0 + 1, 0.6, kind="quantile")
+            out = eng.evaluate(now=T0 + 1)
+            assert out["firing"] == ["lat"]
+            row = out["slos"][0]
+            assert row["burn_fast"] >= slo.burn_threshold
+            assert row["burn_slow"] >= slo.burn_threshold
+            assert row["value"] == 0.6
+            # breach closes the loop ONCE per transition: counter,
+            # gauges, forced trace, tagged dump with the window embedded
+            assert reg.counter("slo_breaches_total", slo="lat").value == 1
+            assert reg.gauge("slo_firing", slo="lat").value == 1.0
+            tid = row["trace_id"]
+            assert tid and tid in reqtrace.get_trace_store()
+            assert len(fr.dumps) == 1
+            assert "slo_breach_lat" in fr.dumps[0]
+            with open(fr.dumps[0]) as f:
+                doc = json.load(f)
+            breach = [e for e in doc["events"]
+                      if e["kind"] == "slo_breach"]
+            assert breach and breach[0]["data"]["windows"]["points"]
+        finally:
+            reqtrace.set_trace_store(prev_store)
+
+    def test_resolve_transition(self, tmp_path):
+        slo = SLO("lat", series="lat:p99", threshold=0.1,
+                  fast_s=5.0, slow_s=10.0)
+        store, reg, fr, eng = _engine(slo, dump_dir=str(tmp_path))
+        prev_store = reqtrace.set_trace_store(reqtrace.TraceStore())
+        try:
+            for i in range(3):
+                store.record("lat:p99", {}, T0 + i, 0.5, kind="quantile")
+                eng.evaluate(now=T0 + i)
+            assert eng.firing() == ["lat"]
+            # recovery: healthy points age the breach out of both windows
+            for i in range(20):
+                store.record("lat:p99", {}, T0 + 10 + i, 0.01,
+                             kind="quantile")
+            eng.evaluate(now=T0 + 30)
+            assert eng.firing() == []
+            assert reg.gauge("slo_firing", slo="lat").value == 0.0
+            assert any(e["kind"] == "slo_resolved" for e in fr.events())
+            # breach history survives resolution
+            assert eng.snapshot()["slos"][0]["breaches"] == 1
+        finally:
+            reqtrace.set_trace_store(prev_store)
+
+    def test_slow_window_dilution_prevents_blip_page(self):
+        slo = SLO("lat", series="lat:p99", threshold=0.1,
+                  fast_s=10.0, slow_s=200.0)
+        store, reg, fr, eng = _engine(slo)
+        # a long healthy history, then a short violating blip: fast
+        # window saturates but the slow window dilutes it below the
+        # burn threshold — no page
+        for i in range(100):
+            store.record("lat:p99", {}, T0 + i, 0.01, kind="quantile")
+        for i in range(3):
+            store.record("lat:p99", {}, T0 + 100 + i, 0.5,
+                         kind="quantile")
+        out = eng.evaluate(now=T0 + 102)
+        row = out["slos"][0]
+        assert row["burn_fast"] >= slo.burn_threshold
+        assert row["burn_slow"] < slo.burn_threshold
+        assert out["firing"] == []
+
+    def test_ratio_slo(self):
+        slo = SLO("avail", kind="ratio", series="req",
+                  num=[{"outcome": "failed"}],
+                  den=[{"outcome": "admitted"}],
+                  budget=0.01, fast_s=60.0, slow_s=120.0)
+        store, reg, fr, eng = _engine(slo)
+        adm = store.ring("req", {"outcome": "admitted"}, kind="counter")
+        bad = store.ring("req", {"outcome": "failed"}, kind="counter")
+        for i in range(5):
+            adm.append(T0 + i, 10.0 * i)     # 40 admitted over window
+            bad.append(T0 + i, 5.0 * i)      # 20 failed → ratio 0.5
+        burn, value, _ = slo.burn(store, 60.0, T0 + 4)
+        assert value == pytest.approx(0.5)
+        assert burn == pytest.approx(50.0)
+        out = eng.evaluate(now=T0 + 4)
+        assert out["firing"] == ["avail"]
+        assert out["slos"][0]["value"] == pytest.approx(0.5)
+
+    def test_rate_slo_uses_threshold_as_budget(self):
+        slo = SLO("recompiles", kind="rate_per_min",
+                  series="jit_compiles", threshold=12.0,
+                  fast_s=60.0, slow_s=120.0)
+        store, reg, fr, eng = _engine(slo)
+        ring = store.ring("jit_compiles", {"owner": "X"}, kind="counter")
+        ring.append(T0, 0.0)
+        ring.append(T0 + 60, 24.0)           # 24/min = 2x threshold
+        burn, rate, _ = slo.burn(store, 120.0, T0 + 60)
+        assert rate == pytest.approx(24.0)
+        assert burn == pytest.approx(2.0)
+        assert slo.burn_threshold == 1.0     # rate kind fires at 1x
+
+    def test_missing_series_never_fires(self):
+        slo = SLO("lat", series="absent:p99", threshold=0.1)
+        store, reg, fr, eng = _engine(slo)
+        out = eng.evaluate(now=T0)
+        row = out["slos"][0]
+        assert row["burn_fast"] == 0.0 and row["value"] is None
+        assert out["firing"] == []
+
+    def test_default_slos_cover_the_objective_set(self):
+        names = {s.name for s in default_slos()}
+        assert names == {"latency-p99", "ttft-p99", "itl-p99",
+                         "availability", "queue-wait-p99",
+                         "recompile-rate", "worker-restart-streak"}
+
+
+# ------------------------------------------------------- anomaly watch
+class TestAnomalyWatch:
+    def _storm_store(self, burst):
+        store = SeriesStore(capacity=256)
+        ring = store.ring("jit_compiles", {"owner": "Runner@1"},
+                          kind="counter")
+        for i in range(10):                   # steady early history
+            ring.append(T0 + i, 5.0)
+        ring.append(T0 + 150, 5.0 + burst)    # recent window
+        return store
+
+    def test_recompile_storm_warns_once_naming_owner(self):
+        store = self._storm_store(burst=4)
+        w = AnomalyWatch(store, registry=MetricsRegistry(),
+                         recent_s=60.0, storm_compiles=3)
+        now = T0 + 150
+        w.check(now=now)
+        w.check(now=now)                      # still active: no repeat
+        assert len(w.warnings) == 1
+        warn = w.warnings[0]
+        assert warn["kind"] == "recompile_storm"
+        assert warn["owner"] == "Runner@1" and warn["burst"] == 4.0
+        assert w.registry.counter("anomaly_warnings_total",
+                                  kind="recompile_storm").value == 1
+
+    def test_recompile_storm_rearms_after_clear(self):
+        store = self._storm_store(burst=4)
+        w = AnomalyWatch(store, registry=MetricsRegistry(),
+                         recent_s=60.0, storm_compiles=3)
+        w.check(now=T0 + 150)
+        assert len(w.warnings) == 1
+        ring = store.match("jit_compiles")[0]
+        ring.append(T0 + 300, 9.0)            # flat again → clears
+        w.check(now=T0 + 300)
+        ring.append(T0 + 450, 14.0)           # second storm
+        w.check(now=T0 + 450)
+        assert len(w.warnings) == 2
+
+    def test_quiet_history_required_before_storm(self):
+        # a fresh process compiling its first programs is NOT a storm
+        store = SeriesStore(capacity=64)
+        ring = store.ring("jit_compiles", {"owner": "R@1"},
+                          kind="counter")
+        for i in range(5):
+            ring.append(T0 + i, float(i * 2))
+        w = AnomalyWatch(store, registry=MetricsRegistry(),
+                         recent_s=60.0)
+        w.check(now=T0 + 5)                   # history < 2*recent_s
+        assert w.warnings == []
+
+    def test_sync_regression_blames_owner(self):
+        store = SeriesStore(capacity=64)
+        ring = store.ring("train_host_syncs_per_step", {}, kind="gauge")
+        for i in range(6):                    # baseline median 0.25
+            ring.append(T0 + i, 0.25)
+        ring.append(T0 + 150, 1.5)            # regression
+        w = AnomalyWatch(store, registry=MetricsRegistry(),
+                         recent_s=60.0, sync_margin=0.75)
+        w.check(now=T0 + 150)
+        assert len(w.warnings) == 1
+        assert w.warnings[0]["kind"] == "sync_regression"
+        assert w.warnings[0]["value"] == 1.5
+        assert "owner" in w.warnings[0]
+
+
+# -------------------------------------------- serving wiring (healthz)
+def _make_net():
+    from deeplearning4j_tpu import InputType
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+
+    return MultiLayerNetwork(
+        (NeuralNetConfiguration.builder()
+         .seed(0).list(DenseLayer(n_out=8, activation="relu"),
+                       OutputLayer(n_out=2, activation="softmax"))
+         .set_input_type(InputType.feed_forward(4))
+         .build())).init()
+
+
+def _get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=10) as r:
+        return json.loads(r.read())
+
+
+def _post(port, path, payload):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        return json.loads(r.read())
+
+
+class TestHealthzVerdicts:
+    def test_worker_streak_degrades_healthz(self):
+        from deeplearning4j_tpu.serving.inference_server import (
+            InferenceServer,
+        )
+        srv = InferenceServer(_make_net(), port=0)
+        srv.start()
+        try:
+            assert _get(srv.port, "/healthz")["status"] == "ok"
+            srv.scheduler.restart_streak = lambda: 4
+            body = _get(srv.port, "/healthz")
+            assert body["status"] == "degraded"
+            assert any("crash-looping (streak 4)" in r
+                       for r in body["reasons"])
+        finally:
+            srv.stop()
+
+    def test_owned_watchdog_trip_degrades_healthz(self):
+        from deeplearning4j_tpu.observe.watchdog import (
+            get_watchdog, set_watchdog,
+        )
+        from deeplearning4j_tpu.serving.inference_server import (
+            InferenceServer,
+        )
+
+        class FakeWatchdog:
+            def snapshot(self):
+                return {"per_owner": {
+                    "BatchRunner@7": {"compiles": 40, "warned": True},
+                    "Other@1": {"compiles": 40, "warned": True},
+                }, "total_compiles": 80}
+
+        srv = InferenceServer(_make_net(), port=0)
+        srv.start()
+        prev = set_watchdog(FakeWatchdog())
+        try:
+            # a tripped owner this server does NOT own must not degrade
+            srv._owned_watchdog_tags = lambda: {"Elsewhere@9"}
+            assert _get(srv.port, "/healthz")["status"] == "ok"
+            srv._owned_watchdog_tags = lambda: {"BatchRunner@7"}
+            body = _get(srv.port, "/healthz")
+            assert body["status"] == "degraded"
+            assert any("recompile watchdog tripped: BatchRunner@7" in r
+                       for r in body["reasons"])
+        finally:
+            set_watchdog(prev)
+            srv.stop()
+
+    def test_scheduler_streak_gauge_tracks_worst_worker(self):
+        from deeplearning4j_tpu.serving.metrics import ServingStats
+        from deeplearning4j_tpu.serving.scheduler import (
+            ContinuousBatchingScheduler,
+        )
+
+        class _Reg:
+            def acquire(self, name):
+                raise KeyError(name)
+
+        stats = ServingStats(registry=MetricsRegistry())
+        sched = ContinuousBatchingScheduler(_Reg(), stats, slots=1)
+        try:
+            sched._note_streak(3)
+            assert sched.restart_streak() == 3
+            assert stats.registry.gauge(
+                "serving_worker_restart_streak").value == 3.0
+            sched._note_streak(0)
+            assert sched.restart_streak() == 0
+        finally:
+            sched.shutdown()
+
+
+# ------------------------------------------- end-to-end breach pinning
+class TestServerBreachE2E:
+    def test_deterministic_breach_scenario(self, tmp_path, monkeypatch):
+        """The pinned scenario: slow the model's dispatch, push traffic,
+        and the whole alerting chain must engage within two forced
+        evaluation ticks — /slo firing, /healthz degraded naming the
+        objective, a tagged flight dump, a forced trace."""
+        from deeplearning4j_tpu.serving.inference_server import (
+            InferenceServer,
+        )
+        monkeypatch.setenv("DL4J_TPU_FLIGHT_DIR", str(tmp_path))
+        prev_flight = set_flight(FlightRecorder(
+            capacity=128, dump_dir=str(tmp_path), enabled=True))
+        prev_traces = reqtrace.set_trace_store(reqtrace.TraceStore())
+        srv = InferenceServer(
+            _make_net(), port=0, slo=True,
+            slo_objectives=[SLO("latency-p99",
+                                series="serving_latency_seconds:p99",
+                                threshold=0.030, fast_s=30.0,
+                                slow_s=60.0)],
+            series_interval=30.0)      # ticks forced via ?refresh=1
+        srv.start()
+        try:
+            entry = srv.registry.get("default")
+            orig = entry.run_batch
+
+            def slow_run_batch(xs):
+                time.sleep(0.08)
+                return orig(xs)
+
+            entry.run_batch = slow_run_batch
+            for _ in range(4):
+                _post(srv.port, "/output",
+                      {"ndarray": np.zeros((1, 4)).tolist()})
+
+            doc = None
+            for _ in range(2):         # breach within two ticks
+                doc = _get(srv.port, "/slo?refresh=1")
+                if doc["firing"]:
+                    break
+            assert doc["firing"] == ["latency-p99"]
+            row = doc["slos"][0]
+            assert row["value"] > 0.030 and row["trace_id"]
+
+            health = _get(srv.port, "/healthz")
+            assert health["status"] == "degraded"
+            assert any("slo firing: latency-p99" in r
+                       for r in health["reasons"])
+            assert health["slo_breaches"][0]["slo"] == "latency-p99"
+
+            dumps = glob.glob(str(tmp_path / "flight_*slo_breach*"))
+            assert dumps, "breach must leave a tagged flight dump"
+            with open(dumps[0]) as f:
+                dump_doc = json.load(f)
+            breach = [e for e in dump_doc["events"]
+                      if e["kind"] == "slo_breach"]
+            assert breach[0]["data"]["windows"]["points"]
+
+            trace = _get(srv.port, f"/trace/{row['trace_id']}")
+            assert trace["spans"]
+
+            series = _get(srv.port,
+                          "/series?prefix=serving_latency_seconds")
+            assert series["enabled"] and series["series"]
+        finally:
+            srv.stop()
+            set_flight(prev_flight)
+            reqtrace.set_trace_store(prev_traces)

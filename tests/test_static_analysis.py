@@ -311,6 +311,29 @@ FIXTURES = {
              return y
          """, True, False),
     ],
+    "GL602": [
+        ("""
+         from deeplearning4j_tpu.observe.registry import get_registry
+         def worker(batches):
+             reg = get_registry()
+             for b in batches:
+                 run(b)
+                 doc = reg.snapshot()
+         """, True, True),
+        ("""
+         import jax
+         @jax.jit
+         def step(metrics, x):
+             metrics.to_prometheus()
+             return x
+         """, False, True),
+        ("""
+         from deeplearning4j_tpu.observe.registry import get_registry
+         def report():
+             reg = get_registry()
+             return reg.snapshot()
+         """, True, False),
+    ],
 }
 
 

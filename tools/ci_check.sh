@@ -10,6 +10,9 @@
 #   tools/ci_check.sh --trace    # request-tracing smoke: one sampled
 #                                #   /generate must reconstruct an
 #                                #   HTTP→dispatch→session trace tree
+#   tools/ci_check.sh --slo      # SLO smoke: deliberate latency breach
+#                                #   must fire /slo, degrade /healthz,
+#                                #   write an slo_breach flight dump
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -30,6 +33,12 @@ fi
 if [[ "${1:-}" == "--trace" ]]; then
     echo "== request-tracing smoke (/generate → /trace/{id}) =="
     env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python tools/trace_smoke.py
+    exit 0
+fi
+
+if [[ "${1:-}" == "--slo" ]]; then
+    echo "== SLO smoke (latency breach → /slo firing, degraded /healthz, flight dump) =="
+    env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python tools/slo_smoke.py
     exit 0
 fi
 

@@ -33,7 +33,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 BASELINE_PATH = os.path.join(os.path.dirname(__file__), "..",
                              ".graftperf-baseline.json")
-WORKLOAD_VERSION = 3
+WORKLOAD_VERSION = 4
 
 # Default slack written into a fresh baseline: zero extra compiles (a
 # new program IS the regression being hunted) and half a sync of noise
@@ -49,7 +49,13 @@ DEFAULT_BUDGETS = {"extra_compiles_per_owner": 0,
                    # request tracing is sync-free BY CONTRACT
                    # (PERF_NOTES): a traced fit may add exactly zero
                    # host syncs over the untraced one
-                   "extra_traced_syncs_per_step": 0.0}
+                   "extra_traced_syncs_per_step": 0.0,
+                   # the telemetry series sampler + SLO engine read
+                   # host-side registry state only (PERF_NOTES): running
+                   # them through a fit may add exactly zero syncs and
+                   # zero compiles
+                   "extra_series_syncs_per_step": 0.0,
+                   "extra_series_compiles": 0}
 
 
 def run_workload() -> dict:
@@ -132,6 +138,51 @@ def run_workload() -> dict:
                                           3),
         }
 
+        # --- series/SLO leg: the SAME steady-state fit with the
+        # telemetry sampler ticking fast and the SLO engine + anomaly
+        # watch evaluating on every tick. Both read host-side registry
+        # state only (PERF_NOTES), so the leg must add ZERO syncs and
+        # ZERO compiles over the plain run — gated below via
+        # extra_series_syncs_per_step / extra_series_compiles.
+        from deeplearning4j_tpu.observe.registry import get_registry
+        from deeplearning4j_tpu.observe.series import (
+            SeriesSampler, SeriesStore,
+        )
+        from deeplearning4j_tpu.observe.slo import (
+            AnomalyWatch, SLOEngine, default_slos,
+        )
+        store = SeriesStore(capacity=256)
+        sampler = SeriesSampler(store, registry=get_registry(),
+                                interval=0.02)
+        engine = SLOEngine(store, slos=default_slos(),
+                           registry=get_registry())
+        watch = AnomalyWatch(store, registry=get_registry())
+        sampler.add_callback(engine.evaluate)
+        sampler.add_callback(watch.check)
+        compiles_before = get_watchdog().snapshot()["total_compiles"]
+        sampler.start()
+        mon = HostSyncMonitor().install()
+        try:
+            net.fit(x, y, batch_size=8, epochs=2)
+            # a warm CPU fit can finish inside one sampler interval, so
+            # pump deterministic ticks under the monitor too — the full
+            # sample -> SLO evaluate -> anomaly check path must measure
+            # regardless of thread timing
+            for _ in range(8):
+                sampler.sample_once()
+        finally:
+            mon.uninstall()
+            sampler.stop()
+        series_syncs = mon.syncs / steps
+        series = {
+            "syncs_per_step": round(series_syncs, 3),
+            "extra_syncs_per_step": round(series_syncs - syncs_per_step,
+                                          3),
+            "extra_compiles": get_watchdog().snapshot()["total_compiles"]
+            - compiles_before,
+            "ticks": sampler.ticks,
+        }
+
         # --- windowed-attention transformer fit: the dispatch-policy
         # seam (attention/banded policies run at trace time) ------------
         T, V = 32, 16
@@ -207,6 +258,7 @@ def run_workload() -> dict:
         "total_compiles": snap["total_compiles"],
         "syncs_per_step": round(syncs_per_step, 3),
         "traced": traced,
+        "series": series,
         "sharded": sharded,
     }
 
@@ -260,6 +312,24 @@ def compare(baseline: dict, measured: dict) -> list:
                 f"the untraced run (budget +{t_budget}) — a span or "
                 f"exemplar attribute is materializing a device value; "
                 f"tracing must stay sync-free (GL601)")
+    # series/SLO leg: only gated once a baseline recorded it
+    if baseline.get("series"):
+        meas_se = measured.get("series") or {}
+        s_budget = budgets["extra_series_syncs_per_step"]
+        if meas_se.get("extra_syncs_per_step", 0.0) > s_budget:
+            breaches.append(
+                f"fit with the series sampler + SLO engine live added "
+                f"{meas_se.get('extra_syncs_per_step')} syncs/step over "
+                f"the plain run (budget +{s_budget}) — telemetry "
+                f"sampling touched a device value; the sampler reads "
+                f"host-side registry state only (GL602)")
+        c_budget = budgets["extra_series_compiles"]
+        if meas_se.get("extra_compiles", 0) > c_budget:
+            breaches.append(
+                f"fit with the series sampler + SLO engine live added "
+                f"{meas_se.get('extra_compiles')} jit compile(s) "
+                f"(budget +{c_budget}) — the telemetry path must never "
+                f"enter jit")
     # sharded-spine leg: only gated once a baseline recorded it
     base_sh = baseline.get("sharded")
     if base_sh:
@@ -310,6 +380,12 @@ def diff(baseline: dict, measured: dict) -> list:
         m = (measured.get("traced") or {}).get(key)
         if b != m:
             out.append(f"  traced.{key}: {b} -> {m}")
+    for key in ("syncs_per_step", "extra_syncs_per_step",
+                "extra_compiles"):
+        b = (baseline.get("series") or {}).get(key)
+        m = (measured.get("series") or {}).get(key)
+        if b != m:
+            out.append(f"  series.{key}: {b} -> {m}")
     return out
 
 

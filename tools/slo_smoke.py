@@ -1,0 +1,142 @@
+#!/usr/bin/env python
+"""CI smoke for the SLO engine (`tools/ci_check.sh --slo`).
+
+Boots a real InferenceServer (CPU) with the telemetry sampler + SLO
+engine enabled and a deliberately slowed handler, then asserts the
+whole breach loop:
+
+  1. /slo reaches firing state for the latency objective within two
+     evaluation ticks of the breach traffic completing;
+  2. /healthz flips to degraded with the breach named in the reasons;
+  3. a FlightRecorder dump tagged `slo_breach` exists on disk and
+     embeds the offending series window points;
+  4. the breach minted a forced trace exemplar resolvable via
+     /trace/{id}.
+
+Exits nonzero with the offending JSON on any miss, so the gate catches
+a broken seam, not just a broken import.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import sys
+import tempfile
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+
+def _get(base, path):
+    with urllib.request.urlopen(base + path, timeout=10) as r:
+        return json.loads(r.read())
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    flight_dir = tempfile.mkdtemp(prefix="slo_smoke_flight_")
+    os.environ["DL4J_TPU_FLIGHT_DIR"] = flight_dir
+
+    import numpy as np
+
+    from deeplearning4j_tpu import InputType
+    from deeplearning4j_tpu.models import MultiLayerNetwork
+    from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+    from deeplearning4j_tpu.nn.layers import DenseLayer, OutputLayer
+    from deeplearning4j_tpu.observe.slo import SLO
+    from deeplearning4j_tpu.serving import InferenceServer
+
+    net = MultiLayerNetwork(
+        (NeuralNetConfiguration.builder()
+         .seed(0).list(DenseLayer(n_out=8, activation="relu"),
+                       OutputLayer(n_out=2, activation="softmax"))
+         .set_input_type(InputType.feed_forward(4))
+         .build())).init()
+
+    # one objective, tight windows: request p99 must stay under 40 ms
+    slos = [SLO("latency-p99", series="serving_latency_seconds:p99",
+                threshold=0.040, fast_s=30.0, slow_s=60.0,
+                description="smoke: p99 under 40ms")]
+    srv = InferenceServer(net, port=0, slo=True, slo_objectives=slos,
+                          series_interval=0.2)
+    port = srv.start()
+    base = f"http://127.0.0.1:{port}"
+    try:
+        # deliberate latency breach: wrap the deployed entry's dispatch
+        # with a sleep — every request now takes >= 120 ms
+        entry = srv.registry.get("default")
+        orig = entry.run_batch
+
+        def slow_run_batch(xs):
+            time.sleep(0.12)
+            return orig(xs)
+        entry.run_batch = slow_run_batch
+
+        body = json.dumps(
+            {"ndarray": np.zeros((1, 4)).tolist()}).encode()
+        for _ in range(5):
+            req = urllib.request.Request(
+                base + "/output", data=body,
+                headers={"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=30) as r:
+                r.read()
+
+        # the breach must fire within two evaluation ticks of the slow
+        # traffic completing (?refresh=1 forces one tick per poll)
+        slo_doc = None
+        for _ in range(2):
+            slo_doc = _get(base, "/slo?refresh=1")
+            if "latency-p99" in slo_doc.get("firing", []):
+                break
+        if "latency-p99" not in (slo_doc or {}).get("firing", []):
+            print(json.dumps(slo_doc, indent=1)[:4000])
+            sys.exit("FAIL: /slo did not fire latency-p99 within two "
+                     "evaluation ticks")
+        rec = [r for r in slo_doc["slos"] if r["name"] == "latency-p99"][0]
+        if not rec.get("trace_id"):
+            sys.exit("FAIL: firing SLO carries no forced trace id")
+
+        health = _get(base, "/healthz")
+        named = any("latency-p99" in r for r in health.get("reasons", []))
+        if health.get("status") != "degraded" or not named:
+            print(json.dumps(health, indent=1))
+            sys.exit("FAIL: /healthz did not degrade naming the "
+                     "breached objective")
+
+        dumps = glob.glob(os.path.join(flight_dir,
+                                       "flight_*slo_breach*.json"))
+        if not dumps:
+            sys.exit(f"FAIL: no slo_breach flight dump in {flight_dir}")
+        with open(dumps[0]) as f:
+            doc = json.load(f)
+        breach_events = [e for e in doc.get("events", [])
+                         if e.get("kind") == "slo_breach"]
+        if not breach_events:
+            sys.exit("FAIL: slo_breach dump carries no slo_breach event")
+        pts = (breach_events[0]["data"].get("windows") or {}).get("points")
+        if not pts:
+            sys.exit("FAIL: slo_breach event embeds no offending window "
+                     "points")
+
+        tree = _get(base, f"/trace/{rec['trace_id']}")
+        if not tree.get("spans"):
+            sys.exit("FAIL: forced trace exemplar not resolvable")
+
+        series = _get(base, "/series?prefix=serving_latency")
+        if not series.get("series"):
+            sys.exit("FAIL: /series has no latency series")
+
+        print(f"slo smoke OK: latency-p99 fired (burn_fast="
+              f"{rec['burn_fast']}, value={rec['value']:.3f}s), healthz "
+              f"degraded, dump {os.path.basename(dumps[0])}, trace "
+              f"{rec['trace_id']}")
+        return 0
+    finally:
+        srv.stop()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
