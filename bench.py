@@ -34,11 +34,13 @@ continuous-batching scheduler against the legacy collect-then-run loop
 /metrics); writes BENCH_serving.json (see _serving_main; knobs:
 BENCH_SERVING_CLIENTS/SECS/ROWS/MAX_BATCH/TPU/OUT).
 `python bench.py --serving-decode` (or BENCH_SERVING_DECODE=1) runs the
-closed-loop prompt→stream decode workload against POST /generate:
-tokens/sec + p99 TTFT/ITL reconciled against the /metrics decode
-section, zero-recompiles-after-warmup asserted; writes
-BENCH_serving_decode.json (see _serving_decode_main; knobs:
-BENCH_DECODE_CLIENTS/ROUNDS/MAX_TOKENS/PROMPT/PREFILL_CHUNK/OUT).
+closed-loop prompt→stream decode workload against POST /generate, one
+leg per fused-decode K (default K∈{1,4,8}): tokens/sec + round
+trips/token + p99 TTFT/ITL reconciled against the /metrics decode
+section, zero-recompiles-after-warmup and cross-K greedy parity
+asserted; writes BENCH_serving_decode.json (see _serving_decode_main;
+knobs: BENCH_DECODE_CLIENTS/ROUNDS/MAX_TOKENS/PROMPT/PREFILL_CHUNK/
+KS/OUT).
 `python bench.py --sharding` (or BENCH_SHARDING=1) profiles the GSPMD
 sharding spine on a forced-8-device CPU mesh: per-device param +
 optimizer-moment bytes replicated vs sharded, syncs/step, post-warmup
@@ -731,10 +733,20 @@ def _append_history(mode, summary):
            "mode": mode}
     for k in ("metric", "value", "unit", "vs_baseline", "mfu", "batch",
               "config", "platform", "device", "devices",
-              "opt_state_shard_factor", "throughput_ratio"):
+              "opt_state_shard_factor", "throughput_ratio", "fused_k",
+              "speedup_vs_stepwise", "greedy_parity"):
         v = summary.get(k)
         if v is not None and not isinstance(v, (dict, list)):
             row[k] = v
+    # per-K decode legs trend as a compact nested list (tools/dash.py
+    # ignores keys it doesn't render)
+    if isinstance(summary.get("legs"), list):
+        row["legs"] = [
+            {"k": leg.get("fused_k"),
+             "tokens_per_s": leg.get("tokens_per_s"),
+             "round_trips_per_token": leg.get("round_trips_per_token"),
+             "itl_p99_ms": (leg.get("itl_ms") or {}).get("p99")}
+            for leg in summary["legs"]]
     for k, sub in (("ttft_p99_ms", ("ttft_ms", "p99")),
                    ("itl_p99_ms", ("itl_ms", "p99")),
                    ("continuous_p99_ms", ("modes", "continuous",
@@ -1080,25 +1092,33 @@ def _serving_decode_main():
     """`--serving-decode` mode: closed-loop prompt→stream workload
     against POST /generate — N concurrent clients, each opening a
     session, reading its SSE token stream to completion, and
-    immediately opening the next (closed loop). Reports device-truth
-    decode serving numbers:
+    immediately opening the next (closed loop). Runs one LEG per
+    fused-decode window size K (BENCH_DECODE_KS, default "1,4,8" —
+    K=1 is the stepwise baseline) and reports device-truth decode
+    serving numbers per leg:
 
-      tokens/sec        aggregate streamed tokens over wall time
-      TTFT p50/p99      request-start → first token (client-side)
-      ITL p50/p99       gap between consecutive streamed tokens
+      tokens/sec          aggregate streamed tokens over wall time
+      round_trips/token   host dispatches per streamed token (the
+                          quantity fused decode divides by K)
+      TTFT p50/p99        request-start → first token (client-side)
+      ITL p50/p99         gap between consecutive streamed tokens
 
-    and reconciles them against the server's /metrics decode section
-    (tokens_streamed, session outcomes, shared-dispatch counters) plus
+    each reconciled against the server's /metrics decode section
+    (tokens_streamed, window counters, shared-dispatch counters) plus
     the recompile watchdog: after the manager's warmup, session churn
-    must cause ZERO compiles (the fixed-shape decode contract).
+    must cause ZERO compiles at every K (the fixed-shape decode
+    contract). Every leg also streams one fixed-prompt greedy probe;
+    `greedy_parity` asserts all legs emitted the bit-exact same
+    sequence (the fused-decode parity contract, measured end-to-end).
 
-    The workload runs TWICE — once with request tracing off (the
-    zero-allocation baseline) and once with DL4J_TPU_TRACE_SAMPLE=1
-    (every request traced) — so the artifact carries the measured
-    sampled-on overhead (`tracing.trace_overhead_pct`, contract <2%)
-    plus one exemplar trace tree (`trace`, renderable with
-    tools/trace_view.py). Emits one JSON line AND writes
-    BENCH_serving_decode.json (BENCH_DECODE_OUT overrides)."""
+    The primary (largest-K) leg runs its workload TWICE — once with
+    request tracing off (the zero-allocation baseline) and once with
+    DL4J_TPU_TRACE_SAMPLE=1 (every request traced) — so the artifact
+    carries the measured sampled-on overhead
+    (`tracing.trace_overhead_pct`, contract <2%) plus one exemplar
+    trace tree (`trace`, renderable with tools/trace_view.py). Emits
+    one JSON line AND writes BENCH_serving_decode.json
+    (BENCH_DECODE_OUT overrides)."""
     import jax
 
     if not os.environ.get("BENCH_SERVING_TPU"):
@@ -1126,156 +1146,218 @@ def _serving_decode_main():
     max_tokens = int(os.environ.get("BENCH_DECODE_MAX_TOKENS", "32"))
     prompt_len = int(os.environ.get("BENCH_DECODE_PROMPT", "12"))
     chunk = int(os.environ.get("BENCH_DECODE_PREFILL_CHUNK", "8"))
+    ks = sorted({int(x) for x in os.environ.get(
+        "BENCH_DECODE_KS", "1,4,8").split(",") if x.strip()})
     V = 32
+    probe_prompt = [(i % (V - 1)) + 1 for i in range(prompt_len)]
 
-    conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(1e-3))
-            .activation("identity")
-            .list(EmbeddingSequenceLayer(n_in=V, n_out=32),
-                  PositionEmbeddingLayer(max_length=256),
-                  TransformerEncoderBlock(num_heads=4, causal=True,
-                                          window=32, rolling_cache=True,
-                                          max_cache=64),
-                  RnnOutputLayer(n_out=V, activation="softmax"))
-            .set_input_type(InputType.recurrent(1, chunk)).build())
-    net = MultiLayerNetwork(conf).init()
-
-    srv = InferenceServer(net, port=0, decode_slots=clients,
-                          decode_prefill_chunk=chunk,
-                          max_batch_size=max(8, clients),
-                          queue_capacity=max(64, 8 * clients))
-    port = srv.start()
-    base = f"http://127.0.0.1:{port}"
-    compiles_after_warmup = get_watchdog().compiles()
-
-    rng = np.random.default_rng(0)
-    lock = threading.Lock()
-    ttfts, itls, tok_total, done_sessions = [], [], [0], [0]
-    errors = []
-    trace_ids = []
-
-    def one_generation(seed):
-        body = json.dumps({
-            "prompt_ids": rng.integers(0, V, prompt_len).tolist(),
-            "max_tokens": max_tokens, "seed": int(seed),
-            "temperature": 0.9}).encode()
-        req = urllib.request.Request(
-            base + "/generate", data=body,
-            headers={"Content-Type": "application/json"})
-        t0 = time.perf_counter()
-        first, prev, n = None, None, 0
-        with urllib.request.urlopen(req, timeout=120) as r:
-            for line in r:
-                line = line.decode().strip()
-                if not line.startswith("data: "):
-                    continue
-                ev = json.loads(line[6:])
-                tid = ev.get("trace_id")
-                if tid:
-                    with lock:
-                        trace_ids.append(tid)
-                if "token" in ev:
-                    now = time.perf_counter()
-                    if first is None:
-                        first = (now - t0) * 1e3
-                    else:
-                        with lock:
-                            itls.append((now - prev) * 1e3)
-                    prev = now
-                    n += 1
-                elif "error" in ev:
-                    raise RuntimeError(ev["error"])
-        if n != max_tokens or first is None:
-            raise RuntimeError(f"short stream: {n}/{max_tokens}")
-        with lock:
-            ttfts.append(first)
-            tok_total[0] += n
-            done_sessions[0] += 1
-
-    def client(i):
-        try:
-            for rd in range(rounds):
-                one_generation(i * 1000 + rd)
-        except BaseException as e:     # surfaced in the artifact
-            with lock:
-                errors.append(f"{type(e).__name__}: {e}")
-
-    def run_pass():
-        threads = [threading.Thread(target=client, args=(i,))
-                   for i in range(clients)]
-        t_p = time.monotonic()
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
-        return time.monotonic() - t_p
-
-    # pass 1: sampling off — the zero-allocation fast path
-    prev_sample = os.environ.pop("DL4J_TPU_TRACE_SAMPLE", None)
-    wall_off = run_pass()
-    toks_off = tok_total[0]
-    # pass 2: every request traced — measures the sampled-on tax
-    os.environ["DL4J_TPU_TRACE_SAMPLE"] = "1"
-    try:
-        wall_on = run_pass()
-    finally:
-        if prev_sample is None:
-            os.environ.pop("DL4J_TPU_TRACE_SAMPLE", None)
-        else:
-            os.environ["DL4J_TPU_TRACE_SAMPLE"] = prev_sample
-    toks_on = tok_total[0] - toks_off
-    wall = wall_off + wall_on
-    compile_delta = get_watchdog().compiles() - compiles_after_warmup
-
-    with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
-        metrics = json.loads(r.read())
-    trace_block = None
-    if trace_ids:
-        with urllib.request.urlopen(
-                base + "/trace/" + trace_ids[-1], timeout=10) as r:
-            trace_block = json.loads(r.read())
-    srv.stop()
-    decode = metrics["decode"]["default"]
+    def build_net():
+        conf = (NeuralNetConfiguration.builder().seed(0)
+                .updater(Adam(1e-3)).activation("identity")
+                .list(EmbeddingSequenceLayer(n_in=V, n_out=32),
+                      PositionEmbeddingLayer(max_length=256),
+                      TransformerEncoderBlock(num_heads=4, causal=True,
+                                              window=32,
+                                              rolling_cache=True,
+                                              max_cache=64),
+                      RnnOutputLayer(n_out=V, activation="softmax"))
+                .set_input_type(InputType.recurrent(1, chunk)).build())
+        return MultiLayerNetwork(conf).init()
 
     def pct(vals, q):
         vals = sorted(vals)
         return (None if not vals else
                 round(vals[min(len(vals) - 1, int(q * len(vals)))], 3))
 
-    toks = tok_total[0]
+    def run_leg(fused_k, *, traced_pass):
+        net = build_net()
+        srv = InferenceServer(net, port=0, decode_slots=clients,
+                              decode_prefill_chunk=chunk,
+                              decode_fused_k=fused_k,
+                              max_batch_size=max(8, clients),
+                              queue_capacity=max(64, 8 * clients))
+        port = srv.start()
+        base = f"http://127.0.0.1:{port}"
+        compiles_after_warmup = get_watchdog().compiles()
+
+        rng = np.random.default_rng(0)
+        lock = threading.Lock()
+        ttfts, itls, tok_total, done_sessions = [], [], [0], [0]
+        errors = []
+        trace_ids = []
+
+        def stream(body):
+            req = urllib.request.Request(
+                base + "/generate", data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"})
+            t0 = time.perf_counter()
+            first, prev, n, toks = None, None, 0, []
+            with urllib.request.urlopen(req, timeout=120) as r:
+                for line in r:
+                    line = line.decode().strip()
+                    if not line.startswith("data: "):
+                        continue
+                    ev = json.loads(line[6:])
+                    tid = ev.get("trace_id")
+                    if tid:
+                        with lock:
+                            trace_ids.append(tid)
+                    if "token" in ev:
+                        now = time.perf_counter()
+                        if first is None:
+                            first = (now - t0) * 1e3
+                        else:
+                            with lock:
+                                itls.append((now - prev) * 1e3)
+                        prev = now
+                        n += 1
+                        toks.append(ev["token"])
+                    elif "error" in ev:
+                        raise RuntimeError(ev["error"])
+            return first, n, toks
+
+        def one_generation(seed):
+            first, n, _ = stream({
+                "prompt_ids": rng.integers(0, V, prompt_len).tolist(),
+                "max_tokens": max_tokens, "seed": int(seed),
+                "temperature": 0.9})
+            if n != max_tokens or first is None:
+                raise RuntimeError(f"short stream: {n}/{max_tokens}")
+            with lock:
+                ttfts.append(first)
+                tok_total[0] += n
+                done_sessions[0] += 1
+
+        def client(i):
+            try:
+                for rd in range(rounds):
+                    one_generation(i * 1000 + rd)
+            except BaseException as e:  # surfaced in the artifact
+                with lock:
+                    errors.append(f"{type(e).__name__}: {e}")
+
+        def run_pass():
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(clients)]
+            t_p = time.monotonic()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            return time.monotonic() - t_p
+
+        prev_sample = os.environ.pop("DL4J_TPU_TRACE_SAMPLE", None)
+        try:
+            # pass 1: sampling off — the zero-allocation fast path
+            wall_off = run_pass()
+            toks_off = tok_total[0]
+            wall_on, toks_on = 0.0, 0
+            if traced_pass:
+                # pass 2: every request traced — the sampled-on tax
+                os.environ["DL4J_TPU_TRACE_SAMPLE"] = "1"
+                wall_on = run_pass()
+                toks_on = tok_total[0] - toks_off
+            # the parity probe: one fixed-prompt greedy stream, same
+            # at every K by the fused-decode parity contract
+            _, _, probe = stream({"prompt_ids": probe_prompt,
+                                  "max_tokens": max_tokens,
+                                  "greedy": True})
+        finally:
+            if prev_sample is None:
+                os.environ.pop("DL4J_TPU_TRACE_SAMPLE", None)
+            else:
+                os.environ["DL4J_TPU_TRACE_SAMPLE"] = prev_sample
+        wall = wall_off + wall_on
+        compile_delta = get_watchdog().compiles() - compiles_after_warmup
+
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            metrics = json.loads(r.read())
+        trace_block = None
+        if trace_ids:
+            with urllib.request.urlopen(
+                    base + "/trace/" + trace_ids[-1], timeout=10) as r:
+                trace_block = json.loads(r.read())
+        srv.stop()
+        decode = metrics["decode"]["default"]
+
+        toks = tok_total[0]
+        streamed = decode["tokens_streamed"]
+        disp = decode["dispatches"]["total"]
+        leg = {
+            "fused_k": fused_k,
+            "loop": decode["decode_loop"]["kind"],
+            "tokens_per_s": round(toks / wall, 2),
+            "duration_s": round(wall, 3),
+            "sessions_completed": done_sessions[0],
+            "round_trips_per_token": (round(disp / streamed, 4)
+                                      if streamed else None),
+            "windows": decode["dispatches"]["windows"],
+            "window_tokens": decode["dispatches"]["window_tokens"],
+            "ttft_ms": {"p50": pct(ttfts, 0.50),
+                        "p99": pct(ttfts, 0.99)},
+            "itl_ms": {"p50": pct(itls, 0.50), "p99": pct(itls, 0.99)},
+            "compile_delta_after_warmup": compile_delta,
+            "zero_recompiles": compile_delta == 0,
+            "metrics_reconciled": (
+                streamed == toks + len(probe)
+                and decode["sessions"]["completed"]
+                == done_sessions[0] + 1),
+            "shared_dispatches": decode["dispatches"]["shared"],
+            "interleaved": decode["dispatches"]["shared"] > 0,
+            "errors": errors,
+        }
+        if traced_pass:
+            leg["tracing"] = {
+                "pass_off": {
+                    "tokens": toks_off,
+                    "duration_s": round(wall_off, 3),
+                    "tokens_per_s": round(toks_off / wall_off, 2)},
+                "pass_on": {
+                    "tokens": toks_on,
+                    "duration_s": round(wall_on, 3),
+                    "tokens_per_s": (round(toks_on / wall_on, 2)
+                                     if wall_on else None)},
+                "trace_overhead_pct": round(
+                    (1 - (toks_on / wall_on) / (toks_off / wall_off))
+                    * 100, 2) if toks_off and toks_on else None,
+                "traces_sampled": len(trace_ids),
+            }
+        return leg, probe, decode, trace_block
+
+    primary_k = ks[-1]
+    legs, probes = [], {}
+    decode_primary, trace_block = None, None
+    for k in ks:
+        leg, probe, decode, tb = run_leg(k, traced_pass=(k == primary_k))
+        legs.append(leg)
+        probes[k] = probe
+        if k == primary_k:
+            decode_primary, trace_block = decode, tb
+
+    by_k = {leg["fused_k"]: leg for leg in legs}
+    primary = by_k[primary_k]
+    stepwise = by_k.get(1)
     out = {
         "metric": "serving_decode_tokens_per_s",
-        "value": round(toks / wall, 2),
+        "value": primary["tokens_per_s"],
         "unit": "tokens/s",
+        "fused_k": primary_k,
         "clients": clients,
         "rounds": rounds,
         "prompt_len": prompt_len,
         "max_tokens": max_tokens,
         "prefill_chunk": chunk,
-        "duration_s": round(wall, 3),
-        "sessions_completed": done_sessions[0],
-        "ttft_ms": {"p50": pct(ttfts, 0.50), "p99": pct(ttfts, 0.99)},
-        "itl_ms": {"p50": pct(itls, 0.50), "p99": pct(itls, 0.99)},
-        "compile_delta_after_warmup": compile_delta,
-        "zero_recompiles": compile_delta == 0,
-        "server_decode": decode,
-        "metrics_reconciled": (
-            decode["tokens_streamed"] == toks
-            and decode["sessions"]["completed"] == done_sessions[0]),
-        "shared_dispatches": decode["dispatches"]["shared"],
-        "interleaved": decode["dispatches"]["shared"] > 0,
-        "errors": errors,
-        "tracing": {
-            "pass_off": {"tokens": toks_off,
-                         "duration_s": round(wall_off, 3),
-                         "tokens_per_s": round(toks_off / wall_off, 2)},
-            "pass_on": {"tokens": toks_on,
-                        "duration_s": round(wall_on, 3),
-                        "tokens_per_s": round(toks_on / wall_on, 2)},
-            "trace_overhead_pct": round(
-                (1 - (toks_on / wall_on) / (toks_off / wall_off)) * 100,
-                2) if toks_off and toks_on else None,
-            "traces_sampled": len(trace_ids),
-        },
+        "legs": legs,
+        "speedup_vs_stepwise": (
+            round(primary["tokens_per_s"] / stepwise["tokens_per_s"], 2)
+            if stepwise and stepwise["tokens_per_s"] else None),
+        "greedy_parity": all(probes[k] == probes[ks[0]] for k in ks),
+        "zero_recompiles": all(leg["zero_recompiles"] for leg in legs),
+        "metrics_reconciled": all(leg["metrics_reconciled"]
+                                  for leg in legs),
+        "errors": [e for leg in legs for e in leg["errors"]],
+        "tracing": primary.get("tracing"),
+        "server_decode": decode_primary,
         "trace": trace_block,
         "registry": _registry_snapshot(),
     }

@@ -859,6 +859,104 @@ class MultiLayerNetwork(SeqCtxJitCache, SeqCtxSolverCache):
             None if active is None else jnp.asarray(active, bool),
             None if valid is None else jnp.asarray(valid, self.dtype))
 
+    def session_decode_window(self, tokens, carries, *, active, k,
+                              temperature, top_k, top_p, greedy,
+                              keys, offsets, budgets, eos_ids):
+        """K fused decode steps in ONE dispatch: a `lax.scan` that
+        forwards each active lane's next token, samples on-device
+        (utils/sampling.sample_token_lanes — greedy/temperature/top-k/
+        top-p as lax ops), feeds the sample back in, and early-exits
+        per lane on EOS or budget via the active mask — finished lanes
+        stop writing carries without breaking the fixed shape. This is
+        the decode twin of the training executor's fused-K machinery:
+        one host round-trip buys K tokens.
+
+        Arguments (S = slot count; everything per-lane so one compiled
+        program serves any request mix — the zero-recompile contract):
+
+        - ``tokens``   i32[S]    first input token per lane (the last
+          prompt token on the first window, the previous window's last
+          sample afterwards)
+        - ``carries``  the KVSlotPool tree from :meth:`session_carries`
+        - ``active``   bool[S]   lanes that decode this window
+        - ``k``        python int, the window length (bucketed by the
+          caller; part of the compile key)
+        - ``temperature/top_k/top_p/greedy``  f32/i32/f32/bool [S]
+        - ``keys``     u32[S, 2] per-lane base rng keys; token i of a
+          lane always draws with fold_in(key, offsets+i), so streams
+          are invariant to K and to how sessions share dispatches
+        - ``offsets``  i32[S]    tokens already generated per lane
+        - ``budgets``  i32[S]    remaining token budget per lane
+        - ``eos_ids``  i32[S]    per-lane EOS id (-1 = none)
+
+        Returns ``(tokens [S, k] i32, emitted [S, k] bool,
+        new_carries)`` — positions where ``emitted`` is False carry -1
+        and must be ignored (lane finished mid-window or was inactive).
+        Greedy output is bit-exact against running the same program
+        with k=1 K times: same per-step forwards, same carry merges —
+        the parity contract tests/test_fused_decode.py pins."""
+        from deeplearning4j_tpu.nn.layers.feedforward import (
+            EmbeddingSequenceLayer,
+        )
+        from deeplearning4j_tpu.utils import sampling as _sampling
+
+        self._check_init()
+        k = int(k)
+        if k < 1:
+            raise ValueError(f"window length k must be >= 1, got {k}")
+        tokens = jnp.asarray(tokens, jnp.int32)
+        ids_input = isinstance(self.layers[0], EmbeddingSequenceLayer)
+        feat = 1 if ids_input else int(self.layers[0].n_in)
+        stateful = set(self._rnn_layer_names) | set(self._decode_layer_names)
+        key = ("session_decode_window", k, tokens.shape, ids_input)
+        if key not in self._jit_cache:
+            def window_fn(params, states, tok0, carries_, active_, temps,
+                          tks, tps, grdy, keys_, offs, buds, eos):
+                dt = self.dtype
+
+                def encode(tok):
+                    if ids_input:
+                        return tok[:, None, None].astype(dt)
+                    return jax.nn.one_hot(tok, feat, dtype=dt)[:, None, :]
+
+                def body(carry, _):
+                    tok, c, act, n = carry
+                    val = act.astype(dt)[:, None]
+                    out, _, new_states, _ = self._forward(
+                        params, states, encode(tok), train=False, rng=None,
+                        fmask=val, carries=c)
+                    new = {nm: new_states[nm] for nm in stateful}
+
+                    def lane(old, nw):
+                        a = act.reshape(
+                            (-1,) + (1,) * (getattr(nw, "ndim", 1) - 1))
+                        return jnp.where(a, nw, old)
+
+                    new = jax.tree_util.tree_map(lane, c, new)
+                    step_keys = jax.vmap(jax.random.fold_in)(keys_, offs + n)
+                    nxt = _sampling.sample_token_lanes(
+                        out[:, -1, :], temps, tks, tps, grdy, step_keys)
+                    emit = act
+                    n2 = n + emit.astype(jnp.int32)
+                    finished = emit & ((nxt == eos) | (n2 >= buds))
+                    return ((jnp.where(emit, nxt, tok), new,
+                             act & jnp.logical_not(finished), n2),
+                            (jnp.where(emit, nxt, -1), emit))
+
+                init = (tok0, carries_, active_, jnp.zeros_like(offs))
+                (_, cf, _, _), (toks, emits) = jax.lax.scan(
+                    body, init, None, length=k)
+                return (jnp.transpose(toks), jnp.transpose(emits), cf)
+
+            self._jit_cache[key] = jax.jit(window_fn)
+        return self._jit_cache[key](
+            self.params_tree, self.state_tree, tokens, carries,
+            jnp.asarray(active, bool), jnp.asarray(temperature, jnp.float32),
+            jnp.asarray(top_k, jnp.int32), jnp.asarray(top_p, jnp.float32),
+            jnp.asarray(greedy, bool), jnp.asarray(keys, jnp.uint32),
+            jnp.asarray(offsets, jnp.int32), jnp.asarray(budgets, jnp.int32),
+            jnp.asarray(eos_ids, jnp.int32))
+
     # -------------------------------------------------------- pretrain
     def pretrain(self, data, *, epochs: int = 1, batch_size: int = 32):
         """Greedy layerwise unsupervised pretraining for pretrainable layers

@@ -24,6 +24,8 @@ Policy, in order:
        DL4J_TPU_ATTN_BLOCK     = "512" or "512x256"   (block_q x block_k)
        DL4J_TPU_DENSE_MAX_T    = int (memory-necessity threshold)
        DL4J_TPU_DECODE_ATTN    = auto|banded|dense   (serving decode step)
+       DL4J_TPU_DECODE_LOOP    = auto|fused|stepwise (serving decode loop)
+       DL4J_TPU_DECODE_K       = int (fused decode window length; bucketed)
        DL4J_TPU_FUSED_UPDATE   = auto|fused|xla      (optimizer update)
   2. Shape eligibility: flash needs the TPU backend and 128-lane-tileable
      sequence lengths; otherwise dense.
@@ -389,6 +391,81 @@ def decode_attention_policy(cache_len: int, h: int, hkv: int,
         return dense(f"measured loss at L={mt} "
                      f"({row.get('banded_ms')} vs {row['dense_ms']} ms)")
     return dense("no measured rows; conservative default")
+
+
+class DecodeLoopPolicy(NamedTuple):
+    kind: str            # "fused" | "stepwise"
+    k: int               # window length (1 when stepwise)
+    reason: str
+
+
+# Fused decode windows compile one program per K, so K is snapped to a
+# small bucket set exactly like the seq-ctx buckets: session churn and
+# per-request budgets never mint new programs (the zero-recompile
+# contract the watchdog polices).
+DECODE_K_BUCKETS = (1, 2, 4, 8, 16)
+
+
+def _bucket_k(k: int) -> int:
+    for b in DECODE_K_BUCKETS:
+        if b >= k:
+            return b
+    return DECODE_K_BUCKETS[-1]
+
+
+def decode_loop_policy(k: Optional[int] = None, *, capable: bool = True,
+                       record: bool = True) -> DecodeLoopPolicy:
+    """Fused-K decode loop (one `lax.scan` dispatch advances every active
+    session K tokens, sampling on-device) vs the stepwise one-token-per-
+    dispatch loop. Same lattice as the other policies — env force, then
+    capability, then the measured verdict — but the no-data default is
+    FUSED, not conservative: both sides lower through the identical
+    per-step XLA program (no hand-written kernel to mistrust), and the
+    K-fold host round-trip amortization is structural, exactly like
+    `lstm_policy`'s fused default. `k` is the caller's requested window
+    (None = the default bucket); it is snapped to DECODE_K_BUCKETS so
+    request churn costs zero compiles. `capable=False` (the model has no
+    `session_decode_window`, e.g. a ComputationGraph endpoint) degrades
+    to stepwise. `record=False` is for observers (serving snapshots)
+    asking what WOULD dispatch."""
+    forced = _env("DL4J_TPU_DECODE_LOOP")
+    env_k = os.environ.get("DL4J_TPU_DECODE_K", "").strip()
+    if env_k:
+        k = int(env_k)
+    want_k = _bucket_k(8 if k is None else max(1, int(k)))
+
+    def fused(kk, reason):
+        if record:
+            record_dispatch("decode_loop", "fused")
+        return DecodeLoopPolicy("fused", kk, reason)
+
+    def stepwise(reason):
+        if record:
+            record_dispatch("decode_loop", "stepwise")
+        return DecodeLoopPolicy("stepwise", 1, reason)
+
+    if forced == "stepwise":
+        return stepwise("forced by DL4J_TPU_DECODE_LOOP=stepwise")
+    if forced == "fused":
+        if not capable:
+            return stepwise("DL4J_TPU_DECODE_LOOP=fused but the model "
+                            "has no session_decode_window")
+        return fused(want_k, "forced by DL4J_TPU_DECODE_LOOP=fused")
+    if not capable:
+        return stepwise("model has no session_decode_window")
+    row = MEASURED.get("decode_loop")
+    if row is not None:
+        mt = _nearest_measured(row, want_k)
+        if mt is not None and row[mt]["winner"] == "stepwise":
+            return stepwise(f"measured loss at K={mt} "
+                            f"({row[mt]['fused_ms']} vs "
+                            f"{row[mt]['stepwise_ms']} ms)")
+        if mt is not None:
+            return fused(want_k, f"measured win at K={mt} "
+                         f"({row[mt]['fused_ms']} vs "
+                         f"{row[mt]['stepwise_ms']} ms)")
+    return fused(want_k, "structural default: identical per-step XLA "
+                 "program, K-fold fewer host round-trips")
 
 
 def fused_update_policy(kind: str) -> str:

@@ -109,7 +109,8 @@ class InferenceServer(JsonHttpServer):
                  batch_buckets=None, collect_wait_ms: float = 5.0,
                  slots: int = 1, degraded_fraction: float = 0.8,
                  mesh=None, metrics=None, decode_slots: int = 0,
-                 decode_prefill_chunk: int = 8, slo: bool = False,
+                 decode_prefill_chunk: int = 8,
+                 decode_fused_k: Optional[int] = None, slo: bool = False,
                  slo_objectives=None,
                  series_interval: Optional[float] = None):
         super().__init__(port=port)
@@ -152,7 +153,8 @@ class InferenceServer(JsonHttpServer):
             if decode_slots:
                 self.enable_decode_sessions(
                     slots=decode_slots,
-                    prefill_chunk=decode_prefill_chunk)
+                    prefill_chunk=decode_prefill_chunk,
+                    fused_k=decode_fused_k)
 
     # ------------------------------------------------------ control API
     def deploy(self, name: str, version, net, *, feat_shape=None,
@@ -164,10 +166,13 @@ class InferenceServer(JsonHttpServer):
 
     def enable_decode_sessions(self, model: str = DEFAULT_MODEL, *,
                                slots: int = 4, prefill_chunk: int = 8,
+                               fused_k: Optional[int] = None,
                                warm: bool = True):
         """Attach a DecodeSessionManager to `model`: POST /generate
         streams tokens from per-request sessions over a shared KV slot
-        pool, stepped through the continuous-batching scheduler."""
+        pool, stepped through the continuous-batching scheduler.
+        `fused_k` requests a fused decode window length (None = the
+        `decode_loop_policy` default; env hatches still win)."""
         if self.mode != "continuous":
             raise ValueError(
                 "decode sessions need the continuous scheduler "
@@ -180,8 +185,8 @@ class InferenceServer(JsonHttpServer):
         )
         mgr = DecodeSessionManager(
             self.registry, self.scheduler, model, slots=slots,
-            prefill_chunk=prefill_chunk, metrics=self.stats.registry,
-            warm=warm)
+            prefill_chunk=prefill_chunk, fused_k=fused_k,
+            metrics=self.stats.registry, warm=warm)
         self._decode[model] = mgr
         return mgr
 

@@ -6,23 +6,31 @@ which mutates MODEL-GLOBAL carries — one autoregressive stream per net,
 and a server would have to dedicate a model replica per conversation.
 This module turns decode into data: each session owns a SLOT in a
 `KVSlotPool` (one batch row of a [slots, ...] carry tree), and every
-step — prefill chunk or single-token decode — is submitted to the
+step — prefill chunk or fused decode window — is submitted to the
 `ContinuousBatchingScheduler` as an ordinary one-row request against a
 dedicated `<model>@decode` endpoint. The scheduler coalesces whatever
-rows are queued, the endpoint's `run_batch` scatters them into the
-fixed [slots, bucket] step shape, runs ONE jitted `session_step`
-(inactive lanes masked, RNN carries held, attention writes dropped),
-and each session samples its next token in the future's done-callback
-and immediately submits the next row. Sessions at different phases —
-one mid-prefill, another deep into decode — share the same dispatch
-and the same compiled program.
+rows are queued (sessions at different phases — one mid-prefill,
+another deep into decode — share the same dispatch), and the
+endpoint's `run_batch` runs at most two jitted programs: one
+`session_step` over the co-batched prefill chunks (its logits are
+never read back), then one `session_decode_window` that advances every
+decoding lane K TOKENS — sampling on-device (greedy/temperature/
+top-k/top-p as lax ops), feeding each sample back through the model
+inside a `lax.scan`, early-exiting lanes on EOS/budget via the active
+mask. The callback chain consumes K sampled tokens per round-trip
+instead of one: host round-trips, the dominant decode cost, are
+amortized K-fold (`decode_loop_policy` picks K; DL4J_TPU_DECODE_LOOP /
+DL4J_TPU_DECODE_K force it). Greedy fused output is bit-exact against
+step-by-step decode by contract (tests/test_fused_decode.py).
 
-Shapes are the contract: every dispatch runs at bucket 1 (pure decode)
-or bucket `prefill_chunk` (any prefill present), both warmed at
+Shapes are the contract: every dispatch runs at a prefill bucket (1 or
+`prefill_chunk`) and/or the one window length K — all warmed at
 construction, so session churn causes ZERO recompiles — the watchdog
 stays quiet (see PERF_NOTES). TTFT/ITL histograms, token counters and
 shared-dispatch counters ride the server's metrics registry so the
-closed-loop bench can reconcile its client-side numbers.
+closed-loop bench can reconcile its client-side numbers; ITL inside a
+window is amortized (window gap / tokens) since tokens arrive in
+bursts of K.
 
 Hot-swap: the manager subscribes to registry deploy hooks for its base
 model. In the "warm" phase it verifies the candidate can host the live
@@ -44,6 +52,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from deeplearning4j_tpu.observe import reqtrace
+from deeplearning4j_tpu.ops.kernel_defaults import decode_loop_policy
 from deeplearning4j_tpu.serving.kv_pool import (
     IncompatibleSessionSwapError, KVSlotPool, SlotPoolExhaustedError,
 )
@@ -51,7 +60,9 @@ from deeplearning4j_tpu.serving.registry import ModelEntry
 from deeplearning4j_tpu.serving.scheduler import (
     DeadlineExceededError, RequestShedError, SchedulerClosedError,
 )
-from deeplearning4j_tpu.utils.sampling import SamplingParams, sample_next
+from deeplearning4j_tpu.utils.sampling import (
+    SamplingParams, lane_param_arrays,
+)
 from deeplearning4j_tpu.utils.textgen import (
     _encode, _input_encoding, _resolve_net,
 )
@@ -77,7 +88,13 @@ class DecodeSession:
         self.trace = trace
         self.max_tokens = int(max_tokens)
         self.params = params
-        self.rng = np.random.default_rng(seed)
+        # sampling runs ON-DEVICE inside the fused window: the session
+        # carries a threefry base key, and token i always draws with
+        # fold_in(base_key, i) — the stream is deterministic in the seed
+        # and invariant to K and to dispatch co-batching
+        seed = 0 if seed is None else int(seed)
+        self.base_key = np.array(
+            [(seed >> 32) & 0xFFFFFFFF, seed & 0xFFFFFFFF], np.uint32)
         self.eos_id = eos_id
         self.opened_at = time.monotonic()
         self.deadline = (None if deadline_ms is None
@@ -115,8 +132,10 @@ class DecodeSession:
         return list(self.generated)
 
     def cancel(self) -> None:
-        """Request cancellation; honored at the next step boundary (there
-        is always at most one step in flight per session)."""
+        """Request cancellation; honored at the next window boundary
+        (there is always at most one row in flight per session, and a
+        window is at most `fused_k` tokens). Tokens already streamed
+        stay streamed."""
         self.cancelled = True
 
     def remaining_ms(self) -> Optional[float]:
@@ -141,6 +160,7 @@ class DecodeSessionManager:
 
     def __init__(self, registry, scheduler, model: str = "default", *,
                  slots: int = 4, prefill_chunk: int = 8,
+                 fused_k: Optional[int] = None,
                  metrics=None, warm: bool = True):
         if prefill_chunk < 1:
             raise ValueError("prefill_chunk must be >= 1")
@@ -155,10 +175,27 @@ class DecodeSessionManager:
         self.decode_name = f"{model}@decode"
         self.prefill_chunk = int(prefill_chunk)
         self.buckets = sorted({1, self.prefill_chunk})
+        # decode-loop verdict: how many tokens one dispatch advances.
+        # K is part of the compile key, so it is fixed per manager (and
+        # bucketed inside the policy) — request churn never mints a new
+        # program. "stepwise" is simply K=1 through the same window
+        # program: one code path, on-device sampling everywhere.
+        loop = decode_loop_policy(
+            k=fused_k,
+            capable=hasattr(base.net, "session_decode_window"))
+        if loop.kind == "stepwise" and \
+                not hasattr(base.net, "session_decode_window"):
+            raise TypeError(
+                f"decode sessions need session_decode_window "
+                f"(MultiLayerNetwork); got {type(base.net).__name__}")
+        self.loop_kind = loop.kind
+        self.fused_k = int(loop.k)
+        self._loop_reason = loop.reason
         self._lock = threading.Lock()
         self._net = base.net
         self._sessions: Dict[str, DecodeSession] = {}
         self._sid = itertools.count(1)
+        self._seed_rng = np.random.default_rng()
         self._closed = False
 
         first, vocab = _resolve_net(base.net)
@@ -166,10 +203,16 @@ class DecodeSessionManager:
         self._encoding = _input_encoding(first)
         self._limit = base.net.decode_limit()
 
+        from deeplearning4j_tpu.observe import get_registry
         if metrics is None:
-            from deeplearning4j_tpu.observe import get_registry
             metrics = get_registry()
         self.metrics = metrics
+        # the policy consult above counted on the process-global registry
+        # (record_dispatch); mirror onto the server's registry when it is
+        # a private one so /metrics surfaces the decode_loop verdict too
+        if metrics is not get_registry():
+            metrics.counter("kernel_dispatch_total", op="decode_loop",
+                            impl=self.loop_kind).inc()
         self.pool = KVSlotPool(base.net, slots, model=model,
                                metrics=metrics)
         self._g_active = metrics.gauge("serving_sessions_active",
@@ -189,6 +232,12 @@ class DecodeSessionManager:
             "serving_decode_dispatch_rows_total", model=model)
         self._c_shared = metrics.counter(
             "serving_decode_shared_dispatches_total", model=model)
+        # fused-window accounting: windows run and tokens they emitted —
+        # dispatches/tokens is the round-trips-per-token the bench trends
+        self._c_windows = metrics.counter(
+            "serving_decode_windows_total", model=model)
+        self._c_window_tokens = metrics.counter(
+            "serving_decode_window_tokens_total", model=model)
 
         # the decode endpoint: an ordinary registry entry whose "runner"
         # is this manager — scheduler dispatch, drain-on-retire and
@@ -210,8 +259,9 @@ class DecodeSessionManager:
         return 1 if self._encoding == "ids" else self.vocab
 
     def _compile_buckets(self, net) -> None:
-        """Run one all-lanes-inactive step per bucket so every dispatch
-        shape this manager will ever use is compiled before traffic (the
+        """Run one all-lanes-inactive step per prefill bucket plus one
+        all-lanes-inactive fused window so every dispatch shape this
+        manager will ever use is compiled before traffic (the
         zero-recompiles-after-warmup contract the bench asserts)."""
         carries = net.session_carries(self.pool.slots)
         S, F = self.pool.slots, self._feat_dim()
@@ -224,6 +274,17 @@ class DecodeSessionManager:
             # first live dispatch
             # graft: allow-sync(warmup barrier — pre-traffic by design)
             np.asarray(out)
+        toks, _, _ = net.session_decode_window(
+            np.zeros((S,), np.int64), carries, active=act,
+            k=self.fused_k, temperature=np.ones((S,), np.float32),
+            top_k=np.full((S,), self.vocab, np.int32),
+            top_p=np.ones((S,), np.float32), greedy=np.ones((S,), bool),
+            keys=np.zeros((S, 2), np.uint32),
+            offsets=np.zeros((S,), np.int32),
+            budgets=np.zeros((S,), np.int32),
+            eos_ids=np.full((S,), -1, np.int32))
+        # graft: allow-sync(warmup barrier — pre-traffic by design)
+        np.asarray(toks)
 
     def warmup(self) -> None:
         self._compile_buckets(self.pool.net)
@@ -261,6 +322,9 @@ class DecodeSessionManager:
         with self._lock:
             if self._closed:
                 raise SchedulerClosedError("session manager is shut down")
+            if seed is None:
+                # unseeded requests still get independent device streams
+                seed = int(self._seed_rng.integers(0, 2 ** 63))
         slot = self.pool.alloc(alloc_timeout_s)
         sess = DecodeSession(
             f"s{next(self._sid):06d}", slot, prompt,
@@ -295,18 +359,27 @@ class DecodeSessionManager:
 
     # --------------------------------------------------- stepping chain
     def _next_row(self, sess: DecodeSession) -> np.ndarray:
-        """The session's next request row, fixed width [1, 2 + chunk]:
-        [slot, n_valid, tok_0..]. Prefill rows carry up to `chunk`
-        prompt tokens; decode rows carry the last sampled token."""
-        row = np.zeros((1, 2 + self.prefill_chunk), np.float32)
+        """The session's next request row, fixed width [1, 3 + chunk]:
+        [slot, phase, n_valid, tok_0..]. Phase 0 rows carry up to
+        `chunk` prompt-STEM tokens (`prompt[:-1]` — their logits are
+        never read back); the phase 1 row carries the window's first
+        input token: the last prompt token before anything is sampled,
+        the previous window's last sample afterwards. The fused window
+        derives everything else (sampling knobs, rng key, budget, EOS)
+        from the session table at dispatch time."""
+        row = np.zeros((1, 3 + self.prefill_chunk), np.float32)
         row[0, 0] = sess.slot
-        if sess._off < sess.prompt.size:
-            toks = sess.prompt[sess._off:sess._off + self.prefill_chunk]
+        stem = sess.prompt.size - 1
+        if sess._off < stem:
+            toks = sess.prompt[sess._off:min(stem, sess._off +
+                                             self.prefill_chunk)]
             sess._off += toks.size
         else:
-            toks = np.asarray([sess.generated[-1]], np.int64)
-        row[0, 1] = toks.size
-        row[0, 2:2 + toks.size] = toks
+            row[0, 1] = 1.0
+            toks = np.asarray([sess.generated[-1] if sess.generated
+                               else sess.prompt[-1]], np.int64)
+        row[0, 2] = toks.size
+        row[0, 3:3 + toks.size] = toks
         return row
 
     def _submit_next(self, sess: DecodeSession) -> None:
@@ -332,9 +405,12 @@ class DecodeSessionManager:
 
     def _on_step(self, sess: DecodeSession, fut) -> None:
         """Future callback (runs on the scheduler worker): consume this
-        step's logits, maybe sample, maybe finish, else chain the next
-        row. Every path must end in _finish or _submit_next — an escaped
-        exception here would orphan the session's slot."""
+        round-trip's result, maybe finish, else chain the next row.
+        Prefill legs return a zero count (their logits never left the
+        device); window legs return the device-sampled tokens, so this
+        callback only does bookkeeping — no host sampling. Every path
+        must end in _finish or _submit_next — an escaped exception here
+        would orphan the session's slot."""
         with self._lock:
             if sess._finished:
                 return      # session was aborted while this step flew
@@ -347,28 +423,37 @@ class DecodeSessionManager:
             if sess.cancelled:
                 self._finish(sess, outcome="cancelled")
                 return
-            if sess._off < sess.prompt.size:
-                # mid-prefill: the logits are positional garbage until
-                # the last prompt token lands; keep feeding chunks
+            n = int(np.asarray(y)[0, 0])
+            if n <= 0:
+                # mid-prefill (or a window whose lane was dropped):
+                # nothing was sampled; keep the chain moving
                 self._submit_next(sess)
                 return
-            p = np.asarray(y, np.float64)[0]
-            tok = int(sample_next(p[None], sess.params, sess.rng)[0])
+            toks = np.asarray(y)[0, 1:1 + n].astype(np.int64)
             now = time.monotonic()
             tid = sess.trace.trace_id if sess.trace is not None else None
             if sess.ttft_ms is None:
                 sess.ttft_ms = (now - sess.opened_at) * 1000.0
                 self._h_ttft.observe(sess.ttft_ms, exemplar=tid)
             else:
-                self._h_itl.observe((now - sess._last_tok_at) * 1000.0,
-                                    exemplar=tid)
+                # tokens arrive in a burst of n: the honest per-token
+                # latency is the window gap amortized over the window
+                gap_ms = (now - sess._last_tok_at) * 1000.0
+                for _ in range(n):
+                    self._h_itl.observe(gap_ms / n, exemplar=tid)
             sess._last_tok_at = now
-            sess.generated.append(tok)
-            self._c_tokens.inc()
-            sess._events.put({"token": tok,
-                              "index": len(sess.generated) - 1})
-            if (sess.eos_id is not None and tok == sess.eos_id) or \
-                    len(sess.generated) >= sess.max_tokens:
+            hit_eos, appended = False, 0
+            for t in toks:
+                tok = int(t)
+                sess.generated.append(tok)
+                appended += 1
+                sess._events.put({"token": tok,
+                                  "index": len(sess.generated) - 1})
+                if sess.eos_id is not None and tok == sess.eos_id:
+                    hit_eos = True
+                    break   # the device stopped emitting after EOS too
+            self._c_tokens.inc(appended)
+            if hit_eos or len(sess.generated) >= sess.max_tokens:
                 self._finish(sess, outcome="completed")
             else:
                 self._submit_next(sess)
@@ -417,14 +502,18 @@ class DecodeSessionManager:
     # ------------------------------------------------- scheduler runner
     def run_batch(self, xs) -> np.ndarray:
         """The decode endpoint's data plane. `xs` is a stack of session
-        rows ([k, 2+chunk], possibly from k different sessions — this
-        coalescing IS continuous batching). Scatter into the [slots,
-        bucket] step shape, run the one shared jitted step under the
-        pool lock, gather each row's last-valid-position logits."""
+        rows ([k, 3+chunk], possibly from k different sessions — this
+        coalescing IS continuous batching, and prefill rows co-batch
+        with decode windows). At most two jitted programs run under the
+        pool lock: one `session_step` over the prefill lanes (logits
+        stay on device — prefill pays NO host sync), then one
+        `session_decode_window` advancing every decoding lane K tokens
+        with on-device sampling. Returns one result row per request
+        row: `[count, tok_0..tok_{K-1}]` — count 0 for prefill legs."""
         xs = np.asarray(xs)
-        if xs.ndim != 2 or xs.shape[1] != 2 + self.prefill_chunk:
+        if xs.ndim != 2 or xs.shape[1] != 3 + self.prefill_chunk:
             raise ValueError(
-                f"decode rows must be [k, {2 + self.prefill_chunk}], "
+                f"decode rows must be [k, {3 + self.prefill_chunk}], "
                 f"got {xs.shape}")
         k = xs.shape[0]
         # fan-in handoff: the scheduler worker opened a dispatch window
@@ -433,71 +522,146 @@ class DecodeSessionManager:
         dtrace = reqtrace.active_dispatch()
         t0 = time.perf_counter() if dtrace is not None else 0.0
         slots_idx = xs[:, 0].astype(np.int64)
-        nvalid = xs[:, 1].astype(np.int64)
-        need = int(nvalid.max())
-        bucket = min(b for b in self.buckets if b >= need)
-        S = self.pool.slots
-        tok = np.zeros((S, bucket), np.int64)
-        val = np.zeros((S, bucket), np.float32)
-        act = np.zeros((S,), bool)
-        for i in range(k):
+        phase = xs[:, 1].astype(np.int64)
+        nvalid = xs[:, 2].astype(np.int64)
+        pre = np.nonzero(phase == 0)[0]
+        dec = np.nonzero(phase == 1)[0]
+        S, K = self.pool.slots, self.fused_k
+        ys = np.zeros((k, 1 + K), np.float32)
+
+        # prefill scatter: [S, bucket] chunk step, inactive lanes masked
+        bucket = 0
+        if pre.size:
+            need = int(nvalid[pre].max())
+            bucket = min(b for b in self.buckets if b >= need)
+            tok = np.zeros((S, bucket), np.int64)
+            val = np.zeros((S, bucket), np.float32)
+        act_p = np.zeros((S,), bool)
+        for i in pre:
             s, n = int(slots_idx[i]), int(nvalid[i])
-            tok[s, :n] = xs[i, 2:2 + n].astype(np.int64)
+            tok[s, :n] = xs[i, 3:3 + n].astype(np.int64)
             val[s, :n] = 1.0
-            act[s] = True
-        x = _encode(tok, self._encoding, self.vocab)
+            act_p[s] = True
+
+        # window lanes: per-lane sampling knobs / keys / budgets from
+        # the session table. Reading session fields here is safe — each
+        # session has exactly one row in flight (this one), so nothing
+        # mutates them concurrently.
+        act_d = np.zeros((S,), bool)
+        if dec.size:
+            with self._lock:
+                by_slot = {s.slot: s for s in self._sessions.values()}
+            tok0 = np.zeros((S,), np.int64)
+            lane_params: List[Optional[SamplingParams]] = [None] * S
+            keys = np.zeros((S, 2), np.uint32)
+            offs = np.zeros((S,), np.int32)
+            buds = np.zeros((S,), np.int32)
+            eos = np.full((S,), -1, np.int32)
+            for i in dec:
+                s = int(slots_idx[i])
+                sess = by_slot.get(s)
+                if sess is None:
+                    continue    # finished while the row was queued
+                act_d[s] = True
+                tok0[s] = int(xs[i, 3])
+                lane_params[s] = sess.params
+                keys[s] = sess.base_key
+                offs[s] = len(sess.generated)
+                buds[s] = sess.max_tokens - len(sess.generated)
+                if sess.eos_id is not None:
+                    eos[s] = sess.eos_id
+            temps, tks, tps, grd = lane_param_arrays(lane_params,
+                                                     self.vocab)
+
+        toks_d = None
         with self.pool.lock():
             # drop rows whose slot was freed while the row was queued
             # (session aborted mid-flight): stepping a freed slot would
             # dirty carries the pool just reset for the next tenant.
             # Reading _active is safe here — we hold the pool lock.
             for i in range(k):
-                if not self.pool._active[int(slots_idx[i])]:
-                    act[int(slots_idx[i])] = False
+                s = int(slots_idx[i])
+                if not self.pool._active[s]:
+                    act_p[s] = False
+                    act_d[s] = False
             net = self.pool.net
-            out, new_carries = net.session_step(
-                x, self.pool.carries, active=act, valid=val)
-            self.pool.swap_carries(new_carries)
-        # device->host sync AFTER releasing the pool lock: the next
-        # dispatch can enqueue its step while we read this one back
-        # graft: allow-sync(decode endpoint result readback — the one
-        # intended host sync per dispatch)
-        out = np.asarray(out)
-        ys = out[slots_idx, np.maximum(nvalid - 1, 0), :]
+            carries = self.pool.carries
+            if pre.size and act_p.any():
+                x = _encode(tok, self._encoding, self.vocab)
+                _, carries = net.session_step(
+                    x, carries, active=act_p, valid=val)
+            if dec.size and act_d.any():
+                toks_d, emits_d, carries = net.session_decode_window(
+                    tok0, carries, active=act_d, k=K,
+                    temperature=temps, top_k=tks, top_p=tps, greedy=grd,
+                    keys=keys, offsets=offs, budgets=buds, eos_ids=eos)
+            self.pool.swap_carries(carries)
+        emit_n = {}
+        if toks_d is not None:
+            # device->host sync AFTER releasing the pool lock: the next
+            # dispatch can enqueue its programs while we read this one
+            # back. Prefill legs never reach this — the fused window's
+            # sampled tokens are the ONE intended host sync, and it
+            # covers K tokens per lane.
+            # graft: allow-sync(decode endpoint window readback — the
+            # one intended host sync per K-token window)
+            toks_h = np.asarray(toks_d)
+            emits_h = np.asarray(emits_d)
+            wtoks = 0
+            for i in dec:
+                s = int(slots_idx[i])
+                if not act_d[s]:
+                    continue
+                n = int(emits_h[s].sum())
+                emit_n[s] = n
+                ys[i, 0] = n
+                ys[i, 1:1 + K] = toks_h[s]
+                wtoks += n
+            self._c_windows.inc()
+            self._c_window_tokens.inc(wtoks)
         self._c_disp.inc()
         self._c_rows.inc(k)
         if k >= 2:
             self._c_shared.inc()
         if dtrace is not None:
-            self._trace_steps(dtrace, slots_idx, bucket, k,
-                              (time.perf_counter() - t0) * 1e3)
+            self._trace_windows(dtrace, slots_idx, phase, nvalid, emit_n,
+                                bucket, k,
+                                (time.perf_counter() - t0) * 1e3)
         return ys
 
-    def _trace_steps(self, dtrace, slots_idx, bucket: int, k: int,
-                     dur_ms: float) -> None:
-        """One `session.step` span per sampled row of this dispatch —
-        the ITL-step leaf of the fan-in tree, parented on that trace's
-        dispatch span and stamped with the slot id and the cached
-        kernel-policy verdict. Host scalars only (the span contract)."""
+    def _trace_windows(self, dtrace, slots_idx, phase, nvalid,
+                       emit_n: dict, bucket: int, k: int,
+                       dur_ms: float) -> None:
+        """One `session.window` span per sampled row of this dispatch —
+        the per-window leaf of the fan-in tree, parented on that trace's
+        dispatch span. Decode spans carry per-token attrs (`tokens`
+        emitted this window, the window length `win`, and the per-token
+        `itl` exemplars land on the histogram from the callback);
+        prefill spans carry the chunk size. Host scalars only (the span
+        contract)."""
         with self._lock:
             by_slot = {s.slot: s for s in self._sessions.values()
                        if s.trace is not None}
         for i in range(slots_idx.shape[0]):
-            sess = by_slot.get(int(slots_idx[i]))
+            s = int(slots_idx[i])
+            sess = by_slot.get(s)
             if sess is None:
                 continue
             sid = dtrace.span_ids.get(sess.trace.trace_id)
             if sid is None:
                 continue        # co-batched with a different endpoint
-            # one step is in flight per session, so `generated` still
+            decode = int(phase[i]) == 1
+            # one row is in flight per session, so `generated` still
             # reflects the state the row was built from: prefill chunks
             # all precede the first sampled token
             reqtrace.record_span(
-                sess.trace.trace_id, "session.step", parent_id=sid,
+                sess.trace.trace_id, "session.window", parent_id=sid,
                 dur_ms=dur_ms, session=sess.id, slot=sess.slot,
-                phase="prefill" if not sess.generated else "decode",
-                step=len(sess.generated), bucket=bucket, rows=k,
-                kernel=self._policy_kind)
+                phase="decode" if decode else "prefill",
+                step=len(sess.generated),
+                win=int(self.fused_k if decode else nvalid[i]),
+                tokens=int(emit_n.get(s, 0)), bucket=bucket, rows=k,
+                kernel=self._policy_kind, loop=self.loop_kind)
 
     # --------------------------------------------------------- hot-swap
     def _deploy_hook(self, phase: str, name: str, version, net) -> None:
@@ -566,9 +730,14 @@ class DecodeSessionManager:
             "itl_ms": self._h_itl.percentiles(),
             "dispatches": {"total": disp,
                            "rows": int(self._c_rows.value),
-                           "shared": int(self._c_shared.value)},
+                           "shared": int(self._c_shared.value),
+                           "windows": int(self._c_windows.value),
+                           "window_tokens":
+                               int(self._c_window_tokens.value)},
             "buckets": list(self.buckets),
             "kernel_policy": self._kernel_policy(),
+            "decode_loop": {"kind": self.loop_kind, "k": self.fused_k,
+                            "reason": self._loop_reason},
         }
 
     def _policy_brief(self) -> str:

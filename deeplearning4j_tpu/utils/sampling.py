@@ -7,9 +7,18 @@ This module is the single tested implementation: truncation semantics
 (stable-order top-k so k=1 coincides with argmax; the nucleus keeps the
 token that crosses the threshold) live here and nowhere else.
 
-Everything is host-side numpy on [B, V] probability matrices — sampling
-happens after the device step's output has been fetched, so there is no
-tracer anywhere near this code.
+Two dialects of the same semantics live here:
+
+- host-side numpy (`truncate_probs` / `sample_next`) for paths that
+  already fetched the step's output (beam search, legacy generate);
+- trace-safe jax (`sample_token` / `sample_token_lanes`) for paths that
+  sample *inside* the jitted program — the fused decode window advances
+  K tokens per dispatch and cannot afford a host round-trip per draw.
+
+Both dialects share the truncation conventions (stable-order top-k so
+k=1 coincides with argmax; the nucleus keeps the token that crosses the
+threshold), and the greedy path is bit-identical between them by
+contract — `tests/test_fused_decode.py` pins the parity.
 """
 
 from __future__ import annotations
@@ -87,3 +96,113 @@ def sample_next(p: np.ndarray, params: SamplingParams,
     p = p / p.sum(axis=-1, keepdims=True)
     vocab = p.shape[-1]
     return np.array([rng.choice(vocab, p=p[b]) for b in range(p.shape[0])])
+
+
+# --------------------------------------------------------------------------
+# trace-safe dialect: the same knobs as lax ops, usable inside jit/scan
+# --------------------------------------------------------------------------
+
+def sample_token_lanes(probs, temperature, top_k, top_p, greedy, keys):
+    """Per-lane token draw from a [S, V] probability matrix, trace-safe.
+
+    Every knob is a traced per-lane array so one compiled program serves
+    any mix of requests (no per-request specialization, no recompiles on
+    session churn):
+
+    - ``temperature`` f32[S]  — 1.0 selects the untouched probabilities
+      (same skip-at-exactly-1.0 convention as :func:`sample_next`)
+    - ``top_k``       i32[S]  — ``V`` (or more) disables the knob
+    - ``top_p``       f32[S]  — 1.0 disables the knob
+    - ``greedy``      bool[S] — take the first-occurrence argmax and
+      ignore truncation/rng entirely
+    - ``keys``        u32[S, 2] — one threefry key per lane; callers
+      derive them via ``fold_in(base_key, token_index)`` so draws are
+      independent of how many steps share a dispatch (K-invariant)
+
+    Knob order matches ``sample_next``: temperature, top-k, top-p, then
+    a renormalized categorical draw. Greedy is bit-identical to the
+    numpy path by contract; stochastic draws use jax's threefry stream
+    (numpy's Generator is not reproducible on-device, so cross-dialect
+    stochastic parity is not promised — K-invariance within this dialect
+    is).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    p = probs.astype(jnp.float32)
+    greedy_tok = jnp.argmax(p, axis=-1).astype(jnp.int32)
+
+    t = temperature[:, None]
+    # temper in log space (softmax(log p / τ) == renormalized p^(1/τ)):
+    # float32 underflows p^(1/τ) for cold τ long before float64 does, and
+    # every op downstream is scale-invariant, so early renormalization is
+    # free. τ == exactly 1.0 selects the untouched probabilities.
+    tempered = jax.nn.softmax(jnp.log(jnp.maximum(p, 1e-30)) / t, axis=-1)
+    p = jnp.where(t == 1.0, p, tempered)
+
+    # top-k: rank of each token under a stable descending sort; exactly k
+    # survivors even under ties (first occurrence wins, like the numpy path)
+    order = jnp.argsort(-p, axis=-1)
+    ranks = jnp.argsort(order, axis=-1)
+    p = jnp.where(ranks < top_k[:, None], p, 0.0)
+
+    # top-p on the post-top-k mass: keep tokens whose preceding mass is
+    # strictly below the threshold (the crossing token survives, so the
+    # nucleus is never empty); top_p == 1.0 keeps every nonzero token
+    order = jnp.argsort(-p, axis=-1)
+    sorted_p = jnp.take_along_axis(p, order, axis=-1)
+    csum = jnp.cumsum(sorted_p, axis=-1)
+    keep_sorted = (csum - sorted_p) < top_p[:, None] * csum[:, -1:]
+    keep = jnp.take_along_axis(keep_sorted, jnp.argsort(order, axis=-1),
+                               axis=-1)
+    p = jnp.where(keep, p, 0.0)
+
+    logp = jnp.where(p > 0.0, jnp.log(p), -jnp.inf)
+    drawn = jax.vmap(jax.random.categorical)(keys, logp).astype(jnp.int32)
+    return jnp.where(greedy, greedy_tok, drawn)
+
+
+def lane_param_arrays(params_list, vocab):
+    """Pack a list of per-lane :class:`SamplingParams` (``None`` for
+    inactive lanes) into the array form :func:`sample_token_lanes`
+    takes. Disabled knobs use their identity encodings (τ=1, k=V,
+    p=1.0); inactive lanes get greedy so they never touch the rng."""
+    n = len(params_list)
+    temperature = np.ones((n,), np.float32)
+    top_k = np.full((n,), int(vocab), np.int32)
+    top_p = np.ones((n,), np.float32)
+    greedy = np.ones((n,), bool)
+    for i, sp in enumerate(params_list):
+        if sp is None:
+            continue
+        temperature[i] = sp.temperature
+        top_k[i] = int(vocab) if sp.top_k is None else min(sp.top_k, vocab)
+        top_p[i] = 1.0 if sp.top_p is None else sp.top_p
+        greedy[i] = bool(sp.greedy)
+    return temperature, top_k, top_p, greedy
+
+
+def sample_token(probs, params: SamplingParams, key):
+    """Single-distribution jit-safe sampler over [V] or [B, V] probs,
+    sharing :func:`sample_token_lanes` so textgen, single-step decode
+    and the fused window all run the one implementation. ``key`` is a
+    jax PRNG key (may be ``None`` for greedy). Returns i32 token(s)."""
+    import jax
+    import jax.numpy as jnp
+
+    p = jnp.asarray(probs)
+    squeeze = p.ndim == 1
+    if squeeze:
+        p = p[None, :]
+    b, vocab = p.shape
+    if params.greedy:
+        tok = jnp.argmax(p, axis=-1).astype(jnp.int32)
+        return tok[0] if squeeze else tok
+    if key is None:
+        raise ValueError("sample_token requires a PRNG key unless greedy")
+    temperature, top_k, top_p, greedy = lane_param_arrays([params] * b, vocab)
+    keys = jax.random.split(key, b)
+    tok = sample_token_lanes(p, jnp.asarray(temperature),
+                             jnp.asarray(top_k), jnp.asarray(top_p),
+                             jnp.asarray(greedy), keys)
+    return tok[0] if squeeze else tok

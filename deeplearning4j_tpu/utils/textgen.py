@@ -18,7 +18,7 @@ from typing import Optional
 import numpy as np
 
 from deeplearning4j_tpu.utils.sampling import (
-    SamplingParams, sample_next, truncate_probs,
+    SamplingParams, sample_next, sample_token, truncate_probs,
 )
 
 
@@ -160,7 +160,13 @@ def generate(net, prompt_ids, n_tokens: int, *, temperature: float = 1.0,
                                              repetition_penalty), 1e-300),
                          p)
             p = p / p.sum(axis=-1, keepdims=True)
-        tok = sample_next(p, params, rng)
+        if params.greedy:
+            # the one shared implementation (utils/sampling.sample_token)
+            # also backs the served fused decode window; greedy here is
+            # bit-identical to the numpy path by contract
+            tok = np.asarray(sample_token(p, params, None)).astype(np.int64)
+        else:
+            tok = sample_next(p, params, rng)
         generated[:, i] = tok
         if penalize:
             seen[np.arange(B), tok] = True

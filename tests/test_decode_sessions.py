@@ -427,8 +427,11 @@ class TestServingDecodeHttp:
         import urllib.request
         from deeplearning4j_tpu.serving import InferenceServer
 
+        # K=2 windows: enough round-trips per session (prefill + 3
+        # windows for 6 tokens) that the two concurrent clients reliably
+        # coalesce into shared dispatches, which this test asserts
         srv = InferenceServer(_make_net(), decode_slots=2,
-                              decode_prefill_chunk=4)
+                              decode_prefill_chunk=4, decode_fused_k=2)
         port = srv.start()
         base = f"http://127.0.0.1:{port}"
         try:

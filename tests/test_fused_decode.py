@@ -1,0 +1,368 @@
+"""Fused multi-token decode: the K-steps-per-dispatch window.
+
+What these pin:
+  * the jit-safe sampler (utils/sampling.sample_token /
+    sample_token_lanes) is semantically identical to the numpy
+    implementation: greedy is BIT-EXACT, truncation supports match,
+    cold temperatures stay on the mode (no float32 underflow)
+  * the hard parity contract: fused-K greedy decode emits the exact
+    token sequence of step-by-step (K=1) decode, across prompt lengths
+    spanning the prefill chunk buckets, and stochastic streams are
+    K-invariant (token i always draws with fold_in(key, i))
+  * per-lane early exit: EOS mid-window stops a lane without breaking
+    the fixed shape or leaking post-EOS tokens
+  * mixed co-batches: a mid-prefill session and a mid-decode session
+    share one dispatch and neither perturbs the other's output
+  * cancel and deadline land at window boundaries and free the slot
+  * session churn at a fixed K causes ZERO recompiles after warmup
+  * the decode_loop policy seam: env forces, capability degrade,
+    K bucketing, and the kernel_dispatch_total counter
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.observe.watchdog import get_watchdog
+from deeplearning4j_tpu.utils.sampling import (
+    SamplingParams, lane_param_arrays, sample_next, sample_token,
+    sample_token_lanes, truncate_probs,
+)
+
+from test_decode_sessions import V, _make_net
+
+
+@pytest.fixture(scope="module")
+def net():
+    return _make_net()
+
+
+def _plane(net, *, slots=2, chunk=4, fused_k=None):
+    from deeplearning4j_tpu.serving import (
+        ContinuousBatchingScheduler, ModelRegistry, ServingStats,
+    )
+    from deeplearning4j_tpu.serving.sessions import DecodeSessionManager
+
+    registry = ModelRegistry()
+    registry.deploy("default", 1, net, warm=False)
+    stats = ServingStats()
+    sched = ContinuousBatchingScheduler(registry, stats, max_batch_size=8)
+    mgr = DecodeSessionManager(registry, sched, "default", slots=slots,
+                               prefill_chunk=chunk, fused_k=fused_k,
+                               metrics=stats.registry)
+    return registry, sched, mgr
+
+
+# ------------------------------------------------------ device sampler
+class TestSampleTokenParity:
+    def _probs(self, b=6, v=V, seed=0):
+        rng = np.random.default_rng(seed)
+        p = rng.random((b, v))
+        return p / p.sum(-1, keepdims=True)
+
+    def test_greedy_bit_exact_vs_numpy(self):
+        p = self._probs()
+        host = sample_next(p, SamplingParams(greedy=True),
+                           np.random.default_rng(0))
+        dev = np.asarray(sample_token(p, SamplingParams(greedy=True), None))
+        assert np.array_equal(host, dev)
+
+    def test_greedy_tie_breaks_first_occurrence(self):
+        p = np.zeros((1, V))
+        p[0, 3] = p[0, 7] = 0.5
+        host = sample_next(p, SamplingParams(greedy=True),
+                           np.random.default_rng(0))
+        dev = np.asarray(sample_token(p, SamplingParams(greedy=True), None))
+        assert host[0] == dev[0] == 3
+
+    def test_top_k_support_matches_numpy(self):
+        import jax.numpy as jnp
+        p = self._probs()
+        for tk in (1, 3, V):
+            want = truncate_probs(p.astype(np.float64), tk, None) > 0
+            t, k, tp, g = lane_param_arrays(
+                [SamplingParams(top_k=tk)] * p.shape[0], V)
+            pj = jnp.asarray(p, jnp.float32)
+            ranks = jnp.argsort(jnp.argsort(-pj, axis=-1), axis=-1)
+            got = np.asarray(ranks < jnp.asarray(k)[:, None])
+            assert np.array_equal(want, got), f"top_k={tk}"
+
+    def test_top_p_keeps_crossing_token(self):
+        import jax
+        # draws from a known nucleus: top_p=0.5 over [0.4, 0.3, 0.2, 0.1]
+        # keeps {0, 1} (token 1 crosses the threshold)
+        p = np.tile([0.4, 0.3, 0.2, 0.1], (512, 1))
+        toks = np.asarray(sample_token(
+            p, SamplingParams(top_p=0.5), jax.random.PRNGKey(0)))
+        assert set(np.unique(toks)) == {0, 1}
+
+    def test_top_k_stochastic_stays_in_support(self):
+        import jax
+        p = np.tile(self._probs(b=1), (512, 1))
+        toks = np.asarray(sample_token(
+            p, SamplingParams(top_k=4, temperature=1.2),
+            jax.random.PRNGKey(1)))
+        allowed = set(np.argsort(-p[0])[:4].tolist())
+        assert set(np.unique(toks)) <= allowed
+
+    def test_cold_temperature_no_underflow(self):
+        import jax
+        # p^(1/tau) at tau=0.005 underflows float32 by ~1e-170; the
+        # log-space tempering must keep the draw on the argmax
+        p = self._probs()
+        toks = np.asarray(sample_token(
+            p, SamplingParams(temperature=0.005), jax.random.PRNGKey(7)))
+        assert np.array_equal(toks, p.argmax(-1))
+
+    def test_lanes_mixed_knobs_single_program(self):
+        import jax
+        import jax.numpy as jnp
+        p = self._probs(b=4)
+        params = [SamplingParams(greedy=True),
+                  SamplingParams(top_k=1),
+                  SamplingParams(temperature=0.005),
+                  SamplingParams(top_p=1e-6)]
+        t, k, tp, g = lane_param_arrays(params, V)
+        keys = jax.random.split(jax.random.PRNGKey(0), 4)
+        toks = np.asarray(sample_token_lanes(
+            jnp.asarray(p, jnp.float32), jnp.asarray(t), jnp.asarray(k),
+            jnp.asarray(tp), jnp.asarray(g), keys))
+        # every knob above collapses the lane to its argmax
+        assert np.array_equal(toks, p.argmax(-1))
+
+    def test_textgen_greedy_uses_shared_sampler(self, net):
+        from deeplearning4j_tpu.utils.textgen import generate
+        out = generate(net, [[1, 2, 3]], 5, greedy=True)
+        net.rnn_clear_previous_state()
+        assert out.shape == (1, 5)
+        assert out.min() >= 0 and out.max() < V
+
+
+# -------------------------------------------------- the parity contract
+def _run_tokens(net, prompt, *, fused_k, max_tokens=10, chunk=4,
+                greedy=True, seed=None, eos_id=None):
+    registry, sched, mgr = _plane(net, chunk=chunk, fused_k=fused_k)
+    try:
+        sess = mgr.open_session(prompt, max_tokens=max_tokens,
+                                greedy=greedy, seed=seed, eos_id=eos_id)
+        return sess.result(timeout=60), mgr
+    finally:
+        sched.shutdown()
+        registry.close()
+
+
+class TestFusedGreedyParity:
+    @pytest.mark.parametrize("prompt", [[5], [1, 2, 3], [1, 2, 3, 4, 5],
+                                        [1, 2, 3, 4, 5, 6, 7, 8, 9]])
+    @pytest.mark.parametrize("k", [4, 8])
+    def test_bit_exact_vs_stepwise_across_buckets(self, net, prompt, k):
+        """Prompts span the prefill buckets (stem 0, <chunk, =chunk,
+        >2*chunk); fused-K greedy must emit the exact stepwise stream."""
+        step, _ = _run_tokens(net, prompt, fused_k=1)
+        fused, _ = _run_tokens(net, prompt, fused_k=k)
+        assert fused == step, (prompt, k)
+
+    def test_stochastic_stream_is_k_invariant(self, net):
+        """Token i draws with fold_in(base_key, i) regardless of how
+        steps share windows, so a seeded stochastic stream is identical
+        at every K."""
+        kwargs = dict(greedy=False, seed=1234, max_tokens=12)
+        one, _ = _run_tokens(net, [1, 2, 3], fused_k=1, **kwargs)
+        four, _ = _run_tokens(net, [1, 2, 3], fused_k=4, **kwargs)
+        eight, _ = _run_tokens(net, [1, 2, 3], fused_k=8, **kwargs)
+        assert one == four == eight
+
+    def test_seed_determinism_and_independence(self, net):
+        a, _ = _run_tokens(net, [1, 2], fused_k=4, greedy=False, seed=7,
+                           max_tokens=12)
+        b, _ = _run_tokens(net, [1, 2], fused_k=4, greedy=False, seed=7,
+                          max_tokens=12)
+        c, _ = _run_tokens(net, [1, 2], fused_k=4, greedy=False, seed=8,
+                          max_tokens=12)
+        assert a == b
+        assert a != c       # 12 tokens over V=13: collision ~ never
+
+
+# ------------------------------------------------- early exit / windows
+class TestWindowEarlyExit:
+    def test_eos_mid_window_stops_lane(self, net):
+        """Find the greedy stream first, then replay with its 3rd token
+        as EOS and a window that spans it: the session must stop AT the
+        EOS token (device early-exit), not at the window edge."""
+        free, _ = _run_tokens(net, [1, 2, 3], fused_k=8, max_tokens=8)
+        # first token that did not appear earlier in the stream: making
+        # it EOS must truncate exactly there (strictly inside the window)
+        i = next(j for j in range(1, len(free))
+                 if free[j] not in free[:j])
+        assert i < len(free) - 1, "stream too repetitive for this net"
+        got, _ = _run_tokens(net, [1, 2, 3], fused_k=8, max_tokens=8,
+                             eos_id=free[i])
+        assert got == free[:i + 1]
+        assert got[-1] == free[i]
+
+    def test_budget_mid_window(self, net):
+        """max_tokens not a multiple of K: the final short window must
+        stop at the budget, not pad the stream to the window edge."""
+        got, _ = _run_tokens(net, [1, 2, 3], fused_k=8, max_tokens=5)
+        full, _ = _run_tokens(net, [1, 2, 3], fused_k=8, max_tokens=8)
+        assert len(got) == 5
+        assert got == full[:5]
+
+    def test_round_trips_amortized(self, net):
+        """The whole point: max_tokens=8 at K=8 is ONE decode window —
+        dispatches/token collapses vs stepwise."""
+        registry, sched, mgr = _plane(net, fused_k=8)
+        try:
+            sess = mgr.open_session([1, 2, 3], max_tokens=8, greedy=True)
+            toks = sess.result(timeout=60)
+            snap = mgr.snapshot()
+            assert len(toks) == 8
+            assert snap["dispatches"]["windows"] == 1
+            assert snap["dispatches"]["window_tokens"] == 8
+            # stem (2 tokens -> 1 chunk) + 1 window = 2 round-trips
+            assert snap["dispatches"]["total"] == 2
+            assert snap["decode_loop"]["kind"] == "fused"
+            assert snap["decode_loop"]["k"] == 8
+        finally:
+            sched.shutdown()
+            registry.close()
+
+
+# ---------------------------------------------- co-batching and churn
+class TestMixedCoBatch:
+    def test_prefill_and_window_share_dispatch(self, net):
+        """A long-prompt session (mid-prefill) and a short-prompt
+        session (mid-decode) coalesce, and neither perturbs the other:
+        co-batched outputs equal solo outputs token for token."""
+        solo_a, _ = _run_tokens(net, [1, 2, 3, 4, 5, 6, 7, 8, 9],
+                                fused_k=4, max_tokens=6)
+        solo_b, _ = _run_tokens(net, [5], fused_k=4, max_tokens=6)
+        registry, sched, mgr = _plane(net, fused_k=4)
+        try:
+            sa = mgr.open_session([1, 2, 3, 4, 5, 6, 7, 8, 9],
+                                  max_tokens=6, greedy=True)
+            sb = mgr.open_session([5], max_tokens=6, greedy=True)
+            got_a = sa.result(timeout=60)
+            got_b = sb.result(timeout=60)
+            assert got_a == solo_a
+            assert got_b == solo_b
+        finally:
+            sched.shutdown()
+            registry.close()
+
+    def test_churn_zero_recompiles_after_warmup(self, net):
+        """Session churn — different prompts, budgets, knobs, seeds —
+        through one warmed manager mints no new programs."""
+        registry, sched, mgr = _plane(net, fused_k=4)
+        try:
+            c0 = get_watchdog().compiles()
+            for i in range(4):
+                s1 = mgr.open_session([1 + i, 2, 3], max_tokens=3 + i,
+                                      greedy=(i % 2 == 0), seed=i,
+                                      temperature=0.7 + 0.1 * i)
+                s2 = mgr.open_session([2 + i], max_tokens=5,
+                                      top_k=3 + i, seed=10 + i)
+                s1.result(timeout=60), s2.result(timeout=60)
+            assert get_watchdog().compiles() == c0, \
+                "session churn caused recompiles at fixed K"
+        finally:
+            sched.shutdown()
+            registry.close()
+
+
+# ----------------------------------------- cancel/deadline in a window
+class TestCancelDeadlineInWindow:
+    def test_cancel_between_windows_keeps_partial(self, net):
+        registry, sched, mgr = _plane(net, fused_k=4)
+        try:
+            sess = mgr.open_session([1, 2, 3], max_tokens=40)
+            # wait for the first window's tokens, then cancel
+            deadline = time.monotonic() + 30
+            while not sess.generated and time.monotonic() < deadline:
+                time.sleep(0.002)
+            assert sess.generated, "no window landed in 30s"
+            sess.cancel()
+            sess.done.wait(30)
+            assert sess.outcome == "cancelled"
+            partial = len(sess.generated)
+            assert 1 <= partial < 40
+            # cancel lands at a window boundary: the slot is free again
+            assert mgr.pool.describe()["in_use"] == 0
+        finally:
+            sched.shutdown()
+            registry.close()
+
+    def test_deadline_expires_mid_stream_frees_slot(self, net):
+        from deeplearning4j_tpu.serving.scheduler import (
+            DeadlineExceededError,
+        )
+        registry, sched, mgr = _plane(net, fused_k=4)
+        try:
+            sess = mgr.open_session([1, 2, 3], max_tokens=40,
+                                    deadline_ms=60000)
+            deadline = time.monotonic() + 30
+            while not sess.generated and time.monotonic() < deadline:
+                time.sleep(0.002)
+            assert sess.generated, "no window landed in 30s"
+            # force the deadline into the past: the next window submit
+            # must expire the session instead of chaining forever
+            sess.deadline = time.monotonic() - 0.001
+            with pytest.raises(DeadlineExceededError):
+                sess.result(timeout=30)
+            assert sess.outcome == "expired"
+            assert mgr.pool.describe()["in_use"] == 0
+        finally:
+            sched.shutdown()
+            registry.close()
+
+
+# ------------------------------------------------------ policy seam
+class TestDecodeLoopPolicy:
+    def test_lattice_and_bucketing(self, monkeypatch):
+        from deeplearning4j_tpu.ops.kernel_defaults import (
+            DECODE_K_BUCKETS, decode_loop_policy,
+        )
+        monkeypatch.delenv("DL4J_TPU_DECODE_LOOP", raising=False)
+        monkeypatch.delenv("DL4J_TPU_DECODE_K", raising=False)
+        pol = decode_loop_policy(record=False)
+        assert pol.kind == "fused" and pol.k in DECODE_K_BUCKETS
+        assert decode_loop_policy(3, record=False).k == 4   # bucketed up
+        assert decode_loop_policy(99, record=False).k == \
+            DECODE_K_BUCKETS[-1]
+        assert decode_loop_policy(capable=False,
+                                  record=False).kind == "stepwise"
+        monkeypatch.setenv("DL4J_TPU_DECODE_LOOP", "stepwise")
+        assert decode_loop_policy(8, record=False) \
+            .kind == "stepwise"
+        monkeypatch.setenv("DL4J_TPU_DECODE_LOOP", "fused")
+        monkeypatch.setenv("DL4J_TPU_DECODE_K", "2")
+        pol = decode_loop_policy(8, record=False)
+        assert pol.kind == "fused" and pol.k == 2
+
+    def test_dispatch_counter_and_stepwise_manager(self, net,
+                                                   monkeypatch):
+        from deeplearning4j_tpu.observe import get_registry
+        monkeypatch.setenv("DL4J_TPU_DECODE_LOOP", "stepwise")
+        registry, sched, mgr = _plane(net)
+        try:
+            assert mgr.loop_kind == "stepwise" and mgr.fused_k == 1
+            # counted on BOTH the global spine and the private registry
+            c = get_registry().counter("kernel_dispatch_total",
+                                       op="decode_loop", impl="stepwise")
+            assert int(c.value) >= 1
+            m = mgr.metrics.counter("kernel_dispatch_total",
+                                    op="decode_loop", impl="stepwise")
+            assert int(m.value) >= 1
+            # stepwise is K=1 through the same window program: still
+            # samples on-device, still exact
+            sess = mgr.open_session([1, 2, 3], max_tokens=4, greedy=True)
+            toks = sess.result(timeout=60)
+            assert len(toks) == 4
+            snap = mgr.snapshot()
+            assert snap["decode_loop"] == {
+                "kind": "stepwise", "k": 1,
+                "reason": "forced by DL4J_TPU_DECODE_LOOP=stepwise"}
+        finally:
+            sched.shutdown()
+            registry.close()

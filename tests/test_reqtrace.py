@@ -221,14 +221,23 @@ class TestDecodeFanIn:
             assert {rt1.trace_id, rt2.trace_id} <= set(shared[0]
                                                        ["co_traces"])
 
-            steps = [(d, a) for d, n, a in spans if n == "session.step"]
-            assert steps, "no per-step session spans"
+            steps = [(d, a) for d, n, a in spans if n == "session.window"]
+            assert steps, "no per-window session spans"
             for d, a in steps:
                 assert d >= 2                 # child of a dispatch span
                 assert a["session"] == s1.id and a["slot"] == s1.slot
                 assert a["kernel"] and a["kernel"] != "n/a"
+                assert a["loop"] in ("fused", "stepwise")
+                assert a["win"] >= 1
             phases = {a["phase"] for _, a in steps}
             assert phases == {"prefill", "decode"}
+            # per-token reconstruction: decode windows account for every
+            # streamed token of the session
+            emitted = sum(a["tokens"] for _, a in steps
+                          if a["phase"] == "decode")
+            assert emitted == len(s1.result())
+            assert all(a["tokens"] == 0 for _, a in steps
+                       if a["phase"] == "prefill")
             # the second trace sees the SAME shared dispatches
             doc2 = sampled.tree(rt2.trace_id)
             assert any(a.get("co_traces") == shared[0]["co_traces"]

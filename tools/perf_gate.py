@@ -33,7 +33,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 BASELINE_PATH = os.path.join(os.path.dirname(__file__), "..",
                              ".graftperf-baseline.json")
-WORKLOAD_VERSION = 4
+WORKLOAD_VERSION = 5
 
 # Default slack written into a fresh baseline: zero extra compiles (a
 # new program IS the regression being hunted) and half a sync of noise
@@ -55,7 +55,13 @@ DEFAULT_BUDGETS = {"extra_compiles_per_owner": 0,
                    # them through a fit may add exactly zero syncs and
                    # zero compiles
                    "extra_series_syncs_per_step": 0.0,
-                   "extra_series_compiles": 0}
+                   "extra_series_compiles": 0,
+                   # fused decode pays ONE host sync per K-token window
+                   # (the token readback) and session churn at a fixed K
+                   # compiles NOTHING after the manager's warmup
+                   # (PERF_NOTES) — both are contracts, not budgets
+                   "extra_decode_syncs_per_window": 0.5,
+                   "extra_decode_compiles": 0}
 
 
 def run_workload() -> dict:
@@ -202,6 +208,75 @@ def run_workload() -> dict:
         for _ in range(2):
             net.output(x[:8])
 
+        # --- fused-decode leg: session churn through the K-token decode
+        # window. Two contracts measure here: churn at a fixed K causes
+        # ZERO compiles after the manager's warmup (the fixed-shape
+        # decode contract), and each window pays exactly ONE host sync
+        # (the token readback — prefill legs never read logits back).
+        from deeplearning4j_tpu.nn.layers.attention import (
+            PositionEmbeddingLayer,
+        )
+        from deeplearning4j_tpu.serving import (
+            ContinuousBatchingScheduler, ModelRegistry, ServingStats,
+        )
+        from deeplearning4j_tpu.serving.sessions import (
+            DecodeSessionManager,
+        )
+        DV, K = 16, 4
+        conf = (NeuralNetConfiguration.builder().seed(0)
+                .updater(Adam(1e-3)).activation("identity")
+                .list(EmbeddingSequenceLayer(n_in=DV, n_out=16),
+                      PositionEmbeddingLayer(max_length=128),
+                      TransformerEncoderBlock(num_heads=2, causal=True,
+                                              window=8,
+                                              rolling_cache=True,
+                                              max_cache=32),
+                      RnnOutputLayer(n_out=DV, activation="softmax"))
+                .set_input_type(InputType.recurrent(1, 4)).build())
+        dnet = MultiLayerNetwork(conf).init()
+        registry = ModelRegistry()
+        registry.deploy("default", 1, dnet, warm=False)
+        stats = ServingStats()
+        sched = ContinuousBatchingScheduler(registry, stats,
+                                            max_batch_size=8)
+        decode = None
+        try:
+            mgr = DecodeSessionManager(registry, sched, "default",
+                                       slots=2, prefill_chunk=4,
+                                       fused_k=K,
+                                       metrics=stats.registry)
+            # one warm session: any lazy path off the measured run
+            mgr.open_session([1, 2, 3], max_tokens=8).result(timeout=60)
+            before = mgr.snapshot()["dispatches"]
+            compiles_warm = get_watchdog().snapshot()["total_compiles"]
+            mon = HostSyncMonitor().install()
+            try:
+                for wave in range(2):      # churn: 2 waves x 2 slots
+                    ss = [mgr.open_session([1 + 2 * wave + i, 2, 3, 4,
+                                            5],
+                                           max_tokens=12, seed=i)
+                          for i in range(2)]
+                    for s in ss:
+                        s.result(timeout=60)
+            finally:
+                mon.uninstall()
+            after = mgr.snapshot()["dispatches"]
+            windows = after["windows"] - before["windows"]
+            decode = {
+                "fused_k": K,
+                "windows": windows,
+                "window_tokens": (after["window_tokens"]
+                                  - before["window_tokens"]),
+                "syncs_per_window": round(mon.syncs / windows, 3)
+                if windows else None,
+                "extra_compiles":
+                    get_watchdog().snapshot()["total_compiles"]
+                    - compiles_warm,
+            }
+        finally:
+            sched.shutdown()
+            registry.close()
+
         # --- sharded fit: the GSPMD spine (data-sharded batch, replica-
         # sharded Adam moments). Placement regressions show up here as
         # extra syncs (collective fell back to host), extra
@@ -259,6 +334,7 @@ def run_workload() -> dict:
         "syncs_per_step": round(syncs_per_step, 3),
         "traced": traced,
         "series": series,
+        "decode": decode,
         "sharded": sharded,
     }
 
@@ -330,6 +406,27 @@ def compare(baseline: dict, measured: dict) -> list:
                 f"{meas_se.get('extra_compiles')} jit compile(s) "
                 f"(budget +{c_budget}) — the telemetry path must never "
                 f"enter jit")
+    # fused-decode leg: only gated once a baseline recorded it
+    if baseline.get("decode"):
+        base_d = baseline["decode"]
+        meas_d = measured.get("decode") or {}
+        d_limit = (base_d.get("syncs_per_window") or 0.0) + \
+            budgets["extra_decode_syncs_per_window"]
+        if (meas_d.get("syncs_per_window") or 0.0) > d_limit:
+            breaches.append(
+                f"decode syncs/window {meas_d.get('syncs_per_window')} "
+                f"vs baseline {base_d.get('syncs_per_window')} (budget "
+                f"+{budgets['extra_decode_syncs_per_window']}) — fused "
+                f"decode pays ONE host sync per K-token window by "
+                f"contract (PERF_NOTES); an extra readback crept into "
+                f"the dispatch loop")
+        d_budget = budgets["extra_decode_compiles"]
+        if meas_d.get("extra_compiles", 0) > d_budget:
+            breaches.append(
+                f"decode session churn compiled "
+                f"{meas_d.get('extra_compiles')} program(s) after "
+                f"warmup (budget +{d_budget}) — the fixed-shape decode "
+                f"contract: churn at a fixed K never recompiles")
     # sharded-spine leg: only gated once a baseline recorded it
     base_sh = baseline.get("sharded")
     if base_sh:
@@ -386,6 +483,11 @@ def diff(baseline: dict, measured: dict) -> list:
         m = (measured.get("series") or {}).get(key)
         if b != m:
             out.append(f"  series.{key}: {b} -> {m}")
+    for key in ("syncs_per_window", "extra_compiles"):
+        b = (baseline.get("decode") or {}).get(key)
+        m = (measured.get("decode") or {}).get(key)
+        if b != m:
+            out.append(f"  decode.{key}: {b} -> {m}")
     return out
 
 

@@ -4,8 +4,10 @@
 Boots a real InferenceServer (CPU), streams one SAMPLED /generate
 request, then asserts the reconstruction contract on GET /trace/{id}:
 the tree must reach depth ≥3 — HTTP root → shared dispatch →
-session.step — with the step spans carrying slot + kernel-policy
-attributes. Exits nonzero (with the offending JSON) on any miss, so the
+session.window — with the window spans carrying slot + kernel-policy +
+decode-loop attributes, and the per-window `tokens` attrs summing to
+exactly the streamed token count (the trace IS the stream, window by
+window). Exits nonzero (with the offending JSON) on any miss, so the
 gate catches a broken seam, not just a broken import.
 """
 
@@ -92,16 +94,31 @@ def main() -> int:
             problems.append("no HTTP root span")
         if not any(name == "dispatch" for _, name, _ in spans):
             problems.append("no shared dispatch span")
-        steps = [a for _, name, a in spans if name == "session.step"]
-        if not steps:
-            problems.append("no session.step spans")
-        elif not all("slot" in a and "kernel" in a for a in steps):
-            problems.append("session.step spans missing slot/kernel attrs")
+        wins = [a for _, name, a in spans if name == "session.window"]
+        if not wins:
+            problems.append("no session.window spans")
+        elif not all("slot" in a and "kernel" in a and "loop" in a
+                     and "win" in a and "tokens" in a for a in wins):
+            problems.append(
+                "session.window spans missing slot/kernel/loop/win/"
+                "tokens attrs")
+        else:
+            emitted = sum(a["tokens"] for a in wins
+                          if a.get("phase") == "decode")
+            if emitted != tokens:
+                problems.append(
+                    f"window spans account for {emitted} tokens but the "
+                    f"stream carried {tokens} — the trace no longer "
+                    f"reconstructs the stream")
+            if any(a["tokens"] != 0 for a in wins
+                   if a.get("phase") == "prefill"):
+                problems.append("prefill window spans claim tokens")
         if problems:
             print(json.dumps(tree, indent=1)[:4000])
             sys.exit("FAIL: " + "; ".join(problems))
         print(f"trace smoke OK: {trace_id} — {tree['spans']} spans, "
-              f"depth {tree['depth']}, {len(steps)} session steps")
+              f"depth {tree['depth']}, {len(wins)} session windows, "
+              f"{tokens} tokens reconciled")
         return 0
     finally:
         srv.stop()
