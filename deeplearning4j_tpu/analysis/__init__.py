@@ -14,23 +14,34 @@ Public API:
     lint_paths(paths) / lint_file(path) / lint_source(src) -> [Finding]
     RULES                         — rule registry (id -> Rule)
     RUNTIME_RULE_HINTS            — runtime-event kind -> static rules
-                                    (the watchdog/monitor cross-check)
+                                    (the watchdog/monitor/lockmon
+                                    cross-check)
     load_baseline/apply_baseline/write_baseline
+    Program / CallGraph           — whole-program call graph (callgraph.py)
+    analyze_lock_sources/analyze_lock_paths — GL7xx lockset pass
 """
 
 from deeplearning4j_tpu.analysis.baseline import (   # noqa: F401
     apply_baseline, load_baseline, write_baseline,
 )
+from deeplearning4j_tpu.analysis.callgraph import (  # noqa: F401
+    CallGraph, Program,
+)
 from deeplearning4j_tpu.analysis.engine import (     # noqa: F401
     DEFAULT_HOT_PREFIXES, Finding, is_hot, lint_file, lint_paths,
     lint_source,
+)
+from deeplearning4j_tpu.analysis.locks import (      # noqa: F401
+    analyze_lock_paths, analyze_lock_sources,
 )
 from deeplearning4j_tpu.analysis.rules import (      # noqa: F401
     RULES, RUNTIME_RULE_HINTS, Rule, runtime_hint,
 )
 
 __all__ = [
-    "DEFAULT_HOT_PREFIXES", "Finding", "RULES", "RUNTIME_RULE_HINTS",
-    "Rule", "apply_baseline", "is_hot", "lint_file", "lint_paths",
-    "lint_source", "load_baseline", "runtime_hint", "write_baseline",
+    "CallGraph", "DEFAULT_HOT_PREFIXES", "Finding", "Program", "RULES",
+    "RUNTIME_RULE_HINTS", "Rule", "analyze_lock_paths",
+    "analyze_lock_sources", "apply_baseline", "is_hot", "lint_file",
+    "lint_paths", "lint_source", "load_baseline", "runtime_hint",
+    "write_baseline",
 ]

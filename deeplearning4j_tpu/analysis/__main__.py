@@ -4,17 +4,27 @@
         [--format text|json|sarif] [--strict]
         [--baseline FILE] [--write-baseline FILE]
         [--select GL2,GL301] [--ignore GL4] [--list-rules]
-        [--hot-prefix PREFIX ...]
+        [--hot-prefix PREFIX ...] [--changed [BASE]]
 
 Exit codes: 0 clean (after baseline/suppressions); 1 findings
 (errors only by default, any finding under --strict); 2 usage error.
 `tools/ci_check.sh` runs `--strict --baseline .graftlint-baseline.json`
 as the repo's lint-clean gate.
+
+`--changed [BASE]` lints only the .py files `git diff --name-only BASE`
+reports (default BASE: HEAD), plus untracked .py files — the pre-commit
+path, which skips the whole-repo call-graph build the GL7xx lockset
+pass otherwise pays. Positional paths become a filter: a changed file
+is linted only if it lies under one of them. Exit-code semantics are
+UNCHANGED: 0/1/2 mean exactly what they mean without --changed, and no
+changed files means 0 findings means exit 0.
 """
 
 from __future__ import annotations
 
 import argparse
+import os
+import subprocess
 import sys
 from typing import List, Optional
 
@@ -34,14 +44,43 @@ def _split_rules(csv: Optional[str]) -> Optional[List[str]]:
     return [s.strip() for s in csv.split(",") if s.strip()]
 
 
+def _changed_files(base: str, roots: List[str]) -> List[str]:
+    """Changed .py files vs `base` (plus untracked), filtered to the
+    requested roots (no roots = keep everything). Raises RuntimeError
+    when git itself fails."""
+    def _git(*cmd: str) -> List[str]:
+        proc = subprocess.run(["git", *cmd], capture_output=True,
+                              text=True)
+        if proc.returncode != 0:
+            raise RuntimeError(
+                f"git {' '.join(cmd)} failed: "
+                f"{proc.stderr.strip() or proc.returncode}")
+        return [ln.strip() for ln in proc.stdout.splitlines()
+                if ln.strip()]
+
+    changed = set(_git("diff", "--name-only", base, "--"))
+    changed |= set(_git("ls-files", "--others", "--exclude-standard"))
+    norm_roots = [r.rstrip("/").replace(os.sep, "/") for r in roots]
+    out = []
+    for f in sorted(changed):
+        if not f.endswith(".py") or not os.path.isfile(f):
+            continue            # deleted files show in the diff too
+        norm = f.replace(os.sep, "/")
+        if not norm_roots or any(norm == r or norm.startswith(r + "/")
+                                 for r in norm_roots):
+            out.append(f)
+    return out
+
+
 def main(argv: Optional[List[str]] = None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m deeplearning4j_tpu.analysis",
         description="graft-lint: tracer-safety & recompile-hazard "
                     "static analysis")
-    ap.add_argument("paths", nargs="*", default=["deeplearning4j_tpu"],
+    ap.add_argument("paths", nargs="*", default=[],
                     help="files or directories (default: "
-                         "deeplearning4j_tpu)")
+                         "deeplearning4j_tpu; under --changed, "
+                         "default: no path filter)")
     ap.add_argument("--format", choices=sorted(RENDERERS),
                     default="text")
     ap.add_argument("--strict", action="store_true",
@@ -60,6 +99,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                     metavar="PREFIX",
                     help="override the hot-module path prefixes "
                          "(repeatable)")
+    ap.add_argument("--changed", nargs="?", const="HEAD", default=None,
+                    metavar="BASE",
+                    help="lint only files changed vs BASE (git diff "
+                         "--name-only; default HEAD) plus untracked .py "
+                         "files; positional paths act as a filter; "
+                         "exit-code semantics unchanged")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
@@ -72,7 +117,14 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     hot = tuple(args.hot_prefix) if args.hot_prefix \
         else DEFAULT_HOT_PREFIXES
-    paths = args.paths or ["deeplearning4j_tpu"]
+    if args.changed is not None:
+        try:
+            paths = _changed_files(args.changed, args.paths)
+        except RuntimeError as e:
+            print(f"graft-lint: {e}", file=sys.stderr)
+            return 2
+    else:
+        paths = args.paths or ["deeplearning4j_tpu"]
     files = iter_python_files(paths)
     findings = lint_paths(paths, hot_prefixes=hot,
                           select=_split_rules(args.select),

@@ -1,0 +1,374 @@
+"""Whole-program call graph for graft-lint's interprocedural passes.
+
+The GL7xx lockset analysis (analysis/locks.py) needs to know, for a
+call site like `self.pool.free(slot)` or a bare `helper(x)`, WHICH
+function body runs — across modules. This module builds that map from
+plain ASTs, stdlib-only, with deliberately-bounded resolution:
+
+- module-level functions: same-module calls, `from mod import f`, and
+  `mod.f(...)` through an import alias;
+- methods: `self.m(...)` resolved through the enclosing class and its
+  program-local bases (depth-first, cycle-safe);
+- one level of attribute typing: `self.pool = KVSlotPool(...)` in
+  `__init__` types `self.pool`, so `self.pool.free(...)` resolves into
+  KVSlotPool — the cross-class seam the lock analysis cares about
+  (KVSlotPool's Condition is acquired from serving/sessions.py);
+- constructors: `ClassName(...)` resolves to `__init__`.
+
+Anything else (duck-typed parameters, dynamic dispatch, builtins)
+deliberately resolves to *nothing*: the lockset pass treats unresolved
+calls as opaque, which keeps it sound-for-suppression — a held lock is
+never invented for code we cannot see — and keeps the whole-repo build
+cheap enough for the CI lint gate.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+#: threading constructors whose result is a lock-ish guard object, and
+#: the attribute-name heuristic for guard attributes (shared with the
+#: engine's intraprocedural GL301).
+LOCK_CLASSES = ("Lock", "RLock", "Condition", "Semaphore",
+                "BoundedSemaphore")
+LOCKISH_RE = re.compile(
+    r"(^|_)r?lock|mutex|(^|_)cv($|_)|(^|_)cond(ition)?($|_)",
+    re.IGNORECASE)
+
+#: Interprocedural propagation is bounded: held-lockset facts travel at
+#: most this many call-graph hops (each fixpoint round moves facts one
+#: edge). Plenty for this codebase; guarantees termination regardless.
+MAX_PROPAGATION_ROUNDS = 16
+
+
+def module_name_from_path(path: str) -> str:
+    """'deeplearning4j_tpu/serving/sessions.py' -> dotted module name."""
+    norm = path.replace(os.sep, "/")
+    if norm.endswith(".py"):
+        norm = norm[:-3]
+    if norm.endswith("/__init__"):
+        norm = norm[: -len("/__init__")]
+    return norm.strip("/").replace("/", ".")
+
+
+@dataclass
+class FunctionInfo:
+    qualname: str                       # "pkg.mod.Class.method"
+    node: ast.AST                       # FunctionDef / AsyncFunctionDef
+    module: "ModuleInfo"
+    cls: Optional["ClassInfo"] = None
+
+    @property
+    def name(self) -> str:
+        return self.node.name
+
+    @property
+    def self_name(self) -> Optional[str]:
+        if self.cls is None:
+            return None
+        args = self.node.args.args
+        return args[0].arg if args else "self"
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    module: "ModuleInfo"
+    node: ast.ClassDef
+    methods: Dict[str, FunctionInfo] = field(default_factory=dict)
+    bases: List[str] = field(default_factory=list)     # dotted as written
+    lock_attrs: Set[str] = field(default_factory=set)
+    #: self.<attr> -> ClassInfo of the constructor assigned in __init__
+    attr_classes: Dict[str, "ClassInfo"] = field(default_factory=dict)
+    self_name: str = "self"
+
+    @property
+    def qualname(self) -> str:
+        return f"{self.module.name}.{self.name}"
+
+
+@dataclass
+class ModuleInfo:
+    path: str
+    name: str                           # dotted
+    source: str
+    lines: List[str]
+    tree: ast.Module
+    #: local alias -> dotted module ("np" -> "numpy")
+    import_alias: Dict[str, str] = field(default_factory=dict)
+    #: local name -> (dotted module, original name) for from-imports
+    from_names: Dict[str, Tuple[str, str]] = field(default_factory=dict)
+    classes: Dict[str, ClassInfo] = field(default_factory=dict)
+    functions: Dict[str, FunctionInfo] = field(default_factory=dict)
+    #: module-global lock variables: name -> lock id "modshort.name"
+    module_locks: Dict[str, str] = field(default_factory=dict)
+
+    @property
+    def shortname(self) -> str:
+        return self.name.split(".")[-1]
+
+
+def _terminal(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _is_lock_ctor(value: ast.AST) -> bool:
+    return (isinstance(value, ast.Call)
+            and _terminal(value.func) in LOCK_CLASSES)
+
+
+class Program:
+    """All parsed modules, indexed for resolution."""
+
+    def __init__(self) -> None:
+        self.modules: Dict[str, ModuleInfo] = {}
+        self.functions: Dict[str, FunctionInfo] = {}    # incl. methods
+
+    # ------------------------------------------------------ construction
+    @classmethod
+    def from_sources(cls, sources: Sequence[Tuple[str, str]]) -> "Program":
+        """Build from (path, source) pairs; unparsable files are skipped
+        (the per-file engine already reports GL000 for them)."""
+        prog = cls()
+        for path, source in sources:
+            try:
+                tree = ast.parse(source)
+            except SyntaxError:
+                continue
+            prog._add_module(path, source, tree)
+        for mod in prog.modules.values():
+            for ci in mod.classes.values():
+                prog._scan_class_init(ci)
+        return prog
+
+    @classmethod
+    def from_paths(cls, paths: Sequence[str]) -> "Program":
+        sources = []
+        for p in paths:
+            try:
+                with open(p, "r", encoding="utf-8", errors="replace") as f:
+                    src = f.read()
+            except OSError:
+                continue
+            rel = os.path.relpath(p).replace(os.sep, "/")
+            if rel.startswith(".."):
+                rel = p.replace(os.sep, "/")
+            sources.append((rel, src))
+        return cls.from_sources(sources)
+
+    def _add_module(self, path: str, source: str, tree: ast.Module) -> None:
+        mi = ModuleInfo(path=path, name=module_name_from_path(path),
+                        source=source, lines=source.splitlines(),
+                        tree=tree)
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    mi.import_alias[a.asname or a.name.split(".")[0]] = \
+                        a.name if a.asname else a.name.split(".")[0]
+            elif isinstance(node, ast.ImportFrom):
+                base = node.module or ""
+                if node.level:      # relative: resolve against this module
+                    parts = mi.name.split(".")
+                    parts = parts[: len(parts) - node.level]
+                    base = ".".join(parts + ([node.module]
+                                             if node.module else []))
+                for a in node.names:
+                    mi.from_names[a.asname or a.name] = (base, a.name)
+        for stmt in tree.body:
+            if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                fi = FunctionInfo(f"{mi.name}.{stmt.name}", stmt, mi)
+                mi.functions[stmt.name] = fi
+                self.functions[fi.qualname] = fi
+            elif isinstance(stmt, ast.ClassDef):
+                ci = ClassInfo(stmt.name, mi, stmt)
+                for b in stmt.bases:
+                    dotted = _dotted(b)
+                    if dotted:
+                        ci.bases.append(dotted)
+                for sub in stmt.body:
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        fi = FunctionInfo(
+                            f"{mi.name}.{stmt.name}.{sub.name}", sub, mi,
+                            cls=ci)
+                        ci.methods[sub.name] = fi
+                        self.functions[fi.qualname] = fi
+                mi.classes[stmt.name] = ci
+            elif isinstance(stmt, ast.Assign):
+                # module-global lock: `_lock = threading.Lock()`
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name) and (
+                            _is_lock_ctor(stmt.value)
+                            or (LOCKISH_RE.search(t.id)
+                                and isinstance(stmt.value, ast.Call))):
+                        if _is_lock_ctor(stmt.value):
+                            mi.module_locks[t.id] = \
+                                f"{mi.shortname}.{t.id}"
+        self.modules[mi.name] = mi
+
+    def _scan_class_init(self, ci: ClassInfo) -> None:
+        init = ci.methods.get("__init__")
+        if init is None:
+            return
+        if init.node.args.args:
+            ci.self_name = init.node.args.args[0].arg
+        for n in ast.walk(init.node):
+            if not isinstance(n, ast.Assign):
+                continue
+            for t in n.targets:
+                if not (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == ci.self_name):
+                    continue
+                if _is_lock_ctor(n.value) or LOCKISH_RE.search(t.attr):
+                    ci.lock_attrs.add(t.attr)
+                elif isinstance(n.value, ast.Call):
+                    target = self._resolve_class(ci.module, n.value.func)
+                    if target is not None:
+                        ci.attr_classes[t.attr] = target
+
+    # -------------------------------------------------------- resolution
+    def _resolve_class(self, mod: ModuleInfo,
+                       func: ast.AST) -> Optional[ClassInfo]:
+        if isinstance(func, ast.Name):
+            if func.id in mod.classes:
+                return mod.classes[func.id]
+            tgt = mod.from_names.get(func.id)
+            if tgt is not None:
+                tmod = self.modules.get(tgt[0])
+                if tmod is not None:
+                    return tmod.classes.get(tgt[1])
+        elif isinstance(func, ast.Attribute) \
+                and isinstance(func.value, ast.Name):
+            tmod_name = mod.import_alias.get(func.value.id)
+            if tmod_name and tmod_name in self.modules:
+                return self.modules[tmod_name].classes.get(func.attr)
+        return None
+
+    def resolve_base(self, ci: ClassInfo, base: str) -> Optional[ClassInfo]:
+        mod = ci.module
+        head = base.split(".")[0]
+        if base in mod.classes:
+            return mod.classes[base]
+        tgt = mod.from_names.get(base)
+        if tgt is not None:
+            tmod = self.modules.get(tgt[0])
+            if tmod is not None:
+                return tmod.classes.get(tgt[1])
+        if "." in base:
+            tmod_name = mod.import_alias.get(head)
+            if tmod_name and tmod_name in self.modules:
+                return self.modules[tmod_name].classes.get(
+                    base.split(".")[-1])
+        return None
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+class CallGraph:
+    """Call-site resolution over a Program."""
+
+    def __init__(self, program: Program):
+        self.program = program
+
+    def lookup_method(self, ci: ClassInfo, name: str,
+                      _seen: Optional[Set[str]] = None,
+                      ) -> Optional[FunctionInfo]:
+        """Method resolution through program-local bases (DFS, cycle-
+        and depth-safe)."""
+        seen = _seen if _seen is not None else set()
+        if ci.qualname in seen or len(seen) > 32:
+            return None
+        seen.add(ci.qualname)
+        if name in ci.methods:
+            return ci.methods[name]
+        for base in ci.bases:
+            bci = self.program.resolve_base(ci, base)
+            if bci is not None:
+                hit = self.lookup_method(bci, name, seen)
+                if hit is not None:
+                    return hit
+        return None
+
+    def attr_class(self, ci: ClassInfo, attr: str) -> Optional[ClassInfo]:
+        cur: Optional[ClassInfo] = ci
+        seen: Set[str] = set()
+        while cur is not None and cur.qualname not in seen:
+            seen.add(cur.qualname)
+            if attr in cur.attr_classes:
+                return cur.attr_classes[attr]
+            nxt = None
+            for base in cur.bases:
+                nxt = self.program.resolve_base(cur, base)
+                if nxt is not None:
+                    break
+            cur = nxt
+        return None
+
+    def resolve(self, fn: FunctionInfo,
+                call: ast.Call) -> List[FunctionInfo]:
+        """Candidate callee bodies for a call site (empty = opaque)."""
+        func = call.func
+        mod = fn.module
+        if isinstance(func, ast.Name):
+            name = func.id
+            if name in mod.functions:
+                return [mod.functions[name]]
+            if name in mod.classes:
+                init = mod.classes[name].methods.get("__init__")
+                return [init] if init else []
+            tgt = mod.from_names.get(name)
+            if tgt is not None:
+                tmod = self.program.modules.get(tgt[0])
+                if tmod is not None:
+                    if tgt[1] in tmod.functions:
+                        return [tmod.functions[tgt[1]]]
+                    if tgt[1] in tmod.classes:
+                        init = tmod.classes[tgt[1]].methods.get("__init__")
+                        return [init] if init else []
+            return []
+        if not isinstance(func, ast.Attribute):
+            return []
+        base, meth = func.value, func.attr
+        # self.m(...)
+        if (fn.cls is not None and isinstance(base, ast.Name)
+                and base.id == fn.self_name):
+            hit = self.lookup_method(fn.cls, meth)
+            return [hit] if hit else []
+        # self.attr.m(...) through a typed attribute
+        if (fn.cls is not None and isinstance(base, ast.Attribute)
+                and isinstance(base.value, ast.Name)
+                and base.value.id == fn.self_name):
+            tcls = self.attr_class(fn.cls, base.attr)
+            if tcls is not None:
+                hit = self.lookup_method(tcls, meth)
+                return [hit] if hit else []
+            return []
+        # mod.f(...) through an import alias
+        if isinstance(base, ast.Name):
+            tmod_name = mod.import_alias.get(base.id)
+            if tmod_name and tmod_name in self.program.modules:
+                tmod = self.program.modules[tmod_name]
+                if meth in tmod.functions:
+                    return [tmod.functions[meth]]
+                if meth in tmod.classes:
+                    init = tmod.classes[meth].methods.get("__init__")
+                    return [init] if init else []
+        return []

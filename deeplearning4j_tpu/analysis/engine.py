@@ -132,6 +132,10 @@ class Finding:
     col: int
     message: str
     snippet: str = ""
+    #: far ends of an interprocedural finding (GL7xx): the lock/guard
+    #: site, the opposing acquisition, the registration site — rendered
+    #: as SARIF relatedLocations. (path, line, message) triples.
+    related: Tuple[Tuple[str, int, str], ...] = ()
 
     @property
     def meta(self) -> Rule:
@@ -151,7 +155,9 @@ class Finding:
                 "category": self.meta.category,
                 "severity": self.severity, "path": self.path,
                 "line": self.line, "col": self.col,
-                "message": self.message, "snippet": self.snippet}
+                "message": self.message, "snippet": self.snippet,
+                "related": [{"path": p, "line": ln, "message": m}
+                            for (p, ln, m) in self.related]}
 
 
 def is_hot(path: str,
@@ -281,6 +287,25 @@ def _collect_suppressions(lines: List[str]) -> Dict[int, Set[str]]:
     return allow
 
 
+def suppression_covers(lines: List[str], allow: Dict[int, Set[str]],
+                       rule: str, line: int, end: int) -> bool:
+    """Shared suppression check: an `allow` token for `rule` (or its
+    category) on any flagged line, or anywhere in the contiguous
+    pure-comment block directly above (multi-line reasons). Used by the
+    per-file walker and the interprocedural lockset pass alike."""
+    covered = set(range(line, end + 1))
+    ln = line - 1
+    while ln >= 1 and _COMMENT_LINE_RE.match(lines[ln - 1]):
+        covered.add(ln)
+        ln -= 1
+    cat_tok = "cat:" + RULES[rule].category
+    for ln in covered:
+        toks = allow.get(ln)
+        if toks and (rule in toks or cat_tok in toks):
+            return True
+    return False
+
+
 # ----------------------------------------------------------------- walker
 
 @dataclass
@@ -353,19 +378,9 @@ class _FileLinter:
                    if 0 < line <= len(self.lines) else "")
         f = Finding(rule, self.path, line, getattr(node, "col_offset", 0),
                     message, snippet)
-        covered = set(range(line, end + 1))
-        # a suppression may sit anywhere in the contiguous pure-comment
-        # block directly above the flagged line (multi-line reasons)
-        ln = line - 1
-        while ln >= 1 and _COMMENT_LINE_RE.match(self.lines[ln - 1]):
-            covered.add(ln)
-            ln -= 1
-        cat_tok = "cat:" + RULES[rule].category
-        for ln in covered:
-            toks = self.allow.get(ln)
-            if toks and (rule in toks or cat_tok in toks):
-                self.suppressed.append(f)
-                return
+        if suppression_covers(self.lines, self.allow, rule, line, end):
+            self.suppressed.append(f)
+            return
         self.findings.append(f)
 
     # ------------------------------------------------- taint predicates
@@ -1059,22 +1074,34 @@ def inner_args(node: ast.Lambda) -> List[str]:
 def lint_source(source: str, path: str = "<string>", *,
                 hot: Optional[bool] = None,
                 hot_prefixes: Sequence[str] = DEFAULT_HOT_PREFIXES,
+                locks: bool = True,
                 ) -> List[Finding]:
-    """Lint one source string; `hot` overrides path-based hot detection."""
+    """Lint one source string; `hot` overrides path-based hot detection.
+    The interprocedural GL7xx lockset pass runs over the file as a
+    one-module program unless `locks=False` (lint_paths disables it
+    per-file and runs one whole-program pass instead)."""
     if hot is None:
         hot = is_hot(path, hot_prefixes)
-    return _FileLinter(path, source, hot=hot).run()
+    findings = _FileLinter(path, source, hot=hot).run()
+    if locks:
+        from deeplearning4j_tpu.analysis.locks import analyze_lock_sources
+        findings.extend(analyze_lock_sources(
+            [(path, source)], hot=hot, hot_prefixes=hot_prefixes))
+        findings.sort(key=lambda f: (f.line, f.col, f.rule))
+    return findings
 
 
 def lint_file(path: str, *,
               hot_prefixes: Sequence[str] = DEFAULT_HOT_PREFIXES,
+              locks: bool = True,
               ) -> List[Finding]:
     with open(path, "r", encoding="utf-8", errors="replace") as f:
         src = f.read()
     rel = os.path.relpath(path).replace(os.sep, "/")
     if rel.startswith(".."):
         rel = path.replace(os.sep, "/")
-    return lint_source(src, rel, hot=is_hot(rel, hot_prefixes))
+    return lint_source(src, rel, hot=is_hot(rel, hot_prefixes),
+                       hot_prefixes=hot_prefixes, locks=locks)
 
 
 def iter_python_files(paths: Sequence[str]) -> List[str]:
@@ -1100,10 +1127,16 @@ def lint_paths(paths: Sequence[str], *,
                ignore: Optional[Sequence[str]] = None,
                ) -> List[Finding]:
     """Lint files/trees; optional rule-id prefix filters ('GL2' selects
-    the whole sync category)."""
+    the whole sync category). The GL7xx lockset pass runs once over ALL
+    the files as one program, so cross-module lock facts (entry-held
+    propagation, acquisition-order edges) see every caller."""
+    from deeplearning4j_tpu.analysis.locks import analyze_lock_paths
+    files = iter_python_files(paths)
     findings: List[Finding] = []
-    for f in iter_python_files(paths):
-        findings.extend(lint_file(f, hot_prefixes=hot_prefixes))
+    for f in files:
+        findings.extend(lint_file(f, hot_prefixes=hot_prefixes,
+                                  locks=False))
+    findings.extend(analyze_lock_paths(files, hot_prefixes=hot_prefixes))
     if select:
         findings = [f for f in findings
                     if any(f.rule.startswith(s) for s in select)]

@@ -1,0 +1,791 @@
+"""Eraser-style interprocedural lockset analysis — the GL7xx family.
+
+GL301 is intraprocedural and lock-blind: it flags `self.x = ...`
+outside *any* `with`-lock, but it cannot see that `_take_batch` is
+only ever called with `self._cv` held, nor that a field written under
+`self._lock` in one method is read bare in a helper three calls away,
+nor that KVSlotPool's Condition and the scheduler's lock are acquired
+in opposite orders on two paths. This pass can. It runs over the whole
+program at once (analysis/callgraph.py) and emits:
+
+  GL701 guarded-field-unlocked-access — a read or write of a guarded
+        attribute with the guarding lock provably not held on any
+        analyzed call path. Guards come from two places: an explicit
+        `# graft: guarded-by(<lock>)` on the attribute's `__init__`
+        assignment, or inference — an attribute written under a held
+        own-class lock outside `__init__` is guarded by that lock.
+  GL702 lock-order-inversion — a cycle in the global lock-acquisition
+        graph (lock B taken under lock A on one path, A under B on
+        another), built from nested `with` scopes across the call
+        graph. The static deadlock detector.
+  GL703 lock-held-across-dispatch — a blocking call (device sync,
+        time.sleep, queue/future/HTTP wait) inside a held-lock region
+        in a hot module. `cond.wait()` on the *held* lock is exempt:
+        Condition.wait releases it.
+  GL704 callback-escapes-lock — a closure capturing guarded state
+        registered as a callback / thread target without re-acquiring
+        the guard inside the closure body (it runs later, on another
+        thread, outside the lock that happened to be held at
+        registration time).
+
+Soundness posture: held locksets are *may*-sets — the union over every
+resolved internal call site (`entry-held`), plus locks visibly taken in
+the function body. GL701 therefore only fires when the guard is held on
+NO analyzed path, which is exactly the "provably not held" criterion:
+unresolved dynamic calls never invent a held lock, and a single locked
+caller is enough to keep a helper quiet (annotate the contract with
+`# graft: allow(GL701): caller holds ...` only when the analysis
+cannot see the caller). Propagation is bounded
+(callgraph.MAX_PROPAGATION_ROUNDS hops) so it terminates on recursion.
+
+Suppression uses the engine's grammar: `# graft: allow(GL70x): reason`
+on the flagged line or the contiguous comment block above it.
+
+Lock identity is `ClassName.attr` for instance locks (`KVSlotPool._cv`,
+`DecodeSessionManager._lock`) and `module._name` for module-level
+locks — the same names observe/lockmon.py uses at runtime, so a static
+GL702 pair and a runtime inversion witness are string-comparable.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass, field
+from typing import Dict, FrozenSet, List, Optional, Sequence, Set, Tuple
+
+from deeplearning4j_tpu.analysis.callgraph import (
+    MAX_PROPAGATION_ROUNDS, CallGraph, ClassInfo, FunctionInfo,
+    ModuleInfo, Program,
+)
+from deeplearning4j_tpu.analysis.engine import (
+    DEFAULT_HOT_PREFIXES, Finding, _collect_suppressions, _MUTATOR_METHODS,
+    _terminal, is_hot, suppression_covers,
+)
+
+_GUARDED_BY_RE = re.compile(
+    r"#\s*graft:\s*guarded-by\(\s*([A-Za-z_][\w]*)\s*\)")
+
+# Blocking terminals that always count (receiver-independent).
+_BLOCKING_ALWAYS = frozenset({
+    "block_until_ready", "sleep", "urlopen", "getresponse",
+    "recv", "accept", "connect",
+})
+# Blocking terminals that wait on their *receiver*: exempt when the
+# receiver is the held lock itself (Condition.wait releases it).
+_BLOCKING_ON_RECEIVER = frozenset({"wait", "wait_for", "result", "join"})
+# `.get()` blocks only on queue-ish receivers with Queue.get's shape
+# (no positional args — dict.get(key) has one).
+_QUEUEISH_RE = re.compile(r"(^|_)(queue|events?|inbox|mailbox)($|s$|_)",
+                          re.IGNORECASE)
+
+# Callback/thread registrars: a closure handed to one of these outlives
+# the registering call — and any lock held at registration time.
+_REGISTRARS = frozenset({
+    "add_done_callback", "Thread", "Timer", "submit", "add_deploy_hook",
+    "call_soon", "call_soon_threadsafe", "call_later", "start_new_thread",
+})
+_CALLBACK_KWARGS = frozenset({"target", "callback", "func", "fn", "cb",
+                              "on_done", "hook"})
+
+
+@dataclass
+class _Access:
+    owner: ClassInfo
+    attr: str
+    node: ast.AST
+    held: FrozenSet[str]
+    write: bool
+    via: str          # rendered receiver, e.g. "self" or "self.pool"
+
+
+@dataclass
+class _Acq:
+    lock: str
+    node: ast.AST
+    held: FrozenSet[str]          # held *before* this acquisition
+
+
+@dataclass
+class _CallRec:
+    callees: Tuple[str, ...]      # callee qualnames
+    held: FrozenSet[str]
+
+
+@dataclass
+class _Block:
+    node: ast.AST
+    held: FrozenSet[str]
+    what: str
+    receiver_lock: Optional[str]  # lock id the call waits on, if any
+
+
+@dataclass
+class _Escape:
+    reg_node: ast.AST             # the registrar call site
+    registrar: str
+    accesses: List[_Access]       # accesses inside the closure;
+                                  # held = locks taken *inside* it
+
+
+@dataclass
+class _FnScan:
+    fn: FunctionInfo
+    accesses: List[_Access] = field(default_factory=list)
+    acqs: List[_Acq] = field(default_factory=list)
+    calls: List[_CallRec] = field(default_factory=list)
+    blocks: List[_Block] = field(default_factory=list)
+    escapes: List[_Escape] = field(default_factory=list)
+
+
+class _FnWalker:
+    """One pass over a function body, tracking the locally-held lockset
+    through `with` scopes and acquire()/release() pairs."""
+
+    def __init__(self, fn: FunctionInfo, graph: CallGraph,
+                 *, closure_of: Optional["_FnWalker"] = None):
+        self.fn = fn
+        self.graph = graph
+        self.held: List[str] = []
+        self.scan = _FnScan(fn)
+        # closure bodies get their own walker (fresh held set — they run
+        # later); accesses land in buckets keyed by the closure node.
+        self.closure_buckets: Dict[int, List[_Access]] = (
+            closure_of.closure_buckets if closure_of is not None else {})
+        self.local_defs: Dict[str, ast.AST] = (
+            closure_of.local_defs if closure_of is not None else {})
+        self.in_closure = closure_of is not None
+
+    # ------------------------------------------------------------ entry
+    def run(self) -> _FnScan:
+        for stmt in self.fn.node.body:
+            self._stmt(stmt)
+        return self.scan
+
+    def _held_now(self) -> FrozenSet[str]:
+        return frozenset(self.held)
+
+    # ------------------------------------------------- lock identities
+    def _lock_id(self, e: ast.AST) -> Optional[str]:
+        fn, cls = self.fn, self.fn.cls
+        # self._lock / self._cv
+        if (cls is not None and isinstance(e, ast.Attribute)
+                and isinstance(e.value, ast.Name)
+                and e.value.id == fn.self_name):
+            if e.attr in cls.lock_attrs:
+                return f"{cls.name}.{e.attr}"
+            return None
+        # self.pool._cv through a typed attribute
+        if (cls is not None and isinstance(e, ast.Attribute)
+                and isinstance(e.value, ast.Attribute)
+                and isinstance(e.value.value, ast.Name)
+                and e.value.value.id == fn.self_name):
+            tcls = self.graph.attr_class(cls, e.value.attr)
+            if tcls is not None and e.attr in tcls.lock_attrs:
+                return f"{tcls.name}.{e.attr}"
+            return None
+        # with self.pool.lock():  — a lock-getter method
+        if isinstance(e, ast.Call):
+            for cand in self.graph.resolve(fn, e):
+                got = _lock_getter(cand)
+                if got is not None:
+                    return got
+            return None
+        # module-global lock
+        if isinstance(e, ast.Name):
+            return fn.module.module_locks.get(e.id)
+        return None
+
+    # -------------------------------------------------------- statements
+    def _stmt(self, node: ast.AST) -> None:
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            acquired: List[str] = []
+            for item in node.items:
+                lid = self._lock_id(item.context_expr)
+                self._expr(item.context_expr)
+                if lid is not None:
+                    self._note_acquire(lid, node)
+                    self.held.append(lid)
+                    acquired.append(lid)
+            for s in node.body:
+                self._stmt(s)
+            for _ in acquired:
+                self.held.pop()
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested def = closure: runs later, with NO inherited locks
+            self.local_defs[node.name] = node
+            self._scan_closure(node, node.body)
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                self._target(t, node)
+            self._expr(node.value)
+        elif isinstance(node, ast.AugAssign):
+            self._target(node.target, node)
+            self._access_expr(node.target, write=False)
+            self._expr(node.value)
+        elif isinstance(node, ast.AnnAssign):
+            self._target(node.target, node)
+            if node.value is not None:
+                self._expr(node.value)
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                self._target(t, node)
+        elif isinstance(node, ast.Expr):
+            if not self._acquire_release_stmt(node.value):
+                self._expr(node.value)
+        elif isinstance(node, ast.Try):
+            for s in node.body:
+                self._stmt(s)
+            for h in node.handlers:
+                if h.type is not None:
+                    self._expr(h.type)
+                for s in h.body:
+                    self._stmt(s)
+            for s in node.orelse:
+                self._stmt(s)
+            for s in node.finalbody:
+                self._stmt(s)
+        elif isinstance(node, (ast.If, ast.While)):
+            self._expr(node.test)
+            for s in node.body:
+                self._stmt(s)
+            for s in node.orelse:
+                self._stmt(s)
+        elif isinstance(node, (ast.For, ast.AsyncFor)):
+            self._expr(node.iter)
+            for s in node.body:
+                self._stmt(s)
+            for s in node.orelse:
+                self._stmt(s)
+        elif isinstance(node, (ast.Return, ast.Raise)):
+            val = getattr(node, "value", None) or getattr(node, "exc", None)
+            if val is not None:
+                self._expr(val)
+        else:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.stmt):
+                    self._stmt(child)
+                elif isinstance(child, ast.expr):
+                    self._expr(child)
+
+    def _acquire_release_stmt(self, e: ast.AST) -> bool:
+        """`self._lock.acquire()` holds until the matching `release()`
+        (or function end — conservative may-held)."""
+        if not (isinstance(e, ast.Call)
+                and isinstance(e.func, ast.Attribute)
+                and e.func.attr in ("acquire", "release")):
+            return False
+        lid = self._lock_id(e.func.value)
+        if lid is None:
+            return False
+        if e.func.attr == "acquire":
+            self._note_acquire(lid, e)
+            self.held.append(lid)
+        elif lid in self.held:
+            self.held.remove(lid)
+        return True
+
+    def _note_acquire(self, lid: str, node: ast.AST) -> None:
+        if self.in_closure:
+            return                    # closure acquisitions are local
+        self.scan.acqs.append(_Acq(lid, node, self._held_now()))
+
+    # ------------------------------------------------------ access sites
+    def _target(self, t: ast.AST, stmt: ast.AST) -> None:
+        if isinstance(t, (ast.Tuple, ast.List)):
+            for e in t.elts:
+                self._target(e, stmt)
+            return
+        if isinstance(t, ast.Starred):
+            self._target(t.value, stmt)
+            return
+        base = t
+        while isinstance(base, ast.Subscript):
+            self._expr(base.slice)
+            base = base.value
+        self._access_expr(base, write=True)
+        # `self.a.b = ...` also *reads* self.a; chain walk handles it
+        if isinstance(base, ast.Attribute):
+            self._expr(base.value)
+
+    def _access_expr(self, e: ast.AST, *, write: bool) -> None:
+        """Record a guarded-attr access for `self.x` or `self.a.x`."""
+        fn, cls = self.fn, self.fn.cls
+        if cls is None or not isinstance(e, ast.Attribute):
+            return
+        if isinstance(e.value, ast.Name) and e.value.id == fn.self_name:
+            if e.attr in cls.lock_attrs:
+                return
+            self._record_access(cls, e.attr, e, write, via=fn.self_name)
+        elif (isinstance(e.value, ast.Attribute)
+              and isinstance(e.value.value, ast.Name)
+              and e.value.value.id == fn.self_name):
+            tcls = self.graph.attr_class(cls, e.value.attr)
+            if tcls is not None and e.attr not in tcls.lock_attrs:
+                self._record_access(
+                    tcls, e.attr, e, write,
+                    via=f"{fn.self_name}.{e.value.attr}")
+
+    def _record_access(self, owner: ClassInfo, attr: str, node: ast.AST,
+                       write: bool, via: str) -> None:
+        acc = _Access(owner, attr, node, self._held_now(), write, via)
+        if self.in_closure:
+            self.closure_buckets.setdefault(
+                id(self._closure_root), []).append(acc)
+        else:
+            self.scan.accesses.append(acc)
+
+    # ------------------------------------------------------- expressions
+    def _expr(self, node: ast.AST) -> None:
+        if isinstance(node, ast.Call):
+            self._call(node)
+            return
+        if isinstance(node, ast.Lambda):
+            self._scan_closure(node, [node.body])
+            return
+        if isinstance(node, ast.Attribute):
+            self._access_expr(node, write=False)
+            self._expr(node.value)
+            return
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._expr(child)
+
+    def _scan_closure(self, root: ast.AST, body: List[ast.AST]) -> None:
+        sub = _FnWalker(self.fn, self.graph, closure_of=self)
+        sub._closure_root = root
+        sub.closure_buckets.setdefault(id(root), [])
+        for item in body:
+            if isinstance(item, ast.stmt):
+                sub._stmt(item)
+            else:
+                sub._expr(item)
+
+    def _call(self, node: ast.Call) -> None:
+        func = node.func
+        term = _terminal(func)
+        held = self._held_now()
+
+        # resolution edge for interprocedural propagation (not from
+        # closures — they run on another thread/time with entry ∅)
+        if not self.in_closure:
+            callees = self.graph.resolve(self.fn, node)
+            if callees:
+                self.scan.calls.append(_CallRec(
+                    tuple(c.qualname for c in callees), held))
+
+        # mutator call on a guarded attr: a write
+        if isinstance(func, ast.Attribute) \
+                and func.attr in _MUTATOR_METHODS:
+            self._access_expr(func.value, write=True)
+
+        # blocking-call detection (GL703) — skip inside closures (the
+        # registration-time lock is not held when the closure runs)
+        if not self.in_closure and held:
+            self._check_blocking(node, term, held)
+
+        # walk children (fills closure buckets for lambda args)
+        if isinstance(func, ast.Attribute):
+            self._expr(func.value)
+        elif isinstance(func, (ast.Call, ast.Lambda)):
+            self._expr(func)
+        for a in node.args:
+            self._expr(a)
+        for k in node.keywords:
+            self._expr(k.value)
+
+        # callback-escape detection (GL704): closures handed to a
+        # registrar, with or without a lock held — the closure must
+        # re-acquire its guard either way
+        if term in _REGISTRARS:
+            cands = list(node.args) + [
+                k.value for k in node.keywords
+                if k.arg in _CALLBACK_KWARGS]
+            for cand in cands:
+                closure = None
+                if isinstance(cand, ast.Lambda):
+                    closure = cand
+                elif isinstance(cand, ast.Name) \
+                        and cand.id in self.local_defs:
+                    closure = self.local_defs[cand.id]
+                if closure is None:
+                    continue
+                accesses = self.closure_buckets.get(id(closure), [])
+                if accesses:
+                    self.scan.escapes.append(
+                        _Escape(node, term or "?", accesses))
+
+    def _check_blocking(self, node: ast.Call, term: Optional[str],
+                        held: FrozenSet[str]) -> None:
+        func = node.func
+        if term in _BLOCKING_ALWAYS:
+            self.scan.blocks.append(_Block(node, held, f"{term}()", None))
+            return
+        if not isinstance(func, ast.Attribute):
+            return
+        if term in _BLOCKING_ON_RECEIVER:
+            rlock = self._lock_id(func.value)
+            self.scan.blocks.append(
+                _Block(node, held, f".{term}()", rlock))
+        elif term == "get" and not node.args:
+            recv = _terminal(func.value) or ""
+            if _QUEUEISH_RE.search(recv):
+                self.scan.blocks.append(
+                    _Block(node, held, f"{recv}.get()", None))
+
+
+def _lock_getter(meth: FunctionInfo) -> Optional[str]:
+    """`def lock(self): return self._cv` -> 'Cls._cv'."""
+    if meth.cls is None:
+        return None
+    for stmt in meth.node.body:
+        if (isinstance(stmt, ast.Return)
+                and isinstance(stmt.value, ast.Attribute)
+                and isinstance(stmt.value.value, ast.Name)
+                and stmt.value.value.id == meth.self_name
+                and stmt.value.attr in meth.cls.lock_attrs):
+            return f"{meth.cls.name}.{stmt.value.attr}"
+    return None
+
+
+# -------------------------------------------------------------- guards
+
+@dataclass
+class _Guard:
+    lock: str                     # "Cls._lock"
+    site: Tuple[str, int, str]    # (path, line, evidence message)
+    explicit: bool
+
+
+def _explicit_guards(ci: ClassInfo) -> Dict[str, _Guard]:
+    """`self.x = ... # graft: guarded-by(_lock)` annotations, on the
+    assignment line or the contiguous comment block above it."""
+    out: Dict[str, _Guard] = {}
+    init = ci.methods.get("__init__")
+    if init is None:
+        return out
+    lines = ci.module.lines
+    for n in ast.walk(init.node):
+        if not isinstance(n, ast.Assign):
+            continue
+        for t in n.targets:
+            if not (isinstance(t, ast.Attribute)
+                    and isinstance(t.value, ast.Name)
+                    and t.value.id == ci.self_name):
+                continue
+            cand = [n.lineno]
+            ln = n.lineno - 1
+            while ln >= 1 and lines[ln - 1].lstrip().startswith("#"):
+                cand.append(ln)
+                ln -= 1
+            for cl in cand:
+                m = _GUARDED_BY_RE.search(lines[cl - 1]) \
+                    if 0 < cl <= len(lines) else None
+                if m:
+                    lock_attr = m.group(1)
+                    out[t.attr] = _Guard(
+                        f"{ci.name}.{lock_attr}",
+                        (ci.module.path, n.lineno,
+                         f"declared `guarded-by({lock_attr})` here"),
+                        explicit=True)
+                    break
+    return out
+
+
+def _infer_guards(prog: Program, scans: Dict[str, _FnScan],
+                  entry: Dict[str, FrozenSet[str]],
+                  ) -> Dict[str, Dict[str, _Guard]]:
+    """attr -> guard per class: explicit annotations, plus inference —
+    an attribute *written* under a held own-class lock outside __init__
+    is guarded by that lock (majority lock wins on ties)."""
+    guards: Dict[str, Dict[str, _Guard]] = {}
+    votes: Dict[Tuple[str, str], Dict[str, Tuple[int, Tuple]]] = {}
+    for scan in scans.values():
+        fn = scan.fn
+        if fn.name == "__init__":
+            continue
+        eff_entry = entry.get(fn.qualname, frozenset())
+        for acc in scan.accesses:
+            if not acc.write:
+                continue
+            own_prefix = f"{acc.owner.name}."
+            for lid in acc.held | eff_entry:
+                if not lid.startswith(own_prefix):
+                    continue
+                key = (acc.owner.qualname, acc.attr)
+                cnt, site = votes.setdefault(key, {}).get(lid, (0, None))
+                if site is None:
+                    site = (fn.module.path, acc.node.lineno,
+                            f"written here under `{lid}`")
+                votes[key][lid] = (cnt + 1, site)
+    for ci in (c for m in prog.modules.values()
+               for c in m.classes.values()):
+        cls_guards = _explicit_guards(ci)
+        for (cq, attr), by_lock in votes.items():
+            if cq != ci.qualname or attr in cls_guards:
+                continue
+            lid, (cnt, site) = max(by_lock.items(),
+                                   key=lambda kv: (kv[1][0], kv[0]))
+            cls_guards[attr] = _Guard(lid, site, explicit=False)
+        if cls_guards:
+            guards[ci.qualname] = cls_guards
+    return guards
+
+
+# ------------------------------------------------------------ the pass
+
+def _propagate_entry(scans: Dict[str, _FnScan],
+                     ) -> Dict[str, FrozenSet[str]]:
+    """entry-held[f] = union over resolved internal call sites of
+    (caller's locks at the site ∪ caller's own entry-held). Bounded
+    fixpoint — each round moves facts one call edge."""
+    entry: Dict[str, Set[str]] = {q: set() for q in scans}
+    for _ in range(MAX_PROPAGATION_ROUNDS):
+        changed = False
+        for q, scan in scans.items():
+            mine = entry[q]
+            for rec in scan.calls:
+                eff = rec.held | mine
+                if not eff:
+                    continue
+                for callee in rec.callees:
+                    tgt = entry.get(callee)
+                    if tgt is not None and not eff <= tgt:
+                        tgt |= eff
+                        changed = True
+        if not changed:
+            break
+    return {q: frozenset(s) for q, s in entry.items()}
+
+
+def _snippet(mod: ModuleInfo, line: int) -> str:
+    if 0 < line <= len(mod.lines):
+        return mod.lines[line - 1].strip()
+    return ""
+
+
+class _LockAnalysis:
+    def __init__(self, prog: Program, *, hot: Optional[bool],
+                 hot_prefixes: Sequence[str]):
+        self.prog = prog
+        self.graph = CallGraph(prog)
+        self.hot = hot
+        self.hot_prefixes = hot_prefixes
+        self.findings: List[Finding] = []
+        self._allow: Dict[str, Dict[int, Set[str]]] = {}
+
+    def run(self) -> List[Finding]:
+        scans: Dict[str, _FnScan] = {}
+        for fn in self.prog.functions.values():
+            scans[fn.qualname] = _FnWalker(fn, self.graph).run()
+        entry = _propagate_entry(scans)
+        guards = _infer_guards(self.prog, scans, entry)
+        self._gl701(scans, entry, guards)
+        self._gl702(scans, entry)
+        self._gl703(scans, entry)
+        self._gl704(scans, guards)
+        self.findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        return self.findings
+
+    # ------------------------------------------------------------- emit
+    def _emit(self, rule: str, mod: ModuleInfo, node: ast.AST,
+              message: str,
+              related: Sequence[Tuple[str, int, str]] = ()) -> None:
+        line = getattr(node, "lineno", 1)
+        end = getattr(node, "end_lineno", line) or line
+        allow = self._allow.setdefault(
+            mod.path, _collect_suppressions(mod.lines))
+        if suppression_covers(mod.lines, allow, rule, line, end):
+            return
+        self.findings.append(Finding(
+            rule, mod.path, line, getattr(node, "col_offset", 0),
+            message, _snippet(mod, line), related=tuple(related)))
+
+    # ------------------------------------------------------------ GL701
+    def _gl701(self, scans, entry, guards) -> None:
+        seen: Set[Tuple[str, int, str]] = set()
+        for scan in scans.values():
+            fn = scan.fn
+            if fn.name == "__init__":
+                continue              # construction precedes publication
+            eff_entry = entry.get(fn.qualname, frozenset())
+            for acc in scan.accesses:
+                g = guards.get(acc.owner.qualname, {}).get(acc.attr)
+                if g is None or g.lock in acc.held | eff_entry:
+                    continue
+                dk = (fn.qualname, acc.node.lineno, acc.attr)
+                if dk in seen:
+                    continue
+                seen.add(dk)
+                kind = "write" if acc.write else "read"
+                how = ("declared" if g.explicit else "inferred from "
+                       "locked writes")
+                self._emit(
+                    "GL701", fn.module, acc.node,
+                    f"{kind} of `{acc.via}.{acc.attr}` "
+                    f"(`{acc.owner.name}.{acc.attr}`, guarded by "
+                    f"`{g.lock}` — {how}) with the lock provably not "
+                    f"held on any analyzed call path into "
+                    f"`{fn.name}()`",
+                    related=[g.site])
+
+    # ------------------------------------------------------------ GL702
+    def _gl702(self, scans, entry) -> None:
+        # edge a->b: b acquired while a held (locally or entry-held)
+        edges: Dict[Tuple[str, str], Tuple[ModuleInfo, ast.AST]] = {}
+        for scan in scans.values():
+            fn = scan.fn
+            eff_entry = entry.get(fn.qualname, frozenset())
+            for acq in scan.acqs:
+                for h in acq.held | eff_entry:
+                    if h != acq.lock:
+                        edges.setdefault((h, acq.lock),
+                                         (fn.module, acq.node))
+        cycles = _find_cycles(set(edges))
+        reported: Set[FrozenSet[str]] = set()
+        for cyc in cycles:
+            key = frozenset(cyc)
+            if key in reported:
+                continue
+            reported.add(key)
+            cyc_edges = [(a, b) for (a, b) in edges
+                         if a in key and b in key]
+            cyc_edges.sort(key=lambda e: (edges[e][1].lineno, e))
+            (a0, b0) = cyc_edges[0]
+            mod0, node0 = edges[(a0, b0)]
+            related = []
+            for (a, b) in cyc_edges[1:5]:
+                m, n = edges[(a, b)]
+                related.append((m.path, n.lineno,
+                                f"`{b}` acquired here while `{a}` held"))
+            order = " -> ".join(sorted(key))
+            self._emit(
+                "GL702", mod0, node0,
+                f"lock-order inversion: cycle {order} -> "
+                f"{sorted(key)[0]} in the global acquisition graph — "
+                f"`{b0}` is acquired here while `{a0}` is held, and the "
+                f"opposite order exists (see related locations); two "
+                f"threads can deadlock",
+                related=related)
+
+    # ------------------------------------------------------------ GL703
+    def _gl703(self, scans, entry) -> None:
+        for scan in scans.values():
+            fn = scan.fn
+            hot = self.hot if self.hot is not None \
+                else is_hot(fn.module.path, self.hot_prefixes)
+            if not hot:
+                continue
+            eff_entry = entry.get(fn.qualname, frozenset())
+            for blk in scan.blocks:
+                eff = blk.held | eff_entry
+                if not eff:
+                    continue
+                if blk.receiver_lock is not None \
+                        and blk.receiver_lock in eff:
+                    continue      # cond.wait() releases the held lock
+                locks = ", ".join(sorted(eff))
+                self._emit(
+                    "GL703", fn.module, blk.node,
+                    f"blocking call {blk.what} while holding "
+                    f"`{locks}` in a hot module — every thread "
+                    f"contending on the lock stalls behind this wait; "
+                    f"move the blocking work outside the lock region")
+
+    # ------------------------------------------------------------ GL704
+    def _gl704(self, scans, guards) -> None:
+        for scan in scans.values():
+            fn = scan.fn
+            for esc in scan.escapes:
+                for acc in esc.accesses:
+                    g = guards.get(acc.owner.qualname, {}).get(acc.attr)
+                    if g is None or g.lock in acc.held:
+                        continue
+                    self._emit(
+                        "GL704", fn.module, acc.node,
+                        f"closure passed to {esc.registrar}(...) "
+                        f"{'writes' if acc.write else 'reads'} "
+                        f"`{acc.via}.{acc.attr}` (guarded by "
+                        f"`{g.lock}`) without re-acquiring the lock — "
+                        f"it runs later on another thread, outside any "
+                        f"lock held at registration",
+                        related=[(fn.module.path, esc.reg_node.lineno,
+                                  "registered here"),
+                                 g.site])
+                    break         # one finding per escaped closure
+
+
+def _find_cycles(edges: Set[Tuple[str, str]]) -> List[List[str]]:
+    """Strongly-connected components of size >= 2 (Tarjan, iterative).
+    Any SCC with two or more locks contains an acquisition-order cycle."""
+    adj: Dict[str, List[str]] = {}
+    for a, b in edges:
+        adj.setdefault(a, []).append(b)
+        adj.setdefault(b, [])
+    index: Dict[str, int] = {}
+    low: Dict[str, int] = {}
+    on_stack: Set[str] = set()
+    stack: List[str] = []
+    sccs: List[List[str]] = []
+    counter = [0]
+
+    def strongconnect(v0: str) -> None:
+        work = [(v0, 0)]
+        while work:
+            v, pi = work[-1]
+            if pi == 0:
+                index[v] = low[v] = counter[0]
+                counter[0] += 1
+                stack.append(v)
+                on_stack.add(v)
+            recurse = False
+            neighbors = adj[v]
+            for i in range(pi, len(neighbors)):
+                w = neighbors[i]
+                if w not in index:
+                    work[-1] = (v, i + 1)
+                    work.append((w, 0))
+                    recurse = True
+                    break
+                if w in on_stack:
+                    low[v] = min(low[v], index[w])
+            if recurse:
+                continue
+            if low[v] == index[v]:
+                scc = []
+                while True:
+                    w = stack.pop()
+                    on_stack.discard(w)
+                    scc.append(w)
+                    if w == v:
+                        break
+                if len(scc) >= 2:
+                    sccs.append(sorted(scc))
+            work.pop()
+            if work:
+                pv = work[-1][0]
+                low[pv] = min(low[pv], low[v])
+
+    for v in adj:
+        if v not in index:
+            strongconnect(v)
+    return sccs
+
+
+# ------------------------------------------------------------ public API
+
+def analyze_lock_sources(sources: Sequence[Tuple[str, str]], *,
+                         hot: Optional[bool] = None,
+                         hot_prefixes: Sequence[str] =
+                         DEFAULT_HOT_PREFIXES) -> List[Finding]:
+    """Run the GL7xx lockset pass over (path, source) pairs as one
+    program. `hot` forces GL703's hot gate for every file (fixtures)."""
+    prog = Program.from_sources(sources)
+    return _LockAnalysis(prog, hot=hot, hot_prefixes=hot_prefixes).run()
+
+
+def analyze_lock_paths(files: Sequence[str], *,
+                       hot_prefixes: Sequence[str] =
+                       DEFAULT_HOT_PREFIXES) -> List[Finding]:
+    prog = Program.from_paths(files)
+    return _LockAnalysis(prog, hot=None, hot_prefixes=hot_prefixes).run()

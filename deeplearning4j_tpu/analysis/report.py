@@ -71,18 +71,29 @@ def render_sarif(findings: List[Finding], *, files: int = 0,
          "defaultConfiguration": {
              "level": RULES[rid].severity}}
         for rid in (rules_used or sorted(RULES))]
-    results = [
-        {"ruleId": f.rule,
-         "level": f.severity,
-         "message": {"text": f.message},
-         "locations": [{
-             "physicalLocation": {
-                 "artifactLocation": {"uri": f.path},
-                 "region": {"startLine": f.line,
-                            "startColumn": f.col + 1,
-                            "snippet": {"text": f.snippet}},
-             }}]}
-        for f in findings]
+    results = []
+    for f in findings:
+        result = {
+            "ruleId": f.rule,
+            "level": f.severity,
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {"startLine": f.line,
+                               "startColumn": f.col + 1,
+                               "snippet": {"text": f.snippet}},
+                }}]}
+        if f.related:
+            # interprocedural findings (GL7xx) carry both ends: the
+            # guard/lock site and the far access/acquisition site
+            result["relatedLocations"] = [
+                {"physicalLocation": {
+                    "artifactLocation": {"uri": rp},
+                    "region": {"startLine": rl}},
+                 "message": {"text": rm}}
+                for (rp, rl, rm) in f.related]
+        results.append(result)
     doc = {
         "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
                     "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),

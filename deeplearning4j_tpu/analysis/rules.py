@@ -134,6 +134,29 @@ _ALL = (
          "reservoirs and is O(all metrics) reader work on the step/"
          "request path; readers pay, so hoist the read off the hot loop "
          "(the series sampler thread is the periodic reader)"),
+    # --------------------- interprocedural concurrency (analysis/locks.py)
+    Rule("GL701", "guarded-field-unlocked-access", CAT_LOCK, ERROR,
+         "read or write of a lock-guarded attribute (inferred from "
+         "locked writes, or declared via `# graft: guarded-by(<lock>)`) "
+         "with the guarding lock provably not held on any analyzed call "
+         "path — held locksets propagate interprocedurally through "
+         "helper calls, so a locked caller keeps a bare helper quiet"),
+    Rule("GL702", "lock-order-inversion", CAT_LOCK, ERROR,
+         "cycle in the global lock-acquisition-order graph: lock B is "
+         "acquired while A is held on one path and A while B is held on "
+         "another — two threads interleaving those paths deadlock; the "
+         "related locations name the opposing acquisition sites"),
+    Rule("GL703", "lock-held-across-dispatch", CAT_LOCK, WARNING,
+         "blocking call (.block_until_ready()/time.sleep/queue/future/"
+         "HTTP wait) inside a held-lock region in a hot module — every "
+         "thread contending on that lock stalls behind a device or I/O "
+         "wait; cond.wait() on the held lock itself is exempt (it "
+         "releases the lock)"),
+    Rule("GL704", "callback-escapes-lock", CAT_LOCK, WARNING,
+         "closure capturing lock-guarded state registered as a callback "
+         "or thread target without re-acquiring the guard inside the "
+         "closure — it runs later on another thread, outside whatever "
+         "lock was held at registration time"),
 )
 
 RULES: Dict[str, Rule] = {r.id: r for r in _ALL}
@@ -147,6 +170,8 @@ RUNTIME_RULE_HINTS: Dict[str, Tuple[str, ...]] = {
     "host_sync": ("GL001", "GL002", "GL201", "GL202", "GL203"),
     "span_taint": ("GL601",),
     "hot_snapshot": ("GL602",),
+    "lock_order": ("GL702",),
+    "guarded_field": ("GL701",),
 }
 
 

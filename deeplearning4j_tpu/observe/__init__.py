@@ -22,6 +22,10 @@ every layer shares:
 - `HostSyncMonitor` (`syncmon.py`) — opt-in runtime generalization of the
   test-only dispatch-depth guard: counts device→host materializations so
   `PerformanceListener` can report syncs/step in production.
+- `LockWitness` (`lockmon.py`) — opt-in (`DL4J_TPU_LOCKMON=1`) runtime
+  cross-check for the GL7xx lockset rules: named-lock wrappers record
+  per-thread acquisition orders (lock-order inversions → GL702) and
+  guarded-field access races (→ GL701) during the thread-hammer suites.
 - `python -m deeplearning4j_tpu.observe.dump` (`dump.py`) — pretty-print
   a registry snapshot or tail a span JSONL.
 - `reqtrace.py` — request-scoped causal trace trees (TraceContext at the
@@ -54,6 +58,10 @@ from deeplearning4j_tpu.observe.watchdog import (
     RecompileWatchdog, WatchedJitCache, get_watchdog, set_watchdog,
 )
 from deeplearning4j_tpu.observe.syncmon import HostSyncMonitor, current_monitor
+from deeplearning4j_tpu.observe.lockmon import (
+    LockWitness, MonitoredLock, get_witness, lockmon_enabled,
+    reset_witness,
+)
 from deeplearning4j_tpu.observe.flight import (
     FlightRecorder, get_flight, latest_dump, read_dump, set_flight,
 )
@@ -82,6 +90,8 @@ __all__ = [
     "tracing_enabled", "read_spans", "emit_manual_span",
     "RecompileWatchdog", "WatchedJitCache", "get_watchdog", "set_watchdog",
     "HostSyncMonitor", "current_monitor",
+    "LockWitness", "MonitoredLock", "get_witness", "lockmon_enabled",
+    "reset_witness",
     "FlightRecorder", "get_flight", "set_flight", "latest_dump", "read_dump",
     "DeviceMonitor", "device_memory_summary", "get_device_monitor",
     "maybe_start_monitor", "set_device_monitor",

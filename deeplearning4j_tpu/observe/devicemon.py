@@ -192,7 +192,8 @@ class DeviceMonitor:
     # ---------------------------------------------------------- background
     @property
     def running(self) -> bool:
-        t = self._thread
+        with self._lock:
+            t = self._thread
         return t is not None and t.is_alive()
 
     def start(self) -> None:
@@ -206,7 +207,11 @@ class DeviceMonitor:
             self._thread.start()
 
     def _loop(self) -> None:
-        while not self._stop.wait(self.interval_s):
+        # pin this run's Event: start() replaces self._stop on restart,
+        # and a straggling old loop must keep waiting on its own event
+        with self._lock:
+            stop = self._stop
+        while not stop.wait(self.interval_s):
             try:
                 self.sample_once()
             except Exception:
@@ -215,7 +220,7 @@ class DeviceMonitor:
     def stop(self, timeout: float = 5.0) -> None:
         with self._lock:
             thread, self._thread = self._thread, None
-        self._stop.set()
+            self._stop.set()
         if thread is not None and thread.is_alive():
             thread.join(timeout=timeout)
 

@@ -301,17 +301,22 @@ class SeriesSampler:
         """Idempotent: stopping a stopped sampler is a no-op."""
         with self._lock:
             t, self._thread = self._thread, None
-        self._stop.set()
+            self._stop.set()
         if t is not None and t.is_alive():
             t.join(timeout=5)
 
     @property
     def running(self) -> bool:
-        t = self._thread
+        with self._lock:
+            t = self._thread
         return t is not None and t.is_alive()
 
     def _run(self) -> None:
-        while not self._stop.wait(self.interval):
+        # pin the Event once: the reference never changes after
+        # __init__, and Event.wait/set are internally synchronized
+        with self._lock:
+            stop = self._stop
+        while not stop.wait(self.interval):
             try:
                 self.sample_once()
             # graft: allow(GL403): sampling races registry mutation in

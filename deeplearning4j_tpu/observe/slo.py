@@ -212,6 +212,7 @@ class SLOEngine:
         self.slos = list(slos) if slos is not None else default_slos()
         self._flight = flight
         self._lock = threading.Lock()
+        # graft: guarded-by(_lock)
         self._state: Dict[str, dict] = {
             s.name: {"firing": False, "since": None, "breaches": 0,
                      "trace_id": None} for s in self.slos}
@@ -240,9 +241,9 @@ class SLOEngine:
             burn_slow, _, _ = slo.burn(self.store, slo.slow_s, now)
             firing = (burn_fast >= slo.burn_threshold
                       and burn_slow >= slo.burn_threshold)
-            st = self._state[slo.name]
             transition = None
             with self._lock:
+                st = self._state[slo.name]
                 if firing and not st["firing"]:
                     st["firing"] = True
                     st["since"] = now

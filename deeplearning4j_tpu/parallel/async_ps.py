@@ -75,7 +75,8 @@ class AsyncParameterServer:
 
     @property
     def version(self) -> int:
-        return self._version
+        with self._lock:
+            return self._version
 
 
 class AsyncTrainer:

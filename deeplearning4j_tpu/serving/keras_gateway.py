@@ -50,15 +50,19 @@ class KerasGatewayServer(JsonHttpServer):
 
     def fit(self, model_id: str, x, y, *, epochs: int = 1,
             batch_size: int = 32) -> float:
-        net = self._models[model_id]
-        with self._model_locks[model_id]:
+        with self._lock:
+            net = self._models[model_id]
+            model_lock = self._model_locks[model_id]
+        with model_lock:
             net.fit(np.asarray(x, np.float32), np.asarray(y, np.float32),
                     epochs=epochs, batch_size=batch_size)
             return float(net.score_)
 
     def predict(self, model_id: str, x):
-        net = self._models[model_id]
-        with self._model_locks[model_id]:
+        with self._lock:
+            net = self._models[model_id]
+            model_lock = self._model_locks[model_id]
+        with model_lock:
             out = net.output(np.asarray(x, np.float32))
         if isinstance(out, dict):
             out = next(iter(out.values()))

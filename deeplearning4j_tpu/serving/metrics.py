@@ -77,6 +77,9 @@ class ServingStats:
         self.registry.gauge("serving_start_time_seconds").set(self._started)
 
     def _m(self, model: str) -> _ModelSeries:
+        # graft: allow(GL701): double-checked fast path — model keys are
+        # never deleted, so a lock-free hit returns a stable object; the
+        # miss path re-checks under the lock before inserting
         s = self._models.get(model)
         if s is None:
             with self._lock:

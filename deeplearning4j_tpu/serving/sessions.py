@@ -287,6 +287,9 @@ class DecodeSessionManager:
         np.asarray(toks)
 
     def warmup(self) -> None:
+        # graft: allow(GL701): warmup runs at construction/deploy time,
+        # before the pool is shared with request threads; steady-state
+        # readers take the pool lock in run_batch
         self._compile_buckets(self.pool.net)
 
     # ---------------------------------------------------------- sessions
@@ -332,8 +335,9 @@ class DecodeSessionManager:
             deadline_ms=deadline_ms, eos_id=eos_id, trace=trace)
         with self._lock:
             self._sessions[sess.id] = sess
+            n_active = len(self._sessions)
         self._c_opened.inc()
-        self._g_active.set(len(self._sessions))
+        self._g_active.set(n_active)
         try:
             from deeplearning4j_tpu.observe import get_flight
             get_flight().record("session_open", model=self.model,
@@ -661,6 +665,9 @@ class DecodeSessionManager:
                 step=len(sess.generated),
                 win=int(self.fused_k if decode else nvalid[i]),
                 tokens=int(emit_n.get(s, 0)), bucket=bucket, rows=k,
+                # graft: allow(GL701): span attribute reads one atomic
+                # str reference; a concurrent hot-swap may label one
+                # window with the outgoing kernel kind — harmless
                 kernel=self._policy_kind, loop=self.loop_kind)
 
     # --------------------------------------------------------- hot-swap

@@ -113,16 +113,20 @@ class StatsStorage(StatsStorageRouter):
 
     def get_static_info(self, session_id: str, type_id: str,
                         worker_id: str) -> Optional[Persistable]:
-        return self._static.get((session_id, type_id, worker_id))
+        with self._lock:
+            return self._static.get((session_id, type_id, worker_id))
 
     def get_latest_update(self, session_id: str, type_id: str,
                           worker_id: str) -> Optional[Persistable]:
-        ups = self._updates.get((session_id, type_id, worker_id))
-        return ups[-1] if ups else None
+        with self._lock:
+            ups = self._updates.get((session_id, type_id, worker_id))
+            return ups[-1] if ups else None
 
     def get_all_updates(self, session_id: str, type_id: str,
                         worker_id: str) -> List[Persistable]:
-        return list(self._updates.get((session_id, type_id, worker_id), []))
+        with self._lock:
+            return list(self._updates.get(
+                (session_id, type_id, worker_id), []))
 
     def get_all_updates_after(self, session_id: str, type_id: str,
                               worker_id: str, ts: float) -> List[Persistable]:
@@ -133,7 +137,9 @@ class StatsStorage(StatsStorageRouter):
 
     def num_updates(self, session_id: str, type_id: str,
                     worker_id: str) -> int:
-        return len(self._updates.get((session_id, type_id, worker_id), []))
+        with self._lock:
+            return len(self._updates.get(
+                (session_id, type_id, worker_id), []))
 
     # ---------------------------------------------------------- listeners
     def register_stats_storage_listener(

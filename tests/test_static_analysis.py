@@ -334,6 +334,115 @@ FIXTURES = {
              return reg.snapshot()
          """, True, False),
     ],
+    # GL7xx — interprocedural lockset pass (callgraph.py + locks.py)
+    "GL701": [
+        ("""
+         import threading
+         class Store:
+             def __init__(self):
+                 self._lock = threading.Lock()
+                 self.items = []
+             def add(self, x):
+                 with self._lock:
+                     self.items.append(x)
+             def peek(self):
+                 return self.items[-1]   # no caller holds _lock
+         """, False, True),
+        ("""
+         import threading
+         class Store:
+             def __init__(self):
+                 self._lock = threading.Lock()
+                 self.items = []
+             def add(self, x):
+                 with self._lock:
+                     self._append(x)
+             def _append(self, x):
+                 self.items.append(x)    # entry-held via add()
+         """, False, False),
+    ],
+    "GL702": [
+        ("""
+         import threading
+         class Pair:
+             def __init__(self):
+                 self._a_lock = threading.Lock()
+                 self._b_lock = threading.Lock()
+             def ab(self):
+                 with self._a_lock:
+                     with self._b_lock:
+                         pass
+             def ba(self):
+                 with self._b_lock:
+                     with self._a_lock:
+                         pass
+         """, False, True),
+        ("""
+         import threading
+         class Pair:
+             def __init__(self):
+                 self._a_lock = threading.Lock()
+                 self._b_lock = threading.Lock()
+             def ab(self):
+                 with self._a_lock:
+                     with self._b_lock:
+                         pass
+             def ab2(self):              # same order everywhere
+                 with self._a_lock:
+                     with self._b_lock:
+                         pass
+         """, False, False),
+    ],
+    "GL703": [
+        ("""
+         import threading
+         import time
+         class Worker:
+             def __init__(self):
+                 self._lock = threading.Lock()
+             def run(self):
+                 with self._lock:
+                     time.sleep(0.1)     # blocks every other holder
+         """, True, True),
+        ("""
+         import threading
+         class Worker:
+             def __init__(self):
+                 self._cv = threading.Condition()
+             def run(self):
+                 with self._cv:
+                     self._cv.wait(0.1)  # wait() releases its own lock
+         """, True, False),
+    ],
+    "GL704": [
+        ("""
+         import threading
+         class Mgr:
+             def __init__(self):
+                 self._lock = threading.Lock()
+                 self.pending = []
+             def submit(self, fut, x):
+                 with self._lock:
+                     self.pending.append(x)
+                     fut.add_done_callback(
+                         lambda f: self.pending.append(f))
+         """, False, True),
+        ("""
+         import threading
+         class Mgr:
+             def __init__(self):
+                 self._lock = threading.Lock()
+                 self.pending = []
+             def submit(self, fut, x):
+                 with self._lock:
+                     self.pending.append(x)
+                     fut.add_done_callback(
+                         lambda f: self._consume(f))
+             def _consume(self, f):
+                 with self._lock:
+                     self.pending.append(f)
+         """, False, False),
+    ],
 }
 
 
@@ -736,6 +845,269 @@ def test_watchdog_warning_names_lint_rules(caplog):
         wd.record_compile("tag", "Cls", (2,))
     assert any("GL101/GL102/GL103" in r.getMessage()
                for r in caplog.records)
+
+
+# ------------------------------------------------- call graph (GL7xx)
+
+def _program(src, path="pkg/mod.py"):
+    from deeplearning4j_tpu.analysis.callgraph import CallGraph, Program
+    prog = Program.from_sources([(path, textwrap.dedent(src))])
+    return prog, CallGraph(prog)
+
+
+def test_callgraph_resolves_self_dispatch():
+    import ast
+    prog, graph = _program("""
+        class A:
+            def f(self):
+                self.g()
+            def g(self):
+                pass
+        """)
+    mod = prog.modules["pkg.mod"]
+    f = mod.classes["A"].methods["f"]
+    call = next(n for n in ast.walk(f.node) if isinstance(n, ast.Call))
+    targets = graph.resolve(f, call)
+    assert [t.qualname for t in targets] == ["pkg.mod.A.g"]
+
+
+def test_callgraph_resolves_module_functions():
+    import ast
+    prog, graph = _program("""
+        def helper():
+            pass
+        def entry():
+            helper()
+        """)
+    mod = prog.modules["pkg.mod"]
+    entry = mod.functions["entry"]
+    call = next(n for n in ast.walk(entry.node)
+                if isinstance(n, ast.Call))
+    targets = graph.resolve(entry, call)
+    assert [t.qualname for t in targets] == ["pkg.mod.helper"]
+
+
+def test_callgraph_inherited_method_lookup():
+    import ast
+    prog, graph = _program("""
+        class Base:
+            def g(self):
+                pass
+        class A(Base):
+            def f(self):
+                self.g()
+        """)
+    mod = prog.modules["pkg.mod"]
+    f = mod.classes["A"].methods["f"]
+    call = next(n for n in ast.walk(f.node) if isinstance(n, ast.Call))
+    targets = graph.resolve(f, call)
+    assert [t.qualname for t in targets] == ["pkg.mod.Base.g"]
+
+
+def test_lockset_recursion_terminates():
+    # mutually recursive lock-holding methods must not loop the
+    # entry-held fixpoint; bounded propagation makes this terminate
+    # and the guarded access under recursion stays quiet.
+    src = """
+        import threading
+        class R:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.n = 0
+            def a(self, k):
+                with self._lock:
+                    self.n += 1
+                    self.b(k)
+            def b(self, k):
+                if k:
+                    self.a(k - 1)
+                self.n += 1
+        """
+    got = rules_of(src)
+    assert "GL701" not in got
+
+
+# -------------------------------------- SARIF relatedLocations (GL7xx)
+
+def _gl701_findings():
+    src = FIXTURES["GL701"][0][0]
+    return [f for f in lint_source(textwrap.dedent(src), "pkg/mod.py")
+            if f.rule == "GL701"]
+
+
+def test_gl701_finding_carries_related_guard_site():
+    findings = _gl701_findings()
+    assert findings, "positive GL701 fixture must fire"
+    f = findings[0]
+    assert f.related, "GL701 must point back at the guard site"
+    rp, rl, rm = f.related[0]
+    assert rp == "pkg/mod.py" and rl >= 1 and "Store._lock" in rm
+    # to_dict round-trips the related sites for the JSON renderer
+    d = f.to_dict()
+    assert d["related"][0]["path"] == rp
+    assert d["related"][0]["line"] == rl
+
+
+def test_sarif_related_locations_roundtrip():
+    findings = _gl701_findings()
+    doc = json.loads(render_sarif(findings, files=1))
+    res = doc["runs"][0]["results"][0]
+    assert res["ruleId"] == "GL701"
+    rel = res["relatedLocations"]
+    assert rel, "GL7xx SARIF results must carry relatedLocations"
+    phys = rel[0]["physicalLocation"]
+    assert phys["artifactLocation"]["uri"] == "pkg/mod.py"
+    assert phys["region"]["startLine"] == findings[0].related[0][1]
+    assert rel[0]["message"]["text"] == findings[0].related[0][2]
+
+
+def test_gl702_relates_both_acquisition_orders():
+    src = FIXTURES["GL702"][0][0]
+    findings = [f for f in lint_source(textwrap.dedent(src),
+                                       "pkg/mod.py")
+                if f.rule == "GL702"]
+    assert len(findings) == 1
+    assert "Pair._a_lock" in findings[0].message
+    assert "Pair._b_lock" in findings[0].message
+    # the finding anchors on one acquisition order; related points at
+    # the opposing one
+    assert findings[0].related
+    assert "acquired here while" in findings[0].related[0][2]
+
+
+# ----------------------------------------------------- --changed mode
+
+def test_cli_changed_mode(tmp_path, capsys):
+    import subprocess as sp
+    repo = tmp_path / "r"
+    repo.mkdir()
+    env = {**os.environ, "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+           "GIT_COMMITTER_NAME": "t", "GIT_COMMITTER_EMAIL": "t@t"}
+
+    def git(*args):
+        sp.run(["git", *args], cwd=repo, check=True, env=env,
+               capture_output=True)
+
+    git("init", "-q")
+    (repo / "clean.py").write_text("x = 1\n")
+    git("add", "."); git("commit", "-qm", "seed")
+    cwd = os.getcwd()
+    os.chdir(repo)
+    try:
+        # nothing changed vs HEAD -> no files -> exit 0
+        assert lint_main(["--changed", "--strict"]) == 0
+        capsys.readouterr()
+        # an untracked file with an error IS picked up
+        (repo / "err.py").write_text(textwrap.dedent("""
+            import jax
+            @jax.jit
+            def f(x):
+                return float(x)
+            """))
+        assert lint_main(["--changed"]) == 1
+        out = capsys.readouterr().out
+        assert "err.py" in out and "clean.py" not in out
+        # positional paths filter the changed set
+        assert lint_main(["clean.py", "--changed", "--strict"]) == 0
+        capsys.readouterr()
+        # committed -> clean again vs HEAD
+        git("add", "."); git("commit", "-qm", "more")
+        assert lint_main(["--changed", "--strict"]) == 0
+        capsys.readouterr()
+    finally:
+        os.chdir(cwd)
+
+
+# --------------------------------------- lockmon (runtime cross-check)
+
+def test_lockmon_disabled_by_default(monkeypatch):
+    from deeplearning4j_tpu.observe import lockmon
+    monkeypatch.delenv("DL4J_TPU_LOCKMON", raising=False)
+    lockmon.reset_witness()
+    assert lockmon.get_witness() is None
+    # MonitoredLock degrades to a plain lock with no witness
+    lk = lockmon.MonitoredLock("X._lock")
+    with lk:
+        assert lk.locked()
+    assert not lk.locked()
+
+
+def test_lockmon_env_flag_enables(monkeypatch):
+    from deeplearning4j_tpu.observe import lockmon
+    monkeypatch.setenv("DL4J_TPU_LOCKMON", "1")
+    lockmon.reset_witness()
+    try:
+        w = lockmon.get_witness()
+        assert w is not None and lockmon.get_witness() is w
+    finally:
+        lockmon.reset_witness()
+
+
+def test_lockmon_witness_field_unguarded():
+    from deeplearning4j_tpu.observe.lockmon import (
+        LockWitness, MonitoredLock,
+    )
+    w = LockWitness()
+    lk = MonitoredLock("Store._lock", witness=w)
+    with lk:
+        w.witness_field("Store", "items", "Store._lock", write=True)
+    w.witness_field("Store", "items", "Store._lock")   # guard not held
+    rep = w.report()
+    assert len(rep["unguarded"]) == 1
+    ev = rep["unguarded"][0]
+    assert ev["rule"] == "GL701"
+    assert ev["field"] == "Store.items"
+    assert rep["static_rules"]["guarded_field"] == runtime_hint(
+        "guarded_field")
+
+
+def test_lockmon_hammer_matches_static_gl702():
+    """Thread-hammer the seeded ABBA pair: the runtime witness must
+    name the same lock pair and rule id the static pass reports."""
+    import threading
+    from deeplearning4j_tpu.observe.lockmon import (
+        LockWitness, MonitoredLock,
+    )
+    src = FIXTURES["GL702"][0][0]
+    static = [f for f in lint_source(textwrap.dedent(src), "pkg/mod.py")
+              if f.rule == "GL702"]
+    assert len(static) == 1
+
+    w = LockWitness()
+    a = MonitoredLock("Pair._a_lock", witness=w)
+    b = MonitoredLock("Pair._b_lock", witness=w)
+    gate = threading.Event()
+
+    def ab():
+        with a:
+            with b:
+                pass
+        gate.set()
+
+    def ba():
+        gate.wait(5.0)          # phase the orders: never deadlocks
+        with b:
+            with a:
+                pass
+
+    ts = [threading.Thread(target=ab), threading.Thread(target=ba)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=10.0)
+        assert not t.is_alive()
+
+    rep = w.report()
+    assert len(rep["inversions"]) == 1
+    inv = rep["inversions"][0]
+    assert inv["rule"] == "GL702"
+    assert inv["locks"] == ["Pair._a_lock", "Pair._b_lock"]
+    # the cross-check: every runtime lock name appears verbatim in the
+    # static finding's message, and the rule ids agree
+    assert static[0].rule == inv["rule"]
+    for name in inv["locks"]:
+        assert name in static[0].message
+    assert rep["static_rules"]["lock_order"] == runtime_hint("lock_order")
 
 
 # ------------------------------------------------------------- meta-test

@@ -13,6 +13,9 @@
 #   tools/ci_check.sh --slo      # SLO smoke: deliberate latency breach
 #                                #   must fire /slo, degrade /healthz,
 #                                #   write an slo_breach flight dump
+#   tools/ci_check.sh --locks    # concurrency gate: GL7xx lockset pass
+#                                #   strict over the package, then the
+#                                #   static↔runtime lock-witness smoke
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -39,6 +42,14 @@ fi
 if [[ "${1:-}" == "--slo" ]]; then
     echo "== SLO smoke (latency breach → /slo firing, degraded /healthz, flight dump) =="
     env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python tools/slo_smoke.py
+    exit 0
+fi
+
+if [[ "${1:-}" == "--locks" ]]; then
+    echo "== concurrency gate (GL7xx strict + lock-witness cross-check) =="
+    python -m deeplearning4j_tpu.analysis deeplearning4j_tpu \
+        --strict --select GL701,GL702,GL703,GL704
+    python tools/lockmon_smoke.py
     exit 0
 fi
 

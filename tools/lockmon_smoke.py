@@ -1,0 +1,133 @@
+#!/usr/bin/env python
+"""Static ↔ runtime lock-witness cross-check smoke (the GL702 loop).
+
+One seeded lock-order inversion, proven twice:
+
+1. **statically** — graft-lint's GL7xx lockset pass over the seeded
+   `Pair` source reports a GL702 lock-order-inversion cycle between
+   `Pair._a_lock` and `Pair._b_lock`;
+2. **at runtime** — two threads acquire `MonitoredLock`s named with the
+   SAME static identities in opposite orders (phased with a barrier +
+   sequencing event so the demo never actually deadlocks), and the
+   LockWitness reports an inversion tagged with the same rule id.
+
+The assertion that closes the loop: the runtime inversion's lock pair
+is string-equal to the locks named in the static finding's message.
+`tools/ci_check.sh --locks` runs this after the strict GL7xx lint.
+
+Exit 0 on success, 1 with a diagnostic on any mismatch.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from deeplearning4j_tpu.analysis import lint_source  # noqa: E402
+from deeplearning4j_tpu.observe.lockmon import (  # noqa: E402
+    LockWitness, MonitoredLock,
+)
+
+# The seeded hazard. `ab()` acquires _a_lock then _b_lock; `ba()` the
+# reverse — the classic ABBA deadlock shape GL702 exists to catch.
+_PAIR_SRC = '''\
+import threading
+
+class Pair:
+    def __init__(self):
+        self._a_lock = threading.Lock()
+        self._b_lock = threading.Lock()
+        self.n = 0
+
+    def ab(self):
+        with self._a_lock:
+            with self._b_lock:
+                self.n += 1
+
+    def ba(self):
+        with self._b_lock:
+            with self._a_lock:
+                self.n -= 1
+'''
+
+LOCK_A = "Pair._a_lock"
+LOCK_B = "Pair._b_lock"
+
+
+def _static_finding():
+    findings = [f for f in lint_source(_PAIR_SRC, path="pkg/pair.py")
+                if f.rule == "GL702"]
+    if not findings:
+        raise SystemExit("lockmon_smoke: static pass found no GL702 "
+                         "in the seeded Pair source")
+    return findings[0]
+
+
+def _runtime_inversion():
+    witness = LockWitness()
+    a = MonitoredLock(LOCK_A, witness=witness)
+    b = MonitoredLock(LOCK_B, witness=witness)
+    start = threading.Barrier(2)
+    # t1 finishes its a->b critical section before t2 starts b->a, so
+    # both orders are observed without the two threads ever contending.
+    t1_done = threading.Event()
+
+    def t1():
+        start.wait()
+        with a:
+            with b:
+                pass
+        t1_done.set()
+
+    def t2():
+        start.wait()
+        t1_done.wait()
+        with b:
+            with a:
+                pass
+
+    threads = [threading.Thread(target=t1, name="lockmon-ab"),
+               threading.Thread(target=t2, name="lockmon-ba")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10.0)
+        if t.is_alive():
+            raise SystemExit("lockmon_smoke: hammer thread hung")
+    report = witness.report()
+    if not report["inversions"]:
+        raise SystemExit("lockmon_smoke: runtime witness saw no "
+                         f"inversion (edges: {report['edges']})")
+    return report["inversions"][0]
+
+
+def main() -> int:
+    static = _static_finding()
+    inversion = _runtime_inversion()
+
+    ok = True
+    if inversion["rule"] != static.rule:
+        print(f"rule mismatch: runtime {inversion['rule']} != "
+              f"static {static.rule}")
+        ok = False
+    for name in (LOCK_A, LOCK_B):
+        if name not in static.message:
+            print(f"static GL702 message does not name {name}: "
+                  f"{static.message}")
+            ok = False
+    if sorted(inversion["locks"]) != sorted([LOCK_A, LOCK_B]):
+        print(f"runtime inversion pair {inversion['locks']} != "
+              f"[{LOCK_A}, {LOCK_B}]")
+        ok = False
+    if not ok:
+        return 1
+    print("lockmon_smoke: OK — static GL702 and runtime witness agree "
+          f"on {sorted(inversion['locks'])}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
