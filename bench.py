@@ -747,6 +747,21 @@ def _append_history(mode, summary):
              "round_trips_per_token": leg.get("round_trips_per_token"),
              "itl_p99_ms": (leg.get("itl_ms") or {}).get("p99")}
             for leg in summary["legs"]]
+    # the {spec on/off} x {native, int8 KV} matrix trends per leg too
+    if isinstance(summary.get("spec_matrix"), list):
+        row["spec_matrix"] = [
+            {"spec": leg.get("spec"), "kv": leg.get("kv_dtype"),
+             "k": leg.get("spec_k") or leg.get("fused_k"),
+             "tokens_per_s": leg.get("tokens_per_s"),
+             "acceptance_rate": leg.get("acceptance_rate"),
+             "slots_factor": leg.get("slots_per_chip_factor")}
+            for leg in summary["spec_matrix"]]
+    if isinstance(summary.get("spec"), dict):
+        for key in ("tokens_per_s", "acceptance_rate",
+                    "speedup_vs_stepwise"):
+            v = summary["spec"].get(key)
+            if v is not None:
+                row["spec_" + key] = v
     for k, sub in (("ttft_p99_ms", ("ttft_ms", "p99")),
                    ("itl_p99_ms", ("itl_ms", "p99")),
                    ("continuous_p99_ms", ("modes", "continuous",
@@ -1169,11 +1184,53 @@ def _serving_decode_main():
         return (None if not vals else
                 round(vals[min(len(vals) - 1, int(q * len(vals)))], 3))
 
-    def run_leg(fused_k, *, traced_pass):
-        net = build_net()
+    def build_spec_pair():
+        """Target + draft for the speculative matrix legs: the target
+        is the bench transformer with a NON-rolling cache (spec decode
+        rewinds positions; rolling rings can't) and its block's residual
+        write-backs zeroed; the draft is the attention-free trunk
+        (embed + pos + output) sharing the target's weights. Under
+        pre-norm the silenced block is exact identity, so draft and
+        target logits agree bit-for-bit — a distilled-draft stand-in
+        that measures the MECHANISM's ceiling (greedy acceptance = 1.0,
+        reported, and floored by the perf gate); real-model speedup
+        scales with the measured acceptance rate."""
+        import jax.numpy as jnp
+
+        def build(blocks):
+            layers = [EmbeddingSequenceLayer(n_in=V, n_out=32),
+                      PositionEmbeddingLayer(max_length=256)]
+            for _ in range(blocks):
+                layers.append(TransformerEncoderBlock(
+                    num_heads=4, causal=True, window=32,
+                    rolling_cache=False, max_cache=128))
+            layers.append(RnnOutputLayer(n_out=V, activation="softmax"))
+            conf = (NeuralNetConfiguration.builder().seed(0)
+                    .updater(Adam(1e-3)).activation("identity")
+                    .list(*layers)
+                    .set_input_type(InputType.recurrent(1, chunk))
+                    .build())
+            return MultiLayerNetwork(conf).init()
+
+        tgt, drf = build(1), build(0)
+        blk = tgt.params_tree["layer2_transformerencoderblock"]
+        for key in ("attn_Wo", "attn_b", "ffn_w2", "ffn_b2"):
+            blk[key] = jnp.zeros_like(blk[key])
+        for name in drf.params_tree:
+            src = ("layer3_rnnoutputlayer"
+                   if name == "layer2_rnnoutputlayer" else name)
+            drf.params_tree[name] = tgt.params_tree[src]
+        return tgt, drf
+
+    def run_leg(fused_k, *, traced_pass, nets=None, spec_k=None,
+                kv_dtype=None):
+        net, draft = nets() if nets else (build_net(), None)
         srv = InferenceServer(net, port=0, decode_slots=clients,
                               decode_prefill_chunk=chunk,
                               decode_fused_k=fused_k,
+                              decode_draft_net=draft,
+                              decode_spec_k=spec_k,
+                              decode_kv_dtype=kv_dtype,
                               max_batch_size=max(8, clients),
                               queue_capacity=max(64, 8 * clients))
         port = srv.start()
@@ -1283,8 +1340,17 @@ def _serving_decode_main():
         toks = tok_total[0]
         streamed = decode["tokens_streamed"]
         disp = decode["dispatches"]["total"]
+        spec_block = decode.get("spec_decode") or {}
         leg = {
             "fused_k": fused_k,
+            "spec": bool(spec_block.get("enabled")),
+            "spec_k": spec_block.get("k") if spec_block.get("enabled")
+            else None,
+            "kv_dtype": (decode.get("slots") or {}).get("kv_dtype",
+                                                        "native"),
+            "acceptance_rate": spec_block.get("acceptance_rate"),
+            "slots_per_chip_factor": (decode.get("slots") or {}).get(
+                "slots_per_chip_factor"),
             "loop": decode["decode_loop"]["kind"],
             "tokens_per_s": round(toks / wall, 2),
             "duration_s": round(wall, 3),
@@ -1334,6 +1400,27 @@ def _serving_decode_main():
         if k == primary_k:
             decode_primary, trace_block = decode, tb
 
+    # --- the {spec on/off} x {native, int8 KV} matrix: four legs over
+    # the truncated-draft pair. Greedy parity is asserted WITHIN each
+    # KV dtype (spec vs non-spec must be bit-exact; int8 legitimately
+    # changes numerics vs native, so cross-dtype streams may differ).
+    spec_k = int(os.environ.get("BENCH_DECODE_SPEC_K", str(primary_k)))
+    spec_legs, spec_probes = [], {}
+    spec_decode_native = None
+    if os.environ.get("BENCH_DECODE_SPEC", "1") != "0":
+        for use_spec, kv in ((False, "native"), (False, "int8"),
+                             (True, "native"), (True, "int8")):
+            leg, probe, dec, _ = run_leg(
+                primary_k, traced_pass=False,
+                nets=(build_spec_pair if use_spec else
+                      (lambda: (build_spec_pair()[0], None))),
+                spec_k=spec_k if use_spec else None,
+                kv_dtype=None if kv == "native" else kv)
+            spec_legs.append(leg)
+            spec_probes[(use_spec, kv)] = probe
+            if use_spec and kv == "native":
+                spec_decode_native = dec
+
     by_k = {leg["fused_k"]: leg for leg in legs}
     primary = by_k[primary_k]
     stepwise = by_k.get(1)
@@ -1361,6 +1448,37 @@ def _serving_decode_main():
         "trace": trace_block,
         "registry": _registry_snapshot(),
     }
+    if spec_legs:
+        by_cfg = {(leg["spec"], leg["kv_dtype"]): leg
+                  for leg in spec_legs}
+        spec_on = by_cfg[(True, "native")]
+        spec_int8 = by_cfg[(True, "int8")]
+        out["spec_matrix"] = spec_legs
+        out["spec"] = {
+            "spec_k": spec_k,
+            "tokens_per_s": spec_on["tokens_per_s"],
+            "tokens_per_s_int8": spec_int8["tokens_per_s"],
+            "acceptance_rate": spec_on["acceptance_rate"],
+            "speedup_vs_stepwise": (
+                round(spec_on["tokens_per_s"]
+                      / stepwise["tokens_per_s"], 2)
+                if stepwise and stepwise["tokens_per_s"] else None),
+            "speedup_vs_fused": (
+                round(spec_on["tokens_per_s"]
+                      / by_cfg[(False, "native")]["tokens_per_s"], 2)
+                if by_cfg[(False, "native")]["tokens_per_s"] else None),
+            "greedy_parity": (
+                spec_probes[(True, "native")]
+                == spec_probes[(False, "native")]
+                and spec_probes[(True, "int8")]
+                == spec_probes[(False, "int8")]),
+            "zero_recompiles": all(leg["zero_recompiles"]
+                                   for leg in spec_legs),
+            "int8_slots_per_chip_factor":
+                spec_int8["slots_per_chip_factor"],
+            "server_decode": spec_decode_native,
+        }
+        out["errors"] += [e for leg in spec_legs for e in leg["errors"]]
     dev = jax.devices()[0]
     out["device"] = getattr(dev, "device_kind", str(dev))
     out["platform"] = dev.platform
@@ -1371,6 +1489,24 @@ def _serving_decode_main():
         json.dump(out, f, indent=1)
     _append_history("serving-decode", out)
     print(json.dumps(out))
+    print(_decode_doc_line(out), file=sys.stderr)
+
+
+def _decode_doc_line(out) -> str:
+    """The doc-facing decode summary sentence, printed verbatim by
+    `--serving-decode` — README/ROADMAP/PERF_NOTES quote THIS line, so
+    refreshing the docs is a re-run + paste, never a hand-transcription
+    (that's how 3292-vs-3364 drift happened)."""
+    line = (f"decode serving: {out['value']} tok/s @ K={out['fused_k']} "
+            f"fused ({out['speedup_vs_stepwise']}x vs stepwise)")
+    sp = out.get("spec")
+    if sp:
+        line += (f"; spec D={sp['spec_k']}: {sp['tokens_per_s']} tok/s "
+                 f"({sp['speedup_vs_stepwise']}x vs stepwise, "
+                 f"acceptance {sp['acceptance_rate']}); int8 KV: "
+                 f"{sp['int8_slots_per_chip_factor']}x slots/chip at "
+                 f"{sp['tokens_per_s_int8']} tok/s")
+    return line
 
 
 def _kernels_main():

@@ -791,13 +791,18 @@ class MultiLayerNetwork(SeqCtxJitCache, SeqCtxSolverCache):
         return _decode_limit(
             l for l in self.layers if hasattr(l, "decode_carry"))
 
-    def session_carries(self, slots: int):
+    def session_carries(self, slots: int, kv_dtype: Optional[str] = None):
         """Batched slot-indexed decode carries for `slots` independent
         sessions: attention layers get PER-SLOT position vectors
         (`decode_carry(per_slot=True)`), recurrent layers their h/c
         carries (mask-gated per step, so padded chunks hold them on pad
         tokens). This is the KVSlotPool's backing tree — pure data, no
-        model-global state."""
+        model-global state.
+
+        `kv_dtype` ("native"/None, "int8", "fp8") selects the attention
+        caches' storage dtype — quantized carries gain per-(token,
+        kv-head) scale rows next to each cache (see
+        `MultiHeadAttention.decode_carry`)."""
         self._check_init()
         decode = [l for l in self.layers if hasattr(l, "decode_carry")]
         rnn = [l for l in self.layers if _is_recurrent(l)]
@@ -812,11 +817,28 @@ class MultiLayerNetwork(SeqCtxJitCache, SeqCtxSolverCache):
                     f"session decoding is causal left-to-right; layer "
                     f"{l.name!r} ({type(l).__name__}) cannot stream")
         self._validate_causal_decode(decode, what="session decoding")
-        carries = {l.name: l.decode_carry(slots, self.dtype, per_slot=True)
+        carries = {l.name: l.decode_carry(slots, self.dtype, per_slot=True,
+                                          kv_dtype=kv_dtype)
                    for l in decode}
         for l in rnn:
             carries[l.name] = l.initial_carry(slots, self.dtype)
         return carries
+
+    def spec_decode_capable(self) -> bool:
+        """Can this net serve as a speculative-decode draft or target?
+        The windows below un-write rejected tokens by REWINDING the
+        per-slot positions — stale cache entries past `pos` are invisible
+        (`k_ids <= pos`) and get overwritten by the next window. That
+        trick needs every stateful carry to be position-addressed:
+        recurrent h/c carries hold irreversible state, and rolling rings
+        misattribute stale slots through their held-index arithmetic, so
+        either disqualifies the net."""
+        if self._rnn_layer_names:
+            return False
+        decode = [l for l in self.layers if hasattr(l, "decode_carry")]
+        if not decode:
+            return False
+        return not any(getattr(l, "rolling_cache", False) for l in decode)
 
     def session_step(self, x, carries, *, active=None, valid=None):
         """One slot-indexed decode step: carries and per-slot positions
@@ -952,6 +974,248 @@ class MultiLayerNetwork(SeqCtxJitCache, SeqCtxSolverCache):
         return self._jit_cache[key](
             self.params_tree, self.state_tree, tokens, carries,
             jnp.asarray(active, bool), jnp.asarray(temperature, jnp.float32),
+            jnp.asarray(top_k, jnp.int32), jnp.asarray(top_p, jnp.float32),
+            jnp.asarray(greedy, bool), jnp.asarray(keys, jnp.uint32),
+            jnp.asarray(offsets, jnp.int32), jnp.asarray(budgets, jnp.int32),
+            jnp.asarray(eos_ids, jnp.int32))
+
+    # ------------------------------------------- speculative decoding
+    #
+    # The draft/target window pair below shares one invariant: every
+    # stateful carry is POSITION-ADDRESSED (linear caches + per-slot
+    # positions — `spec_decode_capable` gates the rest out), so a
+    # rejected token is un-written by rewinding `pos`: stale entries
+    # past `pos` are invisible (`k_ids <= pos`) and the next window's
+    # scatter overwrites them. Bookkeeping per window, for a lane that
+    # accepted n_acc of k draft tokens and emitted n = n_acc + 1:
+    #   target: verify writes k+1 entries, pos snaps back to old + n
+    #   draft:  propose wrote k entries ([t0, d_1..d_{k-1}]); the next
+    #           window enters with rewind = max(k - n, 0); on full
+    #           acceptance (n = k+1) the draft lacks d_k's KV, so the
+    #           next propose catch-up-writes it (pre_tokens/pre_valid)
+
+    # rng stream salts: acceptance uniforms and residual/bonus draws
+    # come from streams independent of both models' sampling draws
+    # (fold_in(fold_in(base_key, SALT), position)) — the rejection
+    # rule's correctness assumes the acceptance coin is independent of
+    # the proposal.
+    _SPEC_U_SALT = 0x5EC0DE
+    _SPEC_R_SALT = 0xDEC0DE5
+
+    @staticmethod
+    def _pos_rewind(carries, delta):
+        """Subtract `delta` [S] from every per-slot `pos` leaf (the
+        decode-carry trees are nested dicts whose position leaves are
+        always keyed "pos")."""
+        def walk(node):
+            if isinstance(node, dict):
+                out = {}
+                for kk, vv in node.items():
+                    if kk == "pos":
+                        out[kk] = vv - delta.astype(vv.dtype)
+                    else:
+                        out[kk] = walk(vv)
+                return out
+            return node
+        return walk(carries)
+
+    def session_propose_window(self, tokens, carries, *, active, k,
+                               temperature, top_k, top_p, greedy, keys,
+                               offsets, rewind, pre_tokens, pre_valid):
+        """The DRAFT half of a speculative window: k sequential decode
+        steps in one dispatch, sampling each proposal on-device and
+        recording the warped distribution it was drawn from (the q the
+        rejection rule needs). Entry bookkeeping per lane: `rewind` [S]
+        is subtracted from the draft positions (un-writing proposals the
+        target rejected last window) and, where `pre_valid`, one masked
+        catch-up step writes `pre_tokens`' KV first (the fully-accepted
+        d_k whose cache entry the draft never wrote). Proposal draws use
+        the SAME stream as the non-speculative sampler
+        (fold_in(base_key, offsets + i)); no EOS/budget early-exit — the
+        target's verify applies the cuts.
+
+        Returns ``(draft_tokens [S, k] i32, draft_probs [S, k, V] f32,
+        new_carries)``."""
+        from deeplearning4j_tpu.nn.layers.feedforward import (
+            EmbeddingSequenceLayer,
+        )
+        from deeplearning4j_tpu.utils import sampling as _sampling
+
+        self._check_init()
+        k = int(k)
+        if k < 1:
+            raise ValueError(f"draft window k must be >= 1, got {k}")
+        tokens = jnp.asarray(tokens, jnp.int32)
+        ids_input = isinstance(self.layers[0], EmbeddingSequenceLayer)
+        feat = 1 if ids_input else int(self.layers[0].n_in)
+        stateful = set(self._rnn_layer_names) | set(self._decode_layer_names)
+        key = ("session_propose_window", k, tokens.shape, ids_input)
+        if key not in self._jit_cache:
+            def propose_fn(params, states, tok0, carries_, active_, temps,
+                           tks, tps, grdy, keys_, offs, rew, ptok, pval):
+                dt = self.dtype
+
+                def encode(tok):
+                    if ids_input:
+                        return tok[:, None, None].astype(dt)
+                    return jax.nn.one_hot(tok, feat, dtype=dt)[:, None, :]
+
+                def lane_merge(mask, old_tree, new_tree):
+                    def lane(old, nw):
+                        a = mask.reshape(
+                            (-1,) + (1,) * (getattr(nw, "ndim", 1) - 1))
+                        return jnp.where(a, nw, old)
+                    return jax.tree_util.tree_map(lane, old_tree, new_tree)
+
+                carries_ = self._pos_rewind(
+                    carries_, jnp.where(active_, rew, 0))
+                cu = active_ & pval
+                _, _, cu_states, _ = self._forward(
+                    params, states, encode(ptok), train=False, rng=None,
+                    fmask=cu.astype(dt)[:, None], carries=carries_)
+                carries_ = lane_merge(
+                    cu, carries_, {nm: cu_states[nm] for nm in stateful})
+
+                def body(carry, i):
+                    tok, c = carry
+                    out, _, new_states, _ = self._forward(
+                        params, states, encode(tok), train=False, rng=None,
+                        fmask=active_.astype(dt)[:, None], carries=c)
+                    new = lane_merge(
+                        active_, c, {nm: new_states[nm] for nm in stateful})
+                    p = out[:, -1, :].astype(jnp.float32)
+                    pw = _sampling.warp_probs_lanes(p, temps, tks, tps)
+                    step_keys = jax.vmap(jax.random.fold_in)(keys_, offs + i)
+                    logp = jnp.where(pw > 0.0, jnp.log(pw), -jnp.inf)
+                    drawn = jax.vmap(jax.random.categorical)(
+                        step_keys, logp).astype(jnp.int32)
+                    g_tok = jnp.argmax(p, axis=-1).astype(jnp.int32)
+                    nxt = jnp.where(grdy, g_tok, drawn)
+                    return ((jnp.where(active_, nxt, tok), new), (nxt, pw))
+
+                (_, cf), (toks, pws) = jax.lax.scan(
+                    body, (tok0, carries_), jnp.arange(k))
+                return (jnp.transpose(toks), jnp.moveaxis(pws, 0, 1), cf)
+
+            self._jit_cache[key] = jax.jit(propose_fn)
+        return self._jit_cache[key](
+            self.params_tree, self.state_tree, tokens, carries,
+            jnp.asarray(active, bool), jnp.asarray(temperature, jnp.float32),
+            jnp.asarray(top_k, jnp.int32), jnp.asarray(top_p, jnp.float32),
+            jnp.asarray(greedy, bool), jnp.asarray(keys, jnp.uint32),
+            jnp.asarray(offsets, jnp.int32), jnp.asarray(rewind, jnp.int32),
+            jnp.asarray(pre_tokens, jnp.int32),
+            jnp.asarray(pre_valid, bool))
+
+    def session_verify_window(self, tokens, carries, *, active, k,
+                              draft_tokens, draft_probs, temperature,
+                              top_k, top_p, greedy, keys, offsets,
+                              budgets, eos_ids):
+        """The TARGET half of a speculative window: ONE chunked forward
+        over [t0, d_1..d_k] scores every draft position, accept/reject
+        runs on device (utils/sampling.spec_accept_lanes — greedy
+        longest-prefix fast path, standard rejection rule otherwise),
+        EOS/budget prefix cuts apply, and the target positions snap back
+        to old + n_emit so rejected entries are rewound. An alive lane
+        always emits n_acc + 1 tokens (its accepted prefix plus the
+        correction/bonus token), so the chain advances every window.
+
+        Returns ``(packed [S, k+3] i32, new_carries)`` where packed rows
+        are ``[n_emit, last_draft, tok_0..tok_k]`` (-1 past n_emit) —
+        one device array so the manager's single post-lock readback
+        covers count, catch-up token, and emissions together."""
+        from deeplearning4j_tpu.nn.layers.feedforward import (
+            EmbeddingSequenceLayer,
+        )
+        from deeplearning4j_tpu.utils import sampling as _sampling
+
+        self._check_init()
+        k = int(k)
+        if k < 1:
+            raise ValueError(f"verify window k must be >= 1, got {k}")
+        tokens = jnp.asarray(tokens, jnp.int32)
+        draft_tokens = jnp.asarray(draft_tokens, jnp.int32)
+        ids_input = isinstance(self.layers[0], EmbeddingSequenceLayer)
+        feat = 1 if ids_input else int(self.layers[0].n_in)
+        stateful = set(self._rnn_layer_names) | set(self._decode_layer_names)
+        key = ("session_verify_window", k, tokens.shape, ids_input)
+        if key not in self._jit_cache:
+            def verify_fn(params, states, tok0, carries_, active_, d_toks,
+                          q_pw, temps, tks, tps, grdy, keys_, offs, buds,
+                          eos):
+                dt = self.dtype
+                chunk = jnp.concatenate([tok0[:, None], d_toks], axis=1)
+                if ids_input:
+                    x = chunk[:, :, None].astype(dt)
+                else:
+                    x = jax.nn.one_hot(chunk, feat, dtype=dt)
+                val = active_.astype(dt)[:, None] * jnp.ones((1, k + 1), dt)
+                out, _, new_states, _ = self._forward(
+                    params, states, x, train=False, rng=None, fmask=val,
+                    carries=carries_)
+                p_raw = out.astype(jnp.float32)            # [S, k+1, V]
+                pw = jax.vmap(
+                    lambda pp: _sampling.warp_probs_lanes(
+                        pp, temps, tks, tps),
+                    in_axes=1, out_axes=1)(p_raw)
+
+                def lane_u(key_, off):
+                    sk = jax.random.fold_in(key_, self._SPEC_U_SALT)
+                    return jax.vmap(
+                        lambda i: jax.random.uniform(
+                            jax.random.fold_in(sk, off + i)))(jnp.arange(k))
+
+                u = jax.vmap(lane_u)(keys_, offs)          # [S, k]
+                extra_keys = jax.vmap(
+                    lambda key_, off: jax.random.fold_in(
+                        jax.random.fold_in(key_, self._SPEC_R_SALT), off)
+                )(keys_, offs)
+                n_acc, extra = _sampling.spec_accept_lanes(
+                    p_raw, pw, q_pw, d_toks, grdy, u, extra_keys)
+
+                idx = jnp.arange(k + 1)[None, :]
+                d_pad = jnp.concatenate(
+                    [d_toks, jnp.zeros_like(d_toks[:, :1])], axis=1)
+                cand = jnp.where(idx == n_acc[:, None], extra[:, None],
+                                 d_pad)
+                base = idx <= n_acc[:, None]
+                eos_hit = base & (cand == eos[:, None]) & (eos[:, None] >= 0)
+                prior_eos = jnp.cumsum(eos_hit, axis=1) - eos_hit
+                emitted = (base & (prior_eos == 0)
+                           & (idx < buds[:, None]) & active_[:, None])
+                n_emit = emitted.sum(axis=1).astype(jnp.int32)
+                toks_out = jnp.where(emitted, cand, -1)
+
+                def lane(old, nw):
+                    a = active_.reshape(
+                        (-1,) + (1,) * (getattr(nw, "ndim", 1) - 1))
+                    return jnp.where(a, nw, old)
+
+                new = jax.tree_util.tree_map(
+                    lane, carries_, {nm: new_states[nm] for nm in stateful})
+                # position snap-back: the forward advanced active lanes
+                # by k+1; the confirmed history is old + n_emit
+                demit = jnp.where(active_, n_emit, 0)
+
+                def fix(path, old_leaf, new_leaf):
+                    # graft: allow(GL003): `path` is static pytree
+                    # structure from tree_map_with_path, not a tracer
+                    if getattr(path[-1], "key", None) == "pos":
+                        return old_leaf + demit.astype(old_leaf.dtype)
+                    return new_leaf
+
+                new = jax.tree_util.tree_map_with_path(
+                    fix, carries_, new)
+                packed = jnp.concatenate(
+                    [n_emit[:, None], d_toks[:, -1:], toks_out], axis=1)
+                return packed.astype(jnp.int32), new
+
+            self._jit_cache[key] = jax.jit(verify_fn)
+        return self._jit_cache[key](
+            self.params_tree, self.state_tree, tokens, carries,
+            jnp.asarray(active, bool), draft_tokens,
+            jnp.asarray(draft_probs, jnp.float32),
+            jnp.asarray(temperature, jnp.float32),
             jnp.asarray(top_k, jnp.int32), jnp.asarray(top_p, jnp.float32),
             jnp.asarray(greedy, bool), jnp.asarray(keys, jnp.uint32),
             jnp.asarray(offsets, jnp.int32), jnp.asarray(budgets, jnp.int32),

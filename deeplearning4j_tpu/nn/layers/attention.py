@@ -130,7 +130,7 @@ class MultiHeadAttention(Layer):
         }, {}
 
     def decode_carry(self, batch: int, dtype=jnp.float32, *,
-                     per_slot: bool = False):
+                     per_slot: bool = False, kv_dtype: str = None):
         """Preallocated KV cache for incremental decoding (the transformer
         analogue of the reference's rnnTimeStep statefulness,
         `MultiLayerNetwork.java:rnnTimeStep`): fixed [B, max_cache, Hkv,
@@ -142,7 +142,14 @@ class MultiHeadAttention(Layer):
         `per_slot=True` makes the write position a [batch] vector — each
         batch row is an independent decode SLOT at its own position
         (serving sessions: rows advance at different rates, inactive
-        lanes stand still). Requires causal attention."""
+        lanes stand still). Requires causal attention.
+
+        `kv_dtype` in ("int8", "fp8") stores K/V quantized with one f32
+        scale per (token, kv-head) — `scale_k`/`scale_v` rows of
+        [B, L, Hkv] ride the carry next to the caches. Quantize-on-write
+        and dequantize-on-read live in `_decode`; the scale rows cost
+        1/Dh of a native cache, so slots-per-chip multiplies by
+        ~4·Dh/(Dh+4) at int8."""
         Dh = self.n_out // self.num_heads
         L = self.max_cache
         Hkv = self._kv_heads
@@ -150,11 +157,25 @@ class MultiHeadAttention(Layer):
             raise ValueError(
                 "per-slot decode carries need causal=True (each lane's "
                 "visible prefix is its own position)")
-        return {
-            "cache_k": jnp.zeros((batch, L, Hkv, Dh), dtype),
-            "cache_v": jnp.zeros((batch, L, Hkv, Dh), dtype),
+        cdt = dtype
+        if kv_dtype in ("int8", "fp8"):
+            if not per_slot:
+                raise ValueError(
+                    "quantized KV carries are a session-pool feature "
+                    "(per_slot=True); the lockstep rnn_time_step path "
+                    "stays native")
+            cdt = jnp.int8 if kv_dtype == "int8" else jnp.float8_e4m3fn
+        elif kv_dtype not in (None, "native"):
+            raise ValueError(f"unknown kv_dtype {kv_dtype!r}")
+        carry = {
+            "cache_k": jnp.zeros((batch, L, Hkv, Dh), cdt),
+            "cache_v": jnp.zeros((batch, L, Hkv, Dh), cdt),
             "pos": jnp.zeros((batch,) if per_slot else (), jnp.int32),
         }
+        if kv_dtype in ("int8", "fp8"):
+            carry["scale_k"] = jnp.zeros((batch, L, Hkv), jnp.float32)
+            carry["scale_v"] = jnp.zeros((batch, L, Hkv), jnp.float32)
+        return carry
 
     def _decode(self, params, x, state, mask=None):
         """One decode step: append this block's K/V at `pos`, attend the
@@ -188,6 +209,9 @@ class MultiHeadAttention(Layer):
         per_slot = getattr(pos, "ndim", 0) == 1
         if per_slot and not self.causal:
             raise ValueError("per-slot decode needs causal=True")
+        quant = "scale_k" in state
+        if quant and not per_slot:
+            raise ValueError("quantized KV carries require per-slot mode")
         if (not self.rolling_cache and not per_slot
                 and not isinstance(pos, jax.core.Tracer)
                 and int(pos) + T > L):
@@ -216,10 +240,34 @@ class MultiHeadAttention(Layer):
                 # short chunk in a wide bucket never dirties the cache
                 tgt = jnp.where(valid, tgt, L)
             cdt = state["cache_k"].dtype
-            ck = state["cache_k"].at[rows, tgt].set(
-                k.astype(cdt), mode="drop")
-            cv = state["cache_v"].at[rows, tgt].set(
-                v.astype(cdt), mode="drop")
+            if quant:
+                # quantize-on-write: one f32 scale per (token, kv-head),
+                # amax-scaled to the storage format's dynamic range.
+                # Zero-amax rows keep scale 1 so dequant stays finite.
+                qmax = 127.0 if cdt == jnp.int8 else 448.0
+
+                def _q(val):
+                    amax = jnp.max(jnp.abs(val), axis=-1)      # [B, T, Hkv]
+                    sc = jnp.where(amax > 0.0, amax / qmax, 1.0)
+                    scaled = val.astype(jnp.float32) / sc[..., None]
+                    if cdt == jnp.int8:
+                        qv = jnp.clip(jnp.round(scaled), -127.0,
+                                      127.0).astype(jnp.int8)
+                    else:
+                        qv = scaled.astype(cdt)
+                    return qv, sc.astype(jnp.float32)
+
+                kq, sk = _q(k)
+                vq, sv = _q(v)
+                ck = state["cache_k"].at[rows, tgt].set(kq, mode="drop")
+                cv = state["cache_v"].at[rows, tgt].set(vq, mode="drop")
+                csk = state["scale_k"].at[rows, tgt].set(sk, mode="drop")
+                csv = state["scale_v"].at[rows, tgt].set(sv, mode="drop")
+            else:
+                ck = state["cache_k"].at[rows, tgt].set(
+                    k.astype(cdt), mode="drop")
+                cv = state["cache_v"].at[rows, tgt].set(
+                    v.astype(cdt), mode="drop")
             if self.rolling_cache:
                 # per-row held-position arithmetic (see scalar branch)
                 end = pos + n_new - 1                          # [B]
@@ -296,6 +344,14 @@ class MultiHeadAttention(Layer):
             pos_new = pos + T
         # [T, L] (lockstep) or [B, T, L] (per-slot) -> broadcastable
         vb = vis if vis.ndim == 3 else vis[None]
+        if quant:
+            # dequantize-on-read for the dense fallback: the banded
+            # kernel path below instead fuses this product into its
+            # block loads and never materializes the f32 cache
+            ck_a = ck.astype(q.dtype) * csk.astype(q.dtype)[..., None]
+            cv_a = cv.astype(q.dtype) * csv.astype(q.dtype)[..., None]
+        else:
+            ck_a, cv_a = ck, cv
         dpol = None
         if T == 1:
             from deeplearning4j_tpu.ops.kernel_defaults import (
@@ -323,7 +379,9 @@ class MultiHeadAttention(Layer):
                 q[:, 0], ck, cv, dec_pos.astype(jnp.int32),
                 dec_end.astype(jnp.int32), window=self.window,
                 rolling=self.rolling_cache, block_l=dpol.block_l,
-                interpret=jax.default_backend() != "tpu")
+                interpret=jax.default_backend() != "tpu",
+                scale_k=csk if quant else None,
+                scale_v=csv if quant else None)
             o = o[:, None]
         elif Hkv != H:
             # GQA: group the query heads against the Hkv-wide cache in
@@ -332,18 +390,22 @@ class MultiHeadAttention(Layer):
             # resource) really is Hkv/H of full MHA
             G = H // Hkv
             qg = q.reshape(B, T, Hkv, G, Dh)
-            s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, ck) / jnp.sqrt(Dh)
+            s = jnp.einsum("bqhgd,bkhd->bhgqk", qg, ck_a) / jnp.sqrt(Dh)
             s = jnp.where(vb[:, None, None], s, -1e30)
             o = jnp.einsum("bhgqk,bkhd->bqhgd",
-                           jax.nn.softmax(s, axis=-1), cv)
+                           jax.nn.softmax(s, axis=-1), cv_a)
             o = o.reshape(B, T, H, Dh)
         else:
-            s = jnp.einsum("bqhd,bkhd->bhqk", q, ck) / jnp.sqrt(Dh)
+            s = jnp.einsum("bqhd,bkhd->bhqk", q, ck_a) / jnp.sqrt(Dh)
             s = jnp.where(vb[:, None], s, -1e30)
             o = jnp.einsum("bhqk,bkhd->bqhd",
-                           jax.nn.softmax(s, axis=-1), cv)
+                           jax.nn.softmax(s, axis=-1), cv_a)
         y = o.reshape(B, T, self.n_out) @ params["Wo"] + params["b"]
-        return self._act(y), {"cache_k": ck, "cache_v": cv, "pos": pos_new}
+        new_state = {"cache_k": ck, "cache_v": cv, "pos": pos_new}
+        if quant:
+            new_state["scale_k"] = csk
+            new_state["scale_v"] = csv
+        return self._act(y), new_state
 
     def apply(self, params, x, *, state=None, train=False, rng=None, mask=None):
         if state is not None and "cache_k" in state:
@@ -516,7 +578,9 @@ class PositionEmbeddingLayer(Layer):
             key, (self.max_length, d), dtype)}, {}
 
     def decode_carry(self, batch: int, dtype=jnp.float32, *,
-                     per_slot: bool = False):
+                     per_slot: bool = False, kv_dtype: str = None):
+        # no KV here — kv_dtype is accepted (and ignored) so the
+        # session-carry builder can pass one policy to every decode layer
         return {"pos": jnp.zeros((batch,) if per_slot else (), jnp.int32)}
 
     def apply(self, params, x, *, state=None, train=False, rng=None,
@@ -672,10 +736,10 @@ class TransformerEncoderBlock(Layer):
             + params[f"{prefix}_b"]
 
     def decode_carry(self, batch: int, dtype=jnp.float32, *,
-                     per_slot: bool = False):
+                     per_slot: bool = False, kv_dtype: str = None):
         attn, _ = self._sub()
-        return {"attn": attn.decode_carry(batch, dtype,
-                                          per_slot=per_slot)}
+        return {"attn": attn.decode_carry(batch, dtype, per_slot=per_slot,
+                                          kv_dtype=kv_dtype)}
 
     def apply(self, params, x, *, state=None, train=False, rng=None,
               mask=None):

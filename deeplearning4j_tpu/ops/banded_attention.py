@@ -278,13 +278,19 @@ banded_attention.defvjp(_banded_fwd, _banded_bwd)
 
 # ------------------------------------------------------- decode (serving)
 def decode_reference(q, cache_k, cache_v, qpos, end, window: Optional[int],
-                     rolling: bool, scale: float):
+                     rolling: bool, scale: float, scale_k=None,
+                     scale_v=None):
     """Dense oracle for the single-query decode kernel, mirroring the
     layer's per-slot `_decode` visibility arithmetic over the pool layout
     (q [S, H, Dh], caches [S, L, Hkv, Dh], qpos/end [S] int32). Rows with
     an empty visible set are garbage-by-contract on BOTH paths (softmax
     of a constant here, zeros in the kernel) — inactive lanes, never
-    read back."""
+    read back. Quantized caches pass their [S, L, Hkv] scale rows and are
+    dequantized up front (the kernel fuses the same product into its
+    block loads)."""
+    if scale_k is not None:
+        cache_k = cache_k.astype(q.dtype) * scale_k.astype(q.dtype)[..., None]
+        cache_v = cache_v.astype(q.dtype) * scale_v.astype(q.dtype)[..., None]
     s_, h, dh = q.shape
     l = cache_k.shape[1]
     hkv = cache_k.shape[2]
@@ -305,14 +311,25 @@ def decode_reference(q, cache_k, cache_v, qpos, end, window: Optional[int],
     return o.reshape(s_, h, dh)
 
 
-def _decode_kernel(qpos_ref, end_ref, q_ref, k_ref, v_ref, o_ref, acc_scr,
-                   m_scr, l_scr, *, cache_len: int, window: Optional[int],
-                   rolling: bool, hkv: int, scale: float):
+def _decode_kernel(qpos_ref, end_ref, *refs, cache_len: int,
+                   window: Optional[int], rolling: bool, hkv: int,
+                   scale: float, quant: bool = False):
     """Grid = (slots, L blocks): one slot's [L, Hkv, Dh] cache rows sweep
     through VMEM while the single-token query group stays resident. The
     per-slot positions arrive scalar-prefetched (SMEM) so visibility is
     computed from traced scalars — one compiled program for every session
-    position, which is what keeps the decode zero-recompile contract."""
+    position, which is what keeps the decode zero-recompile contract.
+
+    `quant=True` adds two [1, Bl, Hkv] scale-row refs after the caches:
+    the per-(token, kv-head) dequantization product happens on the VMEM
+    block right after the load, so quantized KV pays the narrow HBM sweep
+    and never materializes a full-width cache."""
+    if quant:
+        (q_ref, k_ref, v_ref, sk_ref, sv_ref, o_ref,
+         acc_scr, m_scr, l_scr) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, acc_scr, m_scr, l_scr = refs
+        sk_ref = sv_ref = None
     si = pl.program_id(0)
     lb = pl.program_id(1)
     nlb = pl.num_programs(1)
@@ -344,6 +361,10 @@ def _decode_kernel(qpos_ref, end_ref, q_ref, k_ref, v_ref, o_ref, acc_scr,
     def _():
         kc = k_ref[0]                              # [Bl, Hkv, Dh]
         vc = v_ref[0]
+        if quant:
+            # fused dequantize-on-load: widen the narrow block in VMEM
+            kc = kc.astype(jnp.float32) * sk_ref[0][:, :, None]
+            vc = vc.astype(jnp.float32) * sv_ref[0][:, :, None]
         prec = (jax.lax.Precision.HIGHEST if q.dtype == jnp.float32
                 else jax.lax.Precision.DEFAULT)
         # GQA: each Hkv tile scores its G-row query group; Hkv is a
@@ -394,7 +415,8 @@ def banded_decode_attention(q, cache_k, cache_v, qpos, end,
                             rolling: bool = False,
                             scale: Optional[float] = None,
                             block_l: int = 512,
-                            interpret: bool = False):
+                            interpret: bool = False,
+                            scale_k=None, scale_v=None):
     """Single-query attention over the KVSlotPool layout.
 
     q: [S, H, Dh] (this step's query token per slot, post-RoPE);
@@ -417,23 +439,36 @@ def banded_decode_attention(q, cache_k, cache_v, qpos, end,
     if rolling and window is None:
         raise ValueError("rolling decode requires a window")
     sc = scale if scale is not None else dh ** -0.5
+    quant = scale_k is not None
     block_l = _fit_block(block_l, cache_len, interpret=interpret)
     qpos = qpos.astype(jnp.int32)
     end = end.astype(jnp.int32)
+    in_specs = [
+        pl.BlockSpec((1, h, dh), lambda si, lb, *refs: (si, 0, 0)),
+        pl.BlockSpec((1, block_l, hkv, dh),
+                     lambda si, lb, *refs: (si, lb, 0, 0)),
+        pl.BlockSpec((1, block_l, hkv, dh),
+                     lambda si, lb, *refs: (si, lb, 0, 0)),
+    ]
+    inputs = [q, cache_k, cache_v]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, block_l, hkv),
+                         lambda si, lb, *refs: (si, lb, 0)),
+            pl.BlockSpec((1, block_l, hkv),
+                         lambda si, lb, *refs: (si, lb, 0)),
+        ]
+        inputs += [scale_k.astype(jnp.float32),
+                   scale_v.astype(jnp.float32)]
+    out_dtype = q.dtype
     return pl.pallas_call(
         functools.partial(_decode_kernel, cache_len=cache_len,
                           window=window, rolling=rolling, hkv=hkv,
-                          scale=sc),
+                          scale=sc, quant=quant),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=2,
             grid=(s_, cache_len // block_l),
-            in_specs=[
-                pl.BlockSpec((1, h, dh), lambda si, lb, *refs: (si, 0, 0)),
-                pl.BlockSpec((1, block_l, hkv, dh),
-                             lambda si, lb, *refs: (si, lb, 0, 0)),
-                pl.BlockSpec((1, block_l, hkv, dh),
-                             lambda si, lb, *refs: (si, lb, 0, 0)),
-            ],
+            in_specs=in_specs,
             out_specs=pl.BlockSpec((1, h, dh),
                                    lambda si, lb, *refs: (si, 0, 0)),
             scratch_shapes=[
@@ -442,8 +477,8 @@ def banded_decode_attention(q, cache_k, cache_v, qpos, end,
                 pltpu.VMEM((h, 1), jnp.float32),
             ],
         ),
-        out_shape=jax.ShapeDtypeStruct((s_, h, dh), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((s_, h, dh), out_dtype),
         compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
-    )(qpos, end, q, cache_k, cache_v)
+    )(qpos, end, *inputs)

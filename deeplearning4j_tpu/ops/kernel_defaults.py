@@ -26,6 +26,9 @@ Policy, in order:
        DL4J_TPU_DECODE_ATTN    = auto|banded|dense   (serving decode step)
        DL4J_TPU_DECODE_LOOP    = auto|fused|stepwise (serving decode loop)
        DL4J_TPU_DECODE_K       = int (fused decode window length; bucketed)
+       DL4J_TPU_SPEC_DECODE    = auto|on|off  (draft-model speculative decode)
+       DL4J_TPU_DRAFT_K        = int (draft proposal window; bucketed)
+       DL4J_TPU_KV_DTYPE       = auto|native|int8|fp8 (KV-cache storage)
        DL4J_TPU_FUSED_UPDATE   = auto|fused|xla      (optimizer update)
   2. Shape eligibility: flash needs the TPU backend and 128-lane-tileable
      sequence lengths; otherwise dense.
@@ -466,6 +469,128 @@ def decode_loop_policy(k: Optional[int] = None, *, capable: bool = True,
                          f"{row[mt]['stepwise_ms']} ms)")
     return fused(want_k, "structural default: identical per-step XLA "
                  "program, K-fold fewer host round-trips")
+
+
+class SpecDecodePolicy(NamedTuple):
+    kind: str            # "spec" | "plain"
+    k: int               # draft window length (0 when plain)
+    reason: str
+
+
+def spec_decode_policy(k: Optional[int] = None, *, capable: bool = True,
+                       record: bool = True) -> SpecDecodePolicy:
+    """Draft-model speculative decoding (draft proposes D tokens per
+    lane, the target verifies all D in ONE chunk dispatch, accept/reject
+    on device) vs the plain fused window. Same lattice as
+    `decode_loop_policy` — env force, then capability, then the measured
+    verdict. The no-data default is SPEC when a draft is wired up:
+    verification lowers through the same chunked forward the prefill
+    path already runs, and replacing D sequential target steps with one
+    chunk is structural. `capable=False` means no draft model is
+    registered, or either net cannot rewind its caches (recurrent
+    carries / rolling rings hold state that cannot be un-written after
+    a rejection) — degrades to plain. `k` is the requested draft window
+    (None = default bucket), snapped to DECODE_K_BUCKETS so draft-length
+    churn costs zero compiles."""
+    forced = _env("DL4J_TPU_SPEC_DECODE")
+    env_k = os.environ.get("DL4J_TPU_DRAFT_K", "").strip()
+    if env_k:
+        k = int(env_k)
+    want_k = _bucket_k(8 if k is None else max(1, int(k)))
+
+    def spec(kk, reason):
+        if record:
+            record_dispatch("spec_decode", "spec")
+        return SpecDecodePolicy("spec", kk, reason)
+
+    def plain(reason):
+        if record:
+            record_dispatch("spec_decode", "plain")
+        return SpecDecodePolicy("plain", 0, reason)
+
+    if forced == "off":
+        return plain("forced by DL4J_TPU_SPEC_DECODE=off")
+    if forced == "on":
+        if not capable:
+            return plain("DL4J_TPU_SPEC_DECODE=on but no rewindable "
+                         "draft/target pair (draft missing, recurrent "
+                         "carries, or rolling KV rings)")
+        return spec(want_k, "forced by DL4J_TPU_SPEC_DECODE=on")
+    if not capable:
+        return plain("no rewindable draft/target pair (draft missing, "
+                     "recurrent carries, or rolling KV rings)")
+    row = MEASURED.get("spec_decode")
+    if row is not None:
+        mt = _nearest_measured(row, want_k)
+        if mt is not None and row[mt]["winner"] == "plain":
+            return plain(f"measured loss at D={mt} "
+                         f"({row[mt]['spec_ms']} vs "
+                         f"{row[mt]['plain_ms']} ms)")
+        if mt is not None:
+            return spec(want_k, f"measured win at D={mt} "
+                        f"({row[mt]['spec_ms']} vs "
+                        f"{row[mt]['plain_ms']} ms)")
+    return spec(want_k, "structural default: one chunk verify replaces "
+                "D sequential target dispatches")
+
+
+class KVDtypePolicy(NamedTuple):
+    kind: str            # "native" | "int8" | "fp8"
+    reason: str
+
+
+def _fp8_capable() -> bool:
+    """fp8 KV storage needs the e4m3 dtype AND a backend whose cast
+    lowering is trusted; off-TPU the int8 path is the portable one."""
+    import jax
+    import jax.numpy as jnp
+
+    return hasattr(jnp, "float8_e4m3fn") and jax.default_backend() == "tpu"
+
+
+def kv_dtype_policy(kind: Optional[str] = None, *,
+                    record: bool = True) -> KVDtypePolicy:
+    """Storage dtype for the KVSlotPool's attention caches: "native"
+    (the model dtype), "int8" (per-(token, kv-head) scale rows,
+    quantize-on-write / dequantize-on-read fused into the banded decode
+    kernel's block loads and the dense fallback), or "fp8" (e4m3, same
+    scale rows, capable backends only). Env hatch DL4J_TPU_KV_DTYPE
+    always wins; `kind` is the caller's request (server knob); the
+    no-data default is NATIVE — quantization trades ulps for slots, and
+    that trade is opted into per deployment, not defaulted. A MEASURED
+    ["kv_dtype"] verdict (from the autotune sweep) can flip the auto
+    default once rows exist."""
+    forced = _env("DL4J_TPU_KV_DTYPE")
+    want = forced if forced != "auto" else (kind or "").strip().lower()
+    if want not in ("", "auto", "native", "int8", "fp8"):
+        # an explicit-but-unknown request must fail the deploy, not
+        # silently serve unquantized
+        raise ValueError(f"unknown kv_dtype {want!r} "
+                         "(expected native|int8|fp8)")
+
+    def verdict(kd, reason):
+        if record:
+            record_dispatch("kv_dtype", kd)
+        return KVDtypePolicy(kd, reason)
+
+    if want in ("native", "int8"):
+        src = "DL4J_TPU_KV_DTYPE" if forced != "auto" else "caller"
+        return verdict(want, f"forced by {src}={want}")
+    if want == "fp8":
+        if not _fp8_capable():
+            src = "DL4J_TPU_KV_DTYPE" if forced != "auto" else "caller"
+            return verdict("int8", f"{src}=fp8 but backend lacks e4m3 "
+                           "support; int8 carries the same scale rows")
+        src = "DL4J_TPU_KV_DTYPE" if forced != "auto" else "caller"
+        return verdict("fp8", f"forced by {src}=fp8")
+    row = MEASURED.get("kv_dtype")
+    if row is not None and row.get("winner") in ("int8", "fp8"):
+        kd = row["winner"]
+        if kd == "fp8" and not _fp8_capable():
+            kd = "int8"
+        return verdict(kd, f"measured win ({row})")
+    return verdict("native", "no measured rows; quantization is "
+                   "opt-in per deployment")
 
 
 def fused_update_policy(kind: str) -> str:

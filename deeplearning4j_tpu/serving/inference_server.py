@@ -110,7 +110,11 @@ class InferenceServer(JsonHttpServer):
                  slots: int = 1, degraded_fraction: float = 0.8,
                  mesh=None, metrics=None, decode_slots: int = 0,
                  decode_prefill_chunk: int = 8,
-                 decode_fused_k: Optional[int] = None, slo: bool = False,
+                 decode_fused_k: Optional[int] = None,
+                 decode_draft_net=None,
+                 decode_spec_k: Optional[int] = None,
+                 decode_kv_dtype: Optional[str] = None,
+                 slo: bool = False,
                  slo_objectives=None,
                  series_interval: Optional[float] = None):
         super().__init__(port=port)
@@ -154,7 +158,10 @@ class InferenceServer(JsonHttpServer):
                 self.enable_decode_sessions(
                     slots=decode_slots,
                     prefill_chunk=decode_prefill_chunk,
-                    fused_k=decode_fused_k)
+                    fused_k=decode_fused_k,
+                    draft_net=decode_draft_net,
+                    spec_k=decode_spec_k,
+                    kv_dtype=decode_kv_dtype)
 
     # ------------------------------------------------------ control API
     def deploy(self, name: str, version, net, *, feat_shape=None,
@@ -167,12 +174,21 @@ class InferenceServer(JsonHttpServer):
     def enable_decode_sessions(self, model: str = DEFAULT_MODEL, *,
                                slots: int = 4, prefill_chunk: int = 8,
                                fused_k: Optional[int] = None,
+                               draft_net=None,
+                               spec_k: Optional[int] = None,
+                               kv_dtype: Optional[str] = None,
                                warm: bool = True):
         """Attach a DecodeSessionManager to `model`: POST /generate
         streams tokens from per-request sessions over a shared KV slot
         pool, stepped through the continuous-batching scheduler.
         `fused_k` requests a fused decode window length (None = the
-        `decode_loop_policy` default; env hatches still win)."""
+        `decode_loop_policy` default; env hatches still win).
+        `draft_net` wires in a speculative-decoding draft model (same
+        vocab, rewind-capable) and `spec_k` its proposals-per-window;
+        `kv_dtype` ("int8"/"fp8") quantizes the KV slot pools'
+        cache storage. All three defer to their kernel_defaults policy
+        lattice — DL4J_TPU_SPEC_DECODE / DL4J_TPU_DRAFT_K /
+        DL4J_TPU_KV_DTYPE force-override."""
         if self.mode != "continuous":
             raise ValueError(
                 "decode sessions need the continuous scheduler "
@@ -186,6 +202,7 @@ class InferenceServer(JsonHttpServer):
         mgr = DecodeSessionManager(
             self.registry, self.scheduler, model, slots=slots,
             prefill_chunk=prefill_chunk, fused_k=fused_k,
+            draft_net=draft_net, spec_k=spec_k, kv_dtype=kv_dtype,
             metrics=self.stats.registry, warm=warm)
         self._decode[model] = mgr
         return mgr

@@ -47,19 +47,20 @@ class KVSlotPool:
     per-slot reset."""
 
     def __init__(self, net, slots: int, *, model: str = "default",
-                 metrics=None):
+                 metrics=None, kv_dtype: Optional[str] = None):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
         self.net = net
         self.slots = int(slots)
         self.model = model
+        self.kv_dtype = kv_dtype or "native"
         self._cv = threading.Condition()
         # the decode carry pytree and slot occupancy are the shared
         # state every request thread contends on; declare the guard so
         # graft-lint's interprocedural pass (GL701) checks every reader
         # — callers that enter via `with pool.lock():` stay quiet
         # graft: guarded-by(_cv)
-        self.carries = net.session_carries(self.slots)
+        self.carries = net.session_carries(self.slots, kv_dtype=kv_dtype)
         # graft: guarded-by(_cv)
         self._free = list(range(self.slots - 1, -1, -1))
         # graft: guarded-by(_cv)
@@ -142,12 +143,19 @@ class KVSlotPool:
         self.carries = new_carries
 
     # -------------------------------------------------------- hot swap
-    def rebind(self, net) -> None:
+    def rebind(self, net, kv_dtype: Optional[str] = None) -> None:
         """Point the pool at a hot-swapped net, keeping the live carries
         (sessions survive the flip). The candidate must produce an
         identical carry tree — checked abstractly (eval_shape: no device
-        allocation); mismatch raises IncompatibleSessionSwapError."""
-        want = jax.eval_shape(lambda: net.session_carries(self.slots))
+        allocation); mismatch raises IncompatibleSessionSwapError. The
+        dtype comparison below covers the quantization contract too: a
+        candidate whose carries come out at a different KV dtype (model
+        dtype change, or `kv_dtype` explicitly different from the live
+        pool's) is refused — live int8 caches cannot migrate onto a
+        native-dtype tree or vice versa."""
+        kd = self.kv_dtype if kv_dtype is None else kv_dtype
+        want = jax.eval_shape(
+            lambda: net.session_carries(self.slots, kv_dtype=kd))
         have = jax.eval_shape(lambda: self.carries)
         ws, hs = jax.tree_util.tree_structure(want), \
             jax.tree_util.tree_structure(have)
@@ -167,8 +175,43 @@ class KVSlotPool:
         with self._cv:
             return self.slots - len(self._free)
 
+    def _slot_bytes(self) -> tuple:
+        """(actual, hypothetical-native) bytes per slot across the carry
+        tree: KV caches counted at their stored width vs the net dtype's,
+        scale rows counted vs zero. The ratio is the slots-per-chip
+        multiplier quantization buys at a fixed carry budget."""
+        native_itemsize = jnp.dtype(
+            getattr(self.net, "dtype", jnp.float32)).itemsize
+        actual = native = 0
+
+        def walk(node):
+            nonlocal actual, native
+            if isinstance(node, dict):
+                for kk, vv in node.items():
+                    if kk in ("cache_k", "cache_v"):
+                        actual += vv.size * vv.dtype.itemsize
+                        native += vv.size * native_itemsize
+                    elif kk in ("scale_k", "scale_v"):
+                        actual += vv.size * vv.dtype.itemsize
+                    else:
+                        walk(vv)
+            elif isinstance(node, (list, tuple)):
+                for vv in node:
+                    walk(vv)
+            elif hasattr(node, "nbytes"):
+                actual += node.nbytes
+                native += node.nbytes
+
+        walk(self.carries)
+        return actual / self.slots, native / self.slots
+
     def describe(self) -> dict:
         with self._cv:
+            actual, native = self._slot_bytes()
             return {"total": self.slots,
                     "in_use": self.slots - len(self._free),
-                    "model": self.model}
+                    "model": self.model,
+                    "kv_dtype": self.kv_dtype,
+                    "slot_bytes": int(actual),
+                    "slots_per_chip_factor": round(
+                        native / actual, 2) if actual else 1.0}

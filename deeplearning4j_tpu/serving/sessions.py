@@ -32,6 +32,20 @@ closed-loop bench can reconcile its client-side numbers; ITL inside a
 window is amortized (window gap / tokens) since tokens arrive in
 bursts of K.
 
+Speculative decoding rides the same machinery: wire a DRAFT net in and
+`spec_decode_policy` flips each window to draft-propose + target-verify
+— the draft proposes spec_k tokens through its own fused window (its
+slots live in a lockstep KVSlotPool, registered as `<model>@draft`),
+the target scores all of them in ONE chunked forward, and accept/
+reject (utils/sampling.spec_accept_lanes: greedy longest-prefix fast
+path, standard rejection rule otherwise) stays on device. Rejected
+proposals are un-written by rewinding per-slot positions, so both nets
+must be rewind-capable (no recurrent carries, no rolling rings). The
+host still pays exactly one sync per window — the verify's packed
+result rows. `kv_dtype_policy` independently picks the pools' cache
+storage (int8/fp8 with per-(token, kv-head) scales), multiplying
+slots-per-chip at fixed memory.
+
 Hot-swap: the manager subscribes to registry deploy hooks for its base
 model. In the "warm" phase it verifies the candidate can host the live
 carry tree and pre-compiles its session-step buckets (raising rides
@@ -52,7 +66,9 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from deeplearning4j_tpu.observe import reqtrace
-from deeplearning4j_tpu.ops.kernel_defaults import decode_loop_policy
+from deeplearning4j_tpu.ops.kernel_defaults import (
+    decode_loop_policy, kv_dtype_policy, spec_decode_policy,
+)
 from deeplearning4j_tpu.serving.kv_pool import (
     IncompatibleSessionSwapError, KVSlotPool, SlotPoolExhaustedError,
 )
@@ -109,6 +125,14 @@ class DecodeSession:
         self._off = 0              # prompt tokens already submitted
         self._last_tok_at: Optional[float] = None
         self._finished = False     # guarded by the manager lock
+        # speculative-decode bookkeeping (manager-owned; safe to read and
+        # write in run_batch because each session has exactly one row in
+        # flight): how far the draft's positions must rewind on window
+        # entry, and the catch-up token (d_k) the draft never cached
+        # when the previous window fully accepted
+        self._spec_rewind = 0
+        self._spec_pre_tok = 0
+        self._spec_pre_valid = False
 
     # -------------------------------------------------------- client API
     def stream(self, timeout: Optional[float] = None):
@@ -161,6 +185,8 @@ class DecodeSessionManager:
     def __init__(self, registry, scheduler, model: str = "default", *,
                  slots: int = 4, prefill_chunk: int = 8,
                  fused_k: Optional[int] = None,
+                 draft_net=None, spec_k: Optional[int] = None,
+                 kv_dtype: Optional[str] = None,
                  metrics=None, warm: bool = True):
         if prefill_chunk < 1:
             raise ValueError("prefill_chunk must be >= 1")
@@ -203,18 +229,62 @@ class DecodeSessionManager:
         self._encoding = _input_encoding(first)
         self._limit = base.net.decode_limit()
 
+        # kv-dtype verdict: storage dtype for every pool this manager
+        # owns — target and draft slots quantize together, mixed-dtype
+        # pools would double the compiled-program set for no benefit
+        kvp = kv_dtype_policy(kv_dtype)
+        self.kv_dtype = kvp.kind
+        self._kv_reason = kvp.reason
+
+        # speculative-decode verdict: needs a draft that exists, shares
+        # the target's vocabulary (acceptance compares the two nets'
+        # distributions token for token) and can REWIND — as must the
+        # target, since rejected proposals are un-written by snapping
+        # per-slot positions back (recurrent carries and rolling rings
+        # hold state that cannot be un-written, so either disqualifies)
+        self.draft_net = draft_net
+        spec_capable = False
+        if draft_net is not None and \
+                hasattr(draft_net, "session_propose_window"):
+            _, dv = _resolve_net(draft_net)
+            spec_capable = (
+                int(dv) == self.vocab
+                and getattr(base.net, "spec_decode_capable",
+                            lambda: False)()
+                and draft_net.spec_decode_capable())
+        spec = spec_decode_policy(spec_k, capable=spec_capable)
+        self.spec_enabled = spec.kind == "spec"
+        self.spec_k = int(spec.k)
+        self._spec_reason = spec.reason
+        self.draft_name = f"{model}@draft" if self.spec_enabled else None
+
         from deeplearning4j_tpu.observe import get_registry
         if metrics is None:
             metrics = get_registry()
         self.metrics = metrics
-        # the policy consult above counted on the process-global registry
-        # (record_dispatch); mirror onto the server's registry when it is
-        # a private one so /metrics surfaces the decode_loop verdict too
+        # the policy consults above counted on the process-global
+        # registry (record_dispatch); mirror onto the server's registry
+        # when it is a private one so /metrics surfaces the decode_loop,
+        # spec_decode and kv_dtype verdicts too
         if metrics is not get_registry():
             metrics.counter("kernel_dispatch_total", op="decode_loop",
                             impl=self.loop_kind).inc()
+            metrics.counter("kernel_dispatch_total", op="spec_decode",
+                            impl="spec" if self.spec_enabled
+                            else "plain").inc()
+            metrics.counter("kernel_dispatch_total", op="kv_dtype",
+                            impl=self.kv_dtype).inc()
         self.pool = KVSlotPool(base.net, slots, model=model,
-                               metrics=metrics)
+                               metrics=metrics, kv_dtype=self.kv_dtype)
+        # the draft rides a lockstep slot pool: slot i of the draft pool
+        # always belongs to the session holding slot i of the target
+        # pool, so no independent alloc/free bookkeeping — _finish just
+        # zeroes the row for the next tenant
+        self.draft_pool = None
+        if self.spec_enabled:
+            self.draft_pool = KVSlotPool(
+                draft_net, slots, model=self.draft_name,
+                metrics=metrics, kv_dtype=self.kv_dtype)
         self._g_active = metrics.gauge("serving_sessions_active",
                                        model=model)
         self._c_opened = metrics.counter("serving_sessions_total",
@@ -238,6 +308,15 @@ class DecodeSessionManager:
             "serving_decode_windows_total", model=model)
         self._c_window_tokens = metrics.counter(
             "serving_decode_window_tokens_total", model=model)
+        # spec accounting: the counter PAIR makes the acceptance rate
+        # derivable from /metrics alone (accepted / draft), and the
+        # per-lane-window histogram gives its distribution
+        self._c_draft_toks = metrics.counter("draft_tokens_total",
+                                             model=model)
+        self._c_accepted = metrics.counter("accepted_tokens_total",
+                                           model=model)
+        self._h_accept = metrics.histogram(
+            "serving_spec_acceptance_rate", model=model)
 
         # the decode endpoint: an ordinary registry entry whose "runner"
         # is this manager — scheduler dispatch, drain-on-retire and
@@ -246,6 +325,14 @@ class DecodeSessionManager:
             self.decode_name,
             ModelEntry(self.decode_name, getattr(base, "version", None),
                        base.net, runner=self))
+        # the draft is a first-class registry citizen (PR 7 seam): it
+        # shows up in describe(), and registry.close() reaches this
+        # manager through its runner (shutdown is idempotent)
+        if self.spec_enabled:
+            registry.register_entry(
+                self.draft_name,
+                ModelEntry(self.draft_name, getattr(base, "version", None),
+                           draft_net, runner=self))
         registry.add_deploy_hook(model, self._deploy_hook)
         # kernel-policy verdict cached once (and refreshed on hot-swap):
         # session-step spans stamp it per ITL step, and re-deriving it
@@ -260,12 +347,23 @@ class DecodeSessionManager:
 
     def _compile_buckets(self, net) -> None:
         """Run one all-lanes-inactive step per prefill bucket plus one
-        all-lanes-inactive fused window so every dispatch shape this
-        manager will ever use is compiled before traffic (the
-        zero-recompiles-after-warmup contract the bench asserts)."""
-        carries = net.session_carries(self.pool.slots)
+        all-lanes-inactive window program (plain fused window, or the
+        propose+verify pair when speculating) so every dispatch shape
+        this manager will ever use is compiled before traffic (the
+        zero-recompiles-after-warmup contract the bench asserts). On a
+        hot-swap warm phase `net` is the TARGET candidate; the draft is
+        not part of the deploy, so its already-compiled programs feed
+        the candidate's verify warmup."""
+        carries = net.session_carries(self.pool.slots,
+                                      kv_dtype=self.kv_dtype)
         S, F = self.pool.slots, self._feat_dim()
         act = np.zeros((S,), bool)
+        knobs = dict(temperature=np.ones((S,), np.float32),
+                     top_k=np.full((S,), self.vocab, np.int32),
+                     top_p=np.ones((S,), np.float32),
+                     greedy=np.ones((S,), bool),
+                     keys=np.zeros((S, 2), np.uint32),
+                     offsets=np.zeros((S,), np.int32))
         for b in self.buckets:
             x = np.zeros((S, b, F), np.float32)
             val = np.zeros((S, b), np.float32)
@@ -274,17 +372,38 @@ class DecodeSessionManager:
             # first live dispatch
             # graft: allow-sync(warmup barrier — pre-traffic by design)
             np.asarray(out)
-        toks, _, _ = net.session_decode_window(
-            np.zeros((S,), np.int64), carries, active=act,
-            k=self.fused_k, temperature=np.ones((S,), np.float32),
-            top_k=np.full((S,), self.vocab, np.int32),
-            top_p=np.ones((S,), np.float32), greedy=np.ones((S,), bool),
-            keys=np.zeros((S, 2), np.uint32),
-            offsets=np.zeros((S,), np.int32),
-            budgets=np.zeros((S,), np.int32),
-            eos_ids=np.full((S,), -1, np.int32))
-        # graft: allow-sync(warmup barrier — pre-traffic by design)
-        np.asarray(toks)
+        if self.spec_enabled:
+            # graft: allow(GL701): warmup runs at construction/deploy
+            # time, before the draft pool is shared with request
+            # threads; steady-state readers take the pool lock
+            draft = self.draft_pool.net
+            dcar = draft.session_carries(S, kv_dtype=self.kv_dtype)
+            for b in self.buckets:
+                x = np.zeros((S, b, F), np.float32)
+                val = np.zeros((S, b), np.float32)
+                out, _ = draft.session_step(x, dcar, active=act,
+                                            valid=val)
+                # graft: allow-sync(warmup barrier — pre-traffic)
+                np.asarray(out)
+            d_toks, d_probs, _ = draft.session_propose_window(
+                np.zeros((S,), np.int64), dcar, active=act,
+                k=self.spec_k, rewind=np.zeros((S,), np.int32),
+                pre_tokens=np.zeros((S,), np.int32),
+                pre_valid=np.zeros((S,), bool), **knobs)
+            packed, _ = net.session_verify_window(
+                np.zeros((S,), np.int64), carries, active=act,
+                k=self.spec_k, draft_tokens=d_toks, draft_probs=d_probs,
+                budgets=np.zeros((S,), np.int32),
+                eos_ids=np.full((S,), -1, np.int32), **knobs)
+            # graft: allow-sync(warmup barrier — pre-traffic by design)
+            np.asarray(packed)
+        else:
+            toks, _, _ = net.session_decode_window(
+                np.zeros((S,), np.int64), carries, active=act,
+                k=self.fused_k, budgets=np.zeros((S,), np.int32),
+                eos_ids=np.full((S,), -1, np.int32), **knobs)
+            # graft: allow-sync(warmup barrier — pre-traffic by design)
+            np.asarray(toks)
 
     def warmup(self) -> None:
         # graft: allow(GL701): warmup runs at construction/deploy time,
@@ -316,10 +435,16 @@ class DecodeSessionManager:
             raise ValueError("max_tokens must be >= 1")
         params = SamplingParams(temperature=temperature, top_k=top_k,
                                 top_p=top_p, greedy=greedy)
+        # a speculative verify transiently writes spec_k + 1 entries
+        # past the confirmed position before the cut snaps it back; the
+        # cache must leave that headroom or the last window's scatter
+        # would silently drop rows
+        head = (self.spec_k + 1) if self.spec_enabled else 0
         if self._limit is not None and \
-                int(prompt.size) + int(max_tokens) > self._limit:
+                int(prompt.size) + int(max_tokens) + head > self._limit:
             raise ValueError(
-                f"prompt ({prompt.size}) + max_tokens ({max_tokens}) "
+                f"prompt ({prompt.size}) + max_tokens ({max_tokens})"
+                f"{f' + spec headroom ({head})' if head else ''} "
                 f"exceeds the decode budget of {self._limit} for this "
                 f"net (non-rolling cache)")
         with self._lock:
@@ -485,6 +610,11 @@ class DecodeSessionManager:
                 tokens=len(sess.generated),
                 error=None if error is None else type(error).__name__)
         self.pool.free(sess.slot)
+        if self.draft_pool is not None:
+            # lockstep draft slot: zero the mirror row for the next
+            # tenant (reset, not free — the draft pool's free list is
+            # deliberately unused)
+            self.draft_pool.reset(sess.slot)
         self._c_out[outcome].inc()
         self._g_active.set(n_active)
         try:
@@ -513,7 +643,13 @@ class DecodeSessionManager:
         stay on device — prefill pays NO host sync), then one
         `session_decode_window` advancing every decoding lane K tokens
         with on-device sampling. Returns one result row per request
-        row: `[count, tok_0..tok_{K-1}]` — count 0 for prefill legs."""
+        row: `[count, tok_0..tok_{K-1}]` — count 0 for prefill legs.
+
+        Speculating, the window half becomes draft-propose + target-
+        verify (plus a mirrored draft prefill), accept/reject stays on
+        device, and the ONE host sync per window reads back the verify's
+        packed [S, spec_k+3] rows — counts, catch-up token and emitted
+        tokens together, so speculation never adds a sync."""
         xs = np.asarray(xs)
         if xs.ndim != 2 or xs.shape[1] != 3 + self.prefill_chunk:
             raise ValueError(
@@ -531,7 +667,10 @@ class DecodeSessionManager:
         pre = np.nonzero(phase == 0)[0]
         dec = np.nonzero(phase == 1)[0]
         S, K = self.pool.slots, self.fused_k
-        ys = np.zeros((k, 1 + K), np.float32)
+        # a spec window can emit up to spec_k accepted drafts plus the
+        # correction/bonus token; plain windows top out at K
+        W = (self.spec_k + 1) if self.spec_enabled else K
+        ys = np.zeros((k, 1 + W), np.float32)
 
         # prefill scatter: [S, bucket] chunk step, inactive lanes masked
         bucket = 0
@@ -552,6 +691,7 @@ class DecodeSessionManager:
         # session has exactly one row in flight (this one), so nothing
         # mutates them concurrently.
         act_d = np.zeros((S,), bool)
+        by_slot: Dict[int, DecodeSession] = {}
         if dec.size:
             with self._lock:
                 by_slot = {s.slot: s for s in self._sessions.values()}
@@ -561,6 +701,9 @@ class DecodeSessionManager:
             offs = np.zeros((S,), np.int32)
             buds = np.zeros((S,), np.int32)
             eos = np.full((S,), -1, np.int32)
+            rew = np.zeros((S,), np.int32)
+            ptk = np.zeros((S,), np.int32)
+            pvl = np.zeros((S,), bool)
             for i in dec:
                 s = int(slots_idx[i])
                 sess = by_slot.get(s)
@@ -574,10 +717,15 @@ class DecodeSessionManager:
                 buds[s] = sess.max_tokens - len(sess.generated)
                 if sess.eos_id is not None:
                     eos[s] = sess.eos_id
+                if self.spec_enabled:
+                    rew[s] = sess._spec_rewind
+                    ptk[s] = sess._spec_pre_tok
+                    pvl[s] = sess._spec_pre_valid
             temps, tks, tps, grd = lane_param_arrays(lane_params,
                                                      self.vocab)
 
         toks_d = None
+        packed_d = None
         with self.pool.lock():
             # drop rows whose slot was freed while the row was queued
             # (session aborted mid-flight): stepping a freed slot would
@@ -594,13 +742,76 @@ class DecodeSessionManager:
                 x = _encode(tok, self._encoding, self.vocab)
                 _, carries = net.session_step(
                     x, carries, active=act_p, valid=val)
-            if dec.size and act_d.any():
+            if self.spec_enabled:
+                # fixed lock order, target pool THEN draft pool — every
+                # acquirer nests the draft inside the target, so the
+                # pair can never deadlock (graft-lint lock-order pass)
+                with self.draft_pool.lock():
+                    dnet = self.draft_pool.net
+                    dcarries = self.draft_pool.carries
+                    if pre.size and act_p.any():
+                        # mirrored prefill: the draft consumes the same
+                        # prompt stem (logits stay on device here too)
+                        _, dcarries = dnet.session_step(
+                            x, dcarries, active=act_p, valid=val)
+                    if dec.size and act_d.any():
+                        d_toks, d_probs, dcarries = \
+                            dnet.session_propose_window(
+                                tok0, dcarries, active=act_d,
+                                k=self.spec_k, temperature=temps,
+                                top_k=tks, top_p=tps, greedy=grd,
+                                keys=keys, offsets=offs, rewind=rew,
+                                pre_tokens=ptk, pre_valid=pvl)
+                        packed_d, carries = net.session_verify_window(
+                            tok0, carries, active=act_d, k=self.spec_k,
+                            draft_tokens=d_toks, draft_probs=d_probs,
+                            temperature=temps, top_k=tks, top_p=tps,
+                            greedy=grd, keys=keys, offsets=offs,
+                            budgets=buds, eos_ids=eos)
+                    self.draft_pool.swap_carries(dcarries)
+            elif dec.size and act_d.any():
                 toks_d, emits_d, carries = net.session_decode_window(
                     tok0, carries, active=act_d, k=K,
                     temperature=temps, top_k=tks, top_p=tps, greedy=grd,
                     keys=keys, offsets=offs, budgets=buds, eos_ids=eos)
             self.pool.swap_carries(carries)
         emit_n = {}
+        acc_n = {}
+        if packed_d is not None:
+            # ONE host sync per speculative window, after both locks are
+            # released: counts, the catch-up token and all emissions
+            # ride the verify's packed rows — the draft adds NO sync.
+            # graft: allow-sync(decode endpoint window readback — the
+            # one intended host sync per K-token window)
+            ph = np.asarray(packed_d)
+            wtoks = wdraft = wacc = 0
+            for i in dec:
+                s = int(slots_idx[i])
+                if not act_d[s]:
+                    continue
+                n = int(ph[s, 0])
+                emit_n[s] = n
+                # the last emitted token is the correction/bonus, never
+                # a draft proposal — accepted drafts are the n-1 before
+                acc = max(n - 1, 0)
+                acc_n[s] = acc
+                ys[i, 0] = n
+                ys[i, 1:1 + n] = ph[s, 2:2 + n]
+                sess = by_slot.get(s)
+                if sess is not None:
+                    # next window's draft entry bookkeeping (safe: this
+                    # was the session's one in-flight row)
+                    sess._spec_rewind = max(self.spec_k - n, 0)
+                    sess._spec_pre_valid = bool(n == self.spec_k + 1)
+                    sess._spec_pre_tok = int(ph[s, 1])
+                wtoks += n
+                wdraft += self.spec_k
+                wacc += acc
+                self._h_accept.observe(acc / self.spec_k)
+            self._c_windows.inc()
+            self._c_window_tokens.inc(wtoks)
+            self._c_draft_toks.inc(wdraft)
+            self._c_accepted.inc(wacc)
         if toks_d is not None:
             # device->host sync AFTER releasing the pool lock: the next
             # dispatch can enqueue its programs while we read this one
@@ -629,12 +840,12 @@ class DecodeSessionManager:
             self._c_shared.inc()
         if dtrace is not None:
             self._trace_windows(dtrace, slots_idx, phase, nvalid, emit_n,
-                                bucket, k,
+                                acc_n, bucket, k,
                                 (time.perf_counter() - t0) * 1e3)
         return ys
 
     def _trace_windows(self, dtrace, slots_idx, phase, nvalid,
-                       emit_n: dict, bucket: int, k: int,
+                       emit_n: dict, acc_n: dict, bucket: int, k: int,
                        dur_ms: float) -> None:
         """One `session.window` span per sampled row of this dispatch —
         the per-window leaf of the fan-in tree, parented on that trace's
@@ -663,8 +874,11 @@ class DecodeSessionManager:
                 dur_ms=dur_ms, session=sess.id, slot=sess.slot,
                 phase="decode" if decode else "prefill",
                 step=len(sess.generated),
-                win=int(self.fused_k if decode else nvalid[i]),
+                win=int((self.spec_k if self.spec_enabled
+                         else self.fused_k) if decode else nvalid[i]),
                 tokens=int(emit_n.get(s, 0)), bucket=bucket, rows=k,
+                spec=bool(self.spec_enabled and decode),
+                accepted=int(acc_n.get(s, 0)),
                 # graft: allow(GL701): span attribute reads one atomic
                 # str reference; a concurrent hot-swap may label one
                 # window with the outgoing kernel kind — harmless
@@ -704,8 +918,16 @@ class DecodeSessionManager:
 
     def _check_swap_compat(self, net):
         import jax
+        if self.spec_enabled and not (
+                hasattr(net, "spec_decode_capable")
+                and net.spec_decode_capable()):
+            raise IncompatibleSessionSwapError(
+                f"deploy candidate for {self.model!r} cannot rewind its "
+                f"decode caches (recurrent carries or rolling rings) — "
+                f"this manager speculates; rolling back")
         want = jax.eval_shape(
-            lambda: net.session_carries(self.pool.slots))
+            lambda: net.session_carries(self.pool.slots,
+                                        kv_dtype=self.kv_dtype))
         have = jax.eval_shape(lambda: self.pool.carries)
         if jax.tree_util.tree_structure(want) != \
                 jax.tree_util.tree_structure(have) or \
@@ -745,6 +967,18 @@ class DecodeSessionManager:
             "kernel_policy": self._kernel_policy(),
             "decode_loop": {"kind": self.loop_kind, "k": self.fused_k,
                             "reason": self._loop_reason},
+            "spec_decode": {
+                "enabled": self.spec_enabled, "k": self.spec_k,
+                "reason": self._spec_reason, "draft": self.draft_name,
+                "draft_tokens": int(self._c_draft_toks.value),
+                "accepted_tokens": int(self._c_accepted.value),
+                "acceptance_rate": (
+                    round(int(self._c_accepted.value)
+                          / int(self._c_draft_toks.value), 4)
+                    if int(self._c_draft_toks.value) else None),
+            },
+            "kv_dtype": {"kind": self.kv_dtype,
+                         "reason": self._kv_reason},
         }
 
     def _policy_brief(self) -> str:

@@ -132,6 +132,28 @@ def sample_token_lanes(probs, temperature, top_k, top_p, greedy, keys):
     p = probs.astype(jnp.float32)
     greedy_tok = jnp.argmax(p, axis=-1).astype(jnp.int32)
 
+    p = warp_probs_lanes(probs, temperature, top_k, top_p)
+
+    logp = jnp.where(p > 0.0, jnp.log(p), -jnp.inf)
+    drawn = jax.vmap(jax.random.categorical)(keys, logp).astype(jnp.int32)
+    return jnp.where(greedy, greedy_tok, drawn)
+
+
+def warp_probs_lanes(probs, temperature, top_k, top_p):
+    """The truncation half of :func:`sample_token_lanes`, factored out so
+    speculative decoding can reason about the *distribution* a stochastic
+    lane actually samples from (the rejection rule compares target and
+    draft probabilities AFTER temperature/top-k/top-p — warping first
+    and applying vanilla rejection sampling to the warped pair is the
+    standard distribution-preserving construction). Returns the warped
+    [S, V] probabilities, zeroed outside the truncation sets, NOT
+    renormalized except by temperature (the ops downstream are
+    scale-invariant, same as the sampler). Greedy lanes ignore this
+    entirely — they argmax the raw probabilities."""
+    import jax
+    import jax.numpy as jnp
+
+    p = probs.astype(jnp.float32)
     t = temperature[:, None]
     # temper in log space (softmax(log p / τ) == renormalized p^(1/τ)):
     # float32 underflows p^(1/τ) for cold τ long before float64 does, and
@@ -140,26 +162,103 @@ def sample_token_lanes(probs, temperature, top_k, top_p, greedy, keys):
     tempered = jax.nn.softmax(jnp.log(jnp.maximum(p, 1e-30)) / t, axis=-1)
     p = jnp.where(t == 1.0, p, tempered)
 
-    # top-k: rank of each token under a stable descending sort; exactly k
-    # survivors even under ties (first occurrence wins, like the numpy path)
+    # One stable descending sort serves both knobs. Top-k zeroes exactly
+    # the tail of the sorted row (ties: first occurrence wins, like the
+    # numpy path), which leaves the surviving values in sorted order — so
+    # the nucleus cumsum can run in the same space without re-sorting.
+    # Sorts dominate this function's cost and it runs per position in
+    # every decode dispatch, hence the one-sort formulation.
     order = jnp.argsort(-p, axis=-1)
-    ranks = jnp.argsort(order, axis=-1)
-    p = jnp.where(ranks < top_k[:, None], p, 0.0)
+    sorted_p = jnp.take_along_axis(p, order, axis=-1)
+    idx = jnp.arange(p.shape[-1])[None, :]
+    sorted_p = jnp.where(idx < top_k[:, None], sorted_p, 0.0)
 
     # top-p on the post-top-k mass: keep tokens whose preceding mass is
     # strictly below the threshold (the crossing token survives, so the
     # nucleus is never empty); top_p == 1.0 keeps every nonzero token
-    order = jnp.argsort(-p, axis=-1)
-    sorted_p = jnp.take_along_axis(p, order, axis=-1)
     csum = jnp.cumsum(sorted_p, axis=-1)
     keep_sorted = (csum - sorted_p) < top_p[:, None] * csum[:, -1:]
-    keep = jnp.take_along_axis(keep_sorted, jnp.argsort(order, axis=-1),
-                               axis=-1)
-    p = jnp.where(keep, p, 0.0)
+    kept_sorted = jnp.where(keep_sorted, sorted_p, 0.0)
+    # scatter back to vocabulary order (cheaper than inverting the
+    # permutation with another sort)
+    return jax.vmap(lambda o, v: jnp.zeros_like(v).at[o].set(v))(
+        order, kept_sorted)
 
-    logp = jnp.where(p > 0.0, jnp.log(p), -jnp.inf)
-    drawn = jax.vmap(jax.random.categorical)(keys, logp).astype(jnp.int32)
-    return jnp.where(greedy, greedy_tok, drawn)
+
+def spec_accept_lanes(p_raw, p_warp, q_warp, draft_toks, greedy, uniforms,
+                      extra_keys):
+    """On-device accept/reject for one speculative-decode window.
+
+    Inputs (S lanes, k draft tokens, V vocab):
+
+    - ``p_raw``   f32[S, k+1, V] — the target's RAW probabilities at each
+      chunk position (position i conditions on [t0, d_1..d_i])
+    - ``p_warp``  f32[S, k+1, V] — the same, after
+      :func:`warp_probs_lanes` (unnormalized is fine)
+    - ``q_warp``  f32[S, k, V]   — the draft's warped probabilities each
+      ``d_i`` was actually drawn from
+    - ``draft_toks`` i32[S, k]
+    - ``greedy``  bool[S]
+    - ``uniforms`` f32[S, k] — acceptance draws, from a stream
+      independent of both models' sampling streams
+    - ``extra_keys`` u32[S, 2] — per-lane key for the residual/bonus draw
+
+    Greedy lanes take the longest-prefix fast path: accept ``d_i`` while
+    it matches the target's raw argmax; the extra token is the target
+    argmax at the first mismatch (the bonus token when everything
+    matched). Stochastic lanes run the standard rejection rule — accept
+    ``d_i`` with probability ``min(1, p(d_i)/q(d_i))`` on the warped,
+    renormalized pair; on the first rejection the replacement is drawn
+    from ``normalize(max(p - q, 0))`` (falling back to ``p`` when the
+    residual has no mass); full acceptance draws the bonus token from
+    the target's last position. Either way every lane yields
+    ``n_acc`` accepted draft tokens plus exactly one extra token.
+
+    Returns ``(n_acc i32[S], extra i32[S])``.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    s, k1, _ = p_raw.shape
+    k = k1 - 1
+
+    def norm(p):
+        return p / jnp.maximum(p.sum(axis=-1, keepdims=True), 1e-30)
+
+    # --- greedy fast path: longest matching prefix against raw argmax
+    tgt_tok = jnp.argmax(p_raw, axis=-1).astype(jnp.int32)      # [S, k+1]
+    match = tgt_tok[:, :k] == draft_toks                        # [S, k]
+    acc_g = jnp.cumprod(match.astype(jnp.int32), axis=1)
+    n_acc_g = acc_g.sum(axis=1).astype(jnp.int32)
+    extra_g = jnp.take_along_axis(tgt_tok, n_acc_g[:, None],
+                                  axis=1)[:, 0]
+
+    # --- stochastic rejection rule on the warped, renormalized pair
+    pn = norm(p_warp)                                           # [S, k+1, V]
+    qn = norm(q_warp)                                           # [S, k, V]
+    p_d = jnp.take_along_axis(pn[:, :k, :], draft_toks[:, :, None],
+                              axis=2)[:, :, 0]                  # [S, k]
+    q_d = jnp.take_along_axis(qn, draft_toks[:, :, None],
+                              axis=2)[:, :, 0]                  # [S, k]
+    ok = uniforms * jnp.maximum(q_d, 1e-30) < p_d               # [S, k]
+    acc_s = jnp.cumprod(ok.astype(jnp.int32), axis=1)
+    n_acc_s = acc_s.sum(axis=1).astype(jnp.int32)
+    # residual at the first rejected position (q padded with zeros at k,
+    # so full acceptance falls through to "draw the bonus from p")
+    q_pad = jnp.concatenate([qn, jnp.zeros_like(qn[:, :1, :])], axis=1)
+    p_at = jnp.take_along_axis(pn, n_acc_s[:, None, None],
+                               axis=1)[:, 0, :]                 # [S, V]
+    q_at = jnp.take_along_axis(q_pad, n_acc_s[:, None, None],
+                               axis=1)[:, 0, :]
+    res = jnp.maximum(p_at - q_at, 0.0)
+    res = jnp.where((res.sum(axis=-1, keepdims=True) > 0.0), res, p_at)
+    logr = jnp.where(res > 0.0, jnp.log(res), -jnp.inf)
+    extra_s = jax.vmap(jax.random.categorical)(extra_keys,
+                                               logr).astype(jnp.int32)
+
+    n_acc = jnp.where(greedy, n_acc_g, n_acc_s)
+    extra = jnp.where(greedy, extra_g, extra_s)
+    return n_acc, extra
 
 
 def lane_param_arrays(params_list, vocab):

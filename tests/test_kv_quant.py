@@ -1,0 +1,286 @@
+"""Quantized KV cache storage: int8/fp8 carries with per-(token,
+kv-head) scales.
+
+What these pin:
+  * the kv_dtype policy lattice: native default (quantization is
+    opt-in), int8 honored, fp8 degrades to int8 off-TPU, env force wins
+  * quantized session carries: int8 caches + f32 scale rows, the
+    lockstep (non-per-slot) path refuses, unknown dtypes refuse
+  * round-trip error bounds: int8 decode output tracks the native
+    output within amax/254-per-element quantization noise
+  * a freed int8 slot NEVER leaks: finite-poison the caches AND scale
+    rows of a freed slot across ring wraparound, and the reused slot's
+    outputs still equal a clean pool bit-for-bit
+  * `rebind()` refuses dtype-incompatible deploys — live int8 caches
+    cannot migrate onto a native-dtype tree or vice versa
+  * pool accounting: slots_per_chip_factor reports the >= 2x memory
+    multiplier the ISSUE contract promises for int8
+  * the banded decode kernel's fused dequant (scale_k/scale_v block
+    loads) matches the dense dequantize-up-front oracle
+"""
+
+import numpy as np
+import pytest
+
+from test_decode_sessions import V, _make_net as _rolling_net
+from test_spec_decode import _make_net as _linear_net
+
+
+@pytest.fixture(scope="module")
+def net():
+    return _rolling_net()
+
+
+@pytest.fixture(scope="module")
+def lin_net():
+    return _linear_net()
+
+
+# ------------------------------------------------------ policy lattice
+class TestKVDtypePolicy:
+    def test_lattice(self, monkeypatch):
+        from deeplearning4j_tpu.ops.kernel_defaults import kv_dtype_policy
+        monkeypatch.delenv("DL4J_TPU_KV_DTYPE", raising=False)
+        assert kv_dtype_policy(record=False).kind == "native"
+        assert kv_dtype_policy("int8", record=False).kind == "int8"
+        # fp8 needs a TPU backend; CPU degrades to the portable int8
+        pol = kv_dtype_policy("fp8", record=False)
+        assert pol.kind == "int8"
+        assert "int8" in pol.reason or "fp8" in pol.reason
+        monkeypatch.setenv("DL4J_TPU_KV_DTYPE", "int8")
+        assert kv_dtype_policy("native", record=False).kind == "int8"
+        monkeypatch.setenv("DL4J_TPU_KV_DTYPE", "native")
+        assert kv_dtype_policy("int8", record=False).kind == "native"
+
+    def test_unknown_request_fails_fast(self, monkeypatch):
+        """An explicit-but-unknown dtype must fail the deploy, never
+        silently serve unquantized."""
+        from deeplearning4j_tpu.ops.kernel_defaults import kv_dtype_policy
+        monkeypatch.delenv("DL4J_TPU_KV_DTYPE", raising=False)
+        with pytest.raises(ValueError, match="unknown kv_dtype"):
+            kv_dtype_policy("int4", record=False)
+        monkeypatch.setenv("DL4J_TPU_KV_DTYPE", "int16")
+        with pytest.raises(ValueError, match="unknown kv_dtype"):
+            kv_dtype_policy(record=False)
+
+
+# -------------------------------------------------- carry construction
+class TestQuantizedCarries:
+    def test_int8_carries_have_scales(self, net):
+        import jax.numpy as jnp
+        carries = net.session_carries(2, kv_dtype="int8")
+        block = carries["layer2_transformerencoderblock"]["attn"]
+        assert block["cache_k"].dtype == jnp.int8
+        assert block["cache_v"].dtype == jnp.int8
+        assert block["scale_k"].dtype == jnp.float32
+        assert block["scale_k"].shape == block["cache_k"].shape[:3]
+        native = net.session_carries(2)
+        nblock = native["layer2_transformerencoderblock"]["attn"]
+        assert "scale_k" not in nblock
+        assert nblock["cache_k"].dtype == jnp.float32
+
+    def test_unknown_dtype_refused(self, net):
+        with pytest.raises(ValueError, match="unknown kv_dtype"):
+            net.session_carries(2, kv_dtype="int4")
+
+    def test_lockstep_path_stays_native(self, net):
+        # quantization is a session-pool feature; the model-global
+        # rnn_time_step carry must refuse it loudly
+        layer = next(l for l in net.layers if hasattr(l, "max_cache"))
+        with pytest.raises(ValueError, match="per_slot"):
+            layer.decode_carry(2, per_slot=False, kv_dtype="int8")
+
+
+# ----------------------------------------------------- round-trip error
+class TestInt8RoundTrip:
+    def _run(self, net, carries, slot, toks):
+        outs = []
+        S = 2
+        for t in toks:
+            x = np.zeros((S, 1, 1), np.float32)
+            x[slot, 0, 0] = t
+            act = np.zeros((S,), bool)
+            act[slot] = True
+            val = np.zeros((S, 1), np.float32)
+            val[slot] = 1.0
+            out, carries = net.session_step(x, carries, active=act,
+                                            valid=val)
+            outs.append(np.asarray(out)[slot, 0])
+        return np.stack(outs)
+
+    @pytest.mark.parametrize("builder", ["rolling", "linear"])
+    def test_outputs_track_native_within_bounds(self, net, lin_net,
+                                                builder):
+        """Per-element quantization error is <= amax/254 (round-to-
+        nearest at amax/127 step); through attention + softmax the
+        output probabilities must stay within a small additive band of
+        the native path, and the greedy argmax must not flip on this
+        well-separated toy net."""
+        m = net if builder == "rolling" else lin_net
+        toks = np.random.default_rng(5).integers(0, V, 24)
+        a = self._run(m, m.session_carries(2), 0, toks)
+        b = self._run(m, m.session_carries(2, kv_dtype="int8"), 0, toks)
+        assert np.abs(a - b).max() < 0.02, np.abs(a - b).max()
+        assert np.array_equal(a.argmax(-1), b.argmax(-1))
+
+
+# ------------------------------------------------- leakage under reuse
+class TestInt8WraparoundLeak:
+    def test_freed_slot_never_leaks_int8(self, net):
+        """The wraparound-reuse defense at int8: poison a freed slot's
+        quantized caches AND scale rows with finite garbage, reuse the
+        slot past ring wraparound, and require bit-equality with a
+        clean int8 pool — both the ring's visibility arithmetic and the
+        scale rows must mask the stale tenant."""
+        import jax
+        from deeplearning4j_tpu.serving.kv_pool import KVSlotPool
+
+        def run(pool, slot, toks):
+            outs = []
+            for t in toks:
+                x = np.zeros((pool.slots, 1, 1), np.float32)
+                x[slot, 0, 0] = t
+                act = np.zeros((pool.slots,), bool)
+                act[slot] = True
+                val = np.zeros((pool.slots, 1), np.float32)
+                val[slot] = 1.0
+                out, new = pool.net.session_step(
+                    x, pool.carries, active=act, valid=val)
+                with pool.lock():
+                    pool.swap_carries(new)
+                outs.append(np.asarray(out)[slot, 0])
+            return np.stack(outs)
+
+        rng = np.random.default_rng(7)
+        session_a = rng.integers(0, V, 40)   # wraps max_cache=16 rings
+        session_b = rng.integers(0, V, 12)
+
+        pool = KVSlotPool(net, 2, kv_dtype="int8")
+        slot = pool.alloc()
+        run(pool, slot, session_a)
+        pool.free(slot)
+
+        for leaf in jax.tree_util.tree_leaves(pool.carries):
+            leaf = np.asarray(leaf)
+            if leaf.ndim >= 1 and leaf.shape[0] == 2:
+                assert not np.any(leaf[slot]), "freed slot not reset"
+
+        def poison(c):
+            def p(a):
+                if getattr(a, "ndim", 0) < 3 or a.shape[0] != 2:
+                    return a
+                a = np.asarray(a).copy()
+                # int8 caches take extreme quantized garbage, scale
+                # rows huge finite multipliers — a leak would be loud
+                a[slot] = 127 if a.dtype == np.int8 else 7777.0
+                return a
+            return jax.tree_util.tree_map(p, c)
+
+        with pool.lock():
+            pool.swap_carries(poison(pool.carries))
+        assert pool.alloc() == slot
+        got = run(pool, slot, session_b)
+        assert np.isfinite(got).all(), "stale poisoned KV leaked in"
+        assert np.abs(got).max() <= 1.0
+
+        clean = KVSlotPool(net, 2, kv_dtype="int8")
+        s2 = clean.alloc()
+        want = run(clean, s2, session_b)
+        np.testing.assert_array_equal(got, want)
+
+
+# ----------------------------------------------------- rebind / deploy
+class TestRebindDtypeCompat:
+    def test_rebind_refuses_dtype_flip(self, net):
+        from deeplearning4j_tpu.serving.kv_pool import (
+            IncompatibleSessionSwapError, KVSlotPool,
+        )
+        pool = KVSlotPool(net, 2, kv_dtype="int8")
+        pool.rebind(_rolling_net(seed=5))         # same dtype: fine
+        with pytest.raises(IncompatibleSessionSwapError):
+            pool.rebind(_rolling_net(seed=5), kv_dtype="native")
+        native = KVSlotPool(net, 2)
+        with pytest.raises(IncompatibleSessionSwapError):
+            native.rebind(_rolling_net(seed=5), kv_dtype="int8")
+
+    def test_manager_deploy_keeps_kv_dtype(self, lin_net):
+        """Hot-swap through a quantized manager: the candidate's carries
+        are compat-checked AT the pool's kv_dtype, so a same-arch
+        candidate flips cleanly and the pool stays int8."""
+        from deeplearning4j_tpu.serving import (
+            ContinuousBatchingScheduler, ModelRegistry, ServingStats,
+        )
+        from deeplearning4j_tpu.serving.sessions import (
+            DecodeSessionManager,
+        )
+        registry = ModelRegistry()
+        registry.deploy("default", 1, lin_net, warm=False)
+        stats = ServingStats()
+        sched = ContinuousBatchingScheduler(registry, stats,
+                                            max_batch_size=8)
+        mgr = DecodeSessionManager(registry, sched, "default", slots=2,
+                                   prefill_chunk=4, kv_dtype="int8",
+                                   metrics=stats.registry)
+        try:
+            assert mgr.pool.kv_dtype == "int8"
+            sess = mgr.open_session([4, 5], max_tokens=6, greedy=True)
+            registry.deploy("default", 2, _linear_net(seed=7),
+                            feat_shape=(6, 1))
+            assert len(sess.result(timeout=120)) == 6
+            assert mgr.pool.kv_dtype == "int8"
+            snap = mgr.snapshot()
+            assert snap["slots"]["kv_dtype"] == "int8"
+        finally:
+            sched.shutdown()
+            registry.close()
+
+
+# ------------------------------------------------------- accounting
+class TestPoolAccounting:
+    def test_int8_slots_per_chip_factor(self, net):
+        from deeplearning4j_tpu.serving.kv_pool import KVSlotPool
+        d = KVSlotPool(net, 2, kv_dtype="int8").describe()
+        assert d["kv_dtype"] == "int8"
+        # the ISSUE contract: int8 KV multiplies slots per chip >= 2x
+        # (exact factor is 4*Dh/(Dh+4) on the cache bytes, diluted by
+        # the non-KV leaves of the carry tree)
+        assert d["slots_per_chip_factor"] >= 2.0
+        n = KVSlotPool(net, 2).describe()
+        assert n["kv_dtype"] == "native"
+        assert n["slots_per_chip_factor"] == 1.0
+        assert n["slot_bytes"] > d["slot_bytes"]
+
+
+# -------------------------------------------- fused dequant in the kernel
+class TestBandedQuantParity:
+    def _quantize(self, a):
+        amax = np.abs(a).max(axis=-1)
+        sc = np.where(amax > 0, amax / 127.0, 1.0)
+        q = np.clip(np.round(a / sc[..., None]), -127, 127)
+        return q.astype(np.int8), sc.astype(np.float32)
+
+    @pytest.mark.parametrize("rolling", [False, True])
+    def test_kernel_matches_dense_oracle(self, rolling):
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.ops.banded_attention import (
+            banded_decode_attention, decode_reference,
+        )
+        s, l, h, hkv, dh, w = 4, 8, 4, 2, 8, 4
+        rng = np.random.default_rng(11)
+        q = rng.standard_normal((s, h, dh)).astype(np.float32)
+        ck, sk = self._quantize(
+            rng.standard_normal((s, l, hkv, dh)).astype(np.float32))
+        cv, sv = self._quantize(
+            rng.standard_normal((s, l, hkv, dh)).astype(np.float32))
+        qpos = jnp.asarray([1, 3, 9, 15] if rolling else [0, 3, 5, 7],
+                           jnp.int32)
+        got = banded_decode_attention(
+            jnp.asarray(q), jnp.asarray(ck), jnp.asarray(cv), qpos,
+            qpos, window=w, rolling=rolling, block_l=4, interpret=True,
+            scale_k=jnp.asarray(sk), scale_v=jnp.asarray(sv))
+        want = decode_reference(
+            jnp.asarray(q), jnp.asarray(ck), jnp.asarray(cv), qpos,
+            qpos, w, rolling, dh ** -0.5, scale_k=jnp.asarray(sk),
+            scale_v=jnp.asarray(sv))
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-5, atol=2e-5)

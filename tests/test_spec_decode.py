@@ -1,0 +1,440 @@
+"""Speculative decoding: draft-propose + target-verify windows.
+
+What these pin:
+  * the on-device accept/reject (utils/sampling.spec_accept_lanes):
+    greedy is the longest-prefix fast path with a bonus token on full
+    acceptance, stochastic is the standard rejection rule — accept
+    d_i iff u_i * q(d_i) < p(d_i), replacement drawn from
+    normalize(max(p - q, 0)) — and the emitted-token marginal equals
+    the target distribution (the KS-style check)
+  * the hard parity contract: greedy spec decode emits the EXACT token
+    stream of the plain fused window, across prompts, draft quality
+    (self-draft, independent draft) and spec_k — acceptance rate is a
+    throughput knob, never a correctness knob
+  * draft cache bookkeeping survives every acceptance outcome: full
+    accept (catch-up write of d_k), partial accept (rewind), zero
+    accept (full rewind) — across consecutive windows
+  * EOS / budget / cancel / deadline land correctly with a draft in
+    flight, and both pools' slots come back clean
+  * session churn at fixed spec_k causes ZERO recompiles after warmup
+  * the spec_decode policy seam: env forces, capability degrade
+    (rolling rings / recurrent carries / missing draft), K bucketing,
+    and the kernel_dispatch_total{op="spec_decode"} counter
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.nn.layers.attention import (
+    PositionEmbeddingLayer, TransformerEncoderBlock,
+)
+from deeplearning4j_tpu.nn.layers.feedforward import EmbeddingSequenceLayer
+from deeplearning4j_tpu.nn.layers.recurrent import RnnOutputLayer
+from deeplearning4j_tpu.observe.watchdog import get_watchdog
+from deeplearning4j_tpu.optim.updaters import Adam
+
+V, T = 13, 6
+
+
+def _make_net(seed=0, emb=12, max_len=64, window=8, max_cache=64):
+    """Non-rolling decode stack: spec decode rewinds positions, which
+    rolling rings cannot honor (test_decode_sessions keeps the rolling
+    variant)."""
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-3))
+            .activation("identity")
+            .list(EmbeddingSequenceLayer(n_in=V, n_out=emb),
+                  PositionEmbeddingLayer(max_length=max_len),
+                  TransformerEncoderBlock(num_heads=2, causal=True,
+                                          window=window,
+                                          rolling_cache=False,
+                                          max_cache=max_cache),
+                  RnnOutputLayer(n_out=V, activation="softmax"))
+            .set_input_type(InputType.recurrent(1, T)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+@pytest.fixture(scope="module")
+def net():
+    return _make_net()
+
+
+@pytest.fixture(scope="module")
+def draft():
+    # independently initialized: a WRONG-but-valid draft, so acceptance
+    # is partial and rejection paths actually run
+    return _make_net(seed=3)
+
+
+def _plane(net, *, draft=None, spec_k=None, kv_dtype=None, slots=2,
+           chunk=4, fused_k=None):
+    from deeplearning4j_tpu.serving import (
+        ContinuousBatchingScheduler, ModelRegistry, ServingStats,
+    )
+    from deeplearning4j_tpu.serving.sessions import DecodeSessionManager
+
+    registry = ModelRegistry()
+    registry.deploy("default", 1, net, warm=False)
+    stats = ServingStats()
+    sched = ContinuousBatchingScheduler(registry, stats, max_batch_size=8)
+    mgr = DecodeSessionManager(registry, sched, "default", slots=slots,
+                               prefill_chunk=chunk, fused_k=fused_k,
+                               draft_net=draft, spec_k=spec_k,
+                               kv_dtype=kv_dtype, metrics=stats.registry)
+    return registry, sched, mgr
+
+
+def _run(net, prompt, *, draft=None, spec_k=None, fused_k=None,
+         max_tokens=10, greedy=True, seed=None, eos_id=None,
+         temperature=1.0):
+    registry, sched, mgr = _plane(net, draft=draft, spec_k=spec_k,
+                                  fused_k=fused_k)
+    try:
+        sess = mgr.open_session(prompt, max_tokens=max_tokens,
+                                greedy=greedy, seed=seed, eos_id=eos_id,
+                                temperature=temperature)
+        toks = sess.result(timeout=60)
+        return toks, mgr.snapshot()
+    finally:
+        sched.shutdown()
+        registry.close()
+
+
+# ------------------------------------------- on-device accept/reject
+class TestSpecAcceptLanes:
+    def _accept(self, p_raw, p_warp, q_warp, d_toks, greedy, uniforms,
+                seed=0):
+        import jax
+        import jax.numpy as jnp
+        from deeplearning4j_tpu.utils.sampling import spec_accept_lanes
+        S = p_raw.shape[0]
+        keys = jax.random.split(jax.random.PRNGKey(seed), S)
+        n_acc, extra = spec_accept_lanes(
+            jnp.asarray(p_raw, jnp.float32), jnp.asarray(p_warp,
+                                                         jnp.float32),
+            jnp.asarray(q_warp, jnp.float32),
+            jnp.asarray(d_toks, jnp.int32), jnp.asarray(greedy, bool),
+            jnp.asarray(uniforms, jnp.float32), keys)
+        return np.asarray(n_acc), np.asarray(extra)
+
+    def test_greedy_longest_prefix_and_bonus(self):
+        S, k = 4, 3
+        rng = np.random.default_rng(0)
+        p = rng.random((S, k + 1, V)).astype(np.float32)
+        p /= p.sum(-1, keepdims=True)
+        tgt = p.argmax(-1)                       # [S, k+1]
+        d = tgt[:, :k].copy()
+        # lane 0: full match -> n_acc=k, bonus = argmax at k
+        # lane 1: mismatch at 0; lane 2: mismatch at 1; lane 3: at 2
+        for lane, miss in ((1, 0), (2, 1), (3, 2)):
+            d[lane, miss] = (d[lane, miss] + 1) % V
+        n_acc, extra = self._accept(
+            p, p, p[:, :k], d, np.ones(S, bool), np.zeros((S, k)))
+        assert n_acc.tolist() == [k, 0, 1, 2]
+        for s in range(S):
+            assert extra[s] == tgt[s, n_acc[s]]
+
+    def test_stochastic_identical_dists_accept_everything(self):
+        # p == q: u * q(d) < p(d) for every u in [0,1) -> full accept
+        S, k = 8, 4
+        rng = np.random.default_rng(1)
+        q = rng.random((S, k, V)).astype(np.float32)
+        q /= q.sum(-1, keepdims=True)
+        p = np.concatenate([q, q[:, -1:]], axis=1)
+        d = rng.integers(0, V, (S, k))
+        n_acc, _ = self._accept(p, p, q, d, np.zeros(S, bool),
+                                rng.random((S, k)))
+        assert (n_acc == k).all()
+
+    def test_stochastic_zero_target_mass_rejects_to_residual(self):
+        # the target puts ZERO mass on the proposed token -> reject at 0
+        # and the replacement must come from p (residual = p off that
+        # token, but p is already zero there)
+        S, k = 16, 2
+        q = np.zeros((S, k, V), np.float32)
+        q[:, :, 0] = 1.0                          # draft proposes token 0
+        p = np.zeros((S, k + 1, V), np.float32)
+        p[:, :, 1:] = 1.0 / (V - 1)               # target: no mass on 0
+        d = np.zeros((S, k), np.int64)
+        n_acc, extra = self._accept(p, p, q, d, np.zeros(S, bool),
+                                    np.random.default_rng(2).random((S, k)))
+        assert (n_acc == 0).all()
+        assert (extra != 0).all()
+
+    def test_emitted_marginal_matches_target_ks(self):
+        """The distribution-preservation identity, KS-style: over many
+        lanes with one draft position each, the emitted token (accepted
+        proposal or residual replacement) must be distributed per the
+        TARGET distribution p — the whole point of the rejection rule."""
+        S, k = 20000, 1
+        rng = np.random.default_rng(7)
+        p1 = rng.random(V) + 0.05
+        p1 /= p1.sum()
+        q1 = rng.random(V) + 0.05
+        q1 /= q1.sum()
+        p = np.tile(p1, (S, k + 1, 1)).astype(np.float32)
+        q = np.tile(q1, (S, k, 1)).astype(np.float32)
+        d = rng.choice(V, size=(S, k), p=q1)
+        n_acc, extra = self._accept(p, p, q, d, np.zeros(S, bool),
+                                    rng.random((S, k)), seed=3)
+        emitted = np.where(n_acc >= 1, d[:, 0], extra)
+        freq = np.bincount(emitted, minlength=V) / S
+        # V=13 categories, S=2e4 draws: 4-sigma per-cell band is ~0.008
+        assert np.abs(freq - p1).max() < 0.015, (freq, p1)
+
+
+# -------------------------------------------------- the parity contract
+class TestSpecGreedyParity:
+    @pytest.mark.parametrize("prompt", [[5], [1, 2, 3],
+                                        [1, 2, 3, 4, 5, 6, 7, 8, 9]])
+    @pytest.mark.parametrize("spec_k", [4, 8])
+    def test_bit_exact_vs_plain_fused(self, net, draft, prompt, spec_k):
+        plain, _ = _run(net, prompt, fused_k=8)
+        spec, snap = _run(net, prompt, draft=draft, spec_k=spec_k)
+        assert snap["spec_decode"]["enabled"]
+        assert spec == plain, (prompt, spec_k)
+
+    def test_self_draft_full_acceptance(self, net):
+        """Draft == target: every proposal matches, every window fully
+        accepts (the distilled-draft upper bound), and the stream still
+        equals the plain fused stream. max_tokens = 2 full windows
+        (k accepted + 1 bonus each) so the budget never truncates a
+        window mid-acceptance."""
+        plain, _ = _run(net, [1, 2, 3], fused_k=8, max_tokens=10)
+        spec, snap = _run(net, [1, 2, 3], draft=net, spec_k=4,
+                          max_tokens=10)
+        assert spec == plain
+        sp = snap["spec_decode"]
+        assert sp["accepted_tokens"] == sp["draft_tokens"] > 0
+        assert sp["acceptance_rate"] == 1.0
+        # full acceptance at spec_k=4 covers max_tokens=10 in TWO
+        # windows of k+1=5 emitted tokens each
+        assert snap["dispatches"]["windows"] == 2
+
+    def test_wrong_draft_low_acceptance_still_exact(self, net, draft):
+        """An independently-initialized draft proposes mostly-wrong
+        tokens: rejection and rewind run constantly, and the output
+        still cannot drift from the target's greedy stream."""
+        plain, _ = _run(net, [2, 4, 6], fused_k=8, max_tokens=12)
+        spec, snap = _run(net, [2, 4, 6], draft=draft, spec_k=4,
+                          max_tokens=12)
+        assert spec == plain
+        sp = snap["spec_decode"]
+        assert sp["accepted_tokens"] < sp["draft_tokens"]
+
+    def test_stochastic_seeded_determinism(self, net, draft):
+        a, _ = _run(net, [1, 2], draft=draft, spec_k=4, greedy=False,
+                    seed=7, max_tokens=12)
+        b, _ = _run(net, [1, 2], draft=draft, spec_k=4, greedy=False,
+                    seed=7, max_tokens=12)
+        c, _ = _run(net, [1, 2], draft=draft, spec_k=4, greedy=False,
+                    seed=8, max_tokens=12)
+        assert a == b
+        assert len(a) == 12
+        assert a != c       # 12 tokens over V=13: collision ~ never
+
+
+# ------------------------------------------------- early exit / windows
+class TestSpecWindowEdges:
+    def test_eos_mid_window_stops_lane(self, net, draft):
+        free, _ = _run(net, [1, 2, 3], draft=draft, spec_k=8,
+                       max_tokens=8)
+        i = next(j for j in range(1, len(free))
+                 if free[j] not in free[:j])
+        assert i < len(free) - 1, "stream too repetitive for this net"
+        got, _ = _run(net, [1, 2, 3], draft=draft, spec_k=8,
+                      max_tokens=8, eos_id=free[i])
+        assert got == free[:i + 1]
+        assert got[-1] == free[i]
+
+    def test_budget_mid_window(self, net, draft):
+        got, _ = _run(net, [1, 2, 3], draft=draft, spec_k=8,
+                      max_tokens=5)
+        full, _ = _run(net, [1, 2, 3], draft=draft, spec_k=8,
+                       max_tokens=8)
+        assert len(got) == 5
+        assert got == full[:5]
+
+    def test_budget_headroom_enforced(self, net, draft):
+        """The verify transiently writes spec_k+1 entries past the
+        confirmed position; admission must refuse budgets that could
+        overflow the cache during that scatter."""
+        registry, sched, mgr = _plane(net, draft=draft, spec_k=8)
+        try:
+            limit = net.decode_limit()
+            with pytest.raises(ValueError, match="spec headroom"):
+                mgr.open_session([1] * 4, max_tokens=limit - 4)
+        finally:
+            sched.shutdown()
+            registry.close()
+
+    def test_cancel_frees_both_pools(self, net, draft):
+        import jax
+        registry, sched, mgr = _plane(net, draft=draft, spec_k=4)
+        try:
+            sess = mgr.open_session([1, 2, 3], max_tokens=40)
+            deadline = time.monotonic() + 30
+            while not sess.generated and time.monotonic() < deadline:
+                time.sleep(0.002)
+            assert sess.generated, "no window landed in 30s"
+            slot = sess.slot
+            sess.cancel()
+            sess.done.wait(30)
+            assert sess.outcome == "cancelled"
+            assert mgr.pool.describe()["in_use"] == 0
+            # the lockstep draft slot is zeroed for the next tenant
+            for leaf in jax.tree_util.tree_leaves(
+                    mgr.draft_pool.carries):
+                leaf = np.asarray(leaf)
+                if leaf.ndim >= 1 and leaf.shape[0] == mgr.pool.slots:
+                    assert not np.any(leaf[slot]), \
+                        "draft slot not reset on cancel"
+        finally:
+            sched.shutdown()
+            registry.close()
+
+    def test_deadline_expires_mid_stream(self, net, draft):
+        from deeplearning4j_tpu.serving.scheduler import (
+            DeadlineExceededError,
+        )
+        registry, sched, mgr = _plane(net, draft=draft, spec_k=4)
+        try:
+            sess = mgr.open_session([1, 2, 3], max_tokens=40,
+                                    deadline_ms=60000)
+            deadline = time.monotonic() + 30
+            while not sess.generated and time.monotonic() < deadline:
+                time.sleep(0.002)
+            assert sess.generated, "no window landed in 30s"
+            sess.deadline = time.monotonic() - 0.001
+            with pytest.raises(DeadlineExceededError):
+                sess.result(timeout=30)
+            assert sess.outcome == "expired"
+            assert mgr.pool.describe()["in_use"] == 0
+        finally:
+            sched.shutdown()
+            registry.close()
+
+
+# ---------------------------------------------- churn / compile budget
+class TestSpecChurn:
+    def test_zero_recompiles_after_warmup(self, net, draft):
+        registry, sched, mgr = _plane(net, draft=draft, spec_k=4)
+        try:
+            c0 = get_watchdog().compiles()
+            for i in range(4):
+                s1 = mgr.open_session([1 + i, 2, 3], max_tokens=3 + i,
+                                      greedy=(i % 2 == 0), seed=i,
+                                      temperature=0.7 + 0.1 * i)
+                s2 = mgr.open_session([2 + i], max_tokens=5,
+                                      top_k=3 + i, seed=10 + i)
+                s1.result(timeout=60), s2.result(timeout=60)
+            assert get_watchdog().compiles() == c0, \
+                "spec session churn caused recompiles at fixed spec_k"
+        finally:
+            sched.shutdown()
+            registry.close()
+
+
+# ------------------------------------------------------ policy seam
+class TestSpecDecodePolicy:
+    def test_lattice_and_bucketing(self, monkeypatch):
+        from deeplearning4j_tpu.ops.kernel_defaults import (
+            DECODE_K_BUCKETS, spec_decode_policy,
+        )
+        monkeypatch.delenv("DL4J_TPU_SPEC_DECODE", raising=False)
+        monkeypatch.delenv("DL4J_TPU_DRAFT_K", raising=False)
+        pol = spec_decode_policy(record=False)
+        assert pol.kind == "spec" and pol.k in DECODE_K_BUCKETS
+        assert spec_decode_policy(3, record=False).k == 4   # bucketed up
+        assert spec_decode_policy(capable=False,
+                                  record=False).kind == "plain"
+        monkeypatch.setenv("DL4J_TPU_SPEC_DECODE", "off")
+        assert spec_decode_policy(8, record=False).kind == "plain"
+        monkeypatch.setenv("DL4J_TPU_SPEC_DECODE", "on")
+        assert spec_decode_policy(8, record=False).kind == "spec"
+        # forced on but structurally impossible still degrades
+        pol = spec_decode_policy(8, capable=False, record=False)
+        assert pol.kind == "plain"
+        monkeypatch.delenv("DL4J_TPU_SPEC_DECODE", raising=False)
+        monkeypatch.setenv("DL4J_TPU_DRAFT_K", "2")
+        assert spec_decode_policy(8, record=False).k == 2
+
+    def test_spec_decode_capable(self, net):
+        from test_decode_sessions import _make_net as _rolling_net
+        assert net.spec_decode_capable()
+        assert not _rolling_net().spec_decode_capable()
+
+    def test_rolling_target_degrades_to_plain(self, draft):
+        """A rolling-ring target cannot rewind: the manager must fall
+        back to the plain fused window and still serve."""
+        from test_decode_sessions import _make_net as _rolling_net
+        rolling = _rolling_net()
+        registry, sched, mgr = _plane(rolling, draft=draft, spec_k=4)
+        try:
+            assert not mgr.spec_enabled
+            assert mgr.draft_pool is None
+            sess = mgr.open_session([1, 2, 3], max_tokens=6, greedy=True)
+            assert len(sess.result(timeout=60)) == 6
+        finally:
+            sched.shutdown()
+            registry.close()
+
+    def test_no_draft_means_plain(self, net):
+        registry, sched, mgr = _plane(net, fused_k=4)
+        try:
+            assert not mgr.spec_enabled
+            assert mgr.snapshot()["spec_decode"]["enabled"] is False
+        finally:
+            sched.shutdown()
+            registry.close()
+
+
+# --------------------------------------------------- metrics / registry
+class TestSpecObservability:
+    def test_counters_and_registry_entries(self, net):
+        registry, sched, mgr = _plane(net, draft=net, spec_k=4)
+        try:
+            assert "default@draft" in registry.names()
+            sess = mgr.open_session([1, 2, 3], max_tokens=10,
+                                    greedy=True)
+            sess.result(timeout=60)
+            reg = mgr.metrics
+            drafted = reg.counter("draft_tokens_total",
+                                  model="default").value
+            accepted = reg.counter("accepted_tokens_total",
+                                   model="default").value
+            # two untruncated windows: k accepted + 1 bonus each
+            assert drafted == 8 and accepted == 8
+            # the policy verdicts are mirrored onto the server registry
+            assert reg.counter("kernel_dispatch_total", op="spec_decode",
+                               impl="spec").value >= 1
+            assert reg.counter("kernel_dispatch_total", op="kv_dtype",
+                               impl="native").value >= 1
+            snap = mgr.snapshot()
+            assert snap["spec_decode"]["draft"] == "default@draft"
+            assert snap["slots"]["kv_dtype"] == "native"
+        finally:
+            sched.shutdown()
+            registry.close()
+
+    def test_hot_swap_refuses_unrewindable_candidate(self, net):
+        """Deploying a rolling-ring candidate onto a speculating manager
+        must roll back — live sessions keep the rewindable version."""
+        from test_decode_sessions import _make_net as _rolling_net
+        from deeplearning4j_tpu.serving.registry import (
+            DeployRolledBackError,
+        )
+        registry, sched, mgr = _plane(net, draft=net, spec_k=4)
+        try:
+            with pytest.raises(DeployRolledBackError):
+                registry.deploy("default", 2, _rolling_net(seed=9),
+                                feat_shape=(T, 1))
+            sess = mgr.open_session([1, 2], max_tokens=4, greedy=True)
+            assert len(sess.result(timeout=60)) == 4
+        finally:
+            sched.shutdown()
+            registry.close()

@@ -261,12 +261,31 @@ def render_bench(path: str, *, mode: str = "", width: int = 40) -> str:
             lines.append(f"  value {spark(vals, width)} "
                          f"{_fmt(vals[-1])} {unit}{trend}")
         for extra in ("mfu", "ttft_p99_ms", "itl_p99_ms",
-                      "continuous_p99_ms", "opt_state_shard_factor"):
+                      "continuous_p99_ms", "opt_state_shard_factor",
+                      "spec_tokens_per_s", "spec_acceptance_rate",
+                      "spec_speedup_vs_stepwise"):
             evals = [r[extra] for r in rs
                      if isinstance(r.get(extra), (int, float))]
             if evals:
                 lines.append(f"  {extra:22} {spark(evals, width)} "
                              f"{_fmt(evals[-1])}")
+        # the spec/kv matrix from the latest run, one line per leg
+        matrix = last.get("spec_matrix")
+        if isinstance(matrix, list) and matrix:
+            lines.append("  spec/kv matrix (latest run):")
+            for leg in matrix:
+                tag = (f"{'spec' if leg.get('spec') else 'plain'}"
+                       f"/{leg.get('kv', '?'):6}")
+                acc = leg.get("acceptance_rate")
+                slots = leg.get("slots_factor")
+                lines.append(
+                    f"    {tag} k={leg.get('k')}: "
+                    f"{_fmt(leg.get('tokens_per_s'))} tok/s"
+                    + (f", acceptance {_fmt(acc)}"
+                       if isinstance(acc, (int, float)) else "")
+                    + (f", {_fmt(slots)}x slots/chip"
+                       if isinstance(slots, (int, float))
+                       and slots != 1.0 else ""))
         if last.get("error"):
             lines.append("  last run FAILED (see its BENCH_*.json)")
     return "\n".join(lines) + "\n"

@@ -33,7 +33,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 BASELINE_PATH = os.path.join(os.path.dirname(__file__), "..",
                              ".graftperf-baseline.json")
-WORKLOAD_VERSION = 5
+WORKLOAD_VERSION = 6
 
 # Default slack written into a fresh baseline: zero extra compiles (a
 # new program IS the regression being hunted) and half a sync of noise
@@ -61,7 +61,16 @@ DEFAULT_BUDGETS = {"extra_compiles_per_owner": 0,
                    # compiles NOTHING after the manager's warmup
                    # (PERF_NOTES) — both are contracts, not budgets
                    "extra_decode_syncs_per_window": 0.5,
-                   "extra_decode_compiles": 0}
+                   "extra_decode_compiles": 0,
+                   # speculative decode keeps BOTH fused-window
+                   # contracts — one host sync per window (the packed
+                   # verify readback) and zero churn compiles — and the
+                   # deterministic truncated-draft workload must keep
+                   # greedy acceptance above this floor (a drop means
+                   # the verify/rewind bookkeeping broke, not the draft)
+                   "extra_spec_syncs_per_window": 0.5,
+                   "extra_spec_compiles": 0,
+                   "min_spec_acceptance_rate": 0.6}
 
 
 def run_workload() -> dict:
@@ -277,6 +286,83 @@ def run_workload() -> dict:
             sched.shutdown()
             registry.close()
 
+        # --- spec-decode leg: draft-proposed windows through the one-
+        # dispatch verify. Same two fused-window contracts (one host
+        # sync per window, zero churn compiles) plus an acceptance-rate
+        # floor on a deterministic truncated-draft pair: the target is
+        # a 2-block non-rolling net with its upper block's residual
+        # write-backs zeroed (exact identity under pre-norm), the draft
+        # the 1-block prefix sharing the same weights — so greedy
+        # proposals match the target unless the verify bookkeeping
+        # (pos rewind, catch-up token, budget cuts) corrupts state.
+        import jax.numpy as jnp
+
+        def _spec_net(blocks):
+            layers = [EmbeddingSequenceLayer(n_in=DV, n_out=16),
+                      PositionEmbeddingLayer(max_length=128)]
+            for _ in range(blocks):
+                layers.append(TransformerEncoderBlock(
+                    num_heads=2, causal=True, window=8,
+                    rolling_cache=False, max_cache=32))
+            layers.append(RnnOutputLayer(n_out=DV, activation="softmax"))
+            conf = (NeuralNetConfiguration.builder().seed(0)
+                    .updater(Adam(1e-3)).activation("identity")
+                    .list(*layers)
+                    .set_input_type(InputType.recurrent(1, 4)).build())
+            return MultiLayerNetwork(conf).init()
+
+        tnet, drnet = _spec_net(2), _spec_net(1)
+        top = tnet.params_tree["layer3_transformerencoderblock"]
+        for key in ("attn_Wo", "attn_b", "ffn_w2", "ffn_b2"):
+            top[key] = jnp.zeros_like(top[key])
+        for name, params in drnet.params_tree.items():
+            src = ("layer4_rnnoutputlayer"
+                   if name == "layer3_rnnoutputlayer" else name)
+            drnet.params_tree[name] = tnet.params_tree[src]
+        registry = ModelRegistry()
+        registry.deploy("default", 1, tnet, warm=False)
+        stats = ServingStats()
+        sched = ContinuousBatchingScheduler(registry, stats,
+                                            max_batch_size=8)
+        spec = None
+        try:
+            mgr = DecodeSessionManager(registry, sched, "default",
+                                       slots=2, prefill_chunk=4,
+                                       draft_net=drnet, spec_k=K,
+                                       metrics=stats.registry)
+            mgr.open_session([1, 2, 3], max_tokens=10,
+                             greedy=True).result(timeout=60)
+            before = mgr.snapshot()["dispatches"]
+            compiles_warm = get_watchdog().snapshot()["total_compiles"]
+            mon = HostSyncMonitor().install()
+            try:
+                for wave in range(2):
+                    ss = [mgr.open_session([1 + 2 * wave + i, 2, 3, 4,
+                                            5],
+                                           max_tokens=10, greedy=True)
+                          for i in range(2)]
+                    for s in ss:
+                        s.result(timeout=60)
+            finally:
+                mon.uninstall()
+            snap_after = mgr.snapshot()
+            after = snap_after["dispatches"]
+            windows = after["windows"] - before["windows"]
+            spec = {
+                "spec_k": K,
+                "windows": windows,
+                "syncs_per_window": round(mon.syncs / windows, 3)
+                if windows else None,
+                "extra_compiles":
+                    get_watchdog().snapshot()["total_compiles"]
+                    - compiles_warm,
+                "acceptance_rate":
+                    snap_after["spec_decode"]["acceptance_rate"],
+            }
+        finally:
+            sched.shutdown()
+            registry.close()
+
         # --- sharded fit: the GSPMD spine (data-sharded batch, replica-
         # sharded Adam moments). Placement regressions show up here as
         # extra syncs (collective fell back to host), extra
@@ -335,6 +421,7 @@ def run_workload() -> dict:
         "traced": traced,
         "series": series,
         "decode": decode,
+        "spec": spec,
         "sharded": sharded,
     }
 
@@ -427,6 +514,36 @@ def compare(baseline: dict, measured: dict) -> list:
                 f"{meas_d.get('extra_compiles')} program(s) after "
                 f"warmup (budget +{d_budget}) — the fixed-shape decode "
                 f"contract: churn at a fixed K never recompiles")
+    # spec-decode leg: only gated once a baseline recorded it
+    if baseline.get("spec"):
+        base_s = baseline["spec"]
+        meas_s = measured.get("spec") or {}
+        s_limit = (base_s.get("syncs_per_window") or 0.0) + \
+            budgets["extra_spec_syncs_per_window"]
+        if (meas_s.get("syncs_per_window") or 0.0) > s_limit:
+            breaches.append(
+                f"spec-decode syncs/window "
+                f"{meas_s.get('syncs_per_window')} vs baseline "
+                f"{base_s.get('syncs_per_window')} (budget "
+                f"+{budgets['extra_spec_syncs_per_window']}) — "
+                f"speculative decode never adds a host sync per window "
+                f"by contract (PERF_NOTES); draft propose + target "
+                f"verify must share the one packed readback")
+        s_budget = budgets["extra_spec_compiles"]
+        if meas_s.get("extra_compiles", 0) > s_budget:
+            breaches.append(
+                f"spec-decode session churn compiled "
+                f"{meas_s.get('extra_compiles')} program(s) after "
+                f"warmup (budget +{s_budget}) — propose/verify shapes "
+                f"are fixed by (S, k); churn never recompiles")
+        floor = budgets["min_spec_acceptance_rate"]
+        rate = meas_s.get("acceptance_rate")
+        if rate is not None and rate < floor:
+            breaches.append(
+                f"spec-decode acceptance rate {rate} < floor {floor} "
+                f"on the deterministic truncated-draft workload — the "
+                f"draft IS the target's lower half here, so a low rate "
+                f"means verify/rewind bookkeeping corrupted lane state")
     # sharded-spine leg: only gated once a baseline recorded it
     base_sh = baseline.get("sharded")
     if base_sh:
@@ -488,6 +605,12 @@ def diff(baseline: dict, measured: dict) -> list:
         m = (measured.get("decode") or {}).get(key)
         if b != m:
             out.append(f"  decode.{key}: {b} -> {m}")
+    for key in ("syncs_per_window", "extra_compiles",
+                "acceptance_rate"):
+        b = (baseline.get("spec") or {}).get(key)
+        m = (measured.get("spec") or {}).get(key)
+        if b != m:
+            out.append(f"  spec.{key}: {b} -> {m}")
     return out
 
 
