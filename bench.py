@@ -762,6 +762,14 @@ def _append_history(mode, summary):
             v = summary["spec"].get(key)
             if v is not None:
                 row["spec_" + key] = v
+    # the shared-prefix cache trends as flat prefix_* scalars (the
+    # dash sparkline reads prefix_hit_rate / prefix_ttft_speedup)
+    if isinstance(summary.get("prefix"), dict):
+        for key in ("ttft_speedup", "hit_rate", "cow_forks",
+                    "evicted_pages", "no_overlap_ttft_ratio"):
+            v = summary["prefix"].get(key)
+            if v is not None:
+                row["prefix_" + key] = v
     for k, sub in (("ttft_p99_ms", ("ttft_ms", "p99")),
                    ("itl_p99_ms", ("itl_ms", "p99")),
                    ("continuous_p99_ms", ("modes", "continuous",
@@ -1390,6 +1398,143 @@ def _serving_decode_main():
             }
         return leg, probe, decode, trace_block
 
+    def run_prefix_leg(label, *, cache_on, overlap):
+        """One shared-prefix TTFT leg: a NON-rolling (pageable) net,
+        one donor stream priming the radix index, then the closed-loop
+        clients replaying prompts that share the donor's head. With
+        `overlap` the clients reuse a long common stem (distinct
+        tails, so every admission may CoW-fork once); without it every
+        prompt is fresh (the zero-regression control). `cache_on`
+        toggles DL4J_TPU_PREFIX_CACHE, so warm-vs-cold is the same
+        binary, same workload, same shapes — only the radix differs.
+        A small prefill chunk (4) keeps TTFT prefill-dominated, which
+        is what the cache removes; decode windows are identical."""
+        p_len = int(os.environ.get("BENCH_DECODE_PAGE_LEN", "8"))
+        p_prompt = int(os.environ.get("BENCH_DECODE_PREFIX_PROMPT",
+                                      "240"))
+        # page-aligned tail: divergence lands exactly on a page
+        # boundary, so the whole shared stem is reusable full pages
+        p_tail = p_len if overlap else 0
+        p_chunk = 2
+        p_tokens = 8
+        p_cache = p_prompt + 2 * p_tokens
+        base = [(i % (V - 1)) + 1 for i in range(p_prompt)]
+        prev = os.environ.pop("DL4J_TPU_PREFIX_CACHE", None)
+        os.environ["DL4J_TPU_PREFIX_CACHE"] = ("on" if cache_on
+                                               else "off")
+        try:
+            # a long-prompt variant of the bench net: non-rolling (the
+            # pageable shape) with a cache big enough that cold prefill
+            # dominates TTFT — the regime the radix index targets
+            conf = (NeuralNetConfiguration.builder().seed(0)
+                    .updater(Adam(1e-3)).activation("identity")
+                    .list(EmbeddingSequenceLayer(n_in=V, n_out=32),
+                          PositionEmbeddingLayer(max_length=512),
+                          TransformerEncoderBlock(
+                              num_heads=4, causal=True, window=32,
+                              rolling_cache=False, max_cache=p_cache),
+                          RnnOutputLayer(n_out=V,
+                                         activation="softmax"))
+                    .set_input_type(InputType.recurrent(1, p_chunk))
+                    .build())
+            net = MultiLayerNetwork(conf).init()
+            srv = InferenceServer(net, port=0, decode_slots=clients,
+                                  decode_prefill_chunk=p_chunk,
+                                  decode_fused_k=primary_k,
+                                  decode_page_len=p_len,
+                                  max_batch_size=max(8, clients),
+                                  queue_capacity=max(64, 8 * clients))
+            port = srv.start()
+            base_url = f"http://127.0.0.1:{port}"
+            rng = np.random.default_rng(7)
+            lock = threading.Lock()
+            ttfts, toks, errors = [], [0], []
+
+            def stream_one(prompt_ids):
+                req = urllib.request.Request(
+                    base_url + "/generate",
+                    data=json.dumps({"prompt_ids": prompt_ids,
+                                     "max_tokens": p_tokens,
+                                     "greedy": True}).encode(),
+                    headers={"Content-Type": "application/json"})
+                t0 = time.perf_counter()
+                first, n = None, 0
+                with urllib.request.urlopen(req, timeout=120) as r:
+                    for line in r:
+                        line = line.decode().strip()
+                        if not line.startswith("data: "):
+                            continue
+                        ev = json.loads(line[6:])
+                        if "token" in ev:
+                            if first is None:
+                                first = (time.perf_counter() - t0) * 1e3
+                            n += 1
+                        elif "error" in ev:
+                            raise RuntimeError(ev["error"])
+                return first, n
+
+            def follower_prompt(uid):
+                if overlap:
+                    tail = ((rng.integers(1, V, p_tail) + uid) % (V - 1)
+                            + 1)
+                    return base[:p_prompt - p_tail] + tail.tolist()
+                return ((rng.integers(0, p_prompt, p_prompt) + uid)
+                        % (V - 1) + 1).tolist()
+
+            def client(i):
+                try:
+                    for rd in range(rounds):
+                        first, n = stream_one(
+                            follower_prompt(i * 1000 + rd))
+                        if first is None or n != p_tokens:
+                            raise RuntimeError(
+                                f"short stream: {n}/{p_tokens}")
+                        with lock:
+                            ttfts.append(first)
+                            toks[0] += n
+                except BaseException as e:
+                    with lock:
+                        errors.append(f"{type(e).__name__}: {e}")
+
+            # donor pass primes the radix (and, cache-off, is simply
+            # one more cold stream — identical work either way)
+            stream_one(base)
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(clients)]
+            t_p = time.monotonic()
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.monotonic() - t_p
+            with urllib.request.urlopen(base_url + "/metrics",
+                                        timeout=10) as r:
+                metrics = json.loads(r.read())
+            srv.stop()
+            pc = metrics["decode"]["default"].get("prefix_cache") or {}
+            return {
+                "label": label,
+                "cache": "on" if cache_on else "off",
+                "overlap_frac": (round(1 - p_tail / p_prompt, 3)
+                                 if overlap else 0.0),
+                "prompt_len": p_prompt,
+                "page_len": p_len,
+                "prefill_chunk": p_chunk,
+                "ttft_ms": {"p50": pct(ttfts, 0.50),
+                            "p99": pct(ttfts, 0.99)},
+                "tokens_per_s": round(toks[0] / wall, 2) if wall
+                else None,
+                "prefix_cache": {k: pc.get(k) for k in (
+                    "enabled", "hit_rate", "hit_tokens", "cow_forks",
+                    "evicted_pages", "cached_pages")},
+                "errors": errors,
+            }
+        finally:
+            if prev is None:
+                os.environ.pop("DL4J_TPU_PREFIX_CACHE", None)
+            else:
+                os.environ["DL4J_TPU_PREFIX_CACHE"] = prev
+
     primary_k = ks[-1]
     legs, probes = [], {}
     decode_primary, trace_block = None, None
@@ -1420,6 +1565,18 @@ def _serving_decode_main():
             spec_probes[(use_spec, kv)] = probe
             if use_spec and kv == "native":
                 spec_decode_native = dec
+
+    # --- shared-prefix TTFT legs: warm (radix on) vs cold (radix off)
+    # over the same ~92%-overlap workload, plus a no-overlap control
+    # with the cache ON (the zero-regression contract: an enabled but
+    # never-hit cache must not tax admission).
+    prefix_legs = None
+    if os.environ.get("BENCH_DECODE_PREFIX", "1") != "0":
+        prefix_legs = [
+            run_prefix_leg("warm-shared", cache_on=True, overlap=True),
+            run_prefix_leg("cold-shared", cache_on=False, overlap=True),
+            run_prefix_leg("no-overlap", cache_on=True, overlap=False),
+        ]
 
     by_k = {leg["fused_k"]: leg for leg in legs}
     primary = by_k[primary_k]
@@ -1479,6 +1636,31 @@ def _serving_decode_main():
             "server_decode": spec_decode_native,
         }
         out["errors"] += [e for leg in spec_legs for e in leg["errors"]]
+    if prefix_legs:
+        warm, cold, noov = prefix_legs
+        w50 = (warm["ttft_ms"]["p50"] or 0)
+        c50 = (cold["ttft_ms"]["p50"] or 0)
+        n50 = (noov["ttft_ms"]["p50"] or 0)
+        out["prefix"] = {
+            "page_len": warm["page_len"],
+            "overlap_frac": warm["overlap_frac"],
+            "ttft_ms_warm_p50": w50 or None,
+            "ttft_ms_cold_p50": c50 or None,
+            "ttft_speedup": round(c50 / w50, 2) if w50 else None,
+            # the headline contract: >=5x TTFT at >=80% prompt overlap
+            "ttft_speedup_target_met": (w50 > 0 and c50 / w50 >= 5.0),
+            "hit_rate": warm["prefix_cache"].get("hit_rate"),
+            "hit_tokens": warm["prefix_cache"].get("hit_tokens"),
+            "cow_forks": warm["prefix_cache"].get("cow_forks"),
+            "evicted_pages": noov["prefix_cache"].get("evicted_pages"),
+            # no-overlap, cache ON vs cache OFF: ~1.0 means the radix
+            # probe costs nothing when it never hits
+            "no_overlap_ttft_ratio": (round(n50 / c50, 2)
+                                      if c50 else None),
+            "legs": prefix_legs,
+        }
+        out["errors"] += [e for leg in prefix_legs
+                          for e in leg["errors"]]
     dev = jax.devices()[0]
     out["device"] = getattr(dev, "device_kind", str(dev))
     out["platform"] = dev.platform
@@ -1506,6 +1688,12 @@ def _decode_doc_line(out) -> str:
                  f"acceptance {sp['acceptance_rate']}); int8 KV: "
                  f"{sp['int8_slots_per_chip_factor']}x slots/chip at "
                  f"{sp['tokens_per_s_int8']} tok/s")
+    pf = out.get("prefix")
+    if pf:
+        line += (f"; prefix cache: {pf['ttft_speedup']}x TTFT p50 at "
+                 f"{pf['overlap_frac']} overlap (hit rate "
+                 f"{pf['hit_rate']}, no-overlap ratio "
+                 f"{pf['no_overlap_ttft_ratio']})")
     return line
 
 

@@ -791,7 +791,9 @@ class MultiLayerNetwork(SeqCtxJitCache, SeqCtxSolverCache):
         return _decode_limit(
             l for l in self.layers if hasattr(l, "decode_carry"))
 
-    def session_carries(self, slots: int, kv_dtype: Optional[str] = None):
+    def session_carries(self, slots: int, kv_dtype: Optional[str] = None,
+                        page_len: Optional[int] = None,
+                        pages: Optional[int] = None):
         """Batched slot-indexed decode carries for `slots` independent
         sessions: attention layers get PER-SLOT position vectors
         (`decode_carry(per_slot=True)`), recurrent layers their h/c
@@ -802,7 +804,16 @@ class MultiLayerNetwork(SeqCtxJitCache, SeqCtxSolverCache):
         `kv_dtype` ("native"/None, "int8", "fp8") selects the attention
         caches' storage dtype — quantized carries gain per-(token,
         kv-head) scale rows next to each cache (see
-        `MultiHeadAttention.decode_carry`)."""
+        `MultiHeadAttention.decode_carry`).
+
+        `page_len` switches every attention cache to the PAGED layout
+        (fixed [pages, page_len, Hkv, Dh] block pools + per-slot page
+        tables — the prefix-cache storage; see `decode_carry`). One
+        logical page id must mean the same physical row in EVERY layer's
+        pool, so paged mode requires a uniform `max_cache` across decode
+        layers (`prefix_cache_capable` checks the same). `pages`
+        defaults to `slots * max_cache / page_len` per layer — the
+        monolithic layout's exact memory."""
         self._check_init()
         decode = [l for l in self.layers if hasattr(l, "decode_carry")]
         rnn = [l for l in self.layers if _is_recurrent(l)]
@@ -817,8 +828,22 @@ class MultiLayerNetwork(SeqCtxJitCache, SeqCtxSolverCache):
                     f"session decoding is causal left-to-right; layer "
                     f"{l.name!r} ({type(l).__name__}) cannot stream")
         self._validate_causal_decode(decode, what="session decoding")
+        if page_len is not None:
+            caches = {l.max_cache for l in decode
+                      if hasattr(l, "max_cache")}
+            if len(caches) > 1:
+                raise ValueError(
+                    f"paged session carries need a uniform max_cache "
+                    f"across decode layers (one logical page id = one "
+                    f"physical row in every layer's pool); got {sorted(caches)}")
+            if pages is None and caches:
+                pages = slots * (next(iter(caches)) // page_len)
         carries = {l.name: l.decode_carry(slots, self.dtype, per_slot=True,
-                                          kv_dtype=kv_dtype)
+                                          kv_dtype=kv_dtype,
+                                          page_len=page_len, pages=pages)
+                   if page_len is not None else
+                   l.decode_carry(slots, self.dtype, per_slot=True,
+                                  kv_dtype=kv_dtype)
                    for l in decode}
         for l in rnn:
             carries[l.name] = l.initial_carry(slots, self.dtype)
@@ -839,6 +864,46 @@ class MultiLayerNetwork(SeqCtxJitCache, SeqCtxSolverCache):
         if not decode:
             return False
         return not any(getattr(l, "rolling_cache", False) for l in decode)
+
+    def prefix_cache_capable(self) -> bool:
+        """Can this net's session carries run PAGED (the prefix-cache
+        storage)? Pages are position-addressed blocks, so the same
+        rewind argument as `spec_decode_capable` applies (no recurrent
+        carries, no rolling rings — both hold state a shared page cannot
+        represent), plus one structural condition: every decode layer's
+        `max_cache` must agree, because one logical page id must mean
+        the same physical row in every layer's block pool."""
+        if not self.spec_decode_capable():
+            return False
+        caches = {l.max_cache for l in self.layers
+                  if hasattr(l, "decode_carry") and hasattr(l, "max_cache")}
+        return len(caches) == 1
+
+    _PAGE_POOL_KEYS = ("cache_k", "cache_v", "scale_k", "scale_v")
+
+    @classmethod
+    def _lane_merge(cls, old_tree, new_tree, act):
+        """Revert inactive lanes' carry writes: slot-indexed leaves get
+        a per-lane `where`. PAGED cache leaves (physical page pools —
+        leading dim is pages, shared across slots) pass through
+        untouched instead: a slot mask cannot address a page pool, and
+        it does not need to — every paged write path is valid-masked at
+        the scatter (invalid/inactive targets push out of range and
+        `mode="drop"` discards them), so an inactive lane never dirtied
+        a page in the first place."""
+        paged = any(
+            getattr(p[-1], "key", None) == "page_table"
+            for p, _ in jax.tree_util.tree_leaves_with_path(new_tree))
+
+        def lane(path, old, nw):
+            if paged and getattr(path[-1], "key", None) \
+                    in cls._PAGE_POOL_KEYS:
+                return nw
+            a = act.reshape(
+                (-1,) + (1,) * (getattr(nw, "ndim", 1) - 1))
+            return jnp.where(a, nw, old)
+
+        return jax.tree_util.tree_map_with_path(lane, old_tree, new_tree)
 
     def session_step(self, x, carries, *, active=None, valid=None):
         """One slot-indexed decode step: carries and per-slot positions
@@ -868,11 +933,7 @@ class MultiLayerNetwork(SeqCtxJitCache, SeqCtxSolverCache):
                     fmask=valid_, carries=carries_)
                 new = {n: new_states[n] for n in stateful}
                 if active_ is not None:
-                    def lane(old, nw):
-                        a = active_.reshape(
-                            (-1,) + (1,) * (getattr(nw, "ndim", 1) - 1))
-                        return jnp.where(a, nw, old)
-                    new = jax.tree_util.tree_map(lane, carries_, new)
+                    new = self._lane_merge(carries_, new, active_)
                 return out, new
 
             self._jit_cache[key] = jax.jit(step_fn)
@@ -948,13 +1009,7 @@ class MultiLayerNetwork(SeqCtxJitCache, SeqCtxSolverCache):
                         params, states, encode(tok), train=False, rng=None,
                         fmask=val, carries=c)
                     new = {nm: new_states[nm] for nm in stateful}
-
-                    def lane(old, nw):
-                        a = act.reshape(
-                            (-1,) + (1,) * (getattr(nw, "ndim", 1) - 1))
-                        return jnp.where(a, nw, old)
-
-                    new = jax.tree_util.tree_map(lane, c, new)
+                    new = self._lane_merge(c, new, act)
                     step_keys = jax.vmap(jax.random.fold_in)(keys_, offs + n)
                     nxt = _sampling.sample_token_lanes(
                         out[:, -1, :], temps, tks, tps, grdy, step_keys)
@@ -1061,11 +1116,7 @@ class MultiLayerNetwork(SeqCtxJitCache, SeqCtxSolverCache):
                     return jax.nn.one_hot(tok, feat, dtype=dt)[:, None, :]
 
                 def lane_merge(mask, old_tree, new_tree):
-                    def lane(old, nw):
-                        a = mask.reshape(
-                            (-1,) + (1,) * (getattr(nw, "ndim", 1) - 1))
-                        return jnp.where(a, nw, old)
-                    return jax.tree_util.tree_map(lane, old_tree, new_tree)
+                    return self._lane_merge(old_tree, new_tree, mask)
 
                 carries_ = self._pos_rewind(
                     carries_, jnp.where(active_, rew, 0))
@@ -1120,10 +1171,15 @@ class MultiLayerNetwork(SeqCtxJitCache, SeqCtxSolverCache):
         always emits n_acc + 1 tokens (its accepted prefix plus the
         correction/bonus token), so the chain advances every window.
 
-        Returns ``(packed [S, k+3] i32, new_carries)`` where packed rows
-        are ``[n_emit, last_draft, tok_0..tok_k]`` (-1 past n_emit) —
-        one device array so the manager's single post-lock readback
-        covers count, catch-up token, and emissions together."""
+        Returns ``(packed [S, k+4] i32, new_carries)`` where packed rows
+        are ``[n_emit, n_acc, last_draft, tok_0..tok_k]`` (-1 past
+        n_emit) — one device array so the manager's single post-lock
+        readback covers counts, catch-up token, and emissions together.
+        `n_acc` (the acceptance verdict BEFORE the EOS/budget cuts) rides
+        along so the manager can count exactly the accepted drafts that
+        were actually emitted — ``min(n_acc, n_emit)`` — instead of
+        inferring them from n_emit alone, which mis-counts when a fully
+        verified window is truncated by the token budget."""
         from deeplearning4j_tpu.nn.layers.feedforward import (
             EmbeddingSequenceLayer,
         )
@@ -1186,13 +1242,9 @@ class MultiLayerNetwork(SeqCtxJitCache, SeqCtxSolverCache):
                 n_emit = emitted.sum(axis=1).astype(jnp.int32)
                 toks_out = jnp.where(emitted, cand, -1)
 
-                def lane(old, nw):
-                    a = active_.reshape(
-                        (-1,) + (1,) * (getattr(nw, "ndim", 1) - 1))
-                    return jnp.where(a, nw, old)
-
-                new = jax.tree_util.tree_map(
-                    lane, carries_, {nm: new_states[nm] for nm in stateful})
+                new = self._lane_merge(
+                    carries_, {nm: new_states[nm] for nm in stateful},
+                    active_)
                 # position snap-back: the forward advanced active lanes
                 # by k+1; the confirmed history is old + n_emit
                 demit = jnp.where(active_, n_emit, 0)
@@ -1207,7 +1259,8 @@ class MultiLayerNetwork(SeqCtxJitCache, SeqCtxSolverCache):
                 new = jax.tree_util.tree_map_with_path(
                     fix, carries_, new)
                 packed = jnp.concatenate(
-                    [n_emit[:, None], d_toks[:, -1:], toks_out], axis=1)
+                    [n_emit[:, None], n_acc[:, None].astype(jnp.int32),
+                     d_toks[:, -1:], toks_out], axis=1)
                 return packed.astype(jnp.int32), new
 
             self._jit_cache[key] = jax.jit(verify_fn)

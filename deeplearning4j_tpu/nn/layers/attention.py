@@ -130,7 +130,8 @@ class MultiHeadAttention(Layer):
         }, {}
 
     def decode_carry(self, batch: int, dtype=jnp.float32, *,
-                     per_slot: bool = False, kv_dtype: str = None):
+                     per_slot: bool = False, kv_dtype: str = None,
+                     page_len: int = None, pages: int = None):
         """Preallocated KV cache for incremental decoding (the transformer
         analogue of the reference's rnnTimeStep statefulness,
         `MultiLayerNetwork.java:rnnTimeStep`): fixed [B, max_cache, Hkv,
@@ -149,7 +150,22 @@ class MultiHeadAttention(Layer):
         [B, L, Hkv] ride the carry next to the caches. Quantize-on-write
         and dequantize-on-read live in `_decode`; the scale rows cost
         1/Dh of a native cache, so slots-per-chip multiplies by
-        ~4·Dh/(Dh+4) at int8."""
+        ~4·Dh/(Dh+4) at int8.
+
+        `page_len` switches the storage to PAGED layout: a pool of
+        `pages` fixed-size KV blocks `[P, page_len, Hkv, Dh]` plus a
+        per-slot `page_table` [B, max_cache/page_len] int32 mapping each
+        logical page to a physical block. Positions stay LOGICAL —
+        `_decode` translates position -> (page_table[pos // page_len],
+        pos % page_len) at the scatter/gather, so visibility arithmetic
+        and RoPE are unchanged and page indices ride the trace like slot
+        ids (zero recompiles under page churn). This is the KVSlotPool's
+        prefix-cache layout: sessions sharing a prompt prefix point their
+        tables at the same refcounted physical blocks. Requires per_slot
+        and a non-rolling cache (the ring's held-index arithmetic
+        addresses the monolithic slot layout). `pages` defaults to
+        `batch * max_cache / page_len` — the same memory as the
+        monolithic layout."""
         Dh = self.n_out // self.num_heads
         L = self.max_cache
         Hkv = self._kv_heads
@@ -167,6 +183,37 @@ class MultiHeadAttention(Layer):
             cdt = jnp.int8 if kv_dtype == "int8" else jnp.float8_e4m3fn
         elif kv_dtype not in (None, "native"):
             raise ValueError(f"unknown kv_dtype {kv_dtype!r}")
+        if page_len is not None:
+            if not per_slot:
+                raise ValueError(
+                    "paged KV carries are a session-pool feature "
+                    "(per_slot=True)")
+            if self.rolling_cache:
+                raise ValueError(
+                    "paged KV carries cannot ride a rolling ring: the "
+                    "ring's held-index arithmetic addresses the "
+                    "monolithic slot layout")
+            if page_len < 1 or L % page_len:
+                raise ValueError(
+                    f"max_cache {L} not divisible by page_len {page_len}")
+            npg = L // page_len
+            P = int(pages) if pages is not None else batch * npg
+            if P < npg:
+                raise ValueError(
+                    f"page pool of {P} blocks cannot hold even one "
+                    f"slot's {npg} logical pages")
+            carry = {
+                "cache_k": jnp.zeros((P, page_len, Hkv, Dh), cdt),
+                "cache_v": jnp.zeros((P, page_len, Hkv, Dh), cdt),
+                "page_table": jnp.zeros((batch, npg), jnp.int32),
+                "pos": jnp.zeros((batch,), jnp.int32),
+            }
+            if kv_dtype in ("int8", "fp8"):
+                carry["scale_k"] = jnp.zeros((P, page_len, Hkv),
+                                             jnp.float32)
+                carry["scale_v"] = jnp.zeros((P, page_len, Hkv),
+                                             jnp.float32)
+            return carry
         carry = {
             "cache_k": jnp.zeros((batch, L, Hkv, Dh), cdt),
             "cache_v": jnp.zeros((batch, L, Hkv, Dh), cdt),
@@ -189,12 +236,29 @@ class MultiHeadAttention(Layer):
         prefix-validity mask: padded tokens are dropped from the cache
         write (scatter index pushed out of range, `mode="drop"`) and do
         not advance the row's position, so a prefill chunk and a
-        single-token step can share one padded bucket shape."""
+        single-token step can share one padded bucket shape.
+
+        A `page_table` in the carry switches both the scatter and the
+        reads to PAGED addressing (see `decode_carry`): logical position
+        j lives at physical row `page_table[j // Lp]`, offset `j % Lp`.
+        Everything position-flavored — visibility, RoPE, overflow
+        poison — keeps operating on logical positions, so the paged and
+        monolithic layouts are bit-identical by construction."""
         B, T, _ = x.shape
         H = self.num_heads
         Hkv = self._kv_heads
         Dh = self.n_out // H
-        L = state["cache_k"].shape[1]
+        paged = "page_table" in state
+        if paged:
+            if self.rolling_cache:
+                raise ValueError(
+                    "paged KV caches cannot ride a rolling ring")
+            pt = state["page_table"]                   # [B, NP] int32
+            npg = pt.shape[1]
+            Lp = state["cache_k"].shape[1]
+            L = npg * Lp
+        else:
+            L = state["cache_k"].shape[1]
         if self.rolling_cache:
             # per-step feasibility is static: the T new keys plus the
             # window tail of the oldest query must coexist in the ring
@@ -209,6 +273,8 @@ class MultiHeadAttention(Layer):
         per_slot = getattr(pos, "ndim", 0) == 1
         if per_slot and not self.causal:
             raise ValueError("per-slot decode needs causal=True")
+        if paged and not per_slot:
+            raise ValueError("paged KV caches require per-slot mode")
         quant = "scale_k" in state
         if quant and not per_slot:
             raise ValueError("quantized KV carries require per-slot mode")
@@ -239,6 +305,17 @@ class MultiHeadAttention(Layer):
                 # padded tokens scatter out of range -> dropped, so a
                 # short chunk in a wide bucket never dirties the cache
                 tgt = jnp.where(valid, tgt, L)
+            if paged:
+                # logical target -> (physical page, in-page offset);
+                # padded/overflowing rows land at offset Lp, out of the
+                # page dim's bounds, so mode="drop" keeps them out
+                # exactly like the monolithic layout's tgt >= L. The
+                # page indices are traced gathers from the carry —
+                # page churn never mints a new program.
+                i0 = pt[rows, jnp.clip(tgt // Lp, 0, npg - 1)]  # [B, T]
+                i1 = jnp.where(tgt < L, tgt % Lp, Lp)
+            else:
+                i0, i1 = rows, tgt
             cdt = state["cache_k"].dtype
             if quant:
                 # quantize-on-write: one f32 scale per (token, kv-head),
@@ -259,14 +336,14 @@ class MultiHeadAttention(Layer):
 
                 kq, sk = _q(k)
                 vq, sv = _q(v)
-                ck = state["cache_k"].at[rows, tgt].set(kq, mode="drop")
-                cv = state["cache_v"].at[rows, tgt].set(vq, mode="drop")
-                csk = state["scale_k"].at[rows, tgt].set(sk, mode="drop")
-                csv = state["scale_v"].at[rows, tgt].set(sv, mode="drop")
+                ck = state["cache_k"].at[i0, i1].set(kq, mode="drop")
+                cv = state["cache_v"].at[i0, i1].set(vq, mode="drop")
+                csk = state["scale_k"].at[i0, i1].set(sk, mode="drop")
+                csv = state["scale_v"].at[i0, i1].set(sv, mode="drop")
             else:
-                ck = state["cache_k"].at[rows, tgt].set(
+                ck = state["cache_k"].at[i0, i1].set(
                     k.astype(cdt), mode="drop")
-                cv = state["cache_v"].at[rows, tgt].set(
+                cv = state["cache_v"].at[i0, i1].set(
                     v.astype(cdt), mode="drop")
             if self.rolling_cache:
                 # per-row held-position arithmetic (see scalar branch)
@@ -344,14 +421,28 @@ class MultiHeadAttention(Layer):
             pos_new = pos + T
         # [T, L] (lockstep) or [B, T, L] (per-slot) -> broadcastable
         vb = vis if vis.ndim == 3 else vis[None]
+        if paged:
+            # logical [B, L, Hkv, Dh] view for the dense paths: gather
+            # each slot's page chain back into position order. The
+            # banded kernel below never materializes this — its
+            # BlockSpec index_map reads the page table directly.
+            ck_r = jnp.take(ck, pt, axis=0).reshape(B, L, Hkv, Dh)
+            cv_r = jnp.take(cv, pt, axis=0).reshape(B, L, Hkv, Dh)
+            csk_r = (jnp.take(csk, pt, axis=0).reshape(B, L, Hkv)
+                     if quant else None)
+            csv_r = (jnp.take(csv, pt, axis=0).reshape(B, L, Hkv)
+                     if quant else None)
+        else:
+            ck_r, cv_r = ck, cv
+            csk_r, csv_r = (csk, csv) if quant else (None, None)
         if quant:
             # dequantize-on-read for the dense fallback: the banded
             # kernel path below instead fuses this product into its
             # block loads and never materializes the f32 cache
-            ck_a = ck.astype(q.dtype) * csk.astype(q.dtype)[..., None]
-            cv_a = cv.astype(q.dtype) * csv.astype(q.dtype)[..., None]
+            ck_a = ck_r.astype(q.dtype) * csk_r.astype(q.dtype)[..., None]
+            cv_a = cv_r.astype(q.dtype) * csv_r.astype(q.dtype)[..., None]
         else:
-            ck_a, cv_a = ck, cv
+            ck_a, cv_a = ck_r, cv_r
         dpol = None
         if T == 1:
             from deeplearning4j_tpu.ops.kernel_defaults import (
@@ -359,15 +450,21 @@ class MultiHeadAttention(Layer):
             )
 
             dpol = decode_attention_policy(L, H, Hkv)
-        if dpol is not None and dpol.kind == "banded":
+        use_banded = dpol is not None and dpol.kind == "banded"
+        if use_banded and paged and jax.default_backend() == "tpu" \
+                and Lp % 128:
+            # the paged kernel's cache block IS one page; a page that
+            # Mosaic cannot tile falls back to the dense gather
+            use_banded = False
+        if use_banded:
             # Single-token step: the banded decode kernel reads the cache
             # in its stored [*, L, Hkv, Dh] layout (same arithmetic as
             # `vis` above, held-index ring included) without broadcasting
             # KV to H heads or materializing [B, H, 1, L] scores in HBM.
-            from deeplearning4j_tpu.ops.banded_attention import (
-                banded_decode_attention,
-            )
-
+            # Paged carries route to the paged variant: the page table
+            # rides the scalar-prefetch lane and the kernel's index_map
+            # resolves logical block -> physical page, so shared-prefix
+            # sessions read the same HBM blocks with no gather.
             if per_slot:
                 dec_pos = pos
                 dec_end = (pos + n_new - 1 if self.rolling_cache
@@ -375,13 +472,29 @@ class MultiHeadAttention(Layer):
             else:
                 dec_pos = jnp.broadcast_to(pos, (B,))
                 dec_end = dec_pos
-            o = banded_decode_attention(
-                q[:, 0], ck, cv, dec_pos.astype(jnp.int32),
-                dec_end.astype(jnp.int32), window=self.window,
-                rolling=self.rolling_cache, block_l=dpol.block_l,
-                interpret=jax.default_backend() != "tpu",
-                scale_k=csk if quant else None,
-                scale_v=csv if quant else None)
+            if paged:
+                from deeplearning4j_tpu.ops.banded_attention import (
+                    paged_decode_attention,
+                )
+
+                o = paged_decode_attention(
+                    q[:, 0], ck, cv, pt, dec_pos.astype(jnp.int32),
+                    window=self.window,
+                    interpret=jax.default_backend() != "tpu",
+                    scale_k=csk if quant else None,
+                    scale_v=csv if quant else None)
+            else:
+                from deeplearning4j_tpu.ops.banded_attention import (
+                    banded_decode_attention,
+                )
+
+                o = banded_decode_attention(
+                    q[:, 0], ck, cv, dec_pos.astype(jnp.int32),
+                    dec_end.astype(jnp.int32), window=self.window,
+                    rolling=self.rolling_cache, block_l=dpol.block_l,
+                    interpret=jax.default_backend() != "tpu",
+                    scale_k=csk if quant else None,
+                    scale_v=csv if quant else None)
             o = o[:, None]
         elif Hkv != H:
             # GQA: group the query heads against the Hkv-wide cache in
@@ -402,6 +515,8 @@ class MultiHeadAttention(Layer):
                            jax.nn.softmax(s, axis=-1), cv_a)
         y = o.reshape(B, T, self.n_out) @ params["Wo"] + params["b"]
         new_state = {"cache_k": ck, "cache_v": cv, "pos": pos_new}
+        if paged:
+            new_state["page_table"] = pt
         if quant:
             new_state["scale_k"] = csk
             new_state["scale_v"] = csv
@@ -578,9 +693,11 @@ class PositionEmbeddingLayer(Layer):
             key, (self.max_length, d), dtype)}, {}
 
     def decode_carry(self, batch: int, dtype=jnp.float32, *,
-                     per_slot: bool = False, kv_dtype: str = None):
-        # no KV here — kv_dtype is accepted (and ignored) so the
-        # session-carry builder can pass one policy to every decode layer
+                     per_slot: bool = False, kv_dtype: str = None,
+                     page_len: int = None, pages: int = None):
+        # no KV here — kv_dtype/page geometry are accepted (and ignored)
+        # so the session-carry builder can pass one policy to every
+        # decode layer
         return {"pos": jnp.zeros((batch,) if per_slot else (), jnp.int32)}
 
     def apply(self, params, x, *, state=None, train=False, rng=None,
@@ -736,10 +853,12 @@ class TransformerEncoderBlock(Layer):
             + params[f"{prefix}_b"]
 
     def decode_carry(self, batch: int, dtype=jnp.float32, *,
-                     per_slot: bool = False, kv_dtype: str = None):
+                     per_slot: bool = False, kv_dtype: str = None,
+                     page_len: int = None, pages: int = None):
         attn, _ = self._sub()
         return {"attn": attn.decode_carry(batch, dtype, per_slot=per_slot,
-                                          kv_dtype=kv_dtype)}
+                                          kv_dtype=kv_dtype,
+                                          page_len=page_len, pages=pages)}
 
     def apply(self, params, x, *, state=None, train=False, rng=None,
               mask=None):

@@ -482,3 +482,160 @@ def banded_decode_attention(q, cache_k, cache_v, qpos, end,
             dimension_semantics=("parallel", "arbitrary")),
         interpret=interpret,
     )(qpos, end, *inputs)
+
+
+def _paged_decode_kernel(qpos_ref, pt_ref, *refs, page_len: int,
+                         window: Optional[int], hkv: int, scale: float,
+                         quant: bool = False):
+    """Paged twin of `_decode_kernel`: grid = (slots, NP logical pages),
+    and the lb-th cache block is whatever PHYSICAL page the slot's
+    scalar-prefetched page table maps logical page lb to — the BlockSpec
+    index_map reads `pt_ref[si, lb]`, so block-scattered storage costs
+    the kernel nothing (vLLM-style TPU paged attention). Visibility
+    stays the linear `j <= pos` arithmetic over LOGICAL positions
+    j = lb * page_len + offset. Unmapped tail entries of the table must
+    still hold a valid physical index (the pool keeps them 0): their
+    blocks DMA in, but the relevant-guard skips their math."""
+    if quant:
+        (q_ref, k_ref, v_ref, sk_ref, sv_ref, o_ref,
+         acc_scr, m_scr, l_scr) = refs
+    else:
+        q_ref, k_ref, v_ref, o_ref, acc_scr, m_scr, l_scr = refs
+        sk_ref = sv_ref = None
+    si = pl.program_id(0)
+    lb = pl.program_id(1)
+    nlb = pl.num_programs(1)
+    q = q_ref[0]                                   # [H, Dh]
+    h, d = q.shape
+    g = h // hkv
+    pos = qpos_ref[si]
+
+    @pl.when(lb == 0)
+    def _():
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    # only logical pages intersecting [pos-w+1, pos] hold live keys
+    relevant = lb * page_len <= pos
+    if window is not None:
+        relevant &= lb * page_len + page_len - 1 > pos - window
+
+    @pl.when(relevant)
+    def _():
+        kc = k_ref[0]                              # [Lp, Hkv, Dh]
+        vc = v_ref[0]
+        if quant:
+            # fused dequantize-on-load: widen the narrow block in VMEM
+            kc = kc.astype(jnp.float32) * sk_ref[0][:, :, None]
+            vc = vc.astype(jnp.float32) * sv_ref[0][:, :, None]
+        prec = (jax.lax.Precision.HIGHEST if q.dtype == jnp.float32
+                else jax.lax.Precision.DEFAULT)
+        s = jnp.concatenate([
+            jnp.dot(q[hk * g:(hk + 1) * g], kc[:, hk, :].T,
+                    preferred_element_type=jnp.float32,
+                    precision=prec)
+            for hk in range(hkv)], axis=0) * scale  # [H, Lp]
+        j = (lb * page_len
+             + jax.lax.broadcasted_iota(jnp.int32, (h, page_len), 1))
+        vis = j <= pos
+        if window is not None:
+            vis = vis & (j > pos - window)
+        s = jnp.where(vis, s, _NEG_INF)
+        m_prev = m_scr[:]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.where(vis, jnp.exp(s - m_new), 0.0)   # dead-block guard
+        m_scr[:] = m_new
+        l_scr[:] = l_scr[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jnp.concatenate([
+            jnp.dot(p[hk * g:(hk + 1) * g].astype(vc.dtype), vc[:, hk, :],
+                    preferred_element_type=jnp.float32, precision=prec)
+            for hk in range(hkv)], axis=0)            # [H, Dh]
+        acc_scr[:] = acc_scr[:] * alpha + pv
+
+    @pl.when(lb == nlb - 1)
+    def _():
+        l = jnp.maximum(l_scr[:], 1e-30)
+        o_ref[0] = (acc_scr[:] / l).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q, cache_k, cache_v, page_table, qpos,
+                           window: Optional[int] = None,
+                           scale: Optional[float] = None,
+                           interpret: bool = False,
+                           scale_k=None, scale_v=None):
+    """Single-query attention over the PAGED KVSlotPool layout.
+
+    q: [S, H, Dh]; cache_k/cache_v: [P, Lp, Hkv, Dh] — the shared
+    physical page pool (post-write); page_table: [S, NP] int32 mapping
+    each slot's logical pages to physical rows; qpos: [S] int32 logical
+    position of each slot's query. Returns [S, H, Dh].
+
+    The page table rides the scalar-prefetch lane next to the
+    positions: Mosaic resolves each grid step's cache block address from
+    `page_table[si, lb]` BEFORE the DMA, so sessions sharing a prompt
+    prefix stream the SAME physical blocks and nothing is gathered into
+    a per-slot logical copy. One compiled program serves every
+    page-table content — page indices are data, not shape, the same
+    zero-recompile discipline as slot ids. The block length IS the page
+    length (pages are the unit of sharing and of tiling); quantized
+    pools pass their [P, Lp, Hkv] scale rows for fused
+    dequantize-on-load. Inference-only, non-rolling (the prefix cache
+    never pages a rolling ring)."""
+    s_, h, dh = q.shape
+    page_len = cache_k.shape[1]
+    hkv = cache_k.shape[2]
+    npg = page_table.shape[1]
+    if h % hkv:
+        raise ValueError(f"H {h} not divisible by Hkv {hkv}")
+    if not interpret and page_len % 128:
+        raise ValueError(
+            f"page_len {page_len} must be 128-lane tileable on TPU")
+    sc = scale if scale is not None else dh ** -0.5
+    quant = scale_k is not None
+    qpos = qpos.astype(jnp.int32)
+    page_table = page_table.astype(jnp.int32)
+    in_specs = [
+        pl.BlockSpec((1, h, dh), lambda si, lb, *refs: (si, 0, 0)),
+        # the paged indirection: block lb of slot si is physical page
+        # pt[si, lb] (refs = the scalar-prefetch operands, qpos then pt)
+        pl.BlockSpec((1, page_len, hkv, dh),
+                     lambda si, lb, qpos_ref, pt_ref: (pt_ref[si, lb],
+                                                       0, 0, 0)),
+        pl.BlockSpec((1, page_len, hkv, dh),
+                     lambda si, lb, qpos_ref, pt_ref: (pt_ref[si, lb],
+                                                       0, 0, 0)),
+    ]
+    inputs = [q, cache_k, cache_v]
+    if quant:
+        in_specs += [
+            pl.BlockSpec((1, page_len, hkv),
+                         lambda si, lb, qpos_ref, pt_ref: (pt_ref[si, lb],
+                                                           0, 0)),
+            pl.BlockSpec((1, page_len, hkv),
+                         lambda si, lb, qpos_ref, pt_ref: (pt_ref[si, lb],
+                                                           0, 0)),
+        ]
+        inputs += [scale_k.astype(jnp.float32),
+                   scale_v.astype(jnp.float32)]
+    return pl.pallas_call(
+        functools.partial(_paged_decode_kernel, page_len=page_len,
+                          window=window, hkv=hkv, scale=sc, quant=quant),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(s_, npg),
+            in_specs=in_specs,
+            out_specs=pl.BlockSpec((1, h, dh),
+                                   lambda si, lb, *refs: (si, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((h, dh), jnp.float32),
+                pltpu.VMEM((h, 1), jnp.float32),
+                pltpu.VMEM((h, 1), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((s_, h, dh), q.dtype),
+        compiler_params=_CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=interpret,
+    )(qpos, page_table, *inputs)

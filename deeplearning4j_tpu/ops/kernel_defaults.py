@@ -29,6 +29,8 @@ Policy, in order:
        DL4J_TPU_SPEC_DECODE    = auto|on|off  (draft-model speculative decode)
        DL4J_TPU_DRAFT_K        = int (draft proposal window; bucketed)
        DL4J_TPU_KV_DTYPE       = auto|native|int8|fp8 (KV-cache storage)
+       DL4J_TPU_PREFIX_CACHE   = auto|on|off  (paged KV prefix reuse)
+       DL4J_TPU_KV_PAGE        = int (KV page length; snapped to divisors)
        DL4J_TPU_FUSED_UPDATE   = auto|fused|xla      (optimizer update)
   2. Shape eligibility: flash needs the TPU backend and 128-lane-tileable
      sequence lengths; otherwise dense.
@@ -591,6 +593,67 @@ def kv_dtype_policy(kind: Optional[str] = None, *,
         return verdict(kd, f"measured win ({row})")
     return verdict("native", "no measured rows; quantization is "
                    "opt-in per deployment")
+
+
+class PrefixCachePolicy(NamedTuple):
+    kind: str            # "paged" | "off"
+    page_len: int        # KV page length in tokens (0 when off)
+    reason: str
+
+
+def prefix_cache_policy(page_len: Optional[int] = None, *,
+                        max_cache: Optional[int] = None,
+                        capable: bool = True,
+                        record: bool = True) -> PrefixCachePolicy:
+    """Paged KV storage + radix prefix cache vs monolithic per-slot
+    caches. Same lattice as the other policies — env force, then
+    capability — but like `decode_loop_policy` the no-data default is
+    ON when the model is capable: a warm prefix replaces its whole
+    prefill with admission-time page-table writes, and that bookkeeping
+    costs the steady-state window nothing (page indices are traced
+    scalars, one compiled program either way), so there is no measured
+    trade to wait on. `capable=False` (recurrent carries, rolling KV
+    rings, non-uniform max_cache, or an active draft model whose own
+    cache cannot skip the prefill) degrades to off. The page length
+    (DL4J_TPU_KV_PAGE, or `page_len`, default 128 — the TPU lane tile,
+    so the banded paged kernel stays eligible) is snapped down to the
+    largest divisor of `max_cache` so a slot's table tiles exactly."""
+    forced = _env("DL4J_TPU_PREFIX_CACHE")
+    env_p = os.environ.get("DL4J_TPU_KV_PAGE", "").strip()
+    if env_p:
+        page_len = int(env_p)
+    want = max(1, int(page_len)) if page_len else 128
+    if max_cache:
+        mc = int(max_cache)
+        want = min(want, mc)
+        while mc % want:
+            want -= 1
+
+    def paged(reason):
+        if record:
+            record_dispatch("prefix_cache", "paged")
+        return PrefixCachePolicy("paged", want, reason)
+
+    def off(reason):
+        if record:
+            record_dispatch("prefix_cache", "off")
+        return PrefixCachePolicy("off", 0, reason)
+
+    if forced == "off":
+        return off("forced by DL4J_TPU_PREFIX_CACHE=off")
+    if forced == "on":
+        if not capable:
+            return off("DL4J_TPU_PREFIX_CACHE=on but the model cannot "
+                       "page its KV (recurrent carries, rolling rings, "
+                       "non-uniform max_cache, or active draft model)")
+        return paged("forced by DL4J_TPU_PREFIX_CACHE=on")
+    if not capable:
+        return off("model cannot page its KV (recurrent carries, "
+                   "rolling rings, non-uniform max_cache, or active "
+                   "draft model)")
+    return paged("structural default: a warm prefix replaces its whole "
+                 "prefill; admission-time bookkeeping costs the "
+                 "steady-state window nothing")
 
 
 def fused_update_policy(kind: str) -> str:

@@ -19,6 +19,7 @@ from deeplearning4j_tpu.serving.kv_pool import (
     IncompatibleSessionSwapError, KVSlotPool, SlotPoolExhaustedError,
 )
 from deeplearning4j_tpu.serving.metrics import ServingStats
+from deeplearning4j_tpu.serving.prefix_cache import PrefixCache
 from deeplearning4j_tpu.serving.registry import (
     DeployRolledBackError, ModelEntry, ModelRegistry,
 )
@@ -36,6 +37,7 @@ __all__ = [
     "DeployRolledBackError", "HttpError", "IncompatibleSessionSwapError",
     "InferenceServer", "JsonHttpServer", "KVSlotPool", "ModelEntry",
     "ModelRegistry", "ModelServer", "NearestNeighborsServer",
+    "PrefixCache",
     "RequestShedError", "SchedulerClosedError", "ServingStats",
     "SlotPoolExhaustedError", "StreamResponse", "WorkerCrashError",
 ]

@@ -114,6 +114,7 @@ class InferenceServer(JsonHttpServer):
                  decode_draft_net=None,
                  decode_spec_k: Optional[int] = None,
                  decode_kv_dtype: Optional[str] = None,
+                 decode_page_len: Optional[int] = None,
                  slo: bool = False,
                  slo_objectives=None,
                  series_interval: Optional[float] = None):
@@ -161,7 +162,8 @@ class InferenceServer(JsonHttpServer):
                     fused_k=decode_fused_k,
                     draft_net=decode_draft_net,
                     spec_k=decode_spec_k,
-                    kv_dtype=decode_kv_dtype)
+                    kv_dtype=decode_kv_dtype,
+                    page_len=decode_page_len)
 
     # ------------------------------------------------------ control API
     def deploy(self, name: str, version, net, *, feat_shape=None,
@@ -177,6 +179,7 @@ class InferenceServer(JsonHttpServer):
                                draft_net=None,
                                spec_k: Optional[int] = None,
                                kv_dtype: Optional[str] = None,
+                               page_len: Optional[int] = None,
                                warm: bool = True):
         """Attach a DecodeSessionManager to `model`: POST /generate
         streams tokens from per-request sessions over a shared KV slot
@@ -186,9 +189,12 @@ class InferenceServer(JsonHttpServer):
         `draft_net` wires in a speculative-decoding draft model (same
         vocab, rewind-capable) and `spec_k` its proposals-per-window;
         `kv_dtype` ("int8"/"fp8") quantizes the KV slot pools'
-        cache storage. All three defer to their kernel_defaults policy
-        lattice — DL4J_TPU_SPEC_DECODE / DL4J_TPU_DRAFT_K /
-        DL4J_TPU_KV_DTYPE force-override."""
+        cache storage; `page_len` requests a KV page length for the
+        prefix cache (paged storage + radix prefix reuse — on by
+        default when the model can page its KV). All defer to their
+        kernel_defaults policy lattice — DL4J_TPU_SPEC_DECODE /
+        DL4J_TPU_DRAFT_K / DL4J_TPU_KV_DTYPE / DL4J_TPU_PREFIX_CACHE /
+        DL4J_TPU_KV_PAGE force-override."""
         if self.mode != "continuous":
             raise ValueError(
                 "decode sessions need the continuous scheduler "
@@ -203,7 +209,7 @@ class InferenceServer(JsonHttpServer):
             self.registry, self.scheduler, model, slots=slots,
             prefill_chunk=prefill_chunk, fused_k=fused_k,
             draft_net=draft_net, spec_k=spec_k, kv_dtype=kv_dtype,
-            metrics=self.stats.registry, warm=warm)
+            page_len=page_len, metrics=self.stats.registry, warm=warm)
         self._decode[model] = mgr
         return mgr
 

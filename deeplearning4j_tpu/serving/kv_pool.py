@@ -21,6 +21,22 @@ wraparound-reuse test pins both.
 
 Occupancy rides the shared metrics spine: `serving_kv_slots` /
 `serving_kv_slots_in_use` gauges plus alloc/reset counters.
+
+Paged mode (`page_len=...`) replaces the monolithic per-slot cache with
+block-granular KV pages: attention caches become [pages, L_page, Hkv,
+Dh] physical pools and each slot owns a `page_table` row of physical
+page indices. Pages are the unit of sharing — the prefix cache maps a
+matched token prefix to a refcounted chain of read-only pages that many
+sessions' tables can point at, and a session diverging inside a shared
+page gets a private copy first (copy-on-write). The pool provides the
+mechanism only: a page free list, per-page refcounts, and three warmed
+jitted programs (`install`, `copy_page`, `poison_pages`) whose page and
+slot indices are traced scalars — admission-time bookkeeping costs zero
+steady-state compiles, exactly like slot alloc/reset. Policy (what to
+share, when to fork, what to evict) lives in
+`serving/prefix_cache.py` and `serving/sessions.py`. The `*_locked`
+page methods follow the `swap_carries` contract: callers hold `lock()`
+(the Condition is non-reentrant, so they must not re-acquire it).
 """
 
 from __future__ import annotations
@@ -46,37 +62,121 @@ class KVSlotPool:
     """Slot-indexed decode carries + free-list allocation + jitted
     per-slot reset."""
 
+    _CACHE_KEYS = ("cache_k", "cache_v", "scale_k", "scale_v")
+
     def __init__(self, net, slots: int, *, model: str = "default",
-                 metrics=None, kv_dtype: Optional[str] = None):
+                 metrics=None, kv_dtype: Optional[str] = None,
+                 page_len: Optional[int] = None,
+                 pages: Optional[int] = None):
         if slots < 1:
             raise ValueError(f"slots must be >= 1, got {slots}")
         self.net = net
         self.slots = int(slots)
         self.model = model
         self.kv_dtype = kv_dtype or "native"
+        self.page_len = int(page_len) if page_len else None
         self._cv = threading.Condition()
         # the decode carry pytree and slot occupancy are the shared
         # state every request thread contends on; declare the guard so
         # graft-lint's interprocedural pass (GL701) checks every reader
         # — callers that enter via `with pool.lock():` stay quiet
         # graft: guarded-by(_cv)
-        self.carries = net.session_carries(self.slots, kv_dtype=kv_dtype)
+        if self.page_len:
+            self.carries = net.session_carries(
+                self.slots, kv_dtype=kv_dtype, page_len=self.page_len,
+                pages=pages)
+        else:
+            self.carries = net.session_carries(self.slots,
+                                               kv_dtype=kv_dtype)
         # graft: guarded-by(_cv)
         self._free = list(range(self.slots - 1, -1, -1))
         # graft: guarded-by(_cv)
         self._active = [False] * self.slots
 
+        # paged geometry read back off the built tree (session_carries
+        # owns the defaulting): pages = physical pool size, npages =
+        # page-table width (= max_cache // page_len)
+        self.pages = self.npages = 0
+        if self.page_len:
+            for path, leaf in jax.tree_util.tree_leaves_with_path(
+                    self.carries):
+                key = getattr(path[-1], "key", None)
+                if key == "page_table":
+                    self.npages = int(leaf.shape[1])
+                elif key == "cache_k":
+                    self.pages = int(leaf.shape[0])
+            if not (self.pages and self.npages):
+                raise ValueError(
+                    "page_len set but the net produced no paged "
+                    "attention carries")
+        # graft: guarded-by(_cv)
+        self._page_free = list(range(self.pages - 1, -1, -1))
+        # graft: guarded-by(_cv)
+        self._page_ref = [0] * self.pages
+
+        cache_keys = self._CACHE_KEYS
+
         def _reset(carries, slot):
-            def z(a):
+            def z(path, a):
                 # graft: allow(GL003): ndim/shape are static array
                 # metadata, constant per trace — not traced values
-                if getattr(a, "ndim", 0) >= 1 and a.shape[0] == slots:
-                    return a.at[slot].set(jnp.zeros_like(a[slot]))
-                return a
-            return jax.tree_util.tree_map(z, carries)
+                if getattr(a, "ndim", 0) < 1 or a.shape[0] != slots:
+                    return a
+                # paged mode: cache leaves are page-indexed ([pages,
+                # ...]; pages may numerically equal slots) and hold
+                # shared prefix pages other sessions still read —
+                # reset only the slot's view (page_table / pos / h / c)
+                # graft: allow(GL003): path keys are static pytree
+                # metadata, constant per trace — not traced values
+                if page_len and getattr(path[-1], "key", None) \
+                        in cache_keys:
+                    return a
+                return a.at[slot].set(jnp.zeros_like(a[slot]))
+            return jax.tree_util.tree_map_with_path(z, carries)
 
         # slot is a traced scalar: one compile covers every reset ever
         self._reset_jit = jax.jit(_reset)
+
+        def _install(carries, slot, page_row, pos):
+            def ins(path, a):
+                key = getattr(path[-1], "key", None)
+                # graft: allow(GL003): path keys are static metadata
+                if key == "page_table":
+                    return a.at[slot].set(page_row)
+                # graft: allow(GL003): path keys are static metadata
+                if key == "pos":
+                    return a.at[slot].set(pos.astype(a.dtype))
+                return a
+            return jax.tree_util.tree_map_with_path(ins, carries)
+
+        def _copy_page(carries, src, dst):
+            def cp(path, a):
+                # graft: allow(GL003): path keys are static metadata
+                if getattr(path[-1], "key", None) in cache_keys:
+                    return a.at[dst].set(a[src])
+                return a
+            return jax.tree_util.tree_map_with_path(cp, carries)
+
+        def _poison(carries, page, value):
+            def px(path, a):
+                # graft: allow(GL003): path keys are static metadata
+                if getattr(path[-1], "key", None) in cache_keys:
+                    fill = jnp.full_like(a[page], value)
+                    return a.at[page].set(fill)
+                return a
+            return jax.tree_util.tree_map_with_path(px, carries)
+
+        # slot/page indices are traced scalars — one compile each,
+        # warmed here so admission during churn never compiles
+        self._install_jit = jax.jit(_install)
+        self._copy_page_jit = jax.jit(_copy_page)
+        self._poison_pages_jit = jax.jit(_poison)
+        if self.page_len:
+            row = jnp.zeros((self.npages,), jnp.int32)
+            self._install_jit(self.carries, 0, row, jnp.int32(0))
+            self._copy_page_jit(self.carries, 0, 0)
+            self._poison_pages_jit(self.carries, 0, jnp.float32(0.0))
+        self._reset_jit(self.carries, 0)
 
         if metrics is None:
             from deeplearning4j_tpu.observe import get_registry
@@ -89,6 +189,12 @@ class KVSlotPool:
                                          model=model)
         self._g_total.set(self.slots)
         self._g_used.set(0)
+        if self.page_len:
+            self._g_pages = metrics.gauge("serving_kv_pages", model=model)
+            self._g_pages_free = metrics.gauge("serving_kv_pages_free",
+                                               model=model)
+            self._g_pages.set(self.pages)
+            self._g_pages_free.set(len(self._page_free))
 
     def lock(self):
         """The pool lock, for the step critical section: the dispatch
@@ -132,6 +238,100 @@ class KVSlotPool:
             self.carries = self._reset_jit(self.carries, slot)
             self._c_resets.inc()
 
+    # ------------------------------------------------------ paged mode
+    # All `*_locked` methods follow the swap_carries contract: the
+    # caller holds `lock()` for the whole admission / teardown sequence
+    # (match -> alloc -> copy -> install happens atomically w.r.t.
+    # decode windows), and the Condition is non-reentrant so these must
+    # not re-acquire it.
+
+    def pages_free_locked(self) -> int:
+        # graft: allow(GL301): caller holds self._cv by contract
+        return len(self._page_free)
+
+    def page_refcount_locked(self, page: int) -> int:
+        # graft: allow(GL301): caller holds self._cv by contract
+        # graft: allow(GL701): caller holds self._cv by contract (the
+        # *_locked API — no unlocked call path exists)
+        return self._page_ref[page]
+
+    def page_alloc_locked(self, n: int) -> list:
+        """Claim `n` fresh physical pages (refcount 1 each). Raises
+        SlotPoolExhaustedError when the free list is short — the caller
+        (prefix cache) evicts cold refcount-0 chains first and only
+        then gives up."""
+        # graft: allow(GL301): caller holds self._cv by contract
+        if n > len(self._page_free):
+            raise SlotPoolExhaustedError(
+                f"need {n} KV pages, {len(self._page_free)} free "
+                f"(of {self.pages})")
+        # graft: allow(GL301): caller holds self._cv by contract
+        out = [self._page_free.pop() for _ in range(n)]
+        for p in out:
+            # graft: allow(GL301): caller holds self._cv by contract
+            self._page_ref[p] = 1
+        self._g_pages_free.set(len(self._page_free))
+        return out
+
+    def page_ref_locked(self, page: int) -> int:
+        """Take a reference on a live page (a follower session or the
+        radix index adopting it)."""
+        # graft: allow(GL301): caller holds self._cv by contract
+        if self._page_ref[page] <= 0:
+            raise ValueError(f"page {page} is not live")
+        # graft: allow(GL301): caller holds self._cv by contract
+        self._page_ref[page] += 1
+        return self._page_ref[page]
+
+    def page_unref_locked(self, page: int) -> int:
+        """Drop a reference; a page only returns to the free list at
+        refcount 0, so eviction can never reclaim a live session's
+        pages. Freed pages are NOT zeroed: every offset a session can
+        see is either freshly written by its own prefill/decode or part
+        of a matched (still-referenced) prefix page — position
+        arithmetic keeps anything else invisible, and the chaos tests
+        poison freed pages to pin that."""
+        # graft: allow(GL301): caller holds self._cv by contract
+        if self._page_ref[page] <= 0:
+            raise ValueError(f"page {page} is not live")
+        # graft: allow(GL301): caller holds self._cv by contract
+        self._page_ref[page] -= 1
+        if self._page_ref[page] == 0:
+            # graft: allow(GL301): caller holds self._cv by contract
+            self._page_free.append(page)
+            self._g_pages_free.set(len(self._page_free))
+            self._cv.notify_all()
+        return self._page_ref[page]
+
+    def install_pages_locked(self, slot: int, pages: list,
+                             pos: int) -> None:
+        """Point `slot`'s page table at `pages` (padded with physical
+        page 0 — a valid, DMA-able index the kernels' visibility guard
+        never reads) and set its decode position. One jitted program,
+        slot/row/pos traced: zero compiles at admission."""
+        # graft: allow(GL301): caller holds self._cv by contract
+        row = list(pages) + [0] * (self.npages - len(pages))
+        # graft: allow(GL301): caller holds self._cv by contract
+        self.carries = self._install_jit(
+            self.carries, slot, jnp.asarray(row, jnp.int32),
+            jnp.int32(pos))
+
+    def copy_page_locked(self, src: int, dst: int) -> None:
+        """Copy one physical page's K/V (+scales) — the copy-on-write
+        fork at a divergence point inside a shared page."""
+        # graft: allow(GL301): caller holds self._cv by contract
+        self.carries = self._copy_page_jit(self.carries, src, dst)
+
+    def poison_pages_locked(self, pages, value: float) -> None:
+        """Overwrite physical pages with a sentinel (chaos tests: prove
+        freed-page contents are unreachable from live sessions)."""
+        v = jnp.float32(value)
+        for p in pages:
+            # graft: allow(GL301): caller holds self._cv by contract
+            # graft: allow(GL701): caller holds self._cv by contract
+            # (the *_locked API — no unlocked call path exists)
+            self.carries = self._poison_pages_jit(self.carries, p, v)
+
     # ------------------------------------------------------- step seam
     def swap_carries(self, new_carries) -> None:
         """Install the post-step carry tree. Callers hold `lock()` across
@@ -154,8 +354,14 @@ class KVSlotPool:
         pool's) is refused — live int8 caches cannot migrate onto a
         native-dtype tree or vice versa."""
         kd = self.kv_dtype if kv_dtype is None else kv_dtype
-        want = jax.eval_shape(
-            lambda: net.session_carries(self.slots, kv_dtype=kd))
+        if self.page_len:
+            want = jax.eval_shape(
+                lambda: net.session_carries(
+                    self.slots, kv_dtype=kd, page_len=self.page_len,
+                    pages=self.pages))
+        else:
+            want = jax.eval_shape(
+                lambda: net.session_carries(self.slots, kv_dtype=kd))
         have = jax.eval_shape(lambda: self.carries)
         ws, hs = jax.tree_util.tree_structure(want), \
             jax.tree_util.tree_structure(have)
@@ -208,10 +414,15 @@ class KVSlotPool:
     def describe(self) -> dict:
         with self._cv:
             actual, native = self._slot_bytes()
-            return {"total": self.slots,
-                    "in_use": self.slots - len(self._free),
-                    "model": self.model,
-                    "kv_dtype": self.kv_dtype,
-                    "slot_bytes": int(actual),
-                    "slots_per_chip_factor": round(
-                        native / actual, 2) if actual else 1.0}
+            out = {"total": self.slots,
+                   "in_use": self.slots - len(self._free),
+                   "model": self.model,
+                   "kv_dtype": self.kv_dtype,
+                   "slot_bytes": int(actual),
+                   "slots_per_chip_factor": round(
+                       native / actual, 2) if actual else 1.0}
+            if self.page_len:
+                out["page_len"] = self.page_len
+                out["pages"] = self.pages
+                out["pages_free"] = len(self._page_free)
+            return out

@@ -1,0 +1,314 @@
+"""PrefixCache — a page-granular radix index over shared KV pages.
+
+The paged `KVSlotPool` stores attention KV in fixed-size pages; this
+module decides which pages are worth sharing. It is a trie keyed on
+token content at page granularity: every full node is one immutable KV
+page holding exactly `page_len` tokens, edges are the page's token
+tuple (dict lookup — matching a full page is one O(1) probe, not a
+token walk), and each node additionally carries *partial* leaves for
+prefixes that end mid-page. The PyGraph lesson from the serving
+roadmap, applied to prefill: a prompt whose prefix is already resident
+re-executes nothing — admission points the new session's page table at
+the matched chain and prefill starts at the divergence point.
+
+Sharing contract (mechanism in kv_pool, policy here):
+
+- Matched FULL pages are adopted by reference (`page_ref_locked`) and
+  are read-only from every follower's point of view: a follower's
+  writes all land at positions >= its cached prefix, which live in
+  later pages. The donor may still be decoding, but its writes land at
+  positions >= its own prefill stem — beyond every full prefix page —
+  so full pages are immutable by construction, no freeze-copy needed.
+- A match that ends mid-page triggers the ONE copy-on-write fork of an
+  admission: the partial page is copied to a fresh private page and
+  the follower writes from the divergence offset. At most one page is
+  ever copied per session open.
+- Insert adopts the *donor's* pages (one extra refcount held by the
+  cache). A donor's tail page is adopted as a partial leaf even though
+  the donor keeps appending into it: followers fork it before writing
+  and only read offsets below the recorded token count, which prefill
+  finalized — and every copy/install runs under the pool lock, so it
+  serializes with decode windows.
+- Eviction is leaf-first LRU and may only reclaim pages whose pool
+  refcount is exactly 1 (the cache's own reference): a page any live
+  session maps stays resident no matter how cold its chain goes.
+
+Quantized (int8/fp8) pages share bit-exactly: dequantization scales
+are stored per-(token, kv-head) inside the page itself, so a follower
+reading a shared page applies the very scales the donor's prefill
+wrote — there is no per-session calibration to diverge. The tier-1
+suite asserts cross-session bit-equality on shared quantized pages.
+
+Thread-safety: every method must be called with the pool lock held
+(the same `with pool.lock():` critical section that covers page
+alloc/install), mirroring the `*_locked` pool API. The cache keeps no
+lock of its own.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+
+class _Node:
+    """One cached full page (the root holds no page). `children` maps
+    a full page's token tuple to the next node; `partials` are
+    (token_tuple, physical_page, tick) leaves for chains ending
+    mid-page."""
+
+    __slots__ = ("page", "children", "partials", "tick")
+
+    def __init__(self, page: Optional[int] = None):
+        self.page = page
+        self.children = {}
+        self.partials = []
+        self.tick = 0
+
+
+def _lcp(a, b) -> int:
+    n = min(len(a), len(b))
+    for i in range(n):
+        if a[i] != b[i]:
+            return i
+    return n
+
+
+class PrefixCache:
+    """Radix index mapping token prefixes to refcounted page chains."""
+
+    def __init__(self, pool, *, metrics=None):
+        self.pool = pool
+        self.page_len = pool.page_len
+        if not self.page_len:
+            raise ValueError("PrefixCache requires a paged KVSlotPool")
+        self._root = _Node()
+        self._tick = 0
+        if metrics is None:
+            from deeplearning4j_tpu.observe import get_registry
+            metrics = get_registry()
+        m = pool.model
+        self._c_hits = metrics.counter("prefix_cache_hits_total", model=m)
+        self._c_misses = metrics.counter("prefix_cache_misses_total",
+                                         model=m)
+        self._c_hit_tokens = metrics.counter("prefix_cache_hit_tokens_total",
+                                             model=m)
+        self._c_evicted = metrics.counter("prefix_cache_evicted_pages_total",
+                                          model=m)
+        self._c_inserts = metrics.counter("prefix_cache_inserts_total",
+                                          model=m)
+        self._c_cow = metrics.counter("prefix_cache_cow_forks_total",
+                                      model=m)
+
+    # ------------------------------------------------------------ match
+    def match(self, tokens) -> Tuple[int, List[int], Optional[Tuple[int,
+                                                                    int]]]:
+        """Longest cached prefix of `tokens`. Returns `(cached_len,
+        full_pages, partial)` where `full_pages` are physical ids of
+        whole matched pages (adopt by reference) and `partial` is
+        `(physical_page, n_tokens)` when the match ends mid-page (fork
+        before use) or None. Counts a hit iff cached_len > 0. Caller
+        holds the pool lock."""
+        toks = tuple(int(t) for t in tokens)
+        Lp = self.page_len
+        self._tick += 1
+        node, pages, off = self._root, [], 0
+        while off + Lp <= len(toks):
+            child = node.children.get(toks[off:off + Lp])
+            if child is None:
+                break
+            child.tick = self._tick
+            pages.append(child.page)
+            node, off = child, off + Lp
+        # tail: longest common prefix against one more page's worth of
+        # content — a full child's edge or a partial leaf
+        tail = toks[off:off + Lp]
+        best_len, best_page = 0, None
+        if tail:
+            for edge, child in node.children.items():
+                k = _lcp(tail, edge)
+                if k > best_len:
+                    best_len, best_page = k, child.page
+            for ptoks, ppage, _ in node.partials:
+                k = _lcp(tail, ptoks)
+                if k > best_len:
+                    best_len, best_page = k, ppage
+        cached = off + best_len
+        if cached > 0:
+            self._c_hits.inc()
+            self._c_hit_tokens.inc(cached)
+        else:
+            self._c_misses.inc()
+        partial = (best_page, best_len) if best_len else None
+        return cached, pages, partial
+
+    # ----------------------------------------------------------- insert
+    def insert(self, tokens, pages) -> int:
+        """Index a freshly prefilled session's prefix: `pages` is the
+        session's page chain covering `tokens` (page i holds tokens
+        [i*Lp, (i+1)*Lp)). Adopts pages by reference (the cache's own
+        refcount); already-cached chunks are left alone — the donor
+        keeps its private copy, both are valid. Returns the number of
+        pages newly adopted. Caller holds the pool lock."""
+        toks = tuple(int(t) for t in tokens)
+        Lp = self.page_len
+        self._tick += 1
+        node, off, pi, adopted = self._root, 0, 0, 0
+        while off + Lp <= len(toks) and pi < len(pages):
+            chunk = toks[off:off + Lp]
+            child = node.children.get(chunk)
+            if child is None:
+                # a partial leaf that this full chunk extends is now
+                # redundant — the new page covers strictly more tokens
+                # of the same content, so upgrade (drop the short one)
+                keep = []
+                for ptoks, ppage, ptick in node.partials:
+                    if _lcp(ptoks, chunk) == len(ptoks):
+                        self.pool.page_unref_locked(ppage)
+                    else:
+                        keep.append((ptoks, ppage, ptick))
+                node.partials = keep
+                child = _Node(pages[pi])
+                self.pool.page_ref_locked(pages[pi])
+                adopted += 1
+                node.children[chunk] = child
+            child.tick = self._tick
+            node, off, pi = child, off + Lp, pi + 1
+        tail = toks[off:]
+        if tail and pi < len(pages):
+            covered = any(_lcp(tail, e) == len(tail)
+                          for e in node.children)
+            best = None
+            for idx, (ptoks, _, _) in enumerate(node.partials):
+                k = _lcp(tail, ptoks)
+                if k == len(ptoks) and len(tail) > len(ptoks):
+                    best = idx          # existing is a proper prefix
+                if k == len(tail):
+                    covered = True      # tail already fully resident
+            if best is not None and not covered:
+                _, old_page, _ = node.partials[best]
+                self.pool.page_unref_locked(old_page)
+                self.pool.page_ref_locked(pages[pi])
+                adopted += 1
+                node.partials[best] = (tail, pages[pi], self._tick)
+            elif not covered:
+                self.pool.page_ref_locked(pages[pi])
+                adopted += 1
+                node.partials.append((tail, pages[pi], self._tick))
+        if adopted:
+            self._c_inserts.inc()
+        return adopted
+
+    def note_cow_fork(self) -> None:
+        """Admission performed a copy-on-write fork of a partial page."""
+        self._c_cow.inc()
+
+    # --------------------------------------------------------- eviction
+    def _evictable(self):
+        """(tick, unref-thunk) for every leaf whose page only the cache
+        still references. Interior nodes become eligible once their
+        subtree is gone — the loop in evict() re-scans."""
+        out = []
+
+        def walk(node):
+            for i, (_, ppage, ptick) in enumerate(node.partials):
+                if self.pool.page_refcount_locked(ppage) == 1:
+                    out.append((ptick, ("partial", node, i)))
+            for edge, child in node.children.items():
+                if not child.children and not child.partials:
+                    if self.pool.page_refcount_locked(child.page) == 1:
+                        out.append((child.tick, ("node", node, edge)))
+                else:
+                    walk(child)
+
+        walk(self._root)
+        return out
+
+    def evict(self, need_pages: int) -> int:
+        """Leaf-first LRU: release cache references on the coldest
+        chains until `need_pages` pages have returned to the free list
+        or nothing evictable remains. Only pages with pool refcount 1
+        (cache-only) are touched — a live session's pages are
+        untouchable by construction. Returns pages freed. Caller holds
+        the pool lock."""
+        freed = 0
+        while freed < need_pages:
+            cands = self._evictable()
+            if not cands:
+                break
+            cands.sort(key=lambda c: c[0])
+            progress = False
+            for _, (kind, parent, key) in cands:
+                if freed >= need_pages:
+                    break
+                if kind == "partial":
+                    # indexes shift as we pop — re-resolve by identity
+                    if key < len(parent.partials):
+                        _, ppage, _ = parent.partials[key]
+                        if self.pool.page_refcount_locked(ppage) == 1:
+                            parent.partials.pop(key)
+                            self.pool.page_unref_locked(ppage)
+                            freed += 1
+                            progress = True
+                            break   # indices stale — rescan
+                else:
+                    child = parent.children.get(key)
+                    if child is not None and not child.children \
+                            and not child.partials:
+                        del parent.children[key]
+                        self.pool.page_unref_locked(child.page)
+                        freed += 1
+                        progress = True
+            if not progress:
+                break
+        if freed:
+            self._c_evicted.inc(freed)
+        return freed
+
+    def flush(self) -> int:
+        """Drop every cached chain (hot-swap installed new weights: old
+        KV is meaningless for NEW matches; live sessions keep their own
+        references and finish on the pages they hold). Returns pages
+        released. Caller holds the pool lock."""
+        released = 0
+
+        def walk(node):
+            nonlocal released
+            for _, ppage, _ in node.partials:
+                self.pool.page_unref_locked(ppage)
+                released += 1
+            for child in node.children.values():
+                walk(child)
+                self.pool.page_unref_locked(child.page)
+                released += 1
+
+        walk(self._root)
+        self._root = _Node()
+        return released
+
+    # ------------------------------------------------------ inspection
+    def cached_pages(self) -> int:
+        n = 0
+
+        def walk(node):
+            nonlocal n
+            n += len(node.partials)
+            for child in node.children.values():
+                n += 1
+                walk(child)
+
+        walk(self._root)
+        return n
+
+    def stats(self) -> dict:
+        hits = self._c_hits.value
+        misses = self._c_misses.value
+        lookups = hits + misses
+        return {"hits": int(hits),
+                "misses": int(misses),
+                "hit_rate": round(hits / lookups, 4) if lookups else 0.0,
+                "hit_tokens": int(self._c_hit_tokens.value),
+                "inserts": int(self._c_inserts.value),
+                "cow_forks": int(self._c_cow.value),
+                "evicted_pages": int(self._c_evicted.value),
+                "cached_pages": self.cached_pages(),
+                "page_len": self.page_len}

@@ -46,6 +46,19 @@ result rows. `kv_dtype_policy` independently picks the pools' cache
 storage (int8/fp8 with per-(token, kv-head) scales), multiplying
 slots-per-chip at fixed memory.
 
+Prefix cache: when `prefix_cache_policy` verdicts "paged", the pool
+stores KV in fixed-size pages behind per-slot page tables and a radix
+index (`prefix_cache.py`) maps prompt prefixes to refcounted shared
+page chains. Admission matches the prompt stem, adopts the matched
+pages, forks at most one partially-matched page (copy-on-write) and
+installs the slot's table — all under the pool lock, all traced-scalar
+programs — then prefill RESUMES after the cached prefix: a warm prefix
+never re-prefills, so its TTFT approaches one decode window. Completed
+prefills are offered back to the index at the phase-0→1 transition.
+Eviction is leaf-first LRU over refcount-1 (cache-only) pages; a live
+session's pages can never be reclaimed. Mutually exclusive with the
+draft model (a draft's lockstep pool must prefill every token).
+
 Hot-swap: the manager subscribes to registry deploy hooks for its base
 model. In the "warm" phase it verifies the candidate can host the live
 carry tree and pre-compiles its session-step buckets (raising rides
@@ -67,11 +80,13 @@ import numpy as np
 
 from deeplearning4j_tpu.observe import reqtrace
 from deeplearning4j_tpu.ops.kernel_defaults import (
-    decode_loop_policy, kv_dtype_policy, spec_decode_policy,
+    decode_loop_policy, kv_dtype_policy, prefix_cache_policy,
+    spec_decode_policy,
 )
 from deeplearning4j_tpu.serving.kv_pool import (
     IncompatibleSessionSwapError, KVSlotPool, SlotPoolExhaustedError,
 )
+from deeplearning4j_tpu.serving.prefix_cache import PrefixCache
 from deeplearning4j_tpu.serving.registry import ModelEntry
 from deeplearning4j_tpu.serving.scheduler import (
     DeadlineExceededError, RequestShedError, SchedulerClosedError,
@@ -133,6 +148,13 @@ class DecodeSession:
         self._spec_rewind = 0
         self._spec_pre_tok = 0
         self._spec_pre_valid = False
+        # paged prefix-cache bookkeeping (manager-owned): the session's
+        # physical page chain, how many prompt tokens admission found
+        # already cached (prefill skips them), and whether the finished
+        # prefill was offered to the radix index yet
+        self._pages: List[int] = []
+        self._cached_len = 0
+        self._prefix_inserted = False
 
     # -------------------------------------------------------- client API
     def stream(self, timeout: Optional[float] = None):
@@ -187,6 +209,7 @@ class DecodeSessionManager:
                  fused_k: Optional[int] = None,
                  draft_net=None, spec_k: Optional[int] = None,
                  kv_dtype: Optional[str] = None,
+                 page_len: Optional[int] = None,
                  metrics=None, warm: bool = True):
         if prefill_chunk < 1:
             raise ValueError("prefill_chunk must be >= 1")
@@ -258,6 +281,26 @@ class DecodeSessionManager:
         self._spec_reason = spec.reason
         self.draft_name = f"{model}@draft" if self.spec_enabled else None
 
+        # prefix-cache verdict: paged KV + radix prefix reuse. Needs a
+        # net whose attention caches can be paged (non-rolling, uniform
+        # max_cache — prefix_cache_capable) and NO active draft: the
+        # draft's lockstep pool prefills every prompt token into its own
+        # cache, so skipping the target's prefill would desync the pair
+        mc = None
+        for layer in getattr(base.net, "layers", ()):
+            if hasattr(layer, "decode_carry") and \
+                    hasattr(layer, "max_cache"):
+                mc = int(layer.max_cache)
+                break
+        pcap = (mc is not None
+                and getattr(base.net, "prefix_cache_capable",
+                            lambda: False)()
+                and not self.spec_enabled)
+        ppol = prefix_cache_policy(page_len, max_cache=mc, capable=pcap)
+        self.prefix_enabled = ppol.kind == "paged"
+        self.page_len = int(ppol.page_len)
+        self._prefix_reason = ppol.reason
+
         from deeplearning4j_tpu.observe import get_registry
         if metrics is None:
             metrics = get_registry()
@@ -274,8 +317,15 @@ class DecodeSessionManager:
                             else "plain").inc()
             metrics.counter("kernel_dispatch_total", op="kv_dtype",
                             impl=self.kv_dtype).inc()
-        self.pool = KVSlotPool(base.net, slots, model=model,
-                               metrics=metrics, kv_dtype=self.kv_dtype)
+            metrics.counter("kernel_dispatch_total", op="prefix_cache",
+                            impl="paged" if self.prefix_enabled
+                            else "off").inc()
+        self.pool = KVSlotPool(
+            base.net, slots, model=model, metrics=metrics,
+            kv_dtype=self.kv_dtype,
+            page_len=self.page_len if self.prefix_enabled else None)
+        self.prefix_cache = (PrefixCache(self.pool, metrics=metrics)
+                             if self.prefix_enabled else None)
         # the draft rides a lockstep slot pool: slot i of the draft pool
         # always belongs to the session holding slot i of the target
         # pool, so no independent alloc/free bookkeeping — _finish just
@@ -345,6 +395,18 @@ class DecodeSessionManager:
     def _feat_dim(self) -> int:
         return 1 if self._encoding == "ids" else self.vocab
 
+    def _session_carries(self, net):
+        """Build a carry tree shaped exactly like the pool's (paged
+        geometry included) — warmup and swap-compat checks must compile
+        and compare the same programs the live tree will run."""
+        if self.prefix_enabled:
+            return net.session_carries(self.pool.slots,
+                                       kv_dtype=self.kv_dtype,
+                                       page_len=self.pool.page_len,
+                                       pages=self.pool.pages)
+        return net.session_carries(self.pool.slots,
+                                   kv_dtype=self.kv_dtype)
+
     def _compile_buckets(self, net) -> None:
         """Run one all-lanes-inactive step per prefill bucket plus one
         all-lanes-inactive window program (plain fused window, or the
@@ -354,8 +416,7 @@ class DecodeSessionManager:
         hot-swap warm phase `net` is the TARGET candidate; the draft is
         not part of the deploy, so its already-compiled programs feed
         the candidate's verify warmup."""
-        carries = net.session_carries(self.pool.slots,
-                                      kv_dtype=self.kv_dtype)
+        carries = self._session_carries(net)
         S, F = self.pool.slots, self._feat_dim()
         act = np.zeros((S,), bool)
         knobs = dict(temperature=np.ones((S,), np.float32),
@@ -454,10 +515,24 @@ class DecodeSessionManager:
                 # unseeded requests still get independent device streams
                 seed = int(self._seed_rng.integers(0, 2 ** 63))
         slot = self.pool.alloc(alloc_timeout_s)
+        cached_len, pages = 0, []
+        if self.prefix_enabled:
+            try:
+                with self.pool.lock():
+                    cached_len, pages = self._admit_pages(
+                        slot, prompt, int(max_tokens), head)
+            except BaseException:
+                self.pool.free(slot)
+                raise
         sess = DecodeSession(
             f"s{next(self._sid):06d}", slot, prompt,
             max_tokens=max_tokens, params=params, seed=seed,
             deadline_ms=deadline_ms, eos_id=eos_id, trace=trace)
+        sess._pages = pages
+        sess._cached_len = cached_len
+        # prefill resumes AFTER the cached prefix: a fully warm stem
+        # goes straight to the decode window (TTFT ~ one window)
+        sess._off = cached_len
         with self._lock:
             self._sessions[sess.id] = sess
             n_active = len(self._sessions)
@@ -486,6 +561,63 @@ class DecodeSessionManager:
         sess.cancel()
         return True
 
+    # ----------------------------------------------- paged admission
+    def _admit_pages(self, slot: int, prompt: np.ndarray,
+                     max_tokens: int, head: int):
+        """All page bookkeeping for one session happens HERE, under the
+        pool lock, at admission: match the prompt stem against the radix
+        index, adopt the shared full pages by reference, fork (copy) at
+        most ONE partially-matched page, allocate fresh pages for the
+        rest of the token budget, and install the slot's page table +
+        position in one jitted program. Steady-state windows then never
+        touch host page state — page indices are traced scalars inside
+        the compiled step, zero extra syncs and zero recompiles. Returns
+        `(cached_len, page_chain)`. Caller holds the pool lock."""
+        Lp = self.pool.page_len
+        stem = int(prompt.size) - 1
+        cl, shared, partial = self.prefix_cache.match(prompt[:stem])
+        total = int(prompt.size) + max_tokens + head
+        need = -(-total // Lp)          # ceil: whole session footprint
+        n_fresh = need - len(shared)
+        short = n_fresh - self.pool.pages_free_locked()
+        if short > 0:
+            # LRU-evict cold cache-only chains; live pages untouchable
+            self.prefix_cache.evict(short)
+        if self.pool.pages_free_locked() < n_fresh:
+            raise SlotPoolExhaustedError(
+                f"need {n_fresh} KV pages, "
+                f"{self.pool.pages_free_locked()} free after eviction")
+        for p in shared:
+            self.pool.page_ref_locked(p)
+        chain = list(shared) + self.pool.page_alloc_locked(n_fresh)
+        if partial is not None:
+            # the one copy-on-write fork of an admission: the match
+            # ends mid-page, so the follower takes a private copy and
+            # prefill resumes inside it at the divergence offset
+            src, _ = partial
+            self.pool.copy_page_locked(src, chain[len(shared)])
+            self.prefix_cache.note_cow_fork()
+        self.pool.install_pages_locked(slot, chain, cl)
+        return cl, chain
+
+    def _insert_prefix(self, sess: DecodeSession) -> None:
+        """Offer a freshly completed prefill to the radix index (called
+        once, at the session's phase-0 -> phase-1 transition, when every
+        prefill future has resolved). Best-effort: indexing is a perf
+        optimization and must never take down the session chain."""
+        stem = sess.prompt.size - 1
+        if stem <= 0 or not sess._pages:
+            return
+        try:
+            with self.pool.lock():
+                # graft: allow(GL301): guarded by the pool lock just
+                # above — the radix index shares the pool's Condition
+                self.prefix_cache.insert(sess.prompt[:stem], sess._pages)
+        # graft: allow(GL403): cache indexing is best-effort
+        except Exception:
+            logger.exception("prefix-cache insert failed (session %s)",
+                             sess.id)
+
     # --------------------------------------------------- stepping chain
     def _next_row(self, sess: DecodeSession) -> np.ndarray:
         """The session's next request row, fixed width [1, 3 + chunk]:
@@ -504,6 +636,11 @@ class DecodeSessionManager:
                                              self.prefill_chunk)]
             sess._off += toks.size
         else:
+            if self.prefix_enabled and not sess._prefix_inserted:
+                # first decode row => the last prefill future resolved:
+                # the stem's pages hold final KV, index them now
+                sess._prefix_inserted = True
+                self._insert_prefix(sess)
             row[0, 1] = 1.0
             toks = np.asarray([sess.generated[-1] if sess.generated
                                else sess.prompt[-1]], np.int64)
@@ -610,6 +747,15 @@ class DecodeSessionManager:
                 tokens=len(sess.generated),
                 error=None if error is None else type(error).__name__)
         self.pool.free(sess.slot)
+        if self.prefix_enabled and sess._pages:
+            # release the session's page references — free() only wiped
+            # the slot's table/pos rows. Pages the radix index adopted
+            # survive (its own refcount keeps them); purely private
+            # pages drop to zero and return to the free list.
+            with self.pool.lock():
+                for p in sess._pages:
+                    self.pool.page_unref_locked(p)
+            sess._pages = []
         if self.draft_pool is not None:
             # lockstep draft slot: zero the mirror row for the next
             # tenant (reset, not free — the draft pool's free list is
@@ -648,8 +794,8 @@ class DecodeSessionManager:
         Speculating, the window half becomes draft-propose + target-
         verify (plus a mirrored draft prefill), accept/reject stays on
         device, and the ONE host sync per window reads back the verify's
-        packed [S, spec_k+3] rows — counts, catch-up token and emitted
-        tokens together, so speculation never adds a sync."""
+        packed [S, spec_k+4] rows — emit/accept counts, catch-up token
+        and emitted tokens together, so speculation never adds a sync."""
         xs = np.asarray(xs)
         if xs.ndim != 2 or xs.shape[1] != 3 + self.prefill_chunk:
             raise ValueError(
@@ -791,19 +937,22 @@ class DecodeSessionManager:
                     continue
                 n = int(ph[s, 0])
                 emit_n[s] = n
-                # the last emitted token is the correction/bonus, never
-                # a draft proposal — accepted drafts are the n-1 before
-                acc = max(n - 1, 0)
+                # accepted drafts actually EMITTED this window: the
+                # verify's acceptance count, clipped to the emit count —
+                # a token-budget cut mid-window truncates an accepted
+                # run, and acceptance accounting must follow the tokens
+                # that left the device or /metrics' rate drifts
+                acc = min(int(ph[s, 1]), n)
                 acc_n[s] = acc
                 ys[i, 0] = n
-                ys[i, 1:1 + n] = ph[s, 2:2 + n]
+                ys[i, 1:1 + n] = ph[s, 3:3 + n]
                 sess = by_slot.get(s)
                 if sess is not None:
                     # next window's draft entry bookkeeping (safe: this
                     # was the session's one in-flight row)
                     sess._spec_rewind = max(self.spec_k - n, 0)
                     sess._spec_pre_valid = bool(n == self.spec_k + 1)
-                    sess._spec_pre_tok = int(ph[s, 1])
+                    sess._spec_pre_tok = int(ph[s, 2])
                 wtoks += n
                 wdraft += self.spec_k
                 wacc += acc
@@ -879,6 +1028,7 @@ class DecodeSessionManager:
                 tokens=int(emit_n.get(s, 0)), bucket=bucket, rows=k,
                 spec=bool(self.spec_enabled and decode),
                 accepted=int(acc_n.get(s, 0)),
+                prefix_cache=int(sess._cached_len),
                 # graft: allow(GL701): span attribute reads one atomic
                 # str reference; a concurrent hot-swap may label one
                 # window with the outgoing kernel kind — harmless
@@ -897,6 +1047,13 @@ class DecodeSessionManager:
             return
         if phase == "flipped":
             self.pool.rebind(net)
+            if self.prefix_enabled:
+                # old-weight KV is meaningless to NEW sessions under the
+                # new weights: flush every cached chain. Live sessions
+                # keep their own page references and finish coherently
+                # on the pages they hold (the migration contract).
+                with self.pool.lock():
+                    self.prefix_cache.flush()
             with self._lock:
                 self._net = net
                 n = len(self._sessions)
@@ -925,9 +1082,14 @@ class DecodeSessionManager:
                 f"deploy candidate for {self.model!r} cannot rewind its "
                 f"decode caches (recurrent carries or rolling rings) — "
                 f"this manager speculates; rolling back")
-        want = jax.eval_shape(
-            lambda: net.session_carries(self.pool.slots,
-                                        kv_dtype=self.kv_dtype))
+        if self.prefix_enabled and not (
+                hasattr(net, "prefix_cache_capable")
+                and net.prefix_cache_capable()):
+            raise IncompatibleSessionSwapError(
+                f"deploy candidate for {self.model!r} cannot page its "
+                f"KV caches — this manager runs the prefix cache; "
+                f"rolling back")
+        want = jax.eval_shape(lambda: self._session_carries(net))
         have = jax.eval_shape(lambda: self.pool.carries)
         if jax.tree_util.tree_structure(want) != \
                 jax.tree_util.tree_structure(have) or \
@@ -979,7 +1141,19 @@ class DecodeSessionManager:
             },
             "kv_dtype": {"kind": self.kv_dtype,
                          "reason": self._kv_reason},
+            "prefix_cache": self._prefix_snapshot(),
         }
+
+    def _prefix_snapshot(self) -> dict:
+        out = {"enabled": self.prefix_enabled,
+               "page_len": self.page_len if self.prefix_enabled else 0,
+               "reason": self._prefix_reason}
+        if self.prefix_cache is not None:
+            with self.pool.lock():
+                out.update(self.prefix_cache.stats())
+                out["pages"] = self.pool.pages
+                out["pages_free"] = self.pool.pages_free_locked()
+        return out
 
     def _policy_brief(self) -> str:
         """Compact kernel-policy verdict for span attributes: the sorted

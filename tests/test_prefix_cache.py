@@ -1,0 +1,530 @@
+"""Radix-tree prefix cache: paged copy-on-write KV reuse.
+
+What these pin:
+  * the radix index itself (serving/prefix_cache.py): page-granular
+    insert/match, mid-page longest-common-prefix tails, split on
+    divergence, partial-leaf upgrade when a longer chain lands, and
+    refcount-exact adoption/eviction accounting against the pool
+  * the admission contract: a warm prefix NEVER re-prefills — matched
+    full pages are adopted by reference, a mid-page match forks at most
+    ONE copy-on-write page, and the greedy stream is BIT-EXACT against
+    a cold prefill of the same prompt (for native and int8 KV — shared
+    quantized pages carry their own per-(token, head) scales, so
+    sharing is bit-exact by construction)
+  * eviction only ever reclaims cache-only pages (pool refcount 1):
+    a live session's pages are untouchable, and the free/cached/live
+    page accounting reconciles after any open/close sequence
+  * hot-swap coherence: a flipped deploy flushes the radix (stale-KV
+    matches are impossible) while live sessions finish on the pages
+    they hold; incapable candidates roll back
+  * session churn against a warm cache causes ZERO recompiles — page
+    indices are traced scalars inside the one compiled window
+  * the prefix_cache policy seam: env forces, capability degrade, page
+    snapping to a divisor of max_cache, and the
+    kernel_dispatch_total{op="prefix_cache"} verdict mirror
+  * chaos: eviction under page pressure with freed pages poison-filled
+    never corrupts a surviving session; a session killed mid-CoW-fork
+    reconciles every page refcount
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import MultiLayerNetwork
+from deeplearning4j_tpu.nn.config import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.inputs import InputType
+from deeplearning4j_tpu.nn.layers.attention import (
+    PositionEmbeddingLayer, TransformerEncoderBlock,
+)
+from deeplearning4j_tpu.nn.layers.feedforward import EmbeddingSequenceLayer
+from deeplearning4j_tpu.nn.layers.recurrent import RnnOutputLayer
+from deeplearning4j_tpu.observe.registry import MetricsRegistry
+from deeplearning4j_tpu.observe.watchdog import get_watchdog
+from deeplearning4j_tpu.optim.updaters import Adam
+
+V, T = 13, 6
+LP = 4              # page length for every paged plane in this file
+
+
+def _make_net(seed=0, emb=12, max_len=64, window=8, max_cache=16):
+    """Non-rolling decode stack (rolling rings cannot page)."""
+    conf = (NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-3))
+            .activation("identity")
+            .list(EmbeddingSequenceLayer(n_in=V, n_out=emb),
+                  PositionEmbeddingLayer(max_length=max_len),
+                  TransformerEncoderBlock(num_heads=2, causal=True,
+                                          window=window,
+                                          rolling_cache=False,
+                                          max_cache=max_cache),
+                  RnnOutputLayer(n_out=V, activation="softmax"))
+            .set_input_type(InputType.recurrent(1, T)).build())
+    return MultiLayerNetwork(conf).init()
+
+
+@pytest.fixture(scope="module")
+def net():
+    return _make_net()
+
+
+def _plane(net, *, slots=2, chunk=4, page_len=LP, kv_dtype=None):
+    from deeplearning4j_tpu.serving import (
+        ContinuousBatchingScheduler, ModelRegistry, ServingStats,
+    )
+    from deeplearning4j_tpu.serving.sessions import DecodeSessionManager
+
+    registry = ModelRegistry()
+    registry.deploy("default", 1, net, warm=False)
+    stats = ServingStats()
+    sched = ContinuousBatchingScheduler(registry, stats, max_batch_size=8)
+    mgr = DecodeSessionManager(registry, sched, "default", slots=slots,
+                               prefill_chunk=chunk, page_len=page_len,
+                               kv_dtype=kv_dtype, metrics=stats.registry)
+    return registry, sched, mgr
+
+
+def _run(mgr, prompt, max_tokens=4, **kw):
+    sess = mgr.open_session(prompt, max_tokens=max_tokens, greedy=True,
+                            **kw)
+    return sess.result(timeout=60)
+
+
+def _cold(net, prompt, max_tokens=4, **plane_kw):
+    """Reference stream from a fresh, empty-cache plane."""
+    registry, sched, mgr = _plane(net, **plane_kw)
+    try:
+        return _run(mgr, prompt, max_tokens=max_tokens)
+    finally:
+        sched.shutdown()
+        registry.close()
+
+
+# --------------------------------------------------- radix semantics
+class TestRadixIndex:
+    """PrefixCache against a real paged pool, no serving plane: the
+    match/insert/split/evict state machine and its pool refcounts."""
+
+    @pytest.fixture()
+    def pool(self, net):
+        from deeplearning4j_tpu.serving.kv_pool import KVSlotPool
+        return KVSlotPool(net, 2, page_len=LP, metrics=MetricsRegistry())
+
+    @pytest.fixture()
+    def cache(self, pool):
+        from deeplearning4j_tpu.serving import PrefixCache
+        return PrefixCache(pool, metrics=MetricsRegistry())
+
+    def _donate(self, pool, cache, tokens):
+        """Simulate a donor session's prefill: allocate the chain,
+        insert, then drop the session's own references (the cache's
+        survive)."""
+        n = -(-len(tokens) // LP)
+        with pool.lock():
+            chain = pool.page_alloc_locked(n)
+            cache.insert(tokens, chain)
+            for p in chain:
+                pool.page_unref_locked(p)
+        return chain
+
+    def test_insert_then_match_full_and_partial(self, pool, cache):
+        toks = list(range(1, 12))                 # 11 tokens: 2 full + 3
+        chain = self._donate(pool, cache, toks)
+        with pool.lock():
+            cl, full, partial = cache.match(toks)
+            assert cl == 11
+            assert full == chain[:2]
+            assert partial == (chain[2], 3)
+            # every cached page carries exactly the cache's reference
+            for p in chain:
+                assert pool.page_refcount_locked(p) == 1
+
+    def test_match_stops_at_divergence(self, pool, cache):
+        chain = self._donate(pool, cache, [1, 2, 3, 4, 5, 6, 7, 8])
+        with pool.lock():
+            # diverges inside the second page: 1 full page + lcp 2
+            cl, full, partial = cache.match([1, 2, 3, 4, 5, 6, 9, 9])
+            assert (cl, full) == (6, chain[:1])
+            assert partial == (chain[1], 2)
+            # diverges inside the FIRST page: partial-only match
+            cl, full, partial = cache.match([1, 2, 9])
+            assert (cl, full) == (2, [])
+            assert partial == (chain[0], 2)
+            # nothing shared: a miss
+            cl, full, partial = cache.match([9, 9, 9])
+            assert (cl, full, partial) == (0, [], None)
+        st = cache.stats()
+        assert st["hits"] == 2 and st["misses"] == 1
+
+    def test_split_two_chains_share_a_node(self, pool, cache):
+        a = self._donate(pool, cache, [1, 2, 3, 4, 5, 6, 7, 8])
+        b = self._donate(pool, cache, [1, 2, 3, 4, 9, 9, 9, 9])
+        with pool.lock():
+            # the shared first chunk was already cached: chain b's first
+            # page was NOT adopted (donor kept its private copy)
+            assert pool.page_refcount_locked(b[0]) == 0
+            cl_a, full_a, _ = cache.match([1, 2, 3, 4, 5, 6, 7, 8])
+            cl_b, full_b, _ = cache.match([1, 2, 3, 4, 9, 9, 9, 9])
+        assert (cl_a, full_a) == (8, [a[0], a[1]])
+        assert (cl_b, full_b) == (8, [a[0], b[1]])
+
+    def test_partial_upgrade_releases_short_leaf(self, pool, cache):
+        short = self._donate(pool, cache, [1, 2])           # partial (2)
+        longer = self._donate(pool, cache, [1, 2, 3])       # extends it
+        with pool.lock():
+            assert pool.page_refcount_locked(short[0]) == 0  # upgraded
+            assert pool.page_refcount_locked(longer[0]) == 1
+            cl, _, partial = cache.match([1, 2, 3])
+        assert cl == 3 and partial == (longer[0], 3)
+
+    def test_covered_tail_is_not_readopted(self, pool, cache):
+        first = self._donate(pool, cache, [1, 2, 3])
+        second = self._donate(pool, cache, [1, 2])   # strictly shorter
+        with pool.lock():
+            assert pool.page_refcount_locked(second[0]) == 0
+            assert pool.page_refcount_locked(first[0]) == 1
+        assert cache.cached_pages() == 1
+
+    def test_eviction_lru_and_live_pages_untouchable(self, pool, cache):
+        cold = self._donate(pool, cache, [1, 2, 3, 4])
+        hot = self._donate(pool, cache, [5, 6, 7, 8])
+        with pool.lock():
+            # a live session still maps the cold page: pin it
+            pool.page_ref_locked(cold[0])
+            cache.match([5, 6, 7, 8])        # refresh hot's LRU tick
+            freed = cache.evict(2)
+            # only hot was cache-only; the pinned page must survive
+            assert freed == 1
+            assert pool.page_refcount_locked(cold[0]) == 2
+            assert pool.page_refcount_locked(hot[0]) == 0
+            pool.page_unref_locked(cold[0])
+            freed = cache.evict(1)           # now unpinned -> evictable
+            assert freed == 1
+            assert pool.pages_free_locked() == pool.pages
+
+    def test_flush_releases_everything(self, pool, cache):
+        self._donate(pool, cache, [1, 2, 3, 4, 5, 6, 7, 8])
+        self._donate(pool, cache, [1, 2, 3, 4, 9])
+        assert cache.cached_pages() == 3
+        with pool.lock():
+            released = cache.flush()
+            assert released == 3
+            assert cache.cached_pages() == 0
+            assert pool.pages_free_locked() == pool.pages
+
+
+# ------------------------------------------- warm == cold, bit-exact
+class TestWarmParity:
+    def test_warm_full_stem_bit_exact_and_skips_prefill(self, net):
+        prompt = [1, 2, 3, 4, 5, 6, 7, 8, 9]      # stem 8 = 2 pages
+        registry, sched, mgr = _plane(net)
+        try:
+            assert mgr.prefix_enabled
+            cold = _run(mgr, prompt, max_tokens=4)
+            d_cold = mgr.snapshot()["dispatches"]["total"]
+            warm = _run(mgr, prompt, max_tokens=4)
+            snap = mgr.snapshot()
+            assert warm == cold
+            pc = snap["prefix_cache"]
+            assert pc["hits"] == 1 and pc["misses"] == 1
+            assert pc["hit_tokens"] == 8
+            # the warm session's whole prefill vanished: only decode
+            # windows dispatched (cold ran prefill chunks + windows)
+            d_warm = snap["dispatches"]["total"] - d_cold
+            assert d_warm < d_cold
+        finally:
+            sched.shutdown()
+            registry.close()
+
+    def test_cow_fork_parity_vs_cold_prefill(self, net):
+        donor = [1, 2, 3, 4, 5, 6, 7, 8, 9]
+        follower = [1, 2, 3, 4, 5, 6, 9, 9, 9]    # diverges mid-page-2
+        reference = _cold(net, follower, max_tokens=4)
+        registry, sched, mgr = _plane(net)
+        try:
+            _run(mgr, donor, max_tokens=4)
+            got = _run(mgr, follower, max_tokens=4)
+            assert got == reference
+            pc = mgr.snapshot()["prefix_cache"]
+            assert pc["cow_forks"] == 1
+            assert pc["hit_tokens"] == 6           # 1 full page + lcp 2
+        finally:
+            sched.shutdown()
+            registry.close()
+
+    def test_cache_off_stream_parity(self, net, monkeypatch):
+        """The cache is a perf lever, never a correctness lever: the
+        paged plane and the monolithic (env-forced off) plane emit the
+        same greedy stream."""
+        prompt = [2, 4, 6, 8, 1]
+        paged = _cold(net, prompt, max_tokens=5)
+        monkeypatch.setenv("DL4J_TPU_PREFIX_CACHE", "off")
+        registry, sched, mgr = _plane(net)
+        try:
+            assert not mgr.prefix_enabled
+            assert mgr.snapshot()["prefix_cache"]["enabled"] is False
+            assert _run(mgr, prompt, max_tokens=5) == paged
+        finally:
+            sched.shutdown()
+            registry.close()
+
+    def test_int8_shared_pages_bit_exact(self, net):
+        """Quantized pages carry per-(token, kv-head) scales inside the
+        page, so a follower dequantizes with the donor's exact scales:
+        warm int8 == cold int8, bit for bit."""
+        prompt = [3, 1, 4, 1, 5, 9, 2, 6, 5]
+        registry, sched, mgr = _plane(net, kv_dtype="int8")
+        try:
+            cold = _run(mgr, prompt, max_tokens=4)
+            warm = _run(mgr, prompt, max_tokens=4)
+            assert warm == cold
+            pc = mgr.snapshot()["prefix_cache"]
+            assert pc["hits"] == 1 and pc["hit_tokens"] == 8
+        finally:
+            sched.shutdown()
+            registry.close()
+
+
+# ------------------------------------------------- accounting / churn
+class TestPageAccounting:
+    def test_refcounts_reconcile_after_churn(self, net):
+        registry, sched, mgr = _plane(net)
+        try:
+            for i, p in enumerate(([1, 2, 3, 4, 5], [1, 2, 3, 4, 9],
+                                   [7, 7, 7], [1, 2, 3, 4, 5])):
+                _run(mgr, p, max_tokens=3)
+            pc = mgr.snapshot()["prefix_cache"]
+            # every page is either free or held by the cache — no
+            # leaked session references after the sessions finished
+            assert pc["pages_free"] + pc["cached_pages"] == pc["pages"]
+            assert pc["inserts"] >= 2 and pc["hits"] >= 2
+        finally:
+            sched.shutdown()
+            registry.close()
+
+    def test_admission_failure_releases_pages(self, net):
+        from deeplearning4j_tpu.serving import SlotPoolExhaustedError
+        registry, sched, mgr = _plane(net, slots=2)
+        try:
+            _run(mgr, [1, 2, 3, 4, 5, 6, 7, 8, 9], max_tokens=4)
+            with mgr.pool.lock():
+                free0 = mgr.pool.pages_free_locked()
+                # pin every free page so admission cannot be satisfied
+                pinned = mgr.pool.page_alloc_locked(free0)
+            with pytest.raises(SlotPoolExhaustedError):
+                mgr.open_session([9, 8, 7, 6, 5, 4, 3], max_tokens=8,
+                                 alloc_timeout_s=0.0)
+            with mgr.pool.lock():
+                for p in pinned:
+                    mgr.pool.page_unref_locked(p)
+            # the failed admission leaked nothing: the slot and every
+            # adopted/fresh page came back
+            assert mgr.pool.describe()["in_use"] == 0
+            pc = mgr.snapshot()["prefix_cache"]
+            assert pc["pages_free"] + pc["cached_pages"] == pc["pages"]
+        finally:
+            sched.shutdown()
+            registry.close()
+
+    def test_zero_recompiles_warm_churn(self, net):
+        registry, sched, mgr = _plane(net)
+        try:
+            _run(mgr, [1, 2, 3, 4, 5, 6, 7, 8, 9], max_tokens=4)
+            c0 = get_watchdog().compiles()
+            for i in range(3):
+                _run(mgr, [1, 2, 3, 4, 5, 6, 7, 8, 9], max_tokens=4)
+                _run(mgr, [1, 2, 3, 4, 5, 6, 9 - i, 9], max_tokens=3)
+            assert get_watchdog().compiles() == c0, \
+                "warm prefix admission caused recompiles"
+        finally:
+            sched.shutdown()
+            registry.close()
+
+
+# ---------------------------------------------- hot-swap / rebind
+class TestHotSwapCoherence:
+    def test_flipped_deploy_flushes_radix(self, net):
+        registry, sched, mgr = _plane(net)
+        try:
+            prompt = [1, 2, 3, 4, 5, 6, 7, 8, 9]
+            _run(mgr, prompt, max_tokens=4)
+            assert mgr.snapshot()["prefix_cache"]["cached_pages"] > 0
+            v2 = _make_net(seed=5)
+            registry.deploy("default", 2, v2, feat_shape=(T, 1))
+            pc = mgr.snapshot()["prefix_cache"]
+            assert pc["cached_pages"] == 0, "stale KV survived the flip"
+            assert pc["pages_free"] == pc["pages"]
+            # the same prompt under v2 must MISS (then re-index) and
+            # match v2's own cold stream — never the old weights' KV
+            got = _run(mgr, prompt, max_tokens=4)
+            assert got == _cold(v2, prompt, max_tokens=4)
+            assert mgr.snapshot()["prefix_cache"]["misses"] >= 2
+        finally:
+            sched.shutdown()
+            registry.close()
+
+    def test_unpageable_candidate_rolls_back(self, net):
+        from test_decode_sessions import _make_net as _rolling_net
+        from deeplearning4j_tpu.serving.registry import (
+            DeployRolledBackError,
+        )
+        registry, sched, mgr = _plane(net)
+        try:
+            assert mgr.prefix_enabled
+            with pytest.raises(DeployRolledBackError):
+                registry.deploy("default", 2, _rolling_net(seed=9),
+                                feat_shape=(T, 1))
+            assert len(_run(mgr, [1, 2], max_tokens=4)) == 4
+        finally:
+            sched.shutdown()
+            registry.close()
+
+
+# ------------------------------------------------------ policy seam
+class TestPrefixCachePolicy:
+    def test_lattice_and_page_snapping(self, monkeypatch):
+        from deeplearning4j_tpu.ops.kernel_defaults import (
+            prefix_cache_policy,
+        )
+        monkeypatch.delenv("DL4J_TPU_PREFIX_CACHE", raising=False)
+        monkeypatch.delenv("DL4J_TPU_KV_PAGE", raising=False)
+        pol = prefix_cache_policy(max_cache=1024, record=False)
+        assert pol.kind == "paged" and pol.page_len == 128
+        # snapped down to the largest divisor of max_cache
+        assert prefix_cache_policy(max_cache=48,
+                                   record=False).page_len == 48
+        assert prefix_cache_policy(6, max_cache=16,
+                                   record=False).page_len == 4
+        assert prefix_cache_policy(capable=False,
+                                   record=False).kind == "off"
+        monkeypatch.setenv("DL4J_TPU_PREFIX_CACHE", "off")
+        assert prefix_cache_policy(record=False).kind == "off"
+        monkeypatch.setenv("DL4J_TPU_PREFIX_CACHE", "on")
+        assert prefix_cache_policy(record=False).kind == "paged"
+        # forced on but structurally impossible still degrades
+        assert prefix_cache_policy(capable=False,
+                                   record=False).kind == "off"
+        monkeypatch.delenv("DL4J_TPU_PREFIX_CACHE", raising=False)
+        monkeypatch.setenv("DL4J_TPU_KV_PAGE", "8")
+        assert prefix_cache_policy(max_cache=64,
+                                   record=False).page_len == 8
+
+    def test_capability_and_verdict_mirror(self, net):
+        from test_decode_sessions import _make_net as _rolling_net
+        assert net.prefix_cache_capable()
+        assert not _rolling_net().prefix_cache_capable()
+        registry, sched, mgr = _plane(net)
+        try:
+            assert mgr.metrics.counter("kernel_dispatch_total",
+                                       op="prefix_cache",
+                                       impl="paged").value >= 1
+        finally:
+            sched.shutdown()
+            registry.close()
+
+    def test_draft_model_disables_paging(self, net):
+        """Spec decode's lockstep draft pool must prefill every token —
+        the two optimizations are mutually exclusive, draft wins."""
+        from deeplearning4j_tpu.serving import (
+            ContinuousBatchingScheduler, ModelRegistry, ServingStats,
+        )
+        from deeplearning4j_tpu.serving.sessions import (
+            DecodeSessionManager,
+        )
+        registry = ModelRegistry()
+        registry.deploy("default", 1, net, warm=False)
+        stats = ServingStats()
+        sched = ContinuousBatchingScheduler(registry, stats,
+                                            max_batch_size=8)
+        mgr = DecodeSessionManager(registry, sched, "default", slots=2,
+                                   draft_net=net, spec_k=4,
+                                   metrics=stats.registry)
+        try:
+            assert mgr.spec_enabled and not mgr.prefix_enabled
+            assert "draft" in mgr.snapshot()["prefix_cache"]["reason"]
+        finally:
+            sched.shutdown()
+            registry.close()
+
+
+# ------------------------------------------------------------- chaos
+POISON = 7777.0      # finite: NaNs would mask "never read" as "read"
+
+
+@pytest.mark.chaos
+class TestPrefixCacheChaos:
+    def test_eviction_under_pressure_with_poisoned_free_pages(self, net):
+        """Fill the radix, open a live session, then poison every FREED
+        page and force eviction-driven churn: the survivor's stream
+        must be bit-exact — eviction may only ever touch pages no live
+        session maps, and a freed page's stale bytes must be invisible
+        to subsequent tenants."""
+        survivor_prompt = [1, 2, 3, 4, 5, 6, 7, 8, 9]
+        reference = _cold(net, survivor_prompt, max_tokens=6)
+        registry, sched, mgr = _plane(net, slots=2)
+        try:
+            # warm the radix with the survivor's prefix, then start the
+            # survivor but DON'T drain it yet
+            _run(mgr, survivor_prompt, max_tokens=2)
+            survivor = mgr.open_session(survivor_prompt, max_tokens=6,
+                                        greedy=True)
+            # churn disjoint prompts through the other slot: each needs
+            # fresh pages, forcing LRU eviction of cache-only chains
+            for i in range(3):
+                _run(mgr, [10 + i % 3, 9 - i, 8, 7, 6, 5], max_tokens=3)
+                with mgr.pool.lock():
+                    free = [p for p in range(mgr.pool.pages)
+                            if mgr.pool.page_refcount_locked(p) == 0]
+                    mgr.pool.poison_pages_locked(free, POISON)
+            assert survivor.result(timeout=60) == reference, \
+                "eviction/poison corrupted a live session's pages"
+            pc = mgr.snapshot()["prefix_cache"]
+            assert pc["evicted_pages"] > 0, "pressure never evicted"
+            assert pc["pages_free"] + pc["cached_pages"] == pc["pages"]
+        finally:
+            sched.shutdown()
+            registry.close()
+
+    def test_kill_mid_cow_fork_reconciles_refcounts(self, net):
+        """Die between the CoW admission and the first window: the
+        forked private page and every adopted shared page must come
+        back, and the donor's cached chain must still serve warm hits.
+        The kill is driven deterministically through the admission path
+        (admission is synchronous; the 'session' dies before its first
+        dispatch), then the real cancel path is exercised on top."""
+        donor = [1, 2, 3, 4, 5, 6, 7, 8, 9]
+        follower = [1, 2, 3, 4, 5, 6, 9, 9, 9]
+        registry, sched, mgr = _plane(net, slots=2)
+        try:
+            _run(mgr, donor, max_tokens=4)
+            with mgr.pool.lock():
+                ref0 = [mgr.pool.page_refcount_locked(p)
+                        for p in range(mgr.pool.pages)]
+            slot = mgr.pool.alloc(0.0)
+            with mgr.pool.lock():
+                cl, chain = mgr._admit_pages(
+                    slot, np.asarray(follower, np.int64), 4, 0)
+            assert cl == 6            # 1 shared page + lcp 2 into page 2
+            assert mgr.snapshot()["prefix_cache"]["cow_forks"] == 1
+            # the kill: exactly what _finish does for a dead session
+            mgr.pool.free(slot)
+            with mgr.pool.lock():
+                for p in chain:
+                    mgr.pool.page_unref_locked(p)
+                ref1 = [mgr.pool.page_refcount_locked(p)
+                        for p in range(mgr.pool.pages)]
+            assert ref1 == ref0, "mid-CoW kill leaked page references"
+            # the real cancel path on a live follower: whatever window
+            # count it reached, the global accounting must reconcile
+            f = mgr.open_session(follower, max_tokens=7, greedy=True)
+            f.cancel()
+            assert f.done.wait(30)
+            assert mgr.pool.describe()["in_use"] == 0
+            pc = mgr.snapshot()["prefix_cache"]
+            assert pc["pages_free"] + pc["cached_pages"] == pc["pages"]
+            # the donor's chain still serves: warm full-stem hit
+            hits0 = pc["hits"]
+            got = _run(mgr, donor, max_tokens=4)
+            assert got == _cold(net, donor, max_tokens=4)
+            assert mgr.snapshot()["prefix_cache"]["hits"] == hits0 + 1
+        finally:
+            sched.shutdown()
+            registry.close()

@@ -421,6 +421,32 @@ class TestSpecObservability:
             sched.shutdown()
             registry.close()
 
+    def test_budget_truncated_window_counts_only_emitted(self, net):
+        """Regression: a token budget that cuts a fully-accepted window
+        mid-stream must count only the accepted drafts actually EMITTED.
+        Self-draft at spec_k=4 with max_tokens=2 runs exactly one
+        window: the verify accepts all 4 proposals (plus bonus), but
+        only 2 tokens leave the device — the acceptance counter says 2,
+        not the window's internal 4 (the old inflated accounting made
+        acceptance_rate lie above the emitted throughput)."""
+        registry, sched, mgr = _plane(net, draft=net, spec_k=4)
+        try:
+            sess = mgr.open_session([1, 2, 3], max_tokens=2, greedy=True)
+            got = sess.result(timeout=60)
+            assert len(got) == 2
+            reg = mgr.metrics
+            drafted = reg.counter("draft_tokens_total",
+                                  model="default").value
+            accepted = reg.counter("accepted_tokens_total",
+                                   model="default").value
+            assert drafted == 4
+            assert accepted == 2, \
+                "truncated window counted unreachable accepted drafts"
+            assert mgr.snapshot()["spec_decode"]["acceptance_rate"] == 0.5
+        finally:
+            sched.shutdown()
+            registry.close()
+
     def test_hot_swap_refuses_unrewindable_candidate(self, net):
         """Deploying a rolling-ring candidate onto a speculating manager
         must roll back — live sessions keep the rewindable version."""

@@ -5,7 +5,8 @@
 #   tools/ci_check.sh            # full gate
 #   tools/ci_check.sh --lint     # lint gate only (seconds)
 #   tools/ci_check.sh --perf     # perf gate only (recompiles + syncs/step
-#                                #   vs .graftperf-baseline.json)
+#                                #   vs .graftperf-baseline.json, incl.
+#                                #   decode/spec/warm-prefix legs)
 #   tools/ci_check.sh --chaos    # fault-injection / failover suite only
 #   tools/ci_check.sh --trace    # request-tracing smoke: one sampled
 #                                #   /generate must reconstruct an

@@ -263,7 +263,8 @@ def render_bench(path: str, *, mode: str = "", width: int = 40) -> str:
         for extra in ("mfu", "ttft_p99_ms", "itl_p99_ms",
                       "continuous_p99_ms", "opt_state_shard_factor",
                       "spec_tokens_per_s", "spec_acceptance_rate",
-                      "spec_speedup_vs_stepwise"):
+                      "spec_speedup_vs_stepwise",
+                      "prefix_hit_rate", "prefix_ttft_speedup"):
             evals = [r[extra] for r in rs
                      if isinstance(r.get(extra), (int, float))]
             if evals:
@@ -286,6 +287,21 @@ def render_bench(path: str, *, mode: str = "", width: int = 40) -> str:
                     + (f", {_fmt(slots)}x slots/chip"
                        if isinstance(slots, (int, float))
                        and slots != 1.0 else ""))
+        # the prefix-cache panel from the latest run: warm-vs-cold
+        # TTFT plus the radix counters (evictions, CoW forks)
+        if isinstance(last.get("prefix_ttft_speedup"), (int, float)):
+            bits = [f"{_fmt(last['prefix_ttft_speedup'])}x TTFT "
+                    f"warm-vs-cold"]
+            if isinstance(last.get("prefix_hit_rate"), (int, float)):
+                bits.append(f"hit rate {_fmt(last['prefix_hit_rate'])}")
+            for key, tag in (("prefix_cow_forks", "CoW forks"),
+                             ("prefix_evicted_pages", "evictions"),
+                             ("prefix_no_overlap_ttft_ratio",
+                              "no-overlap ratio")):
+                if isinstance(last.get(key), (int, float)):
+                    bits.append(f"{tag} {_fmt(last[key])}")
+            lines.append("  prefix cache (latest run): "
+                         + ", ".join(bits))
         if last.get("error"):
             lines.append("  last run FAILED (see its BENCH_*.json)")
     return "\n".join(lines) + "\n"

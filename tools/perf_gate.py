@@ -33,7 +33,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 BASELINE_PATH = os.path.join(os.path.dirname(__file__), "..",
                              ".graftperf-baseline.json")
-WORKLOAD_VERSION = 6
+WORKLOAD_VERSION = 7
 
 # Default slack written into a fresh baseline: zero extra compiles (a
 # new program IS the regression being hunted) and half a sync of noise
@@ -70,7 +70,16 @@ DEFAULT_BUDGETS = {"extra_compiles_per_owner": 0,
                    # the verify/rewind bookkeeping broke, not the draft)
                    "extra_spec_syncs_per_window": 0.5,
                    "extra_spec_compiles": 0,
-                   "min_spec_acceptance_rate": 0.6}
+                   "min_spec_acceptance_rate": 0.6,
+                   # the radix prefix cache keeps both fused-window
+                   # contracts on WARM admissions — page bookkeeping is
+                   # host-side and page indices are traced scalars, so a
+                   # warm session adds zero syncs and zero compiles —
+                   # and the deterministic shared-stem workload (1 miss
+                   # + 4 full-stem hits) must keep its hit rate
+                   "extra_prefix_syncs_per_window": 0.5,
+                   "extra_prefix_compiles": 0,
+                   "min_prefix_hit_rate": 0.8}
 
 
 def run_workload() -> dict:
@@ -363,6 +372,67 @@ def run_workload() -> dict:
             sched.shutdown()
             registry.close()
 
+        # --- warm-prefix leg: session churn over a SHARED prompt stem
+        # through the paged radix prefix cache. Three contracts: a warm
+        # admission (full-stem hit) adds zero host syncs beyond the one
+        # window readback (page bookkeeping is host-side, under the
+        # pool lock), churn against a warm radix compiles NOTHING
+        # (page-table indices are traced scalars in the one compiled
+        # window), and the deterministic 1-miss + 4-hit workload keeps
+        # hit_rate >= the floor.
+        registry = ModelRegistry()
+        nnet = _spec_net(1)      # non-rolling: paged-capable
+        registry.deploy("default", 1, nnet, warm=False)
+        stats = ServingStats()
+        sched = ContinuousBatchingScheduler(registry, stats,
+                                            max_batch_size=8)
+        prefix = None
+        try:
+            mgr = DecodeSessionManager(registry, sched, "default",
+                                       slots=2, prefill_chunk=4,
+                                       fused_k=K, page_len=8,
+                                       metrics=stats.registry)
+            assert mgr.prefix_enabled, "paged-capable net stayed off"
+            # the donor: seeds the radix AND warms every program
+            prompt = [1, 2, 3, 4, 5, 6, 7, 8, 9]
+            mgr.open_session(prompt, max_tokens=6,
+                             greedy=True).result(timeout=60)
+            before = mgr.snapshot()["dispatches"]
+            compiles_warm = get_watchdog().snapshot()["total_compiles"]
+            mon = HostSyncMonitor().install()
+            try:
+                for wave in range(2):      # churn: 2 waves x 2 slots
+                    ss = [mgr.open_session(prompt, max_tokens=6,
+                                           seed=wave * 2 + i)
+                          for i in range(2)]
+                    for s in ss:
+                        s.result(timeout=60)
+            finally:
+                mon.uninstall()
+            snap_after = mgr.snapshot()
+            after = snap_after["dispatches"]
+            windows = after["windows"] - before["windows"]
+            pc = snap_after["prefix_cache"]
+            prefix = {
+                "page_len": pc["page_len"],
+                "windows": windows,
+                "syncs_per_window": round(mon.syncs / windows, 3)
+                if windows else None,
+                "extra_compiles":
+                    get_watchdog().snapshot()["total_compiles"]
+                    - compiles_warm,
+                "hit_rate": pc["hit_rate"],
+                "hit_tokens": pc["hit_tokens"],
+                "cow_forks": pc["cow_forks"],
+                # warm admissions dispatch NO prefill rows: every
+                # dispatch in the measured churn is a decode window
+                "prefill_free": (after["total"] - before["total"]
+                                 == windows),
+            }
+        finally:
+            sched.shutdown()
+            registry.close()
+
         # --- sharded fit: the GSPMD spine (data-sharded batch, replica-
         # sharded Adam moments). Placement regressions show up here as
         # extra syncs (collective fell back to host), extra
@@ -422,6 +492,7 @@ def run_workload() -> dict:
         "series": series,
         "decode": decode,
         "spec": spec,
+        "prefix": prefix,
         "sharded": sharded,
     }
 
@@ -544,6 +615,42 @@ def compare(baseline: dict, measured: dict) -> list:
                 f"on the deterministic truncated-draft workload — the "
                 f"draft IS the target's lower half here, so a low rate "
                 f"means verify/rewind bookkeeping corrupted lane state")
+    # warm-prefix leg: only gated once a baseline recorded it
+    if baseline.get("prefix"):
+        base_p = baseline["prefix"]
+        meas_p = measured.get("prefix") or {}
+        p_limit = (base_p.get("syncs_per_window") or 0.0) + \
+            budgets["extra_prefix_syncs_per_window"]
+        if (meas_p.get("syncs_per_window") or 0.0) > p_limit:
+            breaches.append(
+                f"warm-prefix syncs/window "
+                f"{meas_p.get('syncs_per_window')} vs baseline "
+                f"{base_p.get('syncs_per_window')} (budget "
+                f"+{budgets['extra_prefix_syncs_per_window']}) — warm "
+                f"admission is host-side page bookkeeping by contract "
+                f"(PERF_NOTES); a radix match or page install is "
+                f"materializing device values")
+        p_budget = budgets["extra_prefix_compiles"]
+        if meas_p.get("extra_compiles", 0) > p_budget:
+            breaches.append(
+                f"warm-prefix churn compiled "
+                f"{meas_p.get('extra_compiles')} program(s) after "
+                f"warmup (budget +{p_budget}) — page-table indices are "
+                f"traced scalars; a warm admission never mints a "
+                f"program")
+        floor = budgets["min_prefix_hit_rate"]
+        rate = meas_p.get("hit_rate")
+        if rate is not None and rate < floor:
+            breaches.append(
+                f"prefix-cache hit rate {rate} < floor {floor} on the "
+                f"deterministic shared-stem workload (1 miss + 4 "
+                f"full-stem hits) — the radix stopped matching or "
+                f"insert stopped indexing")
+        if meas_p.get("prefill_free") is False:
+            breaches.append(
+                "warm-prefix sessions dispatched prefill rows — a warm "
+                "full-stem admission skips its ENTIRE prefill by "
+                "contract (PERF_NOTES)")
     # sharded-spine leg: only gated once a baseline recorded it
     base_sh = baseline.get("sharded")
     if base_sh:
@@ -611,6 +718,12 @@ def diff(baseline: dict, measured: dict) -> list:
         m = (measured.get("spec") or {}).get(key)
         if b != m:
             out.append(f"  spec.{key}: {b} -> {m}")
+    for key in ("syncs_per_window", "extra_compiles", "hit_rate",
+                "cow_forks"):
+        b = (baseline.get("prefix") or {}).get(key)
+        m = (measured.get("prefix") or {}).get(key)
+        if b != m:
+            out.append(f"  prefix.{key}: {b} -> {m}")
     return out
 
 
