@@ -123,15 +123,27 @@ class PrefixCache:
         # content — a full child's edge or a partial leaf
         tail = toks[off:off + Lp]
         best_len, best_page = 0, None
+        best_child, best_pidx = None, None
         if tail:
             for edge, child in node.children.items():
                 k = _lcp(tail, edge)
                 if k > best_len:
                     best_len, best_page = k, child.page
-            for ptoks, ppage, _ in node.partials:
+                    best_child, best_pidx = child, None
+            for i, (ptoks, ppage, _) in enumerate(node.partials):
                 k = _lcp(tail, ptoks)
                 if k > best_len:
                     best_len, best_page = k, ppage
+                    best_child, best_pidx = None, i
+        # refresh the winner's LRU tick: a partially-matched page is as
+        # hot as a fully-matched one — without this, recently-hit
+        # partial leaves and tail children sort as coldest and evict
+        # first under pressure
+        if best_child is not None:
+            best_child.tick = self._tick
+        elif best_pidx is not None:
+            ptoks, ppage, _ = node.partials[best_pidx]
+            node.partials[best_pidx] = (ptoks, ppage, self._tick)
         cached = off + best_len
         if cached > 0:
             self._c_hits.inc()
@@ -210,9 +222,9 @@ class PrefixCache:
         out = []
 
         def walk(node):
-            for i, (_, ppage, ptick) in enumerate(node.partials):
+            for ptoks, ppage, ptick in node.partials:
                 if self.pool.page_refcount_locked(ppage) == 1:
-                    out.append((ptick, ("partial", node, i)))
+                    out.append((ptick, ("partial", node, (ptoks, ppage))))
             for edge, child in node.children.items():
                 if not child.children and not child.partials:
                     if self.pool.page_refcount_locked(child.page) == 1:
@@ -241,15 +253,21 @@ class PrefixCache:
                 if freed >= need_pages:
                     break
                 if kind == "partial":
-                    # indexes shift as we pop — re-resolve by identity
-                    if key < len(parent.partials):
-                        _, ppage, _ = parent.partials[key]
+                    # the partials list mutates as entries pop, so the
+                    # candidate is re-resolved by its (tokens, page)
+                    # identity — a list index could name a DIFFERENT
+                    # (hotter) partial after an earlier pop and violate
+                    # LRU order
+                    for i, (ptoks, ppage, _) in \
+                            enumerate(parent.partials):
+                        if (ptoks, ppage) != key:
+                            continue
                         if self.pool.page_refcount_locked(ppage) == 1:
-                            parent.partials.pop(key)
+                            parent.partials.pop(i)
                             self.pool.page_unref_locked(ppage)
                             freed += 1
                             progress = True
-                            break   # indices stale — rescan
+                        break
                 else:
                     child = parent.children.get(key)
                     if child is not None and not child.children \

@@ -155,6 +155,10 @@ class DecodeSession:
         self._pages: List[int] = []
         self._cached_len = 0
         self._prefix_inserted = False
+        # the manager's radix deploy generation at admission: when a
+        # hot-swap flips mid-stream, this session's KV belongs to the
+        # old weights and must not be offered back to the radix index
+        self._gen = 0
 
     # -------------------------------------------------------- client API
     def stream(self, timeout: Optional[float] = None):
@@ -326,6 +330,12 @@ class DecodeSessionManager:
             page_len=self.page_len if self.prefix_enabled else None)
         self.prefix_cache = (PrefixCache(self.pool, metrics=metrics)
                              if self.prefix_enabled else None)
+        # radix deploy generation (guarded by the pool lock): bumped at
+        # every hot-swap flip alongside flush(). A session stamped with
+        # an older generation prefilled under the OLD weights — its KV
+        # must never be re-indexed after the flip, or new sessions would
+        # match stale-weight pages and decode wrong logits silently.
+        self._prefix_gen = 0
         # the draft rides a lockstep slot pool: slot i of the draft pool
         # always belongs to the session holding slot i of the target
         # pool, so no independent alloc/free bookkeeping — _finish just
@@ -515,10 +525,13 @@ class DecodeSessionManager:
                 # unseeded requests still get independent device streams
                 seed = int(self._seed_rng.integers(0, 2 ** 63))
         slot = self.pool.alloc(alloc_timeout_s)
-        cached_len, pages = 0, []
+        cached_len, pages, gen = 0, [], 0
         if self.prefix_enabled:
             try:
                 with self.pool.lock():
+                    # graft: allow(GL301): guarded by the pool lock just
+                    # above — _prefix_gen shares the pool's Condition
+                    gen = self._prefix_gen
                     cached_len, pages = self._admit_pages(
                         slot, prompt, int(max_tokens), head)
             except BaseException:
@@ -530,6 +543,7 @@ class DecodeSessionManager:
             deadline_ms=deadline_ms, eos_id=eos_id, trace=trace)
         sess._pages = pages
         sess._cached_len = cached_len
+        sess._gen = gen
         # prefill resumes AFTER the cached prefix: a fully warm stem
         # goes straight to the decode window (TTFT ~ one window)
         sess._off = cached_len
@@ -576,28 +590,55 @@ class DecodeSessionManager:
         Lp = self.pool.page_len
         stem = int(prompt.size) - 1
         cl, shared, partial = self.prefix_cache.match(prompt[:stem])
-        total = int(prompt.size) + max_tokens + head
-        need = -(-total // Lp)          # ceil: whole session footprint
-        n_fresh = need - len(shared)
-        short = n_fresh - self.pool.pages_free_locked()
-        if short > 0:
-            # LRU-evict cold cache-only chains; live pages untouchable
-            self.prefix_cache.evict(short)
-        if self.pool.pages_free_locked() < n_fresh:
-            raise SlotPoolExhaustedError(
-                f"need {n_fresh} KV pages, "
-                f"{self.pool.pages_free_locked()} free after eviction")
-        for p in shared:
-            self.pool.page_ref_locked(p)
-        chain = list(shared) + self.pool.page_alloc_locked(n_fresh)
+        # pin every matched page BEFORE the eviction pass below can run:
+        # match() leaves a cache-only chain at refcount 1, which the LRU
+        # sweep would be free to reclaim out from under this very
+        # admission. Refcount 2 (cache + us) makes the matched pages
+        # unevictable by construction. The shared-page pins double as
+        # the session's own references; the partial source's pin is
+        # transient — it only has to survive until the CoW copy.
+        pinned = list(shared)
         if partial is not None:
-            # the one copy-on-write fork of an admission: the match
-            # ends mid-page, so the follower takes a private copy and
-            # prefill resumes inside it at the divergence offset
-            src, _ = partial
-            self.pool.copy_page_locked(src, chain[len(shared)])
-            self.prefix_cache.note_cow_fork()
-        self.pool.install_pages_locked(slot, chain, cl)
+            pinned.append(partial[0])
+        for p in pinned:
+            self.pool.page_ref_locked(p)
+        fresh = []
+        try:
+            total = int(prompt.size) + max_tokens + head
+            need = -(-total // Lp)      # ceil: whole session footprint
+            n_fresh = need - len(shared)
+            short = n_fresh - self.pool.pages_free_locked()
+            if short > 0:
+                # LRU-evict cold cache-only chains; live (and pinned)
+                # pages untouchable
+                self.prefix_cache.evict(short)
+            if self.pool.pages_free_locked() < n_fresh:
+                raise SlotPoolExhaustedError(
+                    f"need {n_fresh} KV pages, "
+                    f"{self.pool.pages_free_locked()} free after "
+                    f"eviction")
+            fresh = self.pool.page_alloc_locked(n_fresh)
+            chain = list(shared) + fresh
+            if partial is not None:
+                # the one copy-on-write fork of an admission: the match
+                # ends mid-page, so the follower takes a private copy
+                # and prefill resumes inside it at the divergence offset
+                src, _ = partial
+                self.pool.copy_page_locked(src, chain[len(shared)])
+                self.prefix_cache.note_cow_fork()
+            self.pool.install_pages_locked(slot, chain, cl)
+        except BaseException:
+            # no page escapes a failed admission: drop the fresh pages
+            # and every pin taken above
+            for p in fresh:
+                self.pool.page_unref_locked(p)
+            for p in pinned:
+                self.pool.page_unref_locked(p)
+            raise
+        if partial is not None:
+            # copy done — the partial source goes back to cache-only
+            # (the session keeps the private copy, not the source)
+            self.pool.page_unref_locked(partial[0])
         return cl, chain
 
     def _insert_prefix(self, sess: DecodeSession) -> None:
@@ -610,6 +651,13 @@ class DecodeSessionManager:
             return
         try:
             with self.pool.lock():
+                if sess._gen != self._prefix_gen:
+                    # a hot-swap flipped between this session's
+                    # admission and its first decode row: its pages
+                    # hold OLD-weight KV. flush() already dropped that
+                    # generation's chains — re-indexing them here would
+                    # hand stale KV to new-weight matches.
+                    return
                 # graft: allow(GL301): guarded by the pool lock just
                 # above — the radix index shares the pool's Condition
                 self.prefix_cache.insert(sess.prompt[:stem], sess._pages)
@@ -1053,6 +1101,11 @@ class DecodeSessionManager:
                 # keep their own page references and finish coherently
                 # on the pages they hold (the migration contract).
                 with self.pool.lock():
+                    # graft: allow(GL301): guarded by the pool lock
+                    # just above — _prefix_gen shares the Condition.
+                    # Bump first so in-flight old-generation sessions
+                    # can never re-index the chains flush() drops.
+                    self._prefix_gen += 1
                     self.prefix_cache.flush()
             with self._lock:
                 self._net = net

@@ -200,6 +200,46 @@ class TestRadixIndex:
             assert freed == 1
             assert pool.pages_free_locked() == pool.pages
 
+    def test_match_refreshes_partial_and_tail_child_ticks(self, pool,
+                                                          cache):
+        """LRU fairness: a match that lands on a partial leaf, or ends
+        inside a full child's edge, marks that page hot — eviction must
+        take the genuinely colder chain first, not the one whose tick
+        match() forgot to refresh."""
+        hot = self._donate(pool, cache, [1, 2, 3])    # partial leaf
+        cold = self._donate(pool, cache, [5, 6, 7])   # newer partial
+        with pool.lock():
+            cache.match([1, 2, 9])    # partial hit -> hot refreshed
+            assert cache.evict(1) == 1
+            assert pool.page_refcount_locked(hot[0]) == 1, \
+                "a just-matched partial leaf was evicted as coldest"
+            assert pool.page_refcount_locked(cold[0]) == 0
+        # same for a match ending inside a full child's edge
+        a = self._donate(pool, cache, [1, 2, 3, 4])   # full page
+        b = self._donate(pool, cache, [5, 6, 7, 8])   # newer full page
+        with pool.lock():
+            cache.match([1, 2, 9])    # tail-child hit -> a refreshed
+            assert cache.evict(1) == 1
+            assert pool.page_refcount_locked(a[0]) == 1, \
+                "a just-matched tail child was evicted as coldest"
+            assert pool.page_refcount_locked(b[0]) == 0
+
+    def test_evict_partials_in_lru_order_by_identity(self, pool, cache):
+        """Several partial leaves under one node: eviction pops
+        strictly coldest-first even as earlier pops shift the list —
+        candidates re-resolve by (tokens, page) identity, never by a
+        stale list index."""
+        a = self._donate(pool, cache, [1, 2])
+        b = self._donate(pool, cache, [3, 4])
+        c = self._donate(pool, cache, [5, 6])
+        with pool.lock():
+            cache.match([3, 4])       # b is now the hottest
+            assert cache.evict(2) == 2
+            assert pool.page_refcount_locked(b[0]) == 1, \
+                "LRU order violated: the hot partial went first"
+            assert pool.page_refcount_locked(a[0]) == 0
+            assert pool.page_refcount_locked(c[0]) == 0
+
     def test_flush_releases_everything(self, pool, cache):
         self._donate(pool, cache, [1, 2, 3, 4, 5, 6, 7, 8])
         self._donate(pool, cache, [1, 2, 3, 4, 9])
@@ -324,6 +364,46 @@ class TestPageAccounting:
             sched.shutdown()
             registry.close()
 
+    def test_matched_pages_pinned_against_admission_eviction(self, net):
+        """Page pressure during admission must never evict the chain
+        match() just returned: matched pages (shared full pages AND the
+        partial CoW source) are pinned to refcount 2 before the LRU
+        sweep runs, so a too-short pool fails with a clean
+        SlotPoolExhaustedError — not a page_ref ValueError on a freed
+        page — and the cached chain survives intact."""
+        from deeplearning4j_tpu.serving import SlotPoolExhaustedError
+        prompt = [1, 2, 3, 4, 5, 6, 7, 8, 9]      # stem 8 = 2 pages
+        registry, sched, mgr = _plane(net, slots=2)
+        try:
+            reference = _run(mgr, prompt, max_tokens=4)
+            with mgr.pool.lock():
+                pinned = mgr.pool.page_alloc_locked(
+                    mgr.pool.pages_free_locked())
+            # full-stem warm admission: both matched pages are
+            # cache-only (refcount 1) and would be the LRU sweep's only
+            # candidates — the pin must keep them out of its reach
+            with pytest.raises(SlotPoolExhaustedError):
+                mgr.open_session(prompt, max_tokens=1,
+                                 alloc_timeout_s=0.0)
+            # mid-page divergence: same pressure, now with the CoW
+            # source page pinned transiently too
+            with pytest.raises(SlotPoolExhaustedError):
+                mgr.open_session([1, 2, 3, 4, 5, 6, 9, 9, 9],
+                                 max_tokens=1, alloc_timeout_s=0.0)
+            with mgr.pool.lock():
+                for p in pinned:
+                    mgr.pool.page_unref_locked(p)
+            pc = mgr.snapshot()["prefix_cache"]
+            assert pc["cached_pages"] == 2, \
+                "admission eviction ate the matched chain"
+            assert pc["pages_free"] + pc["cached_pages"] == pc["pages"]
+            assert mgr.pool.describe()["in_use"] == 0
+            # the surviving chain still serves warm, bit-exact
+            assert _run(mgr, prompt, max_tokens=4) == reference
+        finally:
+            sched.shutdown()
+            registry.close()
+
     def test_zero_recompiles_warm_churn(self, net):
         registry, sched, mgr = _plane(net)
         try:
@@ -357,6 +437,54 @@ class TestHotSwapCoherence:
             got = _run(mgr, prompt, max_tokens=4)
             assert got == _cold(v2, prompt, max_tokens=4)
             assert mgr.snapshot()["prefix_cache"]["misses"] >= 2
+        finally:
+            sched.shutdown()
+            registry.close()
+
+    def test_straddling_session_never_reindexes_after_flip(self, net):
+        """A session admitted under the OLD weights whose first decode
+        row lands after the flip must NOT repopulate the flushed radix:
+        its pages hold old-weight KV, and a new-weight session matching
+        them would silently decode wrong logits. The straddler is
+        driven deterministically through the admission internals
+        (admission is synchronous; the flip lands before its first
+        decode row would have run)."""
+        from deeplearning4j_tpu.serving.sessions import DecodeSession
+        from deeplearning4j_tpu.utils.sampling import SamplingParams
+        prompt = [1, 2, 3, 4, 5, 6, 7, 8, 9]
+        registry, sched, mgr = _plane(net)
+        try:
+            _run(mgr, prompt, max_tokens=4)   # warm the radix under v1
+            slot = mgr.pool.alloc(0.0)
+            with mgr.pool.lock():
+                gen0 = mgr._prefix_gen
+                cl, chain = mgr._admit_pages(
+                    slot, np.asarray(prompt, np.int64), 4, 0)
+            sess = DecodeSession(
+                "straddler", slot, np.asarray(prompt, np.int64),
+                max_tokens=4, params=SamplingParams(greedy=True),
+                seed=0, deadline_ms=None, eos_id=None)
+            sess._pages, sess._cached_len, sess._gen = chain, cl, gen0
+            v2 = _make_net(seed=5)
+            registry.deploy("default", 2, v2, feat_shape=(T, 1))
+            assert mgr.snapshot()["prefix_cache"]["cached_pages"] == 0
+            # first decode row after the flip offers the prefix back:
+            # the generation stamp must refuse it
+            mgr._insert_prefix(sess)
+            assert mgr.snapshot()["prefix_cache"]["cached_pages"] == 0, \
+                "old-weight KV re-indexed after the flip"
+            # teardown exactly as _finish would
+            mgr.pool.free(slot)
+            with mgr.pool.lock():
+                for p in chain:
+                    mgr.pool.page_unref_locked(p)
+            pc = mgr.snapshot()["prefix_cache"]
+            assert pc["pages_free"] == pc["pages"]
+            # a fresh session under v2 cold-prefills, re-indexes under
+            # the NEW generation, and matches v2's own cold stream
+            assert _run(mgr, prompt, max_tokens=4) == _cold(
+                v2, prompt, max_tokens=4)
+            assert mgr.snapshot()["prefix_cache"]["cached_pages"] > 0
         finally:
             sched.shutdown()
             registry.close()
