@@ -41,6 +41,13 @@ section, zero-recompiles-after-warmup and cross-K greedy parity
 asserted; writes BENCH_serving_decode.json (see _serving_decode_main;
 knobs: BENCH_DECODE_CLIENTS/ROUNDS/MAX_TOKENS/PROMPT/PREFILL_CHUNK/
 KS/OUT).
+`python bench.py --serving-fleet` (or BENCH_SERVING_FLEET=1) drives the
+FleetRouter over N replica PROCESSES: closed-loop 1→N replica scaling
+with router-vs-replica /metrics reconciled exactly, a disaggregated
+prefill→handoff→decode greedy-parity probe, and a forced SLO breach →
+drain + reroute with zero failed in-flight streams; writes
+BENCH_serving_fleet.json (see _serving_fleet_main; knobs:
+BENCH_FLEET_REPLICAS/CLIENTS/ROUNDS/MAX_TOKENS/PROMPT/OUT).
 `python bench.py --sharding` (or BENCH_SHARDING=1) profiles the GSPMD
 sharding spine on a forced-8-device CPU mesh: per-device param +
 optimizer-moment bytes replicated vs sharded, syncs/step, post-warmup
@@ -756,6 +763,20 @@ def _append_history(mode, summary):
              "acceptance_rate": leg.get("acceptance_rate"),
              "slots_factor": leg.get("slots_per_chip_factor")}
             for leg in summary["spec_matrix"]]
+    # serving-fleet rows: replica count, reroutes/handoffs/migrations,
+    # fleet p99 + the 1→N scaling ratio (tools/dash.py fleet panel)
+    if isinstance(summary.get("fleet"), dict):
+        fl = summary["fleet"]
+        row["fleet"] = {k: fl.get(k) for k in (
+            "replicas", "reroutes", "handoffs", "migrations",
+            "slo_drains", "ttft_p99_ms", "scaling", "reconciled")}
+    if isinstance(summary.get("scale_legs"), list):
+        row["scale_legs"] = [
+            {"replicas": leg.get("replicas"),
+             "tokens_per_s": leg.get("tokens_per_s"),
+             "ttft_p99_ms": (leg.get("ttft_ms") or {}).get("p99"),
+             "reconciled": leg.get("metrics_reconciled")}
+            for leg in summary["scale_legs"]]
     if isinstance(summary.get("spec"), dict):
         for key in ("tokens_per_s", "acceptance_rate",
                     "speedup_vs_stepwise"):
@@ -1972,6 +1993,328 @@ def _sharding_main():
     print(json.dumps(out))
 
 
+def _serving_fleet_main():
+    """`--serving-fleet` mode: the FleetRouter tier over N replica
+    PROCESSES (each its own interpreter + JAX runtime), three legs:
+
+      scale    — closed-loop client pool through the router at each
+                 replica count (BENCH_FLEET_REPLICAS, default "1,4"):
+                 aggregate streamed tok/s, client-side TTFT/ITL
+                 p50/p99, and an EXACT reconcile of the router's
+                 /metrics token+request counters against the sum of
+                 every replica's own /metrics
+      handoff  — disaggregated prefill→handoff→decode greedy probe,
+                 bit-identical to the single-replica stream of the
+                 same prompt (quantized pages ship as bytes; the
+                 decode admission matches the whole stem)
+      slo      — a forced burn-rate breach on one replica drains it
+                 mid-flight: every in-flight stream completes (zero
+                 failed), traffic reroutes to the healthy replica
+
+    The 1→N scaling contract (>2.5x at N=4) is asserted only where
+    the host can physically scale (cpu_count >= N or
+    BENCH_FLEET_REQUIRE_SCALING=1); a single-core CI box still
+    measures and records the ratio. Writes BENCH_serving_fleet.json
+    (BENCH_FLEET_OUT overrides) + one fleet row in
+    BENCH_history.jsonl."""
+    import jax
+
+    if not os.environ.get("BENCH_SERVING_TPU"):
+        jax.config.update("jax_platforms", "cpu")
+
+    import threading
+
+    from deeplearning4j_tpu.serving.fleet import client as fclient
+    from deeplearning4j_tpu.serving.fleet.launcher import launch_replica
+    from deeplearning4j_tpu.serving.fleet.router import FleetRouter
+
+    counts = sorted({int(x) for x in os.environ.get(
+        "BENCH_FLEET_REPLICAS", "1,4").split(",") if x.strip()})
+    clients = int(os.environ.get("BENCH_FLEET_CLIENTS", "4"))
+    rounds = int(os.environ.get("BENCH_FLEET_ROUNDS", "2"))
+    max_tokens = int(os.environ.get("BENCH_FLEET_MAX_TOKENS", "16"))
+    prompt_len = int(os.environ.get("BENCH_FLEET_PROMPT", "12"))
+    V = 32
+    spec = {"kind": "bench_lm", "seed": 0, "vocab": V, "chunk": 8,
+            "max_cache": 64, "blocks": 1}
+    probe = [(i % (V - 1)) + 1 for i in range(prompt_len)]
+
+    def cfg(name, role="mixed", **kw):
+        c = {"name": name, "role": role, "model": dict(spec),
+             "decode_slots": max(clients, 4), "prefill_chunk": 8,
+             "page_len": 16}
+        c.update(kw)
+        return c
+
+    def pct(vals, q):
+        vals = sorted(vals)
+        return (None if not vals else
+                round(vals[min(len(vals) - 1, int(q * len(vals)))], 3))
+
+    def counter_sum(snap, name):
+        return sum(e.get("value", 0) for e in
+                   snap.get("series", {}).get(name, ()))
+
+    def hist_p99(snap, name):
+        rows = snap.get("series", {}).get(name, ())
+        vals = [e.get("p99") for e in rows if e.get("p99") is not None]
+        return round(max(vals), 3) if vals else None
+
+    def stream(url, body):
+        """One router stream → (tokens, ttft_ms, itls_ms, error)."""
+        t0 = time.monotonic()
+        last = t0
+        toks, itls, ttft, err = [], [], None, None
+        for ev in fclient.sse_events(url, "/generate", dict(body),
+                                     timeout=300.0):
+            if "token" in ev:
+                now = time.monotonic()
+                if ttft is None:
+                    ttft = (now - t0) * 1000.0
+                else:
+                    itls.append((now - last) * 1000.0)
+                last = now
+                toks.append(int(ev["token"]))
+            if "error" in ev:
+                err = ev["error"]
+        return toks, ttft, itls, err
+
+    def start_fleet(cfgs, **router_kw):
+        procs = [launch_replica(c) for c in cfgs]
+        router_kw.setdefault("poll_interval", None)
+        router = FleetRouter([(p.name, p.url, p.role) for p in procs],
+                             **router_kw)
+        rport = router.start()
+        return procs, router, f"http://127.0.0.1:{rport}"
+
+    def stop_fleet(procs, router):
+        router.stop()
+        for p in procs:
+            p.terminate()
+
+    # ---------------------------------------------------- scale legs
+    legs = []
+    probe_tokens = None
+    for n in counts:
+        procs, router, url = start_fleet(
+            [cfg(f"r{i}") for i in range(n)])
+        try:
+            # warm every replica's compiled windows (and record the
+            # single-replica greedy probe as the parity reference)
+            for _ in range(n):
+                toks, _, _, err = stream(url, {
+                    "prompt_ids": probe, "max_tokens": max_tokens,
+                    "greedy": True})
+                assert err is None, f"warmup failed: {err}"
+            if n == counts[0]:
+                probe_tokens = toks
+            ttfts, itls, lock = [], [], threading.Lock()
+            streamed = [0]
+            errors = []
+
+            def worker(ci):
+                for r in range(rounds):
+                    p = [((7 * ci + 3 * r + i) % (V - 1)) + 1
+                         for i in range(prompt_len)]
+                    toks, ttft, it, err = stream(url, {
+                        "prompt_ids": p, "max_tokens": max_tokens,
+                        "greedy": True})
+                    with lock:
+                        if err is not None:
+                            errors.append(err)
+                        streamed[0] += len(toks)
+                        if ttft is not None:
+                            ttfts.append(ttft)
+                        itls.extend(it)
+
+            t0 = time.monotonic()
+            threads = [threading.Thread(target=worker, args=(ci,))
+                       for ci in range(clients)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            wall = time.monotonic() - t0
+            assert not errors, f"fleet leg {n}: {errors[:3]}"
+
+            rsnap = fclient.get_json(url, "/metrics", timeout=10.0)
+            router_tokens = counter_sum(rsnap, "fleet_tokens_streamed_total")
+            router_reqs = counter_sum(rsnap, "fleet_requests_total")
+            rep_tokens = rep_reqs = 0
+            rep_p99s = {}
+            for p in procs:
+                snap = fclient.get_json(p.url, "/metrics", timeout=10.0)
+                p99s = []
+                for d in (snap.get("decode") or {}).values():
+                    rep_tokens += int(d.get("tokens_streamed") or 0)
+                    p99 = (d.get("ttft_ms") or {}).get("p99")
+                    if p99 is not None:
+                        p99s.append(p99)
+                    rep_reqs += int((d.get("sessions") or {})
+                                    .get("opened", 0))
+                rep_p99s[p.name] = (round(max(p99s), 3)
+                                    if p99s else None)
+            client_tokens = streamed[0] + n * len(probe_tokens or ())
+            reconciled = (router_tokens == rep_tokens == client_tokens
+                          and router_reqs == rep_reqs)
+            if not reconciled:
+                print(f"[bench] fleet reconcile MISMATCH n={n}: "
+                      f"router={router_tokens} replicas={rep_tokens} "
+                      f"clients={client_tokens} "
+                      f"reqs {router_reqs}/{rep_reqs}", file=sys.stderr)
+            legs.append({
+                "replicas": n,
+                "tokens_per_s": round(streamed[0] / wall, 2),
+                "streamed_tokens": streamed[0],
+                "wall_s": round(wall, 3),
+                "ttft_ms": {"p50": pct(ttfts, 0.50),
+                            "p99": pct(ttfts, 0.99)},
+                "itl_ms": {"p50": pct(itls, 0.50),
+                           "p99": pct(itls, 0.99)},
+                "fleet_ttft_p99_ms": hist_p99(rsnap, "fleet_ttft_ms"),
+                "replica_ttft_p99_ms": rep_p99s,
+                "router_tokens": router_tokens,
+                "replica_tokens": rep_tokens,
+                "client_tokens": client_tokens,
+                "metrics_reconciled": reconciled,
+            })
+        finally:
+            stop_fleet(procs, router)
+
+    scaling = None
+    if len(legs) > 1 and legs[0]["tokens_per_s"]:
+        scaling = round(legs[-1]["tokens_per_s"]
+                        / legs[0]["tokens_per_s"], 3)
+    can_scale = (os.cpu_count() or 1) >= counts[-1]
+    require = bool(os.environ.get("BENCH_FLEET_REQUIRE_SCALING")) \
+        or (can_scale and counts[-1] >= 4)
+    if require and scaling is not None and scaling < 2.5:
+        print(f"[bench] FLEET SCALING BELOW CONTRACT: "
+              f"{counts[0]}→{counts[-1]} replicas = {scaling}x < 2.5x",
+              file=sys.stderr)
+
+    # --------------------------------------------------- handoff leg
+    procs, router, url = start_fleet(
+        [cfg("pf0", role="prefill"), cfg("dc0", role="decode")])
+    try:
+        toks, _, _, err = stream(url, {"prompt_ids": probe,
+                                       "max_tokens": max_tokens,
+                                       "greedy": True})
+        assert err is None, f"handoff leg failed: {err}"
+        rsnap = fclient.get_json(url, "/metrics", timeout=10.0)
+        handoff_leg = {
+            "tokens": toks,
+            "parity_vs_single_replica": toks == probe_tokens,
+            "handoffs": counter_sum(rsnap, "fleet_handoffs_total"),
+            "handoff_bytes": counter_sum(rsnap,
+                                         "fleet_handoff_bytes_total"),
+        }
+        assert handoff_leg["parity_vs_single_replica"], (
+            f"disaggregated stream diverged: {toks} vs {probe_tokens}")
+        assert handoff_leg["handoffs"] >= 1
+    finally:
+        stop_fleet(procs, router)
+
+    # ------------------------------------------------------- SLO leg
+    slo_cfg = {"interval": 0.1, "objectives": [
+        {"name": "bench-forced-breach",
+         "series": "serving_ttft_ms:p99", "threshold": 0.0,
+         "budget": 1.0, "fast_s": 30.0, "slow_s": 60.0,
+         "burn_threshold": 0.5}]}
+    procs, router, url = start_fleet(
+        [cfg("s0", slo=slo_cfg), cfg("s1")], auto_drain_on_slo=True)
+    try:
+        # land traffic on s0 so its breached series has points
+        fclient.post_json(procs[0].url, "/generate",
+                          {"prompt_ids": probe, "max_tokens": 2,
+                           "greedy": True, "stream": False},
+                          timeout=120.0)
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            hz = fclient.get_json(procs[0].url, "/healthz", timeout=5.0)
+            if any(r.startswith("slo firing")
+                   for r in hz.get("reasons", ())):
+                break
+            time.sleep(0.1)
+        inflight_err, inflight_ok, lock = [], [0], threading.Lock()
+
+        def inflight(ci):
+            toks, _, _, err = stream(url, {
+                "prompt_ids": [((ci + i) % (V - 1)) + 1
+                               for i in range(prompt_len)],
+                "max_tokens": max_tokens, "greedy": True})
+            with lock:
+                if err is None and toks:
+                    inflight_ok[0] += 1
+                else:
+                    inflight_err.append(err or "empty stream")
+
+        threads = [threading.Thread(target=inflight, args=(ci,))
+                   for ci in range(clients)]
+        for t in threads:
+            t.start()
+        time.sleep(0.2)
+        router.poll_once()          # the breach verdict → drain s0
+        for t in threads:
+            t.join()
+        rsnap = fclient.get_json(url, "/metrics", timeout=10.0)
+        post_toks, _, _, err = stream(url, {"prompt_ids": probe,
+                                            "max_tokens": 4,
+                                            "greedy": True})
+        slo_leg = {
+            "slo_drains": counter_sum(rsnap, "fleet_slo_drains_total"),
+            "migrations": counter_sum(rsnap, "fleet_migrations_total"),
+            "reroutes": counter_sum(rsnap, "fleet_reroutes_total"),
+            "inflight_completed": inflight_ok[0],
+            "inflight_failed": len(inflight_err),
+            "failed_requests": counter_sum(rsnap,
+                                           "fleet_failed_requests_total"),
+            "rerouted_stream_ok": err is None and bool(post_toks),
+        }
+        assert slo_leg["slo_drains"] >= 1, "forced SLO breach never drained"
+        assert slo_leg["inflight_failed"] == 0, inflight_err[:3]
+        assert slo_leg["failed_requests"] == 0
+    finally:
+        stop_fleet(procs, router)
+
+    best = legs[-1]
+    out = {
+        "metric": "serving_fleet_tokens_per_s",
+        "value": best["tokens_per_s"],
+        "unit": "tokens/s",
+        "mode": "serving-fleet",
+        "platform": jax.devices()[0].platform,
+        "replica_counts": counts,
+        "clients": clients,
+        "rounds": rounds,
+        "max_tokens": max_tokens,
+        "scaling_1_to_max": scaling,
+        "scaling_contract_25x_enforced": bool(require),
+        "scale_legs": legs,
+        "handoff": handoff_leg,
+        "slo": slo_leg,
+        "fleet": {
+            "replicas": counts[-1],
+            "reroutes": slo_leg["reroutes"],
+            "handoffs": handoff_leg["handoffs"],
+            "migrations": slo_leg["migrations"],
+            "slo_drains": slo_leg["slo_drains"],
+            "ttft_p99_ms": best["fleet_ttft_p99_ms"],
+            "scaling": scaling,
+            "reconciled": all(l["metrics_reconciled"] for l in legs),
+        },
+    }
+    path = os.environ.get("BENCH_FLEET_OUT") or os.path.join(
+        os.path.dirname(os.path.abspath(__file__)),
+        "BENCH_serving_fleet.json")
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    _append_history("serving-fleet", out)
+    print(json.dumps({k: out[k] for k in
+                      ("metric", "value", "unit", "scaling_1_to_max",
+                       "fleet")}))
+
+
 def main():
     if "--sharding" in sys.argv or os.environ.get("BENCH_SHARDING"):
         _sharding_main()
@@ -1982,6 +2325,10 @@ def main():
     if "--serving-decode" in sys.argv or os.environ.get(
             "BENCH_SERVING_DECODE"):
         _serving_decode_main()
+        return
+    if "--serving-fleet" in sys.argv or os.environ.get(
+            "BENCH_SERVING_FLEET"):
+        _serving_fleet_main()
         return
     if "--serving" in sys.argv or os.environ.get("BENCH_SERVING"):
         _serving_main()

@@ -247,14 +247,15 @@ class Solver:
                            model.state_tree)
         model.params_tree = unravel(res.x)
         # Persistent layer state (BN running mean/var): the reference's
-        # solvers run a train-mode forward per iteration + line-search
-        # probe, decay-blending running stats toward the batch every time.
-        # Mirror that by refreshing the stateful subset `iterations` times
-        # at the optimum (capped — the blend converges geometrically).
+        # solvers run a train-mode forward per iteration PLUS several
+        # line-search probes, decay-blending running stats toward the
+        # batch every time — so the blend sees ~4x `iterations` updates,
+        # enough for the default 0.9 decay to converge (0.9^40 ≈ 1.5%).
+        # Mirror that multiplicity (capped — geometric convergence).
         stateful = getattr(model, "_stateful", set())
         if stateful and model.state_tree:
             states = model.state_tree
-            for _ in range(min(self.iterations, 30)):
+            for _ in range(min(4 * self.iterations, 60)):
                 ns = self._refresh(res.x, features, labels, fmask, lmask,
                                    states)
                 states = {

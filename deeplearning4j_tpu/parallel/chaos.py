@@ -37,7 +37,7 @@ from deeplearning4j_tpu.optim.listeners import TrainingListener
 
 __all__ = [
     "SigtermAtStep", "CheckpointIOFault", "FailingIterator",
-    "StallingIterator", "InjectedFault",
+    "StallingIterator", "InjectedFault", "ReplicaKill",
 ]
 
 
@@ -103,6 +103,51 @@ class CheckpointIOFault:
             self.raised += 1
             self.touched = 0      # re-arm for the next checkpoint attempt
             raise self.exc_factory()
+
+
+class ReplicaKill:
+    """Kill a whole serving-fleet replica PROCESS — the fleet-scale
+    fault: one mesh vanishes mid-stream with no goodbye (SIGKILL, so
+    no handler runs and no socket closes cleanly). The router must
+    notice the dead stream, fail the session over to another replica
+    (KV handed off or re-prefilled), and the client's token sequence
+    must continue uncorrupted — which the fleet chaos suite asserts
+    byte-for-byte against an uninterrupted greedy run.
+
+    `target` is a pid or any object with a `.pid` (a launcher
+    ReplicaProcess, a subprocess.Popen). `after_tokens` arms a
+    client-side trigger: call `maybe_fire(n_tokens_streamed)` from the
+    consuming loop and the kill lands exactly once, at the first event
+    at or past the threshold — deterministic in token count, not in
+    wall time."""
+
+    def __init__(self, target: Any, *, after_tokens: int = 0,
+                 sig: int = signal.SIGKILL):
+        self.target = target
+        self.after_tokens = int(after_tokens)
+        self.sig = sig
+        self.fired = False
+
+    @property
+    def pid(self) -> int:
+        return int(getattr(self.target, "pid", self.target))
+
+    def fire(self) -> None:
+        if self.fired:
+            return
+        self.fired = True
+        try:
+            os.kill(self.pid, self.sig)
+        # graft: allow(GL403): target already dead — the fault still
+        # "happened"; chaos injection is idempotent by design
+        except ProcessLookupError:
+            pass
+
+    def maybe_fire(self, n_tokens: int) -> bool:
+        if not self.fired and n_tokens >= self.after_tokens:
+            self.fire()
+            return True
+        return False
 
 
 class FailingIterator:

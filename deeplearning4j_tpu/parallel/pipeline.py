@@ -38,6 +38,19 @@ from deeplearning4j_tpu.parallel.mesh import (AXIS_DATA, AXIS_PIPE,
 _tmap = jax.tree_util.tree_map
 
 
+def _pcast_varying(x, axis: str):
+    """Mark `x` device-varying over `axis` (jax 0.9 vma typing). Older
+    jax has no `lax.pcast` (nor vma tracking at all), so identity is the
+    correct degradation — there is no varying/unvarying distinction to
+    violate there."""
+    try:
+        return lax.pcast(x, (axis,), to="varying")
+    # graft: allow(GL403): version probe — AttributeError = pre-vma jax,
+    # ValueError = vma tracking off in this trace; both mean "no cast"
+    except (AttributeError, ValueError):
+        return x
+
+
 def stack_stage_params(stage_params: Sequence[Any]):
     """Stack S structurally-identical per-stage pytrees on a new leading
     axis (the axis that gets sharded over `pipe`)."""
@@ -100,7 +113,7 @@ def make_pipeline_fn(stage_fn: Callable[[Any, jax.Array], jax.Array],
 
         # Mark the carry as device-varying over `pipe` (jax 0.9 vma typing:
         # the ppermute output is varying, so the initial carry must be too).
-        buf0 = lax.pcast(jnp.zeros_like(x_mb[0]), (axis,), to="varying")
+        buf0 = _pcast_varying(jnp.zeros_like(x_mb[0]), axis)
         _, outs = lax.scan(tick, buf0, jnp.arange(total_ticks))
         # Last stage's outputs for microbatch m appear at tick m + S - 1.
         tail = lax.dynamic_slice_in_dim(outs, n_stages - 1, n_micro, axis=0)
@@ -152,10 +165,7 @@ def make_pipeline_1f1b_fn(stage_fn: Callable[[Any, jax.Array], jax.Array],
         is_last = (stage == S - 1)
 
         def var(x):    # noqa: E306 — defined before first use below
-            try:
-                return lax.pcast(x, (axis,), to="varying")
-            except ValueError:
-                return x
+            return _pcast_varying(x, axis)
 
         # The epilogue params arrive replicated (unvarying over `pipe`).
         # vjp wrt an UNVARYING input of a varying computation inserts an

@@ -417,11 +417,15 @@ def distributed_evaluate(net, features, labels, *, batch_size: int = 32):
     ev._ensure(n_classes)          # empty shard: zero matrix, not None
     if nproc > 1:
         mats = _allgather_host(np.asarray(ev.confusion.matrix))  # [P,C,C]
+        # process_allgather adds NO leading axis when the runtime has a
+        # single process (identity gather) — normalize before the merge
+        # sum or axis 0 would eat a confusion-matrix dimension.
+        mats = np.asarray(mats).reshape(
+            (-1,) + ev.confusion.matrix.shape)
         merged = Evaluation(num_classes=ev.num_classes,
                             labels=ev.label_names)
         merged._ensure(ev.num_classes)
-        merged.confusion.matrix = np.asarray(mats).sum(
-            axis=0, dtype=np.int64)
+        merged.confusion.matrix = mats.sum(axis=0, dtype=np.int64)
         return merged
     return ev
 

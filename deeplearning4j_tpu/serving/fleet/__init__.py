@@ -1,0 +1,29 @@
+"""Serving fleet: a router tier over N InferenceServer replicas.
+
+The single-replica stack (scheduler -> sessions -> paged KVSlotPool ->
+radix prefix cache) is fast but is one process on one mesh; this
+package turns it into a horizontally scalable tier:
+
+- `handoff`   — dtype-aware KV page serialization (quantized bytes +
+                in-page scale rows, never dequantized) between the
+                paged pools of two replicas, wire format mirroring the
+                sharded-checkpoint leaf entries.
+- `replica`   — ReplicaServer: an InferenceServer plus the /fleet/*
+                control surface (role, prefill-only admission, KV
+                export/import, drain, coordinated deploy).
+- `router`    — FleetRouter: the HTTP front door. Disaggregated
+                prefill->decode scheduling, sticky + prefix-overlap +
+                load-aware placement, SLO-driven drain/reroute,
+                mid-stream failover, fleet-wide hot-swap with rollback.
+- `launcher`  — spawn replica processes (distinct interpreters, their
+                own meshes) for benches, smoke tests, and chaos runs.
+"""
+
+from deeplearning4j_tpu.serving.fleet.handoff import (     # noqa: F401
+    HandoffError, export_prefix, install_prefix, payload_bytes)
+from deeplearning4j_tpu.serving.fleet.replica import (     # noqa: F401
+    ReplicaServer)
+from deeplearning4j_tpu.serving.fleet.router import (      # noqa: F401
+    FleetRouter, ReplicaHandle)
+from deeplearning4j_tpu.serving.fleet.launcher import (    # noqa: F401
+    ReplicaProcess, launch_replica)

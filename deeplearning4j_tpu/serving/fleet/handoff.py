@@ -1,0 +1,165 @@
+"""KV page handoff: serialize a replica's warm prefix pages, install
+them into another replica's paged pool — the mechanism that makes
+prefill and decode separable roles.
+
+Wire format (v1) mirrors the sharded-checkpoint manifest discipline:
+every page is a dict of leaf entries keyed by the carry-tree leaf path
+("layer2_transformerencoderblock/cache_k"), each entry carrying
+`{shape, dtype, data}` with the raw page bytes base64-encoded AT THE
+STORED DTYPE. int8/fp8 pages therefore ship as quantized bytes plus
+their in-page fp32 scale rows (`scale_k`/`scale_v` are leaves like any
+other) — a handoff never dequantizes, and the importer's
+`import_page_locked` refuses any dtype that doesn't match its pool
+bit-for-bit. Because quantization scales live per-(token, kv-head)
+inside the page, the imported page is bit-exact: the decode replica
+reads the very scales the prefill replica wrote.
+
+Export and install both run under the donor/recipient pool lock as ONE
+critical section each — the same serialization point as admission and
+decode windows, so a handoff can never observe (or corrupt) a
+half-written page. The device readback in export is a host sync by
+nature; it lives on the handoff path only, never inside any replica's
+decode window (the PERF_NOTES fleet contract).
+"""
+
+from __future__ import annotations
+
+import base64
+from typing import Optional
+
+import numpy as np
+
+try:                        # registers fp8 dtype names with numpy;
+    import ml_dtypes        # ships with jax — never a new dependency
+    del ml_dtypes           # noqa: F821
+# graft: allow(GL403): optional dtype registration — without ml_dtypes
+# fp8 handoffs fail loudly at np.dtype() lookup, fp32/int8 still work
+except ImportError:         # pragma: no cover - jax always bundles it
+    pass
+
+FORMAT = "kv-handoff-v1"
+
+
+class HandoffError(ValueError):
+    """A handoff payload is malformed or incompatible with the
+    recipient pool (dtype/page-geometry mismatch, unknown format)."""
+
+
+def _leaves_to_wire(leaves: dict) -> dict:
+    out = {}
+    for key, arr in leaves.items():
+        a = np.ascontiguousarray(arr)
+        out[key] = {"shape": list(a.shape), "dtype": str(a.dtype),
+                    "data": base64.b64encode(a.tobytes()).decode("ascii")}
+    return out
+
+
+def _wire_to_leaves(entry: dict) -> dict:
+    out = {}
+    for key, spec in entry.items():
+        try:
+            dt = np.dtype(spec["dtype"])
+        except TypeError as e:
+            raise HandoffError(
+                f"leaf {key}: unknown dtype {spec['dtype']!r}") from e
+        raw = base64.b64decode(spec["data"])
+        a = np.frombuffer(raw, dtype=dt).reshape(spec["shape"])
+        out[key] = a
+    return out
+
+
+def payload_bytes(payload: dict) -> int:
+    """Decoded KV bytes a payload carries (metrics, not wire size)."""
+    n = 0
+    for page in payload.get("pages", []):
+        for spec in page.values():
+            n += (len(spec["data"]) * 3) // 4
+    return n
+
+
+def export_prefix(pool, cache, tokens, *, model: str = "") -> Optional[dict]:
+    """Serialize the longest cached prefix of `tokens` from this
+    replica's radix index. Returns the handoff payload, or None when
+    nothing is cached. One pool-lock critical section: the match, the
+    page readbacks, and the LRU refresh are atomic w.r.t. admission,
+    eviction, and decode windows, so every exported page is consistent
+    (full pages are immutable by construction; a partial page's
+    content below its recorded token count was finalized by the
+    donor's prefill)."""
+    toks = [int(t) for t in tokens]
+    with pool.lock():
+        cached_len, full_pages, partial = cache.match(toks)
+        if cached_len <= 0:
+            return None
+        pages = list(full_pages)
+        partial_tokens = 0
+        if partial is not None:
+            pages.append(partial[0])
+            partial_tokens = int(partial[1])
+        wire_pages = [_leaves_to_wire(pool.export_page_locked(p))
+                      for p in pages]
+    return {"format": FORMAT,
+            "model": model or pool.model,
+            "kv_dtype": pool.kv_dtype,
+            "page_len": pool.page_len,
+            "cached_len": int(cached_len),
+            "tokens": toks[:cached_len],
+            "full_pages": len(full_pages),
+            "partial_tokens": partial_tokens,
+            "pages": wire_pages}
+
+
+def install_prefix(pool, cache, payload: dict) -> int:
+    """Install a handoff payload into this replica's pool and index it
+    in the radix so the next admission's `match()` finds the warm stem.
+    Returns the cached token length now resident. The recipient takes
+    ownership page-by-page: fresh pages are allocated (evicting cold
+    cache-only chains first if the free list is short), written with
+    the dtype-preserving `import_page_locked` program, adopted by the
+    radix insert, and the importer's own transient references dropped —
+    a page the index declined (its chunk was already cached) returns
+    straight to the free list, so a duplicate handoff leaks nothing."""
+    if payload.get("format") != FORMAT:
+        raise HandoffError(
+            f"unknown handoff format {payload.get('format')!r}")
+    if int(payload["page_len"]) != int(pool.page_len or 0):
+        raise HandoffError(
+            f"page_len mismatch: payload {payload['page_len']}, "
+            f"pool {pool.page_len}")
+    if payload["kv_dtype"] != pool.kv_dtype:
+        raise HandoffError(
+            f"kv_dtype mismatch: payload {payload['kv_dtype']!r}, pool "
+            f"{pool.kv_dtype!r} — quantized bytes only install into an "
+            f"identical-dtype pool (no dequant round-trip)")
+    tokens = [int(t) for t in payload["tokens"]]
+    cached_len = int(payload["cached_len"])
+    if len(tokens) != cached_len:
+        raise HandoffError(
+            f"payload carries {len(tokens)} tokens for cached_len "
+            f"{cached_len}")
+    Lp = int(payload["page_len"])
+    n_full = int(payload["full_pages"])
+    n_partial = 1 if int(payload["partial_tokens"]) else 0
+    want = n_full * Lp + int(payload["partial_tokens"])
+    if want != cached_len or len(payload["pages"]) != n_full + n_partial:
+        raise HandoffError(
+            f"page accounting does not cover the tokens: {n_full} full "
+            f"+ {payload['partial_tokens']} partial vs cached_len "
+            f"{cached_len} ({len(payload['pages'])} pages shipped)")
+    leaves = [_wire_to_leaves(p) for p in payload["pages"]]
+    n = len(leaves)
+    with pool.lock():
+        short = n - pool.pages_free_locked()
+        if short > 0:
+            cache.evict(short)
+        fresh = pool.page_alloc_locked(n)   # raises when still short
+        try:
+            for page, lv in zip(fresh, leaves):
+                pool.import_page_locked(page, lv)
+            cache.insert(tokens, fresh)
+        finally:
+            # the index holds its own references now; ours were only
+            # for the install. Unadopted pages drop to refcount 0 here.
+            for p in fresh:
+                pool.page_unref_locked(p)
+    return cached_len

@@ -46,6 +46,17 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
+
+
+def _leaf_key(path) -> str:
+    """Stable string name for a carry-tree leaf path ("layer2/cache_k"):
+    the wire identity of a cache leaf in fleet KV handoff payloads."""
+    parts = []
+    for p in path:
+        k = getattr(p, "key", None)
+        parts.append(str(k) if k is not None else str(getattr(p, "idx", p)))
+    return "/".join(parts)
 
 
 class SlotPoolExhaustedError(RuntimeError):
@@ -171,6 +182,9 @@ class KVSlotPool:
         self._install_jit = jax.jit(_install)
         self._copy_page_jit = jax.jit(_copy_page)
         self._poison_pages_jit = jax.jit(_poison)
+        # compiled lazily on the first fleet KV import (page traced, so
+        # one compile covers every handed-off page thereafter)
+        self._import_page_jit = None
         if self.page_len:
             row = jnp.zeros((self.npages,), jnp.int32)
             self._install_jit(self.carries, 0, row, jnp.int32(0))
@@ -331,6 +345,87 @@ class KVSlotPool:
             # graft: allow(GL701): caller holds self._cv by contract
             # (the *_locked API — no unlocked call path exists)
             self.carries = self._poison_pages_jit(self.carries, p, v)
+
+    # ---------------------------------------------------- fleet handoff
+    def cache_leaf_meta(self) -> dict:
+        """{leaf_key: (page_shape, dtype_str)} for every per-page cache
+        leaf — the schema a handoff payload must match. Static array
+        metadata only; no lock needed (the tree's structure never
+        changes, only its leaf values)."""
+        out = {}
+        with self._cv:
+            carries = self.carries
+        for path, leaf in jax.tree_util.tree_leaves_with_path(carries):
+            if getattr(path[-1], "key", None) in self._CACHE_KEYS:
+                out[_leaf_key(path)] = (tuple(leaf.shape[1:]),
+                                        str(leaf.dtype))
+        return out
+
+    def export_page_locked(self, page: int) -> dict:
+        """Read one physical page's K/V (+ in-page scale rows) back to
+        host as {leaf_key: np.ndarray}, at the STORED dtype — int8/fp8
+        pages come back as quantized bytes with their fp32 scale rows,
+        never dequantized. This is a host sync; it lives on the fleet
+        handoff path (admission-adjacent), never inside a decode
+        window."""
+        out = {}
+        # graft: allow(GL301): caller holds self._cv by contract (the
+        # *_locked API — serializes with decode windows so the page
+        # content read is consistent)
+        # graft: allow(GL701): caller holds self._cv by contract
+        carries = self.carries
+        for path, leaf in jax.tree_util.tree_leaves_with_path(carries):
+            if getattr(path[-1], "key", None) in self._CACHE_KEYS:
+                # graft: allow-sync(handoff page readback, not in decode)
+                out[_leaf_key(path)] = np.asarray(leaf[page])
+        return out
+
+    def import_page_locked(self, page: int, leaves: dict) -> None:
+        """Write a handed-off page's contents into physical page `page`.
+        `leaves` is {leaf_key: array} exactly as `export_page_locked`
+        produced it (same leaf set, shapes, dtypes — quantized bytes go
+        straight into the quantized pool, no dequant round-trip). One
+        jitted program with the page index traced: the first import
+        compiles once, every later import (any page) reuses it."""
+        meta = {}
+        # graft: allow(GL301): caller holds self._cv by contract
+        # graft: allow(GL701): caller holds self._cv by contract
+        carries = self.carries
+        for path, leaf in jax.tree_util.tree_leaves_with_path(carries):
+            key = getattr(path[-1], "key", None)
+            if key in self._CACHE_KEYS:
+                meta[_leaf_key(path)] = (tuple(leaf.shape[1:]),
+                                         str(leaf.dtype))
+        if set(leaves) != set(meta):
+            raise IncompatibleSessionSwapError(
+                f"handoff payload leaves {sorted(leaves)} do not match "
+                f"this pool's cache leaves {sorted(meta)}")
+        payload = {}
+        for k, arr in leaves.items():
+            shape, dtype = meta[k]
+            a = jnp.asarray(arr)
+            if tuple(a.shape) != shape or str(a.dtype) != dtype:
+                raise IncompatibleSessionSwapError(
+                    f"handoff leaf {k}: got {a.shape}/{a.dtype}, pool "
+                    f"holds {shape}/{dtype} — dtype-preserving install "
+                    f"refused (no dequant round-trip)")
+            payload[k] = a
+        if getattr(self, "_import_page_jit", None) is None:
+            cache_keys = self._CACHE_KEYS
+
+            def _import(carries, page, payload):
+                def wr(path, a):
+                    # graft: allow(GL003): path keys are static metadata
+                    if getattr(path[-1], "key", None) in cache_keys:
+                        return a.at[page].set(payload[_leaf_key(path)])
+                    return a
+                return jax.tree_util.tree_map_with_path(wr, carries)
+
+            # graft: allow(GL301): caller holds self._cv by contract
+            self._import_page_jit = jax.jit(_import)
+        # graft: allow(GL301): caller holds self._cv by contract
+        # graft: allow(GL701): caller holds self._cv by contract
+        self.carries = self._import_page_jit(carries, page, payload)
 
     # ------------------------------------------------------- step seam
     def swap_carries(self, new_carries) -> None:

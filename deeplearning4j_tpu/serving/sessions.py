@@ -159,6 +159,11 @@ class DecodeSession:
         # hot-swap flips mid-stream, this session's KV belongs to the
         # old weights and must not be offered back to the radix index
         self._gen = 0
+        # fleet disaggregation: a prefill-only session runs the prompt
+        # stem through prefill, offers the pages to the radix index, and
+        # finishes WITHOUT sampling — the decode role lives on another
+        # replica, which imports the pages and decodes from the warm stem
+        self._prefill_only = False
 
     # -------------------------------------------------------- client API
     def stream(self, timeout: Optional[float] = None):
@@ -491,7 +496,8 @@ class DecodeSessionManager:
                      deadline_ms: Optional[float] = None,
                      eos_id: Optional[int] = None,
                      alloc_timeout_s: float = 0.0,
-                     trace=None) -> DecodeSession:
+                     trace=None,
+                     prefill_only: bool = False) -> DecodeSession:
         """Admit one generation: claim a slot (SlotPoolExhaustedError →
         503 upstream), validate the token budget against the net's
         decode limit, and kick off the prefill→decode callback chain.
@@ -544,6 +550,7 @@ class DecodeSessionManager:
         sess._pages = pages
         sess._cached_len = cached_len
         sess._gen = gen
+        sess._prefill_only = bool(prefill_only)
         # prefill resumes AFTER the cached prefix: a fully warm stem
         # goes straight to the decode window (TTFT ~ one window)
         sess._off = cached_len
@@ -563,6 +570,25 @@ class DecodeSessionManager:
             pass
         self._submit_next(sess)
         return sess
+
+    def open_prefill(self, prompt_ids, *,
+                     deadline_ms: Optional[float] = None,
+                     alloc_timeout_s: float = 0.0,
+                     trace=None) -> DecodeSession:
+        """Admit a prefill-ONLY session (fleet prefill role): run the
+        prompt stem through chunked prefill, offer the resulting pages
+        to the radix index, and finish with zero generated tokens. The
+        warm stem is then exportable via the fleet handoff path. Needs
+        the prefix cache — without an index the prefilled pages would
+        be unreachable the moment the slot frees."""
+        if not self.prefix_enabled:
+            raise ValueError(
+                "prefill-only sessions require a paged pool with the "
+                "prefix cache enabled (page_len=...)")
+        return self.open_session(
+            prompt_ids, max_tokens=1, greedy=True,
+            deadline_ms=deadline_ms, alloc_timeout_s=alloc_timeout_s,
+            trace=trace, prefill_only=True)
 
     def get_session(self, sid: str) -> Optional[DecodeSession]:
         with self._lock:
@@ -704,6 +730,15 @@ class DecodeSessionManager:
         if rem is not None and rem <= 0:
             self._finish(sess, error=DeadlineExceededError(
                 f"session {sess.id} deadline passed"))
+            return
+        if sess._prefill_only and sess._off >= sess.prompt.size - 1:
+            # disaggregated prefill role: the stem is fully prefilled —
+            # index the pages (a fleet handoff exports them from the
+            # radix) and finish without ever entering a decode window
+            if self.prefix_enabled and not sess._prefix_inserted:
+                sess._prefix_inserted = True
+                self._insert_prefix(sess)
+            self._finish(sess, outcome="completed")
             return
         row = self._next_row(sess)
         try:

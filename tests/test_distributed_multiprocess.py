@@ -90,7 +90,7 @@ def _spawn_pod(outdir, *, nproc=NPROC, worker=WORKER, mode=None,
 
 
 @pytest.fixture(scope="module")
-def pod_result(tmp_path_factory):
+def pod_result(tmp_path_factory, multiprocess_env):
     outdir = tmp_path_factory.mktemp("mp_pod")
     outs = _spawn_pod(outdir)
     return outdir, outs
@@ -221,7 +221,7 @@ def _spawn_pod4(outdir, mode, expect_fail=False, timeout=600):
 
 
 @pytest.fixture(scope="module")
-def pod4_result(tmp_path_factory):
+def pod4_result(tmp_path_factory, multiprocess_env):
     outdir = tmp_path_factory.mktemp("mp_pod4")
     outs = _spawn_pod4(outdir, "full")
     return outdir, outs

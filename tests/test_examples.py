@@ -68,6 +68,7 @@ def test_transformer_pipeline(devices8, capsys):
     assert "params synced back" in capsys.readouterr().out
 
 
+@pytest.mark.slow       # ~29s; DP training is covered by test_parallel
 def test_resnet_data_parallel(devices8, capsys):
     mod = _run("resnet50_data_parallel.py")
     mod["main"](steps=1, image=32, classes=8)
@@ -98,7 +99,7 @@ def test_long_context_ring_attention(devices8, capsys):
     assert "ring attention" in out and "gradient checkpointing" in out
 
 
-def test_multiprocess_pod(tmp_path, capsys):
+def test_multiprocess_pod(tmp_path, capsys, multiprocess_env):
     mod = _run("multiprocess_pod.py")
     mod["main"](nproc=2, devs=2, ckpt_dir=str(tmp_path / "ck"))
     out = capsys.readouterr().out

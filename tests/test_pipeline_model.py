@@ -226,7 +226,10 @@ class TestPipelinedTransformer:
     def test_trains_and_loss_decreases(self, devices8):
         mesh = Mesh(np.array(devices8[:4]), (AXIS_PIPE,))
         x, y = self._toy_lm_batch(n=64)
-        net = _transformer_net(lr=0.3)
+        # lr must be one the SINGLE-DEVICE step converges at: full-batch
+        # SGD on this toy LM diverges identically on one device at 0.3,
+        # so anything above that tests the optimizer, not the pipeline
+        net = _transformer_net(lr=0.1)
         pp = PipelinedNetwork(net, mesh, n_micro=8)
         losses = [pp.fit_batch(x, y, it=i) for i in range(12)]
         assert losses[-1] < losses[0] * 0.9
@@ -246,4 +249,5 @@ class TestPipelinedTransformer:
         net = _transformer_net()
         pp = PipelinedNetwork(net, mesh, n_micro=4)
         leaf = jax.tree_util.tree_leaves(pp.trunk_params)[0]
-        assert len({s.index for s in leaf.addressable_shards}) == 4
+        # str(): shard indices are tuples of slices, unhashable as-is
+        assert len({str(s.index) for s in leaf.addressable_shards}) == 4

@@ -1308,6 +1308,7 @@ class TestSpaceToDepthStem:
                                    np.asarray(vb["pool0"]),
                                    rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.slow   # ~30s full-model train of an OPT-IN lever
     def test_s2d_full_model_trains(self):
         from deeplearning4j_tpu.models import ComputationGraph
         from deeplearning4j_tpu.zoo import ResNet50
@@ -1367,6 +1368,7 @@ class TestFusedResNet:
         b = np.asarray(fus.output(x))
         np.testing.assert_allclose(a, b, rtol=1e-3, atol=1e-4)
 
+    @pytest.mark.slow   # ~34s full-model train of the FROZEN lever
     def test_fused_resnet_trains(self):
         from deeplearning4j_tpu.data.dataset import MultiDataSet
         from deeplearning4j_tpu.models import ComputationGraph
@@ -1387,7 +1389,8 @@ class TestFusedResNet:
         assert np.isfinite(s1) and s1 < s0
 
 
-def test_fused_resnet_under_data_parallel_mesh():
+@pytest.mark.slow       # ~37s train; the frozen fused path keeps
+def test_fused_resnet_under_data_parallel_mesh():   # fast parity coverage
     """ResNet50(fused=True) trains under the 8-device DP mesh (the
     Pallas path must stay shardable; interpret mode on CPU, see
     PERF_NOTES multichip caveat for real-TPU status)."""

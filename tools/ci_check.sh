@@ -17,6 +17,12 @@
 #   tools/ci_check.sh --locks    # concurrency gate: GL7xx lockset pass
 #                                #   strict over the package, then the
 #                                #   static↔runtime lock-witness smoke
+#   tools/ci_check.sh --fleet    # serving-fleet smoke: 1 router + 2
+#                                #   replica processes — disaggregated
+#                                #   prefill→handoff→decode, a drain-
+#                                #   migration, /metrics reconciled
+#                                #   across tiers; strict GL7xx pass
+#                                #   over serving/fleet/
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -51,6 +57,14 @@ if [[ "${1:-}" == "--locks" ]]; then
     python -m deeplearning4j_tpu.analysis deeplearning4j_tpu \
         --strict --select GL701,GL702,GL703,GL704
     python tools/lockmon_smoke.py
+    exit 0
+fi
+
+if [[ "${1:-}" == "--fleet" ]]; then
+    echo "== serving-fleet smoke (router + 2 replicas: handoff, drain-migration, reconcile) =="
+    python -m deeplearning4j_tpu.analysis deeplearning4j_tpu/serving/fleet \
+        --strict --select GL701,GL702,GL703,GL704
+    env JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" python tools/fleet_smoke.py
     exit 0
 fi
 

@@ -302,6 +302,36 @@ def render_bench(path: str, *, mode: str = "", width: int = 40) -> str:
                     bits.append(f"{tag} {_fmt(last[key])}")
             lines.append("  prefix cache (latest run): "
                          + ", ".join(bits))
+        # the serving-fleet panel: replica count, router traffic
+        # verbs (reroutes/handoffs/migrations/SLO drains), fleet p99,
+        # and the per-replica-count scaling legs from the latest run
+        fl = last.get("fleet")
+        if isinstance(fl, dict):
+            bits = [f"{_fmt(fl.get('replicas'))} replicas"]
+            for key, tag in (("reroutes", "reroutes"),
+                             ("handoffs", "handoffs"),
+                             ("migrations", "migrations"),
+                             ("slo_drains", "SLO drains")):
+                if isinstance(fl.get(key), (int, float)):
+                    bits.append(f"{_fmt(fl[key])} {tag}")
+            if isinstance(fl.get("ttft_p99_ms"), (int, float)):
+                bits.append(f"fleet TTFT p99 {_fmt(fl['ttft_p99_ms'])} ms")
+            if isinstance(fl.get("scaling"), (int, float)):
+                bits.append(f"{_fmt(fl['scaling'])}x 1→N scaling")
+            if fl.get("reconciled") is not None:
+                bits.append("metrics "
+                            + ("reconciled" if fl["reconciled"]
+                               else "MISMATCHED"))
+            lines.append("  fleet (latest run): " + ", ".join(bits))
+            legs = last.get("scale_legs")
+            if isinstance(legs, list):
+                for leg in legs:
+                    lines.append(
+                        f"    {_fmt(leg.get('replicas'))} replica(s): "
+                        f"{_fmt(leg.get('tokens_per_s'))} tok/s, "
+                        f"TTFT p99 {_fmt(leg.get('ttft_p99_ms'))} ms"
+                        + ("" if leg.get("reconciled")
+                           else ", metrics MISMATCHED"))
         if last.get("error"):
             lines.append("  last run FAILED (see its BENCH_*.json)")
     return "\n".join(lines) + "\n"

@@ -1,0 +1,185 @@
+#!/usr/bin/env python
+"""CI smoke for the serving fleet tier (`tools/ci_check.sh --fleet`).
+
+Boots 1 router + 2 replica PROCESSES on localhost (pf0 prefill, dc0
+decode — each its own interpreter and JAX runtime) and walks the three
+seams the fleet contract hangs on:
+
+  1. disaggregated request: the stem prefills on pf0, the warm pages
+     ship over the dtype-aware handoff into dc0, dc0 streams — and a
+     second, hint-warm request for the same prompt must produce the
+     IDENTICAL greedy tokens without a second handoff;
+  2. drain-migration: a finished session's home (dc0) is drained; its
+     warm stem migrates out (export → install) and the sticky
+     follow-up resumes on the survivor, continuing the exact greedy
+     sequence an uninterrupted run would have produced;
+  3. /metrics reconcile across tiers: the router's counters, both
+     replicas' decode metrics, and the client-observed token count
+     must agree EXACTLY (every generated token is accounted once).
+
+Exits nonzero with the offending JSON on any miss, so the gate catches
+a broken seam, not just a broken import.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+SPEC = {"kind": "bench_lm", "seed": 0, "vocab": 32, "chunk": 8,
+        "max_cache": 64, "blocks": 1}
+PROMPT = [3, 1, 4, 1, 5, 9, 2, 6, 5, 3, 5, 8]
+PROMPT2 = [2, 7, 1, 8, 2, 8, 1, 8, 2, 8, 4, 5]
+
+
+def _cfg(name: str, role: str) -> dict:
+    return {"name": name, "role": role, "port": 0, "model": SPEC,
+            "decode_slots": 3, "prefill_chunk": 8, "page_len": 16}
+
+
+def _fail(msg: str, doc=None) -> None:
+    if doc is not None:
+        print(json.dumps(doc, indent=1, default=str)[:4000])
+    sys.exit(f"FAIL: {msg}")
+
+
+def _stream(client, url: str, body: dict):
+    """One /generate stream → (first_frame, tokens, terminal)."""
+    first, tokens, terminal = None, [], None
+    for ev in client.sse_events(url, "/generate", body, timeout=120.0):
+        if first is None and "token" not in ev and "done" not in ev \
+                and "error" not in ev:
+            first = ev
+        elif "token" in ev:
+            tokens.append(int(ev["token"]))
+        elif "done" in ev or "error" in ev:
+            terminal = ev
+            break
+    return first or {}, tokens, terminal or {}
+
+
+def _counter(snap: dict, name: str) -> float:
+    for entry in (snap.get("series") or {}).get(name, ()):
+        if "value" in entry:
+            return float(entry["value"])
+    return 0.0
+
+
+def main() -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    log_dir = tempfile.mkdtemp(prefix="fleet_smoke_")
+
+    from deeplearning4j_tpu.serving.fleet import client
+    from deeplearning4j_tpu.serving.fleet.launcher import launch_replica
+    from deeplearning4j_tpu.serving.fleet.router import (
+        FleetRouter, ReplicaHandle,
+    )
+
+    procs = []
+    router = None
+    try:
+        for name, role in (("pf0", "prefill"), ("dc0", "decode")):
+            procs.append(launch_replica(_cfg(name, role),
+                                        log_dir=log_dir))
+        pf0, dc0 = procs
+        router = FleetRouter([p.handle() for p in procs],
+                             poll_interval=None)
+        url = f"http://127.0.0.1:{router.start()}"
+
+        # -- 1. disaggregated prefill→handoff→decode ------------------
+        body = {"prompt_ids": PROMPT, "max_tokens": 8, "greedy": True}
+        first, t1, term = _stream(client, url, body)
+        if term.get("outcome") != "completed" or len(t1) != 8:
+            _fail("disaggregated stream did not complete 8 tokens",
+                  {"first": first, "terminal": term, "tokens": t1})
+        if first.get("replica") != "dc0":
+            _fail(f"decode landed on {first.get('replica')!r}, "
+                  f"expected the decode-role replica", first)
+        snap = client.get_json(url, "/metrics")
+        if _counter(snap, "fleet_handoffs_total") != 1 or \
+                _counter(snap, "fleet_handoff_failures_total"):
+            _fail("expected exactly one successful KV handoff",
+                  snap.get("series"))
+        if _counter(snap, "fleet_handoff_bytes_total") <= 0:
+            _fail("handoff shipped zero KV bytes")
+        info = client.get_json(dc0.url, "/fleet/info")
+        hits = ((info.get("decode") or {}).get("default", {})
+                .get("prefix") or {}).get("hits", 0)
+        if hits < 1:
+            _fail("decode replica's radix saw no hit — the handed-off "
+                  "pages were not matched at admission", info)
+        # hint-warm repeat: same prompt, no second handoff, same tokens
+        _, t2, _ = _stream(client, url, body)
+        if t2 != t1:
+            _fail(f"warm repeat diverged: {t2} vs {t1}")
+        snap = client.get_json(url, "/metrics")
+        if _counter(snap, "fleet_handoffs_total") != 1:
+            _fail("hint-warm repeat triggered a redundant handoff")
+        print(f"fleet smoke: handoff OK (pf0→dc0, tokens={t1})")
+
+        # -- 2. drain-migration ---------------------------------------
+        sid = "smoke-mig"
+        body2 = {"prompt_ids": PROMPT2, "max_tokens": 8, "greedy": True,
+                 "fleet_session": sid}
+        first, mig1, term = _stream(client, url, body2)
+        home = first.get("replica")
+        if term.get("outcome") != "completed" or home != "dc0":
+            _fail("migration session did not complete on dc0",
+                  {"first": first, "terminal": term})
+        # pf0 becomes a decode-capable target, then the home drains
+        router.add_replica(ReplicaHandle("pf0", pf0.url, "mixed"))
+        drained = client.post_json(url, "/fleet/drain",
+                                   {"replica": "dc0"})
+        if drained.get("migrated", 0) < 1 or drained.get("failed"):
+            _fail("drain migrated no sessions", drained)
+        first, mig2, term = _stream(client, url, {
+            **body2, "prompt_ids": PROMPT2 + mig1})
+        if first.get("replica") != "pf0" or \
+                term.get("outcome") != "completed":
+            _fail("sticky follow-up did not resume on the survivor",
+                  {"first": first, "terminal": term})
+        # the migrated continuation must equal one uninterrupted run
+        _, ref16, _ = _stream(client, url, {
+            "prompt_ids": PROMPT2, "max_tokens": 16, "greedy": True})
+        if mig1 + mig2 != ref16:
+            _fail(f"migrated stream diverged: {mig1 + mig2} vs {ref16}")
+        client.post_json(url, "/fleet/drain",
+                         {"replica": "dc0", "draining": False})
+        print(f"fleet smoke: drain-migration OK "
+              f"(dc0→pf0, migrated={drained['migrated']})")
+
+        # -- 3. /metrics reconcile across tiers -----------------------
+        client_tokens = len(t1 + t2 + mig1 + mig2 + ref16)
+        snap = client.get_json(url, "/metrics")
+        router_tokens = _counter(snap, "fleet_tokens_streamed_total")
+        router_reqs = _counter(snap, "fleet_requests_total")
+        failed = _counter(snap, "fleet_failed_requests_total")
+        rep_tokens = 0
+        for p in procs:
+            rep = client.get_json(p.url, "/metrics")
+            for d in (rep.get("decode") or {}).values():
+                rep_tokens += int(d.get("tokens_streamed") or 0)
+        if failed:
+            _fail(f"router counted {failed} failed requests")
+        if not (router_tokens == rep_tokens == client_tokens):
+            _fail(f"token ledgers disagree: router={router_tokens} "
+                  f"replicas={rep_tokens} client={client_tokens}")
+        if router_reqs != 5:
+            _fail(f"router counted {router_reqs} requests, made 5")
+        print(f"fleet smoke OK: {int(router_tokens)} tokens reconciled "
+              f"across router, {len(procs)} replicas, and the client "
+              f"({int(router_reqs)} requests, 0 failed)")
+        return 0
+    finally:
+        if router is not None:
+            router.stop()
+        for p in procs:
+            p.terminate()
+
+
+if __name__ == "__main__":
+    sys.exit(main())
