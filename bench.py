@@ -769,7 +769,8 @@ def _append_history(mode, summary):
         fl = summary["fleet"]
         row["fleet"] = {k: fl.get(k) for k in (
             "replicas", "reroutes", "handoffs", "migrations",
-            "slo_drains", "ttft_p99_ms", "scaling", "reconciled")}
+            "slo_drains", "ttft_p99_ms", "scaling", "reconciled",
+            "scrape_age_s", "stale_replicas", "slo_burn")}
     if isinstance(summary.get("scale_legs"), list):
         row["scale_legs"] = [
             {"replicas": leg.get("replicas"),
@@ -2271,6 +2272,18 @@ def _serving_fleet_main():
                                            "fleet_failed_requests_total"),
             "rerouted_stream_ok": err is None and bool(post_toks),
         }
+        # federation health off the same poll tick: scrape freshness,
+        # stale count, and the worst fleet-SLO burn (dash.py row)
+        fed_rows = router.obsplane.federation.replicas()
+        ages = [r["age_s"] for r in fed_rows.values()
+                if r["age_s"] is not None]
+        slo_snap = router.obsplane.slo_engine.snapshot()
+        slo_leg["scrape_age_s"] = max(ages) if ages else None
+        slo_leg["stale_replicas"] = sum(
+            1 for r in fed_rows.values() if r["stale"])
+        slo_leg["slo_burn"] = max(
+            (float(s.get("burn_fast") or 0.0)
+             for s in slo_snap.get("slos", ())), default=0.0)
         assert slo_leg["slo_drains"] >= 1, "forced SLO breach never drained"
         assert slo_leg["inflight_failed"] == 0, inflight_err[:3]
         assert slo_leg["failed_requests"] == 0
@@ -2302,6 +2315,9 @@ def _serving_fleet_main():
             "ttft_p99_ms": best["fleet_ttft_p99_ms"],
             "scaling": scaling,
             "reconciled": all(l["metrics_reconciled"] for l in legs),
+            "scrape_age_s": slo_leg.get("scrape_age_s"),
+            "stale_replicas": slo_leg.get("stale_replicas"),
+            "slo_burn": slo_leg.get("slo_burn"),
         },
     }
     path = os.environ.get("BENCH_FLEET_OUT") or os.path.join(

@@ -20,6 +20,7 @@ Design constraints (they shape every choice here):
 
 from __future__ import annotations
 
+import bisect
 import json
 import math
 import re
@@ -29,6 +30,17 @@ from collections import deque
 from typing import Dict, Iterable, List, Optional, Tuple
 
 _NAME_RE = re.compile(r"[^a-zA-Z0-9_:]")
+
+# Fixed bucket ladder shared by every process. Federation (observe/
+# fedmon.py) merges replica histograms bucket-wise, which is only sound
+# when all processes bucket identically — so the ladder is a module
+# constant, never per-instrument. 1/2.5/5 per decade over 0.1..5e5
+# (ms-ish dynamic range), plus an implicit +Inf overflow bin.
+BUCKET_EDGES: Tuple[float, ...] = tuple(
+    round(m * (10.0 ** e), 6)
+    for e in range(-1, 6) for m in (1.0, 2.5, 5.0))
+# bump when the ladder changes: merging two ladders is meaningless
+BUCKET_VERSION = 1
 
 # Prometheus exposition format version implemented by to_prometheus()
 PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
@@ -128,7 +140,7 @@ class Histogram:
 
     kind = "histogram"
     __slots__ = ("name", "labels", "_lock", "_reservoir", "count", "sum",
-                 "_min", "_max", "_exemplars")
+                 "_min", "_max", "_exemplars", "_buckets")
 
     def __init__(self, name: str, labels, reservoir: int = 4096):
         self.name = name
@@ -139,6 +151,10 @@ class Histogram:
         self.sum = 0.0
         self._min: Optional[float] = None
         self._max: Optional[float] = None
+        # per-bin (non-cumulative) counts over BUCKET_EDGES; the last
+        # bin is the +Inf overflow. Exact forever (unlike the sliding
+        # reservoir) so cross-process merges are loss-free.
+        self._buckets = [0] * (len(BUCKET_EDGES) + 1)
         # OpenMetrics-style exemplars: recent observations that carry a
         # trace id, so a tail percentile can be joined back to the exact
         # request tree in the trace store (GET /trace/{id}).
@@ -150,6 +166,7 @@ class Histogram:
             self.count += 1
             self.sum += v
             self._reservoir.append(v)
+            self._buckets[bisect.bisect_left(BUCKET_EDGES, v)] += 1
             if self._min is None or v < self._min:
                 self._min = v
             if self._max is None or v > self._max:
@@ -158,6 +175,11 @@ class Histogram:
                 self._exemplars.append({"value": v,
                                         "trace_id": str(exemplar),
                                         "ts": round(time.time(), 3)})
+
+    def buckets(self) -> List[int]:
+        """Copy of the per-bin counts (len(BUCKET_EDGES) + 1 bins)."""
+        with self._lock:
+            return list(self._buckets)
 
     def exemplars(self) -> List[dict]:
         with self._lock:
@@ -186,8 +208,10 @@ class Histogram:
             count, total = self.count, self.sum
             lo, hi = self._min, self._max
             window = len(self._reservoir)
+            buckets = list(self._buckets)
         out = {"count": count, "sum": total, "min": lo, "max": hi,
-               "window": window}
+               "window": window, "buckets": buckets,
+               "bucket_v": BUCKET_VERSION}
         out.update(self.percentiles())
         exs = self.exemplars()
         if exs:

@@ -139,10 +139,17 @@ class SLO:
                     worst_pts = pts
             return worst_frac / self.budget, worst_val, worst_pts
         if self.kind == "ratio":
-            num = sum(store.delta(self.series, window_s, now, **lab)
-                      for lab in self.num)
-            den = sum(store.delta(self.series, window_s, now, **lab)
-                      for lab in self.den)
+            # a num/den label-dict may carry the reserved "__series__"
+            # key to draw from a DIFFERENT series name — fleet-scope
+            # objectives ratio across counters (failed handoffs over
+            # handoffs) that live in distinct series.
+            def _delta(lab: dict) -> float:
+                lab = dict(lab)
+                name = lab.pop("__series__", self.series)
+                return store.delta(name, window_s, now, **lab)
+
+            num = sum(_delta(lab) for lab in self.num)
+            den = sum(_delta(lab) for lab in self.den)
             ratio = (num / den) if den > 0 else 0.0
             return ratio / self.budget, ratio, []
         # rate_per_min

@@ -163,6 +163,13 @@ class FleetRouter(JsonHttpServer):
                 self.add_replica(ReplicaHandle(*spec))
         self._poller: Optional[threading.Thread] = None
         self._stop = threading.Event()
+        # the fleet observability plane: federation scrapes, trace
+        # stitching, incident bundles — all pull-based, driven from
+        # the poll loop (never from a stream's dispatch path)
+        from deeplearning4j_tpu.serving.fleet.obsplane import (
+            FleetObsPlane,
+        )
+        self.obsplane = FleetObsPlane(self)
 
     # ------------------------------------------------------------ topo
     def add_replica(self, handle: ReplicaHandle) -> None:
@@ -171,6 +178,13 @@ class FleetRouter(JsonHttpServer):
             self._hints.setdefault(
                 handle.name, deque(maxlen=self.HINTS_PER_REPLICA))
             self._refresh_gauges_locked()
+
+    def replica_urls(self) -> Dict[str, str]:
+        """name -> url for every known replica (healthy or not): the
+        obsplane's view of the fleet, copied under the lock so scrapes
+        and stitches run with NO router lock held."""
+        with self._lock:
+            return {name: r.url for name, r in self._replicas.items()}
 
     def _refresh_gauges_locked(self) -> None:
         # graft: allow(GL301): every caller holds self._lock (the
@@ -278,14 +292,23 @@ class FleetRouter(JsonHttpServer):
         """A network-level failure talking to `name`: bump the streak,
         and past the threshold stop placing anything there (the poller
         marks it healthy again when /healthz answers)."""
+        crashed = False
         with self._lock:
             r = self._replicas.get(name)
             if r is None:
                 return
             r.fail_streak += 1
             if r.fail_streak >= self.unhealthy_after:
+                crashed = r.healthy      # the healthy->dead transition
                 r.healthy = False
             self._refresh_gauges_locked()
+        if crashed:
+            # collect evidence while the survivors still remember the
+            # dead replica's streams (outside the lock: the collector
+            # does network I/O)
+            self.obsplane.trigger_incident(
+                f"replica_crash_{name}", sorted(self.replica_urls()),
+                {"replica": name})
 
     # ------------------------------------------------- disaggregation
     def _maybe_disaggregate(self, model: str, prompt: List[int],
@@ -482,6 +505,16 @@ class FleetRouter(JsonHttpServer):
                             tokens_so_far=len(emitted),
                             dur_ms=(time.monotonic() - hop_t0)
                             * 1000.0)
+                    # detached collector: never slows this stream's
+                    # own failover
+                    self.obsplane.trigger_incident(
+                        f"failover_{current.name}",
+                        sorted(self.replica_urls()),
+                        {"dead": current.name,
+                         "fleet_session": fleet_sid,
+                         "tokens_so_far": len(emitted),
+                         **({"trace_id": rt.trace_id}
+                            if rt is not None else {})})
                     if failovers > self.MAX_FAILOVERS:
                         self._c_failed.inc()
                         yield {"error": f"stream failed after "
@@ -684,12 +717,23 @@ class FleetRouter(JsonHttpServer):
         for name, reason in to_drain:
             self._c_slo_drains.inc()
             logger.warning("fleet: draining %s (%s)", name, reason)
+            self.obsplane.trigger_incident(
+                f"slo_drain_{name}", sorted(self.replica_urls()),
+                {"replica": name, "reason": reason})
             try:
                 self.drain_replica(name, reason=f"slo: {reason}")
             # graft: allow(GL403): replica vanished between verdict and
             # drain — the next poll round marks it unhealthy anyway
             except HttpError:
                 pass
+        # federation tick rides the same poll: scrape every replica's
+        # registry, merge, and evaluate the fleet-scope SLOs
+        try:
+            self.obsplane.scrape_once()
+        # graft: allow(GL403): federation is advisory — a failed scrape
+        # must not take down the health poll; the next tick retries
+        except Exception:
+            logger.exception("fleet scrape failed")
         return verdicts
 
     # -------------------------------------------- coordinated deploy
@@ -789,6 +833,10 @@ class FleetRouter(JsonHttpServer):
                     {"replica": r.name, "target": t["name"],
                      "error": str(e)})
         self._c_rollbacks.inc()
+        self.obsplane.trigger_incident(
+            "deploy_rollback", sorted(self.replica_urls()),
+            {"failure": failure, "rolled_back": len(rolled),
+             "rollback_errors": len(rollback_errors)})
         if rt is not None:
             reqtrace.finish_root(rt, ok=False,
                                  failed_replica=failure["replica"],
@@ -858,9 +906,60 @@ class FleetRouter(JsonHttpServer):
                 name, migrate=bool(req.get("migrate", True)))
         return self.undrain_replica(name)
 
+    def _fleet_metrics(self, request=None):
+        """GET /fleet/metrics — the federated view: every replica's
+        scraped registry merged (restart-safe counter deltas,
+        bucket-wise histograms, replica-labeled gauges), scrape
+        staleness per replica, and the fleet SLO verdicts."""
+        q = (request or {}).get("query", {})
+        if q.get("refresh"):
+            self.obsplane.scrape_once()
+        return self.obsplane.metrics_payload()
+
+    def _fleet_series(self, request=None):
+        """GET /fleet/series — the fleet SeriesStore the SLO engine
+        burns over (same query params as a replica's /series)."""
+        q = (request or {}).get("query", {})
+
+        def _f(name):
+            try:
+                return float(q[name][0]) if q.get(name) else None
+            except (TypeError, ValueError):
+                raise HttpError(400, f"bad {name!r} query param")
+        out = self.obsplane.store.snapshot(
+            window_s=_f("window"),
+            prefix=(q.get("prefix") or [None])[0])
+        out["scrapes"] = self.obsplane.scrapes
+        return out
+
+    def _trace_list(self):
+        store = reqtrace.get_trace_store()
+        ids = store.ids()
+        return {"traces": ids[-50:], "count": len(ids),
+                "sample_rate": reqtrace.sample_rate()}
+
+    def _trace(self, suffix: str, request=None):
+        """GET /trace/{id} — the router's tree with every hop's replica
+        subtree grafted in (one cross-process waterfall). `?raw=1`
+        returns the unstitched router-local tree."""
+        tid = suffix.strip("/")
+        if not tid:
+            return self._trace_list()
+        q = (request or {}).get("query", {})
+        doc = self.obsplane.stitched_trace(tid, raw=bool(q.get("raw")))
+        if doc is None:
+            raise HttpError(404, f"unknown trace: {tid!r}")
+        return doc
+
     def get_routes(self):
         return {"/fleet": self._fleet, "/healthz": self._healthz,
-                "/metrics": self._metrics}
+                "/metrics": self._metrics,
+                "/fleet/metrics": self._fleet_metrics,
+                "/fleet/series": self._fleet_series,
+                "/trace": self._trace_list}
+
+    def get_prefix_routes(self):
+        return {"/trace/": self._trace}
 
     def post_routes(self):
         return {"/generate": self._generate,

@@ -515,6 +515,13 @@ class InferenceServer(JsonHttpServer):
     def _metrics(self, request=None):
         depth = self.scheduler.queue_depth() if self.scheduler else 0
         cap = self.scheduler.capacity if self.scheduler else None
+        fmt = (request or {}).get("query", {}).get("format", [])
+        if fmt and fmt[0].lower() == "registry":
+            # the fleet scraper's format: the raw registry snapshot
+            # (counters/gauges/histograms+buckets), mergeable by
+            # observe.fedmon without re-deriving from the stats shape
+            self.stats.set_queue_gauges(depth, cap)
+            return self.stats.registry.snapshot()
         if request is not None and self._wants_prometheus(request):
             self.stats.set_queue_gauges(depth, cap)
             return TextResponse(self.stats.registry.to_prometheus(),
@@ -548,6 +555,37 @@ class InferenceServer(JsonHttpServer):
 
         return get_flight().snapshot()
 
+    def _flight_sub(self, suffix: str, request=None):
+        """GET /flight/latest — the newest on-disk dump bundle as JSON
+        (404 when this process has never dumped). Events are capped so
+        the response stays bounded even with a large keep budget."""
+        from deeplearning4j_tpu.observe.flight import (
+            get_flight, latest_dump, read_dump,
+        )
+
+        sub = suffix.strip("/")
+        if sub != "latest":
+            raise HttpError(404, f"unknown flight endpoint: {sub!r}")
+        path = latest_dump(get_flight().dump_dir)
+        if path is None:
+            raise HttpError(404, "no flight dump recorded yet")
+        doc = read_dump(path)
+        events = doc.get("events")
+        if isinstance(events, list) and len(events) > 500:
+            doc["events"] = events[-500:]
+            doc["events_truncated"] = len(events) - 500
+        doc["path"] = path
+        return doc
+
+    def _flight_dump(self, req: dict):
+        """POST /flight/dump — force a dump now (the fleet incident
+        collector asks survivors for their state at the incident)."""
+        from deeplearning4j_tpu.observe.flight import get_flight
+
+        reason = str(req.get("reason") or "requested")[:120]
+        path = get_flight().dump(reason)
+        return {"ok": path is not None, "path": path, "reason": reason}
+
     def _trace_list(self):
         store = reqtrace.get_trace_store()
         ids = store.ids()
@@ -571,11 +609,12 @@ class InferenceServer(JsonHttpServer):
                 "/series": self._series, "/slo": self._slo_route}
 
     def get_prefix_routes(self):
-        return {"/trace/": self._trace}
+        return {"/trace/": self._trace, "/flight/": self._flight_sub}
 
     def post_routes(self):
         return {"/output": self._output, "/generate": self._generate,
-                "/generate/cancel": self._generate_cancel}
+                "/generate/cancel": self._generate_cancel,
+                "/flight/dump": self._flight_dump}
 
     def stop(self):
         super().stop()

@@ -310,6 +310,22 @@ FIXTURES = {
              hist.observe(y.shape[0], exemplar=tid)
              return y
          """, True, False),
+        # stitch seam: grafting a replica subtree under a hop span must
+        # stay host-side — a devicey attr on the graft span is a trap
+        ("""
+         import jax.numpy as jnp
+         from deeplearning4j_tpu.observe import reqtrace
+         def stitch(tid, hop, x):
+             y = jnp.dot(x, x)
+             reqtrace.record_span(tid, "decode.hop", tokens=y)
+         """, True, True),
+        # the real seam passes only host scalars — no finding
+        ("""
+         from deeplearning4j_tpu.observe import reqtrace
+         def stitch(tid, replica, skew_ms):
+             reqtrace.record_span(tid, "decode.hop", replica=replica,
+                                  clock_skew_ms=skew_ms)
+         """, True, False),
     ],
     "GL602": [
         ("""
@@ -332,6 +348,24 @@ FIXTURES = {
          def report():
              reg = get_registry()
              return reg.snapshot()
+         """, True, False),
+        # scrape seam: snapshotting the registry once per replica in
+        # the federation loop re-locks every series per iteration
+        ("""
+         from deeplearning4j_tpu.observe.registry import get_registry
+         def scrape(replicas, fed):
+             reg = get_registry()
+             for name in replicas:
+                 fed.ingest(name, reg.snapshot())
+         """, True, True),
+        # the real scrape tick snapshots once, outside any loop
+        ("""
+         from deeplearning4j_tpu.observe.registry import get_registry
+         def scrape_once(fed):
+             reg = get_registry()
+             doc = reg.snapshot()
+             fed.ingest("self", doc)
+             return doc
          """, True, False),
     ],
     # GL7xx — interprocedural lockset pass (callgraph.py + locks.py)

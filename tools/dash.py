@@ -323,6 +323,26 @@ def render_bench(path: str, *, mode: str = "", width: int = 40) -> str:
                             + ("reconciled" if fl["reconciled"]
                                else "MISMATCHED"))
             lines.append("  fleet (latest run): " + ", ".join(bits))
+            # federation row across ALL history rows in the group:
+            # scrape freshness, stale replicas, and the fleet SLO burn
+            # sparkline (how close the merged objectives ran to firing)
+            fed_bits = []
+            if isinstance(fl.get("scrape_age_s"), (int, float)):
+                fed_bits.append(
+                    f"scrape age {_fmt(fl['scrape_age_s'])}s")
+            if isinstance(fl.get("stale_replicas"), (int, float)):
+                n = fl["stale_replicas"]
+                fed_bits.append(f"{_fmt(n)} stale replica(s)"
+                                if n else "0 stale")
+            burns = [r["fleet"]["slo_burn"] for r in rs
+                     if isinstance(r.get("fleet"), dict)
+                     and isinstance(r["fleet"].get("slo_burn"),
+                                    (int, float))]
+            if burns:
+                fed_bits.append(f"SLO burn {spark(burns, width // 2)} "
+                                f"{_fmt(burns[-1])}")
+            if fed_bits:
+                lines.append("  federation: " + ", ".join(fed_bits))
             legs = last.get("scale_legs")
             if isinstance(legs, list):
                 for leg in legs:

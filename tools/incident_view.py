@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""Render a fleet incident bundle (serving/fleet/obsplane.py) in the
+terminal.
+
+    python tools/incident_view.py /tmp/incident-1754.../        # one bundle
+    python tools/incident_view.py /tmp                          # newest here
+    python tools/incident_view.py /tmp --list                   # all bundles
+    python tools/incident_view.py <bundle> --traces             # + waterfalls
+
+A bundle is one directory: manifest.json, router_flight.json, the
+stitched last-K cross-process traces, and per-replica flight dumps and
+trace trees fetched at collection time. This tool reads the manifest
+and summarises what was (and was not) captured — unreachable replicas
+are the interesting rows. Stdlib only.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from trace_view import render_tree
+
+
+def find_bundles(root: str) -> list:
+    """All incident-* dirs under `root` (oldest first), or `root`
+    itself when it already is one."""
+    if os.path.isfile(os.path.join(root, "manifest.json")):
+        return [root]
+    try:
+        names = sorted(d for d in os.listdir(root)
+                       if d.startswith("incident-")
+                       and os.path.isfile(
+                           os.path.join(root, d, "manifest.json")))
+    except OSError:
+        return []
+    return [os.path.join(root, d) for d in names]
+
+
+def _load(path):
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def _fmt_ts(ts) -> str:
+    import datetime
+    try:
+        return datetime.datetime.fromtimestamp(float(ts)).strftime(
+            "%Y-%m-%d %H:%M:%S")
+    except (TypeError, ValueError, OSError):
+        return "?"
+
+
+def render_bundle(bundle: str, show_traces: bool = False) -> int:
+    man = _load(os.path.join(bundle, "manifest.json"))
+    if man is None:
+        print(f"{bundle}: no readable manifest.json", file=sys.stderr)
+        return 1
+    print(f"incident  {os.path.basename(bundle)}")
+    print(f"  reason      {man.get('reason', '?')}")
+    print(f"  at          {_fmt_ts(man.get('ts'))}"
+          f"  (router pid {man.get('router_pid', '?')})")
+    extra = man.get("extra") or {}
+    if extra:
+        brief = " ".join(f"{k}={v}" for k, v in list(extra.items())[:6])
+        print(f"  context     {brief}")
+    rf = man.get("router_flight")
+    if rf:
+        doc = _load(os.path.join(bundle, rf)) or {}
+        n_ev = len(doc.get("events") or ())
+        n_tr = len(doc.get("traces") or ())
+        print(f"  router      flight dump: {rf} "
+              f"({n_ev} events, {n_tr} traces)")
+    else:
+        print("  router      flight dump: MISSING")
+    print(f"  stitched    {man.get('stitched_count', 0)} cross-process "
+          f"trace(s): {man.get('stitched_traces', '-')}")
+    rows = man.get("replicas") or []
+    print(f"  replicas    {len(rows)} involved")
+    for row in rows:
+        name = row.get("name", "?")
+        if row.get("unreachable"):
+            print(f"    ✗ {name:<12} UNREACHABLE  "
+                  f"{row.get('error') or ''}")
+            continue
+        bits = []
+        if row.get("flight"):
+            bits.append(f"flight={row['flight']}")
+        else:
+            bits.append("no flight dump")
+        bits.append(f"traces={row.get('trace_count', 0)}")
+        if row.get("error"):
+            bits.append(f"note: {row['error']}")
+        print(f"    ✓ {name:<12} {'  '.join(bits)}")
+    if show_traces and man.get("stitched_traces"):
+        trees = _load(os.path.join(bundle, man["stitched_traces"])) or []
+        for t in trees:
+            if isinstance(t, dict):
+                print()
+                render_tree(t)
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("path", help="a bundle dir, or a dir holding "
+                    "incident-* bundles")
+    ap.add_argument("--list", action="store_true",
+                    help="one line per bundle instead of the newest")
+    ap.add_argument("--traces", action="store_true",
+                    help="also render the stitched trace waterfalls")
+    args = ap.parse_args(argv)
+
+    bundles = find_bundles(args.path)
+    if not bundles:
+        sys.exit(f"no incident bundle under {args.path!r} "
+                 "(expected incident-*/manifest.json)")
+    if args.list:
+        for b in bundles:
+            man = _load(os.path.join(b, "manifest.json")) or {}
+            reps = man.get("replicas") or []
+            dead = sum(1 for r in reps if r.get("unreachable"))
+            print(f"{os.path.basename(b):<56} "
+                  f"{_fmt_ts(man.get('ts'))}  "
+                  f"{man.get('reason', '?'):<28} "
+                  f"replicas={len(reps)} unreachable={dead}")
+        return 0
+    return render_bundle(bundles[-1], show_traces=args.traces)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -33,7 +33,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 BASELINE_PATH = os.path.join(os.path.dirname(__file__), "..",
                              ".graftperf-baseline.json")
-WORKLOAD_VERSION = 7
+WORKLOAD_VERSION = 8
 
 # Default slack written into a fresh baseline: zero extra compiles (a
 # new program IS the regression being hunted) and half a sync of noise
@@ -56,6 +56,12 @@ DEFAULT_BUDGETS = {"extra_compiles_per_owner": 0,
                    # zero compiles
                    "extra_series_syncs_per_step": 0.0,
                    "extra_series_compiles": 0,
+                   # fleet federation is pull-only (PERF_NOTES): a
+                   # scrape ingest or a cross-process trace stitch is
+                   # host-side dict work — zero syncs, zero compiles,
+                   # no budget at all
+                   "extra_fedmon_syncs_per_step": 0.0,
+                   "extra_fedmon_compiles": 0,
                    # fused decode pays ONE host sync per K-token window
                    # (the token readback) and session churn at a fixed K
                    # compiles NOTHING after the manager's warmup
@@ -205,6 +211,53 @@ def run_workload() -> dict:
             "extra_compiles": get_watchdog().snapshot()["total_compiles"]
             - compiles_before,
             "ticks": sampler.ticks,
+        }
+
+        # --- fedmon leg: the SAME steady-state fit with the fleet
+        # federation ingesting registry snapshots (scrape ticks) and
+        # the trace stitcher grafting cross-process subtrees. The
+        # federation contract (PERF_NOTES) is "pull-only": a scrape or
+        # a stitch is host-side dict work and may add ZERO syncs and
+        # ZERO compiles to any dispatch path — gated below via
+        # extra_fedmon_syncs_per_step / extra_fedmon_compiles.
+        from deeplearning4j_tpu.observe import reqtrace as rq
+        from deeplearning4j_tpu.observe.fedmon import FleetFederation
+        fed = FleetFederation(stale_after_s=3600.0)
+        compiles_before = get_watchdog().snapshot()["total_compiles"]
+        mon = HostSyncMonitor().install()
+        try:
+            net.fit(x, y, batch_size=8, epochs=2)
+            reg_doc = get_registry().snapshot()
+            for tick in range(8):          # deterministic scrape ticks
+                for rep in ("r0", "r1"):
+                    fed.ingest(rep, reg_doc)
+                fed.series_points()
+                merged = fed.snapshot()
+                hop = {"name": "decode.hop", "ts": 0.0, "dur_ms": 5.0,
+                       "span_id": "h1", "parent_id": None,
+                       "trace_id": "taaa-000001", "thread": "t",
+                       "attrs": {}, "children": []}
+                sub = {"trace_id": "tbbb-000001",
+                       "tree": [{"name": "session.window", "ts": 0.002,
+                                 "dur_ms": 3.0, "span_id": "w1",
+                                 "parent_id": None,
+                                 "trace_id": "tbbb-000001",
+                                 "thread": "t", "attrs": {},
+                                 "children": []}]}
+                rq.graft_subtree(hop, sub, skew_s=0.001,
+                                 replica="r0", pid=123)
+                rq.tree_stats({"trace_id": "taaa-000001",
+                               "tree": [hop]})
+        finally:
+            mon.uninstall()
+        fedmon_syncs = mon.syncs / steps
+        fedmon_leg = {
+            "syncs_per_step": round(fedmon_syncs, 3),
+            "extra_syncs_per_step": round(fedmon_syncs - syncs_per_step,
+                                          3),
+            "extra_compiles": get_watchdog().snapshot()["total_compiles"]
+            - compiles_before,
+            "replicas_federated": len(merged["replicas"]),
         }
 
         # --- windowed-attention transformer fit: the dispatch-policy
@@ -490,6 +543,7 @@ def run_workload() -> dict:
         "syncs_per_step": round(syncs_per_step, 3),
         "traced": traced,
         "series": series,
+        "fedmon": fedmon_leg,
         "decode": decode,
         "spec": spec,
         "prefix": prefix,
@@ -564,6 +618,25 @@ def compare(baseline: dict, measured: dict) -> list:
                 f"{meas_se.get('extra_compiles')} jit compile(s) "
                 f"(budget +{c_budget}) — the telemetry path must never "
                 f"enter jit")
+    # fedmon leg: only gated once a baseline recorded it
+    if baseline.get("fedmon"):
+        meas_fm = measured.get("fedmon") or {}
+        f_budget = budgets["extra_fedmon_syncs_per_step"]
+        if meas_fm.get("extra_syncs_per_step", 0.0) > f_budget:
+            breaches.append(
+                f"fit with fleet federation scrapes + trace stitching "
+                f"live added {meas_fm.get('extra_syncs_per_step')} "
+                f"syncs/step over the plain run (budget +{f_budget}) — "
+                f"federation is pull-only by contract (PERF_NOTES): a "
+                f"scrape or stitch never adds a host sync to any "
+                f"dispatch path")
+        fc_budget = budgets["extra_fedmon_compiles"]
+        if meas_fm.get("extra_compiles", 0) > fc_budget:
+            breaches.append(
+                f"fleet federation scrape/stitch ticks compiled "
+                f"{meas_fm.get('extra_compiles')} program(s) (budget "
+                f"+{fc_budget}) — the federation path is host-side "
+                f"dict work and must never enter jit")
     # fused-decode leg: only gated once a baseline recorded it
     if baseline.get("decode"):
         base_d = baseline["decode"]
@@ -707,6 +780,12 @@ def diff(baseline: dict, measured: dict) -> list:
         m = (measured.get("series") or {}).get(key)
         if b != m:
             out.append(f"  series.{key}: {b} -> {m}")
+    for key in ("syncs_per_step", "extra_syncs_per_step",
+                "extra_compiles"):
+        b = (baseline.get("fedmon") or {}).get(key)
+        m = (measured.get("fedmon") or {}).get(key)
+        if b != m:
+            out.append(f"  fedmon.{key}: {b} -> {m}")
     for key in ("syncs_per_window", "extra_compiles"):
         b = (baseline.get("decode") or {}).get(key)
         m = (measured.get("decode") or {}).get(key)

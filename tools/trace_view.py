@@ -45,14 +45,33 @@ def _bar(t0: float, span_ts: float, dur_ms: float, total_ms: float) -> str:
     return "[" + " " * a + "█" * (b - a) + " " * (BAR_W - b) + "]"
 
 
+def _boundary_rule(attrs: dict, depth: int) -> str:
+    """The process-boundary marker a stitched trace prints before each
+    grafted subtree: which replica, which pid, and the clock-skew
+    correction already applied to its timestamps."""
+    pad = "  " * depth
+    bits = [f"replica={attrs.get('replica', '?')}"]
+    if attrs.get("pid") is not None:
+        bits.append(f"pid={attrs['pid']}")
+    skew = attrs.get("clock_skew_ms")
+    if isinstance(skew, (int, float)) and skew:
+        bits.append(f"skew{skew:+.2f}ms corrected")
+    if attrs.get("unreachable"):
+        bits.append("UNREACHABLE")
+    rule = f"  {'':>9}   {'':>9}   {'═' * (BAR_W + 2)} {pad}║ "
+    return rule + " ".join(bits)
+
+
 def _walk(node: dict, depth: int, t0: float, total_ms: float) -> None:
+    attrs = node.get("attrs") or {}
+    if attrs.get("boundary") == "process":
+        print(_boundary_rule(attrs, depth))
     rel_ms = (node.get("ts", t0) - t0) * 1e3
     dur = float(node.get("dur_ms", 0.0))
     pad = "  " * depth
     line = (f"  {rel_ms:+9.2f}ms {dur:9.2f}ms "
             f"{_bar(t0, node.get('ts', t0), dur, total_ms)} "
             f"{pad}{node.get('name', '?')}")
-    attrs = node.get("attrs") or {}
     brief = _attrs_brief(attrs)
     if brief:
         line += f"  {brief}"
@@ -64,8 +83,13 @@ def _walk(node: dict, depth: int, t0: float, total_ms: float) -> None:
 def render_tree(doc: dict) -> None:
     """Render one /trace/{id} document: {trace_id, spans, depth, tree}."""
     roots = doc.get("tree") or []
-    print(f"trace {doc.get('trace_id', '?')}  "
-          f"({doc.get('spans', '?')} spans, depth {doc.get('depth', '?')})")
+    head = (f"trace {doc.get('trace_id', '?')}  "
+            f"({doc.get('spans', '?')} spans, depth "
+            f"{doc.get('depth', '?')}")
+    if doc.get("stitched"):
+        head += (f", stitched across {doc.get('processes', '?')} "
+                 f"processes, {doc.get('grafted_spans', 0)} grafted")
+    print(head + ")")
     if not roots:
         print("  (no spans)")
         return
@@ -81,8 +105,10 @@ def render_tree(doc: dict) -> None:
         _walk(r, 0, t0, total_ms)
 
 
-def extract_trees(doc: dict) -> list:
-    """Accept any of the three JSON shapes that carry trace trees."""
+def extract_trees(doc) -> list:
+    """Accept any of the JSON shapes that carry trace trees."""
+    if isinstance(doc, list):                  # incident bundle's
+        return [t for t in doc if isinstance(t, dict)]  # stitched_traces
     if "tree" in doc:                          # GET /trace/{id}
         return [doc]
     if isinstance(doc.get("traces"), list):    # flight dump block
