@@ -2,14 +2,25 @@
 
     python -m deeplearning4j_tpu.analysis [paths...]
         [--format text|json|sarif] [--strict]
-        [--baseline FILE] [--write-baseline FILE]
+        [--baseline FILE] [--write-baseline FILE] [--prune-baseline]
         [--select GL2,GL301] [--ignore GL4] [--list-rules]
-        [--hot-prefix PREFIX ...] [--changed [BASE]]
+        [--hot-prefix PREFIX ...] [--changed [BASE]] [--no-cache]
 
 Exit codes: 0 clean (after baseline/suppressions); 1 findings
 (errors only by default, any finding under --strict); 2 usage error.
 `tools/ci_check.sh` runs `--strict --baseline .graftlint-baseline.json`
 as the repo's lint-clean gate.
+
+Results are cached in `.graftlint-cache.json` (per-file mtime/sha +
+whole-program digest; invalidated by RULES_VERSION bumps) so repeat
+runs over an unchanged tree are stat-only. `--no-cache` forces a cold
+pass and leaves the cache file untouched.
+
+`--prune-baseline` rewrites the baseline file (default
+`.graftlint-baseline.json`, or `--baseline FILE`) dropping entries
+that no longer match any current finding, prints what was pruned, and
+exits 0 — run it after fixing baselined findings so the debt ledger
+never overstates what is still allowed.
 
 `--changed [BASE]` lints only the .py files `git diff --name-only BASE`
 reports (default BASE: HEAD), plus untracked .py files — the pre-commit
@@ -29,8 +40,9 @@ import sys
 from typing import List, Optional
 
 from deeplearning4j_tpu.analysis.baseline import (
-    apply_baseline, load_baseline, write_baseline,
+    apply_baseline, load_baseline, prune_baseline, write_baseline,
 )
+from deeplearning4j_tpu.analysis.cache import CACHE_FILE
 from deeplearning4j_tpu.analysis.engine import (
     DEFAULT_HOT_PREFIXES, iter_python_files, lint_paths,
 )
@@ -90,6 +102,14 @@ def main(argv: Optional[List[str]] = None) -> int:
                     help="subtract findings recorded in FILE")
     ap.add_argument("--write-baseline", metavar="FILE",
                     help="write the current findings to FILE and exit 0")
+    ap.add_argument("--prune-baseline", action="store_true",
+                    help="drop baseline entries that no longer match "
+                         "any current finding (clamping counts), print "
+                         "what was pruned, and exit 0; uses --baseline "
+                         "FILE or .graftlint-baseline.json")
+    ap.add_argument("--no-cache", action="store_true",
+                    help="bypass the .graftlint-cache.json result "
+                         "cache (cold re-lint; cache file untouched)")
     ap.add_argument("--select", metavar="RULES",
                     help="comma-separated rule-id prefixes to keep "
                          "(e.g. GL2,GL301)")
@@ -126,9 +146,28 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         paths = args.paths or ["deeplearning4j_tpu"]
     files = iter_python_files(paths)
+    cache_path = None if args.no_cache else CACHE_FILE
     findings = lint_paths(paths, hot_prefixes=hot,
                           select=_split_rules(args.select),
-                          ignore=_split_rules(args.ignore))
+                          ignore=_split_rules(args.ignore),
+                          cache_path=cache_path)
+
+    if args.prune_baseline:
+        bpath = args.baseline or ".graftlint-baseline.json"
+        try:
+            doc, pruned = prune_baseline(findings, bpath)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"graft-lint: cannot prune baseline {bpath}: {e}",
+                  file=sys.stderr)
+            return 2
+        for e in pruned:
+            print(f"graft-lint: pruned {e['rule']} {e['path']} "
+                  f"(-{e['dropped']} of {e['count']}): "
+                  f"{e['snippet'][:60]}")
+        print(f"graft-lint: pruned {len(pruned)} stale baseline "
+              f"entr{'y' if len(pruned) == 1 else 'ies'}; "
+              f"{len(doc['findings'])} remain in {bpath}")
+        return 0
 
     if args.write_baseline:
         doc = write_baseline(findings, args.write_baseline)

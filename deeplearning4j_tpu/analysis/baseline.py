@@ -48,6 +48,36 @@ def load_baseline(path: str) -> Dict[Tuple[str, str, str], int]:
     return out
 
 
+def prune_baseline(findings: List[Finding], path: str,
+                   ) -> Tuple[dict, List[dict]]:
+    """Drop baseline entries in `path` that no longer match any current
+    finding, clamping each surviving count to the number of findings
+    that actually carry its key today. Rewrites the file in place and
+    returns (new doc, pruned entries) — each pruned entry is the
+    original dict plus how many counts were dropped, so the CLI can
+    print exactly what went stale."""
+    baseline = load_baseline(path)
+    current: Counter = Counter(f.key() for f in findings)
+    entries: List[dict] = []
+    pruned: List[dict] = []
+    for key, n in sorted(baseline.items()):
+        rule, fpath, snippet = key
+        keep = min(n, current.get(key, 0))
+        if keep:
+            entries.append({"rule": rule, "path": fpath,
+                            "snippet": snippet, "count": keep})
+        if n > keep:
+            pruned.append({"rule": rule, "path": fpath,
+                           "snippet": snippet, "count": n,
+                           "dropped": n - keep})
+    doc = {"version": BASELINE_VERSION, "tool": "graft-lint",
+           "findings": entries}
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(doc, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return doc, pruned
+
+
 def apply_baseline(findings: List[Finding],
                    baseline: Dict[Tuple[str, str, str], int],
                    ) -> Tuple[List[Finding], int]:

@@ -1,0 +1,209 @@
+"""graft-lint result cache — `.graftlint-cache.json`.
+
+The full-repo strict pass in CI re-parses and re-lints ~200 files on
+every run even though a typical PR touches a handful. This module
+caches per-file findings keyed by (mtime_ns, size, sha256) and the
+whole-program interprocedural findings keyed by a digest over every
+file's content hash, so a warm re-lint of an unchanged tree is a
+stat()-only walk — no file reads, no AST parses, no fixpoints.
+
+Invalidation is conservative and layered:
+
+  * doc `version` — the cache file format itself (this module).
+  * `rules_version` — rules.RULES_VERSION; any rule-semantics bump
+    forces a cold re-lint even when no source changed.
+  * `config` — the hot-prefix tuple; hot-gating changes per-file
+    results, so a different configuration never reuses entries.
+  * per file: `mtime_ns` + `size` fast path, falling back to sha256
+    when the stat signature moved but content may not have (checkout
+    churn, `touch`); a changed sha re-lints just that file.
+  * program: sha256 over the sorted (path, file-sha) pairs; ANY
+    changed/added/removed file re-runs the (shared, single-build)
+    GL7xx + GL8xx whole-program pass — interprocedural findings in
+    file A can be caused by an edit in file B, so per-file reuse is
+    never attempted for them.
+
+Findings round-trip through Finding.to_dict()/from_dict(); severity
+and category are re-derived from the live rule registry on load.
+Cache write failures are non-fatal (read-only checkouts, parallel CI
+shards racing on the same file) — the lint result is always computed
+correctly, the cache is only ever a speedup.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from deeplearning4j_tpu.analysis.engine import (
+    DEFAULT_HOT_PREFIXES, Finding, is_hot, lint_source)
+from deeplearning4j_tpu.analysis.rules import RULES_VERSION
+
+#: Default cache location (repo root, gitignored).
+CACHE_FILE = ".graftlint-cache.json"
+
+#: Format version of the cache document itself.
+CACHE_VERSION = 1
+
+
+def _sha(text: str) -> str:
+    return hashlib.sha256(text.encode("utf-8", "replace")).hexdigest()
+
+
+def _rel(path: str) -> str:
+    """Same path normalization lint_file / Program.from_paths use, so
+    cached finding paths are byte-identical to cold-pass ones."""
+    rel = os.path.relpath(path).replace(os.sep, "/")
+    if rel.startswith(".."):
+        rel = path.replace(os.sep, "/")
+    return rel
+
+
+def _fresh_doc(config: str) -> dict:
+    return {"version": CACHE_VERSION, "rules_version": RULES_VERSION,
+            "config": config, "files": {}, "program": {}}
+
+
+def load_cache(cache_path: str, config: str) -> dict:
+    """Load the cache doc, discarding it wholesale on any version,
+    rules-version, or configuration mismatch (or corruption)."""
+    try:
+        with open(cache_path, "r", encoding="utf-8") as f:
+            doc = json.load(f)
+        if (not isinstance(doc, dict)
+                or doc.get("version") != CACHE_VERSION
+                or doc.get("rules_version") != RULES_VERSION
+                or doc.get("config") != config
+                or not isinstance(doc.get("files"), dict)
+                or not isinstance(doc.get("program"), dict)):
+            return _fresh_doc(config)
+        return doc
+    except (OSError, ValueError):
+        return _fresh_doc(config)
+
+
+def save_cache(cache_path: str, doc: dict) -> bool:
+    """Atomic best-effort write; returns False (never raises) when the
+    location is unwritable — caching is an optimization, not a result."""
+    try:
+        d = os.path.dirname(os.path.abspath(cache_path))
+        fd, tmp = tempfile.mkstemp(prefix=".graftlint-cache.",
+                                   suffix=".tmp", dir=d)
+        try:
+            with os.fdopen(fd, "w", encoding="utf-8") as f:
+                json.dump(doc, f, separators=(",", ":"))
+            os.replace(tmp, cache_path)
+        except OSError:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+            raise
+        return True
+    except OSError:
+        return False
+
+
+def _read(path: str) -> str:
+    with open(path, "r", encoding="utf-8", errors="replace") as f:
+        return f.read()
+
+
+def lint_files_cached(files: Sequence[str], *,
+                      hot_prefixes: Sequence[str] = DEFAULT_HOT_PREFIXES,
+                      cache_path: str = CACHE_FILE) -> List[Finding]:
+    """Cache-aware equivalent of engine.lint_files.
+
+    Unchanged files (stat signature, else sha) reuse stored findings
+    without being read or parsed; the shared whole-program GL7xx+GL8xx
+    pass reruns only when the file-set content digest moved. Returns
+    the same unsorted, unfiltered finding list lint_files would.
+    """
+    from deeplearning4j_tpu.analysis.callgraph import Program
+    from deeplearning4j_tpu.analysis.locks import analyze_lock_program
+    from deeplearning4j_tpu.analysis.shardflow import (
+        analyze_shardflow_program)
+
+    config = "|".join(hot_prefixes)
+    doc = load_cache(cache_path, config)
+    old_files: Dict[str, dict] = doc["files"]
+    new_files: Dict[str, dict] = {}
+    dirty = False
+
+    findings: List[Finding] = []
+    # rel -> source, only for files we actually had to read this run.
+    read_src: Dict[str, str] = {}
+    order: List[str] = []  # rel paths in lint order (for Program build)
+
+    for path in files:
+        rel = _rel(path)
+        order.append(rel)
+        try:
+            st = os.stat(path)
+            sig = [st.st_mtime_ns, st.st_size]
+        except OSError:
+            sig = None
+        entry = old_files.get(rel)
+        if (entry is not None and sig is not None
+                and entry.get("stat") == sig):
+            # Warm fast path: no read, no parse.
+            new_files[rel] = entry
+            findings.extend(Finding.from_dict(d)
+                            for d in entry["findings"])
+            continue
+        src = _read(path)
+        read_src[rel] = src
+        sha = _sha(src)
+        if entry is not None and entry.get("sha") == sha:
+            # Content unchanged, stat churned (touch/checkout): reuse
+            # findings, refresh the stat signature.
+            entry = dict(entry, stat=sig)
+            new_files[rel] = entry
+            findings.extend(Finding.from_dict(d)
+                            for d in entry["findings"])
+            dirty = True
+            continue
+        fnds = lint_source(src, rel, hot=is_hot(rel, hot_prefixes),
+                           hot_prefixes=hot_prefixes, locks=False)
+        new_files[rel] = {"stat": sig, "sha": sha,
+                          "findings": [f.to_dict() for f in fnds]}
+        findings.extend(fnds)
+        dirty = True
+
+    # Merge rather than replace: a --changed / subset run must not
+    # evict the full-repo entries. Entries for files that vanished
+    # from disk are pruned; everything else survives untouched.
+    merged = dict(old_files)
+    for rel in list(merged):
+        if rel not in new_files and not os.path.exists(rel):
+            del merged[rel]
+            dirty = True
+    merged.update(new_files)
+    doc["files"] = merged
+
+    prog_digest = _sha("\n".join(
+        f"{rel}:{new_files[rel]['sha']}" for rel in sorted(new_files)))
+    prog_entry = doc["program"]
+    if prog_entry.get("digest") == prog_digest:
+        findings.extend(Finding.from_dict(d)
+                        for d in prog_entry["findings"])
+    else:
+        sources: List[Tuple[str, str]] = []
+        for rel, path in zip(order, files):
+            src = read_src.get(rel)
+            if src is None:
+                src = _read(path)
+            sources.append((rel, src))
+        prog = Program.from_sources(sources)
+        pf = list(analyze_lock_program(prog, hot_prefixes=hot_prefixes))
+        pf.extend(analyze_shardflow_program(prog,
+                                            hot_prefixes=hot_prefixes))
+        doc["program"] = {"digest": prog_digest,
+                          "findings": [f.to_dict() for f in pf]}
+        findings.extend(pf)
+        dirty = True
+
+    if dirty:
+        save_cache(cache_path, doc)
+    return findings

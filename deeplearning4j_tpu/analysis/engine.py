@@ -159,6 +159,16 @@ class Finding:
                 "related": [{"path": p, "line": ln, "message": m}
                             for (p, ln, m) in self.related]}
 
+    @classmethod
+    def from_dict(cls, d: dict) -> "Finding":
+        """Inverse of to_dict for the fields a Finding is built from —
+        severity/category are re-derived from the rule registry, so a
+        cached finding always reflects the CURRENT rule metadata."""
+        return cls(d["rule"], d["path"], int(d["line"]), int(d["col"]),
+                   d["message"], d.get("snippet", ""),
+                   related=tuple((r["path"], int(r["line"]), r["message"])
+                                 for r in d.get("related", ())))
+
 
 def is_hot(path: str,
            hot_prefixes: Sequence[str] = DEFAULT_HOT_PREFIXES) -> bool:
@@ -1077,16 +1087,23 @@ def lint_source(source: str, path: str = "<string>", *,
                 locks: bool = True,
                 ) -> List[Finding]:
     """Lint one source string; `hot` overrides path-based hot detection.
-    The interprocedural GL7xx lockset pass runs over the file as a
-    one-module program unless `locks=False` (lint_paths disables it
-    per-file and runs one whole-program pass instead)."""
+    The interprocedural passes (GL7xx lockset + GL8xx shardflow) run
+    over the file as a one-module program — built ONCE and shared
+    between the two families — unless `locks=False` (lint_paths
+    disables them per-file and runs one whole-program pass instead)."""
     if hot is None:
         hot = is_hot(path, hot_prefixes)
     findings = _FileLinter(path, source, hot=hot).run()
     if locks:
-        from deeplearning4j_tpu.analysis.locks import analyze_lock_sources
-        findings.extend(analyze_lock_sources(
-            [(path, source)], hot=hot, hot_prefixes=hot_prefixes))
+        from deeplearning4j_tpu.analysis.callgraph import Program
+        from deeplearning4j_tpu.analysis.locks import analyze_lock_program
+        from deeplearning4j_tpu.analysis.shardflow import (
+            analyze_shardflow_program)
+        prog = Program.from_sources([(path, source)])
+        findings.extend(analyze_lock_program(
+            prog, hot=hot, hot_prefixes=hot_prefixes))
+        findings.extend(analyze_shardflow_program(
+            prog, hot_prefixes=hot_prefixes))
         findings.sort(key=lambda f: (f.line, f.col, f.rule))
     return findings
 
@@ -1121,22 +1138,49 @@ def iter_python_files(paths: Sequence[str]) -> List[str]:
     return out
 
 
-def lint_paths(paths: Sequence[str], *,
+def lint_files(files: Sequence[str], *,
                hot_prefixes: Sequence[str] = DEFAULT_HOT_PREFIXES,
-               select: Optional[Sequence[str]] = None,
-               ignore: Optional[Sequence[str]] = None,
                ) -> List[Finding]:
-    """Lint files/trees; optional rule-id prefix filters ('GL2' selects
-    the whole sync category). The GL7xx lockset pass runs once over ALL
-    the files as one program, so cross-module lock facts (entry-held
-    propagation, acquisition-order edges) see every caller."""
-    from deeplearning4j_tpu.analysis.locks import analyze_lock_paths
-    files = iter_python_files(paths)
+    """Cold lint of an explicit file list: per-file single-module rules,
+    then ONE Program build shared by both interprocedural families
+    (GL7xx lockset, GL8xx shardflow) — the repo is parsed once, not
+    once per family. No select/ignore filtering, no sort; lint_paths
+    and the result cache layer on top of this."""
+    from deeplearning4j_tpu.analysis.callgraph import Program
+    from deeplearning4j_tpu.analysis.locks import analyze_lock_program
+    from deeplearning4j_tpu.analysis.shardflow import (
+        analyze_shardflow_program)
     findings: List[Finding] = []
     for f in files:
         findings.extend(lint_file(f, hot_prefixes=hot_prefixes,
                                   locks=False))
-    findings.extend(analyze_lock_paths(files, hot_prefixes=hot_prefixes))
+    prog = Program.from_paths(files)
+    findings.extend(analyze_lock_program(prog, hot_prefixes=hot_prefixes))
+    findings.extend(analyze_shardflow_program(prog,
+                                              hot_prefixes=hot_prefixes))
+    return findings
+
+
+def lint_paths(paths: Sequence[str], *,
+               hot_prefixes: Sequence[str] = DEFAULT_HOT_PREFIXES,
+               select: Optional[Sequence[str]] = None,
+               ignore: Optional[Sequence[str]] = None,
+               cache_path: Optional[str] = None,
+               ) -> List[Finding]:
+    """Lint files/trees; optional rule-id prefix filters ('GL2' selects
+    the whole sync category). The interprocedural GL7xx/GL8xx passes
+    run once over ALL the files as one program, so cross-module facts
+    (entry-held propagation, donation summaries) see every caller.
+    `cache_path` enables the (mtime, sha) result cache — unchanged
+    files reuse stored findings and the whole-program pass is skipped
+    when no file changed (see analysis/cache.py)."""
+    files = iter_python_files(paths)
+    if cache_path:
+        from deeplearning4j_tpu.analysis.cache import lint_files_cached
+        findings = lint_files_cached(files, hot_prefixes=hot_prefixes,
+                                     cache_path=cache_path)
+    else:
+        findings = lint_files(files, hot_prefixes=hot_prefixes)
     if select:
         findings = [f for f in findings
                     if any(f.rule.startswith(s) for s in select)]

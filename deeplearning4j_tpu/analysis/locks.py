@@ -774,6 +774,20 @@ def _find_cycles(edges: Set[Tuple[str, str]]) -> List[List[str]]:
 
 # ------------------------------------------------------------ public API
 
+def analyze_lock_program(prog: Program, *,
+                         hot: Optional[bool] = None,
+                         hot_prefixes: Sequence[str] =
+                         DEFAULT_HOT_PREFIXES) -> List[Finding]:
+    """Run the GL7xx lockset pass over an already-built Program.
+
+    This is the seam the engine uses to share ONE callgraph build
+    between the lockset and shardflow families — building the Program
+    (parse + symbol tables) dominates a whole-repo run, so each
+    interprocedural pass must accept a prebuilt one rather than
+    re-parsing the world per family."""
+    return _LockAnalysis(prog, hot=hot, hot_prefixes=hot_prefixes).run()
+
+
 def analyze_lock_sources(sources: Sequence[Tuple[str, str]], *,
                          hot: Optional[bool] = None,
                          hot_prefixes: Sequence[str] =
@@ -781,11 +795,11 @@ def analyze_lock_sources(sources: Sequence[Tuple[str, str]], *,
     """Run the GL7xx lockset pass over (path, source) pairs as one
     program. `hot` forces GL703's hot gate for every file (fixtures)."""
     prog = Program.from_sources(sources)
-    return _LockAnalysis(prog, hot=hot, hot_prefixes=hot_prefixes).run()
+    return analyze_lock_program(prog, hot=hot, hot_prefixes=hot_prefixes)
 
 
 def analyze_lock_paths(files: Sequence[str], *,
                        hot_prefixes: Sequence[str] =
                        DEFAULT_HOT_PREFIXES) -> List[Finding]:
     prog = Program.from_paths(files)
-    return _LockAnalysis(prog, hot=None, hot_prefixes=hot_prefixes).run()
+    return analyze_lock_program(prog, hot=None, hot_prefixes=hot_prefixes)

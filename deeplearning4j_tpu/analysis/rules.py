@@ -30,6 +30,12 @@ from typing import Dict, Tuple
 ERROR = "error"
 WARNING = "warning"
 
+#: Bumped whenever rule semantics change in a way that invalidates
+#: previously-computed findings; the `.graftlint-cache.json` result
+#: cache (analysis/cache.py) keys on it, so a rules change forces a
+#: cold re-lint even when no source file changed.
+RULES_VERSION = 2
+
 CAT_TRACER = "tracer"
 CAT_RECOMPILE = "recompile"
 CAT_SYNC = "sync"
@@ -157,6 +163,41 @@ _ALL = (
          "or thread target without re-acquiring the guard inside the "
          "closure — it runs later on another thread, outside whatever "
          "lock was held at registration time"),
+    # --------------- interprocedural sharding/donation (analysis/shardflow.py)
+    Rule("GL801", "use-after-donate", CAT_SHARDING, ERROR,
+         "read or pass of a value after it was handed to a donated "
+         "argument position of a jitted call (donate_argnums) — the "
+         "buffer is dead by contract; XLA may already have aliased it "
+         "into the output, so the read returns garbage or raises; "
+         "donation facts propagate through resolved helper calls, and "
+         "the related location names the donating call site"),
+    Rule("GL802", "cross-spec-combine", CAT_SHARDING, WARNING,
+         "binop/concat/stack of values whose placement provenance "
+         "differs (distinct with_sharding_constraint/device_put specs) "
+         "— GSPMD inserts an implicit resharding collective at the "
+         "combine point; constrain both operands to one spec, or make "
+         "the reshard explicit; related locations name the two "
+         "placement sites"),
+    Rule("GL803", "jit-pytree-churn", CAT_SHARDING, WARNING,
+         "the same jitted callee is invoked with differing pytree "
+         "structure across call sites (dict key order, list-vs-tuple) — "
+         "the jit cache keys on treedef, so each structure is a silent "
+         "full recompile GL101–103 cannot see; canonicalize the "
+         "container at the call sites (related location names the "
+         "other one)"),
+    Rule("GL804", "device-value-serialized", CAT_SHARDING, ERROR,
+         "device-tainted value reaches a serialization sink "
+         "(json.dumps/pickle/struct.pack/base64/.tobytes()) without an "
+         "np.asarray()/jax.device_get() laundering point — the wire "
+         "format captures a live device buffer (undefined bytes under "
+         "donation, a forced sync at best); copy to host first, the "
+         "fleet KV-handoff contract"),
+    Rule("GL805", "collective-axis-literal", CAT_SHARDING, WARNING,
+         "psum/all_gather/ppermute axis name passed as a string "
+         "literal outside parallel/mesh.py — axis names are the mesh "
+         "spine's contract; a literal drifts silently when the mesh "
+         "axes are renamed or reshaped, so read them from the active "
+         "MeshContext / parallel.mesh constants"),
 )
 
 RULES: Dict[str, Rule] = {r.id: r for r in _ALL}
@@ -172,6 +213,8 @@ RUNTIME_RULE_HINTS: Dict[str, Tuple[str, ...]] = {
     "hot_snapshot": ("GL602",),
     "lock_order": ("GL702",),
     "guarded_field": ("GL701",),
+    "use_after_donate": ("GL801",),
+    "device_serialized": ("GL804",),
 }
 
 

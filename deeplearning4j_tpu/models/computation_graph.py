@@ -26,6 +26,7 @@ from deeplearning4j_tpu.data.iterators import (
 )
 from deeplearning4j_tpu.optim.executor import LossTracker, TrainingExecutor
 from deeplearning4j_tpu.optim.recovery import build_plan, run_with_recovery
+from deeplearning4j_tpu.observe import donatemon
 from deeplearning4j_tpu.nn.graph import (
     ComputationGraphConfiguration, GraphVertex, LayerVertex,
     resolve_output_type,
@@ -286,7 +287,12 @@ class ComputationGraph(SeqCtxJitCache, SeqCtxSolverCache):
 
         if not jit:
             return step_fn
-        return jax.jit(step_fn, donate_argnums=(0, 1, 2))
+        # donatemon.instrument is identity with DL4J_TPU_DONATEMON off;
+        # on, it witnesses the (params, opt_state, states) donation.
+        return donatemon.instrument(
+            jax.jit(step_fn, donate_argnums=(0, 1, 2)), (0, 1, 2),
+            name="ComputationGraph._step",
+            arg_names=("params", "opt_state", "states"))
 
     # ---------------------------------------------------- data plumbing
     def _to_dicts(self, ds: Union[DataSet, MultiDataSet], host: bool = False):
@@ -412,7 +418,10 @@ class ComputationGraph(SeqCtxJitCache, SeqCtxSolverCache):
                 (feats, labs, fms, lms))
             return params, opt_state, states, rng, losses
 
-        fn = jax.jit(fused, donate_argnums=(0, 1, 2))
+        fn = donatemon.instrument(
+            jax.jit(fused, donate_argnums=(0, 1, 2)), (0, 1, 2),
+            name="ComputationGraph._fused_step",
+            arg_names=("params", "opt_state", "states"))
         self._jit_cache[cache_key] = fn
         return fn
 

@@ -30,6 +30,7 @@ from deeplearning4j_tpu.data.iterators import (
     DataSetIterator, DevicePrefetchIterator, as_iterator,
 )
 from deeplearning4j_tpu.models.decode_state import DecodeState
+from deeplearning4j_tpu.observe import donatemon
 from deeplearning4j_tpu.nn.config import MultiLayerConfiguration
 from deeplearning4j_tpu.optim.executor import LossTracker, TrainingExecutor
 from deeplearning4j_tpu.optim.recovery import build_plan, run_with_recovery
@@ -341,7 +342,12 @@ class MultiLayerNetwork(SeqCtxJitCache, SeqCtxSolverCache):
 
         if not jit:
             return step_fn
-        return jax.jit(step_fn, donate_argnums=(0, 1, 2))
+        # donatemon.instrument is identity with DL4J_TPU_DONATEMON off;
+        # on, it witnesses the (params, opt_state, states) donation.
+        return donatemon.instrument(
+            jax.jit(step_fn, donate_argnums=(0, 1, 2)), (0, 1, 2),
+            name="MultiLayerNetwork._step",
+            arg_names=("params", "opt_state", "states"))
 
     # ---------------------------------------------------------- fit API
     def fit(self, data, labels=None, *, epochs: int = 1, batch_size: int = 32,
@@ -440,7 +446,10 @@ class MultiLayerNetwork(SeqCtxJitCache, SeqCtxSolverCache):
                 (feats, labs, fms, lms))
             return params, opt_state, states, rng, losses
 
-        fn = jax.jit(fused, donate_argnums=(0, 1, 2))
+        fn = donatemon.instrument(
+            jax.jit(fused, donate_argnums=(0, 1, 2)), (0, 1, 2),
+            name="MultiLayerNetwork._fused_step",
+            arg_names=("params", "opt_state", "states"))
         self._jit_cache[cache_key] = fn
         return fn
 

@@ -26,6 +26,13 @@ every layer shares:
   cross-check for the GL7xx lockset rules: named-lock wrappers record
   per-thread acquisition orders (lock-order inversions → GL702) and
   guarded-field access races (→ GL701) during the thread-hammer suites.
+- `DonationWitness` (`donatemon.py`) — opt-in (`DL4J_TPU_DONATEMON=1`)
+  runtime cross-check for the GL8xx sharding/donation rules:
+  `instrument()` wraps donating jitted entry points, marks donated
+  buffers dead (id-pinned by strong refs), and emits GL801-tagged
+  events when a stale buffer is passed back in; with the flag off the
+  step function is returned unchanged (zero overhead, perf-gate
+  pinned).
 - `python -m deeplearning4j_tpu.observe.dump` (`dump.py`) — pretty-print
   a registry snapshot or tail a span JSONL.
 - `reqtrace.py` — request-scoped causal trace trees (TraceContext at the
@@ -62,6 +69,10 @@ from deeplearning4j_tpu.observe.lockmon import (
     LockWitness, MonitoredLock, get_witness, lockmon_enabled,
     reset_witness,
 )
+from deeplearning4j_tpu.observe.donatemon import (
+    DonationWitness, UseAfterDonateError, donatemon_enabled,
+    get_donation_witness, instrument, reset_donation_witness,
+)
 from deeplearning4j_tpu.observe.flight import (
     FlightRecorder, get_flight, latest_dump, read_dump, set_flight,
 )
@@ -92,6 +103,8 @@ __all__ = [
     "HostSyncMonitor", "current_monitor",
     "LockWitness", "MonitoredLock", "get_witness", "lockmon_enabled",
     "reset_witness",
+    "DonationWitness", "UseAfterDonateError", "donatemon_enabled",
+    "get_donation_witness", "instrument", "reset_donation_witness",
     "FlightRecorder", "get_flight", "set_flight", "latest_dump", "read_dump",
     "DeviceMonitor", "device_memory_summary", "get_device_monitor",
     "maybe_start_monitor", "set_device_monitor",

@@ -27,6 +27,7 @@ from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
 from deeplearning4j_tpu.data.iterators import (
     DevicePrefetchIterator, as_iterator,
 )
+from deeplearning4j_tpu.observe import donatemon
 from deeplearning4j_tpu.optim.executor import TrainingExecutor
 from deeplearning4j_tpu.optim.recovery import RecoveryPlan, run_with_recovery
 from deeplearning4j_tpu.parallel.distributed import (
@@ -162,8 +163,11 @@ class ParallelWrapper(SeqCtxJitCache):
         # placement — the moments stay replica-sharded through the update
         # instead of silently re-replicating (the regression the perf
         # gate's opt_state_shard_factor budget exists to catch).
-        fn = jax.jit(base, in_shardings=in_sh, out_shardings=out_sh,
-                     donate_argnums=(0, 1, 2))
+        fn = donatemon.instrument(
+            jax.jit(base, in_shardings=in_sh, out_shardings=out_sh,
+                    donate_argnums=(0, 1, 2)), (0, 1, 2),
+            name="ParallelWrapper._step",
+            arg_names=("params", "opt_state", "states"))
         self._jit_cache[key] = fn
         return fn
 
@@ -424,8 +428,11 @@ class ParallelWrapper(SeqCtxJitCache):
         # (params, opt, states, rng, losses)
         out_sh = (self._params_sh, self._opt_sh, self._rep, self._rep,
                   self._rep)
-        fn = jax.jit(fused, in_shardings=in_sh, out_shardings=out_sh,
-                     donate_argnums=(0, 1, 2))
+        fn = donatemon.instrument(
+            jax.jit(fused, in_shardings=in_sh, out_shardings=out_sh,
+                    donate_argnums=(0, 1, 2)), (0, 1, 2),
+            name="ParallelWrapper._fused_step",
+            arg_names=("params", "opt_state", "states"))
         self._jit_cache[key] = fn
         return fn
 

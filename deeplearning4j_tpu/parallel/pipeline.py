@@ -32,6 +32,7 @@ from jax import lax
 
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from deeplearning4j_tpu.observe import donatemon
 from deeplearning4j_tpu.parallel.mesh import (AXIS_DATA, AXIS_PIPE,
                                               shard_map_compat)
 
@@ -313,7 +314,11 @@ class PipelineParallel:
             new_params = _tmap(lambda a, b: a - b.astype(a.dtype), params, upd)
             return new_params, new_opt, loss
 
-        return jax.jit(step, donate_argnums=(0, 1))
+        # donatemon.instrument is identity with DL4J_TPU_DONATEMON off.
+        return donatemon.instrument(
+            jax.jit(step, donate_argnums=(0, 1)), (0, 1),
+            name="PipelinedNetwork._step",
+            arg_names=("params", "opt_state"))
 
     def fit_batch(self, x, y, it: int = 0) -> float:
         if self._step is None:
@@ -531,7 +536,11 @@ class PipelinedNetwork:
                                params_all, upd)
             return new_params, new_opt, loss
 
-        return jax.jit(step, donate_argnums=(0, 1))
+        # donatemon.instrument is identity with DL4J_TPU_DONATEMON off.
+        return donatemon.instrument(
+            jax.jit(step, donate_argnums=(0, 1)), (0, 1),
+            name="PipelineParallel._step",
+            arg_names=("params", "opt_state"))
 
     def fit_batch(self, x, labels, it: Optional[int] = None) -> float:
         net = self.net

@@ -477,6 +477,93 @@ FIXTURES = {
                      self.pending.append(f)
          """, False, False),
     ],
+    "GL801": [
+        ("""
+         import jax
+         def train(state, batch):
+             step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+             new_state = step(state, batch)
+             return state          # read after donation
+         """, False, True),
+        ("""
+         import jax
+         def train(state, batch):
+             step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+             state = step(state, batch)   # same-statement rebind
+             return state
+         """, False, False),
+    ],
+    "GL802": [
+        ("""
+         import jax
+         import jax.numpy as jnp
+         from jax.sharding import PartitionSpec as P
+         @jax.jit
+         def f(x, y):
+             a = jax.lax.with_sharding_constraint(x, P("data"))
+             b = jax.lax.with_sharding_constraint(y, P("model"))
+             return jnp.concatenate([a, b])
+         """, False, True),
+        ("""
+         import jax
+         import jax.numpy as jnp
+         from jax.sharding import PartitionSpec as P
+         @jax.jit
+         def f(x, y):
+             a = jax.lax.with_sharding_constraint(x, P("data"))
+             b = jax.lax.with_sharding_constraint(y, P("data"))
+             return jnp.concatenate([a, b])   # same spec: no reshard
+         """, False, False),
+    ],
+    "GL803": [
+        ("""
+         import jax
+         step = jax.jit(lambda tree: tree)
+         def a(u, v):
+             return step({"w": u, "b": v})
+         def b(u, v):
+             return step({"b": v, "w": u})   # key order flips treedef
+         """, False, True),
+        ("""
+         import jax
+         step = jax.jit(lambda tree: tree)
+         def a(u, v):
+             return step({"w": u, "b": v})
+         def b(u, v):
+             return step({"w": v, "b": u})   # same treedef
+         """, False, False),
+    ],
+    "GL804": [
+        ("""
+         import json
+         import jax
+         def export(params):
+             y = jax.jit(lambda a: a)(params)
+             return json.dumps({"y": y})
+         """, False, True),
+        ("""
+         import json
+         import jax
+         import numpy as np
+         def export(params):
+             y = jax.jit(lambda a: a)(params)
+             return json.dumps({"y": np.asarray(y).tolist()})
+         """, False, False),
+    ],
+    "GL805": [
+        ("""
+         import jax
+         @jax.jit
+         def f(x):
+             return jax.lax.psum(x, "data")
+         """, False, True),
+        ("""
+         import jax
+         @jax.jit
+         def f(x, axis):
+             return jax.lax.psum(x, axis)   # spine-provided axis name
+         """, False, False),
+    ],
 }
 
 
@@ -1165,3 +1252,433 @@ def test_lint_paths_filters_and_sorts(tmp_path):
     assert found[0].path <= found[1].path
     assert lint_paths([str(tmp_path)], ignore=["GL4"]) == []
     assert len(lint_paths([str(tmp_path)], select=["GL401"])) == 2
+
+
+# ---------------------------------- GL8xx shardflow (sharding/donation)
+
+_HELPER_UAD_SRC = """
+import jax
+import jax.numpy as jnp
+
+
+def make_step():
+    def step(state, batch):
+        return jax.tree_util.tree_map(lambda a: a + batch, state)
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def train(state, batches):
+    step = make_step()
+    for batch in batches:
+        new_state = step(state, batch)
+        norm = jnp.sqrt(sum(jnp.sum(a * a) for a in state.values()))
+        state = new_state
+    return state
+"""
+
+
+def test_gl801_through_helper():
+    """Donation facts cross a resolved helper: `make_step()` returns a
+    donating callable, so the bound `step`'s first arg is donated."""
+    findings = [f for f in lint_source(_HELPER_UAD_SRC, "pkg/train.py")
+                if f.rule == "GL801"]
+    assert len(findings) == 1
+    f = findings[0]
+    assert "`state`" in f.message
+    assert f.related, "GL801 must point back at the donating call site"
+    assert "donated here" in f.related[0][2]
+    # the related donation site is the step(state, batch) call line
+    assert f.related[0][1] < f.line or f.related[0][1] > 0
+
+
+def test_gl801_self_attr_lazy_step():
+    """The repo's lazily-built donated step idiom: `self._step =
+    self._build_step()` types the attribute, and a stale read of the
+    donated `self.params` after the call fires."""
+    src = """
+import jax
+
+
+class Net:
+    def _build_step(self):
+        def step(params, opt, x):
+            return params, opt
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def fit(self, x):
+        if self._step is None:
+            self._step = self._build_step()
+        new_p, new_o = self._step(self.params, self.opt, x)
+        norm = self.params          # stale: donated at position 0
+        self.params, self.opt = new_p, new_o
+        return norm
+"""
+    findings = [f for f in lint_source(src, "pkg/net.py")
+                if f.rule == "GL801"]
+    assert len(findings) == 1
+    assert "`self.params`" in findings[0].message
+
+
+def test_gl801_real_pipeline_clean_and_mutant_fires():
+    """Regression pin for the audited tree: the shipped
+    parallel/pipeline.py same-statement-rebind idiom is GL801-clean,
+    and re-introducing a stale read between the donating call and the
+    rebind fires at exactly that read."""
+    path = os.path.join(REPO_ROOT, "deeplearning4j_tpu", "parallel",
+                        "pipeline.py")
+    with open(path, encoding="utf-8") as fh:
+        src = fh.read()
+    rel = "deeplearning4j_tpu/parallel/pipeline.py"
+    clean = [f for f in lint_source(src, rel) if f.rule == "GL801"]
+    assert clean == [], [f.message for f in clean]
+
+    target = """        self.params, self.opt_state, loss = self._step(
+            self.params, self.opt_state, jnp.asarray(it, jnp.int32),
+            x_mb, y_mb)
+        return float(loss)"""
+    mutant = """        new_params, new_opt, loss = self._step(
+            self.params, self.opt_state, jnp.asarray(it, jnp.int32),
+            x_mb, y_mb)
+        norm = _tmap(lambda a: a * a, self.params)
+        self.params, self.opt_state = new_params, new_opt
+        return float(loss)"""
+    assert target in src, "pipeline fit_batch idiom moved; update test"
+    broken = src.replace(target, mutant, 1)
+    fired = [f for f in lint_source(broken, rel) if f.rule == "GL801"]
+    assert fired, "stale read of donated self.params must fire GL801"
+    assert "`self.params`" in fired[0].message
+    assert fired[0].related and "donated here" in fired[0].related[0][2]
+
+
+def test_gl802_relates_both_placement_sites():
+    src = FIXTURES["GL802"][0][0]
+    findings = [f for f in lint_source(textwrap.dedent(src), "pkg/mod.py")
+                if f.rule == "GL802"]
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.related and len(f.related) >= 2, \
+        "GL802 must relate the two placement sites"
+
+
+def test_gl803_two_call_sites_carry_related():
+    src = FIXTURES["GL803"][0][0]
+    findings = [f for f in lint_source(textwrap.dedent(src), "pkg/mod.py")
+                if f.rule == "GL803"]
+    assert len(findings) == 1
+    f = findings[0]
+    assert f.related, "GL803 must point at the other call site"
+    assert f.related[0][1] != f.line
+
+
+def test_gl804_device_get_launders():
+    src = """
+import json
+import jax
+
+
+def export(params):
+    y = jax.jit(lambda a: a)(params)
+    return json.dumps({"y": jax.device_get(y)})
+"""
+    assert [f.rule for f in lint_source(src, "pkg/mod.py")
+            if f.rule == "GL804"] == []
+
+
+def test_gl805_mesh_module_is_exempt():
+    src = textwrap.dedent(FIXTURES["GL805"][0][0])
+    in_mesh = [f.rule for f in lint_source(
+        src, "deeplearning4j_tpu/parallel/mesh.py")]
+    assert "GL805" not in in_mesh
+
+
+def test_gl8_allow_suppression_covers():
+    src = """
+import jax
+
+
+def train(state, batch):
+    step = jax.jit(lambda s, b: s, donate_argnums=(0,))
+    new_state = step(state, batch)
+    return state   # graft: allow(GL801): checkpoint reads pre-donation copy
+"""
+    assert "GL801" not in [f.rule for f in lint_source(src, "pkg/mod.py")]
+
+
+def test_gl8_sarif_related_locations_roundtrip():
+    findings = [f for f in lint_source(_HELPER_UAD_SRC, "pkg/train.py")
+                if f.rule == "GL801"]
+    doc = json.loads(render_sarif(findings, files=1))
+    res = doc["runs"][0]["results"][0]
+    assert res["ruleId"] == "GL801"
+    rel = res["relatedLocations"]
+    assert rel, "GL8xx SARIF results must carry relatedLocations"
+    phys = rel[0]["physicalLocation"]
+    assert phys["artifactLocation"]["uri"] == "pkg/train.py"
+    assert phys["region"]["startLine"] == findings[0].related[0][1]
+    assert rel[0]["message"]["text"] == findings[0].related[0][2]
+
+
+def test_repo_gl8_audit_clean():
+    """Acceptance gate: the strict GL8xx pass exits 0 over the package
+    with no baseline."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "deeplearning4j_tpu.analysis",
+         "deeplearning4j_tpu", "--strict", "--select", "GL8",
+         "--no-cache"],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, \
+        f"GL8xx audit failed:\n{proc.stdout}\n{proc.stderr}"
+
+
+# ------------------------------- result cache (.graftlint-cache.json)
+
+def _seed_tree(tmp_path, n=40):
+    """A small synthetic package: every file parses, a couple carry
+    findings, and the volume makes the cold interprocedural pass cost
+    measurable."""
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    for i in range(n):
+        body = "\n".join(
+            f"def f{i}_{j}(x):\n"
+            f"    y = x + {j}\n"
+            f"    return y\n" for j in range(12))
+        (pkg / f"m{i}.py").write_text(
+            "import threading\n\n" + body, encoding="utf-8")
+    (pkg / "bad.py").write_text(
+        "def f(x, acc=[]):\n    return acc\n", encoding="utf-8")
+    return pkg
+
+
+def test_cache_warm_parity_and_speedup(tmp_path):
+    import time
+    pkg = _seed_tree(tmp_path)
+    cache = str(tmp_path / "cache.json")
+    t0 = time.perf_counter()
+    cold = lint_paths([str(pkg)], cache_path=cache)
+    t1 = time.perf_counter()
+    warm = lint_paths([str(pkg)], cache_path=cache)
+    t2 = time.perf_counter()
+    assert [f.to_dict() for f in warm] == [f.to_dict() for f in cold]
+    assert any(f.rule == "GL401" for f in warm)
+    cold_s, warm_s = t1 - t0, t2 - t1
+    assert warm_s * 5 <= cold_s, \
+        f"warm re-lint must be >=5x faster (cold {cold_s:.3f}s, " \
+        f"warm {warm_s:.3f}s)"
+
+
+def test_cache_invalidated_on_edit(tmp_path):
+    pkg = _seed_tree(tmp_path, n=3)
+    cache = str(tmp_path / "cache.json")
+    before = lint_paths([str(pkg)], cache_path=cache)
+    assert sum(f.rule == "GL401" for f in before) == 1
+    # introduce a new finding in a previously-clean file; bump mtime
+    target = pkg / "m0.py"
+    target.write_text("def g(x, acc={}):\n    return acc\n",
+                      encoding="utf-8")
+    os.utime(target, (0, 0))    # force a stat-signature change
+    after = lint_paths([str(pkg)], cache_path=cache)
+    assert sum(f.rule == "GL401" for f in after) == 2
+
+
+def test_cache_invalidated_on_rules_version(tmp_path):
+    from deeplearning4j_tpu.analysis import cache as cache_mod
+    pkg = _seed_tree(tmp_path, n=2)
+    cache = str(tmp_path / "cache.json")
+    cold = lint_paths([str(pkg)], cache_path=cache)
+    with open(cache, encoding="utf-8") as fh:
+        doc = json.load(fh)
+    assert doc["rules_version"] == cache_mod.RULES_VERSION
+    # a rules-version bump discards the doc wholesale
+    doc["rules_version"] = cache_mod.RULES_VERSION - 1
+    with open(cache, "w", encoding="utf-8") as fh:
+        json.dump(doc, fh)
+    fresh = cache_mod.load_cache(cache, doc["config"])
+    assert fresh["files"] == {}
+    # and a relint recomputes with identical results
+    warm = lint_paths([str(pkg)], cache_path=cache)
+    assert [f.to_dict() for f in warm] == [f.to_dict() for f in cold]
+
+
+def test_cache_partial_run_keeps_other_entries(tmp_path):
+    """A subset (--changed-style) run must not evict full-run entries."""
+    pkg = _seed_tree(tmp_path, n=3)
+    cache = str(tmp_path / "cache.json")
+    lint_paths([str(pkg)], cache_path=cache)
+    with open(cache, encoding="utf-8") as fh:
+        n_full = len(json.load(fh)["files"])
+    lint_paths([str(pkg / "bad.py")], cache_path=cache)
+    with open(cache, encoding="utf-8") as fh:
+        assert len(json.load(fh)["files"]) == n_full
+
+
+def test_cli_no_cache_flag(tmp_path, capsys):
+    _write(tmp_path, "ok.py", "x = 1\n")
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        assert lint_main(["ok.py", "--strict"]) == 0
+        assert os.path.exists(".graftlint-cache.json")
+        os.remove(".graftlint-cache.json")
+        assert lint_main(["ok.py", "--strict", "--no-cache"]) == 0
+        assert not os.path.exists(".graftlint-cache.json")
+    finally:
+        os.chdir(cwd)
+        capsys.readouterr()
+
+
+# ------------------------------------------------------ prune-baseline
+
+def test_prune_baseline_cli(tmp_path, capsys):
+    _write(tmp_path, "mod.py",
+           "def f(x, acc=[]):\n    return acc\n")
+    cwd = os.getcwd()
+    os.chdir(tmp_path)
+    try:
+        assert lint_main(["mod.py", "--write-baseline", "bl.json"]) == 0
+        with open("bl.json", encoding="utf-8") as fh:
+            doc = json.load(fh)
+        doc["findings"].append({"rule": "GL402", "path": "gone.py",
+                                "snippet": "except:", "count": 2})
+        with open("bl.json", "w", encoding="utf-8") as fh:
+            json.dump(doc, fh)
+        capsys.readouterr()
+        assert lint_main(["mod.py", "--baseline", "bl.json",
+                          "--prune-baseline"]) == 0
+        out = capsys.readouterr().out
+        assert "pruned GL402 gone.py" in out
+        assert "1 remain" in out
+        kept = load_baseline("bl.json")
+        assert list(kept) == [("GL401", "mod.py", "def f(x, acc=[]):")]
+        # idempotent: nothing left to prune
+        assert lint_main(["mod.py", "--baseline", "bl.json",
+                          "--prune-baseline"]) == 0
+        assert "pruned 0 stale" in capsys.readouterr().out
+    finally:
+        os.chdir(cwd)
+
+
+# -------------------------------------- donatemon (runtime cross-check)
+
+def test_donatemon_disabled_is_identity(monkeypatch):
+    from deeplearning4j_tpu.observe import donatemon
+    monkeypatch.delenv("DL4J_TPU_DONATEMON", raising=False)
+    donatemon.reset_donation_witness()
+    assert donatemon.get_donation_witness() is None
+
+    def step(s, b):
+        return s
+    # zero-overhead contract: the function object comes back unchanged
+    assert donatemon.instrument(step, (0,)) is step
+
+
+def test_donatemon_env_flag_enables(monkeypatch):
+    from deeplearning4j_tpu.observe import donatemon
+    monkeypatch.setenv("DL4J_TPU_DONATEMON", "1")
+    donatemon.reset_donation_witness()
+    try:
+        w = donatemon.get_donation_witness()
+        assert w is not None and donatemon.get_donation_witness() is w
+
+        def step(s, b):
+            return s
+        wrapped = donatemon.instrument(step, (0,))
+        assert wrapped is not step
+        assert wrapped.__wrapped__ is step
+    finally:
+        donatemon.reset_donation_witness()
+
+
+def test_donatemon_witness_marks_and_touches():
+    import numpy as np
+    from deeplearning4j_tpu.observe.donatemon import DonationWitness
+    w = DonationWitness()
+    state = {"w": np.zeros((2, 2), np.float32),
+             "b": np.zeros((2,), np.float32)}
+    assert w.mark_donated(state, "state", "train_step") == 2
+    # scalar leaves are not buffers
+    assert w.mark_donated({"k": 3}, "k", "train_step") == 0
+    events = w.touch(state, "state")
+    assert len(events) == 2
+    assert all(ev["rule"] == "GL801" for ev in events)
+    assert events[0]["buffer"] == "state"
+    # dedup: touching again reports nothing new
+    assert w.touch(state, "state") == []
+    rep = w.report()
+    assert rep["donations"] == 2 and len(rep["events"]) == 2
+    assert rep["static_rules"]["use_after_donate"] == runtime_hint(
+        "use_after_donate")
+
+
+def test_donatemon_fresh_buffers_stay_quiet():
+    import numpy as np
+    from deeplearning4j_tpu.observe.donatemon import (
+        DonationWitness, instrument,
+    )
+    w = DonationWitness()
+
+    def step(state, batch):
+        return {k: v + batch for k, v in state.items()}
+
+    inst = instrument(step, (0,), arg_names=("state", "batch"), witness=w)
+    state = {"w": np.zeros((2,), np.float32)}
+    for _ in range(5):
+        state = inst(state, np.float32(1.0))   # rebind: always fresh
+    assert w.report()["events"] == []
+
+
+def test_donatemon_raise_mode():
+    import numpy as np
+    from deeplearning4j_tpu.observe.donatemon import (
+        DonationWitness, UseAfterDonateError, instrument,
+    )
+    w = DonationWitness(raise_on_use=True)
+
+    def step(state, batch):
+        return dict(state)
+
+    inst = instrument(step, (0,), arg_names=("state", "batch"), witness=w)
+    state = {"w": np.zeros((2,), np.float32)}
+    inst(state, None)
+    with pytest.raises(UseAfterDonateError) as ei:
+        inst(state, None)
+    assert ei.value.event["rule"] == "GL801"
+    assert ei.value.event["buffer"] == "state"
+
+
+def test_donatemon_matches_static_gl801():
+    """The cross-check the smoke tool automates: same rule id, same
+    buffer identity, statically and at runtime."""
+    import numpy as np
+    from deeplearning4j_tpu.observe.donatemon import (
+        DonationWitness, instrument,
+    )
+    static = [f for f in lint_source(_HELPER_UAD_SRC, "pkg/train.py")
+              if f.rule == "GL801"]
+    assert len(static) == 1
+    assert "`state`" in static[0].message
+
+    w = DonationWitness()
+
+    def step(state, batch):
+        return {k: v + batch for k, v in state.items()}
+
+    inst = instrument(step, (0,), name="make_step.step",
+                      arg_names=("state", "batch"), witness=w)
+    state = {"w": np.zeros((3,), np.float32)}
+    inst(state, np.float32(1.0))
+    inst(state, np.float32(1.0))     # the seeded stale reuse
+    events = w.report()["events"]
+    assert events and events[0]["rule"] == static[0].rule == "GL801"
+    assert events[0]["buffer"] == "state"
+
+
+def test_donatemon_smoke_script():
+    proc = subprocess.run(
+        [sys.executable, os.path.join("tools", "donatemon_smoke.py")],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0, \
+        f"donatemon_smoke failed:\n{proc.stdout}\n{proc.stderr}"
+    assert "OK" in proc.stdout

@@ -14,9 +14,13 @@
 #   tools/ci_check.sh --slo      # SLO smoke: deliberate latency breach
 #                                #   must fire /slo, degrade /healthz,
 #                                #   write an slo_breach flight dump
-#   tools/ci_check.sh --locks    # concurrency gate: GL7xx lockset pass
-#                                #   strict over the package, then the
-#                                #   static↔runtime lock-witness smoke
+#   tools/ci_check.sh --analysis # interprocedural gate: GL7xx lockset
+#                                #   + GL8xx shardflow strict over the
+#                                #   package in ONE shared-callgraph
+#                                #   run, then both static↔runtime
+#                                #   witness smokes (lockmon GL702,
+#                                #   donatemon GL801)
+#   tools/ci_check.sh --locks    # alias for --analysis (pre-GL8xx name)
 #   tools/ci_check.sh --fleet    # serving-fleet smoke: 1 router + 2
 #                                #   replica processes — disaggregated
 #                                #   prefill→handoff→decode, a drain-
@@ -52,11 +56,16 @@ if [[ "${1:-}" == "--slo" ]]; then
     exit 0
 fi
 
-if [[ "${1:-}" == "--locks" ]]; then
-    echo "== concurrency gate (GL7xx strict + lock-witness cross-check) =="
+if [[ "${1:-}" == "--locks" || "${1:-}" == "--analysis" ]]; then
+    echo "== interprocedural gate (GL7xx+GL8xx strict, shared callgraph) =="
+    # One invocation, both families: the engine builds the whole-program
+    # call graph once and runs the lockset + shardflow passes over it.
     python -m deeplearning4j_tpu.analysis deeplearning4j_tpu \
-        --strict --select GL701,GL702,GL703,GL704
+        --strict --select GL7,GL8
+    echo "== lock-witness cross-check (GL702 static vs runtime) =="
     python tools/lockmon_smoke.py
+    echo "== donation-witness cross-check (GL801 static vs runtime) =="
+    python tools/donatemon_smoke.py
     exit 0
 fi
 

@@ -1,0 +1,123 @@
+#!/usr/bin/env python
+"""Static ↔ runtime donation-witness cross-check smoke (the GL801 loop).
+
+One seeded use-after-donate, proven twice:
+
+1. **statically** — graft-lint's GL8xx shardflow pass over the seeded
+   trainer source reports GL801: `state` is read after being donated
+   to the jitted step (with the donating call site as the related
+   location);
+2. **at runtime** — the same step shape is instrumented with
+   `donatemon.instrument` (numpy stands in for device arrays; the
+   witness is id()-based, so the backend is irrelevant) and called
+   twice with the SAME state pytree — exactly the stale reuse the
+   static pass flagged — and the DonationWitness records an event
+   tagged with the same rule id.
+
+The assertion that closes the loop: the runtime event's rule id AND
+buffer identity (`state`) are string-equal to the rule id and the
+variable the static finding names. `tools/ci_check.sh --analysis`
+runs this after the strict GL7xx+GL8xx lint.
+
+Exit 0 on success, 1 with a diagnostic on any mismatch.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from deeplearning4j_tpu.analysis import lint_source  # noqa: E402
+from deeplearning4j_tpu.observe.donatemon import (  # noqa: E402
+    DonationWitness, instrument,
+)
+
+BUFFER = "state"
+
+# The seeded hazard: `state` is donated to the jitted step, then read
+# again — the canonical stale-buffer reuse GL801 exists to catch.
+_TRAINER_SRC = '''\
+import jax
+import jax.numpy as jnp
+
+
+def make_step():
+    def step(state, batch):
+        return jax.tree_util.tree_map(lambda a: a + batch, state)
+
+    return jax.jit(step, donate_argnums=(0,))
+
+
+def train(state, batches):
+    step = make_step()
+    for batch in batches:
+        new_state = step(state, batch)
+        norm = jnp.sqrt(sum(jnp.sum(a * a) for a in state.values()))
+        state = new_state
+    return state
+'''
+
+
+def _static_finding():
+    findings = [f for f in lint_source(_TRAINER_SRC, path="pkg/trainer.py")
+                if f.rule == "GL801"]
+    if not findings:
+        raise SystemExit("donatemon_smoke: static pass found no GL801 "
+                         "in the seeded trainer source")
+    return findings[0]
+
+
+def _runtime_event():
+    witness = DonationWitness()
+
+    def step(state, batch):
+        return {k: v + batch for k, v in state.items()}
+
+    inst = instrument(step, (0,), name="make_step.step",
+                      arg_names=("state", "batch"), witness=witness)
+    state = {"w": np.zeros((4, 4), np.float32),
+             "b": np.zeros((4,), np.float32)}
+    batch = np.float32(1.0)
+    inst(state, batch)
+    # the seeded bug: the SAME (now donated) state pytree goes back in.
+    inst(state, batch)
+    report = witness.report()
+    if not report["events"]:
+        raise SystemExit("donatemon_smoke: runtime witness saw no "
+                         f"use-after-donate (report: {report})")
+    return report["events"][0]
+
+
+def main() -> int:
+    static = _static_finding()
+    event = _runtime_event()
+
+    ok = True
+    if event["rule"] != static.rule:
+        print(f"rule mismatch: runtime {event['rule']} != "
+              f"static {static.rule}")
+        ok = False
+    if f"`{BUFFER}`" not in static.message:
+        print(f"static GL801 message does not name '{BUFFER}': "
+              f"{static.message}")
+        ok = False
+    if event["buffer"] != BUFFER:
+        print(f"runtime event buffer {event['buffer']!r} != {BUFFER!r}")
+        ok = False
+    if not static.related:
+        print("static GL801 carries no related donation site")
+        ok = False
+    if not ok:
+        return 1
+    print(f"donatemon_smoke: OK — static {static.rule} and runtime "
+          f"witness agree on buffer '{BUFFER}' "
+          f"(donated to {event['callee']})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

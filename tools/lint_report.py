@@ -44,11 +44,19 @@ def main(argv=None):
     by_rule = Counter(f.rule for f in findings)
     by_cat = Counter(RULES[f.rule].category for f in findings)
     by_file = Counter(f.path for f in findings)
+    # GLnxx families (GL5xx sharding-syntactic, GL7xx lockset, GL8xx
+    # shardflow, ...): every family with a registered rule appears,
+    # zeros included, so the digest shows which gates ran clean.
+    families = sorted({rid[:3] + "xx" for rid in RULES if rid != "GL000"})
+    by_family = {fam: sum(n for rid, n in by_rule.items()
+                          if rid.startswith(fam[:3]))
+                 for fam in families}
 
     if args.json:
         json.dump({"tool": "graft-lint", "baselined": baselined,
                    "findings": len(findings),
                    "by_category": dict(sorted(by_cat.items())),
+                   "by_family": by_family,
                    "by_rule": dict(sorted(by_rule.items())),
                    "by_file": dict(by_file.most_common())},
                   sys.stdout, indent=1, sort_keys=True)
@@ -57,6 +65,9 @@ def main(argv=None):
 
     print(f"graft-lint digest: {len(findings)} finding(s), "
           f"{baselined} baselined")
+    print("\n  by family:")
+    for fam in families:
+        print(f"    {fam:<6} {by_family[fam]}")
     if by_cat:
         print("\n  by category:")
         for cat, n in by_cat.most_common():
