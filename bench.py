@@ -784,6 +784,17 @@ def _append_history(mode, summary):
             v = summary["spec"].get(key)
             if v is not None:
                 row["spec_" + key] = v
+    # the comm ledger trends as flat comm_* scalars (the dash comm
+    # panel reads comm_step_all_reduce_bytes / comm_reconciled)
+    if isinstance(summary.get("comm_ledger"), dict):
+        cl = summary["comm_ledger"]
+        for key, hk in (("measured_step_all_reduce_bytes",
+                         "comm_step_all_reduce_bytes"),
+                        ("reconciliation_error", "comm_rec_error"),
+                        ("reconciled", "comm_reconciled")):
+            v = cl.get(key)
+            if v is not None:
+                row[hk] = v
     # the shared-prefix cache trends as flat prefix_* scalars (the
     # dash sparkline reads prefix_hit_rate / prefix_ttft_speedup)
     if isinstance(summary.get("prefix"), dict):
@@ -1946,6 +1957,27 @@ def _sharding_main():
                 mon.uninstall()
             warm_recompiles = (get_watchdog().snapshot()["total_compiles"]
                                - warm0)
+            # comm ledger while the leg's private watchdog is still
+            # installed: per-owner-class collective totals plus the
+            # single heaviest all-reduce program — the wrapper's train
+            # step, the figure the analytic DP expectation prices
+            comm = {}
+            for tag, orow in get_watchdog().snapshot()["per_owner"].items():
+                cols = orow.get("collectives") or {}
+                if not cols:
+                    continue
+                cls = tag.split("@", 1)[0]
+                agg = comm.setdefault(cls, {
+                    "programs": 0, "ops": 0, "wire_bytes": 0,
+                    "step_all_reduce_bytes": 0})
+                for srow in cols.values():
+                    agg["programs"] += 1
+                    agg["ops"] += srow.get("ops", 0)
+                    agg["wire_bytes"] += srow.get("wire_bytes", 0)
+                    ar = (srow.get("by_kind") or {}).get("all-reduce", {})
+                    agg["step_all_reduce_bytes"] = max(
+                        agg["step_all_reduce_bytes"],
+                        ar.get("wire_bytes", 0))
         finally:
             set_watchdog(prev)
         steps = 2 * (128 // 32)
@@ -1964,6 +1996,7 @@ def _sharding_main():
             "syncs_per_step": round(mon.syncs / steps, 3),
             "warm_recompiles": int(warm_recompiles),
             "final_score": float(net.score_),
+            "comm": comm,
         }, wrap
 
     replicated, _ = leg(False)
@@ -1972,6 +2005,30 @@ def _sharding_main():
                     jax.tree_util.tree_leaves(wrap.net.updater_state))
     factor = (replicated["per_device_opt_state_bytes"]
               / max(sharded["per_device_opt_state_bytes"], 1))
+    # comm-ledger reconciliation: on the REPLICATED (pure-DP) leg the
+    # train step's gradient all-reduce must price at the textbook
+    # 4 * param_count * (n-1)/n per-device ring bytes — the ledger's
+    # one-pass-ring convention makes the two directly comparable (the
+    # scalar loss all-reduce adds ~n/(n-1) bytes of slack, inside tol)
+    ndev = jax.device_count()
+    param_count = sum(int(leaf.size) for leaf in
+                      jax.tree_util.tree_leaves(wrap.net.params_tree))
+    expected_ar = 4.0 * param_count * (ndev - 1) / ndev
+    measured_ar = (replicated["comm"].get("ParallelWrapper", {})
+                   .get("step_all_reduce_bytes", 0))
+    rec_err = (abs(measured_ar - expected_ar) / expected_ar
+               if expected_ar else 1.0)
+    comm_ledger = {
+        "convention": "one-pass ring: wire = payload*(g-1)/g per device",
+        "param_count": param_count,
+        "expected_dp_all_reduce_bytes": int(round(expected_ar)),
+        "measured_step_all_reduce_bytes": int(measured_ar),
+        "reconciliation_error": round(rec_err, 4),
+        "reconciled": bool(rec_err <= 0.1),
+        "sharded_step_all_reduce_bytes": int(
+            sharded["comm"].get("ParallelWrapper", {})
+            .get("step_all_reduce_bytes", 0)),
+    }
     out = {
         "metric": "sharding_spine",
         "devices": jax.device_count(),
@@ -1981,6 +2038,7 @@ def _sharding_main():
         "opt_state_shard_factor": round(factor, 2),
         "losses_match": abs(replicated["final_score"]
                             - sharded["final_score"]) < 1e-4,
+        "comm_ledger": comm_ledger,
         "replicated": replicated,
         "sharded": sharded,
         "device_memory": _devices_summary(),

@@ -215,6 +215,7 @@ RUNTIME_RULE_HINTS: Dict[str, Tuple[str, ...]] = {
     "guarded_field": ("GL701",),
     "use_after_donate": ("GL801",),
     "device_serialized": ("GL804",),
+    "reshard": ("GL802",),
 }
 
 

@@ -246,7 +246,10 @@ class ComputationGraph(SeqCtxJitCache, SeqCtxSolverCache):
             return self._jit_cache[key]
         fn = self._build_step(jit=True, tbptt=tbptt)
         self._jit_cache[key] = fn
-        return fn
+        # read back through the cache: __setitem__ may have wrapped the
+        # callable in the watchdog's cost/comm probe, and returning the
+        # raw local lets the FIRST dispatch bypass the ledger
+        return self._jit_cache[key]
 
     def _build_step(self, jit: bool, tbptt: bool = False):
         mode = self.conf.gradient_normalization
@@ -423,7 +426,8 @@ class ComputationGraph(SeqCtxJitCache, SeqCtxSolverCache):
             name="ComputationGraph._fused_step",
             arg_names=("params", "opt_state", "states"))
         self._jit_cache[cache_key] = fn
-        return fn
+        # read back through the cache (probe wrapping; see _get_train_step)
+        return self._jit_cache[cache_key]
 
     def _fused_dispatch(self, batches: Sequence):
         """K same-shape batches → one `lax.scan` dispatch → (K,) losses."""

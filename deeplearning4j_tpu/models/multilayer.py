@@ -287,7 +287,10 @@ class MultiLayerNetwork(SeqCtxJitCache, SeqCtxSolverCache):
             return self._jit_cache[key]
         fn = self._build_step(key, jit=True)
         self._jit_cache[key] = fn
-        return fn
+        # read back through the cache: __setitem__ may have wrapped the
+        # callable in the watchdog's cost/comm probe, and returning the
+        # raw local lets the FIRST dispatch bypass the ledger
+        return self._jit_cache[key]
 
     @property
     def _rnn_layer_names(self):
@@ -451,7 +454,8 @@ class MultiLayerNetwork(SeqCtxJitCache, SeqCtxSolverCache):
             name="MultiLayerNetwork._fused_step",
             arg_names=("params", "opt_state", "states"))
         self._jit_cache[cache_key] = fn
-        return fn
+        # read back through the cache (probe wrapping; see _get_train_step)
+        return self._jit_cache[cache_key]
 
     def _fused_dispatch(self, batches: List[DataSet]):
         """Run K stacked same-shape batches as ONE `lax.scan` dispatch.

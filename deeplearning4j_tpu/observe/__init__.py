@@ -33,6 +33,16 @@ every layer shares:
   events when a stale buffer is passed back in; with the flag off the
   step function is returned unchanged (zero overhead, perf-gate
   pinned).
+- comm ledger + `ReshardWitness` (`commsmon.py`) — collective-traffic
+  observability: the watchdog's compile probe walks every compiled
+  program's HLO for all-reduce/all-gather/reduce-scatter/
+  collective-permute/all-to-all inventory
+  (`jit_collective_{ops,bytes}_total{owner,kind}`, snapshot
+  `collectives` blocks), and an opt-in (`DL4J_TPU_COMMSMON=1`) runtime
+  witness compares committed argument shardings against the mesh
+  spine's declared specs at the dispatch seams — divergences are
+  GL802-tagged (`reshard_events_total{owner}`), string-comparable with
+  static shardflow findings; off means the dispatch path is unchanged.
 - `python -m deeplearning4j_tpu.observe.dump` (`dump.py`) — pretty-print
   a registry snapshot or tail a span JSONL.
 - `reqtrace.py` — request-scoped causal trace trees (TraceContext at the
@@ -73,6 +83,10 @@ from deeplearning4j_tpu.observe.donatemon import (
     DonationWitness, UseAfterDonateError, donatemon_enabled,
     get_donation_witness, instrument, reset_donation_witness,
 )
+from deeplearning4j_tpu.observe.commsmon import (
+    ReshardWitness, commsmon_enabled, get_reshard_witness,
+    parse_hlo_collectives, reset_reshard_witness, summarize_collectives,
+)
 from deeplearning4j_tpu.observe.flight import (
     FlightRecorder, get_flight, latest_dump, read_dump, set_flight,
 )
@@ -105,6 +119,9 @@ __all__ = [
     "reset_witness",
     "DonationWitness", "UseAfterDonateError", "donatemon_enabled",
     "get_donation_witness", "instrument", "reset_donation_witness",
+    "ReshardWitness", "commsmon_enabled", "get_reshard_witness",
+    "reset_reshard_witness", "parse_hlo_collectives",
+    "summarize_collectives",
     "FlightRecorder", "get_flight", "set_flight", "latest_dump", "read_dump",
     "DeviceMonitor", "device_memory_summary", "get_device_monitor",
     "maybe_start_monitor", "set_device_monitor",

@@ -163,6 +163,7 @@ class FlightRecorder:
             for key, fn in (("registry", self._registry_snapshot),
                             ("watchdog", self._watchdog_snapshot),
                             ("syncmon", self._syncmon_snapshot),
+                            ("commsmon", self._commsmon_snapshot),
                             ("devices", self._device_sample),
                             ("traces", self._traces_snapshot)):
                 try:
@@ -252,6 +253,16 @@ class FlightRecorder:
         from deeplearning4j_tpu.observe.syncmon import current_monitor
         mon = current_monitor()
         return mon.snapshot() if mon is not None else None
+
+    @staticmethod
+    def _commsmon_snapshot():
+        # the comm ledger (per-owner collective totals from compiled
+        # programs) + the reshard witness report when it is live
+        from deeplearning4j_tpu.observe.commsmon import get_reshard_witness
+        from deeplearning4j_tpu.observe.watchdog import get_watchdog
+        wit = get_reshard_witness()
+        return {"comm_totals": get_watchdog().comm_totals(),
+                "reshard": wit.report() if wit is not None else None}
 
     @staticmethod
     def _device_sample():

@@ -70,6 +70,7 @@ class RecompileWatchdog:
         self._counts: Dict[str, int] = {}
         self._signatures: Dict[str, List[str]] = {}
         self._costs: Dict[str, Dict[str, dict]] = {}
+        self._collectives: Dict[str, Dict[str, dict]] = {}
         self._warned: set = set()
 
     def _registry(self):
@@ -148,6 +149,32 @@ class RecompileWatchdog:
             fr.record("compile_cost", owner=owner_class, tag=owner_tag,
                       key=repr(key)[:160], **entry)
 
+    def record_collectives(self, owner_tag: str, owner_class: str, key,
+                           summary: dict) -> None:
+        """Attach a compiled-module collective inventory (the comm
+        ledger block from `commsmon.summarize_collectives`) to a
+        compile — fed by the `_CostProbe`'s compiled-artifact walk.
+        Publishes the `jit_collective_ops_total` /
+        `jit_collective_bytes_total{owner,kind}` counters (owner-CLASS
+        label, bounded cardinality like `jit_compiles`)."""
+        with self._lock:
+            rows = self._collectives.setdefault(owner_tag, {})
+            sig = repr(key)
+            if len(rows) < _MAX_SIGNATURES or sig in rows:
+                rows[sig] = dict(summary)
+        from deeplearning4j_tpu.observe.commsmon import (
+            publish_collectives,
+        )
+        publish_collectives(owner_class, summary,
+                            registry=self._registry())
+        if summary.get("ops"):
+            fr = _flight()
+            if fr is not None:
+                fr.record("compile_collectives", owner=owner_class,
+                          tag=owner_tag, key=repr(key)[:160],
+                          ops=summary["ops"],
+                          wire_bytes=summary["wire_bytes"])
+
     # --------------------------------------------------------- reporting
     def warned(self, owner_tag: str) -> bool:
         """Has this owner tripped the churn threshold? The deploy-gate
@@ -163,6 +190,33 @@ class RecompileWatchdog:
                 return self._counts.get(owner_tag, 0)
             return sum(self._counts.values())
 
+    def owner_comm_totals(self, owner_tag: str) -> Optional[dict]:
+        """Collective totals across every program this owner compiled
+        ({"programs", "ops", "wire_bytes"}), or None when the comm
+        ledger recorded nothing — the cheap host-side read the dispatch
+        spans attach. Zero really means zero: degenerate
+        single-participant ops never count (commsmon contract)."""
+        with self._lock:
+            rows = self._collectives.get(owner_tag)
+            if rows is None:
+                return None
+            return {"programs": len(rows),
+                    "ops": sum(r.get("ops", 0) for r in rows.values()),
+                    "wire_bytes": sum(r.get("wire_bytes", 0)
+                                      for r in rows.values())}
+
+    def comm_totals(self) -> dict:
+        """Whole-process comm rollup keyed by owner tag (flight dumps
+        embed this next to the per-owner snapshot)."""
+        with self._lock:
+            tags = list(self._collectives)
+        out = {}
+        for tag in tags:
+            tot = self.owner_comm_totals(tag)
+            if tot is not None:
+                out[tag] = tot
+        return out
+
     def snapshot(self) -> dict:
         with self._lock:
             return {
@@ -173,6 +227,9 @@ class RecompileWatchdog:
                     tag: {"compiles": n,
                           "signatures": list(self._signatures.get(tag, ())),
                           "costs": dict(self._costs.get(tag, {})),
+                          "collectives": {
+                              sig: dict(row) for sig, row in
+                              self._collectives.get(tag, {}).items()},
                           "warned": tag in self._warned}
                     for tag, n in self._counts.items()},
             }
@@ -182,11 +239,20 @@ class RecompileWatchdog:
             self._counts.clear()
             self._signatures.clear()
             self._costs.clear()
+            self._collectives.clear()
             self._warned.clear()
 
 
 def _cost_probe_enabled() -> bool:
     return os.environ.get("DL4J_TPU_COMPILE_COST", "1") != "0"
+
+
+def _comm_ledger_enabled() -> bool:
+    """The compile-time collective ledger (commsmon's static leg).
+    Default ON like the cost probe — it prices one extra AOT compile
+    per first-seen program, never a hot-path call. `DL4J_TPU_COMPILE_COMM=0`
+    drops back to the cost-analysis-only ledger."""
+    return os.environ.get("DL4J_TPU_COMPILE_COMM", "1") != "0"
 
 
 _cost_failure_logged = False
@@ -214,12 +280,28 @@ def note_cost_analysis_failure(detail: str) -> None:
 
 def _arg_specs(args, kw):
     """ShapeDtypeStructs for the array arguments of a jit call (non-array
-    leaves pass through untouched, so static args keep their values)."""
+    leaves pass through untouched, so static args keep their values).
+    Committed shardings ride along: without them the probe's lowering is
+    an unsharded program, GSPMD inserts no collectives, and the comm
+    ledger would read zero on every sharded owner."""
     try:
         import jax
 
         def spec(x):
             if hasattr(x, "shape") and hasattr(x, "dtype"):
+                sharding = getattr(x, "sharding", None)
+                if sharding is not None and getattr(
+                        x, "_committed", True):
+                    try:
+                        return jax.ShapeDtypeStruct(
+                            x.shape, x.dtype, sharding=sharding)
+                    # graft: allow(GL403): a sharding ShapeDtypeStruct
+                    # rejects (e.g. non-XLA-compatible sharding) →
+                    # degrade to the unsharded spec below; the ledger
+                    # then under-reports collectives rather than
+                    # poisoning the dispatch path
+                    except Exception:
+                        pass
                 return jax.ShapeDtypeStruct(x.shape, x.dtype)
             return x
 
@@ -230,9 +312,11 @@ def _arg_specs(args, kw):
 
 
 def _record_lowered_cost(fn, specs, owner_tag, owner_class, key) -> None:
+    lowered = None
     try:
         spec_args, spec_kw = specs
-        cost = fn.lower(*spec_args, **spec_kw).cost_analysis()
+        lowered = fn.lower(*spec_args, **spec_kw)
+        cost = lowered.cost_analysis()
         if isinstance(cost, (list, tuple)):
             cost = cost[0] if cost else {}
         cost = cost or {}
@@ -243,6 +327,40 @@ def _record_lowered_cost(fn, specs, owner_tag, owner_class, key) -> None:
     except Exception as e:
         note_cost_analysis_failure(
             f"lowering cost analysis failed: {type(e).__name__}")
+    # the comm ledger rides the same lowering; a failed cost_analysis
+    # does not forfeit the collective walk (and vice versa)
+    if lowered is not None and _comm_ledger_enabled():
+        _record_compiled_comm(lowered, owner_tag, owner_class, key)
+
+
+def _record_compiled_comm(lowered, owner_tag, owner_class, key) -> None:
+    """Walk the compiled artifact for the collective inventory.
+
+    Degradation contract (commsmon): a backend that cannot AOT-compile,
+    or a jax version whose `as_text()` shape differs, degrades to the
+    cost-analysis-only ledger — the failure logs once via
+    `note_cost_analysis_failure`, counts in
+    `profiling_cost_analysis_failures`, and NEVER raises into the jit
+    cache seam. An artifact that compiles but yields unparseable text
+    records an EMPTY inventory (parse tolerance lives in the parser)."""
+    try:
+        text = lowered.compile().as_text()
+    except Exception as e:
+        note_cost_analysis_failure(
+            f"compiled-HLO comm walk failed: {type(e).__name__}")
+        return
+    try:
+        from deeplearning4j_tpu.observe.commsmon import (
+            parse_hlo_collectives, summarize_collectives,
+        )
+        if not isinstance(text, str):       # as_text() shape drifted
+            raise TypeError(type(text).__name__)
+        summary = summarize_collectives(parse_hlo_collectives(text))
+        get_watchdog().record_collectives(owner_tag, owner_class, key,
+                                          summary)
+    except Exception as e:
+        note_cost_analysis_failure(
+            f"collective inventory failed: {type(e).__name__}")
 
 
 class _CostProbe:
@@ -256,8 +374,12 @@ class _CostProbe:
     lowering needs the concrete argument avals. Why specs are captured
     BEFORE the call runs: donated input buffers are deleted by the call
     itself. `Lowered.cost_analysis()` traces but does not compile, so
-    the one-time probe costs one extra trace, never a second XLA
-    compile — and nothing it touches can force a device sync."""
+    the cost leg costs one extra trace. The comm-ledger leg
+    (`DL4J_TPU_COMPILE_COMM`, default on) additionally AOT-compiles the
+    lowering to walk the post-GSPMD module for collectives — one extra
+    background compile per FIRST-seen program, never counted as a jit
+    cache insertion and never on a steady-state path; nothing either
+    leg touches can force a device sync."""
 
     __slots__ = ("fn", "_owner_tag", "_owner_class", "_key", "_done",
                  "_lock")

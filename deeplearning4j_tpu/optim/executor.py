@@ -35,8 +35,10 @@ from deeplearning4j_tpu.observe import get_registry, reqtrace, span
 from deeplearning4j_tpu.observe.attribution import (
     StepAttribution, attribution_enabled,
 )
+from deeplearning4j_tpu.observe.commsmon import get_reshard_witness
 from deeplearning4j_tpu.observe.devicemon import maybe_start_monitor
 from deeplearning4j_tpu.observe.flight import get_flight
+from deeplearning4j_tpu.observe.watchdog import get_watchdog
 
 __all__ = ["LossTracker", "TrainingExecutor", "SKIP", "STOP"]
 
@@ -189,6 +191,9 @@ class TrainingExecutor:
         # per-epoch request trace (reqtrace) — None when sampling is off,
         # so the hot loop pays one attribute read per dispatch window
         self._rt = None
+        # commsmon reshard witness — None when DL4J_TPU_COMMSMON is off,
+        # so the disabled hot loop pays one attribute read per dispatch
+        self._reshard = get_reshard_witness()
         reg = get_registry()
         self._iter_counter = reg.counter("train_iterations")
         self._etl_hist = reg.histogram("train_etl_ms")
@@ -270,6 +275,8 @@ class TrainingExecutor:
                             else:
                                 self._drain(buf)
                                 buf = []
+                                if self._reshard is not None:
+                                    self._witness_batch(ds)
                                 t_d = time.perf_counter()
                                 loss = self.step(ds)
                                 dispatch_ms = (time.perf_counter()
@@ -321,20 +328,63 @@ class TrainingExecutor:
         """Record one train.dispatch span keyed (epoch, step-window).
 
         dur_ms is the host ENQUEUE time for the window — never a device
-        wait, so the span machinery stays sync-free."""
+        wait, so the span machinery stays sync-free. When the comm
+        ledger has priced this owner's compiled programs, the span also
+        carries the owner-level collective totals (comm_ops /
+        comm_bytes) — host-side metadata from the watchdog, never a
+        device read."""
         rt = self._rt
         if rt is None:
             return
         ep = self.net.epoch
+        attrs = dict(dur_ms=dur_ms, epoch=ep,
+                     window=f"{ep}:{bi_lo}-{bi_hi}",
+                     steps=bi_hi - bi_lo + 1, fused=fused)
+        comm = self._comm_totals()
+        if comm is not None:
+            attrs["comm_ops"] = comm["ops"]
+            attrs["comm_bytes"] = comm["wire_bytes"]
         reqtrace.record_span(
-            rt.trace_id, "train.dispatch", parent_id=rt.span_id,
-            dur_ms=dur_ms, epoch=ep, window=f"{ep}:{bi_lo}-{bi_hi}",
-            steps=bi_hi - bi_lo + 1, fused=fused)
+            rt.trace_id, "train.dispatch", parent_id=rt.span_id, **attrs)
+
+    def _comm_totals(self) -> Optional[dict]:
+        """Owner-level compiled-collective totals for the net's active
+        jit cache, or None when nothing was priced (ledger disabled,
+        probe not fired yet, owner without a WatchedJitCache)."""
+        try:
+            tag = getattr(self.net._jit_cache, "owner_tag", None)
+            if tag is None:
+                return None
+            return get_watchdog().owner_comm_totals(tag)
+        # graft: allow(GL403): span decoration is best-effort by design
+        except Exception:
+            return None
+
+    def _witness_batch(self, ds) -> None:
+        """Reshard-witness seam (commsmon, GL802): before a dispatch,
+        compare the batch's COMMITTED shardings against the mesh spine's
+        declared batch spec. Metadata-only, and `self._reshard` is None
+        whenever commsmon is off, so the hot path pays one attribute
+        read."""
+        mesh_ctx = self.mesh_ctx
+        if mesh_ctx is None:
+            return
+        from deeplearning4j_tpu.observe.commsmon import check_dispatch_args
+        owner = type(self.net).__name__
+        spec = mesh_ctx.batch_spec      # leaf -> P(batch_axis, None, ...)
+        named = {}
+        for field in ("features", "labels"):
+            v = getattr(ds, field, None)
+            if v is not None:
+                named[field] = (v, lambda leaf: spec(leaf.ndim))
+        check_dispatch_args(owner, named, witness=self._reshard)
 
     def _drain(self, buf) -> None:
         """Flush a partial fusion buffer through the per-step path (a
         short tail would need its own K'-sized compile)."""
         for bi, ds, etl_ms in buf:
+            if self._reshard is not None:
+                self._witness_batch(ds)
             t_d = time.perf_counter()
             loss = self.step(ds)
             dispatch_ms = (time.perf_counter() - t_d) * 1e3
@@ -344,6 +394,8 @@ class TrainingExecutor:
                 self.after_dispatch(bi)
 
     def _run_fused(self, buf) -> None:
+        if self._reshard is not None:
+            self._witness_batch(buf[0][1])
         t_d = time.perf_counter()
         losses = self.fused_step([ds for _, ds, _ in buf])
         # one dispatch for K steps: attribute its enqueue cost evenly

@@ -169,7 +169,11 @@ class ParallelWrapper(SeqCtxJitCache):
             name="ParallelWrapper._step",
             arg_names=("params", "opt_state", "states"))
         self._jit_cache[key] = fn
-        return fn
+        # read back through the cache: __setitem__ may have wrapped the
+        # callable in the watchdog's cost/comm probe, and returning the
+        # raw local would let the FIRST dispatch (often the only one in
+        # a short fit) bypass the ledger entirely
+        return self._jit_cache[key]
 
     # -------------------------------------------------------------- fit
     def _pad_to_divisible(self, ds):
@@ -434,7 +438,8 @@ class ParallelWrapper(SeqCtxJitCache):
             name="ParallelWrapper._fused_step",
             arg_names=("params", "opt_state", "states"))
         self._jit_cache[key] = fn
-        return fn
+        # read back through the cache (probe wrapping), as in _get_step
+        return self._jit_cache[key]
 
     def _fused_step(self, batches):
         """K pre-sharded batches → one sharded `lax.scan` dispatch."""

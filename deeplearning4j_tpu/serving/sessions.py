@@ -382,6 +382,10 @@ class DecodeSessionManager:
                                            model=model)
         self._h_accept = metrics.histogram(
             "serving_spec_acceptance_rate", model=model)
+        # commsmon reshard witness — None when DL4J_TPU_COMMSMON is off,
+        # so the disabled dispatch path pays one attribute read
+        from deeplearning4j_tpu.observe.commsmon import get_reshard_witness
+        self._reshard = get_reshard_witness()
 
         # the decode endpoint: an ordinary registry entry whose "runner"
         # is this manager — scheduler dispatch, drain-on-retire and
@@ -967,6 +971,8 @@ class DecodeSessionManager:
                     act_d[s] = False
             net = self.pool.net
             carries = self.pool.carries
+            if self._reshard is not None:
+                self._witness_carries(net, carries)
             if pre.size and act_p.any():
                 x = _encode(tok, self._encoding, self.vocab)
                 _, carries = net.session_step(
@@ -1076,6 +1082,36 @@ class DecodeSessionManager:
                                 (time.perf_counter() - t0) * 1e3)
         return ys
 
+    def _witness_carries(self, net, carries) -> None:
+        """Reshard-witness seam (commsmon, GL802) for the decode
+        dispatch: until the model axis ships (ROADMAP item 1), session
+        carries are REPLICATED by contract — a committed non-replicated
+        sharding on any carry leaf is exactly where GSPMD would insert a
+        per-window reshard collective. No active mesh context means
+        single-device semantics: nothing to check, zero cost."""
+        from deeplearning4j_tpu.observe.commsmon import check_dispatch_args
+        from deeplearning4j_tpu.parallel.mesh import current_mesh_context
+        if current_mesh_context() is None:
+            return
+        check_dispatch_args(f"{type(net).__name__}.decode",
+                            {"carries": (carries, ())},
+                            witness=self._reshard)
+
+    def _comm_totals(self) -> Optional[dict]:
+        """Owner-level compiled-collective totals for the serving net's
+        active jit cache (None when the ledger has priced nothing)."""
+        try:
+            from deeplearning4j_tpu.observe.watchdog import get_watchdog
+            with self.pool.lock():
+                net = self.pool.net
+            tag = getattr(net._jit_cache, "owner_tag", None)
+            if tag is None:
+                return None
+            return get_watchdog().owner_comm_totals(tag)
+        # graft: allow(GL403): span decoration is best-effort by design
+        except Exception:
+            return None
+
     def _trace_windows(self, dtrace, slots_idx, phase, nvalid,
                        emit_n: dict, acc_n: dict, bucket: int, k: int,
                        dur_ms: float) -> None:
@@ -1089,6 +1125,10 @@ class DecodeSessionManager:
         with self._lock:
             by_slot = {s.slot: s for s in self._sessions.values()
                        if s.trace is not None}
+        # comm ledger totals for the serving net, once per dispatch:
+        # every window span of this dispatch carries the same owner-level
+        # collective figures (host metadata; {} keeps attrs uniform)
+        comm = self._comm_totals() or {}
         for i in range(slots_idx.shape[0]):
             s = int(slots_idx[i])
             sess = by_slot.get(s)
@@ -1112,6 +1152,8 @@ class DecodeSessionManager:
                 spec=bool(self.spec_enabled and decode),
                 accepted=int(acc_n.get(s, 0)),
                 prefix_cache=int(sess._cached_len),
+                comm_ops=int(comm.get("ops", 0)),
+                comm_bytes=int(comm.get("wire_bytes", 0)),
                 # graft: allow(GL701): span attribute reads one atomic
                 # str reference; a concurrent hot-swap may label one
                 # window with the outgoing kernel kind — harmless

@@ -6,7 +6,8 @@ from deeplearning4j_tpu.utils.timesource import (
     NTPTimeSource, SystemClockTimeSource, TimeSource, TimeSourceProvider,
 )
 from deeplearning4j_tpu.utils.profiling import (
-    ProfilerListener, peak_flops, peak_hbm_bytes, step_flops, trace,
+    ProfilerListener, peak_flops, peak_hbm_bytes, peak_ici_bytes,
+    step_flops, trace,
 )
 
 __all__ = [
@@ -14,5 +15,6 @@ __all__ = [
     "flatten_params", "unflatten_params", "param_count", "tree_norm",
     "TimeSource", "SystemClockTimeSource", "NTPTimeSource",
     "TimeSourceProvider", "ProfilerListener", "peak_flops",
+    "peak_ici_bytes",
     "peak_hbm_bytes", "step_flops", "trace",
 ]

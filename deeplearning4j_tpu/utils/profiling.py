@@ -54,6 +54,25 @@ PEAK_HBM_BYTES_BY_KIND = (
     ("v2", 0.700e12),
 )
 
+# Peak inter-chip interconnect (ICI) bandwidth per chip, bytes/s —
+# aggregate across links, one direction (public spec sheets; v5e/v6e
+# figures are the 4-link 2D-torus aggregates, v4/v5p the 6-link 3D).
+# Paired with the commsmon comm ledger's per-device wire bytes these
+# price a program's collective time the way PEAK_HBM prices its memory
+# time — tools/comm_report.py joins the two to classify jit owners
+# compute-bound vs comm-bound.
+PEAK_ICI_BYTES_BY_KIND = (
+    ("v6", 0.448e12),     # Trillium / v6e: 4 x ~112 GB/s
+    ("v5p", 0.600e12),    # 6 x 100 GB/s
+    ("v5 lite", 0.200e12),
+    ("v5litepod", 0.200e12),
+    ("v5e", 0.200e12),    # 4 x 50 GB/s
+    ("v5", 0.600e12),
+    ("v4", 0.300e12),     # 6 x 50 GB/s
+    ("v3", 0.280e12),
+    ("v2", 0.160e12),
+)
+
 
 _warned_kinds: set = set()
 
@@ -99,6 +118,29 @@ def peak_hbm_bytes(device_kind: Optional[str] = None) -> Optional[float]:
             "peak_hbm_bytes: unrecognized device kind %r — no spec-sheet "
             "bandwidth known. Add the kind to PEAK_HBM_BYTES_BY_KIND or "
             "pass the peak explicitly.", device_kind)
+    return None
+
+
+def peak_ici_bytes(device_kind: Optional[str] = None) -> Optional[float]:
+    """Per-chip peak interconnect bandwidth (bytes/s, one direction) for
+    a device kind (default: device 0). Same contract as `peak_flops`:
+    unknown kinds return None and warn once — callers must omit, never
+    fabricate, a comm roofline."""
+    if device_kind is None:
+        # spec-sheet lookup keys off the chip model, not placement
+        device_kind = jax.devices()[0].device_kind  # graft: allow(GL501): roofline reads device kind only
+    kind = device_kind.lower()
+    for key, peak in PEAK_ICI_BYTES_BY_KIND:
+        if key in kind:
+            return peak
+    warn_key = ("ici", kind)
+    if warn_key not in _warned_kinds:
+        _warned_kinds.add(warn_key)
+        logger.warning(
+            "peak_ici_bytes: unrecognized device kind %r — no spec-sheet "
+            "interconnect bandwidth known. Add the kind to "
+            "PEAK_ICI_BYTES_BY_KIND or pass the peak explicitly.",
+            device_kind)
     return None
 
 

@@ -17,9 +17,9 @@
 #   tools/ci_check.sh --analysis # interprocedural gate: GL7xx lockset
 #                                #   + GL8xx shardflow strict over the
 #                                #   package in ONE shared-callgraph
-#                                #   run, then both static↔runtime
+#                                #   run, then the static↔runtime
 #                                #   witness smokes (lockmon GL702,
-#                                #   donatemon GL801)
+#                                #   donatemon GL801, commsmon GL802)
 #   tools/ci_check.sh --locks    # alias for --analysis (pre-GL8xx name)
 #   tools/ci_check.sh --fleet    # serving-fleet smoke: 1 router + 2
 #                                #   replica processes — disaggregated
@@ -66,6 +66,8 @@ if [[ "${1:-}" == "--locks" || "${1:-}" == "--analysis" ]]; then
     python tools/lockmon_smoke.py
     echo "== donation-witness cross-check (GL801 static vs runtime) =="
     python tools/donatemon_smoke.py
+    echo "== reshard-witness cross-check (GL802 static vs runtime + comm ledger) =="
+    python tools/commsmon_smoke.py
     exit 0
 fi
 

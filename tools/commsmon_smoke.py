@@ -1,0 +1,168 @@
+#!/usr/bin/env python
+"""Static ↔ runtime reshard-witness cross-check smoke (the GL802 loop).
+
+One seeded cross-spec combine, proven twice:
+
+1. **statically** — graft-lint's GL8xx shardflow pass over the seeded
+   tower-merge source reports GL802: `x` and `y` carry different
+   placement provenance (`P('data',None)` vs `P(None,'model')`) into a
+   `concatenate`, so GSPMD inserts an implicit resharding collective
+   at the combine point;
+2. **at runtime** — a dispatch with the same spec divergence goes
+   through `commsmon.instrument` (a metadata stub stands in for a
+   committed jax.Array: the witness reads only `.sharding.spec`, never
+   the buffer, so the backend is irrelevant) and the ReshardWitness
+   records an event tagged with the same rule id.
+
+The assertions that close the loop: the runtime event's rule id is
+string-equal to the static finding's, RUNTIME_RULE_HINTS maps the
+witness's event kind to that same id, and the canonical spec string the
+witness records (`('data',None)`) is exactly the static message's spec
+with the `P` constructor stripped — the two passes speak one spec
+grammar. A third leg sanity-checks the compile-side comm ledger: a
+canned HLO all-reduce over 8 replicas must parse to one op with
+one-pass-ring wire bytes `payload * 7/8`.
+
+`tools/ci_check.sh --analysis` runs this after the strict GL7xx+GL8xx
+lint. Exit 0 on success, 1 with a diagnostic on any mismatch.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import types
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+from deeplearning4j_tpu.analysis import lint_source  # noqa: E402
+from deeplearning4j_tpu.observe.commsmon import (  # noqa: E402
+    ReshardWitness, instrument, parse_hlo_collectives,
+    summarize_collectives,
+)
+
+DECLARED = ("data", None)        # the spine-declared spec for `x`
+COMMITTED = (None, "model")      # what actually arrives at dispatch
+
+# The seeded hazard: two towers constrained to different specs are
+# concatenated — the canonical implicit-reshard GL802 exists to catch.
+_TOWERS_SRC = '''\
+import jax.numpy as jnp
+from jax.lax import with_sharding_constraint
+from jax.sharding import PartitionSpec as P
+
+
+def merge_towers(x, y):
+    x = with_sharding_constraint(x, P("data", None))
+    y = with_sharding_constraint(y, P(None, "model"))
+    return jnp.concatenate([x, y], axis=0)
+'''
+
+# One 8-replica gradient all-reduce: 256 f32 = 1024 payload bytes,
+# one-pass ring wire bytes = 1024 * 7/8 = 896.
+_HLO_SNIPPET = """\
+HloModule smoke
+ENTRY main {
+  %p0 = f32[256]{0} parameter(0)
+  ROOT %ar = f32[256]{0} all-reduce(f32[256]{0} %p0), \
+replica_groups={{0,1,2,3,4,5,6,7}}, to_apply=%add
+}
+"""
+
+
+class _StubSharded:
+    """Metadata-only stand-in for a committed jax.Array — the witness
+    reads `.shape`/`.dtype`/`.sharding.spec` and never the buffer."""
+
+    def __init__(self, spec):
+        self.shape = (8, 4)
+        self.dtype = "float32"
+        self.sharding = types.SimpleNamespace(spec=spec)
+
+
+def _static_finding():
+    findings = [f for f in lint_source(_TOWERS_SRC, path="pkg/towers.py")
+                if f.rule == "GL802"]
+    if not findings:
+        raise SystemExit("commsmon_smoke: static pass found no GL802 "
+                         "in the seeded tower-merge source")
+    return findings[0]
+
+
+def _runtime_event():
+    witness = ReshardWitness()
+
+    def dispatch(x):
+        return x
+
+    # off-switch contract first: no witness, no env flag -> identity
+    os.environ.pop("DL4J_TPU_COMMSMON", None)
+    if instrument(dispatch) is not dispatch:
+        raise SystemExit("commsmon_smoke: instrument() with commsmon "
+                         "off must return the function unchanged")
+
+    inst = instrument(dispatch, name="merge_towers.dispatch",
+                      arg_specs=(DECLARED,), arg_names=("x",),
+                      witness=witness)
+    # the seeded divergence: a buffer committed under the OTHER spec.
+    inst(_StubSharded(COMMITTED))
+    report = witness.report()
+    if not report["events"]:
+        raise SystemExit("commsmon_smoke: runtime witness saw no "
+                         f"reshard divergence (report: {report})")
+    return report["events"][0], report
+
+
+def _ledger_check():
+    ops = [o for o in parse_hlo_collectives(_HLO_SNIPPET)
+           if not o["degenerate"]]
+    summary = summarize_collectives(parse_hlo_collectives(_HLO_SNIPPET))
+    if len(ops) != 1 or ops[0]["kind"] != "all-reduce":
+        raise SystemExit("commsmon_smoke: canned HLO should parse to "
+                         f"exactly one all-reduce, got {ops}")
+    if ops[0]["wire_bytes"] != 896 or summary["wire_bytes"] != 896:
+        raise SystemExit("commsmon_smoke: 1024B payload over an "
+                         "8-replica ring must cost 896 wire bytes, got "
+                         f"{ops[0]['wire_bytes']} / {summary}")
+
+
+def main() -> int:
+    _ledger_check()
+    static = _static_finding()
+    event, report = _runtime_event()
+
+    ok = True
+    if event["rule"] != static.rule:
+        print(f"rule mismatch: runtime {event['rule']} != "
+              f"static {static.rule}")
+        ok = False
+    if report["static_rules"].get("reshard") != static.rule:
+        print("RUNTIME_RULE_HINTS does not map 'reshard' to "
+              f"{static.rule}: {report['static_rules']}")
+        ok = False
+    # one spec grammar: the static message spells the declared spec as
+    # P(...) source text; the witness records the same tuple canonically.
+    if f"P{event['expected']}" not in static.message:
+        print(f"spec grammar mismatch: runtime expected "
+              f"{event['expected']!r} (as P{event['expected']}) not in "
+              f"static message: {static.message}")
+        ok = False
+    if event["actual"] != "(None,'model')":
+        print(f"runtime event actual spec {event['actual']!r} != "
+              f"\"(None,'model')\"")
+        ok = False
+    if not static.related or len(static.related) < 2:
+        print("static GL802 does not carry both placement sites")
+        ok = False
+    if not ok:
+        return 1
+    print(f"commsmon_smoke: OK — static {static.rule} and runtime "
+          f"witness agree on the divergence "
+          f"(declared {event['expected']}, committed {event['actual']}); "
+          f"ledger prices the canned 8-replica all-reduce at 896 wire "
+          f"bytes")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

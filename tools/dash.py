@@ -264,7 +264,8 @@ def render_bench(path: str, *, mode: str = "", width: int = 40) -> str:
                       "continuous_p99_ms", "opt_state_shard_factor",
                       "spec_tokens_per_s", "spec_acceptance_rate",
                       "spec_speedup_vs_stepwise",
-                      "prefix_hit_rate", "prefix_ttft_speedup"):
+                      "prefix_hit_rate", "prefix_ttft_speedup",
+                      "comm_step_all_reduce_bytes"):
             evals = [r[extra] for r in rs
                      if isinstance(r.get(extra), (int, float))]
             if evals:
@@ -302,6 +303,20 @@ def render_bench(path: str, *, mode: str = "", width: int = 40) -> str:
                     bits.append(f"{tag} {_fmt(last[key])}")
             lines.append("  prefix cache (latest run): "
                          + ", ".join(bits))
+        # the comm-ledger panel: per-step gradient all-reduce wire
+        # bytes vs the analytic 4*params*(n-1)/n, and whether the
+        # latest run reconciled (bench.py --sharding comm_ledger block)
+        if isinstance(last.get("comm_step_all_reduce_bytes"),
+                      (int, float)):
+            bits = [f"{_fmt(last['comm_step_all_reduce_bytes'])} B "
+                    f"all-reduce/step"]
+            if isinstance(last.get("comm_rec_error"), (int, float)):
+                bits.append(f"vs analytic "
+                            f"{last['comm_rec_error'] * 100:+.2f}%")
+            if last.get("comm_reconciled") is not None:
+                bits.append("reconciled" if last["comm_reconciled"]
+                            else "NOT RECONCILED")
+            lines.append("  comm ledger (latest run): " + ", ".join(bits))
         # the serving-fleet panel: replica count, router traffic
         # verbs (reroutes/handoffs/migrations/SLO drains), fleet p99,
         # and the per-replica-count scaling legs from the latest run

@@ -33,7 +33,7 @@ sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
 
 BASELINE_PATH = os.path.join(os.path.dirname(__file__), "..",
                              ".graftperf-baseline.json")
-WORKLOAD_VERSION = 8
+WORKLOAD_VERSION = 9
 
 # Default slack written into a fresh baseline: zero extra compiles (a
 # new program IS the regression being hunted) and half a sync of noise
@@ -85,7 +85,28 @@ DEFAULT_BUDGETS = {"extra_compiles_per_owner": 0,
                    # + 4 full-stem hits) must keep its hit rate
                    "extra_prefix_syncs_per_window": 0.5,
                    "extra_prefix_compiles": 0,
-                   "min_prefix_hit_rate": 0.8}
+                   "min_prefix_hit_rate": 0.8,
+                   # collective budgets (commsmon comm ledger, v9): every
+                   # single-replica leg — the fused decode window, spec
+                   # verify, warm-prefix churn — contains ZERO collectives
+                   # by contract (PERF_NOTES); the sharded ParallelWrapper
+                   # leg's per-step gradient all-reduce is byte-exact vs
+                   # baseline (compiled programs are deterministic — one
+                   # extra byte means an op was added to the step)
+                   "max_serving_collective_ops": 0,
+                   "extra_sharded_all_reduce_bytes_per_step": 0}
+
+
+def _comm_cumulative(snap: dict) -> tuple:
+    """(total collective ops, total wire bytes) across every program the
+    watchdog's comm ledger has priced so far — non-degenerate ops only,
+    so 1-replica legs really read zero."""
+    ops = wire = 0
+    for owner in snap["per_owner"].values():
+        for row in (owner.get("collectives") or {}).values():
+            ops += row.get("ops", 0)
+            wire += row.get("wire_bytes", 0)
+    return ops, wire
 
 
 def run_workload() -> dict:
@@ -311,6 +332,7 @@ def run_workload() -> dict:
         sched = ContinuousBatchingScheduler(registry, stats,
                                             max_batch_size=8)
         decode = None
+        comm0 = _comm_cumulative(get_watchdog().snapshot())
         try:
             mgr = DecodeSessionManager(registry, sched, "default",
                                        slots=2, prefill_chunk=4,
@@ -343,6 +365,11 @@ def run_workload() -> dict:
                 "extra_compiles":
                     get_watchdog().snapshot()["total_compiles"]
                     - compiles_warm,
+                # single-replica fused decode: zero collectives by
+                # contract (comm-ledger ops across the whole leg)
+                "collective_ops":
+                    _comm_cumulative(get_watchdog().snapshot())[0]
+                    - comm0[0],
             }
         finally:
             sched.shutdown()
@@ -387,6 +414,7 @@ def run_workload() -> dict:
         sched = ContinuousBatchingScheduler(registry, stats,
                                             max_batch_size=8)
         spec = None
+        comm0 = _comm_cumulative(get_watchdog().snapshot())
         try:
             mgr = DecodeSessionManager(registry, sched, "default",
                                        slots=2, prefill_chunk=4,
@@ -420,6 +448,9 @@ def run_workload() -> dict:
                     - compiles_warm,
                 "acceptance_rate":
                     snap_after["spec_decode"]["acceptance_rate"],
+                "collective_ops":
+                    _comm_cumulative(get_watchdog().snapshot())[0]
+                    - comm0[0],
             }
         finally:
             sched.shutdown()
@@ -440,6 +471,7 @@ def run_workload() -> dict:
         sched = ContinuousBatchingScheduler(registry, stats,
                                             max_batch_size=8)
         prefix = None
+        comm0 = _comm_cumulative(get_watchdog().snapshot())
         try:
             mgr = DecodeSessionManager(registry, sched, "default",
                                        slots=2, prefill_chunk=4,
@@ -481,6 +513,9 @@ def run_workload() -> dict:
                 # dispatch in the measured churn is a decode window
                 "prefill_free": (after["total"] - before["total"]
                                  == windows),
+                "collective_ops":
+                    _comm_cumulative(get_watchdog().snapshot())[0]
+                    - comm0[0],
             }
         finally:
             sched.shutdown()
@@ -521,11 +556,24 @@ def run_workload() -> dict:
                        jax.tree_util.tree_leaves(snet.updater_state))
             per_dev = tree_device_bytes(snet.updater_state)
             mean_dev = sum(per_dev.values()) / max(len(per_dev), 1)
+            # comm-ledger row: the train step's gradient all-reduce is
+            # the heaviest all-reduce program the wrapper compiled —
+            # its per-device ring bytes are deterministic, so the gate
+            # can hold them byte-exact against the baseline
+            step_ar = 0
+            for tag, orow in \
+                    get_watchdog().snapshot()["per_owner"].items():
+                if not tag.startswith("ParallelWrapper@"):
+                    continue
+                for crow in (orow.get("collectives") or {}).values():
+                    ar = (crow.get("by_kind") or {}).get("all-reduce", {})
+                    step_ar = max(step_ar, ar.get("wire_bytes", 0))
             sharded = {
                 "devices": jax.device_count(),
                 "syncs_per_step": round(mon.syncs / ssteps, 3),
                 "opt_state_shard_factor": round(full / mean_dev, 2)
                 if mean_dev else 1.0,
+                "step_all_reduce_bytes": int(step_ar),
             }
 
         snap = get_watchdog().snapshot()
@@ -658,6 +706,16 @@ def compare(baseline: dict, measured: dict) -> list:
                 f"{meas_d.get('extra_compiles')} program(s) after "
                 f"warmup (budget +{d_budget}) — the fixed-shape decode "
                 f"contract: churn at a fixed K never recompiles")
+        if base_d.get("collective_ops") is not None and \
+                (meas_d.get("collective_ops") or 0) > \
+                budgets["max_serving_collective_ops"]:
+            breaches.append(
+                f"fused decode leg compiled programs containing "
+                f"{meas_d.get('collective_ops')} collective op(s) "
+                f"(budget {budgets['max_serving_collective_ops']}) — a "
+                f"single-replica decode window contains ZERO collectives "
+                f"by contract (PERF_NOTES); a sharding constraint leaked "
+                f"into the serving programs")
     # spec-decode leg: only gated once a baseline recorded it
     if baseline.get("spec"):
         base_s = baseline["spec"]
@@ -688,6 +746,15 @@ def compare(baseline: dict, measured: dict) -> list:
                 f"on the deterministic truncated-draft workload — the "
                 f"draft IS the target's lower half here, so a low rate "
                 f"means verify/rewind bookkeeping corrupted lane state")
+        if base_s.get("collective_ops") is not None and \
+                (meas_s.get("collective_ops") or 0) > \
+                budgets["max_serving_collective_ops"]:
+            breaches.append(
+                f"spec-decode leg compiled programs containing "
+                f"{meas_s.get('collective_ops')} collective op(s) "
+                f"(budget {budgets['max_serving_collective_ops']}) — "
+                f"single-replica propose/verify contains zero "
+                f"collectives by contract (PERF_NOTES)")
     # warm-prefix leg: only gated once a baseline recorded it
     if baseline.get("prefix"):
         base_p = baseline["prefix"]
@@ -724,6 +791,15 @@ def compare(baseline: dict, measured: dict) -> list:
                 "warm-prefix sessions dispatched prefill rows — a warm "
                 "full-stem admission skips its ENTIRE prefill by "
                 "contract (PERF_NOTES)")
+        if base_p.get("collective_ops") is not None and \
+                (meas_p.get("collective_ops") or 0) > \
+                budgets["max_serving_collective_ops"]:
+            breaches.append(
+                f"warm-prefix leg compiled programs containing "
+                f"{meas_p.get('collective_ops')} collective op(s) "
+                f"(budget {budgets['max_serving_collective_ops']}) — "
+                f"single-replica paged serving contains zero "
+                f"collectives by contract (PERF_NOTES)")
     # sharded-spine leg: only gated once a baseline recorded it
     base_sh = baseline.get("sharded")
     if base_sh:
@@ -749,6 +825,19 @@ def compare(baseline: dict, measured: dict) -> list:
                     f"{floor} — optimizer moments are sharded across "
                     f"the replica axis by contract (PERF_NOTES); "
                     f"replicating them is a regression")
+            if base_sh.get("step_all_reduce_bytes") is not None:
+                ar_limit = base_sh["step_all_reduce_bytes"] + \
+                    budgets["extra_sharded_all_reduce_bytes_per_step"]
+                if meas_sh.get("step_all_reduce_bytes", 0) > ar_limit:
+                    breaches.append(
+                        f"sharded step all-reduce "
+                        f"{meas_sh.get('step_all_reduce_bytes')} bytes "
+                        f"vs baseline "
+                        f"{base_sh['step_all_reduce_bytes']} (budget +"
+                        f"{budgets['extra_sharded_all_reduce_bytes_per_step']}"
+                        f") — the DP gradient all-reduce grew: an extra "
+                        f"collective (or a wider one) entered the "
+                        f"compiled train step")
     return breaches
 
 
@@ -764,7 +853,8 @@ def diff(baseline: dict, measured: dict) -> list:
     b, m = baseline.get("syncs_per_step"), measured["syncs_per_step"]
     if b != m:
         out.append(f"  syncs_per_step: {b} -> {m}")
-    for key in ("syncs_per_step", "opt_state_shard_factor"):
+    for key in ("syncs_per_step", "opt_state_shard_factor",
+                "step_all_reduce_bytes"):
         b = (baseline.get("sharded") or {}).get(key)
         m = (measured.get("sharded") or {}).get(key)
         if b != m:
@@ -786,19 +876,20 @@ def diff(baseline: dict, measured: dict) -> list:
         m = (measured.get("fedmon") or {}).get(key)
         if b != m:
             out.append(f"  fedmon.{key}: {b} -> {m}")
-    for key in ("syncs_per_window", "extra_compiles"):
+    for key in ("syncs_per_window", "extra_compiles",
+                "collective_ops"):
         b = (baseline.get("decode") or {}).get(key)
         m = (measured.get("decode") or {}).get(key)
         if b != m:
             out.append(f"  decode.{key}: {b} -> {m}")
     for key in ("syncs_per_window", "extra_compiles",
-                "acceptance_rate"):
+                "acceptance_rate", "collective_ops"):
         b = (baseline.get("spec") or {}).get(key)
         m = (measured.get("spec") or {}).get(key)
         if b != m:
             out.append(f"  spec.{key}: {b} -> {m}")
     for key in ("syncs_per_window", "extra_compiles", "hit_rate",
-                "cow_forks"):
+                "cow_forks", "collective_ops"):
         b = (baseline.get("prefix") or {}).get(key)
         m = (measured.get("prefix") or {}).get(key)
         if b != m:
